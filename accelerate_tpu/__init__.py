@@ -1,0 +1,59 @@
+"""accelerate_tpu — a TPU-native training/inference framework.
+
+Brand-new design with the capabilities of the reference HF Accelerate snapshot
+(surveyed in SURVEY.md): one ``Accelerator`` façade over a jit-compiled JAX/XLA
+train step, GSPMD sharding over a named device mesh instead of torch engine
+wrappers, and net-new long-context (ring attention) support.
+"""
+
+__version__ = "0.1.0"
+
+from .state import AcceleratorState, GradientState, PartialState
+from .utils import (
+    DataLoaderConfiguration,
+    DistributedDataParallelKwargs,
+    DistributedInitKwargs,
+    DistributedType,
+    FullyShardedDataParallelPlugin,
+    GradientAccumulationPlugin,
+    GradScalerKwargs,
+    InitProcessGroupKwargs,
+    MixedPrecisionPolicy,
+    ParallelismConfig,
+    ProfileKwargs,
+    ProjectConfiguration,
+    SequenceParallelPlugin,
+    TensorParallelPlugin,
+    set_seed,
+    synchronize_rng_states,
+)
+
+# Accelerator / data-loader / big-modeling symbols are appended to this namespace as
+# their modules land (mirroring reference src/accelerate/__init__.py:16-50).
+
+
+def __getattr__(name):
+    # Lazy imports so `import accelerate_tpu` stays cheap and avoids cycles.
+    if name == "Accelerator":
+        from .accelerator import Accelerator
+
+        return Accelerator
+    if name in ("prepare_data_loader", "skip_first_batches", "DataLoaderShard", "DataLoaderDispatcher"):
+        from . import data_loader
+
+        return getattr(data_loader, name)
+    if name == "find_executable_batch_size":
+        from .utils.memory import find_executable_batch_size
+
+        return find_executable_batch_size
+    if name in ("notebook_launcher", "debug_launcher"):
+        from . import launchers
+
+        return getattr(launchers, name)
+    if name in ("init_empty_weights", "infer_auto_device_map", "dispatch_model",
+                "load_checkpoint_and_dispatch", "cpu_offload", "disk_offload",
+                "load_checkpoint_in_model"):
+        from . import big_modeling
+
+        return getattr(big_modeling, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

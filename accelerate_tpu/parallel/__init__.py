@@ -1,0 +1,1 @@
+from .mesh import build_mesh, data_axes, local_mesh_shape, mesh_axis_names, model_axes

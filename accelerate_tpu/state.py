@@ -1,0 +1,575 @@
+"""Process/device state singletons — L1 of the framework.
+
+Parity target: reference ``src/accelerate/state.py`` (1331 LoC): ``PartialState``
+(``state.py:125``), ``AcceleratorState`` (``state.py:856``), ``GradientState``
+(``state.py:1191``).
+
+TPU-native redesign:
+
+- One **process per host** (JAX model), not one per device: ``num_processes`` is
+  ``jax.process_count()`` and governs host-side work (data loading shards, object
+  broadcast, main-process gating).  Device-level parallelism lives in the *mesh*
+  (``AcceleratorState.mesh``), not in the process layout — this is the fundamental
+  inversion vs the reference, where world-size == device count.
+- Bring-up is ``jax.distributed.initialize`` (coordinator = host 0) instead of
+  ``torch.distributed.init_process_group`` (reference ``state.py:202-269``).
+- The reference's ``ThreadLocalSharedDict`` for XRT TPU v2/v3 (``state.py:93-121``)
+  is unnecessary: PJRT/JAX is single-controller per host.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import threading
+from functools import partial, wraps
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+import jax
+
+from .utils.dataclasses import (
+    DistributedInitKwargs,
+    DistributedType,
+    GradientAccumulationPlugin,
+    MixedPrecisionPolicy,
+    ParallelismConfig,
+    PrecisionType,
+)
+from .utils.environment import parse_choice_from_env, parse_flag_from_env
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["PartialState", "AcceleratorState", "GradientState", "is_initialized"]
+
+
+def is_initialized() -> bool:
+    """Whether ``AcceleratorState`` has been initialized (reference ``state.py`` helper)."""
+    return AcceleratorState._shared_state != {}
+
+
+def _probe_platform() -> str:
+    try:
+        return jax.default_backend()
+    except RuntimeError:
+        return "cpu"
+
+
+class PartialState:
+    """Singleton holding process/topology information, initialized once.
+
+    Borg pattern as in reference ``state.py:125`` — every instance shares
+    ``_shared_state``.
+
+    Key attributes:
+      - ``device``: representative local `jax.Device`.
+      - ``num_processes``: number of host processes (JAX processes).
+      - ``process_index`` / ``local_process_index``: this host's rank.
+      - ``num_devices`` / ``local_device_count``: global / per-host chip counts.
+      - ``distributed_type``: `DistributedType`.
+    """
+
+    _shared_state: dict[str, Any] = {}
+    _known_attrs = [
+        "_cpu",
+        "backend",
+        "device",
+        "debug",
+        "distributed_type",
+        "fork_launched",
+        "local_process_index",
+        "num_processes",
+        "process_index",
+        "platform",
+    ]
+
+    def __init__(self, cpu: bool = False, **kwargs):
+        self.__dict__ = self._shared_state
+        if self.initialized:
+            return
+
+        self._cpu = cpu
+        self.debug = parse_flag_from_env("ACCELERATE_DEBUG_MODE")
+        init_kwargs = kwargs.pop("init_kwargs", None) or DistributedInitKwargs()
+
+        if cpu:
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+        self._maybe_init_distributed(init_kwargs)
+
+        self.platform = _probe_platform()
+        self.num_processes = jax.process_count()
+        self.process_index = jax.process_index()
+        # One controller process per host in JAX, so local index == 0 unless the
+        # launcher says otherwise (e.g. multiple processes per host on GPU-style
+        # setups); kept for env-contract parity with reference LOCAL_RANK.
+        self.local_process_index = int(os.environ.get("ACCELERATE_LOCAL_PROCESS_INDEX", 0))
+        self.device = jax.local_devices()[0]
+        self.fork_launched = parse_flag_from_env("FORK_LAUNCHED", 0)
+
+        if self.num_processes > 1:
+            self.distributed_type = DistributedType.MULTI_HOST
+        elif jax.device_count() > 1 or self.platform in ("tpu", "axon"):
+            self.distributed_type = DistributedType.TPU_JAX
+        else:
+            self.distributed_type = DistributedType.NO
+        self.backend = "xla"
+
+    def _maybe_init_distributed(self, init_kwargs: DistributedInitKwargs) -> None:
+        """Multi-host bring-up (reference ``state.py:202-286``'s init_process_group).
+
+        Triggered by the env contract written by the launcher
+        (``ACCELERATE_COORDINATOR_ADDRESS`` et al.) or explicit kwargs; a plain
+        single-host run skips it entirely.
+        """
+        coordinator = init_kwargs.coordinator_address or os.environ.get(
+            "ACCELERATE_COORDINATOR_ADDRESS"
+        )
+        if coordinator is None:
+            return
+        num_processes = init_kwargs.num_processes or int(
+            os.environ.get("ACCELERATE_NUM_PROCESSES", 1)
+        )
+        process_id = init_kwargs.process_id
+        if process_id is None:
+            process_id = int(os.environ.get("ACCELERATE_PROCESS_ID", 0))
+        if num_processes <= 1:
+            return
+        # NOTE: must run before ANY backend-initializing JAX call (jax.devices(),
+        # jax.process_count(), ...) — so the already-initialized check inspects the
+        # distributed client directly instead of querying the backend.
+        from jax._src import distributed as _jax_distributed
+
+        if getattr(_jax_distributed.global_state, "client", None) is not None:
+            return  # already initialized (e.g. by the launcher)
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=init_kwargs.local_device_ids,
+        )
+
+    # -- properties ---------------------------------------------------------
+
+    @property
+    def initialized(self) -> bool:
+        return self._shared_state != {}
+
+    @property
+    def use_distributed(self) -> bool:
+        """Parity: reference ``state.py`` — whether >1 data-consumer exists.
+
+        True when either multiple host processes OR multiple local devices are
+        present (device-level parallelism is first-class here).
+        """
+        return self.num_processes > 1 or jax.device_count() > 1
+
+    @property
+    def num_devices(self) -> int:
+        return jax.device_count()
+
+    @property
+    def local_device_count(self) -> int:
+        return jax.local_device_count()
+
+    @property
+    def local_devices(self) -> list:
+        return jax.local_devices()
+
+    @property
+    def is_main_process(self) -> bool:
+        return self.process_index == 0
+
+    @property
+    def is_local_main_process(self) -> bool:
+        return self.local_process_index == 0
+
+    @property
+    def is_last_process(self) -> bool:
+        return self.process_index == self.num_processes - 1
+
+    # -- process control ----------------------------------------------------
+
+    def wait_for_everyone(self) -> None:
+        """Cross-host barrier (reference ``state.py:361-397`` / ``xm.rendezvous``)."""
+        if self.num_processes > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("accelerate_tpu.wait_for_everyone")
+
+    def _goes_first(self, is_main: bool):
+        if not is_main:
+            self.wait_for_everyone()
+        yield
+        if is_main:
+            self.wait_for_everyone()
+
+    @contextlib.contextmanager
+    def main_process_first(self):
+        """Parity: reference ``state.py main_process_first``."""
+        yield from self._goes_first(self.is_main_process)
+
+    @contextlib.contextmanager
+    def local_main_process_first(self):
+        yield from self._goes_first(self.is_local_main_process)
+
+    def on_main_process(self, function: Callable = None):
+        """Decorator: run only on the main process (reference ``state.py``)."""
+        if function is None:
+            return partial(self.on_main_process)
+
+        @wraps(function)
+        def wrapper(*args, **kwargs):
+            if self.is_main_process:
+                return function(*args, **kwargs)
+
+        return wrapper
+
+    def on_local_main_process(self, function: Callable = None):
+        if function is None:
+            return partial(self.on_local_main_process)
+
+        @wraps(function)
+        def wrapper(*args, **kwargs):
+            if self.is_local_main_process:
+                return function(*args, **kwargs)
+
+        return wrapper
+
+    def on_last_process(self, function: Callable):
+        @wraps(function)
+        def wrapper(*args, **kwargs):
+            if self.is_last_process:
+                return function(*args, **kwargs)
+
+        return wrapper
+
+    def on_process(self, function: Callable = None, process_index: int = None):
+        if function is None:
+            return partial(self.on_process, process_index=process_index)
+
+        @wraps(function)
+        def wrapper(*args, **kwargs):
+            if self.process_index == process_index:
+                return function(*args, **kwargs)
+
+        return wrapper
+
+    def on_local_process(self, function: Callable = None, local_process_index: int = None):
+        if function is None:
+            return partial(self.on_local_process, local_process_index=local_process_index)
+
+        @wraps(function)
+        def wrapper(*args, **kwargs):
+            if self.local_process_index == local_process_index:
+                return function(*args, **kwargs)
+
+        return wrapper
+
+    @contextlib.contextmanager
+    def split_between_processes(self, inputs, apply_padding: bool = False):
+        """Split ``inputs`` evenly between host processes.
+
+        Parity: reference ``state.py:409`` — list/tuple/dict/array inputs; uneven
+        remainders go to earlier ranks; ``apply_padding`` repeats the final element
+        so every rank gets equal length (needed before a gather).
+        """
+        if self.num_processes == 1:
+            yield inputs
+            return
+
+        if isinstance(inputs, dict):
+            lengths = {k: len(v) for k, v in inputs.items()}
+            if len(set(lengths.values())) > 1:
+                raise ValueError(
+                    f"All dict values must have the same length to split between processes, got {lengths}"
+                )
+            length = next(iter(lengths.values())) if lengths else 0
+        else:
+            length = len(inputs)
+        split_sizes = [length // self.num_processes] * self.num_processes
+        for i in range(length % self.num_processes):
+            split_sizes[i] += 1
+        start = sum(split_sizes[: self.process_index])
+        end = start + split_sizes[self.process_index]
+        pad_len = max(split_sizes) - (end - start) if apply_padding else 0
+
+        def _slice(v):
+            chunk = v[start:end]
+            if pad_len:
+                # Pad with the LAST element of the full input so every rank has
+                # equal length (reference state.py:409 apply_padding semantics);
+                # handles ranks whose slice is empty.
+                if isinstance(chunk, np.ndarray):
+                    tail = np.asarray(v)[-1:]
+                    chunk = np.concatenate([chunk] + [tail] * pad_len, axis=0)
+                elif isinstance(chunk, tuple):
+                    chunk = chunk + (v[-1],) * pad_len
+                else:
+                    chunk = list(chunk) + [v[-1]] * pad_len
+            return chunk
+
+        if isinstance(inputs, dict):
+            yield {k: _slice(v) for k, v in inputs.items()}
+        else:
+            yield _slice(inputs)
+
+    def print(self, *args, **kwargs):
+        if self.is_local_main_process:
+            print(*args, **kwargs)
+
+    def destroy_process_group(self) -> None:
+        """Shut down the distributed runtime (reference ``state.py`` destroy)."""
+        if self.num_processes > 1:
+            jax.distributed.shutdown()
+
+    @classmethod
+    def _reset_state(cls) -> None:
+        """Test hook (reference ``AccelerateTestCase`` resets singletons)."""
+        cls._shared_state.clear()
+
+    def __repr__(self) -> str:
+        return (
+            f"Distributed environment: {self.distributed_type}\n"
+            f"Num processes: {self.num_processes}\n"
+            f"Process index: {self.process_index}\n"
+            f"Local process index: {self.local_process_index}\n"
+            f"Device count: {self.num_devices}\n"
+            f"Platform: {self.platform}\n"
+        )
+
+
+class AcceleratorState:
+    """Extends ``PartialState`` with precision policy, mesh, and active plugins.
+
+    Parity: reference ``state.py:856`` — where the reference rewrites
+    ``distributed_type`` to the active engine, we record the active *mesh axes*.
+    The named `jax.sharding.Mesh` lives here and is the single source of truth for
+    every sharding decision downstream.
+    """
+
+    _shared_state: dict[str, Any] = {}
+
+    def __init__(
+        self,
+        mixed_precision: str = None,
+        cpu: bool = False,
+        parallelism_config: Optional[ParallelismConfig] = None,
+        fsdp_plugin=None,
+        tp_plugin=None,
+        sp_plugin=None,
+        pp_plugin=None,
+        ep_plugin=None,
+        _from_accelerator: bool = False,
+        **kwargs,
+    ):
+        self.__dict__ = self._shared_state
+        if self.initialized:
+            if mixed_precision is not None and mixed_precision != self._mixed_precision:
+                raise ValueError(
+                    "AcceleratorState already initialized with mixed_precision="
+                    f"{self._mixed_precision!r}; cannot re-init with {mixed_precision!r}. "
+                    "Call AcceleratorState._reset_state() first (tests) or construct the "
+                    "Accelerator before any other state access."
+                )
+            return
+
+        self._partial = PartialState(cpu, **kwargs)
+        mixed_precision = (
+            parse_choice_from_env("ACCELERATE_MIXED_PRECISION", "no")
+            if mixed_precision is None
+            else mixed_precision.lower()
+        )
+        if mixed_precision not in PrecisionType.list():
+            raise ValueError(
+                f"Unknown mixed_precision mode: {mixed_precision}; must be one of {PrecisionType.list()}"
+            )
+        self._mixed_precision = mixed_precision
+        self.dtype_policy = MixedPrecisionPolicy.from_mixed_precision(mixed_precision)
+
+        if fsdp_plugin is None and parse_flag_from_env("ACCELERATE_USE_FSDP"):
+            from .utils.dataclasses import FullyShardedDataParallelPlugin
+
+            fsdp_plugin = FullyShardedDataParallelPlugin()
+        self.fsdp_plugin = fsdp_plugin
+        self.tp_plugin = tp_plugin
+        self.sp_plugin = sp_plugin
+        self.pp_plugin = pp_plugin
+        self.ep_plugin = ep_plugin
+
+        self.parallelism_config = self._resolve_parallelism(parallelism_config)
+        self.mesh = self._build_mesh(self.parallelism_config)
+
+        # distributed_type rewrite, mirroring reference state.py:952-976.
+        if self.fsdp_plugin is not None and self.parallelism_config.fsdp > 1:
+            self.distributed_type = DistributedType.FSDP
+        elif self.parallelism_config.tp > 1:
+            self.distributed_type = DistributedType.TP
+        else:
+            self.distributed_type = self._partial.distributed_type
+
+    def _resolve_parallelism(self, cfg: Optional[ParallelismConfig]) -> ParallelismConfig:
+        n = jax.device_count()
+        if cfg is None:
+            cfg = ParallelismConfig.from_env()
+        if cfg.total_size == 1 and n > 1:
+            # Default strategy: if an FSDP plugin is active put every chip on the
+            # fsdp axis, else pure data parallelism.
+            if self.fsdp_plugin is not None:
+                cfg = ParallelismConfig(fsdp=n)
+            else:
+                cfg = ParallelismConfig(dp=n)
+        if self.tp_plugin is not None and self.tp_plugin.tp_size > 1 and cfg.tp == 1:
+            tp = self.tp_plugin.tp_size
+            if cfg.dp % tp != 0:
+                raise ValueError(
+                    f"tp_plugin.tp_size={tp} does not divide the data-parallel axis (dp={cfg.dp}); "
+                    "pass an explicit ParallelismConfig."
+                )
+            cfg = ParallelismConfig(
+                dp=cfg.dp // tp, fsdp=cfg.fsdp, tp=tp, sp=cfg.sp, pp=cfg.pp, ep=cfg.ep, dcn_dp=cfg.dcn_dp
+            )
+        if self.sp_plugin is not None and self.sp_plugin.sp_size > 1 and cfg.sp == 1:
+            sp = self.sp_plugin.sp_size
+            if cfg.dp % sp != 0:
+                raise ValueError(
+                    f"sp_plugin.sp_size={sp} does not divide the data-parallel axis (dp={cfg.dp}); "
+                    "pass an explicit ParallelismConfig."
+                )
+            cfg = ParallelismConfig(
+                dp=cfg.dp // sp, fsdp=cfg.fsdp, tp=cfg.tp, sp=sp, pp=cfg.pp, ep=cfg.ep, dcn_dp=cfg.dcn_dp
+            )
+        if cfg.total_size != n:
+            raise ValueError(
+                f"Mesh of size {cfg.total_size} ({cfg.active_axes or '{}'}) does not match "
+                f"device count {n}."
+            )
+        return cfg
+
+    @staticmethod
+    def _build_mesh(cfg: ParallelismConfig) -> jax.sharding.Mesh:
+        """Build the named device mesh; axis order puts tp innermost so its
+        collectives ride the fastest ICI links (SURVEY §2.4 TPU-native column)."""
+        from .parallel.mesh import build_mesh
+
+        return build_mesh(cfg)
+
+    # Pass-throughs to PartialState (reference AcceleratorState mirrors them).
+    def __getattr__(self, name: str):
+        if name in ("_shared_state", "_partial", "initialized"):
+            raise AttributeError(name)
+        partial_state = self.__dict__.get("_partial")
+        if partial_state is not None and hasattr(partial_state, name):
+            return getattr(partial_state, name)
+        raise AttributeError(f"AcceleratorState has no attribute {name!r}")
+
+    @property
+    def initialized(self) -> bool:
+        return self._shared_state != {}
+
+    @property
+    def mixed_precision(self) -> str:
+        return self._mixed_precision
+
+    @classmethod
+    def _reset_state(cls, reset_partial_state: bool = False) -> None:
+        cls._shared_state.clear()
+        if reset_partial_state:
+            PartialState._reset_state()
+
+    def __repr__(self) -> str:
+        return (
+            repr(self.__dict__.get("_partial", PartialState()))
+            + f"Mixed precision: {self.mixed_precision}\n"
+            + f"Mesh: {dict(zip(self.mesh.axis_names, self.mesh.devices.shape))}\n"
+        )
+
+
+class GradientState:
+    """Singleton tracking gradient-accumulation bookkeeping.
+
+    Parity: reference ``state.py:1191`` — ``sync_gradients``, ``num_steps``,
+    ``end_of_dataloader``, ``remainder``, active-dataloader registry.  The XLA
+    ``mark_step`` logic (reference ``state.py:1284-1293``) has no analog: steps are
+    explicit compiled calls here, nothing is lazily queued.
+    """
+
+    _shared_state: dict[str, Any] = {}
+
+    def __init__(self, gradient_accumulation_plugin: Optional[GradientAccumulationPlugin] = None):
+        self.__dict__ = self._shared_state
+        if not self.initialized:
+            self.sync_gradients = True
+            self.active_dataloader = None
+            self.dataloader_references = [None]
+            self.plugin_kwargs = (
+                gradient_accumulation_plugin.to_kwargs()
+                if gradient_accumulation_plugin is not None
+                else {}
+            )
+            self._is_xla_gradients_synced = False
+        if gradient_accumulation_plugin is not None and self.plugin_kwargs != (
+            gradient_accumulation_plugin.to_kwargs()
+        ):
+            self.plugin_kwargs = gradient_accumulation_plugin.to_kwargs()
+
+    @property
+    def num_steps(self) -> int:
+        return self.plugin_kwargs.get("num_steps", 1) or 1
+
+    @property
+    def adjust_scheduler(self) -> bool:
+        return self.plugin_kwargs.get("adjust_scheduler", True)
+
+    @property
+    def sync_with_dataloader(self) -> bool:
+        return self.plugin_kwargs.get("sync_with_dataloader", True)
+
+    @property
+    def sync_each_batch(self) -> bool:
+        return self.plugin_kwargs.get("sync_each_batch", False)
+
+    @property
+    def initialized(self) -> bool:
+        return GradientState._shared_state != {}
+
+    @property
+    def end_of_dataloader(self) -> bool:
+        if not self.in_dataloader:
+            return False
+        return self.active_dataloader.end_of_dataloader
+
+    @property
+    def remainder(self) -> int:
+        if not self.in_dataloader:
+            return -1
+        return self.active_dataloader.remainder
+
+    @property
+    def in_dataloader(self) -> bool:
+        return self.active_dataloader is not None
+
+    def _set_sync_gradients(self, sync_gradients: bool) -> None:
+        self.sync_gradients = sync_gradients
+
+    def _add_dataloader(self, dataloader) -> None:
+        self.active_dataloader = dataloader
+        self.dataloader_references.append(dataloader)
+
+    def _remove_dataloader(self, dataloader) -> None:
+        self.dataloader_references.remove(dataloader)
+        self.active_dataloader = self.dataloader_references[-1]
+
+    @classmethod
+    def _reset_state(cls) -> None:
+        cls._shared_state.clear()
+
+    def __repr__(self) -> str:
+        return (
+            f"Sync Gradients: {self.sync_gradients}\n"
+            f"At end of current dataloader: {self.end_of_dataloader}\n"
+            f"Extra samples added: {self.remainder}\n"
+            f"Gradient accumulation plugin: {self.plugin_kwargs}\n"
+        )

@@ -1,0 +1,465 @@
+"""Plugin & config dataclasses — the strategy surface of the framework.
+
+Parity target: reference ``src/accelerate/utils/dataclasses.py`` (2783 LoC).  The
+reference's plugins configure *external engines* (DDP/FSDP/DeepSpeed/Megatron); ours
+configure *GSPMD sharding over a named device mesh* — the strategy names and env-var
+contract are preserved (``ACCELERATE_*``, ``FSDP_*``) so launch configs carry over,
+but every knob maps onto `jax.sharding` concepts instead of torch engine arguments.
+"""
+
+from __future__ import annotations
+
+import copy
+import enum
+import functools
+import os
+import warnings
+from dataclasses import dataclass, field
+from datetime import timedelta
+from typing import Any, Callable, Iterable, Optional
+
+from .environment import parse_flag_from_env, str_to_bool
+
+__all__ = [
+    "DistributedType",
+    "PrecisionType",
+    "RNGType",
+    "DynamoBackend",
+    "KwargsHandler",
+    "DistributedInitKwargs",
+    "InitProcessGroupKwargs",
+    "GradScalerKwargs",
+    "DistributedDataParallelKwargs",
+    "AutocastKwargs",
+    "ProfileKwargs",
+    "GradientAccumulationPlugin",
+    "ParallelismConfig",
+    "FullyShardedDataParallelPlugin",
+    "TensorParallelPlugin",
+    "TorchTensorParallelPlugin",
+    "SequenceParallelPlugin",
+    "PipelineParallelPlugin",
+    "ExpertParallelPlugin",
+    "DataLoaderConfiguration",
+    "ProjectConfiguration",
+    "MixedPrecisionPolicy",
+]
+
+
+class BaseEnum(str, enum.Enum):
+    def __str__(self) -> str:  # so f-strings print the bare value, as in the reference
+        return self.value
+
+    @classmethod
+    def list(cls) -> list[str]:
+        return [e.value for e in cls]
+
+
+class DistributedType(BaseEnum):
+    """Type of distributed environment.
+
+    Parity: reference ``utils/dataclasses.py DistributedType``.  The engine-specific
+    members (DEEPSPEED, MEGATRON_LM, MULTI_GPU...) collapse here: the backend is
+    always XLA/GSPMD; the member records which *strategy family* is active so the
+    reference's routing logic (``accelerator.py:1438-1757``) has a faithful analog.
+    """
+
+    NO = "NO"
+    TPU_JAX = "TPU_JAX"  # data-parallel over a jax device mesh (the native default)
+    FSDP = "FSDP"  # parameter/grad/optimizer-state sharding on the fsdp axis
+    TP = "TP"  # tensor parallelism axis active
+    MULTI_HOST = "MULTI_HOST"  # >1 jax process (any strategy)
+    # Aliases kept so scripts written against the reference keep working.
+    XLA = "TPU_JAX"
+    DEEPSPEED = "DEEPSPEED"  # accepted as a config dialect, mapped onto FSDP/ZeRO axes
+    MEGATRON_LM = "MEGATRON_LM"  # accepted as a config dialect, mapped onto tp/pp axes
+
+
+class PrecisionType(BaseEnum):
+    """Parity: reference ``utils/dataclasses.py PrecisionType``; fp16 maps to bf16 on
+    TPU (no hardware fp16), fp8 uses XLA float8 dtypes."""
+
+    NO = "no"
+    FP8 = "fp8"
+    FP16 = "fp16"
+    BF16 = "bf16"
+
+
+class RNGType(BaseEnum):
+    JAX = "jax"
+    TORCH = "torch"
+    NUMPY = "numpy"
+    PYTHON = "python"
+    GENERATOR = "generator"
+    XLA = "xla"
+
+
+class DynamoBackend(BaseEnum):
+    """Accepted for CLI/config compatibility; everything compiles through XLA here."""
+
+    NO = "NO"
+    INDUCTOR = "INDUCTOR"
+    XLA = "XLA"
+
+
+# ---------------------------------------------------------------------------
+# Kwargs handlers
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class KwargsHandler:
+    """Base for objects passed in ``Accelerator(kwargs_handlers=[...])``.
+
+    Parity: reference ``utils/dataclasses.py:64-83`` — ``to_kwargs`` diffs against
+    default field values.
+    """
+
+    def to_dict(self) -> dict[str, Any]:
+        return copy.deepcopy(self.__dict__)
+
+    def to_kwargs(self) -> dict[str, Any]:
+        default_dict = self.__class__().to_dict()
+        this_dict = self.to_dict()
+        return {k: v for k, v in this_dict.items() if default_dict[k] != v}
+
+
+@dataclass
+class DistributedInitKwargs(KwargsHandler):
+    """Customize multi-host bring-up (``jax.distributed.initialize``).
+
+    Replaces reference ``InitProcessGroupKwargs`` (``utils/dataclasses.py:259-294``):
+    rendezvous is a coordinator address instead of a torch store.
+    """
+
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+    local_device_ids: Optional[list[int]] = None
+    timeout: timedelta = field(default_factory=lambda: timedelta(seconds=1800))
+
+
+# Compatibility alias matching the reference class name.
+InitProcessGroupKwargs = DistributedInitKwargs
+
+
+@dataclass
+class GradScalerKwargs(KwargsHandler):
+    """Loss-scaling configuration for fp16-style training.
+
+    Parity: reference ``GradScalerKwargs`` → torch GradScaler.  On TPU bf16 needs no
+    scaling; this drives an optax-style dynamic loss scale when requested.
+    """
+
+    init_scale: float = 65536.0
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 2000
+    enabled: bool = True
+
+
+@dataclass
+class DistributedDataParallelKwargs(KwargsHandler):
+    """Accepted for API compatibility (reference ``utils/dataclasses.py:151-226``).
+
+    GSPMD data parallelism has no bucketing / graph-finding knobs — XLA schedules the
+    gradient all-reduce — so these fields are validated then ignored, except
+    ``gradient_as_bucket_view``-style memory hints which map to donation.
+    """
+
+    bucket_cap_mb: int = 25
+    find_unused_parameters: bool = False
+    gradient_as_bucket_view: bool = False
+    static_graph: bool = False
+    comm_hook: str = "no"  # reference DDPCommunicationHookType; fp16/bf16 map to
+    # reduced-precision psum via optax transforms.
+
+
+@dataclass
+class AutocastKwargs(KwargsHandler):
+    """Parity: reference ``AutocastKwargs``; controls the dtype policy of the step."""
+
+    enabled: bool = True
+    cache_enabled: bool = True
+
+
+@dataclass
+class ProfileKwargs(KwargsHandler):
+    """Build a ``jax.profiler`` trace session.
+
+    Parity: reference ``ProfileKwargs`` (``utils/dataclasses.py:438-553``) which built
+    ``torch.profiler.profile``.  Chrome-trace export becomes a perfetto/xplane dump.
+    """
+
+    activities: Optional[list[str]] = None
+    schedule_option: Optional[dict[str, int]] = None
+    record_shapes: bool = False
+    profile_memory: bool = False
+    with_flops: bool = False
+    output_trace_dir: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
+# Plugins
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class GradientAccumulationPlugin(KwargsHandler):
+    """Parity: reference ``GradientAccumulationPlugin``."""
+
+    num_steps: Optional[int] = None
+    adjust_scheduler: bool = True
+    sync_with_dataloader: bool = True
+    sync_each_batch: bool = False
+
+
+@dataclass
+class ParallelismConfig:
+    """The shape of the named device mesh — the heart of the TPU-native design.
+
+    There is no reference analog as a single object (the reference scatters this
+    across DeepSpeed/Megatron/TP plugins); on TPU every strategy is an axis of one
+    mesh.  Axis order is outermost-first: (dp over DCN, fsdp, pp, sp, ep, tp over
+    ICI) — tp innermost so its collectives ride the fastest links.
+    A size of 1 disables the axis.
+    """
+
+    dp: int = 1  # pure data parallel (replicated params)
+    fsdp: int = 1  # data parallel with param/grad/opt-state sharding (ZeRO-3/GSPMD)
+    tp: int = 1  # tensor parallelism
+    sp: int = 1  # sequence/context parallelism (ring attention axis)
+    pp: int = 1  # pipeline parallelism
+    ep: int = 1  # expert parallelism (MoE)
+    dcn_dp: int = 1  # data-parallel replicas across slices (multi-slice DCN axis)
+
+    AXIS_ORDER = ("dcn_dp", "dp", "fsdp", "pp", "sp", "ep", "tp")
+
+    def __post_init__(self):
+        for name in self.AXIS_ORDER:
+            size = getattr(self, name)
+            if not isinstance(size, int) or size < 1:
+                raise ValueError(f"Mesh axis {name!r} must be a positive int, got {size!r}")
+
+    @property
+    def total_size(self) -> int:
+        n = 1
+        for name in self.AXIS_ORDER:
+            n *= getattr(self, name)
+        return n
+
+    @property
+    def active_axes(self) -> dict[str, int]:
+        return {name: getattr(self, name) for name in self.AXIS_ORDER if getattr(self, name) > 1}
+
+    @property
+    def data_shard_size(self) -> int:
+        """Number of ways the global batch is split (dp-like axes)."""
+        return self.dcn_dp * self.dp * self.fsdp
+
+    @classmethod
+    def from_env(cls) -> "ParallelismConfig":
+        def geti(key, default=1):
+            return int(os.environ.get(key, default))
+
+        return cls(
+            dp=geti("ACCELERATE_PARALLELISM_DP"),
+            fsdp=geti("ACCELERATE_PARALLELISM_FSDP"),
+            tp=geti("ACCELERATE_PARALLELISM_TP"),
+            sp=geti("ACCELERATE_PARALLELISM_SP"),
+            pp=geti("ACCELERATE_PARALLELISM_PP"),
+            ep=geti("ACCELERATE_PARALLELISM_EP"),
+            dcn_dp=geti("ACCELERATE_PARALLELISM_DCN_DP"),
+        )
+
+
+@dataclass
+class FullyShardedDataParallelPlugin:
+    """FSDP/ZeRO strategy mapped onto GSPMD parameter sharding.
+
+    Parity: reference ``FullyShardedDataParallelPlugin`` (``utils/dataclasses.py:
+    1451-2020``) which drove ``torch.distributed.fsdp``.  The TPU-native meaning of
+    each surviving knob:
+
+    - ``sharding_strategy``: FULL_SHARD → shard params+grads+opt state on the fsdp
+      axis; SHARD_GRAD_OP → params replicated, grads/opt-state sharded (ZeRO-2);
+      NO_SHARD → plain DP; HYBRID_SHARD → shard within slice, replicate across DCN.
+    - ``min_num_params`` / auto-wrap policy: parameter arrays smaller than the
+      threshold stay replicated (sharding tiny arrays wastes collective latency).
+    - ``cpu_offload``: opt-state (and optionally params between steps) live in
+      pinned host memory, streamed in per step.
+    - ``state_dict_type``: FULL_STATE_DICT consolidates on save; SHARDED_STATE_DICT
+      writes one shard per process (orbax-style) + offline merge.
+
+    Env contract preserved: ``FSDP_*`` variables (reference
+    ``utils/dataclasses.py:1665-1844``) are read in ``__post_init__``.
+    """
+
+    sharding_strategy: str = "FULL_SHARD"
+    reshard_after_forward: bool = True
+    cpu_offload: bool = False
+    min_num_params: int = 0
+    auto_wrap_policy: Optional[Callable] = None
+    transformer_cls_names_to_wrap: Optional[list[str]] = None
+    state_dict_type: str = "SHARDED_STATE_DICT"
+    use_orig_params: bool = True  # accepted, meaningless under GSPMD
+    sync_module_states: bool = True
+    activation_checkpointing: bool = False
+    mixed_precision_policy: Optional["MixedPrecisionPolicy"] = None
+    fsdp_version: int = 2  # reference distinguishes FSDP1/2; both map to one design
+
+    VALID_STRATEGIES = ("FULL_SHARD", "SHARD_GRAD_OP", "NO_SHARD", "HYBRID_SHARD")
+
+    def __post_init__(self):
+        env_prefix = "FSDP_"
+        self.sharding_strategy = os.environ.get(
+            env_prefix + "SHARDING_STRATEGY", self.sharding_strategy
+        ).upper()
+        # The reference accepts the int form (1..4) too.
+        int_map = {"1": "FULL_SHARD", "2": "SHARD_GRAD_OP", "3": "NO_SHARD", "4": "HYBRID_SHARD"}
+        self.sharding_strategy = int_map.get(self.sharding_strategy, self.sharding_strategy)
+        if self.sharding_strategy not in self.VALID_STRATEGIES:
+            raise ValueError(
+                f"sharding_strategy must be one of {self.VALID_STRATEGIES}, got {self.sharding_strategy}"
+            )
+        if "FSDP_MIN_NUM_PARAMS" in os.environ:
+            self.min_num_params = int(os.environ["FSDP_MIN_NUM_PARAMS"])
+        if "FSDP_CPU_OFFLOAD" in os.environ:
+            self.cpu_offload = bool(str_to_bool(os.environ["FSDP_CPU_OFFLOAD"]))
+        if "FSDP_STATE_DICT_TYPE" in os.environ:
+            self.state_dict_type = os.environ["FSDP_STATE_DICT_TYPE"].upper()
+        if "FSDP_ACTIVATION_CHECKPOINTING" in os.environ:
+            self.activation_checkpointing = bool(
+                str_to_bool(os.environ["FSDP_ACTIVATION_CHECKPOINTING"])
+            )
+        if self.transformer_cls_names_to_wrap is None and "FSDP_TRANSFORMER_CLS_TO_WRAP" in os.environ:
+            self.transformer_cls_names_to_wrap = os.environ["FSDP_TRANSFORMER_CLS_TO_WRAP"].split(",")
+
+    @property
+    def shards_parameters(self) -> bool:
+        return self.sharding_strategy in ("FULL_SHARD", "HYBRID_SHARD")
+
+    @property
+    def shards_grads_and_optimizer(self) -> bool:
+        return self.sharding_strategy in ("FULL_SHARD", "HYBRID_SHARD", "SHARD_GRAD_OP")
+
+
+@dataclass
+class TensorParallelPlugin:
+    """Tensor-parallel axis configuration.
+
+    Parity: reference ``TorchTensorParallelPlugin`` (``utils/dataclasses.py:
+    2022-2058``) only carried ``tp_size`` + a DeviceMesh; ours additionally carries
+    the partition-rule table (regex -> PartitionSpec axis for each weight class),
+    since on TPU *we* place the shardings rather than delegating to transformers.
+    """
+
+    tp_size: int = 1
+    # Mapping from parameter-path regex to the mesh axes of its PartitionSpec; when
+    # None, `parallel.sharding.DEFAULT_TP_RULES` applies (transformer QKV/MLP rules).
+    partition_rules: Optional[list[tuple[str, Any]]] = None
+
+    def __post_init__(self):
+        if "TP_SIZE" in os.environ:
+            self.tp_size = int(os.environ["TP_SIZE"])
+        if self.tp_size < 1:
+            raise ValueError(f"tp_size must be >= 1, got {self.tp_size}")
+
+
+# Reference-compatible name.
+TorchTensorParallelPlugin = TensorParallelPlugin
+
+
+@dataclass
+class SequenceParallelPlugin:
+    """Context/sequence parallelism — net-new vs the reference (SURVEY §2.4: absent
+    upstream).  Shards activations on the sequence axis; attention runs as ring
+    attention over the ``sp`` mesh axis."""
+
+    sp_size: int = 1
+    mode: str = "ring"  # "ring" (blockwise ring attention) | "allgather" (Ulysses-style)
+
+    def __post_init__(self):
+        if self.mode not in ("ring", "allgather"):
+            raise ValueError(f"Unknown sequence-parallel mode {self.mode!r}")
+
+
+@dataclass
+class PipelineParallelPlugin:
+    """Pipeline parallelism over the ``pp`` mesh axis (microbatched GPipe schedule).
+
+    Parity: reference ``prepare_pippy`` (``inference.py:124-184``) + Megatron pp.
+    """
+
+    pp_size: int = 1
+    num_micro_batches: int = 1
+    schedule: str = "gpipe"  # "gpipe" | "1f1b" (round 2+)
+
+
+@dataclass
+class ExpertParallelPlugin:
+    """MoE expert parallelism over the ``ep`` axis (ragged all-to-all dispatch)."""
+
+    ep_size: int = 1
+    capacity_factor: float = 1.25
+
+
+@dataclass
+class MixedPrecisionPolicy:
+    """Dtype policy for the compiled step: param storage, compute, and reduction
+    dtypes.  Subsumes the reference's autocast + FSDP MixedPrecision + XLA_USE_BF16
+    env flags (``state.py:942-951``)."""
+
+    param_dtype: str = "float32"
+    compute_dtype: str = "bfloat16"
+    output_dtype: str = "float32"
+    reduce_dtype: str = "float32"
+
+    @classmethod
+    def from_mixed_precision(cls, mixed_precision: str) -> "MixedPrecisionPolicy":
+        if mixed_precision in ("no", None):
+            return cls(param_dtype="float32", compute_dtype="float32", output_dtype="float32")
+        if mixed_precision in ("bf16", "fp16"):
+            # fp16 has no TPU hardware path; bf16 is the faithful equivalent.
+            return cls()
+        if mixed_precision == "fp8":
+            return cls(compute_dtype="float8_e4m3fn")
+        raise ValueError(f"Unknown mixed_precision {mixed_precision!r}")
+
+
+# ---------------------------------------------------------------------------
+# Loader / project configuration
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DataLoaderConfiguration:
+    """Parity: reference ``DataLoaderConfiguration``."""
+
+    split_batches: bool = False
+    dispatch_batches: Optional[bool] = None
+    even_batches: bool = True
+    use_seedable_sampler: bool = False
+    data_seed: Optional[int] = None
+    non_blocking: bool = False
+    use_stateful_dataloader: bool = False
+
+
+@dataclass
+class ProjectConfiguration:
+    """Parity: reference ``ProjectConfiguration`` (``utils/dataclasses.py:859-918``)."""
+
+    project_dir: Optional[str] = None
+    logging_dir: Optional[str] = None
+    automatic_checkpoint_naming: bool = False
+    total_limit: Optional[int] = None
+    iteration: int = 0
+    save_on_each_node: bool = False
+
+    def set_directories(self, project_dir: Optional[str] = None):
+        self.project_dir = project_dir
+        if self.logging_dir is None:
+            self.logging_dir = project_dir
+
+    def __post_init__(self):
+        self.set_directories(self.project_dir)

@@ -1,0 +1,98 @@
+"""Environment-variable helpers.
+
+TPU-native re-design of the reference's env contract (see reference
+``src/accelerate/utils/environment.py:1-120``): config flows launcher -> worker via
+``ACCELERATE_*`` variables, parsed here.  We keep the same variable names so launch
+tooling stays compatible, but backend-specific knobs (CUDA, NUMA) are replaced by
+JAX/XLA equivalents.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Any
+
+__all__ = [
+    "str_to_bool",
+    "parse_flag_from_env",
+    "parse_choice_from_env",
+    "get_int_from_env",
+    "are_libraries_initialized",
+    "patch_environment",
+    "clear_environment",
+]
+
+
+def str_to_bool(value: str) -> int:
+    """Convert a string representation of truth to 1 or 0.
+
+    Mirrors the semantics of reference ``utils/environment.py:str_to_bool``.
+    """
+    value = value.lower()
+    if value in ("y", "yes", "t", "true", "on", "1"):
+        return 1
+    if value in ("n", "no", "f", "false", "off", "0"):
+        return 0
+    raise ValueError(f"invalid truth value {value}")
+
+
+def parse_flag_from_env(key: str, default: bool = False) -> bool:
+    """Read a boolean flag from the environment."""
+    value = os.environ.get(key, str(default))
+    return bool(str_to_bool(value))
+
+
+def parse_choice_from_env(key: str, default: str = "no") -> str:
+    return os.environ.get(key, str(default))
+
+
+def get_int_from_env(env_keys, default: int) -> int:
+    """Return the first positive int found among ``env_keys``."""
+    for e in env_keys:
+        val = int(os.environ.get(e, -1))
+        if val >= 0:
+            return val
+    return default
+
+
+def are_libraries_initialized(*library_names: str) -> list[str]:
+    """Return the list of already-imported libraries among ``library_names``."""
+    import sys
+
+    return [lib for lib in library_names if lib in sys.modules]
+
+
+@contextlib.contextmanager
+def patch_environment(**kwargs: Any):
+    """Temporarily set environment variables; restore previous values on exit.
+
+    Parity: reference ``utils/other.py``/``utils/environment.py patch_environment``.
+    """
+    existing = {}
+    for key, value in kwargs.items():
+        key = key.upper()
+        if key in os.environ:
+            existing[key] = os.environ[key]
+        os.environ[key] = str(value)
+    try:
+        yield
+    finally:
+        for key in kwargs:
+            key = key.upper()
+            if key in existing:
+                os.environ[key] = existing[key]
+            else:
+                os.environ.pop(key, None)
+
+
+@contextlib.contextmanager
+def clear_environment():
+    """Temporarily wipe the environment."""
+    saved = dict(os.environ)
+    os.environ.clear()
+    try:
+        yield
+    finally:
+        os.environ.clear()
+        os.environ.update(saved)

@@ -1,0 +1,169 @@
+"""Lazy, cached availability detectors.
+
+Parity target: reference ``src/accelerate/utils/imports.py`` (55 ``is_*_available``
+detectors).  Ours covers the libraries that matter on the TPU/JAX stack; detectors for
+CUDA-only libraries return False so downstream feature-gating logic keeps working.
+"""
+
+from __future__ import annotations
+
+import functools
+import importlib.metadata
+import importlib.util
+
+__all__ = [
+    "is_available",
+    "is_torch_available",
+    "is_flax_available",
+    "is_optax_available",
+    "is_orbax_available",
+    "is_transformers_available",
+    "is_datasets_available",
+    "is_safetensors_available",
+    "is_tensorboard_available",
+    "is_wandb_available",
+    "is_mlflow_available",
+    "is_comet_ml_available",
+    "is_aim_available",
+    "is_clearml_available",
+    "is_dvclive_available",
+    "is_swanlab_available",
+    "is_trackio_available",
+    "is_tqdm_available",
+    "is_rich_available",
+    "is_pandas_available",
+    "is_tpu_available",
+    "is_cpu_mesh_simulation",
+    "is_pytest_available",
+    "is_einops_available",
+    "is_grain_available",
+]
+
+
+@functools.lru_cache(maxsize=None)
+def is_available(name: str) -> bool:
+    """True when ``import name`` would succeed (spec found, not imported)."""
+    try:
+        return importlib.util.find_spec(name) is not None
+    except (ModuleNotFoundError, ValueError):
+        return False
+
+
+def _package_version(name: str) -> str | None:
+    try:
+        return importlib.metadata.version(name)
+    except importlib.metadata.PackageNotFoundError:
+        return None
+
+
+def is_torch_available() -> bool:
+    return is_available("torch")
+
+
+def is_flax_available() -> bool:
+    return is_available("flax")
+
+
+def is_optax_available() -> bool:
+    return is_available("optax")
+
+
+def is_orbax_available() -> bool:
+    return is_available("orbax")
+
+
+def is_transformers_available() -> bool:
+    return is_available("transformers")
+
+
+def is_datasets_available() -> bool:
+    return is_available("datasets")
+
+
+def is_safetensors_available() -> bool:
+    return is_available("safetensors")
+
+
+def is_tensorboard_available() -> bool:
+    return is_available("tensorboard") or is_available("tensorboardX")
+
+
+def is_wandb_available() -> bool:
+    return is_available("wandb")
+
+
+def is_mlflow_available() -> bool:
+    return is_available("mlflow")
+
+
+def is_comet_ml_available() -> bool:
+    return is_available("comet_ml")
+
+
+def is_aim_available() -> bool:
+    return is_available("aim")
+
+
+def is_clearml_available() -> bool:
+    return is_available("clearml")
+
+
+def is_dvclive_available() -> bool:
+    return is_available("dvclive")
+
+
+def is_swanlab_available() -> bool:
+    return is_available("swanlab")
+
+
+def is_trackio_available() -> bool:
+    return is_available("trackio")
+
+
+def is_tqdm_available() -> bool:
+    return is_available("tqdm")
+
+
+def is_rich_available() -> bool:
+    return is_available("rich")
+
+
+def is_pandas_available() -> bool:
+    return is_available("pandas")
+
+
+def is_einops_available() -> bool:
+    return is_available("einops")
+
+
+def is_grain_available() -> bool:
+    return is_available("grain")
+
+
+def is_pytest_available() -> bool:
+    return is_available("pytest")
+
+
+def is_tpu_available() -> bool:
+    """True when JAX sees at least one TPU-class device.
+
+    Replaces reference ``is_torch_xla_available(check_is_tpu=True)``
+    (``utils/imports.py``).  Deliberately NOT cached: querying the backend before
+    distributed bring-up would freeze a wrong answer (and initialize the backend);
+    callers should only use this after `PartialState` exists.
+    """
+    import jax
+
+    try:
+        platform = jax.default_backend()
+    except RuntimeError:
+        return False
+    # "axon" is the tunneled single-chip TPU platform used in some environments.
+    return platform in ("tpu", "axon")
+
+
+def is_cpu_mesh_simulation() -> bool:
+    """True when running on the virtual multi-device CPU mesh used for tests."""
+    import os
+
+    return "xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", "")

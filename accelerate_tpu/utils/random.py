@@ -1,0 +1,135 @@
+"""Seeding & cross-process RNG synchronization.
+
+Parity target: reference ``src/accelerate/utils/random.py`` (156 LoC):
+``set_seed`` seeds every library in play; ``synchronize_rng_states`` broadcasts
+rank-0 generator state so data-order decisions agree across workers.
+
+TPU-native redesign: JAX randomness is *functional* (threefry keys, no hidden
+state), so the framework keeps one root `jax.random.key` in a registry and hands
+out `fold_in`-derived subkeys.  Stateful generators (python/numpy/torch) are still
+seeded for user-land code and dataloader shuffles.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterable, Optional
+
+import numpy as np
+
+import jax
+
+from .dataclasses import RNGType
+from .imports import is_torch_available
+
+__all__ = ["set_seed", "synchronize_rng_state", "synchronize_rng_states", "rng_registry", "next_rng_key"]
+
+
+class _RngRegistry:
+    """Holds the framework's root JAX PRNG key and a fold-in counter."""
+
+    def __init__(self):
+        self.root_key: Optional[jax.Array] = None
+        self._counter = 0
+        self.initial_seed: Optional[int] = None
+
+    def seed(self, seed: int):
+        self.initial_seed = seed
+        self.root_key = jax.random.key(seed)
+        self._counter = 0
+
+    def next_key(self) -> jax.Array:
+        if self.root_key is None:
+            self.seed(0)
+        self._counter += 1
+        return jax.random.fold_in(self.root_key, self._counter)
+
+
+rng_registry = _RngRegistry()
+
+
+def next_rng_key() -> jax.Array:
+    return rng_registry.next_key()
+
+
+def set_seed(seed: int, device_specific: bool = False, deterministic: bool = False) -> None:
+    """Seed python/numpy/torch/jax in one call.
+
+    Parity: reference ``utils/random.py:39`` (``set_seed``).  ``device_specific``
+    offsets the seed by process index (reference behavior) so per-host shuffles
+    decorrelate when desired.  ``deterministic`` is a no-op: XLA is deterministic
+    by construction for a fixed key.
+    """
+    if device_specific:
+        from ..state import PartialState
+
+        seed += PartialState().process_index
+    random.seed(seed)
+    np.random.seed(seed % (2**32))
+    if is_torch_available():
+        import torch
+
+        torch.manual_seed(seed)
+    rng_registry.seed(seed)
+
+
+def synchronize_rng_state(rng_type: Optional[RNGType] = None, generator=None) -> None:
+    """Broadcast the chosen RNG state from process 0 to all processes.
+
+    Parity: reference ``utils/random.py synchronize_rng_state``.  For
+    ``RNGType.JAX`` the root threefry key is broadcast; for stateful generators the
+    full state blob is broadcast.
+    """
+    from ..state import PartialState
+
+    state = PartialState()
+    if state.num_processes == 1 and rng_type != RNGType.GENERATOR:
+        return
+
+    if rng_type == RNGType.JAX or rng_type is None:
+        if state.num_processes > 1:
+            from jax.experimental import multihost_utils
+
+            seed = np.array([rng_registry.initial_seed or 0], dtype=np.int64)
+            seed = np.asarray(
+                multihost_utils.broadcast_one_to_all(seed, is_source=state.is_main_process)
+            )
+            rng_registry.seed(int(seed[0]))
+        return
+    if rng_type == RNGType.PYTHON:
+        from .operations import broadcast_object_list
+
+        st = [random.getstate()]
+        broadcast_object_list(st)
+        random.setstate(st[0])
+        return
+    if rng_type == RNGType.NUMPY:
+        from .operations import broadcast_object_list
+
+        st = [np.random.get_state()]
+        broadcast_object_list(st)
+        np.random.set_state(st[0])
+        return
+    if rng_type in (RNGType.TORCH, RNGType.XLA, RNGType.GENERATOR):
+        if not is_torch_available():
+            return
+        import torch
+
+        from .operations import broadcast_object_list
+
+        if rng_type == RNGType.GENERATOR and generator is not None:
+            st = [generator.get_state()]
+            broadcast_object_list(st)
+            generator.set_state(st[0])
+        else:
+            st = [torch.get_rng_state()]
+            broadcast_object_list(st)
+            torch.set_rng_state(st[0])
+        return
+    raise ValueError(f"Unknown RNG type {rng_type}")
+
+
+def synchronize_rng_states(rng_types: Iterable[str], generator=None) -> None:
+    """Parity: reference ``utils/random.py:synchronize_rng_states``."""
+    for rng_type in rng_types:
+        synchronize_rng_state(RNGType(rng_type), generator=generator)

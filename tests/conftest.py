@@ -1,0 +1,41 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's `debug_launcher` strategy (reference ``launchers.py:268`` —
+N CPU processes with gloo) translated to JAX: one process, 8 virtual CPU devices via
+``--xla_force_host_platform_device_count``, so every mesh/sharding semantics test
+runs without TPU hardware (SURVEY §4 "Implication for our build").
+"""
+
+import os
+
+# Must be set before the CPU backend client is created.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# Some environments (e.g. the axon TPU tunnel) force jax_platforms at interpreter
+# startup via sitecustomize; undo that so tests run on the virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax.extend.backend import clear_backends
+
+    clear_backends()
+except Exception:
+    pass
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_singletons():
+    """Reference parity: ``AccelerateTestCase.tearDown`` (``test_utils/testing.py:
+    610-621``) resets the three state singletons between tests."""
+    yield
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()

@@ -1,0 +1,135 @@
+"""Tests for L2 pytree collectives & tensor utilities (parity: reference
+tests/test_utils.py + test_utils/scripts/test_ops.py semantics, single-process)."""
+
+import collections
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.utils import operations as ops
+
+
+Point = collections.namedtuple("Point", ["x", "y"])
+
+
+def test_recursively_apply_nested():
+    data = {"a": np.ones((2, 2)), "b": [np.zeros(3), (np.ones(1),)], "c": "keep"}
+    out = ops.recursively_apply(lambda t: t + 1, data)
+    assert out["c"] == "keep"
+    np.testing.assert_array_equal(out["a"], np.full((2, 2), 2.0))
+    np.testing.assert_array_equal(out["b"][1][0], np.full(1, 2.0))
+
+
+def test_recursively_apply_namedtuple():
+    p = Point(np.zeros(2), np.ones(2))
+    out = ops.recursively_apply(lambda t: t + 1, p)
+    assert isinstance(out, Point)
+    np.testing.assert_array_equal(out.x, np.ones(2))
+
+
+def test_recursively_apply_error_on_other_type():
+    with pytest.raises(TypeError):
+        ops.recursively_apply(lambda t: t, {"a": object()}, error_on_other_type=True)
+
+
+def test_send_to_device_converts_torch():
+    import torch
+
+    batch = {"x": torch.ones(2, 3), "y": np.zeros(2)}
+    out = ops.send_to_device(batch)
+    assert isinstance(out["x"], jax.Array)
+    assert out["x"].shape == (2, 3)
+
+
+def test_send_to_device_skip_keys():
+    import torch
+
+    batch = {"x": torch.ones(2), "meta": torch.zeros(1)}
+    out = ops.send_to_device(batch, skip_keys=["meta"])
+    assert isinstance(out["x"], jax.Array)
+    import torch as t
+
+    assert isinstance(out["meta"], t.Tensor)
+
+
+def test_find_batch_size():
+    assert ops.find_batch_size({"a": np.zeros((5, 2))}) == 5
+    assert ops.find_batch_size([np.zeros((3,))]) == 3
+    with pytest.raises(TypeError):
+        ops.find_batch_size({"a": "nope"})
+    assert ops.ignorant_find_batch_size({"a": "nope"}) is None
+
+
+def test_gather_single_process_identity():
+    x = jnp.arange(8.0)
+    out = ops.gather({"x": x})
+    np.testing.assert_array_equal(np.asarray(out["x"]), np.arange(8.0))
+
+
+def test_gather_object_single():
+    assert ops.gather_object([1, 2]) == [1, 2]
+
+
+def test_reduce_single():
+    out = ops.reduce(np.ones((2, 2)), reduction="sum")
+    np.testing.assert_array_equal(out, np.ones((2, 2)))
+
+
+def test_pad_across_processes_noop_single():
+    x = np.ones((2, 3))
+    out = ops.pad_across_processes(x, dim=1)
+    np.testing.assert_array_equal(out, x)
+
+
+def test_pad_input_tensors():
+    # batch of 5 over 4 processes -> padded to 8 by repeating last row.
+    x = np.arange(5)[:, None].repeat(2, axis=1)
+    out = ops.pad_input_tensors(x, batch_size=5, num_processes=4)
+    assert out.shape == (8, 2)
+    np.testing.assert_array_equal(out[5:], np.full((3, 2), 4))
+
+
+def test_concatenate_nested():
+    a = {"x": np.ones((2, 2)), "y": [np.zeros(2)]}
+    b = {"x": np.zeros((3, 2)), "y": [np.ones(1)]}
+    out = ops.concatenate([a, b])
+    assert out["x"].shape == (5, 2)
+    assert out["y"][0].shape == (3,)
+
+
+def test_convert_to_fp32():
+    data = {"a": jnp.ones(2, dtype=jnp.bfloat16), "b": jnp.ones(2, dtype=jnp.int32)}
+    out = ops.convert_to_fp32(data)
+    assert out["a"].dtype == jnp.float32
+    assert out["b"].dtype == jnp.int32
+
+
+def test_get_data_structure_and_initialize():
+    data = {"a": np.ones((2, 3), dtype=np.float32)}
+    struct = ops.get_data_structure(data)
+    assert struct["a"].shape == (2, 3)
+    zeros = ops.initialize_tensors(struct)
+    assert zeros["a"].shape == (2, 3)
+    np.testing.assert_array_equal(np.asarray(zeros["a"]), np.zeros((2, 3)))
+
+
+def test_listify():
+    assert ops.listify({"a": np.array([1, 2])}) == {"a": [1, 2]}
+
+
+def test_broadcast_object_list_single():
+    obj = ["a", {"b": 1}]
+    out = ops.broadcast_object_list(obj)
+    assert out == ["a", {"b": 1}]
+
+
+def test_set_seed_reproducible():
+    from accelerate_tpu.utils import next_rng_key, set_seed
+
+    set_seed(42)
+    k1 = next_rng_key()
+    set_seed(42)
+    k2 = next_rng_key()
+    assert jax.random.uniform(k1) == jax.random.uniform(k2)

@@ -1,0 +1,74 @@
+"""Tests for L1 state singletons (parity: reference tests/test_state_checkpointing
++ test_accelerator state behaviors)."""
+
+import jax
+import numpy as np
+import pytest
+
+from accelerate_tpu import ParallelismConfig
+from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+from accelerate_tpu.utils import DistributedType
+
+
+def test_partial_state_singleton():
+    s1 = PartialState()
+    s2 = PartialState()
+    assert s1.__dict__ is s2.__dict__
+    assert s1.num_processes == 1
+    assert s1.process_index == 0
+    assert s1.is_main_process
+    assert s1.num_devices == 8
+
+
+def test_partial_state_distributed_type_cpu_mesh():
+    s = PartialState()
+    # 8 virtual devices, one process -> device-level parallelism active.
+    assert s.distributed_type == DistributedType.TPU_JAX
+    assert s.use_distributed
+
+
+def test_split_between_processes_single():
+    s = PartialState()
+    with s.split_between_processes([1, 2, 3]) as chunk:
+        assert chunk == [1, 2, 3]
+
+
+def test_accelerator_state_default_mesh():
+    state = AcceleratorState()
+    assert state.mesh.devices.size == 8
+    # Default: all devices on the dp axis.
+    assert state.parallelism_config.dp == 8
+    assert state.mesh.shape["dp"] == 8
+
+
+def test_accelerator_state_explicit_mesh():
+    state = AcceleratorState(parallelism_config=ParallelismConfig(fsdp=4, tp=2))
+    assert state.mesh.shape["fsdp"] == 4
+    assert state.mesh.shape["tp"] == 2
+    assert state.parallelism_config.total_size == 8
+
+
+def test_accelerator_state_bad_mesh_size():
+    with pytest.raises(ValueError, match="does not match"):
+        AcceleratorState(parallelism_config=ParallelismConfig(dp=3))
+
+
+def test_accelerator_state_mixed_precision():
+    state = AcceleratorState(mixed_precision="bf16")
+    assert state.mixed_precision == "bf16"
+    assert state.dtype_policy.compute_dtype == "bfloat16"
+    assert state.dtype_policy.param_dtype == "float32"
+
+
+def test_gradient_state_defaults():
+    gs = GradientState()
+    assert gs.sync_gradients
+    assert gs.num_steps == 1
+    assert not gs.in_dataloader
+    assert gs.remainder == -1
+
+
+def test_mixed_precision_reinit_conflict():
+    AcceleratorState(mixed_precision="no")
+    with pytest.raises(ValueError, match="already initialized"):
+        AcceleratorState(mixed_precision="bf16")
