@@ -1,0 +1,248 @@
+"""Data-pipeline tests.
+
+Covers the index-math semantics that reference ``tests/test_data_loader.py``
+specifies exhaustively, including a direct parity oracle: when the reference tree
+is mounted, every (dataset size, batch size, num_processes, split/drop/even) combo
+is cross-checked against the reference's own samplers.
+"""
+
+import math
+import os
+import sys
+
+import numpy as np
+import pytest
+import torch
+from torch.utils.data import BatchSampler, DataLoader, SequentialSampler, IterableDataset
+
+from accelerate_tpu.data_loader import (
+    BatchSamplerShard,
+    DataLoaderDispatcher,
+    DataLoaderShard,
+    IterableDatasetShard,
+    SeedableRandomSampler,
+    prepare_data_loader,
+    skip_first_batches,
+)
+from accelerate_tpu.state import AcceleratorState, GradientState
+
+REFERENCE_SRC = "/root/reference/src"
+
+
+def _shards(n_items, batch_size, num_processes, split_batches, drop_last, even_batches, cls):
+    out = []
+    for p in range(num_processes):
+        bs = BatchSampler(SequentialSampler(range(n_items)), batch_size=batch_size, drop_last=drop_last)
+        shard = cls(
+            bs,
+            num_processes=num_processes,
+            process_index=p,
+            split_batches=split_batches,
+            even_batches=even_batches,
+        )
+        out.append(list(shard))
+    return out
+
+
+def test_batch_sampler_shard_docstring_cases():
+    # Reference docstring (data_loader.py:128-133): 2 procs, batches [[0..3],[4..7]]
+    res = _shards(8, 4, 2, False, False, True, BatchSamplerShard)
+    assert res == [[[0, 1, 2, 3]], [[4, 5, 6, 7]]]
+    res = _shards(8, 4, 2, True, False, True, BatchSamplerShard)
+    assert res == [[[0, 1], [4, 5]], [[2, 3], [6, 7]]]
+
+
+def test_batch_sampler_shard_wraparound():
+    # 8 items, bs 3, 2 procs: batches [012],[345],[67] -> wraparound fills from start
+    res = _shards(8, 3, 2, False, False, True, BatchSamplerShard)
+    assert res == [[[0, 1, 2], [6, 7, 0]], [[3, 4, 5], [1, 2, 3]]]
+
+
+def test_batch_sampler_shard_even_false():
+    res = _shards(8, 3, 2, False, False, False, BatchSamplerShard)
+    assert res == [[[0, 1, 2], [6, 7]], [[3, 4, 5]]]
+
+
+def test_batch_sampler_shard_drop_last():
+    res = _shards(8, 3, 2, False, True, True, BatchSamplerShard)
+    assert res == [[[0, 1, 2]], [[3, 4, 5]]]
+
+
+def test_batch_sampler_shard_lengths():
+    for n in (7, 8, 16, 22, 25):
+        for bs in (2, 3, 4):
+            for nproc in (2, 3, 4):
+                for drop in (False, True):
+                    shards = _shards(n, bs, nproc, False, drop, True, BatchSamplerShard)
+                    lens = [len(s) for s in shards]
+                    # Every process must yield the same number of batches...
+                    assert len(set(lens)) == 1, (n, bs, nproc, drop, lens)
+                    # ...matching __len__, all full-size.
+                    sampler = BatchSampler(SequentialSampler(range(n)), batch_size=bs, drop_last=drop)
+                    shard0 = BatchSamplerShard(sampler, num_processes=nproc, process_index=0)
+                    assert lens[0] == len(shard0), (n, bs, nproc, drop)
+                    for s in shards:
+                        assert all(len(b) == bs for b in s)
+
+
+@pytest.mark.skipif(not os.path.isdir(REFERENCE_SRC), reason="reference tree not mounted")
+def test_batch_sampler_shard_parity_with_reference():
+    """Oracle: our sampler's output must match the reference's for every combo."""
+    sys.path.insert(0, REFERENCE_SRC)
+    try:
+        from accelerate.data_loader import BatchSamplerShard as RefShard
+    finally:
+        sys.path.remove(REFERENCE_SRC)
+    for n in (5, 7, 8, 12, 16, 21, 24, 2, 3):
+        for bs in (2, 3, 4, 8):
+            for nproc in (1, 2, 3, 4):
+                for split in (False, True):
+                    if split and bs % nproc != 0:
+                        continue
+                    for drop in (False, True):
+                        for even in (True, False):
+                            ours = _shards(n, bs, nproc, split, drop, even, BatchSamplerShard)
+                            theirs = _shards(n, bs, nproc, split, drop, even, RefShard)
+                            assert ours == theirs, (n, bs, nproc, split, drop, even)
+
+
+class _Iterable(IterableDataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __iter__(self):
+        yield from range(self.n)
+
+    def __len__(self):
+        return self.n
+
+
+def test_iterable_dataset_shard():
+    # Reference docstring: 2 procs, data 0..7, bs 4: no-split p0 [0..3], p1 [4..7]
+    shards = [
+        list(IterableDatasetShard(_Iterable(8), batch_size=4, num_processes=2, process_index=p))
+        for p in range(2)
+    ]
+    assert shards == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    shards = [
+        list(
+            IterableDatasetShard(
+                _Iterable(8), batch_size=4, num_processes=2, process_index=p, split_batches=True
+            )
+        )
+        for p in range(2)
+    ]
+    assert shards == [[0, 1, 4, 5], [2, 3, 6, 7]]
+
+
+@pytest.mark.skipif(not os.path.isdir(REFERENCE_SRC), reason="reference tree not mounted")
+def test_iterable_dataset_shard_parity_with_reference():
+    sys.path.insert(0, REFERENCE_SRC)
+    try:
+        from accelerate.data_loader import IterableDatasetShard as RefShard
+    finally:
+        sys.path.remove(REFERENCE_SRC)
+    for n in (3, 7, 8, 12, 17, 24):
+        for bs in (2, 4):
+            for nproc in (1, 2, 4):
+                for split in (False, True):
+                    if split and bs > 1 and bs % nproc != 0:
+                        continue
+                    for drop in (False, True):
+                        ours = [
+                            list(IterableDatasetShard(_Iterable(n), bs, drop, nproc, p, split))
+                            for p in range(nproc)
+                        ]
+                        theirs = [
+                            list(RefShard(_Iterable(n), bs, drop, nproc, p, split))
+                            for p in range(nproc)
+                        ]
+                        assert ours == theirs, (n, bs, nproc, split, drop)
+
+
+def _make_loader(n=16, bs=4):
+    ds = torch.arange(n, dtype=torch.float32).unsqueeze(1)
+    return DataLoader(list(ds), batch_size=bs)
+
+
+def test_prepare_data_loader_places_on_mesh():
+    """batch_size is PER data shard: 8-way dp mesh * bs 4 -> global batches of 32."""
+    import jax
+
+    AcceleratorState()  # default dp=8 mesh
+    dl = prepare_data_loader(_make_loader(64, 4))
+    assert dl.total_batch_size == 32
+    batches = list(dl)
+    assert len(batches) == 2
+    assert isinstance(batches[0], jax.Array)
+    assert batches[0].shape == (32, 1)
+    assert len(batches[0].sharding.device_set) == 8
+    np.testing.assert_array_equal(np.asarray(batches[1])[:4], np.arange(32, 36)[:, None])
+
+
+def test_prepare_data_loader_split_batches():
+    AcceleratorState()
+    dl = prepare_data_loader(_make_loader(64, 32), split_batches=True)
+    assert dl.total_batch_size == 32
+    batches = list(dl)
+    assert len(batches) == 2
+    assert batches[0].shape == (32, 1)
+
+
+def test_dataloader_shard_end_of_dataloader_flag():
+    AcceleratorState()
+    dl = prepare_data_loader(_make_loader(96, 4))
+    gs = GradientState()
+    flags = []
+    for _ in dl:
+        flags.append(gs.end_of_dataloader)
+    assert flags == [False, False, True]
+    assert not gs.in_dataloader
+
+
+def test_dataloader_remainder():
+    AcceleratorState()
+    dl = prepare_data_loader(_make_loader(72, 4))
+    gs = GradientState()
+    for _ in dl:
+        pass
+    # 72 % 32 == 8 extra samples on the final batch
+    assert dl.remainder == 8
+
+
+def test_skip_first_batches():
+    AcceleratorState()
+    dl = prepare_data_loader(_make_loader(128, 4))
+    skipped = skip_first_batches(dl, 2)
+    batches = [np.asarray(b) for b in skipped]
+    assert len(batches) == 2
+    np.testing.assert_array_equal(batches[0][:4], np.arange(64, 68)[:, None])
+
+
+def test_seedable_random_sampler_deterministic():
+    s1 = SeedableRandomSampler(list(range(100)), initial_seed=7)
+    s2 = SeedableRandomSampler(list(range(100)), initial_seed=7)
+    assert list(s1) == list(s2)
+    # Different epoch -> different permutation
+    s2.set_epoch(5)
+    assert list(s1) != list(s2)
+    assert sorted(list(s1)) == list(range(100))
+
+
+def test_dispatcher_single_process():
+    AcceleratorState()
+    dl = DataLoaderDispatcher(_make_loader(16, 4), put_on_device=False)
+    batches = list(dl)
+    assert len(batches) == 4
+    gs = GradientState()
+    assert not gs.in_dataloader
+
+
+def test_set_epoch_propagates():
+    AcceleratorState()
+    sampler = SeedableRandomSampler(list(range(16)), initial_seed=3)
+    ds = [torch.tensor([float(i)]) for i in range(16)]
+    base = DataLoader(ds, batch_size=4, sampler=sampler)
+    dl = DataLoaderShard(base, put_on_device=False)
+    dl.set_epoch(3)
+    assert sampler.epoch == 3
