@@ -1,0 +1,310 @@
+"""Flagship model: Llama-style decoder, designed TPU-first.
+
+No reference analog (the reference wraps user torch models); this is the model used
+by our benchmarks (BASELINE.md: Llama-3-8B FSDP on v5e) and the graft entry.
+
+TPU-first choices:
+- Parameters are a flat pytree of stacked per-layer arrays so the decoder runs as a
+  single ``lax.scan`` over layers — one compiled layer body, fast compiles, and
+  clean pipeline-parallel stage splitting later.
+- bf16 compute / fp32 params + fp32 softmax & loss (MXU-friendly, stable).
+- Every weight carries a `PartitionSpec` (``PARTITION_RULES``) over the named mesh
+  axes (fsdp/tp/sp); activations get ``with_sharding_constraint`` at layer
+  boundaries so GSPMD keeps batch on data axes and sequence on ``sp``.
+- GQA + RoPE, RMSNorm, SwiGLU — the Llama-3 architecture family.
+- Optional ``jax.checkpoint`` rematerialization of each layer (HBM for FLOPs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["LlamaConfig", "init_params", "apply", "loss_fn", "PARTITION_RULES", "param_specs"]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: Optional[int] = None
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    rms_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16  # compute dtype
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @classmethod
+    def tiny(cls, **kw) -> "LlamaConfig":
+        """Test-sized config (CPU-mesh friendly)."""
+        defaults = dict(
+            vocab_size=256,
+            hidden_size=64,
+            intermediate_size=128,
+            num_layers=2,
+            num_heads=4,
+            num_kv_heads=2,
+            max_seq_len=128,
+            remat=False,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def llama3_8b(cls, **kw) -> "LlamaConfig":
+        defaults = dict(
+            vocab_size=128256,
+            hidden_size=4096,
+            intermediate_size=14336,
+            num_layers=32,
+            num_heads=32,
+            num_kv_heads=8,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+    def flops_per_token(self) -> float:
+        """Approximate training FLOPs per token (6 * params for matmuls + attention
+        quadratic term is handled by callers with seq length)."""
+        return 6.0 * self.num_params()
+
+    def num_params(self) -> int:
+        d, f, v, l = self.hidden_size, self.intermediate_size, self.vocab_size, self.num_layers
+        hd = self.head_dim_
+        attn = d * self.num_heads * hd + 2 * d * self.num_kv_heads * hd + self.num_heads * hd * d
+        mlp = 3 * d * f
+        norms = 2 * d
+        embed = v * d * (1 if self.tie_embeddings else 2)
+        return l * (attn + mlp + norms) + embed + d
+
+
+# Mesh-axis layout of every parameter (path regex -> PartitionSpec).  Matmul
+# weights shard their contraction-free dim on `tp` and the other on `fsdp`
+# (Megatron layout expressed as GSPMD annotations; XLA inserts the all-gathers/
+# reduce-scatters the reference delegated to torch FSDP/Megatron).
+PARTITION_RULES: list[tuple[str, P]] = [
+    (r"embed", P("tp", "fsdp")),
+    (r"layers/wq", P(None, "fsdp", "tp")),
+    (r"layers/wk", P(None, "fsdp", "tp")),
+    (r"layers/wv", P(None, "fsdp", "tp")),
+    (r"layers/wo", P(None, "tp", "fsdp")),
+    (r"layers/w_gate", P(None, "fsdp", "tp")),
+    (r"layers/w_up", P(None, "fsdp", "tp")),
+    (r"layers/w_down", P(None, "tp", "fsdp")),
+    (r"layers/ln_", P(None, None)),
+    (r"final_norm", P(None)),
+    (r"lm_head", P("fsdp", "tp")),
+]
+
+
+def param_specs(config: LlamaConfig) -> dict:
+    """Pytree of PartitionSpecs matching ``init_params``' structure."""
+    from ..parallel.sharding import spec_from_rules
+
+    shapes = _param_shapes(config)
+
+    def one(kp, shape):
+        path = "/".join(str(getattr(k, "key", k)) for k in kp)
+        spec = spec_from_rules(path, len(shape), PARTITION_RULES)
+        return spec if spec is not None else P(*([None] * len(shape)))
+
+    return jax.tree_util.tree_map_with_path(one, shapes)
+
+
+def _param_shapes(config: LlamaConfig) -> dict:
+    c = config
+    d, f, hd = c.hidden_size, c.intermediate_size, c.head_dim_
+    L = c.num_layers
+    shapes = {
+        "embed": (c.vocab_size, d),
+        "layers": {
+            "wq": (L, d, c.num_heads * hd),
+            "wk": (L, d, c.num_kv_heads * hd),
+            "wv": (L, d, c.num_kv_heads * hd),
+            "wo": (L, c.num_heads * hd, d),
+            "w_gate": (L, d, f),
+            "w_up": (L, d, f),
+            "w_down": (L, f, d),
+            "ln_attn": (L, d),
+            "ln_mlp": (L, d),
+        },
+        "final_norm": (d,),
+    }
+    if not c.tie_embeddings:
+        shapes["lm_head"] = (d, c.vocab_size)
+    return shapes
+
+
+def init_params(config: LlamaConfig, key: jax.Array) -> dict:
+    """Initialize parameters (truncated-normal fan-in scaling)."""
+    shapes = _param_shapes(config)
+    leaves, treedef = jax.tree_util.tree_flatten(shapes, is_leaf=lambda x: isinstance(x, tuple))
+    keys = jax.random.split(key, len(leaves))
+
+    def init_one(shape, k):
+        if len(shape) == 1 or (len(shape) == 2 and shape[0] == config.num_layers):
+            return jnp.ones(shape, config.param_dtype)  # norm scales
+        fan_in = shape[-2]
+        scale = 1.0 / np.sqrt(fan_in)
+        return (jax.random.truncated_normal(k, -2.0, 2.0, shape, jnp.float32) * scale).astype(
+            config.param_dtype
+        )
+
+    return jax.tree_util.tree_unflatten(treedef, [init_one(s, k) for s, k in zip(leaves, keys)])
+
+
+def _maybe_constrain(x: jax.Array, spec: P) -> jax.Array:
+    """Sharding hint that no-ops when no global mesh is installed (single-device
+    use without an AcceleratorState)."""
+    try:
+        m = jax.sharding.get_abstract_mesh()
+    except AttributeError:  # older jax
+        from jax._src import mesh as _mesh_lib
+
+        m = _mesh_lib.get_abstract_mesh()
+    if m is None or m.empty or not m.axis_names:
+        return x
+    if not all(a in m.axis_names for ax in spec if ax is not None for a in (ax if isinstance(ax, tuple) else (ax,))):
+        return x
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+def _rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    # fp32 statistics regardless of compute dtype.
+    x32 = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * rms).astype(x.dtype) * scale.astype(x.dtype)
+
+
+def _rope(q: jax.Array, k: jax.Array, positions: jax.Array, theta: float) -> tuple[jax.Array, jax.Array]:
+    """Rotary embeddings applied to [B, S, H, hd] queries/keys."""
+    hd = q.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, hd/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+
+    def rot(x):
+        x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+        return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
+def _attention(q, k, v, mask, num_groups: int):
+    """Causal GQA attention.  [B, S, H, hd] x [B, S, K, hd].
+
+    Round-1 implementation is plain einsum+softmax (XLA fuses well on the MXU);
+    the Pallas splash/ring kernel plugs in here for long-context (`ops/`).
+    """
+    b, s, h, hd = q.shape
+    kk = k.shape[2]
+    q = q.reshape(b, s, kk, num_groups, hd)
+    scores = jnp.einsum("bskgd,btkd->bkgst", q, k).astype(jnp.float32)
+    scores = scores / np.sqrt(hd)
+    scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
+    return out.reshape(b, s, h, hd)
+
+
+def _layer(carry, layer_params, *, config: LlamaConfig, mask, positions, act_spec):
+    x = carry
+    c = config
+    hd = c.head_dim_
+    p = layer_params
+
+    h = _rms_norm(x, p["ln_attn"], c.rms_eps)
+    b, s, _ = h.shape
+    q = (h @ p["wq"].astype(c.dtype)).reshape(b, s, c.num_heads, hd)
+    k = (h @ p["wk"].astype(c.dtype)).reshape(b, s, c.num_kv_heads, hd)
+    v = (h @ p["wv"].astype(c.dtype)).reshape(b, s, c.num_kv_heads, hd)
+    q, k = _rope(q, k, positions, c.rope_theta)
+    attn = _attention(q, k, v, mask, c.num_heads // c.num_kv_heads)
+    x = x + attn.reshape(b, s, c.num_heads * hd) @ p["wo"].astype(c.dtype)
+
+    h = _rms_norm(x, p["ln_mlp"], c.rms_eps)
+    gate = jax.nn.silu(h @ p["w_gate"].astype(c.dtype))
+    up = h @ p["w_up"].astype(c.dtype)
+    x = x + (gate * up) @ p["w_down"].astype(c.dtype)
+    x = _maybe_constrain(x, act_spec)
+    return x, None
+
+
+def apply(
+    params: dict,
+    input_ids: jax.Array,
+    config: LlamaConfig,
+    positions: Optional[jax.Array] = None,
+    attention_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Forward pass: token ids [B, S] -> logits [B, S, V] (fp32)."""
+    c = config
+    b, s = input_ids.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    causal = jnp.tril(jnp.ones((s, s), bool))
+    mask = jnp.broadcast_to(causal, (b, s, s))
+    if attention_mask is not None:
+        mask = mask & attention_mask[:, None, :].astype(bool)
+
+    x = params["embed"].astype(c.dtype)[input_ids]
+    act_spec = P(("dcn_dp", "dp", "fsdp"), "sp", None)
+    x = _maybe_constrain(x, act_spec)
+
+    def body(carry, lp):
+        return _layer(carry, lp, config=c, mask=mask, positions=positions, act_spec=act_spec)
+
+    if c.remat:
+        body = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+
+    x = _rms_norm(x, params["final_norm"], c.rms_eps)
+    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
+    logits = (x @ head.astype(c.dtype)).astype(jnp.float32)
+    return logits
+
+
+def loss_fn(
+    params: dict,
+    batch: dict,
+    config: LlamaConfig,
+) -> jax.Array:
+    """Next-token cross-entropy, fp32, mean over non-padded targets.
+
+    ``batch``: {"input_ids": [B, S]} (+ optional "labels", "attention_mask").
+    """
+    input_ids = batch["input_ids"]
+    labels = batch.get("labels")
+    if labels is None:
+        labels = jnp.concatenate([input_ids[:, 1:], jnp.zeros_like(input_ids[:, :1])], axis=1)
+        weights = jnp.concatenate(
+            [jnp.ones_like(input_ids[:, 1:]), jnp.zeros_like(input_ids[:, :1])], axis=1
+        ).astype(jnp.float32)
+    else:
+        weights = (labels >= 0).astype(jnp.float32)
+        labels = jnp.maximum(labels, 0)
+    if "attention_mask" in batch and batch["attention_mask"] is not None:
+        weights = weights * batch["attention_mask"].astype(jnp.float32)
+
+    logits = apply(params, input_ids, config, attention_mask=batch.get("attention_mask"))
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    token_loss = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return jnp.sum(token_loss * weights) / jnp.maximum(jnp.sum(weights), 1.0)

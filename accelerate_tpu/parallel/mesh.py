@@ -57,17 +57,32 @@ def build_mesh(
     """
     axis_names = mesh_axis_names()
     shape = tuple(getattr(cfg, a) for a in axis_names)
+    # Auto axis types: shardings are GSPMD *hints* (with_sharding_constraint
+    # propagates), not the assert semantics of Explicit mode.
+    axis_types = (jax.sharding.AxisType.Auto,) * len(axis_names)
     if devices is None:
         try:
-            return jax.make_mesh(shape, axis_names)
+            return jax.make_mesh(shape, axis_names, axis_types=axis_types)
         except (ValueError, RuntimeError):
             devices = jax.devices()
     n = int(np.prod(shape))
     if len(devices) < n:
         raise ValueError(f"Need {n} devices for mesh {dict(zip(axis_names, shape))}, have {len(devices)}")
     dev_array = np.asarray(devices[:n]).reshape(shape)
-    return Mesh(dev_array, axis_names)
+    return Mesh(dev_array, axis_names, axis_types=axis_types)
 
 
 def local_mesh_shape(mesh: Mesh) -> dict[str, int]:
     return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def trivial_mesh() -> Mesh:
+    """A 1-device mesh with every named axis at size 1 — used to reset the global
+    mesh context so sharding constraints become no-ops."""
+    names = mesh_axis_names()
+    dev = np.asarray(jax.devices()[:1]).reshape((1,) * len(names))
+    return Mesh(dev, names, axis_types=(jax.sharding.AxisType.Auto,) * len(names))
+
+
+def reset_global_mesh() -> None:
+    jax.set_mesh(trivial_mesh())

@@ -401,6 +401,9 @@ class AcceleratorState:
 
         self.parallelism_config = self._resolve_parallelism(parallelism_config)
         self.mesh = self._build_mesh(self.parallelism_config)
+        # Install as the global mesh context so bare-PartitionSpec sharding
+        # constraints inside model code resolve against it.
+        jax.set_mesh(self.mesh)
 
         # distributed_type rewrite, mirroring reference state.py:952-976.
         if self.fsdp_plugin is not None and self.parallelism_config.fsdp > 1:
@@ -475,6 +478,10 @@ class AcceleratorState:
 
     @classmethod
     def _reset_state(cls, reset_partial_state: bool = False) -> None:
+        if cls._shared_state:
+            from .parallel.mesh import reset_global_mesh
+
+            reset_global_mesh()
         cls._shared_state.clear()
         if reset_partial_state:
             PartialState._reset_state()
