@@ -319,10 +319,21 @@ class _GlobalBatchPlacer:
     array; XLA pipelines the transfer.
     """
 
-    def __init__(self, mesh: Optional[jax.sharding.Mesh], non_blocking: bool = False, device=None):
+    def __init__(
+        self,
+        mesh: Optional[jax.sharding.Mesh],
+        non_blocking: bool = False,
+        device=None,
+        output_type: str = "jax",
+    ):
         self.mesh = mesh
         self.non_blocking = non_blocking  # jax transfers are always async; kept for API parity
         self.device = device
+        # "jax": yield global jax.Arrays.  "torch": yield torch views of the host
+        # batch carrying the placed jax array as `._atpu_jax` — user-land torch
+        # ops (criteria, metrics) work unchanged while the model call path picks
+        # up the device array with no extra transfer.
+        self.output_type = output_type
         self._data_axes: tuple[str, ...] = ()
         if mesh is not None:
             from .parallel.mesh import data_axes
@@ -345,9 +356,28 @@ class _GlobalBatchPlacer:
         per-host batch)."""
         return max(self.num_data_shards // jax.process_count(), 1)
 
+    def _wrap(self, host_arr: np.ndarray, jax_arr: jax.Array):
+        if self.output_type != "torch":
+            return jax_arr
+        import torch
+
+        t = torch.from_numpy(np.ascontiguousarray(host_arr))
+        t._atpu_jax = jax_arr
+        return t
+
     def __call__(self, batch):
         if self.mesh is None or not self._data_axes:
-            return send_to_device(batch, self.device)
+            if self.output_type != "torch":
+                return send_to_device(batch, self.device)
+            # Wrap the ORIGINAL host array (dtype preserved, e.g. int64 labels for
+            # torch criteria) and attach the placed jax array — no D2H roundtrip.
+            from .utils.operations import to_jax
+
+            def _place_and_wrap(t):
+                host = to_numpy(t)
+                return self._wrap(host, jax.device_put(to_jax(t), self.device))
+
+            return recursively_apply(_place_and_wrap, batch)
         sharding = NamedSharding(self.mesh, PartitionSpec(self._data_axes))
         local_shards = self.local_data_shards
         multi_host = jax.process_count() > 1
@@ -355,7 +385,7 @@ class _GlobalBatchPlacer:
         def _place(t):
             arr = to_numpy(t)
             if arr.ndim == 0:
-                return jax.device_put(arr, NamedSharding(self.mesh, PartitionSpec()))
+                return self._wrap(arr, jax.device_put(arr, NamedSharding(self.mesh, PartitionSpec())))
             if arr.shape[0] % local_shards != 0:
                 # Pad the batch dim by repeating the final row so GSPMD can split
                 # it; device-level analog of even_batches wraparound.  The true
@@ -372,8 +402,8 @@ class _GlobalBatchPlacer:
                 arr = np.concatenate([arr, np.repeat(arr[-1:], pad, axis=0)], axis=0)
             if multi_host:
                 # ``arr`` must be exactly this host's shard of the global batch.
-                return jax.make_array_from_process_local_data(sharding, arr)
-            return jax.device_put(arr, sharding)
+                return self._wrap(arr, jax.make_array_from_process_local_data(sharding, arr))
+            return self._wrap(arr, jax.device_put(arr, sharding))
 
         return recursively_apply(_place, batch)
 
@@ -423,6 +453,7 @@ class DataLoaderShard(DataLoaderStateMixin):
         put_on_device: bool = True,
         mesh: Optional[jax.sharding.Mesh] = None,
         non_blocking: bool = False,
+        output_type: str = "jax",
         _drop_last: bool = False,
         _non_blocking: bool = False,
         **kwargs,
@@ -435,7 +466,11 @@ class DataLoaderShard(DataLoaderStateMixin):
         self.put_on_device = put_on_device
         self.gradient_state = GradientState()
         self.iteration = 0
-        self._placer = _GlobalBatchPlacer(mesh, non_blocking, device=device) if put_on_device else None
+        self._placer = (
+            _GlobalBatchPlacer(mesh, non_blocking, device=device, output_type=output_type)
+            if put_on_device
+            else None
+        )
         self._total_batch_size = kwargs.pop("total_batch_size", None)
 
     # Convenience pass-throughs so the wrapper quacks like the inner loader.
@@ -543,6 +578,7 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
         mesh: Optional[jax.sharding.Mesh] = None,
         slice_fn: Optional[Callable] = None,
         non_blocking: bool = False,
+        output_type: str = "jax",
         **kwargs,
     ):
         self.base_loader = base_loader
@@ -550,7 +586,9 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
         self.skip_batches = skip_batches
         self.state = PartialState()
         self.gradient_state = GradientState()
-        self._placer = _GlobalBatchPlacer(mesh, non_blocking) if put_on_device else None
+        self._placer = (
+            _GlobalBatchPlacer(mesh, non_blocking, output_type=output_type) if put_on_device else None
+        )
         self.slice_fn = slice_fn or slice_tensors
         self.iteration = 0
 
@@ -691,6 +729,7 @@ def prepare_data_loader(
     non_blocking: bool = False,
     use_stateful_dataloader: bool = False,
     mesh: Optional[jax.sharding.Mesh] = None,
+    output_type: str = "jax",
 ):
     """Shard a (torch) dataloader for the current topology and wrap it for global
     device placement.
@@ -751,6 +790,7 @@ def prepare_data_loader(
             mesh=mesh,
             slice_fn=slice_fn_for_dispatch,
             non_blocking=non_blocking,
+            output_type=output_type,
         )
 
     if not is_torch_loader:
@@ -768,6 +808,7 @@ def prepare_data_loader(
             put_on_device=put_on_device,
             mesh=mesh,
             non_blocking=non_blocking,
+            output_type=output_type,
         )
 
     import torch.utils.data
@@ -810,6 +851,7 @@ def prepare_data_loader(
             put_on_device=put_on_device,
             mesh=mesh,
             non_blocking=non_blocking,
+            output_type=output_type,
             total_batch_size=(dataloader.batch_size or 1)
             * (1 if split_batches else total_shards),
         )
@@ -873,6 +915,7 @@ def prepare_data_loader(
         put_on_device=put_on_device,
         mesh=mesh,
         non_blocking=non_blocking,
+        output_type=output_type,
     )
 
 
@@ -922,6 +965,7 @@ def skip_first_batches(dataloader, num_batches: int = 0):
             put_on_device=dataloader._placer is not None,
             mesh=dataloader._placer.mesh if dataloader._placer else None,
             slice_fn=dataloader.slice_fn,
+            output_type=dataloader._placer.output_type if dataloader._placer else "jax",
         )
         return out
     if isinstance(dataloader, DataLoaderShard):
@@ -933,5 +977,7 @@ def skip_first_batches(dataloader, num_batches: int = 0):
             skip_batches=num_batches,
             put_on_device=dataloader.put_on_device,
             mesh=dataloader._placer.mesh if dataloader._placer else None,
+            output_type=dataloader._placer.output_type if dataloader._placer else "jax",
+            total_batch_size=dataloader._total_batch_size,
         )
     return SkipDataLoader(dataloader, skip_batches=num_batches, put_on_device=False)

@@ -1,0 +1,61 @@
+"""Multi-process-aware logging.
+
+Parity: reference ``src/accelerate/logging.py`` (125 LoC): ``MultiProcessAdapter``
+with ``main_process_only`` / ``in_order`` kwargs + ``get_logger``.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+
+__all__ = ["get_logger", "MultiProcessAdapter"]
+
+
+class MultiProcessAdapter(logging.LoggerAdapter):
+    """``log(..., main_process_only=True)`` gates on rank; ``in_order=True``
+    serializes output by rank with barriers (reference ``logging.py:22``)."""
+
+    @staticmethod
+    def _should_log(main_process_only: bool) -> bool:
+        from .state import PartialState
+
+        if PartialState._shared_state == {}:
+            return True
+        state = PartialState()
+        return not main_process_only or state.is_main_process
+
+    def log(self, level, msg, *args, **kwargs):
+        if os.environ.get("ACCELERATE_DISABLE_RICH"):
+            pass
+        main_process_only = kwargs.pop("main_process_only", True)
+        in_order = kwargs.pop("in_order", False)
+        if self.isEnabledFor(level):
+            if in_order:
+                from .state import PartialState
+
+                state = PartialState()
+                for i in range(state.num_processes):
+                    if i == state.process_index:
+                        msg2, kwargs2 = self.process(msg, kwargs)
+                        self.logger.log(level, msg2, *args, **kwargs2)
+                    state.wait_for_everyone()
+                return
+            if self._should_log(main_process_only):
+                msg, kwargs = self.process(msg, kwargs)
+                self.logger.log(level, msg, *args, **kwargs)
+
+    @functools.lru_cache(None)
+    def warning_once(self, *args, **kwargs):
+        self.warning(*args, **kwargs)
+
+
+def get_logger(name: str, log_level: str | None = None) -> MultiProcessAdapter:
+    logger = logging.getLogger(name)
+    if log_level is None:
+        log_level = os.environ.get("ACCELERATE_LOG_LEVEL", None)
+    if log_level is not None:
+        logger.setLevel(log_level.upper())
+        logger.root.setLevel(log_level.upper())
+    return MultiProcessAdapter(logger, {})

@@ -125,7 +125,9 @@ def param_specs(config: LlamaConfig) -> dict:
         spec = spec_from_rules(path, len(shape), PARTITION_RULES)
         return spec if spec is not None else P(*([None] * len(shape)))
 
-    return jax.tree_util.tree_map_with_path(one, shapes)
+    return jax.tree_util.tree_map_with_path(
+        one, shapes, is_leaf=lambda x: isinstance(x, tuple)
+    )
 
 
 def _param_shapes(config: LlamaConfig) -> dict:
@@ -161,7 +163,12 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
     def init_one(shape, k):
         if len(shape) == 1 or (len(shape) == 2 and shape[0] == config.num_layers):
             return jnp.ones(shape, config.param_dtype)  # norm scales
-        fan_in = shape[-2]
+        if len(shape) == 2 and shape[0] == config.vocab_size:
+            # Embedding table: lookup is one-hot (effective fan-in 1), so scale by
+            # hidden size, not vocab size.
+            fan_in = config.hidden_size
+        else:
+            fan_in = shape[-2]
         scale = 1.0 / np.sqrt(fan_in)
         return (jax.random.truncated_normal(k, -2.0, 2.0, shape, jnp.float32) * scale).astype(
             config.param_dtype

@@ -1,0 +1,151 @@
+"""Optimizer adapter — optax-backed, torch-optimizer-shaped.
+
+Parity target: reference ``src/accelerate/optimizer.py`` (213 LoC,
+``AcceleratedOptimizer``): no-op ``step``/``zero_grad`` while gradients are
+accumulating, scaler integration, lazy XLA grad all-reduce at step time.
+
+TPU-native redesign: the optimizer owns the optax ``GradientTransformation`` and a
+*sharded* opt-state pytree (built from sharded params, so ZeRO-style optimizer
+sharding is automatic — the reference's FSDP2 ``data_ptr`` re-mapping dance,
+``accelerator.py:1400-1457``, has no analog).  The reference's lazy grad
+all-reduce (``optimizer.py:149-155``) is unnecessary: gradients come out of the
+jitted step already reduced over data axes by GSPMD.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .state import AcceleratorState, GradientState
+
+__all__ = ["AcceleratedOptimizer"]
+
+
+@partial(jax.jit, donate_argnums=(1, 2), static_argnums=(0,))
+def _update_step(tx_update, params, opt_state, grads, clip_norm):
+    """One optimizer update, jitted once per (tx, clip) structure.
+
+    ``clip_norm`` < 0 disables clipping (static python float would retrigger
+    compilation; pass as array).
+    """
+    gnorm = optax.global_norm(grads)
+    scale = jnp.where(
+        clip_norm > 0, jnp.minimum(1.0, clip_norm / jnp.maximum(gnorm, 1e-12)), 1.0
+    )
+    grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+    updates, new_opt_state = tx_update(grads, opt_state, params)
+    new_params = optax.apply_updates(params, updates)
+    return new_params, new_opt_state, gnorm
+
+
+class AcceleratedOptimizer:
+    """Wraps an optax transformation (or a converted torch optimizer) so the
+    training loop keeps its imperative ``optimizer.step()`` shape.
+
+    Gradients land here from ``accelerator.backward`` (the accumulation buffer);
+    ``step()`` is a no-op while ``GradientState.sync_gradients`` is False —
+    identical observable semantics to reference ``optimizer.py:145-181``.
+    """
+
+    def __init__(
+        self,
+        tx: optax.GradientTransformation,
+        model=None,
+        torch_optimizer=None,
+        initial_lr: Optional[float] = None,
+    ):
+        self.tx = tx
+        self.model = model  # PreparedModel owning the params
+        self.torch_optimizer = torch_optimizer  # shadow for scheduler compat
+        self.initial_lr = initial_lr
+        self.gradient_state = GradientState()
+        self.accelerator_state = AcceleratorState() if AcceleratorState._shared_state else None
+        self.opt_state = None
+        self._step_was_skipped = False
+        self._clip_norm = -1.0  # <0: disabled
+        self._step_count = 0
+        if model is not None:
+            self._init_state()
+
+    def _init_state(self):
+        self.opt_state = self.tx.init(self.model.params)
+
+    # -- torch-optimizer-shaped surface -------------------------------------
+
+    @property
+    def param_groups(self):
+        if self.torch_optimizer is not None:
+            return self.torch_optimizer.param_groups
+        return [{"lr": self.learning_rate}]
+
+    @property
+    def learning_rate(self) -> Optional[float]:
+        if self.opt_state is not None and hasattr(self.opt_state, "hyperparams"):
+            lr = self.opt_state.hyperparams.get("learning_rate")
+            return float(lr) if lr is not None else self.initial_lr
+        return self.initial_lr
+
+    def set_learning_rate(self, lr: float):
+        if self.opt_state is not None and hasattr(self.opt_state, "hyperparams"):
+            self.opt_state.hyperparams["learning_rate"] = jnp.asarray(lr, jnp.float32)
+
+    def zero_grad(self, set_to_none: bool = True):
+        """Clear accumulated gradients — only when a sync step just happened
+        (reference ``optimizer.py:112``: no-op during accumulation)."""
+        if self.gradient_state.sync_gradients and self.model is not None:
+            self.model._clear_grads()
+
+    def step(self, closure=None):
+        if not self.gradient_state.sync_gradients:
+            self._step_was_skipped = True
+            return
+        if self.model is None or self.model._accum_grads is None:
+            self._step_was_skipped = True
+            return
+        grads = self.model._consume_grads()
+        new_params, self.opt_state, gnorm = _update_step(
+            self.tx.update,
+            self.model.params,
+            self.opt_state,
+            grads,
+            jnp.asarray(self._clip_norm, jnp.float32),
+        )
+        self.model._set_params(new_params)
+        self._last_grad_norm = gnorm
+        self._step_was_skipped = False
+        self._step_count += 1
+
+    @property
+    def step_was_skipped(self) -> bool:
+        """Parity: reference ``optimizer_step_was_skipped`` (``accelerator.py:3764``)."""
+        return self._step_was_skipped
+
+    def state_dict(self) -> dict:
+        return {
+            "opt_state": jax.device_get(self.opt_state),
+            "step_count": self._step_count,
+            "initial_lr": self.initial_lr,
+        }
+
+    def load_state_dict(self, state_dict: dict):
+        target = self.opt_state
+        loaded = state_dict["opt_state"]
+        # Restore with the live opt-state's shardings.
+        flat_t, treedef = jax.tree_util.tree_flatten(target)
+        flat_l = jax.tree_util.tree_leaves(loaded)
+        placed = []
+        for t, l in zip(flat_t, flat_l):
+            if isinstance(t, jax.Array) and hasattr(t, "sharding"):
+                placed.append(jax.device_put(jnp.asarray(l), t.sharding))
+            else:
+                placed.append(l)
+        self.opt_state = jax.tree_util.tree_unflatten(treedef, placed)
+        self._step_count = state_dict.get("step_count", 0)
+
+    def __repr__(self):
+        return f"AcceleratedOptimizer({self.tx.__class__.__name__}, lr={self.learning_rate})"

@@ -146,14 +146,20 @@ def make_param_specs(
             # strategy owns the fsdp axis — NO_SHARD/SHARD_GRAD_OP keep params
             # replicated on it even when a rule names it.
             def keep(s):
-                if not _axis_active(mesh, s):
+                if s is None:
                     return None
-                if not shards_params:
-                    if s == "fsdp":
-                        return None
-                    if isinstance(s, tuple):
-                        s = tuple(a for a in s if a != "fsdp") or None
-                return s
+                # Strip inactive axes (and, when the strategy keeps params
+                # replicated, the fsdp axis) from the spec entry; tuples keep
+                # their remaining members.
+                axes = s if isinstance(s, tuple) else (s,)
+                kept = tuple(
+                    a
+                    for a in axes
+                    if _axis_active(mesh, a) and (shards_params or a != "fsdp")
+                )
+                if not kept:
+                    return None
+                return kept if len(kept) > 1 else kept[0]
 
             spec = P(
                 *[keep(s) for s in (list(spec) + [None] * (len(shape) - len(spec)))][: len(shape)]

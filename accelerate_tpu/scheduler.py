@@ -1,0 +1,93 @@
+"""LR scheduler adapter.
+
+Parity target: reference ``src/accelerate/scheduler.py`` (98 LoC,
+``AcceleratedScheduler``): steps only when the optimizer actually stepped (skips
+on overflow), and steps ``num_processes`` times per call unless ``split_batches``
+so LR schedules written for single-process step counts stay correct.
+
+TPU-native twist: the underlying scheduler may be (a) a torch LR scheduler —
+kept attached to the user's shadow torch optimizer, whose LR we read back and
+inject into the optax hyperparams — or (b) any callable ``step -> lr``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+from .state import AcceleratorState, GradientState
+
+__all__ = ["AcceleratedScheduler"]
+
+
+class AcceleratedScheduler:
+    def __init__(
+        self,
+        scheduler,
+        optimizers,
+        step_with_optimizer: bool = True,
+        split_batches: bool = False,
+    ):
+        self.scheduler = scheduler
+        self.optimizers = optimizers if isinstance(optimizers, (list, tuple)) else [optimizers]
+        self.split_batches = split_batches
+        self.step_with_optimizer = step_with_optimizer
+        self.gradient_state = GradientState()
+        self._is_callable = callable(scheduler) and not hasattr(scheduler, "step")
+        self._step_count = 0
+
+    def _apply_lr(self):
+        if self._is_callable:
+            lr = float(self.scheduler(self._step_count))
+        else:
+            lrs = self.scheduler.get_last_lr()
+            lr = lrs[0] if isinstance(lrs, (list, tuple)) else lrs
+        for opt in self.optimizers:
+            opt.set_learning_rate(lr)
+
+    def step(self, *args, **kwargs):
+        if not self.step_with_optimizer:
+            if not self._is_callable:
+                self.scheduler.step(*args, **kwargs)
+            self._step_count += 1
+            self._apply_lr()
+            return
+        if not self.gradient_state.sync_gradients:
+            return
+        # Skip if any optimizer skipped (overflow) — reference scheduler.py:61-68.
+        if any(getattr(opt, "step_was_skipped", False) for opt in self.optimizers):
+            return
+        # The data-parallel world consumes num_data_shards micro-batches of the
+        # single-process schedule per step (reference steps num_processes times,
+        # scheduler.py:69-82); here the shard count plays that role.
+        num_steps = 1
+        if not self.split_batches:
+            state = AcceleratorState() if AcceleratorState._shared_state else None
+            if state is not None:
+                from .parallel.mesh import data_axes
+
+                num_steps = 1
+                for a in data_axes(state.mesh):
+                    num_steps *= state.mesh.shape[a]
+        for _ in range(max(num_steps, 1)):
+            self._step_count += 1
+            if not self._is_callable:
+                self.scheduler.step(*args, **kwargs)
+        self._apply_lr()
+
+    def get_last_lr(self):
+        if self._is_callable:
+            return [float(self.scheduler(self._step_count))]
+        return self.scheduler.get_last_lr()
+
+    def state_dict(self):
+        if self._is_callable:
+            return {"step_count": self._step_count}
+        sd = self.scheduler.state_dict()
+        sd["accelerate_step_count"] = self._step_count
+        return sd
+
+    def load_state_dict(self, state_dict):
+        self._step_count = state_dict.pop("accelerate_step_count", state_dict.get("step_count", 0))
+        if not self._is_callable and "step_count" not in state_dict:
+            self.scheduler.load_state_dict(state_dict)
+        self._apply_lr()

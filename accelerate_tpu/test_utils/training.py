@@ -1,0 +1,61 @@
+"""Tiny y=ax+b fixtures used by distributed correctness checks.
+
+Parity: reference ``test_utils/training.py`` (RegressionModel/RegressionDataset) —
+the oracle fixtures behind ``training_check`` (reference
+``test_utils/scripts/test_script.py:454``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class RegressionDataset:
+    def __init__(self, a=2, b=3, length=64, seed=42):
+        rng = np.random.default_rng(seed)
+        self.length = length
+        self.x = rng.normal(size=(length,)).astype(np.float32)
+        self.y = (a * self.x + b + 0.1 * rng.normal(size=(length,))).astype(np.float32)
+
+    def __len__(self):
+        return self.length
+
+    def __getitem__(self, i):
+        return {"x": self.x[i], "y": self.y[i]}
+
+
+def _torch():
+    import torch
+
+    return torch
+
+
+class RegressionModel(_torch().nn.Module):
+    """y = a*x + b with scalar parameters; loss computed externally (bridge-mode
+    exercise)."""
+
+    def __init__(self, a=0.0, b=0.0):
+        torch = _torch()
+        super().__init__()
+        self.a = torch.nn.Parameter(torch.tensor(float(a)))
+        self.b = torch.nn.Parameter(torch.tensor(float(b)))
+
+    def forward(self, x):
+        return x * self.a + self.b
+
+
+class RegressionModelWithLoss(_torch().nn.Module):
+    """Variant returning {'loss', 'logits'} like transformers models (fused-mode
+    exercise)."""
+
+    def __init__(self, a=0.0, b=0.0):
+        torch = _torch()
+        super().__init__()
+        self.a = torch.nn.Parameter(torch.tensor(float(a)))
+        self.b = torch.nn.Parameter(torch.tensor(float(b)))
+
+    def forward(self, x, y):
+        import torch.nn.functional as F
+
+        pred = x * self.a + self.b
+        return {"loss": F.mse_loss(pred, y), "logits": pred}

@@ -1,0 +1,223 @@
+"""Experiment trackers.
+
+Parity target: reference ``src/accelerate/tracking.py`` (1089 LoC):
+``GeneralTracker`` ABC with ``main_process_only`` gating, 8 backends, registry +
+``filter_trackers``.  Round 1 ships the ABC, the generic dict/JSONL tracker, and
+TensorBoard/WandB adapters (gated on availability); remaining backends follow the
+same adapter shape.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import time
+from typing import Any, Optional, Union
+
+from .logging import get_logger
+from .state import PartialState
+from .utils.imports import is_tensorboard_available, is_wandb_available
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "GeneralTracker",
+    "GenericTracker",
+    "TensorBoardTracker",
+    "WandBTracker",
+    "LOGGER_TYPE_TO_CLASS",
+    "filter_trackers",
+    "init_trackers",
+    "on_main_process",
+]
+
+
+def on_main_process(function):
+    """Run only on the main process (reference ``tracking.py:69``)."""
+
+    @functools.wraps(function)
+    def wrapper(self, *args, **kwargs):
+        if getattr(self, "main_process_only", True) and not PartialState().is_main_process:
+            return None
+        return function(self, *args, **kwargs)
+
+    return wrapper
+
+
+class GeneralTracker:
+    """Base tracker (reference ``tracking.py:93-166``)."""
+
+    name: str = "general"
+    requires_logging_directory: bool = False
+    main_process_only: bool = True
+
+    def __init__(self, _blank: bool = False):
+        pass
+
+    @property
+    def tracker(self):
+        raise NotImplementedError
+
+    def store_init_configuration(self, values: dict):
+        raise NotImplementedError
+
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        raise NotImplementedError
+
+    def finish(self):
+        pass
+
+
+class GenericTracker(GeneralTracker):
+    """Dependency-free JSONL tracker (each log call appends one line)."""
+
+    name = "generic"
+    requires_logging_directory = True
+
+    def __init__(self, run_name: str, logging_dir: str = "."):
+        self.run_name = run_name
+        self.logging_dir = os.path.join(logging_dir, run_name)
+        os.makedirs(self.logging_dir, exist_ok=True)
+        self.path = os.path.join(self.logging_dir, "metrics.jsonl")
+
+    @property
+    def tracker(self):
+        return self.path
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        with open(os.path.join(self.logging_dir, "config.json"), "w") as f:
+            json.dump(values, f, default=str)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        rec = {"_step": step, "_time": time.time()}
+        rec.update({k: (float(v) if hasattr(v, "__float__") else v) for k, v in values.items()})
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rec, default=str) + "\n")
+
+
+class TensorBoardTracker(GeneralTracker):
+    """Reference ``tracking.py:167``."""
+
+    name = "tensorboard"
+    requires_logging_directory = True
+
+    def __init__(self, run_name: str, logging_dir: str = ".", **kwargs):
+        try:
+            from torch.utils import tensorboard
+        except ImportError:
+            import tensorboardX as tensorboard
+        self.run_name = run_name
+        self.logging_dir = os.path.join(logging_dir, run_name)
+        self.writer = tensorboard.SummaryWriter(self.logging_dir, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.writer.add_hparams(
+            {k: v for k, v in values.items() if isinstance(v, (int, float, str, bool))}, {}
+        )
+        self.writer.flush()
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        for k, v in values.items():
+            if isinstance(v, (int, float)) or hasattr(v, "__float__"):
+                self.writer.add_scalar(k, float(v), global_step=step, **kwargs)
+            elif isinstance(v, str):
+                self.writer.add_text(k, v, global_step=step, **kwargs)
+        self.writer.flush()
+
+    @on_main_process
+    def finish(self):
+        self.writer.close()
+
+
+class WandBTracker(GeneralTracker):
+    """Reference ``tracking.py:278``."""
+
+    name = "wandb"
+    requires_logging_directory = False
+
+    def __init__(self, run_name: str, **kwargs):
+        import wandb
+
+        self.run_name = run_name
+        self.run = wandb.init(project=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        import wandb
+
+        wandb.config.update(values, allow_val_change=True)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        self.run.log(values, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        self.run.finish()
+
+
+LOGGER_TYPE_TO_CLASS = {
+    "generic": GenericTracker,
+    "tensorboard": TensorBoardTracker,
+    "wandb": WandBTracker,
+}
+
+
+def filter_trackers(log_with: list, logging_dir: Optional[str] = None) -> list[str]:
+    """Validate requested trackers against availability (reference
+    ``tracking.py:1037``)."""
+    out = []
+    for item in log_with or []:
+        if isinstance(item, GeneralTracker):
+            out.append(item)
+            continue
+        name = str(item).lower()
+        if name == "all":
+            if is_tensorboard_available():
+                out.append("tensorboard")
+            if is_wandb_available():
+                out.append("wandb")
+            continue
+        if name == "tensorboard" and not is_tensorboard_available():
+            logger.warning("tensorboard not available; skipping tracker")
+            continue
+        if name == "wandb" and not is_wandb_available():
+            logger.warning("wandb not available; skipping tracker")
+            continue
+        if name not in LOGGER_TYPE_TO_CLASS:
+            raise ValueError(f"Unknown tracker {name}; options: {sorted(LOGGER_TYPE_TO_CLASS)}")
+        out.append(name)
+    return out
+
+
+def init_trackers(log_with, project_name, config, init_kwargs, accelerator) -> list[GeneralTracker]:
+    init_kwargs = init_kwargs or {}
+    logging_dir = accelerator.project_configuration.logging_dir or "."
+    trackers = []
+    for item in filter_trackers(log_with, logging_dir):
+        if isinstance(item, GeneralTracker):
+            trackers.append(item)
+            continue
+        cls = LOGGER_TYPE_TO_CLASS[item]
+        kwargs = init_kwargs.get(item, {})
+        if cls.requires_logging_directory:
+            trackers.append(cls(project_name, logging_dir=logging_dir, **kwargs))
+        else:
+            trackers.append(cls(project_name, **kwargs))
+    if config is not None:
+        for t in trackers:
+            t.store_init_configuration(config)
+    return trackers

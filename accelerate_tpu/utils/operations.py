@@ -91,6 +91,10 @@ def to_numpy(x: Any) -> np.ndarray:
 def to_jax(x: Any) -> jax.Array:
     if isinstance(x, jax.Array):
         return x
+    # Loader-produced torch views carry the already-placed global array.
+    attached = getattr(x, "_atpu_jax", None)
+    if attached is not None:
+        return attached
     return jnp.asarray(to_numpy(x))
 
 

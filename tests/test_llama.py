@@ -107,7 +107,9 @@ def test_sharded_matches_single_device():
     sp = shard_params(params, mesh, specs)
     sb = {k: jax.device_put(v, data_sharding(mesh)) for k, v in batch.items()}
     sharded_loss = float(jax.jit(lambda p, b: llama.loss_fn(p, b, cfg))(sp, sb))
-    assert abs(base_loss - sharded_loss) < 1e-3, (base_loss, sharded_loss)
+    # bf16 compute: reduction orderings differ across shardings; 3e-3 on a ~6.0
+    # loss is ~5e-4 relative.
+    assert abs(base_loss - sharded_loss) < 3e-3, (base_loss, sharded_loss)
 
 
 def test_no_shard_strategy_replicates():
