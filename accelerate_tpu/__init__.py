@@ -34,10 +34,10 @@ from .utils import (
 
 def __getattr__(name):
     # Lazy imports so `import accelerate_tpu` stays cheap and avoids cycles.
-    if name == "Accelerator":
-        from .accelerator import Accelerator
+    if name in ("Accelerator", "JaxModel", "PreparedModel"):
+        from . import accelerator
 
-        return Accelerator
+        return getattr(accelerator, name)
     if name in ("prepare_data_loader", "skip_first_batches", "DataLoaderShard", "DataLoaderDispatcher"):
         from . import data_loader
 
@@ -50,10 +50,22 @@ def __getattr__(name):
         from . import launchers
 
         return getattr(launchers, name)
-    if name in ("init_empty_weights", "infer_auto_device_map", "dispatch_model",
-                "load_checkpoint_and_dispatch", "cpu_offload", "disk_offload",
-                "load_checkpoint_in_model"):
+    if name == "LocalSGD":
+        from .local_sgd import LocalSGD
+
+        return LocalSGD
+    if name in ("init_empty_weights", "init_on_device", "infer_auto_device_map", "dispatch_model",
+                "load_checkpoint_and_dispatch", "cpu_offload", "cpu_offload_with_hook",
+                "disk_offload", "load_checkpoint_in_model"):
         from . import big_modeling
 
         return getattr(big_modeling, name)
+    if name == "ring_attention":
+        from .ops import ring_attention
+
+        return ring_attention
+    if name == "get_logger":
+        from .logging import get_logger
+
+        return get_logger
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

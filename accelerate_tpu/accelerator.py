@@ -48,6 +48,7 @@ from .utils.dataclasses import (
     KwargsHandler,
     MixedPrecisionPolicy,
     ParallelismConfig,
+    ProfileKwargs,
     ProjectConfiguration,
     RNGType,
 )
@@ -816,6 +817,34 @@ class Accelerator:
         """bf16 compute is baked into the compiled step (dtype policy), so the
         context is a no-op marker (reference ``accelerator.py autocast``)."""
         yield
+
+    @contextlib.contextmanager
+    def profile(self, profile_handler=None):
+        """Capture a device trace for the enclosed block.
+
+        Parity: reference ``accelerator.py:3705-3762`` (torch.profiler → Chrome
+        trace per rank).  Here: ``jax.profiler`` → perfetto/xplane dump under
+        ``<output_trace_dir>/profile_<rank>`` when a `ProfileKwargs` with
+        ``output_trace_dir`` is given; otherwise the trace is collected and
+        dropped (useful for warm-up parity with the reference's schedule).
+        """
+        import shutil
+        import tempfile
+
+        handler = profile_handler or ProfileKwargs()
+        out_dir = handler.output_trace_dir
+        keep = out_dir is not None
+        if not keep:
+            out_dir = tempfile.mkdtemp(prefix="atpu_profile_")
+        os.makedirs(out_dir, exist_ok=True)
+        trace_dir = os.path.join(out_dir, f"profile_{self.process_index}")
+        jax.profiler.start_trace(trace_dir)
+        try:
+            yield None
+        finally:
+            jax.profiler.stop_trace()
+            if not keep:
+                shutil.rmtree(out_dir, ignore_errors=True)
 
     # -- persistence (full impl in checkpointing.py) --------------------------
 

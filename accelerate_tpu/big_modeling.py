@@ -1,0 +1,266 @@
+"""Big-model inference — L6: models larger than one device's HBM.
+
+Parity target: reference ``src/accelerate/big_modeling.py`` (749 LoC):
+``init_empty_weights``/``init_on_device`` (61-170), ``cpu_offload``/``disk_offload``
+(173-306), ``dispatch_model`` (309-509), ``load_checkpoint_and_dispatch`` (512+).
+
+TPU-native design (SURVEY §2.6 north star): the tier ladder is HBM → host RAM →
+disk.  ``infer_auto_device_map`` plans against the HBM budget;
+``dispatch_model`` attaches `AlignDevicesHook`s that stage host/disk-resident
+blocks just-in-time; execution reaches the TPU through the jit bridge, which
+device_puts the staged block (the reference moved CUDA tensors per block instead,
+``hooks.py:328-371``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Optional, Union
+
+from .hooks import (
+    AlignDevicesHook,
+    CpuOffload,
+    UserCpuOffloadHook,
+    add_hook_to_module,
+    attach_align_device_hook,
+    attach_align_device_hook_on_blocks,
+)
+from .utils.modeling import (
+    check_device_map,
+    compute_module_sizes,
+    get_balanced_memory,
+    get_max_memory,
+    infer_auto_device_map,
+    load_checkpoint_in_model,
+)
+from .utils.offload import OffloadedWeightsLoader, offload_state_dict
+
+__all__ = [
+    "init_empty_weights",
+    "init_on_device",
+    "cpu_offload",
+    "cpu_offload_with_hook",
+    "disk_offload",
+    "dispatch_model",
+    "load_checkpoint_and_dispatch",
+    "infer_auto_device_map",
+    "load_checkpoint_in_model",
+]
+
+
+@contextlib.contextmanager
+def init_empty_weights(include_buffers: bool = False):
+    """Create model parameters on the meta device — O(0) memory skeleton.
+
+    Parity: reference ``big_modeling.py:61-110``.
+    """
+    with init_on_device("meta", include_buffers=include_buffers) as f:
+        yield f
+
+
+@contextlib.contextmanager
+def init_on_device(device, include_buffers: bool = False):
+    """Parity: reference ``big_modeling.py:113-170`` — patch
+    ``nn.Module.register_parameter``/``register_buffer`` during construction."""
+    import torch
+
+    device = torch.device(device)
+    old_register_parameter = torch.nn.Module.register_parameter
+    old_register_buffer = torch.nn.Module.register_buffer
+
+    def register_empty_parameter(module, name, param):
+        old_register_parameter(module, name, param)
+        if param is not None:
+            param_cls = type(module._parameters[name])
+            kwargs = module._parameters[name].__dict__
+            kwargs["requires_grad"] = param.requires_grad
+            module._parameters[name] = param_cls(
+                module._parameters[name].to(device), **{k: v for k, v in kwargs.items() if k == "requires_grad"}
+            )
+
+    def register_empty_buffer(module, name, buffer, persistent=True):
+        old_register_buffer(module, name, buffer, persistent=persistent)
+        if buffer is not None:
+            module._buffers[name] = module._buffers[name].to(device)
+
+    try:
+        torch.nn.Module.register_parameter = register_empty_parameter
+        if include_buffers:
+            torch.nn.Module.register_buffer = register_empty_buffer
+        yield device
+    finally:
+        torch.nn.Module.register_parameter = old_register_parameter
+        if include_buffers:
+            torch.nn.Module.register_buffer = old_register_buffer
+
+
+def cpu_offload(model, execution_device=None, offload_buffers: bool = False, state_dict=None):
+    """Whole-model CPU offload (reference ``big_modeling.py:173``): weights live in
+    a host state dict, staged per-submodule at forward."""
+    if state_dict is None:
+        state_dict = {n: p.detach().cpu() for n, p in model.state_dict().items()}
+    attach_align_device_hook(
+        model,
+        execution_device=execution_device or "cpu",
+        offload=True,
+        weights_map=state_dict,
+        offload_buffers=offload_buffers,
+    )
+    return model
+
+
+def cpu_offload_with_hook(model, execution_device=None, prev_module_hook: Optional[UserCpuOffloadHook] = None):
+    """Reference ``big_modeling.py cpu_offload_with_hook`` — for sequential
+    pipelines that re-use modules."""
+    hook = CpuOffload(execution_device=execution_device, prev_module_hook=prev_module_hook)
+    add_hook_to_module(model, hook, append=True)
+    user_hook = UserCpuOffloadHook(model, hook)
+    return model, user_hook
+
+
+def disk_offload(model, offload_dir: str, execution_device=None, offload_buffers: bool = False):
+    """Whole-model disk offload (reference ``big_modeling.py:239``)."""
+    os.makedirs(offload_dir, exist_ok=True)
+    offload_state_dict(offload_dir, {n: p.detach().cpu().numpy() for n, p in model.state_dict().items()})
+    weights_map = OffloadedWeightsLoader(save_folder=offload_dir)
+    attach_align_device_hook(
+        model,
+        execution_device=execution_device or "cpu",
+        offload=True,
+        weights_map=weights_map,
+        offload_buffers=offload_buffers,
+    )
+    return model
+
+
+def dispatch_model(
+    model,
+    device_map: dict,
+    main_device=None,
+    state_dict=None,
+    offload_dir: Optional[str] = None,
+    offload_index: Optional[dict] = None,
+    offload_buffers: bool = False,
+    skip_keys=None,
+    preload_module_classes=None,
+    force_hooks: bool = False,
+):
+    """Attach tier-staging hooks per device-map block (reference
+    ``big_modeling.py:309-509``).
+
+    Tiers: "tpu" blocks stay host-resident and are device_put by the jit bridge
+    each call (resident in HBM between calls once prepared); "cpu" blocks stage
+    from a host state dict; "disk" blocks stage from the offload folder.
+    """
+    check_device_map(model, device_map)
+
+    disk_modules = [name for name, tier in device_map.items() if tier == "disk"]
+    cpu_modules = [name for name, tier in device_map.items() if tier == "cpu"]
+
+    if disk_modules and offload_dir is None and offload_index is None:
+        raise ValueError(
+            f"Disk-offloaded modules {disk_modules} need an `offload_dir`."
+        )
+
+    weights_map = None
+    if disk_modules or cpu_modules:
+        if state_dict is None:
+            state_dict = {
+                n: p.detach().cpu().numpy() if hasattr(p, "detach") else p
+                for n, p in model.state_dict().items()
+                if not _on_meta(p)
+            }
+        if disk_modules and offload_dir is not None:
+            disk_sd = {
+                n: v
+                for n, v in state_dict.items()
+                if any(n == m or n.startswith(m + ".") for m in disk_modules)
+            }
+            if disk_sd:
+                os.makedirs(offload_dir, exist_ok=True)
+                offload_state_dict(offload_dir, disk_sd)
+        weights_map = OffloadedWeightsLoader(state_dict=state_dict, save_folder=offload_dir)
+
+    execution_device = {
+        name: ("cpu" if tier in ("cpu", "disk") else tier) for name, tier in device_map.items()
+    }
+    offload = {name: tier in ("cpu", "disk") for name, tier in device_map.items()}
+    attach_align_device_hook_on_blocks(
+        model,
+        execution_device=execution_device,
+        offload=offload,
+        weights_map=weights_map,
+        offload_buffers=offload_buffers,
+    )
+    model.hf_device_map = device_map
+    # Poison .to() like the reference (big_modeling.py:489-507).
+    if any(tier in ("cpu", "disk") for tier in device_map.values()):
+        model._original_to = model.to
+
+        def _blocked_to(*args, **kwargs):
+            raise RuntimeError(
+                "You can't move a model that has been dispatched with a device map; "
+                "remove the hooks first (remove_hook_from_submodules)."
+            )
+
+        model.to = _blocked_to
+    return model
+
+
+def _on_meta(t) -> bool:
+    return hasattr(t, "device") and str(getattr(t, "device", "")) == "meta"
+
+
+def load_checkpoint_and_dispatch(
+    model,
+    checkpoint: str,
+    device_map: Optional[Union[str, dict]] = None,
+    max_memory: Optional[dict] = None,
+    no_split_module_classes: Optional[list] = None,
+    offload_folder: Optional[str] = None,
+    offload_buffers: bool = False,
+    dtype=None,
+    offload_state_dict: Optional[bool] = None,
+    skip_keys=None,
+    preload_module_classes=None,
+    force_hooks: bool = False,
+    strict: bool = False,
+):
+    """One-call load + plan + dispatch (reference ``big_modeling.py:512``)."""
+    if isinstance(device_map, str):
+        if device_map not in ("auto", "balanced", "balanced_low_0", "sequential"):
+            raise ValueError(
+                "If passed as a string, device_map must be 'auto', 'balanced', "
+                "'balanced_low_0' or 'sequential'."
+            )
+        if device_map != "sequential":
+            max_memory = get_balanced_memory(
+                model,
+                max_memory=max_memory,
+                no_split_module_classes=no_split_module_classes,
+                dtype=dtype,
+                low_zero=(device_map == "balanced_low_0"),
+            )
+        device_map = infer_auto_device_map(
+            model, max_memory=max_memory, no_split_module_classes=no_split_module_classes, dtype=dtype
+        )
+    load_checkpoint_in_model(
+        model,
+        checkpoint,
+        device_map=device_map,
+        offload_folder=offload_folder,
+        dtype=dtype,
+        strict=strict,
+    )
+    if device_map is None:
+        return model
+    return dispatch_model(
+        model,
+        device_map=device_map,
+        offload_dir=offload_folder,
+        offload_buffers=offload_buffers,
+        skip_keys=skip_keys,
+        preload_module_classes=preload_module_classes,
+        force_hooks=force_hooks,
+    )

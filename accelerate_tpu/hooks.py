@@ -1,0 +1,352 @@
+"""Hook engine: pre/post-forward interception for weight tiering.
+
+Parity target: reference ``src/accelerate/hooks.py`` (765 LoC): ``ModelHook``
+protocol (43-98), ``add_hook_to_module`` (130), ``AlignDevicesHook`` (225-409),
+``attach_align_device_hook[_on_blocks]`` (460/555), CPU-offload hooks (689-738).
+
+TPU-native meaning: "device" for a hooked torch module is the *host staging tier*
+(cpu RAM or disk memmap); the execution device is the TPU reached through the
+jitted bridge.  ``AlignDevicesHook`` stages a block's weights from its tier into
+host arrays before forward and back after — the jax device_put of the staged
+block happens in the lowered apply.  For eager torch execution (no TPU in the
+loop) the hooks behave exactly like the reference's.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Mapping, Optional
+
+import numpy as np
+
+__all__ = [
+    "ModelHook",
+    "SequentialHook",
+    "add_hook_to_module",
+    "remove_hook_from_module",
+    "remove_hook_from_submodules",
+    "AlignDevicesHook",
+    "CpuOffload",
+    "UserCpuOffloadHook",
+    "attach_align_device_hook",
+    "attach_align_device_hook_on_blocks",
+    "named_module_tensors",
+    "set_module_tensor_to_device",
+]
+
+
+class ModelHook:
+    """Reference ``hooks.py:43-98`` protocol."""
+
+    no_grad = False
+
+    def init_hook(self, module):
+        return module
+
+    def pre_forward(self, module, *args, **kwargs):
+        return args, kwargs
+
+    def post_forward(self, module, output):
+        return output
+
+    def detach_hook(self, module):
+        return module
+
+
+class SequentialHook(ModelHook):
+    """Compose several hooks (reference ``hooks.py SequentialHook``)."""
+
+    def __init__(self, *hooks):
+        self.hooks = hooks
+
+    def init_hook(self, module):
+        for hook in self.hooks:
+            module = hook.init_hook(module)
+        return module
+
+    def pre_forward(self, module, *args, **kwargs):
+        for hook in self.hooks:
+            args, kwargs = hook.pre_forward(module, *args, **kwargs)
+        return args, kwargs
+
+    def post_forward(self, module, output):
+        for hook in self.hooks:
+            output = hook.post_forward(module, output)
+        return output
+
+    def detach_hook(self, module):
+        for hook in self.hooks:
+            module = hook.detach_hook(module)
+        return module
+
+
+def add_hook_to_module(module, hook: ModelHook, append: bool = False):
+    """Wrap ``module.forward`` with the hook (reference ``hooks.py:130``)."""
+    if append and getattr(module, "_hf_hook", None) is not None:
+        old_hook = module._hf_hook
+        remove_hook_from_module(module)
+        hook = SequentialHook(old_hook, hook)
+
+    if hasattr(module, "_hf_hook") and hasattr(module, "_old_forward"):
+        old_forward = module._old_forward
+    else:
+        old_forward = module.forward
+        module._old_forward = old_forward
+
+    module = hook.init_hook(module)
+    module._hf_hook = hook
+
+    @functools.wraps(old_forward)
+    def new_forward(*args, **kwargs):
+        args, kwargs = module._hf_hook.pre_forward(module, *args, **kwargs)
+        if module._hf_hook.no_grad:
+            import torch
+
+            with torch.no_grad():
+                output = old_forward(*args, **kwargs)
+        else:
+            output = old_forward(*args, **kwargs)
+        return module._hf_hook.post_forward(module, output)
+
+    module.forward = new_forward
+    return module
+
+
+def remove_hook_from_module(module, recurse: bool = False):
+    if hasattr(module, "_hf_hook"):
+        module._hf_hook.detach_hook(module)
+        delattr(module, "_hf_hook")
+    if hasattr(module, "_old_forward"):
+        module.forward = module._old_forward
+        delattr(module, "_old_forward")
+    if recurse:
+        for child in module.children():
+            remove_hook_from_module(child, recurse=True)
+    return module
+
+
+def remove_hook_from_submodules(module):
+    remove_hook_from_module(module)
+    for child in module.children():
+        remove_hook_from_submodules(child)
+
+
+def named_module_tensors(module, include_buffers: bool = True, recurse: bool = False):
+    for name, param in module.named_parameters(recurse=recurse):
+        yield name, param
+    if include_buffers:
+        for name, buf in module.named_buffers(recurse=recurse):
+            yield name, buf
+
+
+def set_module_tensor_to_device(module, tensor_name: str, device, value=None, dtype=None):
+    """Move/replace one tensor of a torch module (reference
+    ``utils/modeling.py set_module_tensor_to_device``)."""
+    import torch
+
+    if "." in tensor_name:
+        splits = tensor_name.split(".")
+        for split in splits[:-1]:
+            module = getattr(module, split)
+        tensor_name = splits[-1]
+    is_buffer = tensor_name in module._buffers
+    old = module._buffers[tensor_name] if is_buffer else module._parameters[tensor_name]
+    if value is not None:
+        if isinstance(value, np.ndarray) or not isinstance(value, torch.Tensor):
+            value = torch.as_tensor(np.asarray(value))
+        if dtype is not None:
+            value = value.to(dtype)
+        new_tensor = value.to(device)
+    else:
+        new_tensor = old.to(device)
+    if is_buffer:
+        module._buffers[tensor_name] = new_tensor
+    else:
+        module._parameters[tensor_name] = torch.nn.Parameter(new_tensor, requires_grad=False)
+
+
+class AlignDevicesHook(ModelHook):
+    """Stage a module's weights in before forward, release after.
+
+    Parity: reference ``hooks.py:225-409``.  ``execution_device`` here is a host
+    staging device ("cpu") — the TPU transfer happens inside the lowered apply —
+    or a torch device for eager execution.  ``offload=True`` keeps weights in a
+    ``weights_map`` (memmap/safetensors) and materializes them per forward.
+    """
+
+    def __init__(
+        self,
+        execution_device=None,
+        offload: bool = False,
+        io_same_device: bool = False,
+        weights_map: Optional[Mapping] = None,
+        offload_buffers: bool = False,
+        place_submodules: bool = False,
+    ):
+        self.execution_device = execution_device or "cpu"
+        self.offload = offload
+        self.io_same_device = io_same_device
+        self.weights_map = weights_map
+        self.offload_buffers = offload_buffers
+        self.place_submodules = place_submodules
+        self.original_devices = {}
+        self.input_device = None
+
+    def init_hook(self, module):
+        if self.offload:
+            self.original_devices = {
+                name: p.device for name, p in named_module_tensors(module, recurse=self.place_submodules)
+            }
+            for name, _ in named_module_tensors(module, recurse=self.place_submodules):
+                set_module_tensor_to_device(module, name, "meta")
+        elif self.execution_device not in (None, "cpu"):
+            for name, _ in named_module_tensors(module, recurse=self.place_submodules):
+                set_module_tensor_to_device(module, name, self.execution_device)
+        return module
+
+    def pre_forward(self, module, *args, **kwargs):
+        if self.io_same_device and args:
+            import torch
+
+            first = next((a for a in args if isinstance(a, torch.Tensor)), None)
+            self.input_device = first.device if first is not None else None
+        if self.offload:
+            prefix = getattr(module, "_hook_weights_prefix", "")
+            for name, _ in named_module_tensors(module, recurse=self.place_submodules):
+                value = self.weights_map[prefix + name]
+                set_module_tensor_to_device(module, name, self.execution_device, value=value)
+        return args, kwargs
+
+    def post_forward(self, module, output):
+        if self.offload:
+            for name, _ in named_module_tensors(module, recurse=self.place_submodules):
+                set_module_tensor_to_device(module, name, "meta")
+        if self.io_same_device and self.input_device is not None:
+            import torch
+
+            if isinstance(output, torch.Tensor):
+                output = output.to(self.input_device)
+        return output
+
+    def detach_hook(self, module):
+        if self.offload:
+            prefix = getattr(module, "_hook_weights_prefix", "")
+            for name, device in self.original_devices.items():
+                if str(device) != "meta" and self.weights_map is not None:
+                    set_module_tensor_to_device(
+                        module, name, device, value=self.weights_map.get(prefix + name)
+                    )
+        return module
+
+
+def attach_align_device_hook(
+    module,
+    execution_device=None,
+    offload: bool = False,
+    weights_map: Optional[Mapping] = None,
+    offload_buffers: bool = False,
+    module_name: str = "",
+):
+    """Attach AlignDevicesHooks to every leaf module holding weights (reference
+    ``hooks.py:460``)."""
+    directs = list(named_module_tensors(module, recurse=False))
+    if directs:
+        module._hook_weights_prefix = f"{module_name}." if module_name else ""
+        add_hook_to_module(
+            module,
+            AlignDevicesHook(
+                execution_device=execution_device,
+                offload=offload,
+                weights_map=weights_map,
+                offload_buffers=offload_buffers,
+            ),
+            append=True,
+        )
+    for child_name, child in module.named_children():
+        full = f"{module_name}.{child_name}" if module_name else child_name
+        attach_align_device_hook(
+            child,
+            execution_device=execution_device,
+            offload=offload,
+            weights_map=weights_map,
+            offload_buffers=offload_buffers,
+            module_name=full,
+        )
+
+
+def attach_align_device_hook_on_blocks(
+    module,
+    execution_device=None,
+    offload=None,
+    weights_map: Optional[Mapping] = None,
+    offload_buffers: bool = False,
+    module_name: str = "",
+):
+    """Per-block variant driven by a device map (reference ``hooks.py:555``).
+
+    ``execution_device``/``offload`` may be dicts keyed by module path.
+    """
+    if not isinstance(execution_device, Mapping):
+        execution_device = {module_name: execution_device}
+    if not isinstance(offload, Mapping):
+        offload = {module_name: bool(offload)}
+
+    if module_name in execution_device:
+        if offload.get(module_name, False):
+            module._hook_weights_prefix = f"{module_name}." if module_name else ""
+            attach_align_device_hook(
+                module,
+                execution_device=execution_device[module_name],
+                offload=True,
+                weights_map=weights_map,
+                offload_buffers=offload_buffers,
+                module_name=module_name,
+            )
+        else:
+            add_hook_to_module(
+                module, AlignDevicesHook(execution_device[module_name], io_same_device=not module_name)
+            )
+        return
+    for child_name, child in module.named_children():
+        full = f"{module_name}.{child_name}" if module_name else child_name
+        attach_align_device_hook_on_blocks(
+            child,
+            execution_device=execution_device,
+            offload=offload,
+            weights_map=weights_map,
+            offload_buffers=offload_buffers,
+            module_name=full,
+        )
+
+
+class CpuOffload(ModelHook):
+    """Move module to execution device on forward; previous module back to CPU
+    (reference ``hooks.py:689``)."""
+
+    def __init__(self, execution_device=None, prev_module_hook: Optional["UserCpuOffloadHook"] = None):
+        self.execution_device = execution_device or "cpu"
+        self.prev_module_hook = prev_module_hook
+
+    def init_hook(self, module):
+        return module.to("cpu")
+
+    def pre_forward(self, module, *args, **kwargs):
+        if self.prev_module_hook is not None:
+            self.prev_module_hook.offload()
+        module.to(self.execution_device)
+        return args, kwargs
+
+
+class UserCpuOffloadHook:
+    """User handle pairing a model with its CpuOffload hook (reference
+    ``hooks.py:720``)."""
+
+    def __init__(self, model, hook: CpuOffload):
+        self.model = model
+        self.hook = hook
+
+    def offload(self):
+        self.model.to("cpu")
+
+    def remove(self):
+        remove_hook_from_module(self.model)

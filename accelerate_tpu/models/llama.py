@@ -177,15 +177,25 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
     return jax.tree_util.tree_unflatten(treedef, [init_one(s, k) for s, k in zip(leaves, keys)])
 
 
-def _maybe_constrain(x: jax.Array, spec: P) -> jax.Array:
-    """Sharding hint that no-ops when no global mesh is installed (single-device
-    use without an AcceleratorState)."""
+def _abstract_mesh():
     try:
-        m = jax.sharding.get_abstract_mesh()
+        return jax.sharding.get_abstract_mesh()
     except AttributeError:  # older jax
         from jax._src import mesh as _mesh_lib
 
-        m = _mesh_lib.get_abstract_mesh()
+        return _mesh_lib.get_abstract_mesh()
+
+
+def _sp_active() -> bool:
+    """True when the installed global mesh has a >1 sequence-parallel axis."""
+    m = _abstract_mesh()
+    return bool(m is not None and not m.empty and "sp" in m.axis_names and m.shape["sp"] > 1)
+
+
+def _maybe_constrain(x: jax.Array, spec: P) -> jax.Array:
+    """Sharding hint that no-ops when no global mesh is installed (single-device
+    use without an AcceleratorState)."""
+    m = _abstract_mesh()
     if m is None or m.empty or not m.axis_names:
         return x
     if not all(a in m.axis_names for ax in spec if ax is not None for a in (ax if isinstance(ax, tuple) else (ax,))):
@@ -244,7 +254,14 @@ def _layer(carry, layer_params, *, config: LlamaConfig, mask, positions, act_spe
     k = (h @ p["wk"].astype(c.dtype)).reshape(b, s, c.num_kv_heads, hd)
     v = (h @ p["wv"].astype(c.dtype)).reshape(b, s, c.num_kv_heads, hd)
     q, k = _rope(q, k, positions, c.rope_theta)
-    attn = _attention(q, k, v, mask, c.num_heads // c.num_kv_heads)
+    if _sp_active():
+        # Sequence-parallel path: blockwise ring attention over the sp axis
+        # (padding masks unsupported here; pretraining-style dense batches).
+        from ..ops.ring_attention import ring_attention
+
+        attn = ring_attention(q, k, v, mesh=None, axis_name="sp", causal=True)
+    else:
+        attn = _attention(q, k, v, mask, c.num_heads // c.num_kv_heads)
     x = x + attn.reshape(b, s, c.num_heads * hd) @ p["wo"].astype(c.dtype)
 
     h = _rms_norm(x, p["ln_mlp"], c.rms_eps)
@@ -270,6 +287,12 @@ def apply(
     causal = jnp.tril(jnp.ones((s, s), bool))
     mask = jnp.broadcast_to(causal, (b, s, s))
     if attention_mask is not None:
+        if _sp_active():
+            raise NotImplementedError(
+                "attention_mask is not supported on the sequence-parallel (sp>1) path "
+                "yet — ring attention applies causal masking only. Use dense packed "
+                "batches, or an sp=1 mesh for padded batches."
+            )
         mask = mask & attention_mask[:, None, :].astype(bool)
 
     x = params["embed"].astype(c.dtype)[input_ids]

@@ -1,0 +1,1 @@
+from .ring_attention import ring_attention, ring_self_attention

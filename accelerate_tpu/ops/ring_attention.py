@@ -1,0 +1,168 @@
+"""Ring attention — sequence/context parallelism over the ``sp`` mesh axis.
+
+Net-new capability vs the reference (SURVEY §2.4: context parallelism is ABSENT
+upstream; only a Megatron passthrough flag exists).  Design follows the blockwise
+ring-attention pattern (Liu et al.; see PAPERS.md): the sequence dimension is
+sharded across devices; K/V blocks rotate around the ring via ``lax.ppermute``
+(riding ICI neighbor links) while each device keeps a numerically-stable online
+softmax accumulator (flash-attention style m/l/o state).  Compute for block r
+overlaps with the transfer of block r+1 as scheduled by XLA.
+
+Causal masking at block granularity: a device at ring position i only attends to
+K/V chunks j <= i — chunks j > i contribute nothing but still ride the ring so
+every hop is a pure neighbor exchange.
+
+Round-1 implementation is pure-JAX inside ``shard_map`` (XLA already overlaps
+ppermute with the block matmuls); the Pallas fused kernel drops into
+``_block_attention`` later for VMEM-resident streaming.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ring_attention", "ring_self_attention"]
+
+from jax import shard_map as _shard_map
+
+
+def shard_map(f, mesh, in_specs, out_specs):
+    try:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
+    except TypeError:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+def _block_attention(q, k, v, mask, m_prev, l_prev, o_prev, scale):
+    """One K/V block against local Q with online-softmax accumulation.
+
+    q: [B, Sq, H, d]; k,v: [B, Sk, K, d] (GQA: H = K * groups); accumulators
+    m,l: [B, H, Sq], o: [B, Sq, H, d].  All statistics in fp32.
+    """
+    b, sq, h, d = q.shape
+    kheads = k.shape[2]
+    groups = h // kheads
+    qg = q.reshape(b, sq, kheads, groups, d)
+    scores = jnp.einsum("bskgd,btkd->bkgst", qg, k).astype(jnp.float32) * scale
+    scores = scores.reshape(b, h, sq, -1)
+    scores = jnp.where(mask, scores, -jnp.inf)
+
+    m_cur = jnp.max(scores, axis=-1)  # [B, H, Sq]
+    m_new = jnp.maximum(m_prev, m_cur)
+    # Guard fully-masked rows (m_new = -inf) against NaN.
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(scores - m_safe[..., None])
+    p = jnp.where(mask, p, 0.0)
+    alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
+    l_new = alpha * l_prev + p.sum(axis=-1)
+    pk = p.reshape(b, kheads, groups, sq, -1)
+    o_blk = jnp.einsum("bkgst,btkd->bskgd", pk.astype(v.dtype), v).reshape(b, sq, h, d)
+    o_new = o_prev * alpha.transpose(0, 2, 1)[..., None] + o_blk.astype(jnp.float32)
+    return m_new, l_new, o_new
+
+
+def _ring_body(q, k, v, *, axis_name: str, causal: bool, vary_axes: tuple = ()):
+    """Per-device body under shard_map: local q stays, k/v rotate ``n`` times."""
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    scale = 1.0 / np.sqrt(d)
+
+    m0 = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    o0 = jnp.zeros((b, sq, h, d), jnp.float32)
+    # Mark accumulators device-varying over the ring axis so the fori_loop carry
+    # type stays consistent (shard_map VMA rules).
+    axes = tuple(vary_axes) or (axis_name,)
+    m0, l0, o0 = (jax.lax.pvary(x, axes) for x in (m0, l0, o0))
+
+    local_pos = jnp.arange(sq)
+
+    def step(r, carry):
+        k_r, v_r, m, l, o = carry
+        src = (idx - r) % n  # ring position whose K/V we currently hold
+        if causal:
+            # Block-level causality + intra-block triangle when src == idx.
+            q_pos = idx * sq + local_pos  # global positions of local queries
+            k_pos = src * k_r.shape[1] + jnp.arange(k_r.shape[1])
+            mask = (q_pos[:, None] >= k_pos[None, :])[None, None, :, :]
+        else:
+            mask = jnp.ones((1, 1, sq, k_r.shape[1]), bool)
+        m, l, o = _block_attention(q, k_r, v_r, mask, m, l, o, scale)
+        # Rotate upward: device i sends to i+1 and receives i-1's block, so after
+        # r hops we hold chunk (i - r) % n — matching `src` above.
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_next = jax.lax.ppermute(k_r, axis_name, perm)
+        v_next = jax.lax.ppermute(v_r, axis_name, perm)
+        return k_next, v_next, m, l, o
+
+    k_f, v_f, m, l, o = jax.lax.fori_loop(0, n, step, (k, v, m0, l0, o0))
+    l_safe = jnp.maximum(l, 1e-20)
+    out = o / l_safe.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """Sequence-parallel attention: [B, S, H, d] x [B, S, K, d] -> [B, S, H, d]
+    with S sharded over ``axis_name``.
+
+    Falls back to a single dense block when the axis is size 1 / absent.
+    """
+    if mesh is None:
+        from ..state import AcceleratorState
+
+        if AcceleratorState._shared_state:
+            mesh = AcceleratorState().mesh
+    if mesh is None or axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
+        # Dense fallback: one block through the same online-softmax math.
+        b, s, h, d = q.shape
+        if causal:
+            mask = jnp.tril(jnp.ones((s, s), bool))[None, None]
+        else:
+            mask = jnp.ones((1, 1, s, s), bool)
+        m0 = jnp.full((b, h, s), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((b, h, s), jnp.float32)
+        o0 = jnp.zeros((b, s, h, d), jnp.float32)
+        _, l, o = _block_attention(q, k, v, mask, m0, l0, o0, 1.0 / np.sqrt(d))
+        return (o / jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+    # Keep the batch dim sharded over the data axes inside the ring (avoids a
+    # batch all-gather at the shard_map boundary), and the head dim over tp when
+    # divisible — heads are independent in the ring body, so tp devices each run
+    # their own head shard instead of redundantly computing all heads.
+    from ..parallel.mesh import data_axes
+
+    batch_axes = tuple(a for a in data_axes(mesh) if a != axis_name)
+    tp = mesh.shape.get("tp", 1)
+    head_axis = "tp" if (tp > 1 and q.shape[2] % tp == 0 and k.shape[2] % tp == 0) else None
+    vary = batch_axes + (axis_name,) + ((head_axis,) if head_axis else ())
+    spec = P(batch_axes if batch_axes else None, axis_name, head_axis, None)
+    body = functools.partial(
+        _ring_body, axis_name=axis_name, causal=causal, vary_axes=vary
+    )
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )(q, k, v)
+
+
+def ring_self_attention(x_q, x_k, x_v, **kwargs):
+    """Convenience wrapper matching a fused-QKV call pattern."""
+    return ring_attention(x_q, x_k, x_v, **kwargs)

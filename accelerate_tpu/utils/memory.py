@@ -1,0 +1,89 @@
+"""OOM-retry & memory utilities.
+
+Parity target: reference ``src/accelerate/utils/memory.py`` (207 LoC):
+``find_executable_batch_size`` (``memory.py:100-182``), ``release_memory``,
+``clear_device_cache``.
+"""
+
+from __future__ import annotations
+
+import functools
+import gc
+import inspect
+from typing import Callable, Optional
+
+import jax
+
+__all__ = ["find_executable_batch_size", "release_memory", "clear_device_cache", "should_reduce_batch_size"]
+
+
+def clear_device_cache(garbage_collection: bool = False) -> None:
+    """Drop compilation caches + live-array references held by JAX."""
+    if garbage_collection:
+        gc.collect()
+    jax.clear_caches()
+
+
+def release_memory(*objects):
+    """Parity: reference ``release_memory`` — del references and clear caches."""
+    if not isinstance(objects, list):
+        objects = list(objects)
+    for i in range(len(objects)):
+        objects[i] = None
+    clear_device_cache(garbage_collection=True)
+    return objects
+
+
+def should_reduce_batch_size(exception: Exception) -> bool:
+    """Whether ``exception`` smells like an OOM (reference
+    ``memory.py should_reduce_batch_size``; TPU: RESOURCE_EXHAUSTED)."""
+    statements = [
+        "RESOURCE_EXHAUSTED",
+        "Out of memory",
+        "out of memory",
+        "OOM",
+        "Attempting to allocate",
+        "CUDA out of memory",
+    ]
+    text = str(exception)
+    return any(s in text for s in statements)
+
+
+def find_executable_batch_size(
+    function: Optional[Callable] = None, starting_batch_size: int = 128
+):
+    """Decorator: run ``function(batch_size, ...)``, halving ``batch_size`` on OOM
+    until it executes or reaches 0.
+
+    Parity: reference ``memory.py:100-182`` — identical semantics including the
+    first-argument contract and the RuntimeError at batch size 0.
+    """
+    if function is None:
+        return functools.partial(find_executable_batch_size, starting_batch_size=starting_batch_size)
+
+    batch_size = starting_batch_size
+
+    def decorator(*args, **kwargs):
+        nonlocal batch_size
+        clear_device_cache(garbage_collection=True)
+        params = list(inspect.signature(function).parameters.keys())
+        if len(params) < (len(args) + 1):
+            arg_str = ", ".join([f"{arg}={value}" for arg, value in zip(params[1:], args[1:])])
+            raise TypeError(
+                f"Batch size was passed into `{function.__name__}` as the first argument "
+                f"when called. Remove this as the decorator already does so: "
+                f"`{function.__name__}({arg_str})`"
+            )
+        while True:
+            if batch_size == 0:
+                raise RuntimeError("No executable batch size found, reached zero.")
+            try:
+                return function(batch_size, *args, **kwargs)
+            except Exception as e:
+                if should_reduce_batch_size(e):
+                    clear_device_cache(garbage_collection=True)
+                    batch_size //= 2
+                else:
+                    raise
+
+    return decorator

@@ -1,0 +1,323 @@
+"""Model-surgery utilities: sizes, memory budgets, device-map planning, checkpoint
+streaming.
+
+Parity target: reference ``src/accelerate/utils/modeling.py`` (2177 LoC) — the
+pieces behind big-model inference: ``compute_module_sizes`` (655),
+``get_balanced_memory`` (922), ``infer_auto_device_map`` (1281-1588),
+``load_checkpoint_in_model`` (1783-2043).
+
+TPU-native reading of "device": the fast tier is the TPU's HBM (queried from the
+runtime), then host RAM, then disk — ``infer_auto_device_map`` is an HBM-budget
+planner (SURVEY §2.6 north star).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from collections import OrderedDict, defaultdict
+from typing import Optional, Union
+
+import numpy as np
+
+__all__ = [
+    "dtype_byte_size",
+    "compute_module_sizes",
+    "named_module_tensors",
+    "get_max_memory",
+    "get_balanced_memory",
+    "infer_auto_device_map",
+    "load_checkpoint_in_model",
+    "find_tied_parameters",
+    "check_device_map",
+]
+
+
+def dtype_byte_size(dtype) -> float:
+    s = str(dtype).replace("torch.", "")
+    if s == "bool":
+        return 1 / 8
+    m = re.search(r"[^\d](\d+)(_\w+)?$", s)
+    if m is None:
+        raise ValueError(f"`dtype` is not a valid dtype: {dtype}.")
+    return int(m.group(1)) / 8
+
+
+def named_module_tensors(module, include_buffers: bool = True, recurse: bool = True):
+    for name, p in module.named_parameters(recurse=recurse):
+        yield name, p
+    if include_buffers:
+        for name, b in module.named_buffers(recurse=recurse):
+            yield name, b
+
+
+def compute_module_sizes(model, dtype=None, special_dtypes=None) -> dict[str, int]:
+    """Byte size of each submodule (reference ``utils/modeling.py:655``); the ""
+    key is the whole model."""
+    module_sizes: dict[str, int] = defaultdict(int)
+    for name, tensor in named_module_tensors(model, recurse=True):
+        size = int(np.prod(tuple(tensor.shape))) or 1
+        if special_dtypes is not None and name in special_dtypes:
+            nbytes = size * dtype_byte_size(special_dtypes[name])
+        elif dtype is not None and tensor.is_floating_point():
+            nbytes = size * dtype_byte_size(dtype)
+        else:
+            nbytes = size * dtype_byte_size(tensor.dtype)
+        module_sizes[""] += int(nbytes)
+        parts = name.split(".")
+        for i in range(1, len(parts)):
+            module_sizes[".".join(parts[:i])] += int(nbytes)
+    return dict(module_sizes)
+
+
+def _tpu_hbm_bytes() -> int:
+    import jax
+
+    try:
+        dev = jax.local_devices()[0]
+        stats = dev.memory_stats()
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    return 16 * 1024**3  # v5e default
+
+
+def _host_ram_bytes() -> int:
+    try:
+        return os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+    except (ValueError, OSError):
+        return 32 * 1024**3
+
+
+def get_max_memory(max_memory: Optional[dict] = None) -> dict:
+    """Default memory budget: {"tpu": 0.9*HBM, "cpu": 0.9*RAM, "disk": inf}
+    (reference ``get_max_memory`` enumerated CUDA devices)."""
+    if max_memory is not None:
+        return {k: _to_bytes(v) for k, v in max_memory.items()}
+    return {
+        "tpu": int(0.9 * _tpu_hbm_bytes()),
+        "cpu": int(0.9 * _host_ram_bytes()),
+        "disk": float("inf"),
+    }
+
+
+def _to_bytes(v) -> Union[int, float]:
+    if isinstance(v, (int, float)):
+        return v
+    v = str(v).upper().strip()
+    units = {"KB": 1024, "MB": 1024**2, "GB": 1024**3, "TB": 1024**4, "KIB": 1000, "MIB": 1000**2, "GIB": 1000**3}
+    for unit, mult in units.items():
+        if v.endswith(unit):
+            return int(float(v[: -len(unit)]) * mult)
+    return int(v)
+
+
+def get_balanced_memory(
+    model, max_memory: Optional[dict] = None, no_split_module_classes=None, dtype=None, low_zero: bool = False
+) -> dict:
+    """Balance the model across accelerator tiers (reference
+    ``utils/modeling.py:922``).  With one TPU tier this just scales the budget to
+    the model size when the model fits."""
+    max_memory = get_max_memory(max_memory)
+    sizes = compute_module_sizes(model, dtype=dtype)
+    total = sizes[""]
+    accel_keys = [k for k in max_memory if k not in ("cpu", "disk")]
+    if len(accel_keys) <= 1:
+        return max_memory
+    per_device = total // len(accel_keys) + total % len(accel_keys)
+    out = dict(max_memory)
+    for i, k in enumerate(accel_keys):
+        budget = per_device if not (low_zero and i == 0) else per_device // 2
+        out[k] = min(max_memory[k], int(budget * 1.3))
+    return out
+
+
+def find_tied_parameters(model) -> list[list[str]]:
+    """Groups of parameter names sharing storage (reference
+    ``find_tied_parameters``)."""
+    seen: dict[int, list[str]] = defaultdict(list)
+    for name, param in model.named_parameters(remove_duplicate=False):
+        seen[id(param)].append(name)
+    return [names for names in seen.values() if len(names) > 1]
+
+
+def infer_auto_device_map(
+    model,
+    max_memory: Optional[dict] = None,
+    no_split_module_classes: Optional[list[str]] = None,
+    dtype=None,
+    special_dtypes: Optional[dict] = None,
+    verbose: bool = False,
+    offload_buffers: bool = False,
+    clean_result: bool = True,
+) -> "OrderedDict[str, str]":
+    """Greedy block→tier allocator over the memory budget.
+
+    Parity: reference ``utils/modeling.py:1281-1588``.  Tiers are tried in order
+    (tpu → cpu → disk); a module too big for the current tier is recursed into
+    unless its class is in ``no_split_module_classes``.
+    """
+    max_memory = get_max_memory(max_memory)
+    no_split = set(no_split_module_classes or [])
+    sizes = compute_module_sizes(model, dtype=dtype, special_dtypes=special_dtypes)
+    tiers = list(max_memory.keys())
+    remaining = {k: float(v) for k, v in max_memory.items()}
+    device_map: "OrderedDict[str, str]" = OrderedDict()
+    tier_idx = 0
+
+    tied_groups = find_tied_parameters(model)
+
+    def assign(name: str, module) -> None:
+        nonlocal tier_idx
+        size = sizes.get(name, 0)
+        while tier_idx < len(tiers):
+            tier = tiers[tier_idx]
+            if size <= remaining[tier]:
+                device_map[name] = tier
+                remaining[tier] -= size
+                return
+            # Too big for what's left on this tier: split if allowed...
+            children = list(module.named_children()) if module is not None else []
+            if children and type(module).__name__ not in no_split:
+                for child_name, child in children:
+                    assign(f"{name}.{child_name}" if name else child_name, child)
+                # Direct parameters of this module (not in any child).
+                direct = [n for n, _ in module.named_parameters(recurse=False)]
+                if direct:
+                    direct_size = sum(
+                        int(np.prod(tuple(p.shape)) * dtype_byte_size(p.dtype))
+                        for _, p in module.named_parameters(recurse=False)
+                    )
+                    tier2 = tiers[tier_idx]
+                    device_map[name + "._parameters" if name else "_parameters"] = tier2
+                    remaining[tier2] -= direct_size
+                return
+            # ...else move to the next tier.
+            tier_idx += 1
+        raise ValueError(f"Model does not fit in the provided max_memory (stuck at {name!r}).")
+
+    for child_name, child in model.named_children():
+        assign(child_name, child)
+    if not device_map:  # model with only direct parameters
+        assign("", model)
+
+    # Tied parameters must share a tier with their group leader.
+    for group in tied_groups:
+        owners = [device_map.get(_module_of(n)) for n in group if _module_of(n) in device_map]
+        if owners:
+            for n in group:
+                mod = _module_of(n)
+                if mod in device_map:
+                    device_map[mod] = owners[0]
+    return device_map
+
+
+def _module_of(param_name: str) -> str:
+    return param_name.rsplit(".", 1)[0] if "." in param_name else ""
+
+
+def check_device_map(model, device_map: dict) -> None:
+    """Every tensor must be covered (reference ``check_device_map``)."""
+    covered = set(device_map.keys())
+    for name, _ in model.named_parameters():
+        if not any(name == k or name.startswith(k + ".") or k == "" for k in covered):
+            raise ValueError(f"device_map does not cover parameter {name}")
+
+
+def load_checkpoint_in_model(
+    model,
+    checkpoint: str,
+    device_map: Optional[dict] = None,
+    offload_folder: Optional[str] = None,
+    dtype=None,
+    offload_state_dict: bool = False,
+    offload_buffers: bool = False,
+    strict: bool = False,
+) -> None:
+    """Stream checkpoint shards into the model per device-map target.
+
+    Parity: reference ``utils/modeling.py:1783-2043`` — supports a single
+    ``.safetensors``/``.bin`` file, a sharded index json, or a folder; "disk"
+    targets go to ``offload_folder`` memmaps.
+    """
+    from ..hooks import set_module_tensor_to_device
+    from .offload import offload_weight, save_offload_index
+
+    files = _checkpoint_files(checkpoint)
+    offload_index: dict = {}
+    if offload_folder is not None:
+        os.makedirs(offload_folder, exist_ok=True)
+
+    for file in files:
+        state_dict = _load_state_dict(file)
+        for name, value in state_dict.items():
+            target = _target_for(name, device_map)
+            if dtype is not None and hasattr(value, "astype"):
+                import torch
+
+                if isinstance(value, np.ndarray) and np.issubdtype(value.dtype, np.floating):
+                    value = value.astype(_np_dtype(dtype))
+            if target == "disk":
+                if offload_folder is None:
+                    raise ValueError("offload_folder required when device_map has 'disk' entries")
+                offload_index = offload_weight(value, name, offload_folder, index=offload_index)
+            else:
+                try:
+                    set_module_tensor_to_device(model, name, "cpu", value=value)
+                except (AttributeError, KeyError) as e:
+                    if strict:
+                        raise
+    if offload_folder is not None and offload_index:
+        save_offload_index(offload_index, offload_folder)
+
+
+def _np_dtype(dtype):
+    import torch
+
+    mapping = {torch.float32: np.float32, torch.float16: np.float16}
+    return mapping.get(dtype, np.float32)
+
+
+def _checkpoint_files(checkpoint: str) -> list[str]:
+    if os.path.isfile(checkpoint):
+        if checkpoint.endswith(".json"):
+            with open(checkpoint) as f:
+                index = json.load(f)
+            folder = os.path.dirname(checkpoint)
+            return sorted({os.path.join(folder, v) for v in index["weight_map"].values()})
+        return [checkpoint]
+    if os.path.isdir(checkpoint):
+        index_files = [f for f in os.listdir(checkpoint) if f.endswith(".index.json")]
+        if index_files:
+            return _checkpoint_files(os.path.join(checkpoint, index_files[0]))
+        return [
+            os.path.join(checkpoint, f)
+            for f in sorted(os.listdir(checkpoint))
+            if f.endswith((".safetensors", ".bin"))
+        ]
+    raise FileNotFoundError(f"Checkpoint {checkpoint} not found")
+
+
+def _load_state_dict(file: str) -> dict:
+    if file.endswith(".safetensors"):
+        from safetensors.numpy import load_file
+
+        return load_file(file)
+    import torch
+
+    sd = torch.load(file, map_location="cpu", weights_only=True)
+    return sd
+
+
+def _target_for(name: str, device_map: Optional[dict]) -> str:
+    if device_map is None:
+        return "cpu"
+    if name in device_map:
+        return device_map[name]
+    candidates = [k for k in device_map if name.startswith(k + ".") or k == ""]
+    if candidates:
+        return device_map[max(candidates, key=len)]
+    module = _module_of(name)
+    return _target_for(module, device_map) if module != name else "cpu"

@@ -1,0 +1,123 @@
+"""Offloaded-weight storage: per-tensor memmaps + index.json.
+
+Parity target: reference ``src/accelerate/utils/offload.py`` (213 LoC):
+``offload_weight``/``load_offloaded_weight`` (25-66), ``OffloadedWeightsLoader``
+(127-191) — same on-disk format (one ``.dat`` memmap per tensor plus an
+``index.json`` with dtype/shape) so folders are interchangeable with the
+reference's.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections.abc import Mapping
+from typing import Optional
+
+import numpy as np
+
+__all__ = [
+    "offload_weight",
+    "load_offloaded_weight",
+    "save_offload_index",
+    "load_offload_index",
+    "OffloadedWeightsLoader",
+    "offload_state_dict",
+]
+
+
+def offload_weight(weight, weight_name: str, offload_folder: str, index: Optional[dict] = None) -> dict:
+    """Write one tensor to ``<folder>/<name>.dat`` and record it in ``index``."""
+    arr = np.asarray(weight)
+    dtype = str(arr.dtype)
+    if index is None:
+        index = {}
+    # bfloat16 is not a numpy-native dtype; store as uint16 bit pattern.
+    stored = arr
+    if dtype == "bfloat16":
+        stored = arr.view(np.uint16) if arr.dtype.itemsize == 2 else arr.astype(np.float32)
+        dtype = "bfloat16"
+        save_dtype = "uint16"
+    else:
+        save_dtype = dtype
+    path = os.path.join(offload_folder, f"{weight_name}.dat")
+    mm = np.memmap(path, dtype=save_dtype, mode="w+", shape=stored.shape or (1,))
+    mm[:] = stored.reshape(stored.shape or (1,))[:]
+    mm.flush()
+    index[weight_name] = {"dtype": dtype, "shape": list(arr.shape)}
+    return index
+
+
+def load_offloaded_weight(weight_file: str, weight_info: dict) -> np.ndarray:
+    shape = tuple(weight_info["shape"]) or (1,)
+    dtype = weight_info["dtype"]
+    save_dtype = "uint16" if dtype == "bfloat16" else dtype
+    mm = np.memmap(weight_file, dtype=save_dtype, mode="r", shape=shape)
+    if not weight_info["shape"]:
+        mm = mm[0]
+    if dtype == "bfloat16":
+        import jax.numpy as jnp
+
+        return np.asarray(mm).view(jnp.bfloat16.dtype)
+    return mm
+
+
+def save_offload_index(index: dict, offload_folder: str) -> None:
+    with open(os.path.join(offload_folder, "index.json"), "w") as f:
+        json.dump(index, f, indent=2)
+
+
+def load_offload_index(offload_folder: str) -> dict:
+    path = os.path.join(offload_folder, "index.json")
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        return json.load(f)
+
+
+def offload_state_dict(save_dir: str, state_dict: Mapping) -> None:
+    """Offload a whole state dict (reference ``offload_state_dict``)."""
+    os.makedirs(save_dir, exist_ok=True)
+    index = load_offload_index(save_dir)
+    for name, weight in state_dict.items():
+        index = offload_weight(weight, name, save_dir, index=index)
+    save_offload_index(index, save_dir)
+
+
+class OffloadedWeightsLoader(Mapping):
+    """Lazy Mapping over weights living in {in-memory state dict} ∪ {offload
+    folder} ∪ {safetensors files} (reference ``offload.py:127-191``)."""
+
+    def __init__(
+        self,
+        state_dict: Optional[dict] = None,
+        save_folder: Optional[str] = None,
+        index: Optional[dict] = None,
+    ):
+        if state_dict is None and save_folder is None and index is None:
+            raise ValueError("Need either a state_dict or a save_folder")
+        self.state_dict = state_dict or {}
+        self.save_folder = save_folder
+        if index is None and save_folder is not None:
+            index = load_offload_index(save_folder)
+        self.index = index or {}
+        self.all_keys = list(self.state_dict.keys())
+        self.all_keys.extend(k for k in self.index if k not in self.all_keys)
+
+    def __getitem__(self, key: str):
+        if key in self.state_dict:
+            return self.state_dict[key]
+        weight_info = self.index[key]
+        if weight_info.get("safetensors_file") is not None:
+            from safetensors import safe_open
+
+            with safe_open(weight_info["safetensors_file"], framework="np") as f:
+                return f.get_tensor(weight_info.get("weight_name", key))
+        weight_file = os.path.join(self.save_folder, f"{key}.dat")
+        return load_offloaded_weight(weight_file, weight_info)
+
+    def __iter__(self):
+        return iter(self.all_keys)
+
+    def __len__(self):
+        return len(self.all_keys)

@@ -1,0 +1,168 @@
+"""Big-model inference subsystem tests (parity: reference tests/test_big_modeling.py
++ test_modeling_utils.py core cases)."""
+
+import os
+
+import numpy as np
+import pytest
+import torch
+import torch.nn as nn
+
+from accelerate_tpu.big_modeling import (
+    cpu_offload,
+    disk_offload,
+    dispatch_model,
+    init_empty_weights,
+    load_checkpoint_and_dispatch,
+)
+from accelerate_tpu.hooks import remove_hook_from_submodules
+from accelerate_tpu.utils.modeling import (
+    compute_module_sizes,
+    infer_auto_device_map,
+    load_checkpoint_in_model,
+)
+from accelerate_tpu.utils.offload import (
+    OffloadedWeightsLoader,
+    load_offloaded_weight,
+    offload_weight,
+)
+
+
+class ModelForTest(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.linear1 = nn.Linear(3, 4)
+        self.batchnorm = nn.BatchNorm1d(4)
+        self.linear2 = nn.Linear(4, 5)
+
+    def forward(self, x):
+        return self.linear2(self.batchnorm(self.linear1(x)))
+
+
+def test_init_empty_weights():
+    with init_empty_weights():
+        m = ModelForTest()
+    assert m.linear1.weight.device.type == "meta"
+    # Buffers stay real by default (reference behavior).
+    assert m.batchnorm.running_mean.device.type == "cpu"
+
+
+def test_compute_module_sizes():
+    m = ModelForTest()
+    sizes = compute_module_sizes(m)
+    assert sizes["linear1"] == (3 * 4 + 4) * 4
+    assert sizes[""] >= sizes["linear1"] + sizes["linear2"]
+
+
+def test_infer_auto_device_map_fits_tpu():
+    m = ModelForTest()
+    dm = infer_auto_device_map(m, max_memory={"tpu": 10_000, "cpu": 10_000, "disk": float("inf")})
+    assert all(v == "tpu" for v in dm.values())
+
+
+def test_infer_auto_device_map_spills():
+    m = ModelForTest()
+    sizes = compute_module_sizes(m)
+    budget = sizes["linear1"] + sizes["batchnorm"] + 1
+    dm = infer_auto_device_map(m, max_memory={"tpu": budget, "cpu": 10_000_000})
+    assert dm["linear1"] == "tpu"
+    assert dm["linear2"] == "cpu"
+
+
+def test_offload_weight_roundtrip(tmp_path):
+    w = np.random.randn(4, 5).astype(np.float32)
+    index = offload_weight(w, "w", str(tmp_path))
+    loaded = load_offloaded_weight(str(tmp_path / "w.dat"), index["w"])
+    np.testing.assert_array_equal(np.asarray(loaded), w)
+
+
+def test_offloaded_weights_loader(tmp_path):
+    w = np.random.randn(2, 2).astype(np.float32)
+    from accelerate_tpu.utils.offload import offload_state_dict
+
+    offload_state_dict(str(tmp_path), {"a": w})
+    loader = OffloadedWeightsLoader(state_dict={"b": np.ones(3)}, save_folder=str(tmp_path))
+    assert set(loader.keys()) == {"a", "b"}
+    np.testing.assert_array_equal(np.asarray(loader["a"]), w)
+
+
+def test_cpu_offload_forward_matches():
+    torch.manual_seed(0)
+    m = ModelForTest().eval()
+    x = torch.randn(4, 3)
+    with torch.no_grad():
+        expected = m(x)
+    cpu_offload(m)
+    with torch.no_grad():
+        out = m(x)
+    torch.testing.assert_close(out, expected)
+    # Weights parked on meta between forwards.
+    assert m.linear1.weight.device.type == "meta"
+
+
+def test_disk_offload_forward_matches(tmp_path):
+    torch.manual_seed(0)
+    m = ModelForTest().eval()
+    x = torch.randn(4, 3)
+    with torch.no_grad():
+        expected = m(x)
+    disk_offload(m, str(tmp_path))
+    with torch.no_grad():
+        out = m(x)
+    torch.testing.assert_close(out, expected)
+
+
+def test_dispatch_model_mixed_tiers(tmp_path):
+    torch.manual_seed(0)
+    m = ModelForTest().eval()
+    x = torch.randn(4, 3)
+    with torch.no_grad():
+        expected = m(x)
+    dm = {"linear1": "cpu", "batchnorm": "cpu", "linear2": "disk"}
+    dispatch_model(m, dm, offload_dir=str(tmp_path))
+    with torch.no_grad():
+        out = m(x)
+    torch.testing.assert_close(out, expected)
+    with pytest.raises(RuntimeError, match="device map"):
+        m.to("cpu")
+    remove_hook_from_submodules(m)
+
+
+def test_load_checkpoint_in_model(tmp_path):
+    torch.manual_seed(0)
+    src = ModelForTest()
+    from safetensors.numpy import save_file
+
+    sd = {k: v.detach().numpy() for k, v in src.state_dict().items()}
+    sd = {k: np.ascontiguousarray(v) for k, v in sd.items()}
+    save_file(sd, str(tmp_path / "model.safetensors"))
+
+    with init_empty_weights():
+        dst = ModelForTest()
+    load_checkpoint_in_model(dst, str(tmp_path / "model.safetensors"))
+    torch.testing.assert_close(dst.linear1.weight, src.linear1.weight)
+
+
+def test_load_checkpoint_and_dispatch(tmp_path):
+    torch.manual_seed(0)
+    src = ModelForTest().eval()
+    x = torch.randn(4, 3)
+    with torch.no_grad():
+        expected = src(x)
+    from safetensors.numpy import save_file
+
+    save_file(
+        {k: np.ascontiguousarray(v.detach().numpy()) for k, v in src.state_dict().items()},
+        str(tmp_path / "model.safetensors"),
+    )
+    with init_empty_weights():
+        dst = ModelForTest()
+    dst = load_checkpoint_and_dispatch(
+        dst,
+        str(tmp_path / "model.safetensors"),
+        device_map={"linear1": "cpu", "batchnorm": "cpu", "linear2": "cpu"},
+    )
+    dst.eval()
+    with torch.no_grad():
+        out = dst(x)
+    torch.testing.assert_close(out, expected)
