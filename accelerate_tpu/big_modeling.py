@@ -36,6 +36,25 @@ from .utils.modeling import (
 )
 from .utils.offload import OffloadedWeightsLoader, offload_state_dict
 
+
+def _tensor_to_numpy(t):
+    """torch tensor -> numpy, handling bfloat16 (no native numpy dtype) via the
+    ml_dtypes bit-pattern view."""
+    import numpy as np
+
+    try:
+        import torch
+    except ImportError:
+        return np.asarray(t)
+    if isinstance(t, torch.Tensor):
+        t = t.detach().cpu()
+        if t.dtype == torch.bfloat16:
+            import ml_dtypes
+
+            return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+        return t.numpy()
+    return np.asarray(t)
+
 __all__ = [
     "init_empty_weights",
     "init_on_device",
@@ -122,7 +141,7 @@ def cpu_offload_with_hook(model, execution_device=None, prev_module_hook: Option
 def disk_offload(model, offload_dir: str, execution_device=None, offload_buffers: bool = False):
     """Whole-model disk offload (reference ``big_modeling.py:239``)."""
     os.makedirs(offload_dir, exist_ok=True)
-    offload_state_dict(offload_dir, {n: p.detach().cpu().numpy() for n, p in model.state_dict().items()})
+    offload_state_dict(offload_dir, {n: _tensor_to_numpy(p) for n, p in model.state_dict().items()})
     weights_map = OffloadedWeightsLoader(save_folder=offload_dir)
     attach_align_device_hook(
         model,
@@ -167,7 +186,7 @@ def dispatch_model(
     if disk_modules or cpu_modules:
         if state_dict is None:
             state_dict = {
-                n: p.detach().cpu().numpy() if hasattr(p, "detach") else p
+                n: _tensor_to_numpy(p)
                 for n, p in model.state_dict().items()
                 if not _on_meta(p)
             }
@@ -182,9 +201,10 @@ def dispatch_model(
                 offload_state_dict(offload_dir, disk_sd)
         weights_map = OffloadedWeightsLoader(state_dict=state_dict, save_folder=offload_dir)
 
-    execution_device = {
-        name: ("cpu" if tier in ("cpu", "disk") else tier) for name, tier in device_map.items()
-    }
+    # Every tier stages on host ("cpu"): "tpu" blocks are host-resident too — the
+    # HBM transfer happens in the jit bridge, not via torch .to() (there is no
+    # torch "tpu" device).
+    execution_device = {name: "cpu" for name in device_map}
     offload = {name: tier in ("cpu", "disk") for name, tier in device_map.items()}
     attach_align_device_hook_on_blocks(
         model,

@@ -1,0 +1,35 @@
+"""Console entry point (parity: reference ``commands/accelerate_cli.py``)."""
+
+from __future__ import annotations
+
+import argparse
+
+from . import config as config_cmd
+from . import env as env_cmd
+from . import estimate as estimate_cmd
+from . import launch as launch_cmd
+from . import merge as merge_cmd
+from . import test as test_cmd
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        "accelerate-tpu", usage="accelerate-tpu <command> [<args>]", allow_abbrev=False
+    )
+    subparsers = parser.add_subparsers(help="accelerate-tpu command helpers", dest="command")
+    config_cmd.register_subcommand(subparsers)
+    env_cmd.register_subcommand(subparsers)
+    launch_cmd.register_subcommand(subparsers)
+    estimate_cmd.register_subcommand(subparsers)
+    merge_cmd.register_subcommand(subparsers)
+    test_cmd.register_subcommand(subparsers)
+
+    args = parser.parse_args()
+    if not hasattr(args, "func"):
+        parser.print_help()
+        raise SystemExit(1)
+    args.func(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,102 @@
+"""``accelerate-tpu config`` — write/load the default YAML config.
+
+Parity target: reference ``commands/config/`` (~1800 LoC questionnaire + YAML).
+Round 1 ships the YAML schema + non-interactive ``default`` + a compact
+questionnaire; the config file feeds ``launch`` exactly like the reference's.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from dataclasses import asdict, dataclass, field
+from typing import Optional
+
+import yaml
+
+DEFAULT_CONFIG_DIR = os.path.expanduser(
+    os.environ.get("ACCELERATE_CONFIG_DIR", "~/.cache/accelerate_tpu")
+)
+DEFAULT_CONFIG_FILE = os.path.join(DEFAULT_CONFIG_DIR, "default_config.yaml")
+
+__all__ = ["ClusterConfig", "load_config", "save_config", "config_command", "default_config_command"]
+
+
+@dataclass
+class ClusterConfig:
+    compute_environment: str = "LOCAL_MACHINE"
+    distributed_type: str = "TPU_JAX"
+    mixed_precision: str = "no"
+    num_machines: int = 1
+    machine_rank: int = 0
+    main_process_ip: Optional[str] = None
+    main_process_port: Optional[int] = None
+    gradient_accumulation_steps: int = 1
+    # Mesh axes (ParallelismConfig)
+    dp: int = 0  # 0 = auto (all remaining devices)
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    pp: int = 1
+    ep: int = 1
+    use_fsdp: bool = False
+    fsdp_sharding_strategy: str = "FULL_SHARD"
+    fsdp_min_num_params: int = 0
+    downcast_bf16: bool = False
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+def save_config(config: ClusterConfig, path: str = DEFAULT_CONFIG_FILE) -> str:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        yaml.safe_dump(config.to_dict(), f)
+    return path
+
+
+def load_config(path: Optional[str] = None) -> ClusterConfig:
+    path = path or DEFAULT_CONFIG_FILE
+    if not os.path.exists(path):
+        return ClusterConfig()
+    with open(path) as f:
+        data = yaml.safe_load(f) or {}
+    known = {k: v for k, v in data.items() if k in ClusterConfig.__dataclass_fields__}
+    return ClusterConfig(**known)
+
+
+def _ask(prompt: str, default, cast=str):
+    raw = input(f"{prompt} [{default}]: ").strip()
+    return cast(raw) if raw else default
+
+
+def config_command(args):
+    if getattr(args, "default", False):
+        return default_config_command(args)
+    cfg = ClusterConfig()
+    cfg.num_machines = _ask("How many machines (hosts)?", 1, int)
+    if cfg.num_machines > 1:
+        cfg.machine_rank = _ask("Rank of this machine?", 0, int)
+        cfg.main_process_ip = _ask("Main process IP?", "127.0.0.1")
+        cfg.main_process_port = _ask("Main process port?", 29500, int)
+    cfg.mixed_precision = _ask("Mixed precision (no/bf16/fp16/fp8)?", "bf16")
+    cfg.use_fsdp = _ask("Use FSDP parameter sharding (yes/no)?", "no") in ("yes", "y", "true", "1")
+    if cfg.use_fsdp:
+        cfg.fsdp = _ask("FSDP axis size (0=all devices)?", 0, int) or 0
+        cfg.fsdp_sharding_strategy = _ask("Sharding strategy?", "FULL_SHARD")
+    cfg.tp = _ask("Tensor-parallel size?", 1, int)
+    cfg.sp = _ask("Sequence-parallel size?", 1, int)
+    path = save_config(cfg, getattr(args, "config_file", None) or DEFAULT_CONFIG_FILE)
+    print(f"Configuration saved to {path}")
+
+
+def default_config_command(args):
+    path = save_config(ClusterConfig(), getattr(args, "config_file", None) or DEFAULT_CONFIG_FILE)
+    print(f"Default configuration saved to {path}")
+
+
+def register_subcommand(subparsers):
+    parser = subparsers.add_parser("config", help="Create the launch configuration")
+    parser.add_argument("--config_file", default=None)
+    parser.add_argument("--default", action="store_true", help="Write defaults without prompting")
+    parser.set_defaults(func=config_command)

@@ -1,0 +1,52 @@
+"""``accelerate-tpu env`` — platform report for bug reports (parity: reference
+``commands/env.py``, 119 LoC)."""
+
+from __future__ import annotations
+
+import platform
+
+from .config import DEFAULT_CONFIG_FILE, load_config
+
+
+def env_command(args):
+    import jax
+
+    import accelerate_tpu
+
+    info = {
+        "accelerate_tpu version": accelerate_tpu.__version__,
+        "Platform": platform.platform(),
+        "Python version": platform.python_version(),
+        "JAX version": jax.__version__,
+        "JAX backend": jax.default_backend(),
+        "Device count": jax.device_count(),
+        "Devices": ", ".join(str(d) for d in jax.devices()[:8]),
+        "Process count": jax.process_count(),
+    }
+    try:
+        import flax, optax
+
+        info["Flax version"] = flax.__version__
+        info["Optax version"] = optax.__version__
+    except ImportError:
+        pass
+    try:
+        import torch
+
+        info["PyTorch version (ingestion)"] = torch.__version__
+    except ImportError:
+        pass
+    info["Default config"] = DEFAULT_CONFIG_FILE
+    cfg = load_config(getattr(args, "config_file", None))
+    print("\nCopy-and-paste the text below in your GitHub issue\n")
+    for k, v in info.items():
+        print(f"- {k}: {v}")
+    print("- Config:")
+    for k, v in cfg.to_dict().items():
+        print(f"\t- {k}: {v}")
+
+
+def register_subcommand(subparsers):
+    parser = subparsers.add_parser("env", help="Print environment information")
+    parser.add_argument("--config_file", default=None)
+    parser.set_defaults(func=env_command)

@@ -1,0 +1,58 @@
+"""``accelerate-tpu estimate-memory`` — per-dtype model memory table.
+
+Parity target: reference ``commands/estimate.py`` (312 LoC): load the model
+skeleton on the meta device, print total / largest-layer sizes per dtype
+(training estimate = 4x inference: params + grads + 2 optimizer moments).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def _format_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024:
+            return f"{n:.2f} {unit}"
+        n /= 1024
+    return f"{n:.2f} PB"
+
+
+def estimate_command(args):
+    from ..big_modeling import init_empty_weights
+    from ..utils.modeling import compute_module_sizes
+
+    try:
+        from transformers import AutoConfig, AutoModel
+
+        config = AutoConfig.from_pretrained(args.model_name, trust_remote_code=args.trust_remote_code)
+        with init_empty_weights():
+            model = AutoModel.from_config(config, trust_remote_code=args.trust_remote_code)
+    except Exception as e:
+        raise SystemExit(f"Could not build model skeleton for {args.model_name}: {e}")
+
+    dtypes = args.dtypes or ["float32", "bfloat16", "int8", "int4"]
+    bytes_per = {"float32": 4, "float16": 2, "bfloat16": 2, "int8": 1, "int4": 0.5}
+    sizes = compute_module_sizes(model)
+    total_f32 = sizes[""]
+    largest_f32 = max((v for k, v in sizes.items() if k.count(".") == 0 and k), default=total_f32)
+
+    print(f"Memory estimate for {args.model_name}:")
+    header = f"{'dtype':>10} | {'largest layer':>14} | {'total size':>12} | {'training (adam)':>16}"
+    print(header)
+    print("-" * len(header))
+    for dt in dtypes:
+        factor = bytes_per.get(dt, 4) / 4
+        total = total_f32 * factor
+        print(
+            f"{dt:>10} | {_format_bytes(largest_f32 * factor):>14} | "
+            f"{_format_bytes(total):>12} | {_format_bytes(total * 4):>16}"
+        )
+
+
+def register_subcommand(subparsers):
+    parser = subparsers.add_parser("estimate-memory", help="Estimate model memory usage")
+    parser.add_argument("model_name", type=str)
+    parser.add_argument("--dtypes", nargs="+", default=None)
+    parser.add_argument("--trust_remote_code", action="store_true")
+    parser.set_defaults(func=estimate_command)

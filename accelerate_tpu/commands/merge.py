@@ -1,0 +1,58 @@
+"""``accelerate-tpu merge-weights`` — consolidate a sharded checkpoint directory.
+
+Parity target: reference ``commands/merge.py`` (71 LoC) over
+``merge_fsdp_weights`` (``utils/fsdp_utils.py:354``): distributed checkpoint →
+one consolidated safetensors file.  Our sharded layout is one
+``model_shard_{rank}.safetensors`` per process (written under
+state_dict_type=SHARDED_STATE_DICT); merging concatenates by the recorded specs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+
+def merge_command(args):
+    from safetensors.numpy import load_file, save_file
+
+    in_dir = args.checkpoint_dir
+    out_dir = args.output_path
+    os.makedirs(out_dir, exist_ok=True)
+    shard_files = sorted(
+        f for f in os.listdir(in_dir) if f.startswith("model_shard_") and f.endswith(".safetensors")
+    )
+    if not shard_files:
+        # Already consolidated: copy through.
+        src = os.path.join(in_dir, "model.safetensors")
+        if not os.path.exists(src):
+            raise SystemExit(f"No shards or consolidated weights found in {in_dir}")
+        save_file(load_file(src), os.path.join(out_dir, "model.safetensors"))
+        print(f"Copied consolidated weights to {out_dir}")
+        return
+
+    meta_path = os.path.join(in_dir, "shard_index.json")
+    shard_meta = {}
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            shard_meta = json.load(f)
+
+    merged: dict[str, np.ndarray] = {}
+    shards = [load_file(os.path.join(in_dir, f)) for f in shard_files]
+    for key in shards[0]:
+        axis = shard_meta.get(key, {}).get("concat_axis")
+        if axis is None:
+            merged[key] = shards[0][key]
+        else:
+            merged[key] = np.concatenate([s[key] for s in shards], axis=axis)
+    save_file(merged, os.path.join(out_dir, "model.safetensors"))
+    print(f"Merged {len(shard_files)} shards -> {out_dir}/model.safetensors")
+
+
+def register_subcommand(subparsers):
+    parser = subparsers.add_parser("merge-weights", help="Merge sharded checkpoints")
+    parser.add_argument("checkpoint_dir", type=str)
+    parser.add_argument("output_path", type=str)
+    parser.set_defaults(func=merge_command)

@@ -1,0 +1,36 @@
+"""``accelerate-tpu test`` — run the bundled smoke-test payload through the
+launcher (parity: reference ``commands/test.py``, 66 LoC)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def test_command(args):
+    import accelerate_tpu.test_utils.scripts.test_script as payload
+
+    script = payload.__file__
+    cmd = [sys.executable, script]
+    env = dict(os.environ)
+    # Make the package importable in the child even when running from a source
+    # checkout (not pip-installed).
+    import accelerate_tpu
+
+    pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(accelerate_tpu.__file__)))
+    env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
+    if getattr(args, "config_file", None):
+        env["ACCELERATE_TEST_CONFIG_FILE"] = args.config_file
+    print("Running:  python " + script)
+    result = subprocess.run(cmd, env=env)
+    if result.returncode == 0:
+        print("Test is a success! You are ready for your distributed training!")
+    else:
+        raise SystemExit(result.returncode)
+
+
+def register_subcommand(subparsers):
+    parser = subparsers.add_parser("test", help="Run the bundled sanity test")
+    parser.add_argument("--config_file", default=None)
+    parser.set_defaults(func=test_command)

@@ -153,7 +153,11 @@ def set_module_tensor_to_device(module, tensor_name: str, device, value=None, dt
     old = module._buffers[tensor_name] if is_buffer else module._parameters[tensor_name]
     if value is not None:
         if isinstance(value, np.ndarray) or not isinstance(value, torch.Tensor):
-            value = torch.as_tensor(np.asarray(value))
+            arr = np.asarray(value)
+            if arr.dtype.name == "bfloat16":  # ml_dtypes bfloat16 -> torch view
+                value = torch.from_numpy(arr.view(np.uint16).copy()).view(torch.bfloat16)
+            else:
+                value = torch.as_tensor(arr)
         if dtype is not None:
             value = value.to(dtype)
         new_tensor = value.to(device)
@@ -162,7 +166,10 @@ def set_module_tensor_to_device(module, tensor_name: str, device, value=None, dt
     if is_buffer:
         module._buffers[tensor_name] = new_tensor
     else:
-        module._parameters[tensor_name] = torch.nn.Parameter(new_tensor, requires_grad=False)
+        requires_grad = (
+            bool(old.requires_grad) if old is not None else False
+        ) and new_tensor.is_floating_point()
+        module._parameters[tensor_name] = torch.nn.Parameter(new_tensor, requires_grad=requires_grad)
 
 
 class AlignDevicesHook(ModelHook):
@@ -194,10 +201,17 @@ class AlignDevicesHook(ModelHook):
 
     def init_hook(self, module):
         if self.offload:
+            # Buffers stay resident unless offload_buffers=True (reference
+            # hooks.py AlignDevicesHook semantics).
             self.original_devices = {
-                name: p.device for name, p in named_module_tensors(module, recurse=self.place_submodules)
+                name: p.device
+                for name, p in named_module_tensors(
+                    module, include_buffers=self.offload_buffers, recurse=self.place_submodules
+                )
             }
-            for name, _ in named_module_tensors(module, recurse=self.place_submodules):
+            for name, _ in named_module_tensors(
+                module, include_buffers=self.offload_buffers, recurse=self.place_submodules
+            ):
                 set_module_tensor_to_device(module, name, "meta")
         elif self.execution_device not in (None, "cpu"):
             for name, _ in named_module_tensors(module, recurse=self.place_submodules):
@@ -212,14 +226,18 @@ class AlignDevicesHook(ModelHook):
             self.input_device = first.device if first is not None else None
         if self.offload:
             prefix = getattr(module, "_hook_weights_prefix", "")
-            for name, _ in named_module_tensors(module, recurse=self.place_submodules):
+            for name, _ in named_module_tensors(
+                module, include_buffers=self.offload_buffers, recurse=self.place_submodules
+            ):
                 value = self.weights_map[prefix + name]
                 set_module_tensor_to_device(module, name, self.execution_device, value=value)
         return args, kwargs
 
     def post_forward(self, module, output):
         if self.offload:
-            for name, _ in named_module_tensors(module, recurse=self.place_submodules):
+            for name, _ in named_module_tensors(
+                module, include_buffers=self.offload_buffers, recurse=self.place_submodules
+            ):
                 set_module_tensor_to_device(module, name, "meta")
         if self.io_same_device and self.input_device is not None:
             import torch

@@ -284,16 +284,22 @@ def apply(
     b, s = input_ids.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s), (b, s))
-    causal = jnp.tril(jnp.ones((s, s), bool))
-    mask = jnp.broadcast_to(causal, (b, s, s))
-    if attention_mask is not None:
-        if _sp_active():
+    if _sp_active():
+        # Ring attention builds block-local causal masks internally; materializing
+        # a (b, s, s) mask here would be O(s^2) memory — exactly what the ring
+        # path exists to avoid at long context.
+        if attention_mask is not None:
             raise NotImplementedError(
                 "attention_mask is not supported on the sequence-parallel (sp>1) path "
                 "yet — ring attention applies causal masking only. Use dense packed "
                 "batches, or an sp=1 mesh for padded batches."
             )
-        mask = mask & attention_mask[:, None, :].astype(bool)
+        mask = None
+    else:
+        causal = jnp.tril(jnp.ones((s, s), bool))
+        mask = jnp.broadcast_to(causal, (b, s, s))
+        if attention_mask is not None:
+            mask = mask & attention_mask[:, None, :].astype(bool)
 
     x = params["embed"].astype(c.dtype)[input_ids]
     act_spec = P(("dcn_dp", "dp", "fsdp"), "sp", None)

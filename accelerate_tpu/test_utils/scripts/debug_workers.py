@@ -1,0 +1,41 @@
+"""Importable worker payloads for debug_launcher tests (spawn requires module-level
+functions)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def check_cluster_formed(expected: int):
+    import jax
+
+    from accelerate_tpu.state import PartialState
+
+    state = PartialState()
+    assert state.num_processes == expected, (state.num_processes, expected)
+    # A real cross-process collective.
+    from accelerate_tpu.utils import gather
+
+    out = gather(np.array([float(state.process_index)]))
+    assert sorted(np.asarray(out).tolist()) == [float(i) for i in range(expected)], out
+    state.wait_for_everyone()
+
+
+def check_object_collectives(expected: int):
+    from accelerate_tpu.state import PartialState
+    from accelerate_tpu.utils import broadcast_object_list, gather_object
+
+    state = PartialState()
+    objs = gather_object([{"rank": state.process_index}])
+    assert len(objs) == expected
+    payload = [f"hello-{state.process_index}"]
+    broadcast_object_list(payload, from_process=0)
+    assert payload[0] == "hello-0"
+
+
+def check_split_between_processes(expected: int):
+    from accelerate_tpu.state import PartialState
+
+    state = PartialState()
+    with state.split_between_processes(list(range(7)), apply_padding=True) as chunk:
+        assert len(chunk) == 4 if expected == 2 else True

@@ -107,7 +107,11 @@ def _to_bytes(v) -> Union[int, float]:
     if isinstance(v, (int, float)):
         return v
     v = str(v).upper().strip()
-    units = {"KB": 1024, "MB": 1024**2, "GB": 1024**3, "TB": 1024**4, "KIB": 1000, "MIB": 1000**2, "GIB": 1000**3}
+    # HF convention: GiB/MiB/KiB binary (2^30/2^20/2^10), GB/MB/KB decimal.
+    units = {
+        "KIB": 1024, "MIB": 1024**2, "GIB": 1024**3, "TIB": 1024**4,
+        "KB": 1000, "MB": 1000**2, "GB": 1000**3, "TB": 1000**4,
+    }
     for unit, mult in units.items():
         if v.endswith(unit):
             return int(float(v[: -len(unit)]) * mult)
@@ -181,27 +185,32 @@ def infer_auto_device_map(
             # Too big for what's left on this tier: split if allowed...
             children = list(module.named_children()) if module is not None else []
             if children and type(module).__name__ not in no_split:
+                # Direct parameters of this module (not in any child) get their
+                # own full-path entries so check_device_map finds them.
+                for pname, p in module.named_parameters(recurse=False):
+                    full = f"{name}.{pname}" if name else pname
+                    psize = int(np.prod(tuple(p.shape)) * dtype_byte_size(p.dtype))
+                    tier2 = tiers[tier_idx]
+                    device_map[full] = tier2
+                    remaining[tier2] -= psize
                 for child_name, child in children:
                     assign(f"{name}.{child_name}" if name else child_name, child)
-                # Direct parameters of this module (not in any child).
-                direct = [n for n, _ in module.named_parameters(recurse=False)]
-                if direct:
-                    direct_size = sum(
-                        int(np.prod(tuple(p.shape)) * dtype_byte_size(p.dtype))
-                        for _, p in module.named_parameters(recurse=False)
-                    )
-                    tier2 = tiers[tier_idx]
-                    device_map[name + "._parameters" if name else "_parameters"] = tier2
-                    remaining[tier2] -= direct_size
                 return
             # ...else move to the next tier.
             tier_idx += 1
         raise ValueError(f"Model does not fit in the provided max_memory (stuck at {name!r}).")
 
+    # Root-level direct parameters first (execution-order locality).
+    for pname, p in model.named_parameters(recurse=False):
+        psize = int(np.prod(tuple(p.shape)) * dtype_byte_size(p.dtype))
+        while tier_idx < len(tiers) and psize > remaining[tiers[tier_idx]]:
+            tier_idx += 1
+        if tier_idx >= len(tiers):
+            raise ValueError(f"Model does not fit in the provided max_memory (param {pname!r}).")
+        device_map[pname] = tiers[tier_idx]
+        remaining[tiers[tier_idx]] -= psize
     for child_name, child in model.named_children():
         assign(child_name, child)
-    if not device_map:  # model with only direct parameters
-        assign("", model)
 
     # Tied parameters must share a tier with their group leader.
     for group in tied_groups:

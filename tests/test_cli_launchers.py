@@ -1,0 +1,112 @@
+"""CLI + launcher tests (parity: reference tests/test_cli.py + launcher suites)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+from accelerate_tpu.commands.config import ClusterConfig, load_config, save_config
+from accelerate_tpu.commands.launch import build_env, launch_command_parser
+
+
+def test_config_roundtrip(tmp_path):
+    cfg = ClusterConfig(mixed_precision="bf16", tp=2, use_fsdp=True)
+    path = save_config(cfg, str(tmp_path / "cfg.yaml"))
+    loaded = load_config(path)
+    assert loaded.mixed_precision == "bf16"
+    assert loaded.tp == 2
+    assert loaded.use_fsdp
+
+
+def test_launch_parser_and_env():
+    parser = launch_command_parser()
+    args = parser.parse_args(
+        ["--mixed_precision", "bf16", "--tp_size", "2", "--use_fsdp", "--num_machines", "2",
+         "--machine_rank", "1", "--main_process_ip", "10.0.0.1", "train.py", "--epochs", "3"]
+    )
+    assert args.training_script == "train.py"
+    assert args.training_script_args == ["--epochs", "3"]
+    from accelerate_tpu.commands.launch import _merge
+
+    merged = _merge(args, ClusterConfig())
+    env = build_env(merged)
+    assert env["ACCELERATE_MIXED_PRECISION"] == "bf16"
+    assert env["ACCELERATE_PARALLELISM_TP"] == "2"
+    assert env["ACCELERATE_USE_FSDP"] == "1"
+    assert env["ACCELERATE_COORDINATOR_ADDRESS"] == "10.0.0.1:29500"
+    assert env["ACCELERATE_PROCESS_ID"] == "1"
+
+
+def test_cli_help_and_env_command():
+    res = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli", "env"],
+        capture_output=True,
+        text=True,
+        cwd="/root/repo",
+    )
+    assert res.returncode == 0, res.stderr
+    assert "JAX version" in res.stdout
+    assert "accelerate_tpu version" in res.stdout
+
+
+def test_merge_weights_roundtrip(tmp_path):
+    import numpy as np
+    from safetensors.numpy import load_file, save_file
+
+    shard0 = {"w": np.arange(4, dtype=np.float32).reshape(2, 2)}
+    shard1 = {"w": (np.arange(4, dtype=np.float32) + 4).reshape(2, 2)}
+    save_file(shard0, str(tmp_path / "model_shard_0.safetensors"))
+    save_file(shard1, str(tmp_path / "model_shard_1.safetensors"))
+    import json
+
+    (tmp_path / "shard_index.json").write_text(json.dumps({"w": {"concat_axis": 0}}))
+    out = tmp_path / "merged"
+    res = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli", "merge-weights",
+         str(tmp_path), str(out)],
+        capture_output=True,
+        text=True,
+        cwd="/root/repo",
+    )
+    assert res.returncode == 0, res.stderr
+    merged = load_file(str(out / "model.safetensors"))
+    assert merged["w"].shape == (4, 2)
+
+
+@pytest.mark.slow
+def test_debug_launcher_forms_real_cluster():
+    """Two OS processes join a jax.distributed cluster and run collectives."""
+    code = (
+        "from accelerate_tpu.launchers import debug_launcher;"
+        "from accelerate_tpu.test_utils.scripts.debug_workers import check_cluster_formed;"
+        "debug_launcher(check_cluster_formed, args=(2,), num_processes=2);"
+        "print('CLUSTER_OK')"
+    )
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, timeout=180, cwd="/root/repo", env=env
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "CLUSTER_OK" in res.stdout
+
+
+@pytest.mark.slow
+def test_debug_launcher_object_collectives():
+    code = (
+        "from accelerate_tpu.launchers import debug_launcher;"
+        "from accelerate_tpu.test_utils.scripts.debug_workers import check_object_collectives;"
+        "debug_launcher(check_object_collectives, args=(2,), num_processes=2);"
+        "print('OBJECTS_OK')"
+    )
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, timeout=180, cwd="/root/repo", env=env
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "OBJECTS_OK" in res.stdout
