@@ -64,6 +64,10 @@ def __getattr__(name):
         from .ops import ring_attention
 
         return ring_attention
+    if name == "prepare_pippy":
+        from .inference import prepare_pippy
+
+        return prepare_pippy
     if name == "get_logger":
         from .logging import get_logger
 

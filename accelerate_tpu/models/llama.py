@@ -27,7 +27,16 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["LlamaConfig", "init_params", "apply", "loss_fn", "PARTITION_RULES", "param_specs"]
+__all__ = [
+    "LlamaConfig",
+    "init_params",
+    "apply",
+    "loss_fn",
+    "labels_and_weights",
+    "cross_entropy",
+    "PARTITION_RULES",
+    "param_specs",
+]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -177,30 +186,13 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
     return jax.tree_util.tree_unflatten(treedef, [init_one(s, k) for s, k in zip(leaves, keys)])
 
 
-def _abstract_mesh():
-    try:
-        return jax.sharding.get_abstract_mesh()
-    except AttributeError:  # older jax
-        from jax._src import mesh as _mesh_lib
-
-        return _mesh_lib.get_abstract_mesh()
+from ..parallel.sharding import _abstract_mesh, constrain as _maybe_constrain  # noqa: E402
 
 
 def _sp_active() -> bool:
     """True when the installed global mesh has a >1 sequence-parallel axis."""
     m = _abstract_mesh()
     return bool(m is not None and not m.empty and "sp" in m.axis_names and m.shape["sp"] > 1)
-
-
-def _maybe_constrain(x: jax.Array, spec: P) -> jax.Array:
-    """Sharding hint that no-ops when no global mesh is installed (single-device
-    use without an AcceleratorState)."""
-    m = _abstract_mesh()
-    if m is None or m.empty or not m.axis_names:
-        return x
-    if not all(a in m.axis_names for ax in spec if ax is not None for a in (ax if isinstance(ax, tuple) else (ax,))):
-        return x
-    return jax.lax.with_sharding_constraint(x, spec)
 
 
 def _rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
@@ -268,7 +260,8 @@ def _layer(carry, layer_params, *, config: LlamaConfig, mask, positions, act_spe
     gate = jax.nn.silu(h @ p["w_gate"].astype(c.dtype))
     up = h @ p["w_up"].astype(c.dtype)
     x = x + (gate * up) @ p["w_down"].astype(c.dtype)
-    x = _maybe_constrain(x, act_spec)
+    if act_spec is not None:
+        x = _maybe_constrain(x, act_spec)
     return x, None
 
 
@@ -318,12 +311,8 @@ def apply(
     return logits
 
 
-def loss_fn(
-    params: dict,
-    batch: dict,
-    config: LlamaConfig,
-) -> jax.Array:
-    """Next-token cross-entropy, fp32, mean over non-padded targets.
+def labels_and_weights(batch: dict) -> tuple[jax.Array, jax.Array]:
+    """Next-token labels + fp32 loss weights from a batch dict.
 
     ``batch``: {"input_ids": [B, S]} (+ optional "labels", "attention_mask").
     """
@@ -339,8 +328,22 @@ def loss_fn(
         labels = jnp.maximum(labels, 0)
     if "attention_mask" in batch and batch["attention_mask"] is not None:
         weights = weights * batch["attention_mask"].astype(jnp.float32)
+    return labels, weights
 
-    logits = apply(params, input_ids, config, attention_mask=batch.get("attention_mask"))
+
+def cross_entropy(logits: jax.Array, labels: jax.Array, weights: jax.Array) -> jax.Array:
+    """Weighted-mean token cross-entropy in fp32."""
     logp = jax.nn.log_softmax(logits, axis=-1)
     token_loss = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
     return jnp.sum(token_loss * weights) / jnp.maximum(jnp.sum(weights), 1.0)
+
+
+def loss_fn(
+    params: dict,
+    batch: dict,
+    config: LlamaConfig,
+) -> jax.Array:
+    """Next-token cross-entropy, fp32, mean over non-padded targets."""
+    labels, weights = labels_and_weights(batch)
+    logits = apply(params, batch["input_ids"], config, attention_mask=batch.get("attention_mask"))
+    return cross_entropy(logits, labels, weights)

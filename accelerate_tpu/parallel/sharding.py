@@ -43,7 +43,41 @@ __all__ = [
     "replicated",
     "data_sharding",
     "batch_spec",
+    "constrain",
 ]
+
+
+def _abstract_mesh():
+    try:
+        return jax.sharding.get_abstract_mesh()
+    except AttributeError:  # older jax
+        from jax._src import mesh as _mesh_lib
+
+        return _mesh_lib.get_abstract_mesh()
+
+
+def constrain(x: jax.Array, spec: P) -> jax.Array:
+    """``with_sharding_constraint`` that no-ops when no global mesh is installed
+    (single-device use without an AcceleratorState).  Axes the mesh doesn't have
+    are pruned per-dimension rather than dropping the whole constraint, so a
+    user-installed mesh with a subset of our named axes still gets the valid
+    placement hints."""
+    m = _abstract_mesh()
+    if m is None or m.empty or not m.axis_names:
+        return x
+
+    def prune(dim):
+        if dim is None:
+            return None
+        if isinstance(dim, tuple):
+            kept = tuple(a for a in dim if a in m.axis_names)
+            return kept if kept else None
+        return dim if dim in m.axis_names else None
+
+    pruned = P(*(prune(dim) for dim in spec))
+    if all(dim is None for dim in pruned):
+        return x
+    return jax.lax.with_sharding_constraint(x, pruned)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
