@@ -1,1 +1,1 @@
-from . import llama
+from . import llama, mixtral

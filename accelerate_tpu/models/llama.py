@@ -294,7 +294,7 @@ def apply(
         if attention_mask is not None:
             mask = mask & attention_mask[:, None, :].astype(bool)
 
-    x = params["embed"].astype(c.dtype)[input_ids]
+    x = embed_tokens(params, input_ids, c)
     act_spec = P(("dcn_dp", "dp", "fsdp"), "sp", None)
     x = _maybe_constrain(x, act_spec)
 
@@ -304,11 +304,21 @@ def apply(
     if c.remat:
         body = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)
     x, _ = jax.lax.scan(body, x, params["layers"])
+    return unembed(params, x, c)
 
-    x = _rms_norm(x, params["final_norm"], c.rms_eps)
-    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
-    logits = (x @ head.astype(c.dtype)).astype(jnp.float32)
-    return logits
+
+def embed_tokens(params: dict, input_ids: jax.Array, config: LlamaConfig) -> jax.Array:
+    """Token embedding lookup in compute dtype — shared by the dense and
+    pipeline-parallel paths."""
+    return params["embed"].astype(config.dtype)[input_ids]
+
+
+def unembed(params: dict, x: jax.Array, config: LlamaConfig) -> jax.Array:
+    """Final norm + LM head -> fp32 logits — shared by the dense and
+    pipeline-parallel paths."""
+    x = _rms_norm(x, params["final_norm"], config.rms_eps)
+    head = params["embed"].T if config.tie_embeddings else params["lm_head"]
+    return (x @ head.astype(config.dtype)).astype(jnp.float32)
 
 
 def labels_and_weights(batch: dict) -> tuple[jax.Array, jax.Array]:

@@ -1,1 +1,2 @@
+from .moe import dispatch_combine, expert_capacity, moe_ffn, router
 from .ring_attention import ring_attention, ring_self_attention

@@ -134,14 +134,16 @@ def pipeline_llama_apply(
         )
     from ..models import llama
 
+    from .mesh import DATA_AXES
+
     c = config
     b, s = input_ids.shape
     mb = b // num_micro_batches
     mask = jnp.broadcast_to(jnp.tril(jnp.ones((s, s), bool)), (mb, s, s))
     positions = jnp.broadcast_to(jnp.arange(s), (mb, s))
-    data_spec = ("dcn_dp", "dp", "fsdp")
+    data_spec = DATA_AXES
 
-    x = params["embed"].astype(c.dtype)[input_ids]
+    x = llama.embed_tokens(params, input_ids, c)
     x = constrain(x, P(data_spec, None, None))
 
     stage_layers = stack_pipeline_stages(params["layers"], num_stages)
@@ -165,10 +167,7 @@ def pipeline_llama_apply(
         state_spec=(data_spec, None, None),
     )
 
-    x = llama._rms_norm(x, params["final_norm"], c.rms_eps)
-    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
-    logits = (x @ head.astype(c.dtype)).astype(jnp.float32)
-    return logits
+    return llama.unembed(params, x, c)
 
 
 def pipeline_llama_loss_fn(
