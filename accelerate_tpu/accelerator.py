@@ -164,6 +164,7 @@ class PreparedModel:
         self._mode: Optional[str] = None  # "fused" | "bridge", decided on first call
         policy = accelerator.state.dtype_policy
         self._compute_dtype = jnp.dtype(policy.compute_dtype) if policy.compute_dtype else None
+        self._fp8_recipe = policy.fp8_recipe if policy.fp8 else None
         self._jit_fused = None
         self._jit_fwd = None
         self._jit_vjp = None
@@ -196,7 +197,14 @@ class PreparedModel:
         )
 
     def _forward(self, params, args, kwargs):
-        out = self._apply_fn(self._cast(params), self.buffers, *args, **kwargs)
+        if self._fp8_recipe is not None:
+            # Read at trace time: the compiled step bakes in fp8 matmuls.
+            from .ops.fp8 import fp8_autowrap
+
+            with fp8_autowrap(self._fp8_recipe):
+                out = self._apply_fn(self._cast(params), self.buffers, *args, **kwargs)
+        else:
+            out = self._apply_fn(self._cast(params), self.buffers, *args, **kwargs)
         return convert_to_fp32(out) if self._compute_dtype not in (None, jnp.float32) else out
 
     def _build_jits(self):

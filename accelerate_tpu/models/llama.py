@@ -55,6 +55,11 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16  # compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = True
+    # fp8 matmuls (ops/fp8.py scaled_matmul): projection/MLP weights quantized
+    # per-tensor to e4m3 with fp32 accumulation; embed/unembed stay in `dtype`
+    # (the reference's fp8 bridges likewise skip first/last layers,
+    # utils/ao.py:104).
+    fp8: bool = False
 
     @property
     def head_dim_(self) -> int:
@@ -234,17 +239,29 @@ def _attention(q, k, v, mask, num_groups: int):
     return out.reshape(b, s, h, hd)
 
 
-def _layer(carry, layer_params, *, config: LlamaConfig, mask, positions, act_spec):
-    x = carry
-    c = config
-    hd = c.head_dim_
-    p = layer_params
+def _mm(h: jax.Array, w: jax.Array, c: LlamaConfig) -> jax.Array:
+    """Projection matmul honoring the precision mode: ``config.fp8`` or an
+    active ``fp8_autowrap`` context (mixed_precision="fp8") routes through the
+    scaled float8 matmul."""
+    from ..ops import fp8 as _fp8
 
+    recipe = _fp8.active_recipe()
+    if c.fp8 or recipe is not None:
+        fwd, grad = _fp8.recipe_dtypes(recipe)
+        return _fp8.scaled_matmul(h, w, dtype=fwd, grad_dtype=grad, out_dtype=c.dtype)
+    return h @ w.astype(c.dtype)
+
+
+def attention_block(x, p, c, mask, positions) -> jax.Array:
+    """Pre-norm attention sub-block with residual: shared by llama and the MoE
+    models (mixtral) — both get the ring-attention (sp) and fp8 paths from one
+    implementation."""
+    hd = c.head_dim_
     h = _rms_norm(x, p["ln_attn"], c.rms_eps)
     b, s, _ = h.shape
-    q = (h @ p["wq"].astype(c.dtype)).reshape(b, s, c.num_heads, hd)
-    k = (h @ p["wk"].astype(c.dtype)).reshape(b, s, c.num_kv_heads, hd)
-    v = (h @ p["wv"].astype(c.dtype)).reshape(b, s, c.num_kv_heads, hd)
+    q = _mm(h, p["wq"], c).reshape(b, s, c.num_heads, hd)
+    k = _mm(h, p["wk"], c).reshape(b, s, c.num_kv_heads, hd)
+    v = _mm(h, p["wv"], c).reshape(b, s, c.num_kv_heads, hd)
     q, k = _rope(q, k, positions, c.rope_theta)
     if _sp_active():
         # Sequence-parallel path: blockwise ring attention over the sp axis
@@ -254,12 +271,18 @@ def _layer(carry, layer_params, *, config: LlamaConfig, mask, positions, act_spe
         attn = ring_attention(q, k, v, mesh=None, axis_name="sp", causal=True)
     else:
         attn = _attention(q, k, v, mask, c.num_heads // c.num_kv_heads)
-    x = x + attn.reshape(b, s, c.num_heads * hd) @ p["wo"].astype(c.dtype)
+    return x + _mm(attn.reshape(b, s, c.num_heads * hd), p["wo"], c)
+
+
+def _layer(carry, layer_params, *, config: LlamaConfig, mask, positions, act_spec):
+    c = config
+    p = layer_params
+    x = attention_block(carry, p, c, mask, positions)
 
     h = _rms_norm(x, p["ln_mlp"], c.rms_eps)
-    gate = jax.nn.silu(h @ p["w_gate"].astype(c.dtype))
-    up = h @ p["w_up"].astype(c.dtype)
-    x = x + (gate * up) @ p["w_down"].astype(c.dtype)
+    gate = jax.nn.silu(_mm(h, p["w_gate"], c))
+    up = _mm(h, p["w_up"], c)
+    x = x + _mm(gate * up, p["w_down"], c)
     if act_spec is not None:
         x = _maybe_constrain(x, act_spec)
     return x, None

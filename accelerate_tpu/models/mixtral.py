@@ -55,6 +55,7 @@ class MixtralConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = True
+    fp8: bool = False  # route attention matmuls through ops/fp8.py (expert FFN stays bf16)
 
     @property
     def head_dim_(self) -> int:
@@ -183,17 +184,8 @@ def init_params(config: MixtralConfig, key: jax.Array) -> dict:
 def _layer(carry, layer_params, *, config: MixtralConfig, mask, positions, act_spec, capacity):
     x, aux_acc = carry
     c = config
-    hd = c.head_dim_
     p = layer_params
-
-    h = _llama._rms_norm(x, p["ln_attn"], c.rms_eps)
-    b, s, _ = h.shape
-    q = (h @ p["wq"].astype(c.dtype)).reshape(b, s, c.num_heads, hd)
-    k = (h @ p["wk"].astype(c.dtype)).reshape(b, s, c.num_kv_heads, hd)
-    v = (h @ p["wv"].astype(c.dtype)).reshape(b, s, c.num_kv_heads, hd)
-    q, k = _llama._rope(q, k, positions, c.rope_theta)
-    attn = _llama._attention(q, k, v, mask, c.num_heads // c.num_kv_heads)
-    x = x + attn.reshape(b, s, c.num_heads * hd) @ p["wo"].astype(c.dtype)
+    x = _llama.attention_block(x, p, c, mask, positions)
 
     h = _llama._rms_norm(x, p["ln_mlp"], c.rms_eps)
     y, aux = moe_ffn(

@@ -1,2 +1,3 @@
+from .fp8 import dequantize, quantize, scaled_matmul
 from .moe import dispatch_combine, expert_capacity, moe_ffn, router
 from .ring_attention import ring_attention, ring_self_attention

@@ -1,0 +1,240 @@
+"""FP8 training path — scaled float8 matmuls on the MXU.
+
+Parity target: the reference's three fp8 engine bridges (SURVEY §2.7 —
+TransformerEngine ``utils/transformer_engine.py:26-160``, torchao ``utils/ao.py``,
+MS-AMP ``accelerator.py:2244-2291``), which swap Linear layers for fp8 modules
+under a recipe (``TERecipeKwargs`` ``utils/dataclasses.py:316``).  TPU-native
+equivalent: XLA's float8 dtypes feed the MXU directly — a "Linear swap" is just
+routing the model's matmuls through :func:`scaled_matmul`.
+
+Two scaling strategies, both recipe-selectable (``FP8RecipeKwargs``):
+
+- **current** (default): per-tensor dynamic scaling computed from the live amax
+  of each operand — stateless, a perfect fit for a functional jit step (this is
+  torchao-float8's "dynamic" mode).
+- **delayed**: TransformerEngine-style amax history + delayed scale, carried as
+  an explicit :func:`init_delayed_state` pytree threaded through the step
+  (functional translation of TE's module-resident amax buffers).
+
+Format convention (TE "HYBRID"): e4m3 for activations/weights (forward), e5m2
+reserved for gradients (wider range).  All scales are fp32 scalars; the matmul
+accumulates in fp32 via ``preferred_element_type``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "E4M3_MAX",
+    "E5M2_MAX",
+    "quantize",
+    "dequantize",
+    "scaled_matmul",
+    "fp8_autowrap",
+    "active_recipe",
+    "recipe_dtypes",
+    "init_delayed_state",
+    "delayed_scale",
+    "update_delayed_state",
+]
+
+# Largest finite magnitudes of the XLA float8 formats.
+E4M3_MAX = 448.0
+E5M2_MAX = 57344.0
+
+_FMT_MAX = {
+    jnp.float8_e4m3fn: E4M3_MAX,
+    jnp.float8_e5m2: E5M2_MAX,
+}
+
+
+def _fmt_max(dtype) -> float:
+    return _FMT_MAX[jnp.dtype(dtype).type if not isinstance(dtype, type) else dtype]
+
+
+def quantize(
+    x: jax.Array,
+    dtype=jnp.float8_e4m3fn,
+    scale: Optional[jax.Array] = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Quantize to fp8.  Returns (x_q, scale) with ``x ≈ x_q * scale``.
+
+    With no ``scale`` given, current scaling is used: scale = amax / fmt_max
+    (per tensor, fp32)."""
+    if scale is None:
+        amax = jnp.max(jnp.abs(x.astype(jnp.float32)))
+        scale = jnp.maximum(amax, 1e-12) / _fmt_max(dtype)
+    x_q = (x.astype(jnp.float32) / scale).astype(dtype)
+    return x_q, scale
+
+
+def dequantize(x_q: jax.Array, scale: jax.Array, dtype=jnp.float32) -> jax.Array:
+    return (x_q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def _f8_dot(a_q, sa, b_q, sb, contract):
+    y = jax.lax.dot_general(a_q, b_q, (contract, ((), ())), preferred_element_type=jnp.float32)
+    return y * (sa * sb)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_scaled_matmul(fwd_name: str, grad_name: str):
+    """Custom-VJP fp8 matmul specialized to (forward, gradient) float8 formats.
+
+    The backward pass quantizes the incoming cotangent to ``grad_name`` (e5m2
+    under the TE "HYBRID" format) and runs both gradient matmuls in fp8 too."""
+    fwd_dtype = jnp.dtype(fwd_name)
+    grad_dtype = jnp.dtype(grad_name)
+
+    @jax.custom_vjp
+    def f(x, w):
+        x_q, sx = quantize(x, fwd_dtype)
+        w_q, sw = quantize(w, fwd_dtype)
+        return _f8_dot(x_q, sx, w_q, sw, ((x.ndim - 1,), (0,)))
+
+    def f_fwd(x, w):
+        x_q, sx = quantize(x, fwd_dtype)
+        w_q, sw = quantize(w, fwd_dtype)
+        y = _f8_dot(x_q, sx, w_q, sw, ((x.ndim - 1,), (0,)))
+        # Zero-size prototypes carry the primal dtypes (residuals must be arrays).
+        return y, (x_q, sx, w_q, sw, jnp.zeros((0,), x.dtype), jnp.zeros((0,), w.dtype))
+
+    def f_bwd(res, dy):
+        x_q, sx, w_q, sw, x_proto, w_proto = res
+        x_dtype, w_dtype = x_proto.dtype, w_proto.dtype
+        k, n = w_q.shape
+        dy_q, sdy = quantize(dy, grad_dtype)
+        # dx = dy @ w^T   (contract dy's last dim with w's output dim)
+        dx = _f8_dot(dy_q, sdy, w_q, sw, ((dy.ndim - 1,), (1,))).astype(x_dtype)
+        # dw = x^T @ dy over all leading dims (flattened to one contraction).
+        dw = _f8_dot(
+            x_q.reshape(-1, k).T, sx, dy_q.reshape(-1, n), sdy, ((1,), (0,))
+        ).astype(w_dtype)
+        return dx, dw
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+def scaled_matmul(
+    x: jax.Array,
+    w: jax.Array,
+    *,
+    dtype=jnp.float8_e4m3fn,
+    grad_dtype=jnp.float8_e5m2,
+    x_scale: Optional[jax.Array] = None,
+    w_scale: Optional[jax.Array] = None,
+    out_dtype: Any = None,
+) -> jax.Array:
+    """``x @ w`` through fp8: quantize both operands, multiply in float8 with
+    fp32 accumulation, rescale.  Contraction over the last dim of ``x`` and
+    first dim of ``w`` (matmul semantics for any leading batch dims of ``x``).
+
+    The backward pass also runs in fp8: incoming cotangents are quantized to
+    ``grad_dtype`` — e5m2 by default, the TE "HYBRID" format (wider range for
+    gradients).  Pass ``grad_dtype=jnp.float8_e4m3fn`` for the "E4M3" format.
+
+    Explicit ``x_scale``/``w_scale`` (delayed recipe) bypass the custom-VJP
+    current-scaling path: quantization then differentiates as a cast.
+    """
+    out_dtype = out_dtype or x.dtype
+    if x_scale is not None or w_scale is not None:
+        x_q, sx = quantize(x, dtype, x_scale)
+        w_q, sw = quantize(w, dtype, w_scale)
+        return _f8_dot(x_q, sx, w_q, sw, ((x.ndim - 1,), (0,))).astype(out_dtype)
+    f = _make_scaled_matmul(jnp.dtype(dtype).name, jnp.dtype(grad_dtype).name)
+    return f(x, w).astype(out_dtype)
+
+
+# ---------------------------------------------------------------------------
+# fp8 autowrap mode
+# ---------------------------------------------------------------------------
+
+_ACTIVE: list = []
+
+
+@contextlib.contextmanager
+def fp8_autowrap(recipe=None):
+    """While active (at trace time), framework matmuls — the torch-bridge
+    Linear/matmul lowerings and the models' ``_mm`` helpers — route through
+    :func:`scaled_matmul`.  Parity: reference ``apply_fp8_autowrap``
+    (``utils/transformer_engine.py:136``), which wraps ``forward`` in TE's
+    ``fp8_autocast``.  The mode is read during jit tracing, so a step function
+    traced under it bakes fp8 into the compiled program."""
+    if recipe is None:
+        from ..utils.dataclasses import FP8RecipeKwargs
+
+        recipe = FP8RecipeKwargs()
+    _ACTIVE.append(recipe)
+    try:
+        yield
+    finally:
+        _ACTIVE.pop()
+
+
+def active_recipe():
+    return _ACTIVE[-1] if _ACTIVE else None
+
+
+def recipe_dtypes(recipe) -> tuple[Any, Any]:
+    """(forward_dtype, grad_dtype) for a recipe (None -> HYBRID defaults)."""
+    if recipe is None or recipe.fp8_format == "HYBRID":
+        return jnp.float8_e4m3fn, jnp.float8_e5m2
+    return jnp.float8_e4m3fn, jnp.float8_e4m3fn
+
+
+# ---------------------------------------------------------------------------
+# Delayed scaling (TransformerEngine recipe, functional form)
+# ---------------------------------------------------------------------------
+
+
+def init_delayed_state(amax_history_len: int = 1024) -> dict[str, jax.Array]:
+    """Per-tensor delayed-scaling state: amax ring history + current scale."""
+    return {
+        "amax_history": jnp.zeros((amax_history_len,), jnp.float32),
+        "scale": jnp.ones((), jnp.float32),
+    }
+
+
+def delayed_scale(
+    state: dict[str, jax.Array],
+    *,
+    dtype=jnp.float8_e4m3fn,
+    margin: int = 0,
+    amax_compute_algo: str = "max",
+) -> jax.Array:
+    """Scale for the *next* step from recorded history (TE DelayedScaling)."""
+    if amax_compute_algo == "max":
+        amax = jnp.max(state["amax_history"])
+    elif amax_compute_algo == "most_recent":
+        amax = state["amax_history"][0]
+    else:
+        raise ValueError(f"Unknown amax_compute_algo {amax_compute_algo!r}")
+    amax = jnp.maximum(amax, 1e-12)
+    return amax / _fmt_max(dtype) * (2.0 ** margin)
+
+
+def update_delayed_state(
+    state: dict[str, jax.Array],
+    x: jax.Array,
+    *,
+    dtype=jnp.float8_e4m3fn,
+    margin: int = 0,
+    amax_compute_algo: str = "max",
+) -> dict[str, jax.Array]:
+    """Record ``amax(x)`` into the history and refresh the scale."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)))
+    hist = jnp.roll(state["amax_history"], 1).at[0].set(amax)
+    new = {"amax_history": hist, "scale": state["scale"]}
+    new["scale"] = delayed_scale(
+        new, dtype=dtype, margin=margin, amax_compute_algo=amax_compute_algo
+    )
+    return new

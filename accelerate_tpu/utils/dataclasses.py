@@ -31,6 +31,7 @@ __all__ = [
     "GradScalerKwargs",
     "DistributedDataParallelKwargs",
     "AutocastKwargs",
+    "FP8RecipeKwargs",
     "ProfileKwargs",
     "GradientAccumulationPlugin",
     "ParallelismConfig",
@@ -181,6 +182,35 @@ class AutocastKwargs(KwargsHandler):
 
     enabled: bool = True
     cache_enabled: bool = True
+
+
+@dataclass
+class FP8RecipeKwargs(KwargsHandler):
+    """FP8 training recipe — parity with reference ``TERecipeKwargs``
+    (``utils/dataclasses.py:316``) mapped onto XLA float8 (``ops/fp8.py``).
+
+    ``fp8_format``: "HYBRID" = e4m3 forward / e5m2 gradients (TE default),
+    "E4M3" = e4m3 everywhere.  ``scaling``: "current" (stateless per-tensor
+    dynamic scaling, torchao-style — the autowrap default) or "delayed" (TE
+    amax-history recipe; requires threading explicit per-tensor state built by
+    ``ops.fp8.init_delayed_state`` through the step, which consumes
+    ``margin``/``interval``/``amax_history_len``/``amax_compute_algo``)."""
+
+    margin: int = 0
+    interval: int = 1
+    fp8_format: str = "HYBRID"
+    amax_history_len: int = 1024
+    amax_compute_algo: str = "max"
+    scaling: str = "current"
+
+    def __post_init__(self):
+        self.fp8_format = self.fp8_format.upper()
+        if self.fp8_format not in ("HYBRID", "E4M3"):
+            raise ValueError("fp8_format must be 'HYBRID' or 'E4M3'")
+        if self.amax_compute_algo not in ("max", "most_recent"):
+            raise ValueError("amax_compute_algo must be 'max' or 'most_recent'")
+        if self.scaling not in ("current", "delayed"):
+            raise ValueError("scaling must be 'current' or 'delayed'")
 
 
 @dataclass
@@ -414,6 +444,11 @@ class MixedPrecisionPolicy:
     compute_dtype: str = "bfloat16"
     output_dtype: str = "float32"
     reduce_dtype: str = "float32"
+    # fp8 is not a blanket cast: activations stay in compute_dtype and the
+    # model's matmuls route through ``ops.fp8.scaled_matmul`` (per-tensor-scaled
+    # float8 operands, fp32 accumulation) under ``fp8_recipe``.
+    fp8: bool = False
+    fp8_recipe: Optional["FP8RecipeKwargs"] = None
 
     @classmethod
     def from_mixed_precision(cls, mixed_precision: str) -> "MixedPrecisionPolicy":
@@ -423,7 +458,7 @@ class MixedPrecisionPolicy:
             # fp16 has no TPU hardware path; bf16 is the faithful equivalent.
             return cls()
         if mixed_precision == "fp8":
-            return cls(compute_dtype="float8_e4m3fn")
+            return cls(fp8=True, fp8_recipe=FP8RecipeKwargs())
         raise ValueError(f"Unknown mixed_precision {mixed_precision!r}")
 
 

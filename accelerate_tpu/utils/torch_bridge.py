@@ -52,7 +52,14 @@ def _t2j(t) -> jax.Array:
 
 
 def _linear(x, weight, bias=None):
-    y = x @ weight.T
+    from ..ops import fp8 as _fp8
+
+    recipe = _fp8.active_recipe()
+    if recipe is not None and weight.ndim == 2:
+        fwd, grad = _fp8.recipe_dtypes(recipe)
+        y = _fp8.scaled_matmul(x, weight.T, dtype=fwd, grad_dtype=grad, out_dtype=x.dtype)
+    else:
+        y = x @ weight.T
     return y + bias if bias is not None else y
 
 
@@ -191,6 +198,12 @@ def _dropout(x, p=0.5, training=False, inplace=False):
 
 
 def _matmul(a, b):
+    from ..ops import fp8 as _fp8
+
+    recipe = _fp8.active_recipe()
+    if recipe is not None and b.ndim == 2 and a.ndim >= 2:
+        fwd, grad = _fp8.recipe_dtypes(recipe)
+        return _fp8.scaled_matmul(a, b, dtype=fwd, grad_dtype=grad, out_dtype=a.dtype)
     return a @ b
 
 
