@@ -1,10 +1,12 @@
 """Experiment trackers.
 
 Parity target: reference ``src/accelerate/tracking.py`` (1089 LoC):
-``GeneralTracker`` ABC with ``main_process_only`` gating, 8 backends, registry +
-``filter_trackers``.  Round 1 ships the ABC, the generic dict/JSONL tracker, and
-TensorBoard/WandB adapters (gated on availability); remaining backends follow the
-same adapter shape.
+``GeneralTracker`` ABC with ``main_process_only`` gating (``tracking.py:69``),
+the full backend set — TensorBoard (167), WandB (278), CometML (401), Aim (493),
+MLflow (592), ClearML (790), DVCLive (942) — plus a dependency-free JSONL
+tracker, registry ``LOGGER_TYPE_TO_CLASS`` (1026) and ``filter_trackers``
+(1037).  Backends import their SDK lazily and are filtered by availability, so
+the module works in environments with none of them installed.
 """
 
 from __future__ import annotations
@@ -17,7 +19,15 @@ from typing import Any, Optional, Union
 
 from .logging import get_logger
 from .state import PartialState
-from .utils.imports import is_tensorboard_available, is_wandb_available
+from .utils.imports import (
+    is_aim_available,
+    is_clearml_available,
+    is_comet_ml_available,
+    is_dvclive_available,
+    is_mlflow_available,
+    is_tensorboard_available,
+    is_wandb_available,
+)
 
 logger = get_logger(__name__)
 
@@ -26,6 +36,11 @@ __all__ = [
     "GenericTracker",
     "TensorBoardTracker",
     "WandBTracker",
+    "CometMLTracker",
+    "AimTracker",
+    "MLflowTracker",
+    "ClearMLTracker",
+    "DVCLiveTracker",
     "LOGGER_TYPE_TO_CLASS",
     "filter_trackers",
     "init_trackers",
@@ -43,6 +58,11 @@ def on_main_process(function):
         return function(self, *args, **kwargs)
 
     return wrapper
+
+
+def _is_scalar(v) -> bool:
+    """Loggable-as-metric predicate shared by the backends."""
+    return isinstance(v, (int, float)) or hasattr(v, "__float__")
 
 
 class GeneralTracker:
@@ -127,7 +147,7 @@ class TensorBoardTracker(GeneralTracker):
     @on_main_process
     def log(self, values: dict, step: Optional[int] = None, **kwargs):
         for k, v in values.items():
-            if isinstance(v, (int, float)) or hasattr(v, "__float__"):
+            if _is_scalar(v):
                 self.writer.add_scalar(k, float(v), global_step=step, **kwargs)
             elif isinstance(v, str):
                 self.writer.add_text(k, v, global_step=step, **kwargs)
@@ -169,16 +189,213 @@ class WandBTracker(GeneralTracker):
         self.run.finish()
 
 
+class CometMLTracker(GeneralTracker):
+    """Reference ``tracking.py:401``."""
+
+    name = "comet_ml"
+    requires_logging_directory = False
+
+    def __init__(self, run_name: str, **kwargs):
+        import comet_ml
+
+        self.run_name = run_name
+        self.experiment = comet_ml.start(project_name=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.experiment
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.experiment.log_parameters(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        if step is not None:
+            self.experiment.log_current_epoch(step)
+        for k, v in values.items():
+            if _is_scalar(v):
+                self.experiment.log_metric(k, float(v), step=step, **kwargs)
+            elif isinstance(v, str):
+                self.experiment.log_other(k, v, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        self.experiment.end()
+
+
+class AimTracker(GeneralTracker):
+    """Reference ``tracking.py:493``."""
+
+    name = "aim"
+    requires_logging_directory = True
+
+    def __init__(self, run_name: str, logging_dir: str = ".", **kwargs):
+        from aim import Run
+
+        self.run_name = run_name
+        self.writer = Run(repo=logging_dir, **kwargs)
+        self.writer.name = run_name
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.writer["hparams"] = values
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        for k, v in values.items():
+            self.writer.track(v, name=k, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        self.writer.close()
+
+
+class MLflowTracker(GeneralTracker):
+    """Reference ``tracking.py:592``."""
+
+    name = "mlflow"
+    requires_logging_directory = False
+
+    def __init__(self, run_name: str, logging_dir: Optional[str] = None, **kwargs):
+        import mlflow
+
+        self.run_name = run_name
+        experiment_name = kwargs.pop("experiment_name", run_name)
+        mlflow.set_experiment(experiment_name)
+        self.active_run = mlflow.start_run(run_name=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.active_run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        import mlflow
+
+        # MLflow caps param value length; stringify + truncate like the reference.
+        items = [(k, str(v)[:500]) for k, v in values.items()]
+        for i in range(0, len(items), 100):  # batch limit per call
+            mlflow.log_params(dict(items[i : i + 100]))
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        import mlflow
+
+        metrics = {k: float(v) for k, v in values.items() if _is_scalar(v)}
+        mlflow.log_metrics(metrics, step=step)
+
+    @on_main_process
+    def finish(self):
+        import mlflow
+
+        mlflow.end_run()
+
+
+class ClearMLTracker(GeneralTracker):
+    """Reference ``tracking.py:790``."""
+
+    name = "clearml"
+    requires_logging_directory = False
+
+    def __init__(self, run_name: str, **kwargs):
+        from clearml import Task
+
+        self.run_name = run_name
+        self.task = Task.init(project_name=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.task
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.task.connect_configuration(dict(values))
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        clearml_logger = self.task.get_logger()
+        for k, v in values.items():
+            if not (_is_scalar(v)):
+                continue
+            if step is None:
+                clearml_logger.report_single_value(name=k, value=float(v), **kwargs)
+                continue
+            title, _, series = k.partition("/")
+            series = series or title
+            clearml_logger.report_scalar(
+                title=title, series=series, value=float(v), iteration=step, **kwargs
+            )
+
+    @on_main_process
+    def finish(self):
+        self.task.close()
+
+
+class DVCLiveTracker(GeneralTracker):
+    """Reference ``tracking.py:942``."""
+
+    name = "dvclive"
+    requires_logging_directory = False
+
+    def __init__(self, run_name: Optional[str] = None, live=None, **kwargs):
+        from dvclive import Live
+
+        self.live = live if live is not None else Live(**kwargs)
+
+    @property
+    def tracker(self):
+        return self.live
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.live.log_params(dict(values))
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        if step is not None:
+            self.live.step = step
+        for k, v in values.items():
+            if _is_scalar(v):
+                self.live.log_metric(k, float(v), **kwargs)
+        self.live.next_step()
+
+    @on_main_process
+    def finish(self):
+        self.live.end()
+
+
 LOGGER_TYPE_TO_CLASS = {
     "generic": GenericTracker,
     "tensorboard": TensorBoardTracker,
     "wandb": WandBTracker,
+    "comet_ml": CometMLTracker,
+    "aim": AimTracker,
+    "mlflow": MLflowTracker,
+    "clearml": ClearMLTracker,
+    "dvclive": DVCLiveTracker,
+}
+
+# name -> availability probe; "generic" has no dependency so it is always on.
+_TRACKER_AVAILABLE = {
+    "tensorboard": is_tensorboard_available,
+    "wandb": is_wandb_available,
+    "comet_ml": is_comet_ml_available,
+    "aim": is_aim_available,
+    "mlflow": is_mlflow_available,
+    "clearml": is_clearml_available,
+    "dvclive": is_dvclive_available,
 }
 
 
-def filter_trackers(log_with: list, logging_dir: Optional[str] = None) -> list[str]:
+def filter_trackers(log_with: list, logging_dir: Optional[str] = None) -> list:
     """Validate requested trackers against availability (reference
-    ``tracking.py:1037``)."""
+    ``tracking.py:1037``): "all" expands to every installed backend, unavailable
+    backends warn + drop, unknown names raise."""
     out = []
     for item in log_with or []:
         if isinstance(item, GeneralTracker):
@@ -186,24 +403,32 @@ def filter_trackers(log_with: list, logging_dir: Optional[str] = None) -> list[s
             continue
         name = str(item).lower()
         if name == "all":
-            if is_tensorboard_available():
-                out.append("tensorboard")
-            if is_wandb_available():
-                out.append("wandb")
-            continue
-        if name == "tensorboard" and not is_tensorboard_available():
-            logger.warning("tensorboard not available; skipping tracker")
-            continue
-        if name == "wandb" and not is_wandb_available():
-            logger.warning("wandb not available; skipping tracker")
+            out.extend(n for n, avail in _TRACKER_AVAILABLE.items() if avail())
             continue
         if name not in LOGGER_TYPE_TO_CLASS:
             raise ValueError(f"Unknown tracker {name}; options: {sorted(LOGGER_TYPE_TO_CLASS)}")
+        if name in _TRACKER_AVAILABLE and not _TRACKER_AVAILABLE[name]():
+            logger.warning(f"{name} not available; skipping tracker")
+            continue
         out.append(name)
-    return out
+    # Dedupe preserving order ("all" + an explicit name must not instantiate a
+    # backend twice — a second mlflow.start_run/wandb.init would raise).
+    seen: set = set()
+    deduped = []
+    for item in out:
+        key = item if isinstance(item, str) else id(item)
+        if key not in seen:
+            seen.add(key)
+            deduped.append(item)
+    return deduped
 
 
 def init_trackers(log_with, project_name, config, init_kwargs, accelerator) -> list[GeneralTracker]:
+    # Constructors create SDK runs/tasks, so non-main processes must not build
+    # backends at all (reference gates Accelerator.init_trackers itself with
+    # @on_main_process): only already-constructed instances pass through.
+    if not PartialState().is_main_process:
+        return [t for t in (log_with or []) if isinstance(t, GeneralTracker)]
     init_kwargs = init_kwargs or {}
     logging_dir = accelerator.project_configuration.logging_dir or "."
     trackers = []
