@@ -403,10 +403,49 @@ class Accelerator:
         rng_types: Optional[list[Union[str, RNGType]]] = None,
         fsdp_plugin: Optional[FullyShardedDataParallelPlugin] = None,
         parallelism_config: Optional[ParallelismConfig] = None,
+        deepspeed_plugin=None,
+        megatron_lm_plugin=None,
         even_batches: bool = True,
         dispatch_batches: Optional[bool] = None,
         use_seedable_sampler: bool = False,
     ):
+        # Engine config dialects (SURVEY §7 item 14): a DeepSpeed or Megatron
+        # plugin is translated onto the GSPMD mesh instead of handed to an
+        # external engine — explicit fsdp_plugin/parallelism_config win.
+        if deepspeed_plugin is not None and megatron_lm_plugin is not None:
+            raise ValueError("Pass either deepspeed_plugin or megatron_lm_plugin, not both")
+        # Launcher env contract (reference utils/launch.py:329, :310): the worker
+        # reconstructs the active dialect from env alone.
+        if deepspeed_plugin is None and megatron_lm_plugin is None:
+            from .utils.environment import parse_flag_from_env
+
+            if parse_flag_from_env("ACCELERATE_USE_DEEPSPEED"):
+                from .utils.deepspeed import DeepSpeedPlugin
+
+                ds_config = os.environ.get("ACCELERATE_DEEPSPEED_CONFIG_FILE")
+                deepspeed_plugin = DeepSpeedPlugin(hf_ds_config=ds_config)
+            elif parse_flag_from_env("ACCELERATE_USE_MEGATRON_LM"):
+                from .utils.megatron import MegatronLMPlugin
+
+                megatron_lm_plugin = MegatronLMPlugin()
+        self.deepspeed_plugin = deepspeed_plugin
+        self.megatron_lm_plugin = megatron_lm_plugin
+        dialect = deepspeed_plugin or megatron_lm_plugin
+        self._dialect_grad_clip = dialect.gradient_clipping if dialect is not None else None
+        if dialect is not None:
+            import jax
+
+            n_devices = jax.device_count()
+            if parallelism_config is None:
+                parallelism_config = dialect.to_parallelism_config(n_devices)
+            if fsdp_plugin is None:
+                fsdp_plugin = dialect.to_fsdp_plugin()
+        if deepspeed_plugin is not None:
+            if mixed_precision is None:
+                mixed_precision = deepspeed_plugin.mixed_precision
+            if gradient_accumulation_steps == 1:
+                gradient_accumulation_steps = deepspeed_plugin.gradient_accumulation_steps
+            deepspeed_plugin.select()
         if project_config is not None:
             self.project_configuration = project_config
         else:
@@ -433,6 +472,14 @@ class Accelerator:
             fsdp_plugin=fsdp_plugin,
             _from_accelerator=True,
         )
+        if dialect is not None:
+            # Reference parity: the dialect rewrites distributed_type ON THE
+            # STATE singleton (``state.py:952-976``) so direct readers agree.
+            self.state.deepspeed_plugin = deepspeed_plugin
+            self.state.megatron_lm_plugin = megatron_lm_plugin
+            self.state.distributed_type = (
+                DistributedType.DEEPSPEED if deepspeed_plugin is not None else DistributedType.MEGATRON_LM
+            )
         self.gradient_state = GradientState(gradient_accumulation_plugin=gradient_accumulation_plugin)
         self.device_placement = device_placement
         self.step_scheduler_with_optimizer = step_scheduler_with_optimizer
@@ -549,6 +596,8 @@ class Accelerator:
         """
         import torch
 
+        from .utils.deepspeed import DummyOptim, DummyScheduler
+
         prepared = []
         # Pass 1: everything except optimizers/schedulers (model must exist first).
         staged: dict[int, Any] = {}
@@ -559,17 +608,52 @@ class Accelerator:
                 obj, (DataLoaderShard, DataLoaderDispatcher)
             ):
                 staged[i] = self.prepare_data_loader(obj)
+        if self.deepspeed_plugin is not None:
+            # Resolve "auto" DS-config fields against the prepared dataloaders
+            # (reference _prepare_deepspeed accelerator.py:1837-1863).
+            micro_bs = next(
+                (dl.batch_size for dl in self._dataloaders if getattr(dl, "batch_size", None)),
+                None,
+            )
+            self.deepspeed_plugin.fill_auto(
+                train_micro_batch_size_per_gpu=micro_bs, num_devices=self.num_processes
+            )
+        dummy_realized: dict[int, Any] = {}  # id(DummyOptim) -> real torch optimizer
         for i, obj in enumerate(args):
             if i in staged:
                 continue
-            if isinstance(obj, torch.optim.Optimizer):
+            if isinstance(obj, DummyOptim):
+                # "Optimizer comes from the DS config": materialize the AdamW the
+                # DS engine would have built (reference utils/deepspeed.py:325).
+                real = torch.optim.AdamW(obj.params, lr=obj.lr, weight_decay=obj.weight_decay)
+                dummy_realized[id(obj)] = real
+                staged[i] = self.prepare_optimizer(real)
+            elif isinstance(obj, torch.optim.Optimizer):
                 staged[i] = self.prepare_optimizer(obj)
             elif _is_optax_tx(obj):
                 staged[i] = self.prepare_optimizer(obj)
         for i, obj in enumerate(args):
             if i in staged:
                 continue
-            if _is_scheduler_like(obj):
+            if isinstance(obj, DummyScheduler):
+                real_opt = dummy_realized.get(id(obj.optimizer))
+                if real_opt is None and isinstance(obj.optimizer, torch.optim.Optimizer):
+                    real_opt = obj.optimizer
+                if real_opt is None:
+                    raise ValueError(
+                        "DummyScheduler's optimizer must be the DummyOptim (or torch "
+                        "optimizer) passed to the same prepare() call"
+                    )
+                if obj.lr_scheduler_callable is not None:
+                    sched = obj.lr_scheduler_callable(real_opt)
+                else:
+                    # DS WarmupLR semantics: linear warmup then constant.
+                    warm = max(int(obj.warmup_num_steps or 0), 0)
+                    sched = torch.optim.lr_scheduler.LambdaLR(
+                        real_opt, lambda step: min(1.0, (step + 1) / warm) if warm else 1.0
+                    )
+                staged[i] = self.prepare_scheduler(sched)
+            elif _is_scheduler_like(obj):
                 staged[i] = self.prepare_scheduler(obj)
             else:
                 staged[i] = obj  # passthrough, reference behavior
@@ -647,6 +731,11 @@ class Accelerator:
             prepared = AcceleratedOptimizer(tx, model=model, torch_optimizer=optimizer, initial_lr=lr)
         else:
             prepared = AcceleratedOptimizer(optimizer, model=model)
+        if self._dialect_grad_clip is not None:
+            # DS/Megatron configs carry gradient_clipping; the engines applied it
+            # automatically, so the dialect must too (reference utils/deepspeed.py
+            # fills "gradient_clipping" into the engine config).
+            prepared._clip_norm = float(self._dialect_grad_clip)
         self._optimizers.append(prepared)
         return prepared
 

@@ -1,0 +1,270 @@
+"""DeepSpeed config dialect — ZeRO stages mapped onto GSPMD sharding.
+
+Parity target: reference ``utils/deepspeed.py`` (371 LoC) + ``DeepSpeedPlugin``
+(``utils/dataclasses.py:1021-1449``).  The reference hands the training objects
+to the DeepSpeed engine; here the plugin is a *config dialect*: an existing
+``ds_config.json`` (or the same constructor kwargs) is parsed and translated
+onto the one GSPMD mesh —
+
+- ZeRO stage 3  -> ``FULL_SHARD``      (params+grads+opt state on the fsdp axis)
+- ZeRO stage 1/2 -> ``SHARD_GRAD_OP``  (params replicated, grads/opt sharded)
+- ZeRO stage 0  -> ``NO_SHARD``        (plain DP)
+- ``tensor_parallel.autotp_size``      -> ``tp`` mesh axis (reference
+  ``accelerator.py:1817-1830``)
+- fp16/bf16 sections                   -> mixed-precision policy (bf16 on TPU)
+- offload_optimizer/offload_param      -> ``cpu_offload``
+- gradient_accumulation / clipping     -> accumulation plugin + clip value
+
+"auto" values follow the reference's fill-from-runtime contract
+(``_prepare_deepspeed`` ``accelerator.py:1941-1998``): they are resolved against
+the model/dataloader at prepare time via :meth:`DeepSpeedPlugin.fill_auto`.
+
+``DummyOptim``/``DummyScheduler`` (reference ``utils/deepspeed.py:325-370``) are
+kept so scripts written for "optimizer comes from the DS config" run unchanged.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+from copy import deepcopy
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from .dataclasses import FullyShardedDataParallelPlugin, ParallelismConfig
+
+__all__ = [
+    "HfDeepSpeedConfig",
+    "DeepSpeedPlugin",
+    "DummyOptim",
+    "DummyScheduler",
+    "get_active_deepspeed_plugin",
+]
+
+_ZERO_TO_STRATEGY = {
+    0: "NO_SHARD",
+    1: "SHARD_GRAD_OP",
+    2: "SHARD_GRAD_OP",
+    3: "FULL_SHARD",
+}
+
+
+class HfDeepSpeedConfig:
+    """Minimal ds_config holder with nested get/set (reference depends on the
+    same-named class from DeepSpeed/transformers; ours is standalone)."""
+
+    def __init__(self, config_file_or_dict):
+        if isinstance(config_file_or_dict, dict):
+            self.config = deepcopy(config_file_or_dict)
+        elif isinstance(config_file_or_dict, (str, os.PathLike)):
+            with io.open(config_file_or_dict, "r", encoding="utf-8") as f:
+                self.config = json.load(f)
+        else:
+            raise ValueError("Expected a dict or a path to a DeepSpeed JSON config")
+
+    def get_value(self, ds_key_long, default=None):
+        node = self.config
+        *parents, key = ds_key_long.split(".")
+        for p in parents:
+            node = node.get(p)
+            if node is None:
+                return default
+        return node.get(key, default)
+
+    def set_value(self, ds_key_long, value):
+        node = self.config
+        *parents, key = ds_key_long.split(".")
+        for p in parents:
+            node = node.setdefault(p, {})
+        node[key] = value
+
+    def is_auto(self, ds_key_long) -> bool:
+        return self.get_value(ds_key_long) == "auto"
+
+    def is_zero3(self) -> bool:
+        return self.get_value("zero_optimization.stage", 0) == 3
+
+
+@dataclass
+class DeepSpeedPlugin:
+    """Parity: reference ``DeepSpeedPlugin`` (``utils/dataclasses.py:1021-1449``).
+
+    Every knob is honored as a mapping onto the GSPMD mesh rather than an engine
+    handoff; env contract (``ACCELERATE_DEEPSPEED_*``, ``ACCELERATE_GRADIENT_*``)
+    preserved so ``accelerate launch`` configs carry over.
+    """
+
+    hf_ds_config: Any = None  # dict | path | HfDeepSpeedConfig
+    gradient_accumulation_steps: Optional[int] = None
+    gradient_clipping: Optional[float] = None
+    zero_stage: Optional[int] = None
+    is_train_batch_min: bool = True
+    offload_optimizer_device: Optional[str] = None
+    offload_param_device: Optional[str] = None
+    offload_optimizer_nvme_path: Optional[str] = None
+    offload_param_nvme_path: Optional[str] = None
+    zero3_init_flag: Optional[bool] = None
+    zero3_save_16bit_model: Optional[bool] = None
+    transformer_moe_cls_names: Optional[str] = None
+    enable_msamp: bool = False
+    msamp_opt_level: str = "O1"
+
+    def __post_init__(self):
+        env = os.environ
+        if self.gradient_accumulation_steps is None:
+            self.gradient_accumulation_steps = int(
+                env.get("ACCELERATE_GRADIENT_ACCUMULATION_STEPS", 1)
+            )
+        if self.gradient_clipping is None:
+            clip = env.get("ACCELERATE_GRADIENT_CLIPPING", "none").lower()
+            if clip != "none":
+                self.gradient_clipping = float(clip)
+        if self.zero_stage is None:
+            self.zero_stage = int(env.get("ACCELERATE_DEEPSPEED_ZERO_STAGE", 2))
+        if self.offload_optimizer_device is None:
+            self.offload_optimizer_device = env.get(
+                "ACCELERATE_DEEPSPEED_OFFLOAD_OPTIMIZER_DEVICE", "none"
+            )
+        if self.offload_param_device is None:
+            self.offload_param_device = env.get(
+                "ACCELERATE_DEEPSPEED_OFFLOAD_PARAM_DEVICE", "none"
+            )
+        if self.zero3_save_16bit_model is None:
+            self.zero3_save_16bit_model = (
+                env.get("ACCELERATE_DEEPSPEED_ZERO3_SAVE_16BIT_MODEL", "false") == "true"
+            )
+
+        if self.hf_ds_config is not None and not isinstance(self.hf_ds_config, HfDeepSpeedConfig):
+            self.hf_ds_config = HfDeepSpeedConfig(self.hf_ds_config)
+        if self.hf_ds_config is not None:
+            cfg = self.hf_ds_config
+            stage = cfg.get_value("zero_optimization.stage")
+            if stage is not None and stage != "auto":
+                self.zero_stage = int(stage)
+            ga = cfg.get_value("gradient_accumulation_steps")
+            if ga is not None and ga != "auto":
+                self.gradient_accumulation_steps = int(ga)
+            clip = cfg.get_value("gradient_clipping")
+            if clip is not None and clip != "auto":
+                self.gradient_clipping = float(clip)
+            off_opt = cfg.get_value("zero_optimization.offload_optimizer.device")
+            if off_opt is not None and off_opt != "auto":
+                self.offload_optimizer_device = off_opt
+            off_par = cfg.get_value("zero_optimization.offload_param.device")
+            if off_par is not None and off_par != "auto":
+                self.offload_param_device = off_par
+        if self.zero_stage not in _ZERO_TO_STRATEGY:
+            raise ValueError(f"zero_stage must be 0..3, got {self.zero_stage}")
+        if self.zero3_init_flag is None:
+            self.zero3_init_flag = self.zero_stage == 3
+
+    # -- dialect translation -------------------------------------------------
+
+    @property
+    def sharding_strategy(self) -> str:
+        return _ZERO_TO_STRATEGY[self.zero_stage]
+
+    @property
+    def cpu_offload(self) -> bool:
+        return "cpu" in (self.offload_optimizer_device or "") or "cpu" in (
+            self.offload_param_device or ""
+        )
+
+    def to_fsdp_plugin(self) -> FullyShardedDataParallelPlugin:
+        """The GSPMD strategy this DS config describes."""
+        return FullyShardedDataParallelPlugin(
+            sharding_strategy=self.sharding_strategy,
+            cpu_offload=self.cpu_offload,
+        )
+
+    def to_parallelism_config(self, num_devices: int) -> ParallelismConfig:
+        """fsdp axis spans all devices; DS AutoTP carves out a tp axis."""
+        tp = 1
+        if self.hf_ds_config is not None:
+            autotp = self.hf_ds_config.get_value("tensor_parallel.autotp_size", 1)
+            if autotp and autotp != "auto":
+                tp = int(autotp)
+        if num_devices % tp != 0:
+            raise ValueError(f"autotp_size {tp} must divide device count {num_devices}")
+        if self.zero_stage == 0:
+            return ParallelismConfig(dp=num_devices // tp, tp=tp)
+        return ParallelismConfig(fsdp=num_devices // tp, tp=tp)
+
+    @property
+    def mixed_precision(self) -> Optional[str]:
+        if self.hf_ds_config is None:
+            return None
+        if self.hf_ds_config.get_value("bf16.enabled") is True:
+            return "bf16"
+        if self.hf_ds_config.get_value("fp16.enabled") is True:
+            return "fp16"  # no TPU fp16 hardware path; policy maps it to bf16
+        return None
+
+    def fill_auto(self, *, train_micro_batch_size_per_gpu=None, num_devices=1):
+        """Resolve "auto" fields against runtime facts (reference
+        ``_prepare_deepspeed`` ``accelerator.py:1941-1998``)."""
+        if self.hf_ds_config is None:
+            return
+        cfg = self.hf_ds_config
+        if train_micro_batch_size_per_gpu is not None:
+            if cfg.is_auto("train_micro_batch_size_per_gpu") or cfg.get_value(
+                "train_micro_batch_size_per_gpu"
+            ) is None:
+                cfg.set_value("train_micro_batch_size_per_gpu", train_micro_batch_size_per_gpu)
+            if cfg.is_auto("train_batch_size") or cfg.get_value("train_batch_size") is None:
+                cfg.set_value(
+                    "train_batch_size",
+                    train_micro_batch_size_per_gpu
+                    * self.gradient_accumulation_steps
+                    * num_devices,
+                )
+        if cfg.is_auto("gradient_accumulation_steps"):
+            cfg.set_value("gradient_accumulation_steps", self.gradient_accumulation_steps)
+        if cfg.is_auto("gradient_clipping") and self.gradient_clipping is not None:
+            cfg.set_value("gradient_clipping", self.gradient_clipping)
+        if cfg.is_auto("zero_optimization.stage"):
+            cfg.set_value("zero_optimization.stage", self.zero_stage)
+
+    # -- multi-plugin selection (reference get_active_deepspeed_plugin) ------
+
+    def select(self, _from_accelerator_state: bool = False):
+        """Mark this plugin active (reference ``utils/dataclasses.py:1443``)."""
+        global _active_plugin
+        _active_plugin = self
+
+
+_active_plugin: Optional[DeepSpeedPlugin] = None
+
+
+def get_active_deepspeed_plugin(state=None) -> Optional[DeepSpeedPlugin]:
+    """Reference ``utils/deepspeed.py:100``.  The Accelerator records the active
+    plugin on the state singleton (``state.deepspeed_plugin``); the module-level
+    fallback covers plugins activated via ``select()`` before an Accelerator
+    exists."""
+    if state is not None and getattr(state, "deepspeed_plugin", None) is not None:
+        return state.deepspeed_plugin
+    return _active_plugin
+
+
+class DummyOptim:
+    """Placeholder optimizer for "optimizer defined in the DS config" scripts
+    (reference ``utils/deepspeed.py:325``): prepare() swaps in the real optax
+    transform built from the config's lr/weight-decay."""
+
+    def __init__(self, params, lr=0.001, weight_decay=0.0, **kwargs):
+        self.params = params
+        self.lr = lr
+        self.weight_decay = weight_decay
+        self.kwargs = kwargs
+
+
+class DummyScheduler:
+    """Placeholder scheduler (reference ``utils/deepspeed.py:349``)."""
+
+    def __init__(self, optimizer, total_num_steps=None, warmup_num_steps=0, lr_scheduler_callable=None, **kwargs):
+        self.optimizer = optimizer
+        self.total_num_steps = total_num_steps
+        self.warmup_num_steps = warmup_num_steps
+        self.lr_scheduler_callable = lr_scheduler_callable
+        self.kwargs = kwargs
