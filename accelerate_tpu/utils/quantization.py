@@ -1,0 +1,319 @@
+"""Weight-only quantization — the TPU-native bnb bridge.
+
+Parity target: reference ``utils/bnb.py`` (470 LoC, ``load_and_quantize_model``
+swapping Linear layers for bitsandbytes 8/4-bit modules) and
+``BnbQuantizationConfig`` (``utils/dataclasses.py:2613``).  TPU-native design:
+instead of swapping module classes, parameter *arrays* are stored quantized
+(int8 or packed nf4/fp4 with blockwise absmax scales — the bitsandbytes
+numerics) and dequantized inside the jit step right before their matmul; XLA
+fuses the dequant into the consumer, so HBM holds the 1-byte/0.5-byte storage
+while the MXU still sees bf16 operands.
+
+``QuantizedArray`` is a registered pytree node, so quantized parameter trees
+flow through ``jax.jit``/``device_put``/checkpointing unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "BnbQuantizationConfig",
+    "QuantizedArray",
+    "quantize_blockwise_int8",
+    "quantize_blockwise_4bit",
+    "dequantize",
+    "quantize_array",
+    "quantize_params",
+    "dequantize_params",
+    "load_and_quantize_model",
+    "NF4_CODE",
+    "FP4_CODE",
+]
+
+# QLoRA NF4 codebook: 16 quantiles of a standard normal, normalized to [-1, 1].
+NF4_CODE = np.array(
+    [
+        -1.0, -0.6961928009986877, -0.5250730514526367, -0.39491748809814453,
+        -0.28444138169288635, -0.18477343022823334, -0.09105003625154495, 0.0,
+        0.07958029955625534, 0.16093020141124725, 0.24611230194568634,
+        0.33791524171829224, 0.44070982933044434, 0.5626170039176941,
+        0.7229568362236023, 1.0,
+    ],
+    np.float32,
+)
+
+# bitsandbytes FP4 codebook (1-3-0 layout, no NaN/inf), normalized to [-1, 1].
+FP4_CODE = np.array(
+    [0.0, 0.0052, 0.6667, 1.0, 0.3333, 0.5, 0.1667, 0.25,
+     -0.0, -0.0052, -0.6667, -1.0, -0.3333, -0.5, -0.1667, -0.25],
+    np.float32,
+)
+
+
+@dataclasses.dataclass
+class BnbQuantizationConfig:
+    """Parity: reference ``BnbQuantizationConfig`` (``utils/dataclasses.py:2613``)."""
+
+    load_in_8bit: bool = False
+    load_in_4bit: bool = False
+    llm_int8_threshold: float = 6.0  # accepted; outlier split is not needed on TPU
+    bnb_4bit_quant_type: str = "fp4"  # "fp4" | "nf4" (reference default fp4)
+    bnb_4bit_use_double_quant: bool = False
+    bnb_4bit_compute_dtype: str = "bf16"
+    torch_dtype: Any = None
+    skip_modules: Optional[list[str]] = None
+    keep_in_fp32_modules: Optional[list[str]] = None
+    block_size: int = 64
+
+    def __post_init__(self):
+        if self.load_in_8bit and self.load_in_4bit:
+            raise ValueError("Pass load_in_8bit or load_in_4bit, not both")
+        if not (self.load_in_8bit or self.load_in_4bit):
+            raise ValueError("Set load_in_8bit=True or load_in_4bit=True")
+        if self.bnb_4bit_quant_type not in ("fp4", "nf4"):
+            raise ValueError("bnb_4bit_quant_type must be 'fp4' or 'nf4'")
+        if self.block_size < 2 or self.block_size % 2:
+            raise ValueError("block_size must be a positive even number (4-bit codes pack in pairs)")
+
+    @property
+    def qtype(self) -> str:
+        return "int8" if self.load_in_8bit else self.bnb_4bit_quant_type
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class QuantizedArray:
+    """Quantized parameter storage: codes + per-block absmax scales.
+
+    ``data``: int8 codes (int8 mode) or uint8 with two 4-bit codes per byte.
+    ``scales``: fp32 absmax per ``block_size`` flat elements.
+    """
+
+    data: jax.Array
+    scales: jax.Array
+    shape: tuple
+    qtype: str  # "int8" | "nf4" | "fp4"
+    block_size: int
+    out_dtype: Any
+
+    def tree_flatten(self):
+        return (self.data, self.scales), (self.shape, self.qtype, self.block_size, self.out_dtype)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], *aux)
+
+    @property
+    def dtype(self):  # duck-type as an array for shape/dtype probes
+        return self.out_dtype
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def dequantize(self) -> jax.Array:
+        return dequantize(self)
+
+    def nbytes_stored(self) -> int:
+        return int(np.asarray(self.data).nbytes + np.asarray(self.scales).nbytes)
+
+
+def _blocks(x: jax.Array, block_size: int) -> tuple[jax.Array, int]:
+    flat = x.astype(jnp.float32).reshape(-1)
+    pad = (-flat.size) % block_size
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    return flat.reshape(-1, block_size), pad
+
+
+def quantize_blockwise_int8(x: jax.Array, block_size: int = 64) -> tuple[jax.Array, jax.Array]:
+    """bitsandbytes LLM.int8-style blockwise absmax quantization."""
+    blocks, _ = _blocks(x, block_size)
+    absmax = jnp.maximum(jnp.max(jnp.abs(blocks), axis=1, keepdims=True), 1e-12)
+    codes = jnp.clip(jnp.round(blocks / absmax * 127.0), -127, 127).astype(jnp.int8)
+    return codes.reshape(-1), absmax[:, 0]
+
+
+def quantize_blockwise_4bit(
+    x: jax.Array, block_size: int = 64, quant_type: str = "nf4"
+) -> tuple[jax.Array, jax.Array]:
+    """4-bit codebook quantization (nf4/fp4), two codes packed per uint8."""
+    code = NF4_CODE if quant_type == "nf4" else FP4_CODE
+    blocks, _ = _blocks(x, block_size)
+    absmax = jnp.maximum(jnp.max(jnp.abs(blocks), axis=1, keepdims=True), 1e-12)
+    normed = blocks / absmax  # [-1, 1]
+    # Nearest codebook entry via searchsorted over the sorted code's midpoints —
+    # O(n log 16) with no (n, 16) broadcast temporary (a 16x fp32 blowup on a
+    # large embedding table would defeat the memory point of quantizing).
+    order = np.argsort(code)
+    sorted_code = code[order]
+    mids = jnp.asarray((sorted_code[1:] + sorted_code[:-1]) / 2.0)
+    pos = jnp.searchsorted(mids, normed)
+    idx = jnp.asarray(order.astype(np.uint8))[pos]
+    flat = idx.reshape(-1)
+    packed = (flat[0::2] << 4) | flat[1::2]
+    return packed, absmax[:, 0]
+
+
+def dequantize(q: QuantizedArray) -> jax.Array:
+    n = int(np.prod(q.shape))
+    if q.qtype == "int8":
+        flat = q.data.astype(jnp.float32).reshape(-1, q.block_size)
+        vals = flat * (q.scales[:, None] / 127.0)
+    else:
+        code = jnp.asarray(NF4_CODE if q.qtype == "nf4" else FP4_CODE)
+        hi = (q.data >> 4).astype(jnp.int32)
+        lo = (q.data & 0xF).astype(jnp.int32)
+        idx = jnp.stack([hi, lo], axis=1).reshape(-1)
+        vals = code[idx].reshape(-1, q.block_size) * q.scales[:, None]
+    return vals.reshape(-1)[:n].reshape(q.shape).astype(q.out_dtype)
+
+
+def quantize_array(x, config: BnbQuantizationConfig, out_dtype=jnp.bfloat16) -> QuantizedArray:
+    x = jnp.asarray(x)
+    if config.load_in_8bit:
+        data, scales = quantize_blockwise_int8(x, config.block_size)
+        qtype = "int8"
+    else:
+        data, scales = quantize_blockwise_4bit(x, config.block_size, config.bnb_4bit_quant_type)
+        qtype = config.bnb_4bit_quant_type
+    return QuantizedArray(data, scales, tuple(x.shape), qtype, config.block_size, out_dtype)
+
+
+def _matches(path: str, names: Optional[list[str]]) -> bool:
+    return bool(names) and any(re.search(n, path) for n in names)
+
+
+def quantize_params(params: Any, config: BnbQuantizationConfig) -> Any:
+    """Quantize every >=2-D floating parameter in a pytree.
+
+    ``skip_modules`` / ``keep_in_fp32_modules`` filter by path substring-regex,
+    mirroring the reference's module-name filters (``utils/bnb.py:44-130``;
+    1-D params — norms, biases — always stay in full precision, as bnb keeps
+    non-Linear weights unquantized).  ``keep_in_fp32_modules`` additionally
+    upcasts the matching leaves to fp32 (reference casts them to torch.float32).
+    """
+    out_dtype = _parse_compute_dtype(config.bnb_4bit_compute_dtype)
+
+    def one(kp, leaf):
+        path = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in kp)
+        if not hasattr(leaf, "shape") or len(np.shape(leaf)) < 2:
+            return leaf
+        if not jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+            return leaf
+        if _matches(path, config.keep_in_fp32_modules):
+            return jnp.asarray(leaf, jnp.float32)
+        if _matches(path, config.skip_modules):
+            return leaf
+        return quantize_array(leaf, config, out_dtype)
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def _parse_compute_dtype(dtype) -> Any:
+    """Accept reference-style values: torch dtypes, 'bf16'/'fp16'/'fp32' strings
+    (fp16 maps to bf16 — no TPU fp16 hardware path), or jnp dtypes."""
+    if dtype is None:
+        return jnp.bfloat16
+    s = str(dtype).replace("torch.", "").lower()
+    if s in ("bf16", "bfloat16", "fp16", "float16", "half"):
+        return jnp.bfloat16
+    if s in ("fp32", "float32", "float"):
+        return jnp.float32
+    try:
+        return jnp.dtype(s)
+    except TypeError:
+        raise ValueError(f"Unrecognized bnb_4bit_compute_dtype {dtype!r}")
+
+
+def dequantize_params(params: Any) -> Any:
+    """Materialize a full-precision pytree (QuantizedArray leaves dequantized)."""
+    return jax.tree_util.tree_map(
+        lambda p: p.dequantize() if isinstance(p, QuantizedArray) else p,
+        params,
+        is_leaf=lambda p: isinstance(p, QuantizedArray),
+    )
+
+
+def load_and_quantize_model(
+    model,
+    bnb_quantization_config: BnbQuantizationConfig,
+    weights_location: Optional[str] = None,
+    device_map: Optional[Any] = None,
+    no_split_module_classes: Optional[list] = None,
+    offload_folder: Optional[str] = None,
+    offload_state_dict: bool = False,
+):
+    """Quantize a model's weights for inference (reference ``utils/bnb.py:44``).
+
+    Accepts a torch module (lowered through the torch bridge) or a params
+    pytree.  Returns ``(apply_fn, quantized_params)`` where ``apply_fn``
+    dequantizes inside jit — storage stays 8/4-bit, compute runs bf16.  With
+    ``weights_location``, weights stream from the checkpoint before quantizing
+    (so the fp32 model never fully materializes in HBM).
+
+    When ``skip_modules`` is unset, the output head / tied embeddings are kept
+    in full precision (reference ``get_keys_to_not_convert``: quantizing the
+    logit projection costs disproportionate quality).
+    """
+    from .imports import is_torch_available
+
+    if is_torch_available():
+        import torch
+
+        if isinstance(model, torch.nn.Module):
+            from .modeling import load_checkpoint_in_model
+            from .torch_bridge import lower_module
+
+            if bnb_quantization_config.skip_modules is None:
+                bnb_quantization_config.skip_modules = _default_keys_to_not_convert(model)
+            if weights_location is not None:
+                load_checkpoint_in_model(model, weights_location, device_map=device_map)
+            lowered = lower_module(model)
+            params = quantize_params(lowered.params, bnb_quantization_config)
+            buffers = lowered.buffers
+
+            def apply_fn(qparams, *args, **kwargs):
+                return lowered.apply(dequantize_params(qparams), buffers, *args, **kwargs)
+
+            return apply_fn, params
+    # Raw pytree path (JAX-native models).
+    if bnb_quantization_config.skip_modules is None:
+        bnb_quantization_config.skip_modules = ["lm_head", "embed", r"\bwte\b", r"\bshared\b"]
+    params = quantize_params(model, bnb_quantization_config)
+    return dequantize_params, params
+
+
+def _default_keys_to_not_convert(torch_model) -> list[str]:
+    """Module names to keep in full precision: anything tied to the input
+    embedding plus the final leaf module (reference ``get_keys_to_not_convert``,
+    ``utils/bnb.py:200-250``)."""
+    names = []
+    tied_ptrs = set()
+    get_in = getattr(torch_model, "get_input_embeddings", None)
+    if callable(get_in):
+        try:
+            emb = get_in()
+            if emb is not None:
+                tied_ptrs.add(emb.weight.data_ptr())
+        except Exception:
+            pass
+    last_name = None
+    for name, module in torch_model.named_modules():
+        w = getattr(module, "weight", None)
+        if w is None or not len(list(module.children())) == 0:
+            continue
+        last_name = name or last_name
+        if hasattr(w, "data_ptr") and w.data_ptr() in tied_ptrs:
+            names.append(re.escape(name) if name else name)
+    if last_name:
+        names.append(re.escape(last_name))
+    return [n for n in names if n]
