@@ -251,14 +251,17 @@ def load_and_quantize_model(
     no_split_module_classes: Optional[list] = None,
     offload_folder: Optional[str] = None,
     offload_state_dict: bool = False,
+    apply_fn: Optional[Any] = None,
 ):
     """Quantize a model's weights for inference (reference ``utils/bnb.py:44``).
 
     Accepts a torch module (lowered through the torch bridge) or a params
-    pytree.  Returns ``(apply_fn, quantized_params)`` where ``apply_fn``
-    dequantizes inside jit — storage stays 8/4-bit, compute runs bf16.  With
-    ``weights_location``, weights stream from the checkpoint before quantizing
-    (so the fp32 model never fully materializes in HBM).
+    pytree with its ``apply_fn``.  Returns ``(apply_fn, quantized_params)``
+    where ``apply_fn(qparams, *inputs)`` dequantizes inside jit — quantized
+    storage stays 8/4-bit, compute runs bf16.  (The caller's original
+    full-precision objects — torch module or input pytree — remain theirs to
+    free.)  With ``weights_location``, weights stream from the checkpoint
+    before quantizing.
 
     When ``skip_modules`` is unset, the output head / tied embeddings are kept
     in full precision (reference ``get_keys_to_not_convert``: quantizing the
@@ -273,29 +276,54 @@ def load_and_quantize_model(
             from .modeling import load_checkpoint_in_model
             from .torch_bridge import lower_module
 
-            if bnb_quantization_config.skip_modules is None:
-                bnb_quantization_config.skip_modules = _default_keys_to_not_convert(model)
+            config = bnb_quantization_config
+            if config.skip_modules is None:
+                config = dataclasses.replace(
+                    config, skip_modules=_default_keys_to_not_convert(model)
+                )
             if weights_location is not None:
                 load_checkpoint_in_model(model, weights_location, device_map=device_map)
             lowered = lower_module(model)
-            params = quantize_params(lowered.params, bnb_quantization_config)
+            params = quantize_params(lowered.params, config)
             buffers = lowered.buffers
+            graph_apply = lowered.apply
+            # Drop the lowered full-precision params so the closure doesn't pin
+            # an fp32 copy alongside the quantized one.
+            lowered.params = None
 
-            def apply_fn(qparams, *args, **kwargs):
-                return lowered.apply(dequantize_params(qparams), buffers, *args, **kwargs)
+            def quantized_apply(qparams, *args, **kwargs):
+                return graph_apply(dequantize_params(qparams), buffers, *args, **kwargs)
 
-            return apply_fn, params
-    # Raw pytree path (JAX-native models).
-    if bnb_quantization_config.skip_modules is None:
-        bnb_quantization_config.skip_modules = ["lm_head", "embed", r"\bwte\b", r"\bshared\b"]
-    params = quantize_params(model, bnb_quantization_config)
-    return dequantize_params, params
+            return quantized_apply, params
+    # Raw pytree path (JAX-native models): caller supplies its apply function.
+    config = bnb_quantization_config
+    if config.skip_modules is None:
+        config = dataclasses.replace(
+            config, skip_modules=[r"(^|[./])lm_head", r"(^|[./])embed", r"(^|[./])wte($|[./])",
+                                  r"(^|[./])shared($|[./])"]
+        )
+    params = quantize_params(model, config)
+    if apply_fn is None:
+        raise ValueError(
+            "For a params pytree, pass apply_fn=<your model's apply function>; "
+            "it will be wrapped to dequantize inside jit."
+        )
+
+    def quantized_apply(qparams, *args, **kwargs):
+        return apply_fn(dequantize_params(qparams), *args, **kwargs)
+
+    return quantized_apply, params
 
 
 def _default_keys_to_not_convert(torch_model) -> list[str]:
     """Module names to keep in full precision: anything tied to the input
     embedding plus the final leaf module (reference ``get_keys_to_not_convert``,
-    ``utils/bnb.py:200-250``)."""
+    ``utils/bnb.py:200-250``).  Names are anchored on path-separator boundaries
+    so short names (Sequential indices like "2") don't over-match."""
+
+    def anchored(name: str) -> str:
+        return rf"(^|[./]){re.escape(name)}($|[./])"
+
     names = []
     tied_ptrs = set()
     get_in = getattr(torch_model, "get_input_embeddings", None)
@@ -312,8 +340,8 @@ def _default_keys_to_not_convert(torch_model) -> list[str]:
         if w is None or not len(list(module.children())) == 0:
             continue
         last_name = name or last_name
-        if hasattr(w, "data_ptr") and w.data_ptr() in tied_ptrs:
-            names.append(re.escape(name) if name else name)
+        if name and hasattr(w, "data_ptr") and w.data_ptr() in tied_ptrs:
+            names.append(anchored(name))
     if last_name:
-        names.append(re.escape(last_name))
-    return [n for n in names if n]
+        names.append(anchored(last_name))
+    return names

@@ -141,10 +141,32 @@ def test_load_and_quantize_torch_model():
         qparams, is_leaf=lambda p: isinstance(p, QuantizedArray)
     )
     assert any(isinstance(l, QuantizedArray) for l in leaves)
-    # Default keys-to-not-convert: the final (output) layer stays full precision.
-    assert cfg.skip_modules is not None
+    # Default keys-to-not-convert: the final (output) layer stays full precision,
+    # and the caller's config is NOT mutated.
+    assert cfg.skip_modules is None
+    flat = {
+        "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in kp): v
+        for kp, v in jax.tree_util.tree_flatten_with_path(
+            qparams, is_leaf=lambda p: isinstance(p, QuantizedArray)
+        )[0]
+    }
+    head_keys = [k for k in flat if k.startswith("2")]
+    assert head_keys and all(not isinstance(flat[k], QuantizedArray) for k in head_keys)
     x = jnp.asarray(np.random.randn(4, 16).astype(np.float32))
     y = apply_fn(qparams, x)
     with torch.no_grad():
         y_ref = model(torch.from_numpy(np.asarray(x))).numpy()
     np.testing.assert_allclose(np.asarray(y, np.float32), y_ref, rtol=0.1, atol=0.05)
+
+
+def test_load_and_quantize_pytree_requires_apply_fn():
+    params = {"w": jnp.ones((16, 16))}
+    with pytest.raises(ValueError, match="apply_fn"):
+        load_and_quantize_model(params, BnbQuantizationConfig(load_in_8bit=True))
+    qapply, qparams = load_and_quantize_model(
+        params,
+        BnbQuantizationConfig(load_in_8bit=True),
+        apply_fn=lambda p, x: x @ p["w"],
+    )
+    y = qapply(qparams, jnp.ones((2, 16)))
+    np.testing.assert_allclose(np.asarray(y), 16.0, rtol=0.02)

@@ -1,0 +1,102 @@
+"""Utility-layer tests: OOM retry, logging adapter, kwargs handlers.
+
+Parity targets: reference ``tests/test_memory_utils.py``,
+``tests/test_logging.py``, ``tests/test_kwargs_handlers.py``.
+"""
+
+import logging
+
+import pytest
+
+from accelerate_tpu.logging import get_logger
+from accelerate_tpu.utils import AutocastKwargs, FP8RecipeKwargs, GradScalerKwargs
+from accelerate_tpu.utils.memory import (
+    find_executable_batch_size,
+    release_memory,
+    should_reduce_batch_size,
+)
+
+
+class FakeOOM(RuntimeError):
+    def __init__(self):
+        super().__init__("RESOURCE_EXHAUSTED: Out of memory allocating 1234 bytes")
+
+
+def test_find_executable_batch_size_halves_until_fit():
+    sizes = []
+
+    @find_executable_batch_size(starting_batch_size=128)
+    def run(batch_size):
+        sizes.append(batch_size)
+        if batch_size > 16:
+            raise FakeOOM()
+        return batch_size
+
+    assert run() == 16
+    assert sizes == [128, 64, 32, 16]
+
+
+def test_find_executable_batch_size_propagates_other_errors():
+    @find_executable_batch_size(starting_batch_size=8)
+    def run(batch_size):
+        raise ValueError("shape mismatch in layer")
+
+    with pytest.raises(ValueError, match="shape mismatch in layer"):
+        run()
+
+
+def test_find_executable_batch_size_reaches_zero():
+    @find_executable_batch_size(starting_batch_size=4)
+    def run(batch_size):
+        raise FakeOOM()
+
+    with pytest.raises(RuntimeError, match="No executable batch size"):
+        run()
+
+
+def test_find_executable_batch_size_first_arg_contract():
+    @find_executable_batch_size(starting_batch_size=8)
+    def run(batch_size, x):
+        return batch_size + x
+
+    assert run(1) == 9
+    with pytest.raises(TypeError, match="as the first argument"):
+        run(1, 2)
+
+
+def test_should_reduce_batch_size_patterns():
+    assert should_reduce_batch_size(RuntimeError("RESOURCE_EXHAUSTED: ..."))
+    assert should_reduce_batch_size(RuntimeError("CUDA out of memory"))
+    assert not should_reduce_batch_size(ValueError("shape mismatch"))
+
+
+def test_release_memory_clears_references():
+    a, b = object(), object()
+    out = release_memory(a, b)
+    assert out == [None, None]
+
+
+def test_logger_main_process_only(caplog):
+    logger = get_logger("atpu_test_logger", log_level="INFO")
+    with caplog.at_level(logging.INFO, logger="atpu_test_logger"):
+        logger.info("hello-main", main_process_only=True)
+        logger.info("hello-all", main_process_only=False)
+    # Single process == main process: both messages pass.
+    assert "hello-main" in caplog.text and "hello-all" in caplog.text
+
+
+def test_logger_warning_once(caplog):
+    logger = get_logger("atpu_once_logger", log_level="WARNING")
+    with caplog.at_level(logging.WARNING, logger="atpu_once_logger"):
+        logger.warning_once("only-once")
+        logger.warning_once("only-once")
+    assert caplog.text.count("only-once") == 1
+
+
+def test_kwargs_handler_to_kwargs_diffs_defaults():
+    assert AutocastKwargs().to_kwargs() == {}
+    assert AutocastKwargs(enabled=False).to_kwargs() == {"enabled": False}
+    scaler = GradScalerKwargs(init_scale=1024.0, growth_interval=4000)
+    kw = scaler.to_kwargs()
+    assert kw == {"init_scale": 1024.0, "growth_interval": 4000}
+    assert FP8RecipeKwargs(margin=2).to_kwargs() == {"margin": 2}
