@@ -199,6 +199,9 @@ def dispatch_model(
             if disk_sd:
                 os.makedirs(offload_dir, exist_ok=True)
                 offload_state_dict(offload_dir, disk_sd)
+                # Disk-tier weights must not stay pinned in host RAM — the whole
+                # point of the tier (the loader falls back to the .dat files).
+                state_dict = {n: v for n, v in state_dict.items() if n not in disk_sd}
         weights_map = OffloadedWeightsLoader(state_dict=state_dict, save_folder=offload_dir)
 
     # Every tier stages on host ("cpu"): "tpu" blocks are host-resident too — the
@@ -213,6 +216,10 @@ def dispatch_model(
         weights_map=weights_map,
         offload_buffers=offload_buffers,
     )
+    if weights_map is not None:
+        from .hooks import wire_sequential_prefetch
+
+        wire_sequential_prefetch(model)
     model.hf_device_map = device_map
     # Poison .to() like the reference (big_modeling.py:489-507).
     if any(tier in ("cpu", "disk") for tier in device_map.values()):

@@ -198,6 +198,9 @@ class AlignDevicesHook(ModelHook):
         self.place_submodules = place_submodules
         self.original_devices = {}
         self.input_device = None
+        # Weight keys of upcoming block(s), queued on the native prefetch pool
+        # at this block's pre_forward (wired by wire_sequential_prefetch).
+        self.prefetch_next: list = []
 
     def init_hook(self, module):
         if self.offload:
@@ -225,6 +228,12 @@ class AlignDevicesHook(ModelHook):
             first = next((a for a in args if isinstance(a, torch.Tensor)), None)
             self.input_device = first.device if first is not None else None
         if self.offload:
+            if self.prefetch_next and hasattr(self.weights_map, "prefetch"):
+                # Queue the NEXT block's disk reads before staging this block's
+                # weights: the pool's worker threads overlap that IO with this
+                # block's copy + compute (vs the reference's per-block blocking
+                # load, hooks.py:328-371).
+                self.weights_map.prefetch(self.prefetch_next)
             prefix = getattr(module, "_hook_weights_prefix", "")
             for name, _ in named_module_tensors(
                 module, include_buffers=self.offload_buffers, recurse=self.place_submodules
@@ -335,6 +344,41 @@ def attach_align_device_hook_on_blocks(
             offload_buffers=offload_buffers,
             module_name=full,
         )
+
+
+def _iter_hooks(hook):
+    if isinstance(hook, SequentialHook):
+        yield from hook.hooks
+    elif hook is not None:
+        yield hook
+
+
+def wire_sequential_prefetch(model, depth: int = 1) -> int:
+    """Chain offloading AlignDevicesHooks so each block's pre_forward queues the
+    next ``depth`` blocks' weight files on the prefetch pool.
+
+    Forward order is approximated by registration (module-tree) order — the
+    order attach_align_device_hook walks, which matches execution for the
+    sequential block structure device maps describe.  Returns the number of
+    hooks wired."""
+    hooked = []
+    for _, mod in model.named_modules():
+        for h in _iter_hooks(getattr(mod, "_hf_hook", None)):
+            if isinstance(h, AlignDevicesHook) and h.offload:
+                prefix = getattr(mod, "_hook_weights_prefix", "")
+                keys = [
+                    prefix + name
+                    for name, _ in named_module_tensors(
+                        mod, include_buffers=h.offload_buffers, recurse=h.place_submodules
+                    )
+                ]
+                hooked.append((h, keys))
+    for i, (h, _) in enumerate(hooked):
+        nxt: list = []
+        for j in range(i + 1, min(i + 1 + depth, len(hooked))):
+            nxt.extend(hooked[j][1])
+        h.prefetch_next = nxt
+    return len(hooked)
 
 
 class CpuOffload(ModelHook):

@@ -1,0 +1,206 @@
+"""ctypes bindings for the native tensorstore (``_native/tensorstore.cpp``).
+
+The shared library is compiled with g++ on first use (cached next to the
+source); every entry point has a pure-Python fallback so the package works
+without a toolchain (``ACCELERATE_TPU_DISABLE_NATIVE=1`` forces the fallback).
+
+Role: fast streaming of offloaded weight shards + a background prefetch pool
+that overlaps the next block's disk read with the current block's compute
+(consumed by ``utils/offload.py`` and the big-model dispatch hooks).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from .environment import parse_flag_from_env
+
+__all__ = ["native_available", "write_bytes", "read_bytes", "PrefetchPool"]
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "_native")
+_SRC = os.path.join(_NATIVE_DIR, "tensorstore.cpp")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libtensorstore.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def _compile() -> bool:
+    # Compile to a process-unique temp file and rename atomically: N worker
+    # processes racing on first use must never CDLL a partially written .so.
+    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        _SRC, "-o", tmp,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=180)
+        if proc.returncode != 0 or not os.path.exists(tmp):
+            return False
+        os.replace(tmp, _LIB_PATH)
+        return True
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def _load():
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        if parse_flag_from_env("ACCELERATE_TPU_DISABLE_NATIVE"):
+            _build_failed = True
+            return None
+        if not os.path.exists(_LIB_PATH) or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC):
+            if not _compile():
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            _build_failed = True
+            return None
+        lib.ts_write.argtypes = [ctypes.c_char_p, ctypes.c_void_p, ctypes.c_uint64]
+        lib.ts_write.restype = ctypes.c_int
+        lib.ts_read.argtypes = [ctypes.c_char_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64]
+        lib.ts_read.restype = ctypes.c_int
+        lib.ts_file_size.argtypes = [ctypes.c_char_p]
+        lib.ts_file_size.restype = ctypes.c_int64
+        lib.ts_pool_create.argtypes = [ctypes.c_int]
+        lib.ts_pool_create.restype = ctypes.c_void_p
+        lib.ts_pool_destroy.argtypes = [ctypes.c_void_p]
+        lib.ts_pool_prefetch.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ts_pool_prefetch.restype = ctypes.c_int
+        lib.ts_pool_fetch.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_uint64,
+        ]
+        lib.ts_pool_fetch.restype = ctypes.c_int64
+        lib.ts_pool_pending.argtypes = [ctypes.c_void_p]
+        lib.ts_pool_pending.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def write_bytes(path: str, data: np.ndarray) -> None:
+    """Write a contiguous array's bytes to ``path`` (native when available)."""
+    arr = np.ascontiguousarray(data)
+    lib = _load()
+    if lib is None:
+        arr.tofile(path)
+        return
+    rc = lib.ts_write(path.encode(), arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes)
+    if rc != 0:
+        raise OSError(f"native write failed for {path}")
+
+
+def read_bytes(path: str, nbytes: int, offset: int = 0) -> np.ndarray:
+    """Read ``nbytes`` from ``path`` into a fresh uint8 array."""
+    lib = _load()
+    out = np.empty(nbytes, np.uint8)
+    if lib is None:
+        with open(path, "rb") as f:
+            f.seek(offset)
+            buf = f.read(nbytes)
+        if len(buf) < nbytes:
+            raise OSError(f"short read from {path}: wanted {nbytes}, got {len(buf)}")
+        out[:] = np.frombuffer(buf, np.uint8)
+        return out
+    rc = lib.ts_read(path.encode(), out.ctypes.data_as(ctypes.c_void_p), nbytes, offset)
+    if rc != 0:
+        raise OSError(f"native read failed for {path}")
+    return out
+
+
+class PrefetchPool:
+    """Background file prefetcher.
+
+    ``prefetch(path)`` queues an async full-file load on a worker thread;
+    ``fetch(path, nbytes)`` blocks until the bytes are ready (or reads
+    synchronously if never queued).  Python-threads fallback when the native
+    library is unavailable.
+    """
+
+    def __init__(self, num_threads: int = 2):
+        self._lib = _load()
+        self._num_threads = max(1, num_threads)
+        if self._lib is not None:
+            self._pool = self._lib.ts_pool_create(self._num_threads)
+        else:
+            import concurrent.futures
+
+            self._executor = concurrent.futures.ThreadPoolExecutor(self._num_threads)
+            self._futures: dict[str, object] = {}
+            self._flock = threading.Lock()
+
+    def prefetch(self, path: str) -> None:
+        if self._lib is not None:
+            self._lib.ts_pool_prefetch(self._pool, path.encode())
+            return
+        with self._flock:
+            if path not in self._futures:
+                self._futures[path] = self._executor.submit(self._read_all, path)
+
+    @staticmethod
+    def _read_all(path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def fetch(self, path: str, nbytes: int) -> np.ndarray:
+        out = np.empty(nbytes, np.uint8)
+        if self._lib is not None:
+            got = self._lib.ts_pool_fetch(
+                self._pool, path.encode(), out.ctypes.data_as(ctypes.c_void_p), nbytes
+            )
+            if got < 0:
+                raise OSError(f"prefetch fetch failed for {path}")
+            if got < nbytes:
+                # A truncated file must fail loudly — a silently garbage-tailed
+                # weight tensor is the worst possible outcome.
+                raise OSError(f"short read from {path}: wanted {nbytes}, got {got}")
+            return out
+        with self._flock:
+            fut = self._futures.pop(path, None)
+        buf = fut.result() if fut is not None else self._read_all(path)
+        if len(buf) < nbytes:
+            raise OSError(f"short read from {path}: wanted {nbytes}, got {len(buf)}")
+        out[:] = np.frombuffer(buf[:nbytes], np.uint8)
+        return out
+
+    def pending(self) -> int:
+        if self._lib is not None:
+            return int(self._lib.ts_pool_pending(self._pool))
+        with self._flock:
+            return sum(1 for f in self._futures.values() if not f.done())
+
+    def close(self) -> None:
+        if self._lib is not None:
+            if getattr(self, "_pool", None):
+                self._lib.ts_pool_destroy(self._pool)
+                self._pool = None
+        else:
+            self._executor.shutdown(wait=False)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

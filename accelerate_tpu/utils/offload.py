@@ -86,13 +86,19 @@ def offload_state_dict(save_dir: str, state_dict: Mapping) -> None:
 
 class OffloadedWeightsLoader(Mapping):
     """Lazy Mapping over weights living in {in-memory state dict} ∪ {offload
-    folder} ∪ {safetensors files} (reference ``offload.py:127-191``)."""
+    folder} ∪ {safetensors files} (reference ``offload.py:127-191``).
+
+    ``prefetch(keys)`` queues background disk reads on the native prefetch pool
+    (``utils/native_io.py``) so a dispatch hook can overlap the next block's IO
+    with the current block's compute — the reference's blocking per-block copy
+    (``hooks.py:328-371``) is the latency this removes."""
 
     def __init__(
         self,
         state_dict: Optional[dict] = None,
         save_folder: Optional[str] = None,
         index: Optional[dict] = None,
+        prefetch_threads: int = 2,
     ):
         if state_dict is None and save_folder is None and index is None:
             raise ValueError("Need either a state_dict or a save_folder")
@@ -103,6 +109,27 @@ class OffloadedWeightsLoader(Mapping):
         self.index = index or {}
         self.all_keys = list(self.state_dict.keys())
         self.all_keys.extend(k for k in self.index if k not in self.all_keys)
+        self._prefetch_threads = prefetch_threads
+        self._pool = None
+        self._prefetched: set = set()
+
+    def _weight_file(self, key: str) -> str:
+        return os.path.join(self.save_folder, f"{key}.dat")
+
+    def prefetch(self, keys) -> None:
+        """Queue async loads of offloaded ``.dat`` weights."""
+        if self.save_folder is None:
+            return
+        from .native_io import PrefetchPool
+
+        if self._pool is None:
+            self._pool = PrefetchPool(self._prefetch_threads)
+        for key in keys:
+            info = self.index.get(key)
+            if info is None or key in self.state_dict or info.get("safetensors_file"):
+                continue
+            self._pool.prefetch(self._weight_file(key))
+            self._prefetched.add(key)
 
     def __getitem__(self, key: str):
         if key in self.state_dict:
@@ -113,7 +140,22 @@ class OffloadedWeightsLoader(Mapping):
 
             with safe_open(weight_info["safetensors_file"], framework="np") as f:
                 return f.get_tensor(weight_info.get("weight_name", key))
-        weight_file = os.path.join(self.save_folder, f"{key}.dat")
+        weight_file = self._weight_file(key)
+        if key in self._prefetched:
+            self._prefetched.discard(key)
+            shape = tuple(weight_info["shape"]) or (1,)
+            dtype = weight_info["dtype"]
+            save_dtype = np.dtype("uint16" if dtype == "bfloat16" else dtype)
+            nbytes = int(np.prod(shape)) * save_dtype.itemsize
+            raw = self._pool.fetch(weight_file, nbytes)
+            arr = raw.view(save_dtype).reshape(shape)
+            if not weight_info["shape"]:
+                arr = arr[0]
+            if dtype == "bfloat16":
+                import jax.numpy as jnp
+
+                return arr.view(jnp.bfloat16.dtype)
+            return arr
         return load_offloaded_weight(weight_file, weight_info)
 
     def __iter__(self):

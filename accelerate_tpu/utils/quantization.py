@@ -258,10 +258,10 @@ def load_and_quantize_model(
     Accepts a torch module (lowered through the torch bridge) or a params
     pytree with its ``apply_fn``.  Returns ``(apply_fn, quantized_params)``
     where ``apply_fn(qparams, *inputs)`` dequantizes inside jit — quantized
-    storage stays 8/4-bit, compute runs bf16.  (The caller's original
-    full-precision objects — torch module or input pytree — remain theirs to
-    free.)  With ``weights_location``, weights stream from the checkpoint
-    before quantizing.
+    storage stays 8/4-bit, compute runs bf16.  A torch module is converted
+    DESTRUCTIVELY (its parameter storage is released), matching the reference's
+    in-place Linear swap; a params pytree input is left untouched.  With
+    ``weights_location``, weights stream from the checkpoint before quantizing.
 
     When ``skip_modules`` is unset, the output head / tied embeddings are kept
     in full precision (reference ``get_keys_to_not_convert``: quantizing the
@@ -287,9 +287,14 @@ def load_and_quantize_model(
             params = quantize_params(lowered.params, config)
             buffers = lowered.buffers
             graph_apply = lowered.apply
-            # Drop the lowered full-precision params so the closure doesn't pin
-            # an fp32 copy alongside the quantized one.
+            # Release the full-precision copies: the lowered JAX params AND the
+            # torch parameter storage (shared by model and its fx GraphModule).
+            # In-place release matches the reference, whose load_and_quantize_
+            # model also converts the input module destructively.
             lowered.params = None
+            with torch.no_grad():
+                for p in model.parameters():
+                    p.data = torch.empty(0, dtype=p.dtype)
 
             def quantized_apply(qparams, *args, **kwargs):
                 return graph_apply(dequantize_params(qparams), buffers, *args, **kwargs)
@@ -322,7 +327,10 @@ def _default_keys_to_not_convert(torch_model) -> list[str]:
     so short names (Sequential indices like "2") don't over-match."""
 
     def anchored(name: str) -> str:
-        return rf"(^|[./]){re.escape(name)}($|[./])"
+        # Anchor at the path START: module names here are full paths from the
+        # root, and a mid-path match would make numeric Sequential names (e.g.
+        # "2") over-match every index-2 child of every ModuleList.
+        return rf"^{re.escape(name)}($|[./])"
 
     names = []
     tied_ptrs = set()

@@ -135,8 +135,13 @@ def test_load_and_quantize_torch_model():
     import torch
 
     model = torch.nn.Sequential(torch.nn.Linear(16, 32), torch.nn.ReLU(), torch.nn.Linear(32, 4))
+    x = jnp.asarray(np.random.randn(4, 16).astype(np.float32))
+    with torch.no_grad():
+        y_ref = model(torch.from_numpy(np.asarray(x))).numpy()
     cfg = BnbQuantizationConfig(load_in_8bit=True)
     apply_fn, qparams = load_and_quantize_model(model, cfg)
+    # Conversion is destructive (reference parity): torch storage released.
+    assert sum(p.numel() for p in model.parameters()) == 0
     leaves = jax.tree_util.tree_leaves(
         qparams, is_leaf=lambda p: isinstance(p, QuantizedArray)
     )
@@ -152,10 +157,7 @@ def test_load_and_quantize_torch_model():
     }
     head_keys = [k for k in flat if k.startswith("2")]
     assert head_keys and all(not isinstance(flat[k], QuantizedArray) for k in head_keys)
-    x = jnp.asarray(np.random.randn(4, 16).astype(np.float32))
     y = apply_fn(qparams, x)
-    with torch.no_grad():
-        y_ref = model(torch.from_numpy(np.asarray(x))).numpy()
     np.testing.assert_allclose(np.asarray(y, np.float32), y_ref, rtol=0.1, atol=0.05)
 
 
