@@ -7,9 +7,11 @@ import argparse
 from . import config as config_cmd
 from . import env as env_cmd
 from . import estimate as estimate_cmd
+from . import from_accelerate as from_accelerate_cmd
 from . import launch as launch_cmd
 from . import merge as merge_cmd
 from . import test as test_cmd
+from . import tpu as tpu_cmd
 
 
 def main():
@@ -23,6 +25,8 @@ def main():
     estimate_cmd.register_subcommand(subparsers)
     merge_cmd.register_subcommand(subparsers)
     test_cmd.register_subcommand(subparsers)
+    tpu_cmd.register_subcommand(subparsers)
+    from_accelerate_cmd.register_subcommand(subparsers)
 
     args = parser.parse_args()
     if not hasattr(args, "func"):

@@ -43,6 +43,9 @@ class ClusterConfig:
     fsdp_sharding_strategy: str = "FULL_SHARD"
     fsdp_min_num_params: int = 0
     downcast_bf16: bool = False
+    # Pod management (consumed by `accelerate-tpu tpu-config`).
+    tpu_name: Optional[str] = None
+    tpu_zone: Optional[str] = None
 
     def to_dict(self) -> dict:
         return asdict(self)

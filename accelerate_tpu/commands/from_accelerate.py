@@ -1,0 +1,95 @@
+"""``accelerate-tpu from-accelerate`` — migrate an HF Accelerate config YAML.
+
+The analog of the reference's ``accelerate to-fsdp2`` migrator
+(``commands/to_fsdp2.py``, 172 LoC): reads a reference
+``default_config.yaml`` and writes our ``ClusterConfig``, mapping each engine
+choice onto the GSPMD mesh —
+
+- MULTI_GPU / MULTI_CPU / MULTI_XPU etc.  -> plain dp (all devices)
+- FSDP (v1 or v2) + fsdp_config          -> fsdp axis + sharding strategy
+- DEEPSPEED + zero stage                 -> fsdp axis (stage>=1 shards)
+- MEGATRON_LM + tp/pp degrees            -> tp/pp axes
+- TP (torch tensor parallel)             -> tp axis
+- mixed_precision / gradient accumulation carried over verbatim
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import yaml
+
+from .config import ClusterConfig, save_config
+
+__all__ = ["register_subcommand", "from_accelerate_command", "convert_config"]
+
+_DESCRIPTION = "Convert an HF Accelerate config yaml to an accelerate-tpu config"
+
+
+def register_subcommand(subparsers):
+    parser = subparsers.add_parser(
+        "from-accelerate", description=_DESCRIPTION, help=_DESCRIPTION
+    )
+    parser.add_argument("config_file", type=str, help="Path to the reference accelerate yaml")
+    parser.add_argument("--output_file", type=str, default=None, help="Where to write ours")
+    parser.add_argument(
+        "--overwrite", action="store_true", help="Allow overwriting the output file"
+    )
+    parser.set_defaults(func=from_accelerate_command)
+
+
+def convert_config(src: dict) -> ClusterConfig:
+    cfg = ClusterConfig()
+    dist = str(src.get("distributed_type", "NO")).upper()
+    cfg.mixed_precision = str(src.get("mixed_precision", "no"))
+    cfg.num_machines = int(src.get("num_machines", 1))
+    cfg.machine_rank = int(src.get("machine_rank", 0))
+    cfg.main_process_ip = src.get("main_process_ip")
+    port = src.get("main_process_port")
+    cfg.main_process_port = int(port) if port not in (None, "") else None
+    cfg.gradient_accumulation_steps = int(src.get("gradient_accumulation_steps", 1))
+
+    if dist in ("FSDP",):
+        cfg.use_fsdp = True
+        cfg.fsdp = 0  # all devices
+        fsdp_cfg = src.get("fsdp_config", {}) or {}
+        strategy = str(
+            fsdp_cfg.get("fsdp_sharding_strategy", fsdp_cfg.get("sharding_strategy", "FULL_SHARD"))
+        ).upper()
+        int_map = {"1": "FULL_SHARD", "2": "SHARD_GRAD_OP", "3": "NO_SHARD", "4": "HYBRID_SHARD"}
+        cfg.fsdp_sharding_strategy = int_map.get(strategy, strategy)
+        cfg.fsdp_min_num_params = int(fsdp_cfg.get("fsdp_min_num_params", 0))
+    elif dist == "DEEPSPEED":
+        ds_cfg = src.get("deepspeed_config", {}) or {}
+        stage = int(ds_cfg.get("zero_stage", 2))
+        cfg.use_fsdp = stage >= 1
+        cfg.fsdp = 0 if stage >= 1 else 1
+        cfg.fsdp_sharding_strategy = "FULL_SHARD" if stage == 3 else "SHARD_GRAD_OP"
+        if ds_cfg.get("gradient_accumulation_steps"):
+            cfg.gradient_accumulation_steps = int(ds_cfg["gradient_accumulation_steps"])
+    elif dist == "MEGATRON_LM":
+        mlm = src.get("megatron_lm_config", {}) or {}
+        cfg.tp = int(mlm.get("megatron_lm_tp_degree", 1))
+        cfg.pp = int(mlm.get("megatron_lm_pp_degree", 1))
+    elif dist == "TP":
+        tp_cfg = src.get("tp_config", {}) or {}
+        cfg.tp = int(tp_cfg.get("tp_size", 1))
+    # Everything else (NO/MULTI_GPU/MULTI_CPU/XLA/...) -> dp over all devices.
+    return cfg
+
+
+def from_accelerate_command(args):
+    with open(args.config_file) as f:
+        src = yaml.safe_load(f) or {}
+    cfg = convert_config(src)
+    out = args.output_file
+    if out is None:
+        out = args.config_file.replace(".yaml", ".tpu.yaml").replace(".yml", ".tpu.yml")
+        if out == args.config_file:
+            out = args.config_file + ".tpu"
+    import os
+
+    if os.path.exists(out) and not args.overwrite:
+        raise FileExistsError(f"{out} exists; pass --overwrite to replace it.")
+    path = save_config(cfg, out)
+    print(f"Converted {args.config_file} -> {path}")

@@ -1,0 +1,91 @@
+"""``accelerate-tpu tpu-config`` — fan out setup commands to TPU pod VMs.
+
+Parity target: reference ``commands/tpu.py`` (157 LoC): wraps
+``gcloud compute tpus tpu-vm ssh --worker=all --command=...`` to install
+dependencies / run setup on every worker of a pod slice.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+
+from .config import DEFAULT_CONFIG_FILE, load_config
+
+__all__ = ["register_subcommand", "tpu_command"]
+
+_DESCRIPTION = "Run commands on a TPU pod's VMs (gcloud ssh fan-out)"
+
+
+def register_subcommand(subparsers):
+    parser = subparsers.add_parser("tpu-config", description=_DESCRIPTION, help=_DESCRIPTION)
+    parser.add_argument("--config_file", type=str, default=None, help="Config yaml to read TPU name/zone from.")
+    parser.add_argument("--tpu_name", type=str, default=None, help="TPU name (overrides config).")
+    parser.add_argument("--tpu_zone", type=str, default=None, help="TPU zone (overrides config).")
+    parser.add_argument("--command", action="append", help="Command to run on each worker (repeatable).")
+    parser.add_argument(
+        "--command_file", type=str, default=None, help="File with one command per line."
+    )
+    parser.add_argument(
+        "--install_accelerate",
+        action="store_true",
+        help="Prepend installation of this package on each worker.",
+    )
+    parser.add_argument(
+        "--accelerate_version",
+        type=str,
+        default="latest",
+        help="Version to install with --install_accelerate.",
+    )
+    parser.add_argument("--debug", action="store_true", help="Print the gcloud command instead of running it.")
+    parser.set_defaults(func=tpu_command)
+
+
+def tpu_command(args):
+    cfg = {}
+    path = args.config_file or DEFAULT_CONFIG_FILE
+    if os.path.isfile(path):
+        cfg = load_config(path).__dict__
+    tpu_name = args.tpu_name or cfg.get("tpu_name")
+    tpu_zone = args.tpu_zone or cfg.get("tpu_zone")
+    if not tpu_name or not tpu_zone:
+        raise ValueError("Pass --tpu_name and --tpu_zone (or set them in the config file).")
+
+    commands = []
+    if args.command_file:
+        with open(args.command_file) as f:
+            commands.extend(line.strip() for line in f if line.strip())
+    if args.command:
+        commands.extend(args.command)
+    if args.install_accelerate:
+        version = (
+            "accelerate-tpu"
+            if args.accelerate_version == "latest"
+            else f"accelerate-tpu=={args.accelerate_version}"
+        )
+        commands.insert(0, f"pip install {version}")
+    if not commands:
+        raise ValueError("Nothing to run: pass --command/--command_file/--install_accelerate.")
+
+    # One ssh session, commands joined — exactly the reference's fan-out shape
+    # (reference commands/tpu.py builds the same gcloud invocation).
+    joined = "; ".join(commands)
+    gcloud = [
+        "gcloud", "compute", "tpus", "tpu-vm", "ssh", tpu_name,
+        "--zone", tpu_zone, "--command", joined, "--worker", "all",
+    ]
+    if args.debug:
+        import shlex
+
+        print(shlex.join(gcloud))
+        return
+    if shutil.which("gcloud") is None:
+        raise RuntimeError(
+            "gcloud CLI not found — install the Google Cloud SDK, or use --debug to "
+            "print the command for manual execution."
+        )
+    print(f"Running {joined!r} on every worker of {tpu_name}...")
+    subprocess.run(gcloud, check=True)
+    print("Done.")

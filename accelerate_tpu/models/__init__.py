@@ -1,1 +1,1 @@
-from . import llama, mixtral
+from . import bert, gpt2, llama, mixtral

@@ -1,0 +1,211 @@
+"""BERT-style bidirectional encoder — classification/MLM model family.
+
+Parity rationale: the reference's perf/metric oracles train BERT-MRPC
+(``test_utils/scripts/external_deps/test_performance.py``; Megatron
+``BertTrainStep`` ``utils/megatron_lm.py:445``).  This native family covers the
+encoder architecture class: bidirectional attention, learned position + token
+type embeddings, LayerNorm(+bias), pooler + classification head.
+
+Same TPU-first layout as the other families: stacked per-layer params under
+``lax.scan``, bf16 compute / fp32 params, partition rules over the mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.sharding import constrain as _constrain
+from .gpt2 import _layer_norm
+
+__all__ = [
+    "BertConfig",
+    "init_params",
+    "apply",
+    "classification_loss_fn",
+    "PARTITION_RULES",
+    "param_specs",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    num_labels: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @classmethod
+    def tiny(cls, **kw) -> "BertConfig":
+        defaults = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4, max_seq_len=64)
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+PARTITION_RULES: list[tuple[str, P]] = [
+    (r"embeddings/", P(None, "fsdp")),
+    (r"layers/w_qkv", P(None, "fsdp", "tp")),
+    (r"layers/w_proj", P(None, "tp", "fsdp")),
+    (r"layers/w_up", P(None, "fsdp", "tp")),
+    (r"layers/w_down", P(None, "tp", "fsdp")),
+    (r"pooler/w", P("fsdp", "tp")),
+    (r"classifier/w", P("tp", None)),
+]
+
+
+def _param_shapes(c: BertConfig) -> dict:
+    d, L = c.hidden_size, c.num_layers
+    return {
+        "embeddings": {
+            "word": (c.vocab_size, d),
+            "position": (c.max_seq_len, d),
+            "token_type": (c.type_vocab_size, d),
+            "ln_scale": (d,),
+            "ln_bias": (d,),
+        },
+        "layers": {
+            "w_qkv": (L, d, 3 * d),
+            "b_qkv": (L, 3 * d),
+            "w_proj": (L, d, d),
+            "b_proj": (L, d),
+            "w_up": (L, d, 4 * d),
+            "b_up": (L, 4 * d),
+            "w_down": (L, 4 * d, d),
+            "b_down": (L, d),
+            "ln_attn_scale": (L, d),
+            "ln_attn_bias": (L, d),
+            "ln_mlp_scale": (L, d),
+            "ln_mlp_bias": (L, d),
+        },
+        "pooler": {"w": (d, d), "b": (d,)},
+        "classifier": {"w": (d, c.num_labels), "b": (c.num_labels,)},
+    }
+
+
+def param_specs(config: BertConfig) -> dict:
+    from ..parallel.sharding import spec_from_rules
+
+    shapes = _param_shapes(config)
+
+    def one(kp, shape):
+        path = "/".join(str(getattr(k, "key", k)) for k in kp)
+        spec = spec_from_rules(path, len(shape), PARTITION_RULES)
+        return spec if spec is not None else P(*([None] * len(shape)))
+
+    return jax.tree_util.tree_map_with_path(one, shapes, is_leaf=lambda x: isinstance(x, tuple))
+
+
+def init_params(config: BertConfig, key: jax.Array) -> dict:
+    shapes = _param_shapes(config)
+    leaves, treedef = jax.tree_util.tree_flatten(shapes, is_leaf=lambda x: isinstance(x, tuple))
+    keys = jax.random.split(key, len(leaves))
+
+    def init_one(shape, k):
+        if len(shape) == 1 or (len(shape) == 2 and shape[0] == config.num_layers):
+            return jnp.zeros(shape, config.param_dtype)
+        return (jax.random.normal(k, shape, jnp.float32) * 0.02).astype(config.param_dtype)
+
+    out = jax.tree_util.tree_unflatten(treedef, [init_one(s, k) for s, k in zip(leaves, keys)])
+    for scale_key in ("ln_attn_scale", "ln_mlp_scale"):
+        out["layers"][scale_key] = jnp.ones_like(out["layers"][scale_key])
+    out["embeddings"]["ln_scale"] = jnp.ones_like(out["embeddings"]["ln_scale"])
+    return out
+
+
+def _layer(carry, p, *, c: BertConfig, mask, act_spec):
+    x = carry
+    d, h, hd = c.hidden_size, c.num_heads, c.head_dim
+    b, s, _ = x.shape
+
+    qkv = x @ p["w_qkv"].astype(c.dtype) + p["b_qkv"].astype(c.dtype)
+    q, k, v = (t[:, :, 0] for t in jnp.split(qkv.reshape(b, s, 3, h, hd), 3, axis=2))
+    scores = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) / np.sqrt(hd)
+    scores = jnp.where(mask[:, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    attn = jnp.einsum("bhst,bthd->bshd", probs, v).reshape(b, s, d)
+    # Post-LN (original BERT): residual then LayerNorm.
+    x = _layer_norm(
+        x + attn @ p["w_proj"].astype(c.dtype) + p["b_proj"].astype(c.dtype),
+        p["ln_attn_scale"], p["ln_attn_bias"], c.layer_norm_eps,
+    )
+    u = jax.nn.gelu(x @ p["w_up"].astype(c.dtype) + p["b_up"].astype(c.dtype))
+    x = _layer_norm(
+        x + u @ p["w_down"].astype(c.dtype) + p["b_down"].astype(c.dtype),
+        p["ln_mlp_scale"], p["ln_mlp_bias"], c.layer_norm_eps,
+    )
+    if act_spec is not None:
+        x = _constrain(x, act_spec)
+    return x, None
+
+
+def apply(
+    params: dict,
+    input_ids: jax.Array,
+    config: BertConfig,
+    attention_mask: Optional[jax.Array] = None,
+    token_type_ids: Optional[jax.Array] = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (sequence_output [B, S, d] in compute dtype, pooled [B, d] fp32)."""
+    c = config
+    b, s = input_ids.shape
+    if attention_mask is None:
+        mask = jnp.ones((b, s, s), bool)
+    else:
+        valid = attention_mask.astype(bool)
+        mask = valid[:, None, :] & valid[:, :, None]
+    if token_type_ids is None:
+        token_type_ids = jnp.zeros_like(input_ids)
+
+    e = params["embeddings"]
+    x = (
+        e["word"].astype(c.dtype)[input_ids]
+        + e["position"].astype(c.dtype)[:s][None]
+        + e["token_type"].astype(c.dtype)[token_type_ids]
+    )
+    x = _layer_norm(x, e["ln_scale"], e["ln_bias"], c.layer_norm_eps)
+    act_spec = P(("dcn_dp", "dp", "fsdp"), "sp", None)
+    x = _constrain(x, act_spec)
+
+    def body(carry, lp):
+        return _layer(carry, lp, c=c, mask=mask, act_spec=act_spec)
+
+    if c.remat:
+        body = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    pooled = jnp.tanh(
+        x[:, 0].astype(jnp.float32) @ params["pooler"]["w"].astype(jnp.float32)
+        + params["pooler"]["b"]
+    )
+    return x, pooled
+
+
+def classification_loss_fn(params: dict, batch: dict, config: BertConfig) -> jax.Array:
+    """Sequence-classification cross-entropy (the BERT-MRPC oracle shape)."""
+    _, pooled = apply(
+        params,
+        batch["input_ids"],
+        config,
+        attention_mask=batch.get("attention_mask"),
+        token_type_ids=batch.get("token_type_ids"),
+    )
+    logits = pooled @ params["classifier"]["w"].astype(jnp.float32) + params["classifier"]["b"]
+    labels = batch["labels"]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))

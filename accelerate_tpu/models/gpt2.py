@@ -1,0 +1,200 @@
+"""GPT-2-style decoder — second dense model family, TPU-first.
+
+Parity rationale: the reference's Megatron bridge ships per-family train-step
+handlers (``GPTTrainStep`` ``utils/megatron_lm.py:587``); our native analog is
+a model family per architecture.  GPT-2 differs from llama everywhere it
+matters for coverage: learned absolute positions (no RoPE), LayerNorm with
+bias (not RMSNorm), MHA (no GQA), GELU MLP (not SwiGLU), tied embeddings.
+
+Same TPU-first layout as ``models/llama.py``: stacked per-layer params scanned
+with ``lax.scan``, bf16 compute / fp32 params, partition rules over the named
+mesh axes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .llama import cross_entropy, labels_and_weights
+from ..parallel.sharding import constrain as _constrain
+
+__all__ = ["GPT2Config", "init_params", "apply", "loss_fn", "PARTITION_RULES", "param_specs"]
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 1024
+    layer_norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def intermediate_size(self) -> int:
+        return 4 * self.hidden_size
+
+    @classmethod
+    def tiny(cls, **kw) -> "GPT2Config":
+        defaults = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                        max_seq_len=128, remat=False)
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def gpt2_small(cls, **kw) -> "GPT2Config":
+        return cls(**kw)
+
+    def num_params(self) -> int:
+        d, v, l = self.hidden_size, self.vocab_size, self.num_layers
+        attn = 3 * d * d + 3 * d + d * d + d  # qkv + proj with biases
+        mlp = d * 4 * d + 4 * d + 4 * d * d + d
+        norms = 4 * d
+        return l * (attn + mlp + norms) + v * d + self.max_seq_len * d + 2 * d
+
+
+PARTITION_RULES: list[tuple[str, P]] = [
+    (r"wte", P("tp", "fsdp")),
+    (r"wpe", P(None, "fsdp")),
+    (r"layers/w_qkv", P(None, "fsdp", "tp")),
+    (r"layers/w_proj", P(None, "tp", "fsdp")),
+    (r"layers/w_up", P(None, "fsdp", "tp")),
+    (r"layers/w_down", P(None, "tp", "fsdp")),
+    (r"layers/(b_|ln_)", P(None, None)),
+    (r"final_ln", P(None)),
+]
+
+
+def _param_shapes(c: GPT2Config) -> dict:
+    d, L = c.hidden_size, c.num_layers
+    return {
+        "wte": (c.vocab_size, d),
+        "wpe": (c.max_seq_len, d),
+        "layers": {
+            "w_qkv": (L, d, 3 * d),
+            "b_qkv": (L, 3 * d),
+            "w_proj": (L, d, d),
+            "b_proj": (L, d),
+            "w_up": (L, d, 4 * d),
+            "b_up": (L, 4 * d),
+            "w_down": (L, 4 * d, d),
+            "b_down": (L, d),
+            "ln_attn_scale": (L, d),
+            "ln_attn_bias": (L, d),
+            "ln_mlp_scale": (L, d),
+            "ln_mlp_bias": (L, d),
+        },
+        "final_ln_scale": (d,),
+        "final_ln_bias": (d,),
+    }
+
+
+def param_specs(config: GPT2Config) -> dict:
+    from ..parallel.sharding import spec_from_rules
+
+    shapes = _param_shapes(config)
+
+    def one(kp, shape):
+        path = "/".join(str(getattr(k, "key", k)) for k in kp)
+        spec = spec_from_rules(path, len(shape), PARTITION_RULES)
+        return spec if spec is not None else P(*([None] * len(shape)))
+
+    return jax.tree_util.tree_map_with_path(one, shapes, is_leaf=lambda x: isinstance(x, tuple))
+
+
+def init_params(config: GPT2Config, key: jax.Array) -> dict:
+    shapes = _param_shapes(config)
+    leaves, treedef = jax.tree_util.tree_flatten(shapes, is_leaf=lambda x: isinstance(x, tuple))
+    keys = jax.random.split(key, len(leaves))
+
+    def init_one(kp_shape, k):
+        shape = kp_shape
+        # Scales to 1, biases to 0, weights normal(0.02) (GPT-2 init).
+        if len(shape) == 1 or (len(shape) == 2 and shape[0] == config.num_layers):
+            return jnp.zeros(shape, config.param_dtype)
+        return (jax.random.normal(k, shape, jnp.float32) * 0.02).astype(config.param_dtype)
+
+    out = jax.tree_util.tree_unflatten(treedef, [init_one(s, k) for s, k in zip(leaves, keys)])
+    # LayerNorm scales start at 1.
+    out["layers"]["ln_attn_scale"] = jnp.ones_like(out["layers"]["ln_attn_scale"])
+    out["layers"]["ln_mlp_scale"] = jnp.ones_like(out["layers"]["ln_mlp_scale"])
+    out["final_ln_scale"] = jnp.ones_like(out["final_ln_scale"])
+    return out
+
+
+def _layer_norm(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean((x32 - mean) ** 2, axis=-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return y.astype(x.dtype) * scale.astype(x.dtype) + bias.astype(x.dtype)
+
+
+def _layer(carry, p, *, c: GPT2Config, mask, act_spec):
+    x = carry
+    d, h, hd = c.hidden_size, c.num_heads, c.head_dim
+    b, s, _ = x.shape
+
+    hn = _layer_norm(x, p["ln_attn_scale"], p["ln_attn_bias"], c.layer_norm_eps)
+    qkv = hn @ p["w_qkv"].astype(c.dtype) + p["b_qkv"].astype(c.dtype)
+    q, k, v = jnp.split(qkv.reshape(b, s, 3, h, hd), 3, axis=2)
+    q, k, v = (t[:, :, 0] for t in (q, k, v))
+    scores = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) / np.sqrt(hd)
+    scores = jnp.where(mask[:, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    attn = jnp.einsum("bhst,bthd->bshd", probs, v).reshape(b, s, d)
+    x = x + attn @ p["w_proj"].astype(c.dtype) + p["b_proj"].astype(c.dtype)
+
+    hn = _layer_norm(x, p["ln_mlp_scale"], p["ln_mlp_bias"], c.layer_norm_eps)
+    u = jax.nn.gelu(hn @ p["w_up"].astype(c.dtype) + p["b_up"].astype(c.dtype))
+    x = x + u @ p["w_down"].astype(c.dtype) + p["b_down"].astype(c.dtype)
+    if act_spec is not None:
+        x = _constrain(x, act_spec)
+    return x, None
+
+
+def apply(
+    params: dict,
+    input_ids: jax.Array,
+    config: GPT2Config,
+    attention_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Token ids [B, S] -> fp32 logits [B, S, V] (tied lm head)."""
+    c = config
+    b, s = input_ids.shape
+    mask = jnp.broadcast_to(jnp.tril(jnp.ones((s, s), bool)), (b, s, s))
+    if attention_mask is not None:
+        mask = mask & attention_mask[:, None, :].astype(bool)
+
+    x = params["wte"].astype(c.dtype)[input_ids] + params["wpe"].astype(c.dtype)[:s][None]
+    act_spec = P(("dcn_dp", "dp", "fsdp"), "sp", None)
+    x = _constrain(x, act_spec)
+
+    def body(carry, lp):
+        return _layer(carry, lp, c=c, mask=mask, act_spec=act_spec)
+
+    if c.remat:
+        body = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = _layer_norm(x, params["final_ln_scale"], params["final_ln_bias"], c.layer_norm_eps)
+    return (x @ params["wte"].astype(c.dtype).T).astype(jnp.float32)
+
+
+def loss_fn(params: dict, batch: dict, config: GPT2Config) -> jax.Array:
+    labels, weights = labels_and_weights(batch)
+    logits = apply(params, batch["input_ids"], config, attention_mask=batch.get("attention_mask"))
+    return cross_entropy(logits, labels, weights)

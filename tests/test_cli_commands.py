@@ -1,0 +1,92 @@
+"""tpu-config and from-accelerate CLI command tests.
+
+Parity targets: reference ``commands/tpu.py`` (gcloud fan-out; we assert the
+constructed command via --debug) and ``commands/to_fsdp2.py`` (config
+migrator; ours converts reference yamls onto the mesh schema).
+"""
+
+import argparse
+
+import pytest
+import yaml
+
+from accelerate_tpu.commands.from_accelerate import convert_config, from_accelerate_command
+from accelerate_tpu.commands.tpu import tpu_command
+
+
+def test_tpu_config_debug_prints_gcloud(capsys, tmp_path):
+    args = argparse.Namespace(
+        config_file=str(tmp_path / "none.yaml"),
+        tpu_name="my-pod",
+        tpu_zone="us-central2-b",
+        command=["echo hello"],
+        command_file=None,
+        install_accelerate=True,
+        accelerate_version="latest",
+        debug=True,
+    )
+    tpu_command(args)
+    out = capsys.readouterr().out
+    assert "gcloud compute tpus tpu-vm ssh my-pod" in out
+    assert "--zone us-central2-b" in out
+    assert "pip install accelerate-tpu; echo hello" in out
+    assert "--worker all" in out
+
+
+def test_tpu_config_requires_name_and_commands(tmp_path):
+    base = dict(
+        config_file=str(tmp_path / "none.yaml"),
+        command=None,
+        command_file=None,
+        install_accelerate=False,
+        accelerate_version="latest",
+        debug=True,
+    )
+    with pytest.raises(ValueError, match="tpu_name"):
+        tpu_command(argparse.Namespace(tpu_name=None, tpu_zone=None, **base))
+    with pytest.raises(ValueError, match="Nothing to run"):
+        tpu_command(argparse.Namespace(tpu_name="a", tpu_zone="b", **base))
+
+
+def test_convert_fsdp_config():
+    src = {
+        "distributed_type": "FSDP",
+        "mixed_precision": "bf16",
+        "num_machines": 2,
+        "machine_rank": 0,
+        "fsdp_config": {"fsdp_sharding_strategy": "1", "fsdp_min_num_params": 100000},
+    }
+    cfg = convert_config(src)
+    assert cfg.use_fsdp and cfg.fsdp == 0
+    assert cfg.fsdp_sharding_strategy == "FULL_SHARD"
+    assert cfg.fsdp_min_num_params == 100000
+    assert cfg.mixed_precision == "bf16" and cfg.num_machines == 2
+
+
+def test_convert_deepspeed_and_megatron():
+    ds = convert_config(
+        {"distributed_type": "DEEPSPEED", "deepspeed_config": {"zero_stage": 3,
+         "gradient_accumulation_steps": 4}}
+    )
+    assert ds.use_fsdp and ds.fsdp_sharding_strategy == "FULL_SHARD"
+    assert ds.gradient_accumulation_steps == 4
+    mlm = convert_config(
+        {"distributed_type": "MEGATRON_LM",
+         "megatron_lm_config": {"megatron_lm_tp_degree": 4, "megatron_lm_pp_degree": 2}}
+    )
+    assert mlm.tp == 4 and mlm.pp == 2
+
+
+def test_from_accelerate_command_writes_yaml(tmp_path):
+    src_path = tmp_path / "hf.yaml"
+    src_path.write_text(yaml.safe_dump({"distributed_type": "MULTI_GPU", "mixed_precision": "fp16"}))
+    out_path = tmp_path / "out.yaml"
+    args = argparse.Namespace(
+        config_file=str(src_path), output_file=str(out_path), overwrite=False
+    )
+    from_accelerate_command(args)
+    data = yaml.safe_load(out_path.read_text())
+    assert data["mixed_precision"] == "fp16"
+    assert data["distributed_type"] == "TPU_JAX"
+    with pytest.raises(FileExistsError):
+        from_accelerate_command(args)

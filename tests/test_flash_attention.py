@@ -1,0 +1,105 @@
+"""Flash (blockwise online-softmax) attention correctness vs the einsum oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.models import llama
+from accelerate_tpu.models.llama import _attention
+from accelerate_tpu.ops.flash_attention import flash_attention
+
+
+def _qkv(b=2, s=256, h=8, kh=4, d=32, key=0):
+    k1, k2, k3 = jax.random.split(jax.random.key(key), 3)
+    return (
+        jax.random.normal(k1, (b, s, h, d), jnp.float32),
+        jax.random.normal(k2, (b, s, kh, d), jnp.float32),
+        jax.random.normal(k3, (b, s, kh, d), jnp.float32),
+    )
+
+
+def test_forward_matches_einsum():
+    q, k, v = _qkv()
+    b, s = q.shape[:2]
+    mask = jnp.broadcast_to(jnp.tril(jnp.ones((s, s), bool)), (b, s, s))
+    ref = _attention(q, k, v, mask, q.shape[2] // k.shape[2])
+    out = flash_attention(q, k, v, causal=True, block_size=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_gradients_match_einsum():
+    q, k, v = _qkv(s=128)
+    b, s = q.shape[:2]
+    mask = jnp.broadcast_to(jnp.tril(jnp.ones((s, s), bool)), (b, s, s))
+
+    def f_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, block_size=32) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(_attention(q, k, v, mask, q.shape[2] // k.shape[2]) ** 2)
+
+    gf = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-4, atol=1e-4)
+
+
+def test_non_causal_and_block_edge():
+    q, k, v = _qkv(s=64)
+    full = jnp.ones((2, 64, 64), bool)
+    ref = _attention(q, k, v, jnp.broadcast_to(full, (2, 64, 64)), 2)
+    out = flash_attention(q, k, v, causal=False, block_size=64)  # single block
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+    with pytest.raises(ValueError, match="divisible"):
+        flash_attention(q, k, v, block_size=48)
+
+
+def test_llama_flash_matches_einsum_logits():
+    cfg_e = llama.LlamaConfig.tiny(dtype=jnp.float32, attention_impl="einsum")
+    cfg_f = llama.LlamaConfig.tiny(dtype=jnp.float32, attention_impl="flash")
+    params = llama.init_params(cfg_e, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (2, 64), 0, cfg_e.vocab_size)
+    le = llama.apply(params, ids, cfg_e)
+    lf = llama.apply(params, ids, cfg_f)
+    np.testing.assert_allclose(np.asarray(lf), np.asarray(le), rtol=2e-4, atol=2e-4)
+
+
+def test_llama_dots_remat_policy_runs():
+    cfg = llama.LlamaConfig.tiny(attention_impl="flash", remat=True, remat_policy="dots")
+    params = llama.init_params(cfg, jax.random.key(0))
+    batch = {"input_ids": jax.random.randint(jax.random.key(1), (2, 64), 0, cfg.vocab_size)}
+    loss, grads = jax.jit(jax.value_and_grad(lambda p: llama.loss_fn(p, batch, cfg)))(params)
+    assert np.isfinite(float(loss))
+    with pytest.raises(ValueError, match="remat_policy"):
+        llama._remat_policy("everything")
+
+
+def test_flash_block_selection_and_validation():
+    from accelerate_tpu.models.llama import _flash_block
+
+    assert _flash_block(2048) == 512
+    assert _flash_block(768) == 256
+    assert _flash_block(1088) == 64
+    assert _flash_block(770) == 770  # single block, s <= 1024
+    assert _flash_block(1090) is None  # prime-ish long seq -> einsum fallback
+    with pytest.raises(ValueError, match="attention_impl"):
+        llama.LlamaConfig.tiny(attention_impl="Flash")
+    with pytest.raises(ValueError, match="remat_policy"):
+        llama.LlamaConfig.tiny(remat_policy="everything")
+
+
+def test_padding_mask_falls_back_to_einsum():
+    """attention_mask forces the einsum path even when flash is preferred —
+    outputs must respect padding."""
+    cfg = llama.LlamaConfig.tiny(dtype=jnp.float32, attention_impl="flash")
+    params = llama.init_params(cfg, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (1, 64), 0, cfg.vocab_size)
+    am = jnp.ones((1, 64), jnp.int32).at[0, 32:].set(0)
+    logits_padded = llama.apply(params, ids, cfg, attention_mask=am)
+    # Changing a masked-out token must not affect positions before the pad.
+    ids2 = ids.at[0, 40].set((ids[0, 40] + 1) % cfg.vocab_size)
+    logits2 = llama.apply(params, ids2, cfg, attention_mask=am)
+    np.testing.assert_allclose(
+        np.asarray(logits_padded[0, :32]), np.asarray(logits2[0, :32]), rtol=1e-5, atol=1e-5
+    )
