@@ -1,1 +1,1 @@
-from . import bert, gpt2, llama, mixtral
+from . import bert, gpt2, llama, mixtral, t5

@@ -1,0 +1,271 @@
+"""T5-style encoder-decoder — third architecture family, TPU-first.
+
+Parity rationale: the reference's Megatron bridge ships ``T5TrainStep``
+(``utils/megatron_lm.py:719``); this native family covers the encoder-decoder
+class: relative position bias (no absolute/rotary embeddings), RMSNorm without
+bias, ReLU MLP, cross-attention, tied embeddings scaled at the head.
+
+Same TPU-first layout as the other families: stacked per-layer params under
+``lax.scan``, bf16 compute / fp32 params, partition rules over the mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.sharding import constrain as _constrain
+from .llama import _rms_norm
+
+__all__ = ["T5Config", "init_params", "apply", "loss_fn", "PARTITION_RULES", "param_specs"]
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    hidden_size: int = 512
+    intermediate_size: int = 2048
+    num_layers: int = 6  # per stack (encoder and decoder)
+    num_heads: int = 8
+    head_dim: int = 64
+    num_buckets: int = 32
+    max_distance: int = 128
+    rms_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+
+    @classmethod
+    def tiny(cls, **kw) -> "T5Config":
+        defaults = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                        num_layers=2, num_heads=4, head_dim=16, num_buckets=8, max_distance=32)
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+PARTITION_RULES: list[tuple[str, P]] = [
+    (r"shared_embed", P("tp", "fsdp")),
+    (r"/(wq|wk|wv|cross_wq|cross_wk|cross_wv)", P(None, "fsdp", "tp")),
+    (r"/(wo|cross_wo)", P(None, "tp", "fsdp")),
+    (r"/w_up", P(None, "fsdp", "tp")),
+    (r"/w_down", P(None, "tp", "fsdp")),
+    (r"rel_bias", P(None, None)),
+    (r"final_ln", P(None)),
+    (r"/ln_", P(None, None)),
+]
+
+
+def _stack_shapes(c: T5Config, decoder: bool) -> dict:
+    d, f, L, hd = c.hidden_size, c.intermediate_size, c.num_layers, c.head_dim
+    h = c.num_heads
+    shapes = {
+        "wq": (L, d, h * hd),
+        "wk": (L, d, h * hd),
+        "wv": (L, d, h * hd),
+        "wo": (L, h * hd, d),
+        "w_up": (L, d, f),
+        "w_down": (L, f, d),
+        "ln_attn": (L, d),
+        "ln_mlp": (L, d),
+    }
+    if decoder:
+        shapes.update(
+            {
+                "cross_wq": (L, d, h * hd),
+                "cross_wk": (L, d, h * hd),
+                "cross_wv": (L, d, h * hd),
+                "cross_wo": (L, h * hd, d),
+                "ln_cross": (L, d),
+            }
+        )
+    return shapes
+
+
+def _param_shapes(c: T5Config) -> dict:
+    return {
+        "shared_embed": (c.vocab_size, c.hidden_size),
+        "enc_rel_bias": (c.num_buckets, c.num_heads),
+        "dec_rel_bias": (c.num_buckets, c.num_heads),
+        "encoder": _stack_shapes(c, decoder=False),
+        "decoder": _stack_shapes(c, decoder=True),
+        "enc_final_ln": (c.hidden_size,),
+        "dec_final_ln": (c.hidden_size,),
+    }
+
+
+def param_specs(config: T5Config) -> dict:
+    from ..parallel.sharding import spec_from_rules
+
+    shapes = _param_shapes(config)
+
+    def one(kp, shape):
+        path = "/".join(str(getattr(k, "key", k)) for k in kp)
+        spec = spec_from_rules(path, len(shape), PARTITION_RULES)
+        return spec if spec is not None else P(*([None] * len(shape)))
+
+    return jax.tree_util.tree_map_with_path(one, shapes, is_leaf=lambda x: isinstance(x, tuple))
+
+
+def init_params(config: T5Config, key: jax.Array) -> dict:
+    shapes = _param_shapes(config)
+    leaves, treedef = jax.tree_util.tree_flatten(shapes, is_leaf=lambda x: isinstance(x, tuple))
+    keys = jax.random.split(key, len(leaves))
+
+    def init_one(shape, k):
+        if len(shape) == 1 or (len(shape) == 2 and shape[0] == config.num_layers):
+            return jnp.ones(shape, config.param_dtype)  # RMSNorm scales
+        fan_in = shape[-2] if len(shape) >= 2 else shape[0]
+        if len(shape) == 2 and shape[0] == config.num_buckets:
+            return jnp.zeros(shape, config.param_dtype)  # relative bias starts flat
+        return (jax.random.normal(k, shape, jnp.float32) / np.sqrt(fan_in)).astype(
+            config.param_dtype
+        )
+
+    return jax.tree_util.tree_unflatten(treedef, [init_one(s, k) for s, k in zip(leaves, keys)])
+
+
+def _relative_buckets(rel_pos: jax.Array, num_buckets: int, max_distance: int, bidirectional: bool):
+    """T5 relative-position bucketing (log-spaced beyond the exact range)."""
+    ret = jnp.zeros_like(rel_pos)
+    n = -rel_pos
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + (n < 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    large = max_exact + (
+        jnp.log(jnp.maximum(n, 1).astype(jnp.float32) / max_exact)
+        / np.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    large = jnp.minimum(large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, large)
+
+
+def _rel_bias(table: jax.Array, q_len: int, k_len: int, c: T5Config, bidirectional: bool):
+    ctx = jnp.arange(q_len)[:, None]
+    mem = jnp.arange(k_len)[None, :]
+    buckets = _relative_buckets(mem - ctx, c.num_buckets, c.max_distance, bidirectional)
+    return table[buckets].transpose(2, 0, 1)  # [H, q, k]
+
+
+def _mha(h_q, h_kv, p, prefix, c: T5Config, bias, mask):
+    b, sq, _ = h_q.shape
+    sk = h_kv.shape[1]
+    hd, nh = c.head_dim, c.num_heads
+    q = (h_q @ p[prefix + "wq"].astype(c.dtype)).reshape(b, sq, nh, hd)
+    k = (h_kv @ p[prefix + "wk"].astype(c.dtype)).reshape(b, sk, nh, hd)
+    v = (h_kv @ p[prefix + "wv"].astype(c.dtype)).reshape(b, sk, nh, hd)
+    scores = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32)  # T5: no 1/sqrt(d)
+    if bias is not None:
+        scores = scores + bias[None]
+    if mask is not None:
+        scores = jnp.where(mask[:, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhst,bthd->bshd", probs, v).reshape(b, sq, nh * hd)
+    return out @ p[prefix + "wo"].astype(c.dtype)
+
+
+def _enc_layer(carry, p, *, c: T5Config, bias, mask, act_spec):
+    x = carry
+    h = _rms_norm(x, p["ln_attn"], c.rms_eps)
+    x = x + _mha(h, h, p, "", c, bias, mask)
+    h = _rms_norm(x, p["ln_mlp"], c.rms_eps)
+    x = x + jax.nn.relu(h @ p["w_up"].astype(c.dtype)) @ p["w_down"].astype(c.dtype)
+    if act_spec is not None:
+        x = _constrain(x, act_spec)
+    return x, None
+
+
+def _dec_layer(carry, p, *, c: T5Config, bias, self_mask, enc_out, cross_mask, act_spec):
+    x = carry
+    h = _rms_norm(x, p["ln_attn"], c.rms_eps)
+    x = x + _mha(h, h, p, "", c, bias, self_mask)
+    h = _rms_norm(x, p["ln_cross"], c.rms_eps)
+    x = x + _mha(h, enc_out, p, "cross_", c, None, cross_mask)
+    h = _rms_norm(x, p["ln_mlp"], c.rms_eps)
+    x = x + jax.nn.relu(h @ p["w_up"].astype(c.dtype)) @ p["w_down"].astype(c.dtype)
+    if act_spec is not None:
+        x = _constrain(x, act_spec)
+    return x, None
+
+
+def apply(
+    params: dict,
+    input_ids: jax.Array,
+    decoder_input_ids: jax.Array,
+    config: T5Config,
+    attention_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """(encoder ids [B, S], decoder ids [B, T]) -> fp32 logits [B, T, V]."""
+    c = config
+    b, s = input_ids.shape
+    t = decoder_input_ids.shape[1]
+    act_spec = P(("dcn_dp", "dp", "fsdp"), None, None)
+
+    enc_mask = None
+    if attention_mask is not None:
+        valid = attention_mask.astype(bool)
+        enc_mask = valid[:, None, :] & valid[:, :, None]
+    enc_bias = _rel_bias(params["enc_rel_bias"].astype(jnp.float32), s, s, c, bidirectional=True)
+
+    x = params["shared_embed"].astype(c.dtype)[input_ids]
+    x = _constrain(x, act_spec)
+
+    def enc_body(carry, lp):
+        return _enc_layer(carry, lp, c=c, bias=enc_bias, mask=enc_mask, act_spec=act_spec)
+
+    if c.remat:
+        enc_body = jax.checkpoint(enc_body, policy=jax.checkpoint_policies.nothing_saveable)
+    x, _ = jax.lax.scan(enc_body, x, params["encoder"])
+    enc_out = _rms_norm(x, params["enc_final_ln"], c.rms_eps)
+
+    dec_bias = _rel_bias(params["dec_rel_bias"].astype(jnp.float32), t, t, c, bidirectional=False)
+    self_mask = jnp.broadcast_to(jnp.tril(jnp.ones((t, t), bool)), (b, t, t))
+    cross_mask = None
+    if attention_mask is not None:
+        cross_mask = jnp.broadcast_to(attention_mask.astype(bool)[:, None, :], (b, t, s))
+
+    y = params["shared_embed"].astype(c.dtype)[decoder_input_ids]
+    y = _constrain(y, act_spec)
+
+    def dec_body(carry, lp):
+        return _dec_layer(
+            carry, lp, c=c, bias=dec_bias, self_mask=self_mask,
+            enc_out=enc_out, cross_mask=cross_mask, act_spec=act_spec,
+        )
+
+    if c.remat:
+        dec_body = jax.checkpoint(dec_body, policy=jax.checkpoint_policies.nothing_saveable)
+    y, _ = jax.lax.scan(dec_body, y, params["decoder"])
+    y = _rms_norm(y, params["dec_final_ln"], c.rms_eps)
+    # Tied head, scaled by 1/sqrt(d) (T5 convention).
+    head = params["shared_embed"].T.astype(c.dtype) / np.sqrt(c.hidden_size)
+    return (y @ head).astype(jnp.float32)
+
+
+def loss_fn(params: dict, batch: dict, config: T5Config) -> jax.Array:
+    """Seq2seq cross-entropy: batch needs input_ids, decoder_input_ids, labels
+    (and optional attention_mask); labels < 0 are ignored."""
+    from .llama import cross_entropy
+
+    labels = batch["labels"]
+    weights = (labels >= 0).astype(jnp.float32)
+    labels = jnp.maximum(labels, 0)
+    logits = apply(
+        params,
+        batch["input_ids"],
+        batch["decoder_input_ids"],
+        config,
+        attention_mask=batch.get("attention_mask"),
+    )
+    return cross_entropy(logits, labels, weights)

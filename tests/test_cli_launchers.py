@@ -40,11 +40,15 @@ def test_launch_parser_and_env():
 
 
 def test_cli_help_and_env_command():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # device-independent (and TPU-outage-proof)
     res = subprocess.run(
         [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli", "env"],
         capture_output=True,
         text=True,
         cwd="/root/repo",
+        env=env,
+        timeout=180,
     )
     assert res.returncode == 0, res.stderr
     assert "JAX version" in res.stdout
@@ -63,12 +67,16 @@ def test_merge_weights_roundtrip(tmp_path):
 
     (tmp_path / "shard_index.json").write_text(json.dumps({"w": {"concat_axis": 0}}))
     out = tmp_path / "merged"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
     res = subprocess.run(
         [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli", "merge-weights",
          str(tmp_path), str(out)],
         capture_output=True,
         text=True,
         cwd="/root/repo",
+        env=env,
+        timeout=180,
     )
     assert res.returncode == 0, res.stderr
     merged = load_file(str(out / "model.safetensors"))
