@@ -62,7 +62,11 @@ class LlamaConfig:
     remat_policy: str = "nothing"
     # "einsum": materialize scores (fast at short seq, supports padding masks).
     # "flash": blockwise online-softmax (ops/flash_attention.py).
-    # "auto": flash for long sequences without padding masks.
+    # "pallas": fused Pallas MXU kernel (ops/pallas_attention.py) — fastest on
+    #   a single chip; not GSPMD-partitionable, so "auto" only picks it when
+    #   the computation is single-device.
+    # "auto": pallas on a 1-chip TPU, else flash for long sequences without
+    #   padding masks.
     attention_impl: str = "auto"
     # fp8 matmuls (ops/fp8.py scaled_matmul): projection/MLP weights quantized
     # per-tensor to e4m3 with fp32 accumulation; embed/unembed stay in `dtype`
@@ -71,9 +75,10 @@ class LlamaConfig:
     fp8: bool = False
 
     def __post_init__(self):
-        if self.attention_impl not in ("auto", "einsum", "flash"):
+        if self.attention_impl not in ("auto", "einsum", "flash", "pallas"):
             raise ValueError(
-                f"attention_impl must be 'auto', 'einsum' or 'flash', got {self.attention_impl!r}"
+                "attention_impl must be 'auto', 'einsum', 'flash' or 'pallas', "
+                f"got {self.attention_impl!r}"
             )
         if self.remat_policy not in ("nothing", "dots"):
             raise ValueError(f"remat_policy must be 'nothing' or 'dots', got {self.remat_policy!r}")
@@ -264,6 +269,25 @@ def _flash_block(s: int):
     return s if s <= 1024 else None
 
 
+def _use_pallas(c: "LlamaConfig", s: int) -> bool:
+    """Pick the fused Pallas kernel: explicit opt-in always; "auto" only when
+    single-device (pallas_call is opaque to GSPMD — a sharded mesh would force
+    an all-gather of activations around it)."""
+    if c.attention_impl == "pallas":
+        return True
+    if c.attention_impl != "auto" or s < 1024 or _flash_block(s) is None:
+        return False
+    try:
+        from ..ops.pallas_attention import pallas_available
+    except ImportError:
+        return False
+    return (
+        pallas_available()
+        and jax.default_backend() == "tpu"
+        and jax.device_count() == 1
+    )
+
+
 def _mm(h: jax.Array, w: jax.Array, c: LlamaConfig) -> jax.Array:
     """Projection matmul honoring the precision mode: ``config.fp8`` or an
     active ``fp8_autowrap`` context (mixed_precision="fp8") routes through the
@@ -294,6 +318,16 @@ def attention_block(x, p, c, mask, positions) -> jax.Array:
         from ..ops.ring_attention import ring_attention
 
         attn = ring_attention(q, k, v, mesh=None, axis_name="sp", causal=True)
+    elif mask is None and _use_pallas(c, s):
+        from ..ops.pallas_attention import pallas_attention
+
+        blk = _flash_block(s)
+        if blk is None:
+            raise ValueError(
+                f"attention_impl='pallas' needs a sequence length divisible by "
+                f"64/128/256/512 (VMEM tiling); got seq_len={s}"
+            )
+        attn = pallas_attention(q, k, v, causal=True, block_size=blk)
     elif mask is None and (
         c.attention_impl == "flash" or (c.attention_impl == "auto" and s >= 1024)
     ) and _flash_block(s) is not None:

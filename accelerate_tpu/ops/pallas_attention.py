@@ -1,0 +1,400 @@
+"""Pallas TPU flash attention — hand-written MXU kernels (fwd + bwd).
+
+The blockwise ``ops/flash_attention.py`` path expresses the online-softmax
+recurrence through XLA (``lax.scan`` + remat); this module is the hardware
+kernel behind the same math: one fused ``pallas_call`` per pass keeps the
+query tile, running max/denominator and output accumulator in VMEM while K/V
+tiles stream in, so the [S, S] score matrix never touches HBM in either
+direction.  Backward uses the standard flash-attention decomposition
+(saved logsumexp + delta = rowsum(dO*O)) with two kernels: dq accumulates over
+K/V tiles, dk/dv accumulate over Q tiles.
+
+The reference framework has no attention kernels at all (it delegates compute
+to torch engines; SURVEY.md §2.4 — CP/ring/blockwise "ABSENT from the
+reference"), so this is net-new capability, per-tile layout chosen for the
+MXU (128-aligned tiles, fp32 accumulation via ``preferred_element_type``).
+
+GQA is handled without materializing expanded K/V: the kernel grid runs over
+Q heads and the K/V BlockSpec index maps divide by the group size; backward
+produces per-Q-head dK/dV which are group-summed outside the kernel.
+
+Partitioning note: ``pallas_call`` does not participate in GSPMD automatic
+partitioning, so this path is selected (``attention_impl="auto"``) only when
+the computation is single-device; the ``lax.scan`` flash path remains the
+spmd-friendly fallback XLA can slice freely on a multi-chip mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is absent on some CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+__all__ = ["pallas_attention", "pallas_available"]
+
+_NEG_INF = -1e30  # finite: avoids inf-inf NaNs inside the exp bookkeeping
+
+
+def pallas_available() -> bool:
+    return pltpu is not None
+
+
+def _vmem_spec(block_shape, index_map):
+    return pl.BlockSpec(block_shape, index_map, memory_space=pltpu.VMEM)
+
+
+def _compiler_params():
+    """batch/head/outer-tile grid dims are parallel (lets Mosaic split them
+    across the two TensorCores on megacore chips); only the innermost
+    accumulation dim is sequential."""
+    cp = getattr(pltpu, "CompilerParams", None) or getattr(pltpu, "TPUCompilerParams", None)
+    if cp is None:  # pragma: no cover
+        return None
+    return cp(dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
+
+
+def _causal_mask(s, iq, ik, blk_q, blk_k, rows_are_k=False):
+    """Mask score tile ``s`` ([blk_q, blk_k] or transposed) below the diagonal."""
+    if rows_are_k:
+        k_pos = ik * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        q_pos = iq * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    else:
+        q_pos = iq * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = ik * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
+                scale, blk_q, blk_k, causal, nk):
+    ik = pl.program_id(3)
+    iq = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    def compute():
+        q = q_ref[0, 0]  # [blk_q, d]
+        k = k_ref[0, 0]  # [blk_k, d]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [blk_q, blk_k]
+        if causal:
+            s = _causal_mask(s, iq, ik, blk_q, blk_k)
+
+        m_prev = m_scr[:, :1]  # [blk_q, 1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)  # [blk_q, blk_k] f32
+        alpha = jnp.exp(m_prev - m_new)  # [blk_q, 1]
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc[:] = acc[:] * alpha + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        # Skip K/V tiles entirely above the causal diagonal.
+        pl.when(ik * blk_k <= iq * blk_q + blk_q - 1)(compute)
+    else:
+        compute()
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0, 0] = (acc[:] / l).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_scr[:, :1] + jnp.log(l)
+
+
+def _flash_fwd(q, k, v, *, scale, causal, blk_q, blk_k, interpret):
+    """q: [B, H, S, d]; k, v: [B, K, S, d].  Returns (out [B,H,S,d], lse [B,H,S])."""
+    b, h, s, d = q.shape
+    kh = k.shape[1]
+    g = h // kh
+    nq = s // blk_q
+    nk = s // blk_k
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, blk_q=blk_q, blk_k=blk_k, causal=causal, nk=nk
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            _vmem_spec((1, 1, blk_q, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            _vmem_spec((1, 1, blk_k, d), lambda ib, ih, iq, ik: (ib, ih // g, ik, 0)),
+            _vmem_spec((1, 1, blk_k, d), lambda ib, ih, iq, ik: (ib, ih // g, ik, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, 1, blk_q, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            _vmem_spec((1, 1, blk_q, 1), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, d), jnp.float32),
+            pltpu.VMEM((blk_q, 128), jnp.float32),
+            pltpu.VMEM((blk_q, 128), jnp.float32),
+        ],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse.reshape(b, h, s)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+                   *, scale, blk_q, blk_k, causal, nk):
+    ik = pl.program_id(3)
+    iq = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]    # [blk_q, 1]
+        delta = delta_ref[0, 0]  # [blk_q, 1]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            s = _causal_mask(s, iq, ik, blk_q, blk_k)
+        p = jnp.exp(s - lse)  # [blk_q, blk_k]
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(ik * blk_k <= iq * blk_q + blk_q - 1)(compute)
+    else:
+        compute()
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale, blk_q, blk_k, causal, nq):
+    iq = pl.program_id(3)
+    ik = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]    # [1, blk_q]
+        delta = delta_ref[0, 0]  # [1, blk_q]
+
+        st = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [blk_k, blk_q]
+        if causal:
+            st = _causal_mask(st, iq, ik, blk_q, blk_k, rows_are_k=True)
+        pt = jnp.exp(st - lse)  # [blk_k, blk_q]
+        dv_acc[:] += jax.lax.dot_general(
+            pt.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dpt = jax.lax.dot_general(
+            v.astype(jnp.float32), do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [blk_k, blk_q]
+        dst = pt * (dpt - delta) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            dst.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(ik * blk_k <= iq * blk_q + blk_q - 1)(compute)
+    else:
+        compute()
+
+    @pl.when(iq == nq - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, do, *, scale, causal, blk_q, blk_k, interpret):
+    b, h, s, d = q.shape
+    kh = k.shape[1]
+    g = h // kh
+    nq = s // blk_q
+    nk = s // blk_k
+
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    lse_col = lse.reshape(b, h, s, 1)
+    delta_col = delta.reshape(b, h, s, 1)
+    lse_row = lse.reshape(b, h, 1, s)
+    delta_row = delta.reshape(b, h, 1, s)
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, scale=scale, blk_q=blk_q, blk_k=blk_k, causal=causal, nk=nk
+    )
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            _vmem_spec((1, 1, blk_q, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            _vmem_spec((1, 1, blk_k, d), lambda ib, ih, iq, ik: (ib, ih // g, ik, 0)),
+            _vmem_spec((1, 1, blk_k, d), lambda ib, ih, iq, ik: (ib, ih // g, ik, 0)),
+            _vmem_spec((1, 1, blk_q, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            _vmem_spec((1, 1, blk_q, 1), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            _vmem_spec((1, 1, blk_q, 1), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+        ],
+        out_specs=_vmem_spec((1, 1, blk_q, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(q, k, v, do, lse_col, delta_col)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, scale=scale, blk_q=blk_q, blk_k=blk_k, causal=causal, nq=nq
+    )
+    # dK/dV computed per Q-head ([B, H, S, d]) then group-summed to K heads.
+    dk_h, dv_h = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, h, nk, nq),
+        in_specs=[
+            _vmem_spec((1, 1, blk_q, d), lambda ib, ih, ik, iq: (ib, ih, iq, 0)),
+            _vmem_spec((1, 1, blk_k, d), lambda ib, ih, ik, iq: (ib, ih // g, ik, 0)),
+            _vmem_spec((1, 1, blk_k, d), lambda ib, ih, ik, iq: (ib, ih // g, ik, 0)),
+            _vmem_spec((1, 1, blk_q, d), lambda ib, ih, ik, iq: (ib, ih, iq, 0)),
+            _vmem_spec((1, 1, 1, blk_q), lambda ib, ih, ik, iq: (ib, ih, 0, iq)),
+            _vmem_spec((1, 1, 1, blk_q), lambda ib, ih, ik, iq: (ib, ih, 0, iq)),
+        ],
+        out_specs=[
+            _vmem_spec((1, 1, blk_k, d), lambda ib, ih, ik, iq: (ib, ih, ik, 0)),
+            _vmem_spec((1, 1, blk_k, d), lambda ib, ih, ik, iq: (ib, ih, ik, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, s, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_k, d), jnp.float32),
+            pltpu.VMEM((blk_k, d), jnp.float32),
+        ],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(q, k, v, do, lse_row, delta_row)
+
+    if g > 1:
+        dk = dk_h.reshape(b, kh, g, s, d).sum(axis=2)
+        dv = dv_h.reshape(b, kh, g, s, d).sum(axis=2)
+    else:
+        dk, dv = dk_h, dv_h
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp wrapper + public API
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _mha(q, k, v, scale, causal, blk_q, blk_k, interpret):
+    out, _ = _flash_fwd(q, k, v, scale=scale, causal=causal, blk_q=blk_q,
+                        blk_k=blk_k, interpret=interpret)
+    return out
+
+
+def _mha_fwd(q, k, v, scale, causal, blk_q, blk_k, interpret):
+    out, lse = _flash_fwd(q, k, v, scale=scale, causal=causal, blk_q=blk_q,
+                          blk_k=blk_k, interpret=interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _mha_bwd(scale, causal, blk_q, blk_k, interpret, res, do):
+    q, k, v, out, lse = res
+    return _flash_bwd(q, k, v, out, lse, do, scale=scale, causal=causal,
+                      blk_q=blk_q, blk_k=blk_k, interpret=interpret)
+
+
+_mha.defvjp(_mha_fwd, _mha_bwd)
+
+
+def pallas_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    block_size: int = 512,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Fused flash attention on TPU via Pallas.
+
+    Same contract as ``ops.flash_attention.flash_attention``: q ``[B, S, H, d]``,
+    k/v ``[B, S, K, d]`` with ``H = K * groups``; causal GQA over densely packed
+    batches (no padding mask).  ``interpret=None`` auto-enables the Pallas
+    interpreter off-TPU so the same tests run on the CPU mesh.
+    """
+    if pltpu is None:
+        raise RuntimeError("jax.experimental.pallas.tpu unavailable")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, s, h, d = q.shape
+    kh = k.shape[2]
+    if h % kh:
+        raise ValueError(f"num q heads {h} not divisible by kv heads {kh}")
+    blk = min(block_size, s)
+    if s % blk:
+        raise ValueError(f"seq len {s} must be divisible by block_size {blk}")
+
+    qh = q.transpose(0, 2, 1, 3)  # [B, H, S, d]
+    kk = k.transpose(0, 2, 1, 3)  # [B, K, S, d]
+    vv = v.transpose(0, 2, 1, 3)
+    scale = float(1.0 / np.sqrt(d))
+    out = _mha(qh, kk, vv, scale, causal, blk, blk, interpret)
+    return out.transpose(0, 2, 1, 3)

@@ -115,6 +115,7 @@ def main():
     # model down.  A SIGALRM watchdog bounds each rung so a pathological
     # compile can't eat the whole bench budget.
     ladder = [
+        ("llama-509m", 2048, 6, 8192, 4, 2048, "pallas", "dots"),
         ("llama-509m", 2048, 6, 8192, 4, 2048, "flash", "dots"),
         ("llama-509m", 2048, 6, 8192, 4, 2048, "einsum", "nothing"),
         ("llama-310m", 1536, 6, 6144, 4, 2048, "einsum", "nothing"),
