@@ -165,6 +165,14 @@ class PreparedModel:
         policy = accelerator.state.dtype_policy
         self._compute_dtype = jnp.dtype(policy.compute_dtype) if policy.compute_dtype else None
         self._fp8_recipe = policy.fp8_recipe if policy.fp8 else None
+        # DDP comm-hook analog: fp16/bf16 hooks compress the cross-replica
+        # gradient traffic; here the accumulated/synced gradient pytree is held
+        # in that dtype (bf16 on TPU for both — fp16 grads overflow without a
+        # scaler and bf16 is the hardware-native reduced type).
+        ddp = getattr(accelerator, "ddp_handler", None)
+        self._grad_sync_dtype = (
+            jnp.bfloat16 if ddp is not None and ddp.comm_hook in ("fp16", "bf16") else None
+        )
         self._jit_fused = None
         self._jit_fwd = None
         self._jit_vjp = None
@@ -270,6 +278,11 @@ class PreparedModel:
 
     def _accumulate(self, grads, scale: float):
         scaled = jax.tree_util.tree_map(lambda g: g * scale, grads)
+        if self._grad_sync_dtype is not None:
+            scaled = jax.tree_util.tree_map(
+                lambda g: g.astype(self._grad_sync_dtype) if jnp.issubdtype(g.dtype, jnp.floating) else g,
+                scaled,
+            )
         if self._accum_grads is None:
             self._accum_grads = scaled
         else:
@@ -493,6 +506,43 @@ class Accelerator:
         self.flag_tensor = None
         self.trackers: list = []
         self.log_with = log_with if isinstance(log_with, (list, tuple)) else ([log_with] if log_with else [])
+
+        # kwargs handlers → named slots (reference accelerator.py:413-450); at
+        # most one of each kind.
+        from .utils.dataclasses import (
+            AutocastKwargs,
+            DistributedDataParallelKwargs,
+            DistributedInitKwargs,
+            FP8RecipeKwargs,
+            GradScalerKwargs,
+        )
+
+        self.ddp_handler = None
+        self.scaler_handler = None
+        self.init_handler = None
+        self.autocast_handler = None
+        self.profile_handler = None
+        self.fp8_recipe_handler = None
+        _slots = {
+            DistributedDataParallelKwargs: "ddp_handler",
+            GradScalerKwargs: "scaler_handler",
+            DistributedInitKwargs: "init_handler",
+            AutocastKwargs: "autocast_handler",
+            ProfileKwargs: "profile_handler",
+            FP8RecipeKwargs: "fp8_recipe_handler",
+        }
+        for handler in kwargs_handlers or []:
+            if not isinstance(handler, KwargsHandler):
+                raise ValueError(f"Unsupported kwargs handler: {handler!r}")
+            slot = _slots.get(type(handler))
+            if slot is None:
+                raise ValueError(f"Unsupported kwargs handler type: {type(handler).__name__}")
+            if getattr(self, slot) is not None:
+                raise ValueError(f"You can only pass one {type(handler).__name__} in `kwargs_handlers`.")
+            setattr(self, slot, handler)
+        if self.fp8_recipe_handler is not None and hasattr(self.state, "dtype_policy"):
+            # Recipe kwargs override the policy default (reference fp8 plumbing).
+            self.state.dtype_policy.fp8_recipe = self.fp8_recipe_handler
 
     # -- state passthroughs (reference properties) ---------------------------
 
@@ -928,7 +978,7 @@ class Accelerator:
         import shutil
         import tempfile
 
-        handler = profile_handler or ProfileKwargs()
+        handler = profile_handler or self.profile_handler or ProfileKwargs()
         out_dir = handler.output_trace_dir
         keep = out_dir is not None
         if not keep:

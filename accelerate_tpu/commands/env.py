@@ -8,6 +8,43 @@ import platform
 from .config import DEFAULT_CONFIG_FILE, load_config
 
 
+def _probe_devices(timeout_s: float = 20.0) -> dict:
+    """Backend probe in a daemon thread with a deadline: a tunneled TPU whose
+    link is down blocks client creation forever, and an env report must never
+    hang (the reference's env command touches no device at all)."""
+    import os
+    import threading
+
+    result: dict = {}
+
+    def probe():
+        try:
+            import jax
+
+            # The axon sitecustomize overrides JAX_PLATFORMS at interpreter
+            # start; re-apply an explicit cpu-only request before the first
+            # backend touch.
+            if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+                jax.config.update("jax_platforms", "cpu")
+            result.update(
+                {
+                    "JAX backend": jax.default_backend(),
+                    "Device count": jax.device_count(),
+                    "Devices": ", ".join(str(d) for d in jax.devices()[:8]),
+                    "Process count": jax.process_count(),
+                }
+            )
+        except Exception as e:  # import/config/backend-init error
+            result["JAX backend"] = f"ERROR ({type(e).__name__}: {e})"
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout=timeout_s)
+    if not result:
+        return {"JAX backend": f"UNREACHABLE (no response in {timeout_s:.0f}s)"}
+    return result
+
+
 def env_command(args):
     import jax
 
@@ -18,11 +55,8 @@ def env_command(args):
         "Platform": platform.platform(),
         "Python version": platform.python_version(),
         "JAX version": jax.__version__,
-        "JAX backend": jax.default_backend(),
-        "Device count": jax.device_count(),
-        "Devices": ", ".join(str(d) for d in jax.devices()[:8]),
-        "Process count": jax.process_count(),
     }
+    info.update(_probe_devices())
     try:
         import flax, optax
 

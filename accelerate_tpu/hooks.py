@@ -29,6 +29,8 @@ __all__ = [
     "CpuOffload",
     "UserCpuOffloadHook",
     "attach_align_device_hook",
+    "LayerwiseCastingHook",
+    "attach_layerwise_casting_hooks",
     "attach_align_device_hook_on_blocks",
     "named_module_tensors",
     "set_module_tensor_to_device",
@@ -412,3 +414,94 @@ class UserCpuOffloadHook:
 
     def remove(self):
         remove_hook_from_module(self.model)
+
+
+class LayerwiseCastingHook(ModelHook):
+    """Store a layer's weights in a low-precision dtype, upcast to the compute
+    dtype around forward (reference ``hooks.py:741-765``).
+
+    TPU meaning: fp8/bf16 *storage* halves the host-RAM/HBM footprint of a
+    dispatched model while matmuls still run in the compute dtype — the same
+    recipe as the fp8 weight-only path in ``ops/fp8.py``, applied at the torch
+    module boundary.
+    """
+
+    def __init__(self, storage_dtype, compute_dtype, non_blocking: bool = False):
+        self.storage_dtype = storage_dtype
+        self.compute_dtype = compute_dtype
+        self.non_blocking = non_blocking
+
+    def _cast(self, module, dtype):
+        # Direct tensors only — module.to() recurses into children, which would
+        # re-cast submodules the skip list excluded.
+        for p in module.parameters(recurse=False):
+            if p.is_floating_point():
+                p.data = p.data.to(dtype, non_blocking=self.non_blocking)
+        for name, b in module._buffers.items():
+            if b is not None and b.is_floating_point():
+                module._buffers[name] = b.to(dtype, non_blocking=self.non_blocking)
+        return module
+
+    def init_hook(self, module):
+        return self._cast(module, self.storage_dtype)
+
+    def pre_forward(self, module, *args, **kwargs):
+        self._cast(module, self.compute_dtype)
+        return args, kwargs
+
+    def post_forward(self, module, output):
+        self._cast(module, self.storage_dtype)
+        return output
+
+    def detach_hook(self, module):
+        return self._cast(module, self.compute_dtype)
+
+
+_DEFAULT_SKIP_CAST_PATTERNS = ("norm", "embed", "ln_", "layernorm")
+
+
+def attach_layerwise_casting_hooks(
+    module,
+    storage_dtype,
+    compute_dtype,
+    skip_modules_pattern=_DEFAULT_SKIP_CAST_PATTERNS,
+    skip_modules_classes=(),
+    non_blocking: bool = False,
+    _prefix: str = "",
+):
+    """Walk the module tree attaching :class:`LayerwiseCastingHook` to leaf
+    modules with weights, skipping precision-sensitive ones (norms/embeddings
+    by default) — reference ``big_modeling.py:653`` semantics."""
+    import torch
+
+    name = _prefix.rsplit(".", 1)[-1].lower()
+    if (skip_modules_classes and isinstance(module, tuple(skip_modules_classes))) or (
+        skip_modules_pattern and any(p in name for p in skip_modules_pattern)
+    ):
+        return
+    has_own_params = any(True for _ in module.parameters(recurse=False))
+    children = list(module.named_children())
+    if has_own_params and not children:
+        add_hook_to_module(
+            module,
+            LayerwiseCastingHook(storage_dtype, compute_dtype, non_blocking),
+            append=True,
+        )
+        return
+    if has_own_params:
+        # Mixed node: cast its direct params too.
+        add_hook_to_module(
+            module,
+            LayerwiseCastingHook(storage_dtype, compute_dtype, non_blocking),
+            append=True,
+        )
+    for child_name, child in children:
+        attach_layerwise_casting_hooks(
+            child,
+            storage_dtype,
+            compute_dtype,
+            skip_modules_pattern,
+            skip_modules_classes,
+            non_blocking,
+            _prefix=f"{_prefix}.{child_name}" if _prefix else child_name,
+        )

@@ -161,19 +161,30 @@ class GradScalerKwargs(KwargsHandler):
 
 @dataclass
 class DistributedDataParallelKwargs(KwargsHandler):
-    """Accepted for API compatibility (reference ``utils/dataclasses.py:151-226``).
+    """DDP tuning knobs (reference ``utils/dataclasses.py:151-226``).
 
     GSPMD data parallelism has no bucketing / graph-finding knobs — XLA schedules the
-    gradient all-reduce — so these fields are validated then ignored, except
-    ``gradient_as_bucket_view``-style memory hints which map to donation.
+    gradient all-reduce — so those fields are validated then ignored.
+    ``comm_hook`` IS honored: "fp16"/"bf16" hold the accumulated/synced gradient
+    pytree in bf16 (the reference's reduced-precision hooks,
+    ``DDPCommunicationHookType`` ``utils/dataclasses.py:130-149``; bf16 is the
+    hardware-native reduced dtype on TPU).  Note the scope: this halves gradient
+    *storage* (and host/DCN bytes when grads cross process boundaries); the
+    in-jit GSPMD all-reduce over ICI is scheduled by XLA and keeps the compute
+    dtype.
     """
 
     bucket_cap_mb: int = 25
     find_unused_parameters: bool = False
     gradient_as_bucket_view: bool = False
     static_graph: bool = False
-    comm_hook: str = "no"  # reference DDPCommunicationHookType; fp16/bf16 map to
-    # reduced-precision psum via optax transforms.
+    comm_hook: str = "no"  # "no" | "fp16" | "bf16" (powerSGD not supported)
+
+    def __post_init__(self):
+        if self.comm_hook not in ("no", "fp16", "bf16"):
+            raise ValueError(
+                f"comm_hook must be 'no', 'fp16' or 'bf16', got {self.comm_hook!r}"
+            )
 
 
 @dataclass

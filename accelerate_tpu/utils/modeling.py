@@ -13,6 +13,7 @@ planner (SURVEY §2.6 north star).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import re
@@ -31,6 +32,8 @@ __all__ = [
     "load_checkpoint_in_model",
     "find_tied_parameters",
     "check_device_map",
+    "align_module_device",
+    "get_state_dict_offloaded_model",
 ]
 
 
@@ -330,3 +333,98 @@ def _target_for(name: str, device_map: Optional[dict]) -> str:
         return device_map[max(candidates, key=len)]
     module = _module_of(name)
     return _target_for(module, device_map) if module != name else "cpu"
+
+
+@contextlib.contextmanager
+def align_module_device(module, execution_device=None):
+    """Temporarily move all of a module's parameters to ``execution_device``
+    (reference ``utils/modeling.py:2142``).  Offloaded (meta) parameters are
+    materialized from the module's AlignDevicesHook ``weights_map``; everything
+    is restored on exit."""
+    from ..hooks import AlignDevicesHook, named_module_tensors, set_module_tensor_to_device
+
+    hook = getattr(module, "_hf_hook", None)
+    align = None
+    for h in ([hook] if not hasattr(hook, "hooks") else list(hook.hooks)):
+        if isinstance(h, AlignDevicesHook):
+            align = h
+            break
+
+    if align is not None and align.offload:
+        original_device = align.execution_device
+        if execution_device is not None:
+            align.execution_device = execution_device
+        try:
+            align.pre_forward(module)
+            yield
+        finally:
+            align.post_forward(module, None)
+            align.execution_device = original_device
+    elif execution_device is not None:
+        import torch
+
+        target = torch.device(execution_device)
+        # Data-level moves (p.data = ...) preserve Parameter identity, so
+        # optimizer references, tied weights and .grad survive; no-op when the
+        # tensor already lives on the target device.
+        moved: list = []
+        try:
+            for _, p in sorted(named_module_tensors(module, recurse=True)):
+                if p.device != target:
+                    moved.append((p, p.device))
+                    p.data = p.data.to(target)
+            yield
+        finally:
+            for p, device in moved:
+                p.data = p.data.to(device)
+    else:
+        yield
+
+
+def get_state_dict_offloaded_model(model) -> dict:
+    """Full state dict of a dispatched model whose blocks may live on meta with
+    disk/cpu-offloaded weights (reference ``utils/modeling.py:1710-1782``):
+    each offloaded block is temporarily onloaded via its hook, copied out, and
+    released, so peak memory is one block."""
+    state_dict = {}
+    placeholders = set()
+    failures: dict[str, str] = {}
+    for name, module in model.named_modules():
+        if name == "":
+            continue
+        try:
+            with align_module_device(module, "cpu"):
+                module_state = {
+                    f"{name}.{k}": v.detach().cpu().clone()
+                    for k, v in module.state_dict(keep_vars=True).items()
+                    if "." not in k  # direct tensors only; children handled in their own visit
+                }
+        except Exception as e:
+            # A module whose onload fails must surface, not silently drop its
+            # weights from the returned dict (a checkpoint would be corrupt).
+            if any(True for _ in module.parameters(recurse=False)) or any(
+                True for _ in module.buffers(recurse=False)
+            ):
+                failures[name] = f"{type(e).__name__}: {e}"
+            continue
+        for key, value in module_state.items():
+            if value.device.type == "meta":
+                placeholders.add(key)
+            else:
+                state_dict[key] = value
+    # root-level direct tensors
+    root_state = {
+        k: v.detach().cpu().clone()
+        for k, v in model.state_dict(keep_vars=True).items()
+        if "." not in k
+    }
+    for k, v in root_state.items():
+        if v.device.type != "meta":
+            state_dict[k] = v
+    placeholders -= set(state_dict)
+    if placeholders or failures:
+        raise RuntimeError(
+            f"offloaded weights could not be materialized: {sorted(placeholders)}; "
+            f"module onload failures: {failures}"
+        )
+    return state_dict

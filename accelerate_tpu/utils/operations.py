@@ -84,6 +84,16 @@ def is_tensor_like(x: Any) -> bool:
 
 def to_numpy(x: Any) -> np.ndarray:
     if is_torch_tensor(x):
+        import torch
+
+        if x.dtype == torch.bfloat16:
+            # numpy() rejects bf16; round-trip losslessly via a uint16 view
+            # into an ml_dtypes bfloat16 array.
+            import ml_dtypes
+
+            return (
+                x.detach().cpu().view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+            )
         return x.detach().cpu().numpy()
     return np.asarray(x)
 
@@ -322,17 +332,35 @@ def gather(tensor):
     state = PartialState()
 
     def _gather(t):
+        torch_template = t if _is_torch_tensor(t) else None
         if isinstance(t, jax.Array) and not t.is_fully_addressable:
             # Global array spanning hosts: replicate to host (full logical value).
             from jax.experimental import multihost_utils
 
             return np.asarray(multihost_utils.process_allgather(t))
         t = to_numpy(t)
-        if state.num_processes == 1:
-            return t
-        return _process_allgather(t, tiled=True)
+        out = t if state.num_processes == 1 else _process_allgather(t, tiled=True)
+        if torch_template is not None:
+            # Type parity with the reference: torch in → torch out.
+            out = _numpy_to_torch(out)
+        return out
 
     return recursively_apply(_gather, tensor, error_on_other_type=True)
+
+
+def _is_torch_tensor(t) -> bool:
+    import sys
+
+    torch = sys.modules.get("torch")
+    return torch is not None and isinstance(t, torch.Tensor)
+
+
+def _numpy_to_torch(arr: np.ndarray):
+    import torch
+
+    if arr.dtype.name == "bfloat16":  # ml_dtypes bf16 -> torch via uint16 view
+        return torch.from_numpy(arr.view(np.uint16).copy()).view(torch.bfloat16)
+    return torch.from_numpy(np.ascontiguousarray(arr))
 
 
 def gather_object(object: Any):

@@ -22,6 +22,7 @@ constraint torch.compile/XLA impose).
 
 from __future__ import annotations
 
+import collections
 import math
 import operator
 from typing import Any, Callable, Optional
@@ -277,6 +278,18 @@ def _build_tables():
         torch.zeros: lambda *a, **k: jnp.zeros(a[0] if len(a) == 1 else a, dtype=_DTYPE_MAP.get(k.get("dtype"), jnp.float32)),
         torch.where: jnp.where,
         torch.clamp: lambda x, min=None, max=None: jnp.clip(x, min, max),
+        torch.zeros_like: lambda x, **k: jnp.zeros_like(x),
+        torch.ones_like: lambda x, **k: jnp.ones_like(x),
+        torch.full_like: lambda x, v, **k: jnp.full_like(x, v),
+        torch.cumsum: lambda x, dim, **k: jnp.cumsum(x, axis=dim),
+        torch.cumprod: lambda x, dim, **k: jnp.cumprod(x, axis=dim),
+        torch.max: _torch_max,
+        torch.min: _torch_min,
+        torch.argmax: lambda x, dim=None, keepdim=False: jnp.argmax(x, axis=dim, keepdims=keepdim),
+        torch.tanh: jnp.tanh,
+        torch.sigmoid: jax.nn.sigmoid,
+        torch.sin: jnp.sin,
+        torch.cos: jnp.cos,
         operator.add: operator.add,
         operator.sub: operator.sub,
         operator.mul: operator.mul,
@@ -286,7 +299,7 @@ def _build_tables():
         operator.neg: operator.neg,
         operator.getitem: _getitem,
         operator.matmul: _matmul,
-        getattr: getattr,
+        getattr: _safe_getattr,
     }
 
     module_table: dict[type, Callable] = {
@@ -369,10 +382,43 @@ def _build_tables():
     return function_table, module_table, method_table
 
 
+# torch.max/min have three call forms: reduce-all, reduce-dim (returns a
+# namedtuple with .values/.indices), and elementwise two-tensor.
+_MinMax = collections.namedtuple("minmax", ["values", "indices"])
+
+
+def _torch_max(x, dim=None, keepdim=False, **_):
+    if dim is None:
+        return jnp.max(x)
+    if not isinstance(dim, int):  # torch.max(a, b): elementwise maximum
+        return jnp.maximum(x, dim)
+    return _MinMax(jnp.max(x, axis=dim, keepdims=keepdim), jnp.argmax(x, axis=dim, keepdims=keepdim))
+
+
+def _torch_min(x, dim=None, keepdim=False, **_):
+    if dim is None:
+        return jnp.min(x)
+    if not isinstance(dim, int):
+        return jnp.minimum(x, dim)
+    return _MinMax(jnp.min(x, axis=dim, keepdims=keepdim), jnp.argmin(x, axis=dim, keepdims=keepdim))
+
+
 def _is_torch_extra(x):
     import torch
 
-    return isinstance(x, (torch.device, torch.dtype))
+    return isinstance(x, (torch.device, torch.dtype)) or x is _JAX_DEVICE_SENTINEL
+
+
+# Placeholder returned for `.device` on traced jax values (`tensor.device` in
+# torch code is placement metadata — meaningless under jit, where XLA owns
+# placement).  Filtered out of factory-function args like torch.device is.
+_JAX_DEVICE_SENTINEL = object()
+
+
+def _safe_getattr(obj, name, *default):
+    if name == "device" and not hasattr(obj, "device"):
+        return _JAX_DEVICE_SENTINEL
+    return getattr(obj, name, *default)
 
 
 def _getitem(x, idx):

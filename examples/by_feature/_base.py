@@ -1,0 +1,19 @@
+"""Shared loader for by_feature examples: imports the canonical nlp_example
+components so each feature script shows ONLY its feature's delta (the
+reference keeps its by_feature scripts in sync with the canonical example via
+AST diff, tests/test_examples.py; importing makes the sync structural)."""
+
+import importlib.util
+import os
+import sys
+
+_EXAMPLES_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_nlp_example():
+    path = os.path.join(_EXAMPLES_DIR, "nlp_example.py")
+    spec = importlib.util.spec_from_file_location("nlp_example", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("nlp_example", mod)
+    spec.loader.exec_module(mod)
+    return mod

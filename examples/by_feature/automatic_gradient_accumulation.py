@@ -1,0 +1,93 @@
+"""Feature: combine ``find_executable_batch_size`` with automatic gradient
+accumulation (reference ``examples/by_feature/automatic_gradient_accumulation.py``).
+
+The script targets an OBSERVED batch size (``--target_batch_size``): if the
+device can't fit it, the OOM-retry halves the per-step batch and doubles
+``gradient_accumulation_steps`` so the effective batch stays constant.
+
+Run: python examples/by_feature/automatic_gradient_accumulation.py --target_batch_size 64
+"""
+
+import argparse
+
+import torch
+from torch.optim.lr_scheduler import LambdaLR
+
+from accelerate_tpu import Accelerator, find_executable_batch_size
+from accelerate_tpu.utils import set_seed
+
+from _base import load_nlp_example
+
+nlp = load_nlp_example()
+
+
+def training_function(config, args):
+    set_seed(int(config["seed"]))
+    observed = []
+
+    @find_executable_batch_size(starting_batch_size=args.target_batch_size)
+    def inner_training_loop(batch_size):
+        # Keep the effective batch at target by accumulating the difference.
+        accumulation_steps = max(1, args.target_batch_size // batch_size)
+        observed.append((batch_size, accumulation_steps))
+        accelerator = Accelerator(
+            cpu=args.cpu,
+            mixed_precision=args.mixed_precision,
+            gradient_accumulation_steps=accumulation_steps,
+        )
+        train_dataloader, eval_dataloader = nlp.get_dataloaders(accelerator, batch_size)
+        model = nlp.PairClassifier()
+        optimizer = torch.optim.AdamW(model.parameters(), lr=config["lr"])
+        total_steps = int(config["num_epochs"]) * len(train_dataloader)
+        lr_scheduler = LambdaLR(optimizer, lambda step: max(0.0, 1.0 - step / max(total_steps, 1)))
+        model, optimizer, train_dataloader, eval_dataloader, lr_scheduler = accelerator.prepare(
+            model, optimizer, train_dataloader, eval_dataloader, lr_scheduler
+        )
+        criterion = torch.nn.CrossEntropyLoss()
+        final_accuracy = 0.0
+        for epoch in range(int(config["num_epochs"])):
+            model.train()
+            for batch in train_dataloader:
+                with accelerator.accumulate(model):
+                    logits = model(batch["input_ids_a"], batch["input_ids_b"])
+                    loss = criterion(logits, batch["labels"])
+                    accelerator.backward(loss)
+                    optimizer.step()
+                    lr_scheduler.step()
+                    optimizer.zero_grad()
+            model.eval()
+            correct, total = 0, 0
+            for batch in eval_dataloader:
+                with torch.no_grad():
+                    logits = model(batch["input_ids_a"], batch["input_ids_b"])
+                preds = torch.argmax(logits, dim=-1)
+                preds, refs = accelerator.gather_for_metrics((preds, batch["labels"]))
+                correct += int((preds == refs).sum())
+                total += len(refs)
+            final_accuracy = correct / max(total, 1)
+            accelerator.print(
+                f"epoch {epoch}: accuracy {final_accuracy:.3f} "
+                f"(batch {batch_size} x accum {accumulation_steps})"
+            )
+        accelerator.free_memory()
+        return final_accuracy
+
+    acc = inner_training_loop()
+    print(f"(batch_size, accumulation_steps) tried: {observed}")
+    return acc
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Automatic gradient-accumulation example")
+    parser.add_argument("--mixed_precision", type=str, default=None,
+                        choices=["no", "fp16", "bf16", "fp8"])
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--target_batch_size", type=int, default=64)
+    parser.add_argument("--num_epochs", type=int, default=2)
+    args = parser.parse_args()
+    config = {"lr": 2e-3, "num_epochs": args.num_epochs, "seed": 42, "batch_size": 16}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,107 @@
+"""Feature: checkpoint/resume with ``save_state``/``load_state`` and mid-epoch
+``skip_first_batches`` (reference ``examples/by_feature/checkpointing.py``).
+
+Saves a checkpoint every epoch under ``ProjectConfiguration``'s automatic
+naming, then shows resuming: restore the latest checkpoint and skip the
+already-consumed batches of the current epoch.
+
+Run: python examples/by_feature/checkpointing.py --checkpointing_steps epoch \
+         --project_dir ./ckpt_demo [--resume_from_checkpoint ./ckpt_demo/checkpoints/checkpoint_0]
+"""
+
+import argparse
+import os
+
+import torch
+from torch.optim.lr_scheduler import LambdaLR
+
+from accelerate_tpu import Accelerator, skip_first_batches
+from accelerate_tpu.utils import ProjectConfiguration, set_seed
+
+from _base import load_nlp_example
+
+nlp = load_nlp_example()
+
+
+def training_function(config, args):
+    project_config = ProjectConfiguration(
+        project_dir=args.project_dir, automatic_checkpoint_naming=True, total_limit=3
+    )
+    accelerator = Accelerator(
+        cpu=args.cpu, mixed_precision=args.mixed_precision, project_config=project_config
+    )
+    set_seed(int(config["seed"]))
+    train_dataloader, eval_dataloader = nlp.get_dataloaders(accelerator, int(config["batch_size"]))
+    model = nlp.PairClassifier()
+    optimizer = torch.optim.AdamW(model.parameters(), lr=config["lr"])
+    total_steps = int(config["num_epochs"]) * len(train_dataloader)
+    lr_scheduler = LambdaLR(optimizer, lambda step: max(0.0, 1.0 - step / max(total_steps, 1)))
+
+    model, optimizer, train_dataloader, eval_dataloader, lr_scheduler = accelerator.prepare(
+        model, optimizer, train_dataloader, eval_dataloader, lr_scheduler
+    )
+
+    starting_epoch = 0
+    resume_step = None
+    if args.resume_from_checkpoint:
+        accelerator.load_state(args.resume_from_checkpoint)
+        # Checkpoint name encodes the epoch it was saved after (epoch granularity).
+        ckpt_idx = int(os.path.basename(args.resume_from_checkpoint).split("_")[-1])
+        starting_epoch = ckpt_idx + 1
+
+    criterion = torch.nn.CrossEntropyLoss()
+    overall_step = 0
+    final_accuracy = 0.0
+    for epoch in range(starting_epoch, int(config["num_epochs"])):
+        model.train()
+        active_dataloader = train_dataloader
+        if resume_step is not None:
+            # Mid-epoch resume path: fast-forward the already-consumed batches.
+            active_dataloader = skip_first_batches(train_dataloader, resume_step)
+            resume_step = None
+        for batch in active_dataloader:
+            logits = model(batch["input_ids_a"], batch["input_ids_b"])
+            loss = criterion(logits, batch["labels"])
+            accelerator.backward(loss)
+            optimizer.step()
+            lr_scheduler.step()
+            optimizer.zero_grad()
+            overall_step += 1
+            if args.checkpointing_steps not in (None, "epoch") and overall_step % int(
+                args.checkpointing_steps
+            ) == 0:
+                accelerator.save_state()
+        if args.checkpointing_steps == "epoch":
+            accelerator.save_state()
+
+        model.eval()
+        correct, total = 0, 0
+        for batch in eval_dataloader:
+            with torch.no_grad():
+                logits = model(batch["input_ids_a"], batch["input_ids_b"])
+            preds = torch.argmax(logits, dim=-1)
+            preds, refs = accelerator.gather_for_metrics((preds, batch["labels"]))
+            correct += int((preds == refs).sum())
+            total += len(refs)
+        final_accuracy = correct / max(total, 1)
+        accelerator.print(f"epoch {epoch}: accuracy {final_accuracy:.3f}")
+    return final_accuracy
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Checkpointing example")
+    parser.add_argument("--mixed_precision", type=str, default=None,
+                        choices=["no", "fp16", "bf16", "fp8"])
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--checkpointing_steps", type=str, default="epoch",
+                        help='"epoch", or an integer number of steps')
+    parser.add_argument("--project_dir", type=str, default="./ckpt_demo")
+    parser.add_argument("--resume_from_checkpoint", type=str, default=None)
+    parser.add_argument("--num_epochs", type=int, default=3)
+    args = parser.parse_args()
+    config = {"lr": 2e-3, "num_epochs": args.num_epochs, "seed": 42, "batch_size": 16}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+"""Feature: k-fold cross validation, aggregating fold predictions across
+processes (reference ``examples/by_feature/cross_validation.py``).
+
+Each fold trains a fresh model on k-1 splits and evaluates on the held-out
+test split; predictions are ``gather_for_metrics``-ed, and the final metric
+averages the folds.
+
+Run: python examples/by_feature/cross_validation.py --num_folds 3
+"""
+
+import argparse
+
+import numpy as np
+import torch
+from torch.optim.lr_scheduler import LambdaLR
+from torch.utils.data import DataLoader
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.utils import set_seed
+
+from _base import load_nlp_example
+
+nlp = load_nlp_example()
+
+
+def get_fold_dataloaders(accelerator, fold: int, num_folds: int, batch_size: int):
+    """Split the training set into ``num_folds``; train on k-1, validate on the
+    held-out fold, test on the canonical validation set."""
+    data = nlp.make_dataset(512, seed=0)
+    folds = np.array_split(np.arange(len(data)), num_folds)
+    heldout = set(folds[fold].tolist())
+    train = [s for i, s in enumerate(data) if i not in heldout]
+    valid = [s for i, s in enumerate(data) if i in heldout]
+    test = nlp.make_dataset(128, seed=1)
+    return (
+        DataLoader(train, shuffle=True, collate_fn=nlp.collate, batch_size=batch_size),
+        DataLoader(valid, shuffle=False, collate_fn=nlp.collate, batch_size=nlp.EVAL_BATCH_SIZE),
+        DataLoader(test, shuffle=False, collate_fn=nlp.collate, batch_size=nlp.EVAL_BATCH_SIZE),
+    )
+
+
+def training_function(config, args):
+    accelerator = Accelerator(cpu=args.cpu, mixed_precision=args.mixed_precision)
+    set_seed(int(config["seed"]))
+    criterion = torch.nn.CrossEntropyLoss()
+    test_fold_logits = []
+    test_refs = None
+
+    for fold in range(args.num_folds):
+        train_dl, valid_dl, test_dl = get_fold_dataloaders(
+            accelerator, fold, args.num_folds, int(config["batch_size"])
+        )
+        model = nlp.PairClassifier()
+        optimizer = torch.optim.AdamW(model.parameters(), lr=config["lr"])
+        total_steps = int(config["num_epochs"]) * len(train_dl)
+        lr_scheduler = LambdaLR(optimizer, lambda step: max(0.0, 1.0 - step / max(total_steps, 1)))
+        model, optimizer, train_dl, valid_dl, test_dl, lr_scheduler = accelerator.prepare(
+            model, optimizer, train_dl, valid_dl, test_dl, lr_scheduler
+        )
+
+        for epoch in range(int(config["num_epochs"])):
+            model.train()
+            for batch in train_dl:
+                logits = model(batch["input_ids_a"], batch["input_ids_b"])
+                loss = criterion(logits, batch["labels"])
+                accelerator.backward(loss)
+                optimizer.step()
+                lr_scheduler.step()
+                optimizer.zero_grad()
+
+        # Held-out fold metric (monitoring only).
+        model.eval()
+        correct, total = 0, 0
+        for batch in valid_dl:
+            with torch.no_grad():
+                logits = model(batch["input_ids_a"], batch["input_ids_b"])
+            preds = torch.argmax(logits, dim=-1)
+            preds, refs = accelerator.gather_for_metrics((preds, batch["labels"]))
+            correct += int((preds == refs).sum())
+            total += len(refs)
+        accelerator.print(f"fold {fold}: heldout accuracy {correct / max(total, 1):.3f}")
+
+        # Accumulate test-set logits for the ensemble metric.
+        fold_logits, fold_refs = [], []
+        for batch in test_dl:
+            with torch.no_grad():
+                logits = model(batch["input_ids_a"], batch["input_ids_b"])
+            logits, refs = accelerator.gather_for_metrics((logits, batch["labels"]))
+            fold_logits.append(logits.float())
+            fold_refs.append(refs)
+        test_fold_logits.append(torch.cat(fold_logits))
+        test_refs = torch.cat(fold_refs)
+        accelerator.free_memory()
+
+    # Ensemble: average fold logits, then score.
+    ensemble = torch.stack(test_fold_logits).mean(dim=0)
+    preds = torch.argmax(ensemble, dim=-1)
+    accuracy = float((preds == test_refs).float().mean())
+    accelerator.print(f"ensemble test accuracy over {args.num_folds} folds: {accuracy:.3f}")
+    return accuracy
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Cross-validation example")
+    parser.add_argument("--mixed_precision", type=str, default=None,
+                        choices=["no", "fp16", "bf16", "fp8"])
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--num_folds", type=int, default=3)
+    parser.add_argument("--num_epochs", type=int, default=2)
+    args = parser.parse_args()
+    config = {"lr": 2e-3, "num_epochs": args.num_epochs, "seed": 42, "batch_size": 16}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

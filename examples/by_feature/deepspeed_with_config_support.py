@@ -1,0 +1,105 @@
+"""Feature: DeepSpeed config-file support (reference
+``examples/by_feature/deepspeed_with_config_support.py``).
+
+A ``ds_config.json`` is accepted as a *dialect*: ZeRO stage → GSPMD sharding
+strategy on the ``fsdp`` mesh axis (stage 3 = FULL_SHARD, 2 = SHARD_GRAD_OP,
+0/1 = replicated), ``gradient_accumulation_steps``/``bf16``/clipping picked up
+from the config, and ``optimizer``/``scheduler`` sections materialized through
+``DummyOptim``/``DummyScheduler`` exactly like the reference.
+
+Run: python examples/by_feature/deepspeed_with_config_support.py \
+        [--config_file my_ds_config.json]
+"""
+
+import argparse
+import json
+import os
+import tempfile
+
+import torch
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.utils import set_seed
+from accelerate_tpu.utils.deepspeed import DeepSpeedPlugin
+from accelerate_tpu.utils.deepspeed import DummyOptim, DummyScheduler
+
+from _base import load_nlp_example
+
+nlp = load_nlp_example()
+
+DEFAULT_DS_CONFIG = {
+    "train_micro_batch_size_per_gpu": 16,
+    "gradient_accumulation_steps": 1,
+    "zero_optimization": {"stage": 2},
+    "bf16": {"enabled": True},
+    "gradient_clipping": 1.0,
+    "optimizer": {"type": "AdamW", "params": {"lr": 2e-3, "weight_decay": 0.0}},
+    "scheduler": {
+        "type": "WarmupDecayLR",
+        "params": {"warmup_num_steps": 4, "total_num_steps": 100, "warmup_min_lr": 0.0},
+    },
+}
+
+
+def training_function(config, args):
+    if args.config_file:
+        ds_config_path = args.config_file
+    else:
+        fd, ds_config_path = tempfile.mkstemp(suffix=".json")
+        with os.fdopen(fd, "w") as f:
+            json.dump(DEFAULT_DS_CONFIG, f)
+
+    plugin = DeepSpeedPlugin(hf_ds_config=ds_config_path)
+    accelerator = Accelerator(cpu=args.cpu, deepspeed_plugin=plugin)
+    set_seed(int(config["seed"]))
+    train_dataloader, eval_dataloader = nlp.get_dataloaders(accelerator, int(config["batch_size"]))
+    model = nlp.PairClassifier()
+    # Optimizer/scheduler come from the DS config sections: pass Dummy objects,
+    # prepare() materializes real ones with the config's hyperparameters.
+    optimizer = DummyOptim(model.parameters())
+    lr_scheduler = DummyScheduler(optimizer)
+
+    model, optimizer, train_dataloader, eval_dataloader, lr_scheduler = accelerator.prepare(
+        model, optimizer, train_dataloader, eval_dataloader, lr_scheduler
+    )
+
+    criterion = torch.nn.CrossEntropyLoss()
+    final_accuracy = 0.0
+    for epoch in range(int(config["num_epochs"])):
+        model.train()
+        for batch in train_dataloader:
+            with accelerator.accumulate(model):
+                logits = model(batch["input_ids_a"], batch["input_ids_b"])
+                loss = criterion(logits, batch["labels"])
+                accelerator.backward(loss)
+                optimizer.step()
+                lr_scheduler.step()
+                optimizer.zero_grad()
+
+        model.eval()
+        correct, total = 0, 0
+        for batch in eval_dataloader:
+            with torch.no_grad():
+                logits = model(batch["input_ids_a"], batch["input_ids_b"])
+            preds = torch.argmax(logits, dim=-1)
+            preds, refs = accelerator.gather_for_metrics((preds, batch["labels"]))
+            correct += int((preds == refs).sum())
+            total += len(refs)
+        final_accuracy = correct / max(total, 1)
+        accelerator.print(f"epoch {epoch}: accuracy {final_accuracy:.3f}")
+    return final_accuracy
+
+
+def main():
+    parser = argparse.ArgumentParser(description="DeepSpeed-config-dialect example")
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--config_file", type=str, default=None,
+                        help="Path to a DeepSpeed JSON config (default: built-in zero-2).")
+    parser.add_argument("--num_epochs", type=int, default=3)
+    args = parser.parse_args()
+    config = {"num_epochs": args.num_epochs, "seed": 42, "batch_size": 16}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+"""Feature: coordinated early stopping with ``set_trigger``/``check_trigger``
+(reference ``examples/by_feature/early_stopping.py``).
+
+Any process may raise the trigger (here: loss below a threshold); the check is
+an all-reduce, so EVERY process sees it and breaks on the same step — no
+deadlocked collective with half the replicas still in the loop.
+
+Run: python examples/by_feature/early_stopping.py
+"""
+
+import argparse
+
+import torch
+from torch.optim.lr_scheduler import LambdaLR
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.utils import set_seed
+
+from _base import load_nlp_example
+
+nlp = load_nlp_example()
+
+
+class EarlyStoppingCallback:
+    """Raise the breakpoint trigger once the loss stays under ``threshold``."""
+
+    def __init__(self, threshold: float = 0.25, patience: int = 3):
+        self.threshold = threshold
+        self.patience = patience
+        self.count = 0
+
+    def check_early_stopping(self, loss: float) -> bool:
+        self.count = self.count + 1 if loss < self.threshold else 0
+        return self.count >= self.patience
+
+
+def training_function(config, args):
+    accelerator = Accelerator(cpu=args.cpu, mixed_precision=args.mixed_precision)
+    set_seed(int(config["seed"]))
+    train_dataloader, eval_dataloader = nlp.get_dataloaders(accelerator, int(config["batch_size"]))
+    model = nlp.PairClassifier()
+    optimizer = torch.optim.AdamW(model.parameters(), lr=config["lr"])
+    total_steps = int(config["num_epochs"]) * len(train_dataloader)
+    lr_scheduler = LambdaLR(optimizer, lambda step: max(0.0, 1.0 - step / max(total_steps, 1)))
+
+    model, optimizer, train_dataloader, eval_dataloader, lr_scheduler = accelerator.prepare(
+        model, optimizer, train_dataloader, eval_dataloader, lr_scheduler
+    )
+
+    callback = EarlyStoppingCallback(threshold=0.25)
+    criterion = torch.nn.CrossEntropyLoss()
+    stopped_at = None
+    step = 0
+    for epoch in range(int(config["num_epochs"])):
+        model.train()
+        for batch in train_dataloader:
+            logits = model(batch["input_ids_a"], batch["input_ids_b"])
+            loss = criterion(logits, batch["labels"])
+            accelerator.backward(loss)
+            # This process votes to stop...
+            if callback.check_early_stopping(float(loss.detach())):
+                accelerator.set_trigger()
+            optimizer.step()
+            lr_scheduler.step()
+            optimizer.zero_grad()
+            step += 1
+            # ...and ALL processes agree via the all-reduced trigger.
+            if accelerator.check_trigger():
+                stopped_at = step
+                break
+        if stopped_at is not None:
+            break
+    accelerator.print(
+        f"stopped early at step {stopped_at}" if stopped_at else "ran to completion"
+    )
+    return stopped_at
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Early-stopping example")
+    parser.add_argument("--mixed_precision", type=str, default=None,
+                        choices=["no", "fp16", "bf16", "fp8"])
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--num_epochs", type=int, default=5)
+    args = parser.parse_args()
+    config = {"lr": 2e-3, "num_epochs": args.num_epochs, "seed": 42, "batch_size": 16}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

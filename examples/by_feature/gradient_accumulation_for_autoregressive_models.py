@@ -1,0 +1,117 @@
+"""Feature: gradient accumulation for autoregressive LMs with correct
+cross-micro-batch loss normalization (reference
+``examples/by_feature/gradient_accumulation_for_autoregressive_models.py``).
+
+Plain ``accumulate()`` scales each micro-batch loss by 1/steps — correct when
+every micro-batch holds the same number of loss tokens, WRONG for causal LM
+batches of varying length.  The fix (same as the reference): normalize by the
+number of non-padding tokens summed over the whole accumulation window, not
+per micro-batch.
+
+Run: python examples/by_feature/gradient_accumulation_for_autoregressive_models.py
+"""
+
+import argparse
+
+import numpy as np
+import torch
+from torch.utils.data import DataLoader
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.utils import set_seed
+
+VOCAB = 256
+PAD = 0
+
+
+class TinyCausalLM(torch.nn.Module):
+    def __init__(self, vocab=VOCAB, dim=64):
+        super().__init__()
+        self.embed = torch.nn.Embedding(vocab, dim)
+        self.proj = torch.nn.Linear(dim, dim)
+        self.head = torch.nn.Linear(dim, vocab)
+
+    def forward(self, input_ids):
+        h = self.embed(input_ids)
+        # Causal mixing: cumulative mean over positions (no future leakage).
+        h = torch.cumsum(self.proj(h), dim=1) / torch.arange(
+            1, h.shape[1] + 1, device=h.device
+        ).view(1, -1, 1)
+        return self.head(h)
+
+
+def make_dataset(n: int, seed: int):
+    """Variable-length repeated-pattern sequences, padded to 32."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        length = int(rng.integers(8, 33))
+        pattern = rng.integers(1, VOCAB, 4)
+        ids = np.tile(pattern, 9)[:length]
+        padded = np.full(32, PAD)
+        padded[:length] = ids
+        out.append(torch.tensor(padded))
+    return out
+
+
+def collate(samples):
+    return {"input_ids": torch.stack(samples)}
+
+
+def training_function(config, args):
+    accelerator = Accelerator(
+        cpu=args.cpu,
+        mixed_precision=args.mixed_precision,
+        gradient_accumulation_steps=args.gradient_accumulation_steps,
+    )
+    set_seed(int(config["seed"]))
+    data = make_dataset(256, seed=0)
+    train_dataloader = DataLoader(data, shuffle=True, collate_fn=collate, batch_size=8)
+    model = TinyCausalLM()
+    optimizer = torch.optim.AdamW(model.parameters(), lr=config["lr"])
+    model, optimizer, train_dataloader = accelerator.prepare(model, optimizer, train_dataloader)
+
+    n_accum = args.gradient_accumulation_steps
+    losses = []
+    batches = list(train_dataloader)
+    for epoch in range(int(config["num_epochs"])):
+        model.train()
+        for window_start in range(0, len(batches) - n_accum + 1, n_accum):
+            window = batches[window_start : window_start + n_accum]
+            # Token count over the WHOLE window: the correct normalizer.
+            num_tokens = sum(int((b["input_ids"][:, 1:] != PAD).sum()) for b in window)
+            for batch in window:
+                with accelerator.accumulate(model):
+                    ids = batch["input_ids"]
+                    logits = model(ids[:, :-1])
+                    targets = ids[:, 1:]
+                    token_loss = torch.nn.functional.cross_entropy(
+                        logits.reshape(-1, VOCAB), targets.reshape(-1), reduction="none"
+                    )
+                    mask = (targets != PAD).reshape(-1).float()
+                    # Sum (not mean) over tokens, divided by the window total;
+                    # accumulate() multiplies by 1/n_accum, so pre-multiply by
+                    # n_accum to cancel it (reference's trick).
+                    loss = (token_loss * mask).sum() * n_accum / max(num_tokens, 1)
+                    accelerator.backward(loss)
+                    optimizer.step()
+                    optimizer.zero_grad()
+                    losses.append(float(loss.detach()) / n_accum)
+        accelerator.print(f"epoch {epoch}: loss {np.mean(losses[-10:]):.4f}")
+    return losses[0], float(np.mean(losses[-10:]))
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Autoregressive grad-accum example")
+    parser.add_argument("--mixed_precision", type=str, default=None,
+                        choices=["no", "fp16", "bf16", "fp8"])
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=2)
+    parser.add_argument("--num_epochs", type=int, default=2)
+    args = parser.parse_args()
+    config = {"lr": 2e-3, "num_epochs": args.num_epochs, "seed": 42}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""Feature: automatic OOM-retry with ``find_executable_batch_size`` (reference
+``examples/by_feature/memory.py``).
+
+The decorated inner function re-runs with a halved batch size whenever it
+raises an out-of-memory error (torch CUDA OOM / XLA RESOURCE_EXHAUSTED
+patterns, utils/memory.py), so one script serves every chip size.
+
+Run: python examples/by_feature/memory.py
+"""
+
+import argparse
+
+import torch
+from torch.optim.lr_scheduler import LambdaLR
+
+from accelerate_tpu import Accelerator, find_executable_batch_size
+from accelerate_tpu.utils import set_seed
+
+from _base import load_nlp_example
+
+nlp = load_nlp_example()
+
+
+def training_function(config, args):
+    accelerator = Accelerator(cpu=args.cpu, mixed_precision=args.mixed_precision)
+    set_seed(int(config["seed"]))
+    observed_batch_sizes = []
+
+    @find_executable_batch_size(starting_batch_size=int(config["batch_size"]))
+    def inner_training_loop(batch_size):
+        # Everything that allocates device memory lives INSIDE the decorated
+        # function, so a retry starts clean.
+        nonlocal observed_batch_sizes
+        observed_batch_sizes.append(batch_size)
+        accelerator.free_memory()
+        train_dataloader, eval_dataloader = nlp.get_dataloaders(accelerator, batch_size)
+        model = nlp.PairClassifier()
+        optimizer = torch.optim.AdamW(model.parameters(), lr=config["lr"])
+        total_steps = int(config["num_epochs"]) * len(train_dataloader)
+        lr_scheduler = LambdaLR(optimizer, lambda step: max(0.0, 1.0 - step / max(total_steps, 1)))
+        model, optimizer, train_dataloader, eval_dataloader, lr_scheduler = accelerator.prepare(
+            model, optimizer, train_dataloader, eval_dataloader, lr_scheduler
+        )
+        criterion = torch.nn.CrossEntropyLoss()
+        final_accuracy = 0.0
+        for epoch in range(int(config["num_epochs"])):
+            model.train()
+            for batch in train_dataloader:
+                logits = model(batch["input_ids_a"], batch["input_ids_b"])
+                loss = criterion(logits, batch["labels"])
+                accelerator.backward(loss)
+                optimizer.step()
+                lr_scheduler.step()
+                optimizer.zero_grad()
+            model.eval()
+            correct, total = 0, 0
+            for batch in eval_dataloader:
+                with torch.no_grad():
+                    logits = model(batch["input_ids_a"], batch["input_ids_b"])
+                preds = torch.argmax(logits, dim=-1)
+                preds, refs = accelerator.gather_for_metrics((preds, batch["labels"]))
+                correct += int((preds == refs).sum())
+                total += len(refs)
+            final_accuracy = correct / max(total, 1)
+            accelerator.print(f"epoch {epoch}: accuracy {final_accuracy:.3f} (batch {batch_size})")
+        return final_accuracy
+
+    acc = inner_training_loop()
+    accelerator.print(f"batch sizes tried: {observed_batch_sizes}")
+    return acc
+
+
+def main():
+    parser = argparse.ArgumentParser(description="OOM-retry example")
+    parser.add_argument("--mixed_precision", type=str, default=None,
+                        choices=["no", "fp16", "bf16", "fp8"])
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--num_epochs", type=int, default=3)
+    args = parser.parse_args()
+    config = {"lr": 2e-3, "num_epochs": args.num_epochs, "seed": 42, "batch_size": 16}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

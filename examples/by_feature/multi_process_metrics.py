@@ -1,0 +1,81 @@
+"""Feature: correct distributed metrics with ``gather_for_metrics`` (reference
+``examples/by_feature/multi_process_metrics.py``).
+
+A plain ``gather`` over an even-batches dataloader double-counts the samples
+that were duplicated to pad the last batch; ``gather_for_metrics`` strips that
+padding so the metric equals the single-process value exactly.
+
+Run: python examples/by_feature/multi_process_metrics.py
+"""
+
+import argparse
+
+import torch
+from torch.optim.lr_scheduler import LambdaLR
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.utils import set_seed
+
+from _base import load_nlp_example
+
+nlp = load_nlp_example()
+
+
+def training_function(config, args):
+    accelerator = Accelerator(cpu=args.cpu, mixed_precision=args.mixed_precision)
+    set_seed(int(config["seed"]))
+    train_dataloader, eval_dataloader = nlp.get_dataloaders(accelerator, int(config["batch_size"]))
+    model = nlp.PairClassifier()
+    optimizer = torch.optim.AdamW(model.parameters(), lr=config["lr"])
+    total_steps = int(config["num_epochs"]) * len(train_dataloader)
+    lr_scheduler = LambdaLR(optimizer, lambda step: max(0.0, 1.0 - step / max(total_steps, 1)))
+
+    model, optimizer, train_dataloader, eval_dataloader, lr_scheduler = accelerator.prepare(
+        model, optimizer, train_dataloader, eval_dataloader, lr_scheduler
+    )
+
+    criterion = torch.nn.CrossEntropyLoss()
+    final_accuracy = 0.0
+    for epoch in range(int(config["num_epochs"])):
+        model.train()
+        for batch in train_dataloader:
+            logits = model(batch["input_ids_a"], batch["input_ids_b"])
+            loss = criterion(logits, batch["labels"])
+            accelerator.backward(loss)
+            optimizer.step()
+            lr_scheduler.step()
+            optimizer.zero_grad()
+
+        model.eval()
+        all_preds, all_refs = [], []
+        for batch in eval_dataloader:
+            with torch.no_grad():
+                logits = model(batch["input_ids_a"], batch["input_ids_b"])
+            preds = torch.argmax(logits, dim=-1)
+            # Gathers across processes AND drops the even-batches duplicates
+            # of the final batch; len(sum of gathered) == len(dataset).
+            preds, refs = accelerator.gather_for_metrics((preds, batch["labels"]))
+            all_preds.append(preds)
+            all_refs.append(refs)
+        preds = torch.cat(all_preds)
+        refs = torch.cat(all_refs)
+        final_accuracy = float((preds == refs).float().mean())
+        accelerator.print(
+            f"epoch {epoch}: accuracy {final_accuracy:.3f} over exactly {len(refs)} samples"
+        )
+    return final_accuracy
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Distributed-metrics example")
+    parser.add_argument("--mixed_precision", type=str, default=None,
+                        choices=["no", "fp16", "bf16", "fp8"])
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--num_epochs", type=int, default=3)
+    args = parser.parse_args()
+    config = {"lr": 2e-3, "num_epochs": args.num_epochs, "seed": 42, "batch_size": 16}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""Feature: device profiling with ``accelerator.profile()`` (reference
+``examples/by_feature/profiler.py``).
+
+The reference exports torch.profiler Chrome traces; here the same
+``ProfileKwargs`` surface drives ``jax.profiler`` — the trace under
+``output_trace_dir/profile_<rank>`` opens in Perfetto/TensorBoard and shows
+the compiled step's MXU utilization and HBM transfers.
+
+Run: python examples/by_feature/profiler.py --output_trace_dir ./profile_demo
+"""
+
+import argparse
+
+import torch
+from torch.optim.lr_scheduler import LambdaLR
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.utils import ProfileKwargs, set_seed
+
+from _base import load_nlp_example
+
+nlp = load_nlp_example()
+
+
+def training_function(config, args):
+    profile_kwargs = ProfileKwargs(output_trace_dir=args.output_trace_dir)
+    accelerator = Accelerator(
+        cpu=args.cpu, mixed_precision=args.mixed_precision, kwargs_handlers=[profile_kwargs]
+    )
+    set_seed(int(config["seed"]))
+    train_dataloader, eval_dataloader = nlp.get_dataloaders(accelerator, int(config["batch_size"]))
+    model = nlp.PairClassifier()
+    optimizer = torch.optim.AdamW(model.parameters(), lr=config["lr"])
+    total_steps = int(config["num_epochs"]) * len(train_dataloader)
+    lr_scheduler = LambdaLR(optimizer, lambda step: max(0.0, 1.0 - step / max(total_steps, 1)))
+
+    model, optimizer, train_dataloader, eval_dataloader, lr_scheduler = accelerator.prepare(
+        model, optimizer, train_dataloader, eval_dataloader, lr_scheduler
+    )
+
+    criterion = torch.nn.CrossEntropyLoss()
+    # Profile one epoch of training steps.
+    with accelerator.profile() as prof:
+        model.train()
+        for batch in train_dataloader:
+            logits = model(batch["input_ids_a"], batch["input_ids_b"])
+            loss = criterion(logits, batch["labels"])
+            accelerator.backward(loss)
+            optimizer.step()
+            lr_scheduler.step()
+            optimizer.zero_grad()
+    if args.output_trace_dir:
+        accelerator.print(f"trace written under {args.output_trace_dir}")
+    return prof
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Profiler example")
+    parser.add_argument("--mixed_precision", type=str, default=None,
+                        choices=["no", "fp16", "bf16", "fp8"])
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--output_trace_dir", type=str, default=None)
+    parser.add_argument("--num_epochs", type=int, default=1)
+    args = parser.parse_args()
+    config = {"lr": 2e-3, "num_epochs": args.num_epochs, "seed": 42, "batch_size": 16}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

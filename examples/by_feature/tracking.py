@@ -1,0 +1,101 @@
+"""Feature: experiment tracking with ``init_trackers``/``log``/``end_training``
+(reference ``examples/by_feature/tracking.py``).
+
+``log_with="all"`` activates every tracker whose backend is importable
+(TensorBoard, WandB, CometML, Aim, MLflow, ClearML, DVCLive) plus the
+dependency-free JSONL tracker; in this image that typically means
+tensorboard + jsonl.
+
+Run: python examples/by_feature/tracking.py --with_tracking --project_dir ./track_demo
+"""
+
+import argparse
+
+import torch
+from torch.optim.lr_scheduler import LambdaLR
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.utils import set_seed
+
+from _base import load_nlp_example
+
+nlp = load_nlp_example()
+
+
+def training_function(config, args):
+    accelerator = Accelerator(
+        cpu=args.cpu,
+        mixed_precision=args.mixed_precision,
+        log_with="all" if args.with_tracking else None,
+        project_dir=args.project_dir,
+    )
+    set_seed(int(config["seed"]))
+    train_dataloader, eval_dataloader = nlp.get_dataloaders(accelerator, int(config["batch_size"]))
+    model = nlp.PairClassifier()
+    optimizer = torch.optim.AdamW(model.parameters(), lr=config["lr"])
+    total_steps = int(config["num_epochs"]) * len(train_dataloader)
+    lr_scheduler = LambdaLR(optimizer, lambda step: max(0.0, 1.0 - step / max(total_steps, 1)))
+
+    model, optimizer, train_dataloader, eval_dataloader, lr_scheduler = accelerator.prepare(
+        model, optimizer, train_dataloader, eval_dataloader, lr_scheduler
+    )
+
+    if args.with_tracking:
+        accelerator.init_trackers("nlp_example_tracking", config=config)
+
+    criterion = torch.nn.CrossEntropyLoss()
+    overall_step = 0
+    final_accuracy = 0.0
+    for epoch in range(int(config["num_epochs"])):
+        model.train()
+        total_loss = 0.0
+        for batch in train_dataloader:
+            logits = model(batch["input_ids_a"], batch["input_ids_b"])
+            loss = criterion(logits, batch["labels"])
+            total_loss += float(loss.detach())
+            accelerator.backward(loss)
+            optimizer.step()
+            lr_scheduler.step()
+            optimizer.zero_grad()
+            overall_step += 1
+
+        model.eval()
+        correct, total = 0, 0
+        for batch in eval_dataloader:
+            with torch.no_grad():
+                logits = model(batch["input_ids_a"], batch["input_ids_b"])
+            preds = torch.argmax(logits, dim=-1)
+            preds, refs = accelerator.gather_for_metrics((preds, batch["labels"]))
+            correct += int((preds == refs).sum())
+            total += len(refs)
+        final_accuracy = correct / max(total, 1)
+        accelerator.print(f"epoch {epoch}: accuracy {final_accuracy:.3f}")
+        if args.with_tracking:
+            accelerator.log(
+                {
+                    "accuracy": final_accuracy,
+                    "train_loss": total_loss / len(train_dataloader),
+                    "epoch": epoch,
+                },
+                step=overall_step,
+            )
+    if args.with_tracking:
+        accelerator.end_training()
+    return final_accuracy
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Tracking example")
+    parser.add_argument("--mixed_precision", type=str, default=None,
+                        choices=["no", "fp16", "bf16", "fp8"])
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--with_tracking", action="store_true")
+    parser.add_argument("--project_dir", type=str, default="./track_demo")
+    parser.add_argument("--num_epochs", type=int, default=3)
+    args = parser.parse_args()
+    config = {"lr": 2e-3, "num_epochs": args.num_epochs, "seed": 42, "batch_size": 16}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

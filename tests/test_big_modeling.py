@@ -166,3 +166,53 @@ def test_load_checkpoint_and_dispatch(tmp_path):
     with torch.no_grad():
         out = dst(x)
     torch.testing.assert_close(out, expected)
+
+
+def test_get_state_dict_offloaded_model(tmp_path):
+    torch.manual_seed(0)
+    m = ModelForTest().eval()
+    reference_sd = {k: v.clone() for k, v in m.state_dict().items()}
+    disk_offload(m, str(tmp_path))
+    from accelerate_tpu.utils.modeling import get_state_dict_offloaded_model
+
+    sd = get_state_dict_offloaded_model(m)
+    assert set(sd) == set(reference_sd)
+    for k in reference_sd:
+        torch.testing.assert_close(sd[k], reference_sd[k], msg=k)
+    # Model still offloaded (weights on meta) after extraction.
+    assert m.linear1.weight.device.type == "meta"
+    remove_hook_from_submodules(m)
+
+
+def test_align_module_device_offloaded(tmp_path):
+    torch.manual_seed(0)
+    m = ModelForTest().eval()
+    w = m.linear1.weight.detach().clone()
+    disk_offload(m, str(tmp_path))
+    from accelerate_tpu.utils.modeling import align_module_device
+
+    assert m.linear1.weight.device.type == "meta"
+    with align_module_device(m.linear1, "cpu"):
+        torch.testing.assert_close(m.linear1.weight.detach(), w)
+    assert m.linear1.weight.device.type == "meta"
+    remove_hook_from_submodules(m)
+
+
+def test_layerwise_casting_hooks():
+    torch.manual_seed(0)
+    m = ModelForTest().eval()
+    x = torch.randn(4, 3)
+    with torch.no_grad():
+        expected = m(x)
+    from accelerate_tpu.hooks import attach_layerwise_casting_hooks
+
+    attach_layerwise_casting_hooks(m, storage_dtype=torch.bfloat16, compute_dtype=torch.float32)
+    # Weights stored in bf16 between forwards...
+    assert m.linear1.weight.dtype == torch.bfloat16
+    with torch.no_grad():
+        out = m(x)
+    # ...compute happened in fp32 (output dtype) and matches within bf16 noise.
+    assert out.dtype == torch.float32
+    torch.testing.assert_close(out, expected, atol=0.05, rtol=0.05)
+    assert m.linear1.weight.dtype == torch.bfloat16
+    remove_hook_from_submodules(m)

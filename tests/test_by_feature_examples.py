@@ -1,0 +1,169 @@
+"""Run every by_feature example end-to-end with tiny settings (reference
+``tests/test_examples.py`` runs ``examples/by_feature/*`` on tiny data)."""
+
+import argparse
+import importlib.util
+import os
+import sys
+
+import pytest
+
+BY_FEATURE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples", "by_feature"
+)
+
+
+def _load(name):
+    path = os.path.join(BY_FEATURE, f"{name}.py")
+    if BY_FEATURE not in sys.path:
+        sys.path.insert(0, BY_FEATURE)
+    spec = importlib.util.spec_from_file_location(f"by_feature_{name}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+CONFIG = {"lr": 2e-3, "num_epochs": 2, "seed": 42, "batch_size": 16}
+
+
+def test_gradient_accumulation_example():
+    mod = _load("gradient_accumulation")
+    args = argparse.Namespace(mixed_precision=None, cpu=True, gradient_accumulation_steps=4)
+    acc = mod.training_function(dict(CONFIG), args)
+    assert acc > 0.7, acc
+
+
+def test_checkpointing_example(tmp_path):
+    mod = _load("checkpointing")
+    args = argparse.Namespace(
+        mixed_precision=None, cpu=True, checkpointing_steps="epoch",
+        project_dir=str(tmp_path), resume_from_checkpoint=None,
+    )
+    acc = mod.training_function(dict(CONFIG), args)
+    assert acc > 0.7, acc
+    ckpts = os.listdir(os.path.join(str(tmp_path), "checkpoints"))
+    assert len(ckpts) == 2, ckpts
+    # Resume from the first checkpoint.
+    args.resume_from_checkpoint = os.path.join(str(tmp_path), "checkpoints", "checkpoint_0")
+    acc2 = mod.training_function(dict(CONFIG), args)
+    assert acc2 > 0.7, acc2
+
+
+def test_tracking_example(tmp_path):
+    mod = _load("tracking")
+    args = argparse.Namespace(
+        mixed_precision=None, cpu=True, with_tracking=True, project_dir=str(tmp_path)
+    )
+    acc = mod.training_function(dict(CONFIG), args)
+    assert acc > 0.7, acc
+    # The dependency-free JSONL tracker always writes.
+    logged = []
+    for root, _, files in os.walk(str(tmp_path)):
+        logged += [os.path.join(root, f) for f in files]
+    assert logged, "tracker wrote nothing"
+
+
+def test_memory_example():
+    mod = _load("memory")
+    args = argparse.Namespace(mixed_precision=None, cpu=True, num_epochs=2)
+    acc = mod.training_function(dict(CONFIG), args)
+    assert acc > 0.7, acc
+
+
+def test_early_stopping_example():
+    mod = _load("early_stopping")
+    args = argparse.Namespace(mixed_precision=None, cpu=True, num_epochs=5)
+    stopped_at = mod.training_function(
+        {"lr": 5e-3, "num_epochs": 5, "seed": 42, "batch_size": 16}, args
+    )
+    assert stopped_at is not None, "never triggered early stop"
+
+
+def test_local_sgd_example():
+    mod = _load("local_sgd")
+    args = argparse.Namespace(
+        mixed_precision=None, cpu=True, gradient_accumulation_steps=1, local_sgd_steps=4
+    )
+    acc = mod.training_function(dict(CONFIG), args)
+    assert acc > 0.7, acc
+
+
+def test_multi_process_metrics_example():
+    mod = _load("multi_process_metrics")
+    args = argparse.Namespace(mixed_precision=None, cpu=True)
+    acc = mod.training_function(dict(CONFIG), args)
+    assert acc > 0.7, acc
+
+
+def test_cross_validation_example():
+    mod = _load("cross_validation")
+    args = argparse.Namespace(mixed_precision=None, cpu=True, num_folds=2, num_epochs=1)
+    acc = mod.training_function({**CONFIG, "num_epochs": 1}, args)
+    assert acc > 0.6, acc
+
+
+def test_automatic_gradient_accumulation_example():
+    mod = _load("automatic_gradient_accumulation")
+    args = argparse.Namespace(mixed_precision=None, cpu=True, target_batch_size=32, num_epochs=2)
+    acc = mod.training_function(dict(CONFIG), args)
+    assert acc > 0.7, acc
+
+
+def test_autoregressive_grad_accum_example():
+    mod = _load("gradient_accumulation_for_autoregressive_models")
+    args = argparse.Namespace(mixed_precision=None, cpu=True, gradient_accumulation_steps=2, num_epochs=4)
+    first, last = mod.training_function({"lr": 1e-2, "num_epochs": 4, "seed": 42}, args)
+    # The cumulative-mean mixer is intentionally tiny; assert clear learning,
+    # not convergence.
+    assert last < first * 0.95, (first, last)
+
+
+def test_ddp_comm_hook_example():
+    mod = _load("ddp_comm_hook")
+    args = argparse.Namespace(mixed_precision=None, cpu=True, ddp_comm_hook="bf16")
+    acc = mod.training_function(dict(CONFIG), args)
+    assert acc > 0.7, acc
+
+
+def test_profiler_example(tmp_path):
+    mod = _load("profiler")
+    args = argparse.Namespace(
+        mixed_precision=None, cpu=True, output_trace_dir=str(tmp_path), num_epochs=1
+    )
+    mod.training_function({**CONFIG, "num_epochs": 1}, args)
+    traces = []
+    for root, _, files in os.walk(str(tmp_path)):
+        traces += files
+    assert traces, "profiler wrote no trace"
+
+
+def test_deepspeed_config_example():
+    mod = _load("deepspeed_with_config_support")
+    args = argparse.Namespace(cpu=True, config_file=None, num_epochs=2)
+    acc = mod.training_function({"num_epochs": 2, "seed": 42, "batch_size": 16}, args)
+    assert acc > 0.7, acc
+
+
+def test_megatron_gpt_pretraining_example():
+    mod = _load("megatron_lm_gpt_pretraining")
+    args = argparse.Namespace(
+        tp_degree=2, pp_degree=1, num_micro_batches=1, use_distributed_optimizer=False,
+        sequence_parallelism=False, steps=6, batch_size=8, seq_len=32,
+    )
+    loss = mod.training_function({"lr": 3e-4, "seed": 42, "layers": 2, "hidden": 64}, args)
+    assert loss < 9.0, loss
+
+
+def test_fsdp_peak_mem_example():
+    mod = _load("fsdp_with_peak_mem_tracking")
+    args = argparse.Namespace(
+        fsdp_size=8, sharding_strategy="FULL_SHARD", cpu_offload=False, steps=3
+    )
+    mod.training_function({"lr": 3e-4, "seed": 42, "layers": 2, "hidden": 64}, args)
+
+
+def test_schedule_free_example():
+    mod = _load("schedule_free")
+    args = argparse.Namespace(steps=40, warmup_steps=5)
+    first, last = mod.training_function({"lr": 3e-3, "seed": 42, "layers": 2, "hidden": 64}, args)
+    assert last < first * 0.9, (first, last)

@@ -133,3 +133,41 @@ def test_set_seed_reproducible():
     set_seed(42)
     k2 = next_rng_key()
     assert jax.random.uniform(k1) == jax.random.uniform(k2)
+
+
+def test_gather_torch_bf16_roundtrip():
+    """torch-in/torch-out parity for bf16 (reviewed failure: to_numpy rejected
+    torch bf16)."""
+    import torch
+
+    from accelerate_tpu.utils.operations import gather
+
+    t = torch.randn(4, 3).to(torch.bfloat16)
+    out = gather(t)
+    assert isinstance(out, torch.Tensor) and out.dtype == torch.bfloat16
+    torch.testing.assert_close(out, t)
+
+
+def test_torch_max_forms_lower():
+    """torch.max: elementwise, reduce-all and dim (namedtuple) forms lower."""
+    import torch
+
+    from accelerate_tpu import Accelerator
+
+    class M(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.lin = torch.nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.lin(x)
+            h = torch.max(h, torch.zeros_like(h))  # elementwise (relu)
+            m = torch.max(h, dim=-1, keepdim=True)
+            return h / (m.values + 1.0) + torch.max(h) * 0
+
+    acc = Accelerator(cpu=True)
+    model = acc.prepare(M())
+    import numpy as np
+
+    out = model(torch.randn(2, 4))
+    assert np.asarray(out.detach()).shape == (2, 4)
