@@ -1,0 +1,120 @@
+"""Launchable collectives check (reference ``test_utils/scripts/test_ops.py``,
+181 LoC): every pytree collective + the ACCELERATE_DEBUG_MODE shape verifier.
+
+Run standalone or through the launcher:
+    accelerate-tpu launch -m accelerate_tpu.test_utils.scripts.test_ops
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def test_gather():
+    from accelerate_tpu.state import PartialState
+    from accelerate_tpu.utils import gather
+
+    state = PartialState()
+    x = np.full((2, 3), float(state.process_index))
+    g = np.asarray(gather(x))
+    assert g.shape == (2 * state.num_processes, 3), g.shape
+    for rank in range(state.num_processes):
+        assert (g[2 * rank : 2 * rank + 2] == rank).all()
+    state.print("gather ok")
+
+
+def test_gather_object():
+    from accelerate_tpu.state import PartialState
+    from accelerate_tpu.utils import gather_object
+
+    state = PartialState()
+    objs = gather_object([{"rank": state.process_index}])
+    assert [o["rank"] for o in objs] == list(range(state.num_processes)), objs
+    state.print("gather_object ok")
+
+
+def test_broadcast():
+    from accelerate_tpu.state import PartialState
+    from accelerate_tpu.utils import broadcast
+
+    state = PartialState()
+    x = {"a": np.full(4, float(state.process_index)), "b": [np.arange(2) + state.process_index]}
+    out = broadcast(x)
+    assert (np.asarray(out["a"]) == 0).all()
+    assert (np.asarray(out["b"][0]) == np.arange(2)).all()
+    state.print("broadcast ok")
+
+
+def test_reduce():
+    from accelerate_tpu.state import PartialState
+    from accelerate_tpu.utils import reduce
+
+    state = PartialState()
+    n = state.num_processes
+    x = np.full(3, float(state.process_index + 1))
+    total = np.asarray(reduce(x, reduction="sum"))
+    assert (total == n * (n + 1) / 2).all(), total
+    mean = np.asarray(reduce(x, reduction="mean"))
+    assert np.allclose(mean, (n + 1) / 2), mean
+    state.print("reduce ok")
+
+
+def test_pad_across_processes():
+    from accelerate_tpu.state import PartialState
+    from accelerate_tpu.utils import pad_across_processes
+
+    state = PartialState()
+    x = np.ones((state.process_index + 1, 2))
+    padded = np.asarray(pad_across_processes(x, dim=0))
+    assert padded.shape == (state.num_processes, 2), padded.shape
+    state.print("pad_across_processes ok")
+
+
+def test_op_checker():
+    """ACCELERATE_DEBUG_MODE shape verification (reference ``test_ops.py`` +
+    ``utils/operations.py:350-411``)."""
+    import os
+
+    from accelerate_tpu.state import PartialState
+    from accelerate_tpu.utils import broadcast
+    from accelerate_tpu.utils.operations import DistributedOperationException
+
+    state = PartialState()
+    if state.num_processes < 2:
+        state.print("op checker skipped (single process)")
+        return
+    prior = os.environ.get("ACCELERATE_DEBUG_MODE")
+    os.environ["ACCELERATE_DEBUG_MODE"] = "1"
+    try:
+        # Mismatched shapes across ranks must raise, not hang.
+        bad = np.ones((1 + state.process_index,))
+        raised = False
+        try:
+            broadcast(bad)
+        except DistributedOperationException:
+            raised = True
+        assert raised, "debug mode did not catch the shape mismatch"
+    finally:
+        if prior is None:
+            os.environ.pop("ACCELERATE_DEBUG_MODE", None)
+        else:
+            os.environ["ACCELERATE_DEBUG_MODE"] = prior
+    state.print("op checker ok")
+
+
+def main():
+    from accelerate_tpu.state import PartialState
+
+    state = PartialState()
+    state.print(f"test_ops on {state.num_processes} process(es)")
+    test_gather()
+    test_gather_object()
+    test_broadcast()
+    test_reduce()
+    test_pad_across_processes()
+    test_op_checker()
+    state.print("test_ops: success")
+
+
+if __name__ == "__main__":
+    main()

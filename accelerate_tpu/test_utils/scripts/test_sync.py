@@ -1,0 +1,125 @@
+"""Launchable gradient-accumulation sync oracle (reference
+``test_utils/scripts/test_sync.py``, 410 LoC; oracle at 29-43/207/248).
+
+The contract under test: during accumulation (``accumulate()`` on non-sync
+micro-steps) optimizer/scheduler steps are no-ops and gradients keep
+accumulating; on the sync step one update fires whose gradient equals the mean
+of the micro-batch gradients — byte-identical final weights to feeding the
+concatenated batch once.
+
+Run:
+    accelerate-tpu launch -m accelerate_tpu.test_utils.scripts.test_sync
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _make_model_and_data(seed: int = 0):
+    import torch
+
+    from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModel
+
+    torch.manual_seed(seed)
+    model = RegressionModel()
+    dataset = RegressionDataset(length=16, seed=seed)
+    xs = np.stack([np.atleast_1d(s["x"]) for s in dataset]).astype(np.float32)
+    ys = np.stack([np.atleast_1d(s["y"]) for s in dataset]).astype(np.float32)
+    return model, xs, ys
+
+
+def _run(accum_steps: int, micro_batches):
+    """Train one accumulation window; return (final_a, final_b, stepped_flags)."""
+    import torch
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+    accelerator = Accelerator(gradient_accumulation_steps=accum_steps)
+    model, _, _ = _make_model_and_data()
+    optimizer = torch.optim.SGD(model.parameters(), lr=0.1)
+    model, optimizer = accelerator.prepare(model, optimizer)
+
+    stepped = []
+    for x, y in micro_batches:
+        with accelerator.accumulate(model):
+            out = model(torch.tensor(x))
+            loss = torch.nn.functional.mse_loss(out, torch.tensor(y))
+            accelerator.backward(loss)
+            optimizer.step()
+            stepped.append(not optimizer.step_was_skipped)
+            optimizer.zero_grad()
+    params = model.params
+    return float(np.asarray(params["a"])), float(np.asarray(params["b"])), stepped
+
+
+def test_noop_on_non_sync_steps():
+    _, xs, ys = _make_model_and_data()
+    micro = [(xs[i * 4 : (i + 1) * 4], ys[i * 4 : (i + 1) * 4]) for i in range(4)]
+    _, _, stepped = _run(accum_steps=4, micro_batches=micro)
+    assert stepped == [False, False, False, True], stepped
+    print("no-op on non-sync steps ok")
+
+
+def test_accumulation_matches_full_batch():
+    _, xs, ys = _make_model_and_data()
+    micro = [(xs[i * 4 : (i + 1) * 4], ys[i * 4 : (i + 1) * 4]) for i in range(4)]
+    a_accum, b_accum, _ = _run(accum_steps=4, micro_batches=micro)
+    a_full, b_full, stepped_full = _run(accum_steps=1, micro_batches=[(xs, ys)])
+    assert stepped_full == [True]
+    assert np.isclose(a_accum, a_full, atol=1e-6), (a_accum, a_full)
+    assert np.isclose(b_accum, b_full, atol=1e-6), (b_accum, b_full)
+    print("accumulated update == full-batch update ok")
+
+
+def test_grads_differ_until_sync():
+    """Accumulated gradient must grow across micro-steps (unequal between
+    non-sync steps), then clear after the sync step — the reference's
+    grads-equal-exactly-when-they-should-be oracle."""
+    import torch
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+    _, xs, ys = _make_model_and_data()
+    accelerator = Accelerator(gradient_accumulation_steps=2)
+    model, _, _ = _make_model_and_data()
+    optimizer = torch.optim.SGD(model.parameters(), lr=0.1)
+    model, optimizer = accelerator.prepare(model, optimizer)
+
+    snapshots = []
+    for i in range(2):
+        with accelerator.accumulate(model):
+            out = model(torch.tensor(xs[i * 8 : (i + 1) * 8]))
+            loss = torch.nn.functional.mse_loss(out, torch.tensor(ys[i * 8 : (i + 1) * 8]))
+            accelerator.backward(loss)
+            grabbed = model._accum_grads
+            snapshots.append(
+                None if grabbed is None else float(np.asarray(grabbed["a"]))
+            )
+            optimizer.step()
+            optimizer.zero_grad()
+    assert snapshots[0] is not None and snapshots[1] is not None
+    assert not np.isclose(snapshots[0], snapshots[1]), snapshots
+    assert model._accum_grads is None, "grads not cleared after sync step"
+    print("grad accumulation growth/clear ok")
+
+
+def main():
+    test_noop_on_non_sync_steps()
+    test_accumulation_matches_full_batch()
+    test_grads_differ_until_sync()
+    print("test_sync: success")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,151 @@
+"""The shipped test harness (test_utils/testing.py) works as advertised.
+
+Parity: reference ``test_utils/testing.py`` decorators + subprocess driver
+(SURVEY §2.10).
+"""
+
+import os
+import sys
+import unittest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+import pytest
+
+from accelerate_tpu.test_utils import (
+    AccelerateTestCase,
+    SubprocessCallException,
+    TempDirTestCase,
+    assert_exception,
+    capture_call_output,
+    execute_subprocess_async,
+    get_backend,
+    get_launch_command,
+    get_unique_port,
+    require_cpu,
+    require_multi_device,
+    require_tpu,
+)
+
+
+def test_get_backend_cpu_mesh():
+    backend, n, mem_fn = get_backend()
+    assert backend == "cpu"
+    assert n == 8  # conftest virtual mesh
+    assert isinstance(mem_fn(), int)
+
+
+def test_require_decorators_skip_semantics():
+    @require_tpu
+    class NeedsTPU(unittest.TestCase):
+        def test_x(self):
+            pass
+
+    @require_cpu
+    class NeedsCPU(unittest.TestCase):
+        def test_x(self):
+            pass
+
+    @require_multi_device
+    class NeedsMulti(unittest.TestCase):
+        def test_x(self):
+            pass
+
+    # On the 8-device CPU mesh: TPU-gated skips, CPU and multi-device run.
+    assert NeedsTPU.__unittest_skip__
+    assert not getattr(NeedsCPU, "__unittest_skip__", False)
+    assert not getattr(NeedsMulti, "__unittest_skip__", False)
+
+
+def test_assert_exception_and_capture():
+    with assert_exception(ValueError, "boom"):
+        raise ValueError("boom goes the test")
+    with pytest.raises(AssertionError):
+        with assert_exception(ValueError):
+            pass  # nothing raised
+    out = capture_call_output(print, "hello capture")
+    assert "hello capture" in out
+
+
+def test_unique_port_is_free():
+    import socket
+
+    port = get_unique_port()
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", port))
+
+
+def test_execute_subprocess_async_success_and_failure():
+    out = execute_subprocess_async([sys.executable, "-c", "print('ok-marker')"], timeout=60)
+    assert "ok-marker" in out.stdout
+    with pytest.raises(SubprocessCallException, match="fail-marker"):
+        execute_subprocess_async(
+            [sys.executable, "-c", "import sys; print('fail-marker', file=sys.stderr); sys.exit(3)"],
+            timeout=60,
+        )
+
+
+def test_launch_command_through_real_launcher(tmp_path):
+    """Tier-2 mechanism (SURVEY §4): shell out through the real launcher, which
+    must propagate the env contract to the payload."""
+    payload = tmp_path / "payload.py"
+    payload.write_text(
+        "import os\n"
+        "assert os.environ.get('ACCELERATE_MIXED_PRECISION') == 'bf16', os.environ.get('ACCELERATE_MIXED_PRECISION')\n"
+        "print('payload-ran')\n"
+    )
+    import os
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = get_launch_command(num_processes=1, mixed_precision="bf16") + [str(payload)]
+    out = execute_subprocess_async(cmd, env=env, timeout=120)
+    assert "payload-ran" in out.stdout
+
+
+class TestTempDir(TempDirTestCase):
+    def test_tmpdir_exists(self):
+        import os
+
+        assert os.path.isdir(self.tmpdir)
+
+
+class TestSingletonReset(AccelerateTestCase):
+    def test_state_resets(self):
+        from accelerate_tpu.state import PartialState
+
+        PartialState()  # construct; tearDown must reset it without error
+
+
+def test_test_ops_script_multiprocess():
+    """test_ops payload under the debug launcher: 2 real processes, collectives
+    + the ACCELERATE_DEBUG_MODE shape checker (reference tier 2+3)."""
+    import os
+    import subprocess
+
+    code = (
+        "from accelerate_tpu.launchers import debug_launcher;"
+        "from accelerate_tpu.test_utils.scripts.test_ops import main;"
+        "debug_launcher(main, num_processes=2);"
+        "print('TEST_OPS_OK')"
+    )
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, timeout=240,
+        cwd=REPO_ROOT, env=env,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "TEST_OPS_OK" in res.stdout
+    assert "op checker ok" in res.stdout
+
+
+def test_test_sync_script():
+    """Grad-accum oracle script runs green end-to-end."""
+    out = execute_subprocess_async(
+        [sys.executable, "-m", "accelerate_tpu.test_utils.scripts.test_sync"],
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO_ROOT},
+        timeout=240,
+    )
+    assert "test_sync: success" in out.stdout
