@@ -68,6 +68,11 @@ class LlamaConfig:
     # "auto": pallas on a 1-chip TPU, else flash for long sequences without
     #   padding masks.
     attention_impl: str = "auto"
+    # Sequence-parallel attention implementation when the mesh has sp > 1:
+    # "ring" rotates K/V via neighbor ppermute (works for any head count);
+    # "ulysses" re-shards seq->heads with one all-to-all each way (needs
+    # num_heads % sp == 0; cheaper when the torus all-to-all is fast).
+    sp_impl: str = "ring"
     # fp8 matmuls (ops/fp8.py scaled_matmul): projection/MLP weights quantized
     # per-tensor to e4m3 with fp32 accumulation; embed/unembed stay in `dtype`
     # (the reference's fp8 bridges likewise skip first/last layers,
@@ -82,6 +87,8 @@ class LlamaConfig:
             )
         if self.remat_policy not in ("nothing", "dots"):
             raise ValueError(f"remat_policy must be 'nothing' or 'dots', got {self.remat_policy!r}")
+        if self.sp_impl not in ("ring", "ulysses"):
+            raise ValueError(f"sp_impl must be 'ring' or 'ulysses', got {self.sp_impl!r}")
 
     @property
     def head_dim_(self) -> int:
@@ -313,11 +320,17 @@ def attention_block(x, p, c, mask, positions) -> jax.Array:
     v = _mm(h, p["wv"], c).reshape(b, s, c.num_kv_heads, hd)
     q, k = _rope(q, k, positions, c.rope_theta)
     if _sp_active():
-        # Sequence-parallel path: blockwise ring attention over the sp axis
-        # (padding masks unsupported here; pretraining-style dense batches).
-        from ..ops.ring_attention import ring_attention
+        # Sequence-parallel path over the sp axis (padding masks unsupported
+        # here; pretraining-style dense batches).  mixtral shares this block —
+        # getattr default covers configs without the knob.
+        if getattr(c, "sp_impl", "ring") == "ulysses":
+            from ..ops.ulysses_attention import ulysses_attention
 
-        attn = ring_attention(q, k, v, mesh=None, axis_name="sp", causal=True)
+            attn = ulysses_attention(q, k, v, mesh=None, axis_name="sp", causal=True)
+        else:
+            from ..ops.ring_attention import ring_attention
+
+            attn = ring_attention(q, k, v, mesh=None, axis_name="sp", causal=True)
     elif mask is None and _use_pallas(c, s):
         from ..ops.pallas_attention import pallas_attention
 

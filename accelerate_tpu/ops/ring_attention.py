@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["ring_attention", "ring_self_attention"]
+__all__ = ["ring_attention", "ring_self_attention", "full_sequence_attention"]
 
 from jax import shard_map as _shard_map
 
@@ -67,6 +67,28 @@ def _block_attention(q, k, v, mask, m_prev, l_prev, o_prev, scale):
     o_blk = jnp.einsum("bkgst,btkd->bskgd", pk.astype(v.dtype), v).reshape(b, sq, h, d)
     o_new = o_prev * alpha.transpose(0, 2, 1)[..., None] + o_blk.astype(jnp.float32)
     return m_new, l_new, o_new
+
+
+def full_sequence_attention(q, k, v, causal: bool = True) -> jax.Array:
+    """Full-sequence attention on local data — the shared non-ring path: flash
+    (blockwise) when an MXU-friendly block divides S, otherwise one dense block
+    through the same online-softmax math.  Used as the sp=1 fallback here and
+    as the per-device local attention inside ulysses_attention."""
+    b, s, h, d = q.shape
+    blk = next((x for x in (512, 256, 128, 64) if s % x == 0), None)
+    if blk is not None and s > blk:
+        from .flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, block_size=blk)
+    if causal:
+        mask = jnp.tril(jnp.ones((s, s), bool))[None, None]
+    else:
+        mask = jnp.ones((1, 1, s, s), bool)
+    m0 = jnp.full((b, h, s), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, s), jnp.float32)
+    o0 = jnp.zeros((b, s, h, d), jnp.float32)
+    _, l, o = _block_attention(q, k, v, mask, m0, l0, o0, 1.0 / np.sqrt(d))
+    return (o / jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]).astype(q.dtype)
 
 
 def _ring_body(q, k, v, *, axis_name: str, causal: bool, vary_axes: tuple = ()):
@@ -129,17 +151,7 @@ def ring_attention(
         if AcceleratorState._shared_state:
             mesh = AcceleratorState().mesh
     if mesh is None or axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
-        # Dense fallback: one block through the same online-softmax math.
-        b, s, h, d = q.shape
-        if causal:
-            mask = jnp.tril(jnp.ones((s, s), bool))[None, None]
-        else:
-            mask = jnp.ones((1, 1, s, s), bool)
-        m0 = jnp.full((b, h, s), -jnp.inf, jnp.float32)
-        l0 = jnp.zeros((b, h, s), jnp.float32)
-        o0 = jnp.zeros((b, s, h, d), jnp.float32)
-        _, l, o = _block_attention(q, k, v, mask, m0, l0, o0, 1.0 / np.sqrt(d))
-        return (o / jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]).astype(q.dtype)
+        return full_sequence_attention(q, k, v, causal=causal)
 
     # Keep the batch dim sharded over the data axes inside the ring (avoids a
     # batch all-gather at the shard_map boundary), and the head dim over tp when

@@ -1,0 +1,115 @@
+"""Ulysses-style sequence parallelism: all-to-all head scatter over ``sp``.
+
+Net-new vs the reference (SURVEY §2.4: context parallelism ABSENT upstream).
+Alternative to ``ops/ring_attention.py`` with a different comm pattern
+(DeepSpeed-Ulysses, Jacobs et al.; see PAPERS.md): instead of rotating K/V
+blocks around a ring (n-1 neighbor hops overlapping compute), ONE all-to-all
+re-shards activations from sequence-sharded to head-sharded, each device runs
+ordinary dense/flash attention over the FULL sequence for its head slice, and
+a second all-to-all restores sequence sharding.
+
+Trade-off (why both exist): Ulysses moves O(S·H/n·d) bytes twice in two
+dense collectives and then attends with zero extra masking logic — better
+when heads are plentiful and ICI all-to-all bandwidth is good (a TPU torus
+does all-to-all well); ring keeps activations put and pays n-1 overlapped
+neighbor hops — better when n exceeds the head count or K/V blocks are huge.
+Requires num_q_heads % sp == 0; GQA K/V heads not divisible by sp are
+group-expanded before the exchange.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from .ring_attention import full_sequence_attention, shard_map
+
+__all__ = ["ulysses_attention"]
+
+
+def _ulysses_body(q, k, v, *, axis_name: str, causal: bool):
+    """Per-device body under shard_map.
+
+    In:  q [B, S/n, H, d]; k, v [B, S/n, K, d] (sequence-sharded).
+    Out: [B, S/n, H, d].
+    """
+    n = jax.lax.psum(1, axis_name)
+    h = q.shape[2]
+    kh = k.shape[2]
+    if kh % n:
+        # GQA heads not divisible by the axis: expand groups to full H first.
+        k = jnp.repeat(k, h // kh, axis=2)
+        v = jnp.repeat(v, h // kh, axis=2)
+
+    # seq-sharded -> head-sharded: split heads (axis 2), gather sequence
+    # (axis 1).  all_to_all chunk order follows axis index order, so the
+    # gathered sequence is globally contiguous and plain causal masking holds.
+    a2a = functools.partial(
+        jax.lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1, tiled=True
+    )
+    qh, kh_, vh = a2a(q), a2a(k), a2a(v)
+    out = full_sequence_attention(qh, kh_, vh, causal=causal)  # [B, S, H/n, d]
+    # head-sharded -> seq-sharded.
+    return jax.lax.all_to_all(out, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """Sequence-parallel attention, all-to-all variant.  Same contract as
+    ``ring_attention``: [B, S, H, d] x [B, S, K, d] -> [B, S, H, d] with S
+    sharded over ``axis_name``; dense fallback when the axis is trivial."""
+    if mesh is None:
+        from ..state import AcceleratorState
+
+        if AcceleratorState._shared_state:
+            mesh = AcceleratorState().mesh
+    if mesh is None or axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
+        return full_sequence_attention(q, k, v, causal=causal)
+
+    n = mesh.shape[axis_name]
+    # Shard heads over tp too when both divisions work out (same policy as
+    # ring_attention): each tp device then handles its own head shard instead
+    # of redundantly computing all heads.
+    tp = mesh.shape.get("tp", 1)
+    head_axis = (
+        "tp"
+        if (
+            tp > 1
+            and q.shape[2] % tp == 0
+            and (q.shape[2] // tp) % n == 0
+            and k.shape[2] % tp == 0
+        )
+        else None
+    )
+    local_heads = q.shape[2] // (tp if head_axis else 1)
+    if local_heads % n:
+        raise ValueError(
+            f"ulysses needs (num_heads / tp-shard) divisible by the sp axis: "
+            f"{local_heads} % {n} != 0 "
+            "(use sp_impl='ring' for head counts below the axis size)"
+        )
+
+    from ..parallel.mesh import data_axes
+
+    batch_axes = tuple(a for a in data_axes(mesh) if a != axis_name)
+    spec = P(batch_axes if batch_axes else None, axis_name, head_axis, None)
+    body = functools.partial(_ulysses_body, axis_name=axis_name, causal=causal)
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )(q, k, v)

@@ -1,0 +1,143 @@
+"""Misc utilities — reference ``utils/other.py`` (373 LoC): model unwrapping,
+generic save/load, OS checks, module traversal; plus the main-process tqdm
+wrapper (reference ``utils/tqdm.py``) and rich traceback installer
+(reference ``utils/rich.py``)."""
+
+from __future__ import annotations
+
+import os
+import platform
+import warnings
+from typing import Any, Optional
+
+import numpy as np
+
+__all__ = [
+    "extract_model_from_parallel",
+    "save",
+    "load",
+    "check_os_kernel",
+    "get_module_children_bottom_up",
+    "tqdm",
+    "install_rich_traceback",
+]
+
+
+def extract_model_from_parallel(model, keep_fp32_wrapper: bool = True, keep_torch_compile: bool = True):
+    """Unwrap a prepared/compiled model back to the original module (reference
+    ``utils/other.py:62``).  For a ``PreparedModel`` this returns the ingested
+    torch module with the CURRENT trained weights copied in; torch-level
+    wrappers (``torch.compile``'s ``_orig_mod``) are peeled too."""
+    from ..accelerator import PreparedModel
+
+    if isinstance(model, PreparedModel):
+        acc = model.accelerator
+        return acc.unwrap_model(model, keep_fp32_wrapper=keep_fp32_wrapper,
+                                keep_torch_compile=keep_torch_compile)
+    inner = getattr(model, "_orig_mod", None)
+    if inner is not None and not keep_torch_compile:
+        return inner
+    return model
+
+
+def save(obj: Any, f, save_on_each_node: bool = False, safe_serialization: bool = False) -> None:
+    """Save on main process only (or every node's main process) — reference
+    ``utils/other.py save``.  ``safe_serialization`` writes safetensors for a
+    flat dict of arrays; otherwise pickle via torch.save when torch is present,
+    else numpy savez."""
+    from ..state import PartialState
+
+    state = PartialState()
+    should_write = state.is_main_process or (save_on_each_node and state.is_local_main_process)
+    if not should_write:
+        return
+    if safe_serialization:
+        from safetensors.numpy import save_file
+
+        flat = {k: np.asarray(v) for k, v in obj.items()}
+        save_file(flat, str(f))
+        return
+    try:
+        import torch
+    except ImportError:  # torch-free environment: flat array dicts only
+        if not hasattr(obj, "items"):
+            raise TypeError(
+                "without torch, save() supports only mappings of arrays; "
+                f"got {type(obj).__name__}"
+            )
+        # Write through a file handle so np.savez can't append '.npz' and
+        # diverge from the path load() will read.
+        with open(f, "wb") as fh:
+            np.savez(fh, **{k: np.asarray(v) for k, v in obj.items()})
+        return
+    torch.save(obj, f)
+
+
+def load(f, map_location=None, **kwargs):
+    """Counterpart of :func:`save` (reference ``utils/other.py load``)."""
+    path = str(f)
+    if path.endswith(".safetensors"):
+        from safetensors.numpy import load_file
+
+        return load_file(path)
+    try:
+        import torch
+    except ImportError:
+        return dict(np.load(path, allow_pickle=False))
+    kwargs.setdefault("weights_only", True)
+    return torch.load(f, map_location=map_location or "cpu", **kwargs)
+
+
+def check_os_kernel() -> None:
+    """Warn on Linux kernels < 5.5 (reference ``utils/other.py
+    check_os_kernel``: MKL threading hangs on old kernels)."""
+    if platform.system() != "Linux":
+        return
+    release = platform.release()
+    try:
+        major, minor = (int(x) for x in release.split(".")[:2])
+    except ValueError:
+        return
+    if (major, minor) < (5, 5):
+        warnings.warn(
+            f"Detected kernel version {release}, which is below the recommended minimum "
+            "of 5.5.0; this can cause the process to hang. It is recommended to upgrade "
+            "the kernel to the minimum version or higher.",
+            UserWarning,
+        )
+
+
+def get_module_children_bottom_up(model, return_fqns: bool = False) -> list:
+    """All submodules deepest-first, root last (reference ``utils/other.py
+    get_module_children_bottom_up``; the FSDP auto-wrap traversal order)."""
+    out: list = []
+
+    def visit(module, fqn: str):
+        for child_name, child in module.named_children():
+            visit(child, f"{fqn}.{child_name}" if fqn else child_name)
+        out.append((fqn, module) if return_fqns else module)
+
+    visit(model, "")
+    return out
+
+
+def tqdm(*args, main_process_only: bool = True, **kwargs):
+    """tqdm that renders only on the main process (reference ``utils/tqdm.py``)."""
+    from tqdm.auto import tqdm as _tqdm
+
+    from ..state import PartialState
+
+    if main_process_only and not PartialState().is_main_process:
+        kwargs["disable"] = True
+    return _tqdm(*args, **kwargs)
+
+
+def install_rich_traceback() -> None:
+    """Pretty tracebacks when rich is available (reference ``utils/rich.py``;
+    enabled by ``ACCELERATE_ENABLE_RICH=1`` or ``launch --debug``)."""
+    try:
+        from rich.traceback import install
+
+        install(show_locals=False)
+    except ImportError:
+        pass

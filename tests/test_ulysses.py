@@ -1,0 +1,75 @@
+"""Ulysses (all-to-all) sequence parallelism: parity vs dense and vs ring."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu import AcceleratorState, ParallelismConfig
+from accelerate_tpu.models import llama
+from accelerate_tpu.ops.ring_attention import ring_attention
+from accelerate_tpu.ops.ulysses_attention import ulysses_attention
+from accelerate_tpu.parallel.sharding import data_sharding
+
+
+def _mk_qkv(key, b, s, h, kh, d):
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (b, s, h, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, kh, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, kh, d), jnp.float32)
+    return q, k, v
+
+
+def test_ulysses_matches_dense_and_ring():
+    state = AcceleratorState(parallelism_config=ParallelismConfig(dp=2, sp=4))
+    mesh = state.mesh
+    q, k, v = _mk_qkv(jax.random.key(0), 2, 64, 4, 4, 16)
+
+    dense = ulysses_attention(q, k, v, mesh=None, axis_name="nope", causal=True)
+    uly = jax.jit(lambda q, k, v: ulysses_attention(q, k, v, mesh=mesh, causal=True))(q, k, v)
+    ring = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh=mesh, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(uly), np.asarray(dense), atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(uly), np.asarray(ring), atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_gqa_expansion():
+    """KV heads (2) not divisible by sp (4): group expansion path."""
+    state = AcceleratorState(parallelism_config=ParallelismConfig(dp=2, sp=4))
+    mesh = state.mesh
+    q, k, v = _mk_qkv(jax.random.key(1), 2, 64, 4, 2, 16)
+    dense = ulysses_attention(q, k, v, mesh=None, axis_name="nope", causal=True)
+    uly = jax.jit(lambda q, k, v: ulysses_attention(q, k, v, mesh=mesh, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(uly), np.asarray(dense), atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_head_divisibility_error():
+    state = AcceleratorState(parallelism_config=ParallelismConfig(sp=8))
+    q, k, v = _mk_qkv(jax.random.key(2), 1, 64, 4, 4, 16)  # 4 heads < sp=8
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attention(q, k, v, mesh=state.mesh, causal=True)
+
+
+def test_llama_sp_ulysses_loss_matches_dense():
+    cfg = llama.LlamaConfig.tiny(sp_impl="ulysses")
+    params = llama.init_params(cfg, jax.random.key(0))
+    batch = {"input_ids": jax.random.randint(jax.random.key(1), (4, 32), 0, cfg.vocab_size)}
+    dense_loss = float(jax.jit(lambda p, b: llama.loss_fn(p, b, cfg))(params, batch))
+
+    state = AcceleratorState(parallelism_config=ParallelismConfig(dp=2, sp=4))
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    params = jax.device_put(params, NamedSharding(state.mesh, P()))
+    sb = {"input_ids": jax.device_put(batch["input_ids"], data_sharding(state.mesh))}
+    sp_loss = float(jax.jit(lambda p, b: llama.loss_fn(p, b, cfg))(params, sb))
+    assert abs(dense_loss - sp_loss) < 3e-3, (dense_loss, sp_loss)
+
+
+def test_ulysses_tp_head_shard():
+    """tp=2 x sp=2: heads shard over tp AND ulysses splits the remainder."""
+    state = AcceleratorState(parallelism_config=ParallelismConfig(dp=2, sp=2, tp=2))
+    mesh = state.mesh
+    q, k, v = _mk_qkv(jax.random.key(3), 2, 64, 4, 4, 16)
+    dense = ulysses_attention(q, k, v, mesh=None, axis_name="nope", causal=True)
+    uly = jax.jit(lambda q, k, v: ulysses_attention(q, k, v, mesh=mesh, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(uly), np.asarray(dense), atol=2e-5, rtol=2e-5)

@@ -100,3 +100,51 @@ def test_kwargs_handler_to_kwargs_diffs_defaults():
     kw = scaler.to_kwargs()
     assert kw == {"init_scale": 1024.0, "growth_interval": 4000}
     assert FP8RecipeKwargs(margin=2).to_kwargs() == {"margin": 2}
+
+
+def test_other_utils_surface(tmp_path):
+    """Reference utils/other.py parity: save/load, bottom-up traversal,
+    extract_model_from_parallel, check_os_kernel."""
+    import numpy as np
+    import torch
+
+    from accelerate_tpu.utils import (
+        check_os_kernel,
+        extract_model_from_parallel,
+        get_module_children_bottom_up,
+        load,
+        save,
+    )
+
+    # save/load round-trips (pickle + safetensors paths).
+    obj = {"w": torch.arange(6).reshape(2, 3).float()}
+    p = tmp_path / "state.bin"
+    save(obj, str(p))
+    back = load(str(p))
+    torch.testing.assert_close(back["w"], obj["w"])
+    sp = tmp_path / "state.safetensors"
+    save({"w": obj["w"].numpy()}, str(sp), safe_serialization=True)
+    back2 = load(str(sp))
+    assert np.allclose(back2["w"], obj["w"].numpy())
+
+    # bottom-up traversal: children before parents, root last.
+    model = torch.nn.Sequential(torch.nn.Linear(2, 2), torch.nn.Sequential(torch.nn.ReLU()))
+    mods = get_module_children_bottom_up(model)
+    assert mods[-1] is model
+    assert mods.index(model[1][0]) < mods.index(model[1])
+
+    # unwrap through the accelerator wrapper.
+    from accelerate_tpu import Accelerator
+
+    acc = Accelerator(cpu=True)
+    lin = torch.nn.Linear(2, 2)
+    prepared = acc.prepare(lin)
+    assert extract_model_from_parallel(prepared) is lin
+    check_os_kernel()  # must not raise
+
+
+def test_main_process_tqdm():
+    from accelerate_tpu.utils import tqdm
+
+    bar = tqdm(range(3))
+    assert list(bar) == [0, 1, 2]
