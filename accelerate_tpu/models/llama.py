@@ -269,11 +269,11 @@ def _attention(q, k, v, mask, num_groups: int):
 
 
 def _flash_block(s: int):
-    """Largest MXU-friendly block dividing ``s`` (None -> einsum fallback)."""
-    for b in (512, 256, 128, 64):
-        if s % b == 0:
-            return b
-    return s if s <= 1024 else None
+    """Largest MXU-friendly block dividing ``s`` (None -> einsum fallback);
+    short sequences run as one block."""
+    from ..ops.flash_attention import pick_block
+
+    return pick_block(s) or (s if s <= 1024 else None)
 
 
 def _use_pallas(c: "LlamaConfig", s: int) -> bool:
@@ -472,3 +472,130 @@ def loss_fn(
     labels, weights = labels_and_weights(batch)
     logits = apply(params, batch["input_ids"], config, attention_mask=batch.get("attention_mask"))
     return cross_entropy(logits, labels, weights)
+
+
+# ---------------------------------------------------------------------------
+# KV-cache inference (prefill + decode)
+# ---------------------------------------------------------------------------
+#
+# The reference's big-model inference path generates through torch/transformers
+# (BASELINE.md s-per-token tables); the TPU-native equivalent is a compiled
+# decode step over a static-shape KV cache: cache tensors are stacked per layer
+# so prefill/decode run the same single lax.scan layer body as training, and
+# the whole generate loop is one jit (no per-token Python dispatch).
+
+
+def init_cache(config: LlamaConfig, batch_size: int, max_len: int) -> dict:
+    """Zeroed KV cache: k/v ``[L, B, max_len, K, hd]`` + write index."""
+    c = config
+    shape = (c.num_layers, batch_size, max_len, c.num_kv_heads, c.head_dim_)
+    return {
+        "k": jnp.zeros(shape, c.dtype),
+        "v": jnp.zeros(shape, c.dtype),
+        "index": jnp.zeros((), jnp.int32),
+    }
+
+
+def _attention_block_cached(x, p, c, ck, cv, index, positions):
+    """Attention sub-block against the cache.  x: [B, S, D] (S = new tokens);
+    ck/cv: [B, max_len, K, hd].  Returns (out, new_ck, new_cv)."""
+    hd = c.head_dim_
+    h = _rms_norm(x, p["ln_attn"], c.rms_eps)
+    b, s, _ = h.shape
+    max_len = ck.shape[1]
+    q = _mm(h, p["wq"], c).reshape(b, s, c.num_heads, hd)
+    k = _mm(h, p["wk"], c).reshape(b, s, c.num_kv_heads, hd)
+    v = _mm(h, p["wv"], c).reshape(b, s, c.num_kv_heads, hd)
+    q, k = _rope(q, k, positions, c.rope_theta)
+
+    ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, index, 0, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, index, 0, 0))
+
+    # q position i (global index + i) attends cache slots <= its position.
+    q_pos = index + jnp.arange(s)
+    k_pos = jnp.arange(max_len)
+    mask = jnp.broadcast_to(q_pos[:, None] >= k_pos[None, :], (b, s, max_len))
+    attn = _attention(q, ck, cv, mask, c.num_heads // c.num_kv_heads)
+    return x + _mm(attn.reshape(b, s, c.num_heads * hd), p["wo"], c), ck, cv
+
+
+def apply_cached(
+    params: dict,
+    input_ids: jax.Array,
+    config: LlamaConfig,
+    cache: dict,
+) -> tuple[jax.Array, dict]:
+    """Forward over new tokens with cache read/write.
+
+    input_ids ``[B, S]`` are the tokens at positions ``cache['index'] ..
+    index+S``; returns (logits ``[B, S, V]``, updated cache)."""
+    c = config
+    b, s = input_ids.shape
+    index = cache["index"]
+    positions = jnp.broadcast_to(index + jnp.arange(s), (b, s))
+    x = embed_tokens(params, input_ids, c)
+
+    def body(carry, xs):
+        lp, ck, cv = xs
+        y, ck, cv = _attention_block_cached(carry, lp, c, ck, cv, index, positions)
+        h = _rms_norm(y, lp["ln_mlp"], c.rms_eps)
+        gate = jax.nn.silu(_mm(h, lp["w_gate"], c))
+        up = _mm(h, lp["w_up"], c)
+        return y + _mm(gate * up, lp["w_down"], c), (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    logits = unembed(params, x, c)
+    return logits, {"k": new_k, "v": new_v, "index": index + s}
+
+
+def generate(
+    params: dict,
+    input_ids: jax.Array,
+    config: LlamaConfig,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    key: Optional[jax.Array] = None,
+    max_len: Optional[int] = None,
+) -> jax.Array:
+    """Greedy (temperature=0) or sampled autoregressive generation.
+
+    input_ids ``[B, S]`` dense prompt (no padding) -> ``[B, S+max_new_tokens]``.
+    The decode loop is a single ``lax.scan`` of a one-token cached step, so the
+    whole call compiles to one XLA program.
+    """
+    c = config
+    b, s = input_ids.shape
+    total = s + max_new_tokens
+    if max_len is None:
+        max_len = total
+    if total > max_len:
+        raise ValueError(f"prompt ({s}) + max_new_tokens ({max_new_tokens}) > max_len ({max_len})")
+    if temperature > 0 and key is None:
+        raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    if max_new_tokens < 0:
+        raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
+    if max_new_tokens == 0:
+        return input_ids
+
+    cache = init_cache(c, b, max_len)
+    logits, cache = apply_cached(params, input_ids, c, cache)
+    next_tok = _select_token(logits[:, -1], temperature, key, 0)
+
+    def step(carry, i):
+        tok, cache, key = carry
+        logits, cache = apply_cached(params, tok[:, None], c, cache)
+        nxt = _select_token(logits[:, -1], temperature, key, i)
+        return (nxt, cache, key), tok
+
+    (last, _, _), toks = jax.lax.scan(
+        step, (next_tok, cache, key), jnp.arange(1, max_new_tokens)
+    )
+    generated = jnp.concatenate([toks.T, last[:, None]], axis=1) if max_new_tokens > 1 else last[:, None]
+    return jnp.concatenate([input_ids, generated], axis=1)
+
+
+def _select_token(logits, temperature: float, key, i):
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    step_key = jax.random.fold_in(key, i)
+    return jax.random.categorical(step_key, logits / temperature, axis=-1).astype(jnp.int32)

@@ -27,7 +27,16 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "pick_block"]
+
+
+def pick_block(s: int) -> Optional[int]:
+    """Largest MXU-friendly block size dividing ``s`` (None when none does) —
+    the single block-ladder used by the flash path pickers."""
+    for b in (512, 256, 128, 64):
+        if s % b == 0:
+            return b
+    return None
 
 
 def _block_step(carry, kv, *, scale, blk_k, causal):

@@ -29,7 +29,41 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["ring_attention", "ring_self_attention", "full_sequence_attention"]
+__all__ = [
+    "ring_attention",
+    "ring_self_attention",
+    "full_sequence_attention",
+    "resolve_sp_mesh",
+    "tp_head_axis",
+]
+
+
+def resolve_sp_mesh(mesh: Optional[Mesh], axis_name: str) -> Optional[Mesh]:
+    """Shared mesh resolution for the sp backends: fall back to the installed
+    AcceleratorState mesh; None when the axis is absent/trivial (caller runs
+    the dense path)."""
+    if mesh is None:
+        from ..state import AcceleratorState
+
+        if AcceleratorState._shared_state:
+            mesh = AcceleratorState().mesh
+    if mesh is None or axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
+        return None
+    return mesh
+
+
+def tp_head_axis(mesh: Mesh, num_heads: int, num_kv_heads: int, extra_div: int = 1) -> Optional[str]:
+    """Shared tp head-sharding policy: shard heads over tp when divisible (and,
+    for ulysses, when the per-tp head count still divides by the sp axis)."""
+    tp = mesh.shape.get("tp", 1)
+    if (
+        tp > 1
+        and num_heads % tp == 0
+        and num_kv_heads % tp == 0
+        and (num_heads // tp) % extra_div == 0
+    ):
+        return "tp"
+    return None
 
 from jax import shard_map as _shard_map
 
@@ -75,10 +109,10 @@ def full_sequence_attention(q, k, v, causal: bool = True) -> jax.Array:
     through the same online-softmax math.  Used as the sp=1 fallback here and
     as the per-device local attention inside ulysses_attention."""
     b, s, h, d = q.shape
-    blk = next((x for x in (512, 256, 128, 64) if s % x == 0), None)
-    if blk is not None and s > blk:
-        from .flash_attention import flash_attention
+    from .flash_attention import flash_attention, pick_block
 
+    blk = pick_block(s)
+    if blk is not None and s > blk:
         return flash_attention(q, k, v, causal=causal, block_size=blk)
     if causal:
         mask = jnp.tril(jnp.ones((s, s), bool))[None, None]
@@ -145,12 +179,8 @@ def ring_attention(
 
     Falls back to a single dense block when the axis is size 1 / absent.
     """
+    mesh = resolve_sp_mesh(mesh, axis_name)
     if mesh is None:
-        from ..state import AcceleratorState
-
-        if AcceleratorState._shared_state:
-            mesh = AcceleratorState().mesh
-    if mesh is None or axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
         return full_sequence_attention(q, k, v, causal=causal)
 
     # Keep the batch dim sharded over the data axes inside the ring (avoids a
@@ -160,8 +190,7 @@ def ring_attention(
     from ..parallel.mesh import data_axes
 
     batch_axes = tuple(a for a in data_axes(mesh) if a != axis_name)
-    tp = mesh.shape.get("tp", 1)
-    head_axis = "tp" if (tp > 1 and q.shape[2] % tp == 0 and k.shape[2] % tp == 0) else None
+    head_axis = tp_head_axis(mesh, q.shape[2], k.shape[2])
     vary = batch_axes + (axis_name,) + ((head_axis,) if head_axis else ())
     spec = P(batch_axes if batch_axes else None, axis_name, head_axis, None)
     body = functools.partial(

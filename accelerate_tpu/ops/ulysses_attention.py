@@ -29,9 +29,21 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
-from .ring_attention import full_sequence_attention, shard_map
+import math
+
+from .ring_attention import full_sequence_attention, resolve_sp_mesh, shard_map, tp_head_axis
 
 __all__ = ["ulysses_attention"]
+
+
+def _kv_expansion(num_q_heads: int, num_kv_heads: int, n: int) -> int:
+    """Minimal KV-head expansion factor so the expanded count divides over the
+    sp axis AND still groups evenly against the q heads: lcm(K, n) when that
+    divides H, else full expansion to H (always valid since H % n == 0)."""
+    target = math.lcm(num_kv_heads, n)
+    if num_q_heads % target:
+        target = num_q_heads
+    return target // num_kv_heads
 
 
 def _ulysses_body(q, k, v, *, axis_name: str, causal: bool):
@@ -44,9 +56,11 @@ def _ulysses_body(q, k, v, *, axis_name: str, causal: bool):
     h = q.shape[2]
     kh = k.shape[2]
     if kh % n:
-        # GQA heads not divisible by the axis: expand groups to full H first.
-        k = jnp.repeat(k, h // kh, axis=2)
-        v = jnp.repeat(v, h // kh, axis=2)
+        # GQA heads not divisible by the axis: expand groups minimally (lcm)
+        # so the K/V all-to-alls move as few bytes as possible.
+        rep = _kv_expansion(h, kh, n)
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
 
     # seq-sharded -> head-sharded: split heads (axis 2), gather sequence
     # (axis 1).  all_to_all chunk order follows axis index order, so the
@@ -71,30 +85,16 @@ def ulysses_attention(
     """Sequence-parallel attention, all-to-all variant.  Same contract as
     ``ring_attention``: [B, S, H, d] x [B, S, K, d] -> [B, S, H, d] with S
     sharded over ``axis_name``; dense fallback when the axis is trivial."""
+    mesh = resolve_sp_mesh(mesh, axis_name)
     if mesh is None:
-        from ..state import AcceleratorState
-
-        if AcceleratorState._shared_state:
-            mesh = AcceleratorState().mesh
-    if mesh is None or axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
         return full_sequence_attention(q, k, v, causal=causal)
 
     n = mesh.shape[axis_name]
-    # Shard heads over tp too when both divisions work out (same policy as
+    # Shard heads over tp too when both divisions work out (shared policy with
     # ring_attention): each tp device then handles its own head shard instead
     # of redundantly computing all heads.
-    tp = mesh.shape.get("tp", 1)
-    head_axis = (
-        "tp"
-        if (
-            tp > 1
-            and q.shape[2] % tp == 0
-            and (q.shape[2] // tp) % n == 0
-            and k.shape[2] % tp == 0
-        )
-        else None
-    )
-    local_heads = q.shape[2] // (tp if head_axis else 1)
+    head_axis = tp_head_axis(mesh, q.shape[2], k.shape[2], extra_div=n)
+    local_heads = q.shape[2] // (mesh.shape["tp"] if head_axis else 1)
     if local_heads % n:
         raise ValueError(
             f"ulysses needs (num_heads / tp-shard) divisible by the sp axis: "

@@ -1,0 +1,82 @@
+"""Autoregressive-generation throughput (the reference's headline big-model
+inference metric is s/token — BASELINE.md tables from
+``benchmarks/big_model_inference``).
+
+Whole decode loop is one compiled XLA program (lax.scan over a KV cache), so
+s/token here has no per-token Python dispatch in it.
+
+Run:  python benchmarks/inference_bench.py [--hidden 2048 --layers 6 --prompt 128 --new 128]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--hidden", type=int, default=2048)
+    parser.add_argument("--layers", type=int, default=6)
+    parser.add_argument("--batch", type=int, default=4)
+    parser.add_argument("--prompt", type=int, default=128)
+    parser.add_argument("--new", type=int, default=128)
+    parser.add_argument("--temperature", type=float, default=0.0)
+    args = parser.parse_args()
+
+    import jax
+    import numpy as np
+
+    from accelerate_tpu.models import llama
+
+    cfg = llama.LlamaConfig(
+        vocab_size=32000,
+        hidden_size=args.hidden,
+        intermediate_size=4 * args.hidden,
+        num_layers=args.layers,
+        num_heads=max(args.hidden // 128, 1),
+        num_kv_heads=max(args.hidden // 256, 1),
+        max_seq_len=args.prompt + args.new,
+        remat=False,
+        attention_impl="einsum",  # decode q-len is 1; flash buys nothing
+    )
+    params = llama.init_params(cfg, jax.random.key(0))
+    prompt = np.random.default_rng(0).integers(0, cfg.vocab_size, (args.batch, args.prompt))
+    prompt = jax.numpy.asarray(prompt.astype(np.int32))
+
+    key = jax.random.key(1) if args.temperature > 0 else None
+    gen = jax.jit(
+        lambda p, ids: llama.generate(
+            p, ids, cfg, max_new_tokens=args.new, temperature=args.temperature, key=key
+        )
+    )
+
+    t0 = time.perf_counter()
+    out = jax.device_get(gen(params, prompt))
+    compile_and_first = time.perf_counter() - t0
+
+    runs = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = jax.device_get(gen(params, prompt))
+        runs.append(time.perf_counter() - t0)
+    dt = min(runs)
+    new_tokens = args.batch * args.new
+    print(
+        json.dumps(
+            {
+                "metric": "generation_throughput",
+                "value": round(new_tokens / dt, 1),
+                "unit": "tokens/sec",
+                "s_per_token_per_seq": round(dt / args.new, 5),
+                "params": cfg.num_params(),
+                "first_call_s": round(compile_and_first, 2),
+                "out_shape": list(out.shape),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

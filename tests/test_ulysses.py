@@ -73,3 +73,18 @@ def test_ulysses_tp_head_shard():
     dense = ulysses_attention(q, k, v, mesh=None, axis_name="nope", causal=True)
     uly = jax.jit(lambda q, k, v: ulysses_attention(q, k, v, mesh=mesh, causal=True))(q, k, v)
     np.testing.assert_allclose(np.asarray(uly), np.asarray(dense), atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_minimal_gqa_expansion():
+    """H=8, K=2, sp=4: lcm expansion (to 4 KV heads) rather than full (8)."""
+    from accelerate_tpu.ops.ulysses_attention import _kv_expansion
+
+    assert _kv_expansion(8, 2, 4) == 2   # 2 -> 4 heads, not 8
+    assert _kv_expansion(32, 8, 16) == 2  # llama-8B at sp=16: 8 -> 16, not 32
+    assert _kv_expansion(4, 2, 4) == 2   # lcm=4 == H: full expansion
+    state = AcceleratorState(parallelism_config=ParallelismConfig(dp=2, sp=4))
+    mesh = state.mesh
+    q, k, v = _mk_qkv(jax.random.key(6), 2, 64, 8, 2, 16)
+    dense = ulysses_attention(q, k, v, mesh=None, axis_name="nope", causal=True)
+    uly = jax.jit(lambda q, k, v: ulysses_attention(q, k, v, mesh=mesh, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(uly), np.asarray(dense), atol=2e-5, rtol=2e-5)
