@@ -1016,7 +1016,9 @@ class Accelerator:
     def save_model(self, model, save_directory, max_shard_size="10GB", safe_serialization=True):
         from .checkpointing import save_model_weights
 
-        return save_model_weights(model, save_directory, safe_serialization=safe_serialization)
+        return save_model_weights(
+            model, save_directory, safe_serialization=safe_serialization, max_shard_size=max_shard_size
+        )
 
     def get_state_dict(self, model, unwrap: bool = True):
         if isinstance(model, PreparedModel):
@@ -1025,6 +1027,13 @@ class Accelerator:
 
     def skip_first_batches(self, dataloader, num_batches: int = 0):
         return skip_first_batches(dataloader, num_batches)
+
+    def wait_for_checkpoint(self):
+        """Block until any in-flight async checkpoint writes
+        (``save_state(async_save=True)``) are durable on disk."""
+        for ck in getattr(self, "_async_checkpointers", []):
+            ck.wait_until_finished()
+        self._async_checkpointers = []
 
     def free_memory(self, *objects):
         """Reference ``accelerator.py:3497``: drop references + clear caches."""

@@ -16,6 +16,7 @@ threefry root seed alongside python/numpy/torch states (reference
 
 from __future__ import annotations
 
+import json
 import os
 import pickle
 import random
@@ -73,33 +74,160 @@ def _restore_rng_state(states: dict) -> None:
         torch.set_rng_state(states["torch"])
 
 
-def save_model_weights(model, save_directory, safe_serialization: bool = True, weights_name: str = WEIGHTS_NAME):
+def _parse_size(size) -> int:
+    if isinstance(size, (int, float)):
+        return int(size)
+    s = str(size).upper().strip()
+    # Match the reference's convert_file_size_to_int surface: decimal and
+    # binary units (sizes here are split thresholds, so GB==GiB in spirit).
+    units = (
+        ("TIB", 1024**4), ("GIB", 1024**3), ("MIB", 1024**2), ("KIB", 1024),
+        ("TB", 1024**4), ("GB", 1024**3), ("MB", 1024**2), ("KB", 1024),
+    )
+    for unit, mult in units:
+        if s.endswith(unit):
+            return int(float(s[: -len(unit)]) * mult)
+    return int(s)
+
+
+def save_model_weights(
+    model,
+    save_directory,
+    safe_serialization: bool = True,
+    weights_name: str = WEIGHTS_NAME,
+    max_shard_size="10GB",
+):
     """Save a prepared model's consolidated weights (reference ``save_model``
-    ``accelerator.py:3048``)."""
+    ``accelerator.py:3048``).  Weights above ``max_shard_size`` split into
+    ``model-0000i-of-0000N.safetensors`` files plus a
+    ``model.safetensors.index.json`` weight map (reference sharded export,
+    ``accelerator.py:3110-3157``)."""
     os.makedirs(save_directory, exist_ok=True)
     state_dict = model.state_dict()
     arrays = {k: np.ascontiguousarray(np.asarray(v)) for k, v in state_dict.items()}
-    path = os.path.join(save_directory, weights_name)
-    if safe_serialization:
-        from safetensors.numpy import save_file
-
-        save_file(arrays, path)
-    else:
-        with open(os.path.join(save_directory, f"{MODEL_NAME}.pkl"), "wb") as f:
+    stem = weights_name.rsplit(".", 1)[0]
+    if not safe_serialization:
+        pkl_path = os.path.join(save_directory, f"{stem}.pkl")
+        with open(pkl_path, "wb") as f:
             pickle.dump(arrays, f)
-    return path
+        return pkl_path
+
+    from safetensors.numpy import save_file
+
+    limit = _parse_size(max_shard_size)
+    total = sum(a.nbytes for a in arrays.values())
+    path = os.path.join(save_directory, weights_name)
+
+    def _clear_stale(sharded_now: bool):
+        # A re-save into the same directory must not leave the OTHER format's
+        # files behind: load prefers the index, so a stale one silently wins.
+        index_path = f"{path}.index.json"
+        if os.path.exists(index_path):
+            try:
+                stale = set(json.load(open(index_path)).get("weight_map", {}).values())
+            except Exception:
+                stale = set()
+            if not sharded_now:
+                for fname in stale:
+                    fp = os.path.join(save_directory, fname)
+                    if os.path.exists(fp):
+                        os.remove(fp)
+                os.remove(index_path)
+        if sharded_now and os.path.exists(path):
+            os.remove(path)
+
+    if total <= limit:
+        _clear_stale(sharded_now=False)
+        save_file(arrays, path)
+        return path
+
+    # Greedy sharding in insertion order (one oversized tensor gets its own file).
+    _clear_stale(sharded_now=True)
+    shards: list[dict] = [{}]
+    sizes = [0]
+    for k, a in arrays.items():
+        if shards[-1] and sizes[-1] + a.nbytes > limit:
+            shards.append({})
+            sizes.append(0)
+        shards[-1][k] = a
+        sizes[-1] += a.nbytes
+    weight_map = {}
+    for i, shard in enumerate(shards):
+        fname = f"{stem}-{i + 1:05d}-of-{len(shards):05d}.safetensors"
+        save_file(shard, os.path.join(save_directory, fname))
+        for k in shard:
+            weight_map[k] = fname
+    index = {"metadata": {"total_size": total}, "weight_map": weight_map}
+    index_path = os.path.join(save_directory, f"{weights_name}.index.json")
+    with open(index_path, "w") as f:
+        json.dump(index, f, indent=2)
+    return index_path
 
 
 def load_model_weights(model, input_dir, weights_name: str = WEIGHTS_NAME):
     path = os.path.join(input_dir, weights_name)
-    if os.path.exists(path):
+    index_path = f"{path}.index.json"
+    if os.path.exists(index_path):
+        from safetensors.numpy import load_file
+
+        with open(index_path) as f:
+            weight_map = json.load(f)["weight_map"]
+        state_dict = {}
+        for fname in sorted(set(weight_map.values())):
+            state_dict.update(load_file(os.path.join(input_dir, fname)))
+    elif os.path.exists(path):
         from safetensors.numpy import load_file
 
         state_dict = load_file(path)
     else:
-        with open(os.path.join(input_dir, f"{MODEL_NAME}.pkl"), "rb") as f:
+        stem = weights_name.rsplit(".", 1)[0]
+        with open(os.path.join(input_dir, f"{stem}.pkl"), "rb") as f:
             state_dict = pickle.load(f)
     model.load_state_dict(state_dict)
+
+
+# ---------------------------------------------------------------------------
+# Orbax sharded / async checkpointing (FSDP SHARDED_STATE_DICT path)
+# ---------------------------------------------------------------------------
+
+
+def save_sharded_model(model, directory: str, async_save: bool = False):
+    """Sharded param export via orbax: every process writes only its own
+    shards (no consolidation) — the TPU-native form of the reference's FSDP
+    ``dist_cp`` SHARDED_STATE_DICT save (``utils/fsdp_utils.py:101``).  With
+    ``async_save`` the write overlaps training (orbax AsyncCheckpointer);
+    returns the checkpointer — call ``wait_until_finished()`` before relying
+    on the files."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(directory)
+    ckptr = (
+        ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+        if async_save
+        else ocp.StandardCheckpointer()
+    )
+    # force=True lets orbax replace an existing checkpoint itself (atomic
+    # tmp-dir + rename) — a manual per-process rmtree would race across
+    # processes and could destroy the old checkpoint before the new write
+    # succeeds.
+    ckptr.save(path, model.params, force=True)
+    if not async_save:
+        ckptr.wait_until_finished()
+    return ckptr
+
+
+def load_sharded_model(model, directory: str) -> None:
+    """Restore an orbax sharded export with each param's LIVE sharding, so
+    every process reads only the shards it owns."""
+    import orbax.checkpoint as ocp
+
+    abstract = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=getattr(a, "sharding", None)),
+        model.params,
+    )
+    ckptr = ocp.StandardCheckpointer()
+    restored = ckptr.restore(os.path.abspath(directory), abstract)
+    model._set_params(restored)
 
 
 def save_custom_state(obj, path: str, index: int = 0):
@@ -133,6 +261,21 @@ def _resolve_output_dir(accelerator, output_dir: Optional[str]) -> str:
     return output_dir
 
 
+def _use_sharded_save(accelerator) -> bool:
+    """True when the FSDP plugin asks for SHARDED_STATE_DICT and the prepared
+    models hold jax param pytrees (orbax per-process shard writing applies)."""
+    from .utils.dataclasses import DistributedType
+
+    plugin = getattr(accelerator.state, "fsdp_plugin", None)
+    return (
+        accelerator.distributed_type == DistributedType.FSDP
+        and plugin is not None
+        and getattr(plugin, "state_dict_type", None) == "SHARDED_STATE_DICT"
+        and all(hasattr(m, "params") for m in accelerator._models)
+        and len(accelerator._models) > 0
+    )
+
+
 def save_accelerator_state(accelerator, output_dir: Optional[str] = None, **save_model_func_kwargs) -> str:
     """Reference ``save_accelerator_state`` ``checkpointing.py:56`` +
     ``Accelerator.save_state`` orchestration."""
@@ -140,10 +283,30 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, **save
     os.makedirs(output_dir, exist_ok=True)
     state = accelerator.state
 
-    if state.is_main_process or state.num_processes == 1:
+    sharded = _use_sharded_save(accelerator)
+    if sharded:
+        # A still-running async save from the previous save_state must finish
+        # before its directory can be replaced.
+        for ck in getattr(accelerator, "_async_checkpointers", []):
+            ck.wait_until_finished()
+        async_save = bool(save_model_func_kwargs.get("async_save", False))
+        checkpointers = []
+        # Orbax path runs on EVERY process — each writes only its own shards
+        # (reference FSDP SHARDED_STATE_DICT semantics).
         for i, model in enumerate(accelerator._models):
-            name = WEIGHTS_NAME if i == 0 else f"{MODEL_NAME}_{i}.safetensors"
-            save_model_weights(model, output_dir, weights_name=name)
+            name = f"{MODEL_NAME}_orbax" if i == 0 else f"{MODEL_NAME}_{i}_orbax"
+            checkpointers.append(
+                save_sharded_model(model, os.path.join(output_dir, name), async_save=async_save)
+            )
+        # Keep async handles reachable so callers (and the next save) can wait:
+        # accelerator.wait_for_checkpoint().
+        accelerator._async_checkpointers = checkpointers if async_save else []
+
+    if state.is_main_process or state.num_processes == 1:
+        if not sharded:
+            for i, model in enumerate(accelerator._models):
+                name = WEIGHTS_NAME if i == 0 else f"{MODEL_NAME}_{i}.safetensors"
+                save_model_weights(model, output_dir, weights_name=name)
         for i, opt in enumerate(accelerator._optimizers):
             name = f"{OPTIMIZER_NAME}.bin" if i == 0 else f"{OPTIMIZER_NAME}_{i}.bin"
             with open(os.path.join(output_dir, name), "wb") as f:
@@ -189,6 +352,10 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None, **load_
         raise ValueError("input_dir required")
 
     for i, model in enumerate(accelerator._models):
+        orbax_dir = os.path.join(input_dir, f"{MODEL_NAME}_orbax" if i == 0 else f"{MODEL_NAME}_{i}_orbax")
+        if os.path.isdir(orbax_dir):
+            load_sharded_model(model, orbax_dir)
+            continue
         name = WEIGHTS_NAME if i == 0 else f"{MODEL_NAME}_{i}.safetensors"
         load_model_weights(model, input_dir, weights_name=name)
     for i, opt in enumerate(accelerator._optimizers):
