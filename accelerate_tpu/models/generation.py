@@ -1,0 +1,68 @@
+"""Shared autoregressive generation driver for the model families.
+
+Each family supplies ``init_cache(config, batch, max_len)`` and
+``apply_cached(params, ids, config, cache) -> (logits, cache)``; the driver
+compiles prefill + a one-token ``lax.scan`` decode loop into a single XLA
+program (no per-token Python dispatch — the TPU-native answer to the
+reference's torch generation loop, BASELINE.md s/token tables)."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["generate_loop", "select_token"]
+
+
+def select_token(logits: jax.Array, temperature: float, key, i) -> jax.Array:
+    """Greedy argmax (temperature<=0) or categorical sample at step ``i``."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    step_key = jax.random.fold_in(key, i)
+    return jax.random.categorical(step_key, logits / temperature, axis=-1).astype(jnp.int32)
+
+
+def generate_loop(
+    apply_cached: Callable,
+    init_cache: Callable,
+    params,
+    input_ids: jax.Array,
+    config,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    key: Optional[jax.Array] = None,
+    max_len: Optional[int] = None,
+) -> jax.Array:
+    """Dense prompt ``[B, S]`` -> ``[B, S + max_new_tokens]``."""
+    b, s = input_ids.shape
+    total = s + max_new_tokens
+    if max_len is None:
+        max_len = total
+    if total > max_len:
+        raise ValueError(f"prompt ({s}) + max_new_tokens ({max_new_tokens}) > max_len ({max_len})")
+    if temperature > 0 and key is None:
+        raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    if max_new_tokens < 0:
+        raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
+    if max_new_tokens == 0:
+        return input_ids
+
+    cache = init_cache(config, b, max_len)
+    logits, cache = apply_cached(params, input_ids, config, cache)
+    next_tok = select_token(logits[:, -1], temperature, key, 0)
+
+    def step(carry, i):
+        tok, cache, key = carry
+        logits, cache = apply_cached(params, tok[:, None], config, cache)
+        nxt = select_token(logits[:, -1], temperature, key, i)
+        return (nxt, cache, key), tok
+
+    (last, _, _), toks = jax.lax.scan(
+        step, (next_tok, cache, key), jnp.arange(1, max_new_tokens)
+    )
+    generated = (
+        jnp.concatenate([toks.T, last[:, None]], axis=1) if max_new_tokens > 1 else last[:, None]
+    )
+    return jnp.concatenate([input_ids, generated], axis=1)

@@ -144,24 +144,36 @@ def _layer_norm(x, scale, bias, eps):
     return y.astype(x.dtype) * scale.astype(x.dtype) + bias.astype(x.dtype)
 
 
-def _layer(carry, p, *, c: GPT2Config, mask, act_spec):
-    x = carry
-    d, h, hd = c.hidden_size, c.num_heads, c.head_dim
+def _qkv(x, p, c: GPT2Config):
+    """Pre-norm fused QKV projection -> q, k, v ``[B, S, H, hd]``."""
     b, s, _ = x.shape
-
     hn = _layer_norm(x, p["ln_attn_scale"], p["ln_attn_bias"], c.layer_norm_eps)
     qkv = hn @ p["w_qkv"].astype(c.dtype) + p["b_qkv"].astype(c.dtype)
-    q, k, v = jnp.split(qkv.reshape(b, s, 3, h, hd), 3, axis=2)
-    q, k, v = (t[:, :, 0] for t in (q, k, v))
-    scores = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) / np.sqrt(hd)
-    scores = jnp.where(mask[:, None], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
-    attn = jnp.einsum("bhst,bthd->bshd", probs, v).reshape(b, s, d)
-    x = x + attn @ p["w_proj"].astype(c.dtype) + p["b_proj"].astype(c.dtype)
+    q, k, v = jnp.split(qkv.reshape(b, s, 3, c.num_heads, c.head_dim), 3, axis=2)
+    return (t[:, :, 0] for t in (q, k, v))
 
+
+def _attend(q, k, v, mask, c: GPT2Config):
+    """Masked softmax attention; mask broadcasts against ``[B, H, Sq, Sk]``."""
+    b, s = q.shape[:2]
+    scores = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) / np.sqrt(c.head_dim)
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhst,bthd->bshd", probs, v).reshape(b, s, c.hidden_size)
+
+
+def _mlp_block(x, p, c: GPT2Config):
     hn = _layer_norm(x, p["ln_mlp_scale"], p["ln_mlp_bias"], c.layer_norm_eps)
     u = jax.nn.gelu(hn @ p["w_up"].astype(c.dtype) + p["b_up"].astype(c.dtype))
-    x = x + u @ p["w_down"].astype(c.dtype) + p["b_down"].astype(c.dtype)
+    return x + u @ p["w_down"].astype(c.dtype) + p["b_down"].astype(c.dtype)
+
+
+def _layer(carry, p, *, c: GPT2Config, mask, act_spec):
+    x = carry
+    q, k, v = _qkv(x, p, c)
+    attn = _attend(q, k, v, mask[:, None], c)
+    x = x + attn @ p["w_proj"].astype(c.dtype) + p["b_proj"].astype(c.dtype)
+    x = _mlp_block(x, p, c)
     if act_spec is not None:
         x = _constrain(x, act_spec)
     return x, None
@@ -198,3 +210,81 @@ def loss_fn(params: dict, batch: dict, config: GPT2Config) -> jax.Array:
     labels, weights = labels_and_weights(batch)
     logits = apply(params, batch["input_ids"], config, attention_mask=batch.get("attention_mask"))
     return cross_entropy(logits, labels, weights)
+
+
+# ---------------------------------------------------------------------------
+# KV-cache inference (shared driver: models/generation.py)
+# ---------------------------------------------------------------------------
+
+
+def init_cache(config: GPT2Config, batch_size: int, max_len: int) -> dict:
+    """Zeroed KV cache: k/v ``[L, B, max_len, H, hd]`` + write index."""
+    c = config
+    shape = (c.num_layers, batch_size, max_len, c.num_heads, c.head_dim)
+    return {
+        "k": jnp.zeros(shape, c.dtype),
+        "v": jnp.zeros(shape, c.dtype),
+        "index": jnp.zeros((), jnp.int32),
+    }
+
+
+def apply_cached(
+    params: dict,
+    input_ids: jax.Array,
+    config: GPT2Config,
+    cache: dict,
+) -> tuple[jax.Array, dict]:
+    """Forward over new tokens at positions ``index..index+S`` with cache
+    read/write; returns (logits [B, S, V], updated cache)."""
+    c = config
+    b, s = input_ids.shape
+    index = cache["index"]
+    max_len = cache["k"].shape[2]
+    if max_len > c.max_seq_len:
+        # wpe has max_seq_len rows; a longer cache would silently clamp the
+        # position gather under jit and degrade output past the table edge.
+        raise ValueError(
+            f"cache length {max_len} exceeds max_seq_len {c.max_seq_len} "
+            "(GPT-2's learned position table)"
+        )
+
+    positions = index + jnp.arange(s)
+    x = params["wte"].astype(c.dtype)[input_ids] + params["wpe"].astype(c.dtype)[positions][None]
+
+    k_pos = jnp.arange(max_len)
+    mask = positions[:, None] >= k_pos[None, :]  # [S, max_len]
+
+    def body(carry, xs):
+        lp, ck, cv = xs
+        x = carry
+        q, k, v = _qkv(x, lp, c)
+        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, index, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, index, 0, 0))
+        attn = _attend(q, ck, cv, mask[None, None], c)
+        x = x + attn @ lp["w_proj"].astype(c.dtype) + lp["b_proj"].astype(c.dtype)
+        x = _mlp_block(x, lp, c)
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    x = _layer_norm(x, params["final_ln_scale"], params["final_ln_bias"], c.layer_norm_eps)
+    logits = (x @ params["wte"].astype(c.dtype).T).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v, "index": index + s}
+
+
+def generate(
+    params: dict,
+    input_ids: jax.Array,
+    config: GPT2Config,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    key=None,
+    max_len=None,
+) -> jax.Array:
+    """Autoregressive generation (one compiled XLA program; see
+    models/generation.py)."""
+    from .generation import generate_loop
+
+    return generate_loop(
+        apply_cached, init_cache, params, input_ids, config,
+        max_new_tokens, temperature=temperature, key=key, max_len=max_len,
+    )

@@ -563,39 +563,9 @@ def generate(
     The decode loop is a single ``lax.scan`` of a one-token cached step, so the
     whole call compiles to one XLA program.
     """
-    c = config
-    b, s = input_ids.shape
-    total = s + max_new_tokens
-    if max_len is None:
-        max_len = total
-    if total > max_len:
-        raise ValueError(f"prompt ({s}) + max_new_tokens ({max_new_tokens}) > max_len ({max_len})")
-    if temperature > 0 and key is None:
-        raise ValueError("sampling (temperature > 0) needs a PRNG key")
-    if max_new_tokens < 0:
-        raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
-    if max_new_tokens == 0:
-        return input_ids
+    from .generation import generate_loop
 
-    cache = init_cache(c, b, max_len)
-    logits, cache = apply_cached(params, input_ids, c, cache)
-    next_tok = _select_token(logits[:, -1], temperature, key, 0)
-
-    def step(carry, i):
-        tok, cache, key = carry
-        logits, cache = apply_cached(params, tok[:, None], c, cache)
-        nxt = _select_token(logits[:, -1], temperature, key, i)
-        return (nxt, cache, key), tok
-
-    (last, _, _), toks = jax.lax.scan(
-        step, (next_tok, cache, key), jnp.arange(1, max_new_tokens)
+    return generate_loop(
+        apply_cached, init_cache, params, input_ids, config,
+        max_new_tokens, temperature=temperature, key=key, max_len=max_len,
     )
-    generated = jnp.concatenate([toks.T, last[:, None]], axis=1) if max_new_tokens > 1 else last[:, None]
-    return jnp.concatenate([input_ids, generated], axis=1)
-
-
-def _select_token(logits, temperature: float, key, i):
-    if temperature <= 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    step_key = jax.random.fold_in(key, i)
-    return jax.random.categorical(step_key, logits / temperature, axis=-1).astype(jnp.int32)

@@ -262,3 +262,75 @@ def loss_fn(params: dict, batch: dict, config: MixtralConfig) -> jax.Array:
         + config.router_aux_coef * aux["load_balancing_loss"]
         + config.router_z_coef * aux["router_z_loss"]
     )
+
+
+# ---------------------------------------------------------------------------
+# KV-cache inference (shared driver: models/generation.py)
+# ---------------------------------------------------------------------------
+
+
+def init_cache(config: MixtralConfig, batch_size: int, max_len: int) -> dict:
+    """Zeroed KV cache (same layout as llama: attention is shared code)."""
+    c = config
+    shape = (c.num_layers, batch_size, max_len, c.num_kv_heads, c.head_dim_)
+    return {
+        "k": jnp.zeros(shape, c.dtype),
+        "v": jnp.zeros(shape, c.dtype),
+        "index": jnp.zeros((), jnp.int32),
+    }
+
+
+def apply_cached(
+    params: dict,
+    input_ids: jax.Array,
+    config: MixtralConfig,
+    cache: dict,
+) -> tuple[jax.Array, dict]:
+    """Forward over new tokens with cache read/write; router aux losses are
+    not accumulated (inference)."""
+    c = config
+    b, s = input_ids.shape
+    index = cache["index"]
+    positions = jnp.broadcast_to(index + jnp.arange(s), (b, s))
+    x = params["embed"].astype(c.dtype)[input_ids]
+    capacity = expert_capacity(s, c.num_experts, c.top_k, c.capacity_factor)
+
+    def body(carry, xs):
+        lp, ck, cv = xs
+        y, ck, cv = _llama._attention_block_cached(carry, lp, c, ck, cv, index, positions)
+        h = _llama._rms_norm(y, lp["ln_mlp"], c.rms_eps)
+        ffn, _ = moe_ffn(
+            h,
+            lp["router"],
+            lp["w_gate"],
+            lp["w_up"],
+            lp["w_down"],
+            top_k=c.top_k,
+            capacity=capacity,
+            compute_dtype=c.dtype,
+        )
+        return y + ffn, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    x = _llama._rms_norm(x, params["final_norm"], c.rms_eps)
+    logits = (x @ params["lm_head"].astype(c.dtype)).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v, "index": index + s}
+
+
+def generate(
+    params: dict,
+    input_ids: jax.Array,
+    config: MixtralConfig,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    key=None,
+    max_len=None,
+) -> jax.Array:
+    """Autoregressive generation (one compiled XLA program; see
+    models/generation.py)."""
+    from .generation import generate_loop
+
+    return generate_loop(
+        apply_cached, init_cache, params, input_ids, config,
+        max_new_tokens, temperature=temperature, key=key, max_len=max_len,
+    )

@@ -2,6 +2,7 @@
 matches step-by-step argmax without a cache."""
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -85,3 +86,52 @@ def test_generate_zero_new_tokens():
     prompt = jax.random.randint(jax.random.key(6), (1, 4), 0, cfg.vocab_size)
     out = llama.generate(params, prompt, cfg, max_new_tokens=0)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
+
+
+def test_gpt2_cached_matches_dense_and_generates():
+    from accelerate_tpu.models import gpt2
+
+    cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32)
+    params = gpt2.init_params(cfg, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (2, 12), 0, cfg.vocab_size)
+
+    dense = gpt2.apply(params, ids, cfg)
+    cache = gpt2.init_cache(cfg, 2, 20)
+    cached, cache = gpt2.apply_cached(params, ids, cfg, cache)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(cached), atol=1e-4, rtol=1e-4)
+
+    out = gpt2.generate(params, ids, cfg, max_new_tokens=5)
+    assert out.shape == (2, 17)
+    # Greedy parity vs uncached loop.
+    seq = ids
+    for _ in range(5):
+        logits = gpt2.apply(params, seq, cfg)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(seq.dtype)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+
+def test_mixtral_cached_matches_dense_and_generates():
+    from accelerate_tpu.models import mixtral
+
+    cfg = mixtral.MixtralConfig.tiny(dtype=jnp.float32)
+    params = mixtral.init_params(cfg, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (2, 12), 0, cfg.vocab_size)
+
+    dense, _ = mixtral.apply(params, ids, cfg)
+    cache = mixtral.init_cache(cfg, 2, 20)
+    cached, cache = mixtral.apply_cached(params, ids, cfg, cache)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(cached), atol=1e-4, rtol=1e-4)
+
+    out = mixtral.generate(params, ids, cfg, max_new_tokens=4)
+    assert out.shape == (2, 16)
+
+
+def test_gpt2_cache_beyond_position_table_errors():
+    from accelerate_tpu.models import gpt2
+
+    cfg = gpt2.GPT2Config.tiny(max_seq_len=16, dtype=jnp.float32)
+    params = gpt2.init_params(cfg, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (1, 12), 0, cfg.vocab_size)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        gpt2.generate(params, ids, cfg, max_new_tokens=10)  # 22 > 16
