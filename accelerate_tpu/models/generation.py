@@ -13,7 +13,35 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["generate_loop", "select_token"]
+__all__ = ["generate_loop", "select_token", "make_kv_cache", "check_cache_room"]
+
+
+def make_kv_cache(num_layers: int, batch_size: int, max_len: int,
+                  num_kv_heads: int, head_dim: int, dtype) -> dict:
+    """Zeroed stacked KV cache shared by every family: k/v
+    ``[L, B, max_len, K, hd]`` plus the int32 write index."""
+    shape = (num_layers, batch_size, max_len, num_kv_heads, head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype),
+        "v": jnp.zeros(shape, dtype),
+        "index": jnp.zeros((), jnp.int32),
+    }
+
+
+def check_cache_room(index, new_tokens: int, max_len: int) -> None:
+    """Eager-mode overflow guard: ``dynamic_update_slice`` CLAMPS an
+    out-of-range write start under jit (silent cache corruption), so callers
+    driving ``apply_cached`` directly get a real error when the index is
+    concrete; traced callers rely on the documented ``index + S <= max_len``
+    contract (generate_loop maintains it)."""
+    try:
+        concrete = int(index)
+    except Exception:  # traced inside jit — cannot check
+        return
+    if concrete + new_tokens > max_len:
+        raise ValueError(
+            f"KV cache overflow: index {concrete} + {new_tokens} new tokens > max_len {max_len}"
+        )
 
 
 def select_token(logits: jax.Array, temperature: float, key, i) -> jax.Array:

@@ -150,7 +150,7 @@ def _qkv(x, p, c: GPT2Config):
     hn = _layer_norm(x, p["ln_attn_scale"], p["ln_attn_bias"], c.layer_norm_eps)
     qkv = hn @ p["w_qkv"].astype(c.dtype) + p["b_qkv"].astype(c.dtype)
     q, k, v = jnp.split(qkv.reshape(b, s, 3, c.num_heads, c.head_dim), 3, axis=2)
-    return (t[:, :, 0] for t in (q, k, v))
+    return q[:, :, 0], k[:, :, 0], v[:, :, 0]
 
 
 def _attend(q, k, v, mask, c: GPT2Config):
@@ -219,13 +219,10 @@ def loss_fn(params: dict, batch: dict, config: GPT2Config) -> jax.Array:
 
 def init_cache(config: GPT2Config, batch_size: int, max_len: int) -> dict:
     """Zeroed KV cache: k/v ``[L, B, max_len, H, hd]`` + write index."""
+    from .generation import make_kv_cache
+
     c = config
-    shape = (c.num_layers, batch_size, max_len, c.num_heads, c.head_dim)
-    return {
-        "k": jnp.zeros(shape, c.dtype),
-        "v": jnp.zeros(shape, c.dtype),
-        "index": jnp.zeros((), jnp.int32),
-    }
+    return make_kv_cache(c.num_layers, batch_size, max_len, c.num_heads, c.head_dim, c.dtype)
 
 
 def apply_cached(
@@ -238,8 +235,11 @@ def apply_cached(
     read/write; returns (logits [B, S, V], updated cache)."""
     c = config
     b, s = input_ids.shape
+    from .generation import check_cache_room
+
     index = cache["index"]
     max_len = cache["k"].shape[2]
+    check_cache_room(index, s, max_len)
     if max_len > c.max_seq_len:
         # wpe has max_seq_len rows; a longer cache would silently clamp the
         # position gather under jit and degrade output past the table edge.

@@ -487,13 +487,10 @@ def loss_fn(
 
 def init_cache(config: LlamaConfig, batch_size: int, max_len: int) -> dict:
     """Zeroed KV cache: k/v ``[L, B, max_len, K, hd]`` + write index."""
+    from .generation import make_kv_cache
+
     c = config
-    shape = (c.num_layers, batch_size, max_len, c.num_kv_heads, c.head_dim_)
-    return {
-        "k": jnp.zeros(shape, c.dtype),
-        "v": jnp.zeros(shape, c.dtype),
-        "index": jnp.zeros((), jnp.int32),
-    }
+    return make_kv_cache(c.num_layers, batch_size, max_len, c.num_kv_heads, c.head_dim_, c.dtype)
 
 
 def _attention_block_cached(x, p, c, ck, cv, index, positions):
@@ -529,9 +526,12 @@ def apply_cached(
 
     input_ids ``[B, S]`` are the tokens at positions ``cache['index'] ..
     index+S``; returns (logits ``[B, S, V]``, updated cache)."""
+    from .generation import check_cache_room
+
     c = config
     b, s = input_ids.shape
     index = cache["index"]
+    check_cache_room(index, s, cache["k"].shape[2])
     positions = jnp.broadcast_to(index + jnp.arange(s), (b, s))
     x = embed_tokens(params, input_ids, c)
 

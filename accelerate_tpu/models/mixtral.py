@@ -271,13 +271,10 @@ def loss_fn(params: dict, batch: dict, config: MixtralConfig) -> jax.Array:
 
 def init_cache(config: MixtralConfig, batch_size: int, max_len: int) -> dict:
     """Zeroed KV cache (same layout as llama: attention is shared code)."""
+    from .generation import make_kv_cache
+
     c = config
-    shape = (c.num_layers, batch_size, max_len, c.num_kv_heads, c.head_dim_)
-    return {
-        "k": jnp.zeros(shape, c.dtype),
-        "v": jnp.zeros(shape, c.dtype),
-        "index": jnp.zeros((), jnp.int32),
-    }
+    return make_kv_cache(c.num_layers, batch_size, max_len, c.num_kv_heads, c.head_dim_, c.dtype)
 
 
 def apply_cached(
@@ -288,9 +285,12 @@ def apply_cached(
 ) -> tuple[jax.Array, dict]:
     """Forward over new tokens with cache read/write; router aux losses are
     not accumulated (inference)."""
+    from .generation import check_cache_room
+
     c = config
     b, s = input_ids.shape
     index = cache["index"]
+    check_cache_room(index, s, cache["k"].shape[2])
     positions = jnp.broadcast_to(index + jnp.arange(s), (b, s))
     x = params["embed"].astype(c.dtype)[input_ids]
     capacity = expert_capacity(s, c.num_experts, c.top_k, c.capacity_factor)

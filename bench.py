@@ -126,6 +126,37 @@ def main():
     def _alarm(signum, frame):
         raise TimeoutError("bench rung exceeded time budget")
 
+    # Fast-fail when the device backend is unreachable (e.g. wedged TPU
+    # tunnel).  The probe MUST be a subprocess: backend init blocks inside a C
+    # call, which a SIGALRM-based timeout cannot interrupt.
+    import subprocess
+
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.device_count(), jax.devices()[0].device_kind)"],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        ok = probe.returncode == 0
+        detail = probe.stdout.strip() if ok else probe.stderr[-300:]
+    except subprocess.TimeoutExpired:
+        ok, detail = False, "no response in 120s"
+    if not ok:
+        print(
+            json.dumps(
+                {
+                    "metric": "train_mfu",
+                    "value": 0.0,
+                    "unit": "mfu_fraction",
+                    "vs_baseline": 0.0,
+                    "error": f"device backend unreachable: {detail}",
+                }
+            )
+        )
+        sys.exit(1)
+    print(f"# bench devices: {detail}", file=sys.stderr)
+
     result = None
     errors = []
     for name, d, layers, f, b, s, impl, policy in ladder:
