@@ -1,0 +1,11 @@
+"""Deprecation shim (reference ``memory_utils.py:18-22``): import from
+``accelerate_tpu.utils.memory`` instead."""
+
+import warnings
+
+from .utils.memory import *  # noqa: F401,F403
+
+warnings.warn(
+    "accelerate_tpu.memory_utils is deprecated; use accelerate_tpu.utils.memory",
+    FutureWarning,
+)

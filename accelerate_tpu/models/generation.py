@@ -36,7 +36,9 @@ def check_cache_room(index, new_tokens: int, max_len: int) -> None:
     contract (generate_loop maintains it)."""
     try:
         concrete = int(index)
-    except Exception:  # traced inside jit — cannot check
+    except jax.errors.TracerIntegerConversionError:  # traced inside jit
+        return
+    except jax.errors.ConcretizationTypeError:  # abstract value (e.g. eval_shape)
         return
     if concrete + new_tokens > max_len:
         raise ValueError(

@@ -56,6 +56,20 @@ class BaseEnum(str, enum.Enum):
         return [e.value for e in cls]
 
 
+class CustomDtype(BaseEnum):
+    """Sub-byte / quantized storage dtypes for memory planning (reference
+    ``utils/dataclasses.py:744``): these aren't numpy dtypes, so
+    ``infer_auto_device_map``'s size math handles them by name."""
+
+    FP8 = "fp8"
+    INT4 = "int4"
+    INT2 = "int2"
+
+    @property
+    def byte_size(self) -> float:
+        return {"fp8": 1.0, "int4": 0.5, "int2": 0.25}[self.value]
+
+
 class DistributedType(BaseEnum):
     """Type of distributed environment.
 

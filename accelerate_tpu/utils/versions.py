@@ -1,0 +1,62 @@
+"""Version comparison helpers (reference ``utils/versions.py``)."""
+
+from __future__ import annotations
+
+import importlib.metadata
+import operator
+
+__all__ = ["compare_versions", "is_torch_version", "is_jax_version"]
+
+_OPS = {
+    "<": operator.lt,
+    "<=": operator.le,
+    "==": operator.eq,
+    "!=": operator.ne,
+    ">=": operator.ge,
+    ">": operator.gt,
+}
+
+
+def _version_tuple(v: str) -> tuple:
+    """(release..., pre_flag) with pre-releases ordered BEFORE their release
+    and components zero-padded for cross-length equality ("1.2" == "1.2.0")."""
+    v = v.lstrip("vV").split("+")[0]
+    parts = []
+    pre = 0  # 0 = final release, -1 = pre-release (rc/a/b/dev sorts earlier)
+    for p in v.split("."):
+        digits = ""
+        for ch in p:
+            if ch.isdigit():
+                digits += ch
+            else:
+                pre = -1  # anything non-numeric marks a pre-release segment
+                break
+        parts.append(int(digits) if digits else 0)
+    while len(parts) < 4:
+        parts.append(0)
+    return tuple(parts[:4]) + (pre,)
+
+
+def compare_versions(library_or_version, operation: str, requirement_version: str) -> bool:
+    """``compare_versions("jax", ">=", "0.4")`` or with an explicit version
+    string as first arg (reference ``utils/versions.py compare_versions``)."""
+    if operation not in _OPS:
+        raise ValueError(f"operation must be one of {sorted(_OPS)}, got {operation!r}")
+    raw = str(library_or_version)
+    if raw.lstrip("vV")[:1].isdigit():
+        version = raw
+    else:
+        version = importlib.metadata.version(raw)
+    return _OPS[operation](_version_tuple(version), _version_tuple(requirement_version))
+
+
+def is_torch_version(operation: str, version: str) -> bool:
+    import torch
+
+    return compare_versions(torch.__version__, operation, version)
+
+
+def is_jax_version(operation: str, version: str) -> bool:
+    import jax
+
+    return compare_versions(jax.__version__, operation, version)

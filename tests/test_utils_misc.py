@@ -148,3 +148,42 @@ def test_main_process_tqdm():
 
     bar = tqdm(range(3))
     assert list(bar) == [0, 1, 2]
+
+
+def test_versions_and_custom_dtype():
+    import pytest
+
+    from accelerate_tpu.utils import CustomDtype, compare_versions, is_jax_version, is_torch_version
+    from accelerate_tpu.utils.modeling import dtype_byte_size
+
+    assert compare_versions("1.2.3", ">=", "1.2")
+    assert not compare_versions("1.2.3", ">", "2.0")
+    assert compare_versions("numpy", ">=", "1.0")
+    assert is_jax_version(">=", "0.4")
+    assert is_torch_version(">=", "1.0")
+    with pytest.raises(ValueError):
+        compare_versions("1.0", "~=", "1.0")
+
+    assert dtype_byte_size(CustomDtype.INT4) == 0.5
+    assert dtype_byte_size("fp8") == 1.0
+    assert dtype_byte_size(CustomDtype.INT2) == 0.25
+
+
+def test_memory_utils_shim():
+    import warnings
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        import accelerate_tpu.memory_utils  # noqa: F401
+
+        assert any("deprecated" in str(x.message) for x in w)
+    from accelerate_tpu.memory_utils import find_executable_batch_size  # noqa: F401
+
+
+def test_version_prerelease_and_padding():
+    from accelerate_tpu.utils import compare_versions
+
+    assert not compare_versions("0.4.0rc1", ">", "0.4.0")  # rc sorts before final
+    assert compare_versions("0.4.0", ">", "0.4.0rc1")
+    assert compare_versions("1.2", "==", "1.2.0")
+    assert compare_versions("v1.2.3", ">=", "1.2")  # git-tag prefix
