@@ -45,10 +45,6 @@ def dtype_byte_size(dtype) -> float:
     s = str(dtype).replace("torch.", "")
     if s == "bool":
         return 1 / 8
-    try:
-        return CustomDtype(s).byte_size
-    except ValueError:
-        pass
     m = re.search(r"[^\d](\d+)(_\w+)?$", s)
     if m is None:
         raise ValueError(f"`dtype` is not a valid dtype: {dtype}.")

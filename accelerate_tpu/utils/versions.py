@@ -5,6 +5,8 @@ from __future__ import annotations
 import importlib.metadata
 import operator
 
+from packaging.version import parse as _parse_version
+
 __all__ = ["compare_versions", "is_torch_version", "is_jax_version"]
 
 _OPS = {
@@ -17,26 +19,6 @@ _OPS = {
 }
 
 
-def _version_tuple(v: str) -> tuple:
-    """(release..., pre_flag) with pre-releases ordered BEFORE their release
-    and components zero-padded for cross-length equality ("1.2" == "1.2.0")."""
-    v = v.lstrip("vV").split("+")[0]
-    parts = []
-    pre = 0  # 0 = final release, -1 = pre-release (rc/a/b/dev sorts earlier)
-    for p in v.split("."):
-        digits = ""
-        for ch in p:
-            if ch.isdigit():
-                digits += ch
-            else:
-                pre = -1  # anything non-numeric marks a pre-release segment
-                break
-        parts.append(int(digits) if digits else 0)
-    while len(parts) < 4:
-        parts.append(0)
-    return tuple(parts[:4]) + (pre,)
-
-
 def compare_versions(library_or_version, operation: str, requirement_version: str) -> bool:
     """``compare_versions("jax", ">=", "0.4")`` or with an explicit version
     string as first arg (reference ``utils/versions.py compare_versions``)."""
@@ -44,10 +26,10 @@ def compare_versions(library_or_version, operation: str, requirement_version: st
         raise ValueError(f"operation must be one of {sorted(_OPS)}, got {operation!r}")
     raw = str(library_or_version)
     if raw.lstrip("vV")[:1].isdigit():
-        version = raw
+        version = raw.lstrip("vV")
     else:
         version = importlib.metadata.version(raw)
-    return _OPS[operation](_version_tuple(version), _version_tuple(requirement_version))
+    return _OPS[operation](_parse_version(version), _parse_version(requirement_version))
 
 
 def is_torch_version(operation: str, version: str) -> bool:

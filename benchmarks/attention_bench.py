@@ -1,0 +1,77 @@
+"""Attention implementation microbenchmark: einsum vs flash (XLA blockwise)
+vs pallas (fused MXU kernel), fwd+bwd, on the current device.
+
+Run:  python benchmarks/attention_bench.py [--batch 4 --seq 2048 --heads 16 --kv_heads 8 --dim 128]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch", type=int, default=4)
+    parser.add_argument("--seq", type=int, default=2048)
+    parser.add_argument("--heads", type=int, default=16)
+    parser.add_argument("--kv_heads", type=int, default=8)
+    parser.add_argument("--dim", type=int, default=128)
+    parser.add_argument("--block", type=int, default=512)
+    parser.add_argument("--steps", type=int, default=20)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    b, s, h, kh, d = args.batch, args.seq, args.heads, args.kv_heads, args.dim
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (b, s, h, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, s, kh, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, s, kh, d), jnp.bfloat16)
+
+    def dense_impl(q, k, v):
+        g = h // kh
+        kf = jnp.repeat(k, g, axis=2)
+        vf = jnp.repeat(v, g, axis=2)
+        scores = jnp.einsum("bshd,bthd->bhst", q, kf).astype(jnp.float32) / (d**0.5)
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask[None, None], scores, -1e30)
+        p = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhst,bthd->bshd", p.astype(vf.dtype), vf)
+
+    def flash_impl(q, k, v):
+        from accelerate_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True, block_size=args.block)
+
+    def pallas_impl(q, k, v):
+        from accelerate_tpu.ops.pallas_attention import pallas_attention
+
+        return pallas_attention(q, k, v, causal=True, block_size=args.block)
+
+    impls = {"einsum": dense_impl, "flash": flash_impl, "pallas": pallas_impl}
+    # Causal attention fwd+bwd FLOPs: fwd 2*2*b*h*s^2*d/2, bwd ~2.5x fwd.
+    flops = 3.5 * 4 * b * h * s * s * d / 2
+
+    results = {}
+    for name, impl in impls.items():
+        try:
+            step = jax.jit(jax.grad(lambda q, k, v: jnp.sum(impl(q, k, v).astype(jnp.float32) ** 2), argnums=(0, 1, 2)))
+            out = step(q, k, v)
+            jax.device_get(out[0])  # compile + sync
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                out = step(q, k, v)
+            jax.device_get(out[0])
+            dt = (time.perf_counter() - t0) / args.steps
+            results[name] = {"ms": round(dt * 1e3, 3), "tflops": round(flops / dt / 1e12, 2)}
+        except Exception as e:
+            results[name] = {"error": f"{type(e).__name__}: {str(e)[:120]}"}
+
+    print(json.dumps({"metric": "attention_fwd_bwd", "shape": [b, s, h, kh, d], "impls": results}))
+
+
+if __name__ == "__main__":
+    main()

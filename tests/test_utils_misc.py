@@ -187,3 +187,11 @@ def test_version_prerelease_and_padding():
     assert compare_versions("0.4.0", ">", "0.4.0rc1")
     assert compare_versions("1.2", "==", "1.2.0")
     assert compare_versions("v1.2.3", ">=", "1.2")  # git-tag prefix
+
+
+def test_version_post_release_and_rc_ordering():
+    from accelerate_tpu.utils import compare_versions
+
+    assert compare_versions("1.2.3.post1", ">=", "1.2.3")
+    assert compare_versions("0.4.0rc2", ">", "0.4.0rc1")
+    assert not compare_versions("0.4.0rc1", ">=", "0.4.0rc2")
