@@ -64,6 +64,10 @@ def launch_command_parser(subparsers=None):
     parser.add_argument("--fsdp_cpu_offload", action="store_true", default=None)
     # Misc
     parser.add_argument("--debug", action="store_true", help="ACCELERATE_DEBUG_MODE=1")
+    parser.add_argument("-m", "--module", action="store_true",
+                        help="Run the training script as a python module (python -m)")
+    parser.add_argument("--no_python", action="store_true",
+                        help="Execute the script directly (it is not a python file)")
     parser.add_argument("training_script", type=str)
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
     parser.set_defaults(func=launch_command)
@@ -125,6 +129,17 @@ def build_env(merged: dict, debug: bool = False, cpu: bool = False) -> dict:
     return env
 
 
+def _script_cmd(args) -> list:
+    if getattr(args, "module", False) and getattr(args, "no_python", False):
+        raise SystemExit("--module and --no_python cannot be used together.")
+    if getattr(args, "no_python", False):
+        return [args.training_script] + list(args.training_script_args)
+    base = [sys.executable]
+    if getattr(args, "module", False):
+        base.append("-m")
+    return base + [args.training_script] + list(args.training_script_args)
+
+
 def launch_command(args):
     cfg = load_config(args.config_file)
     merged = _merge(args, cfg)
@@ -135,7 +150,7 @@ def launch_command(args):
         return _debug_cpu_launch(args, merged)
 
     env = build_env(merged, debug=args.debug, cpu=args.cpu)
-    cmd = [sys.executable, args.training_script] + list(args.training_script_args)
+    cmd = _script_cmd(args)
     result = subprocess.run(cmd, env=env)
     if result.returncode != 0:
         raise SystemExit(result.returncode)
@@ -161,7 +176,7 @@ def _debug_cpu_launch(args, merged):
         env["XLA_FLAGS"] = env.get("XLA_FLAGS", "").replace(
             "--xla_force_host_platform_device_count=8", ""
         )
-        cmd = [sys.executable, args.training_script] + list(args.training_script_args)
+        cmd = _script_cmd(args)
         procs.append(subprocess.Popen(cmd, env=env))
     codes = [p.wait() for p in procs]
     if any(codes):

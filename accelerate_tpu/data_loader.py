@@ -537,20 +537,30 @@ class DataLoaderShard(DataLoaderStateMixin):
             self.end()
             return
         batch_index = 0
+        current_converted = None
         while True:
+            if current_converted is None and batch_index >= self.skip_batches:
+                current_converted = self._convert(current)
             try:
                 upcoming = next(iterator)
             except StopIteration:
                 self.end_of_dataloader = True
                 self._update_state_dict()
                 if batch_index >= self.skip_batches:
-                    yield self._convert(current)
+                    yield current_converted
                 break
+            # Double buffering (reference MpDeviceLoader's background preload,
+            # data_loader.py:643-693): issue batch n+1's async device transfer
+            # BEFORE yielding batch n, so the H2D overlaps the user's step.
+            upcoming_converted = (
+                self._convert(upcoming) if batch_index + 1 >= self.skip_batches else None
+            )
             self._update_state_dict()
             if batch_index >= self.skip_batches:
-                yield self._convert(current)
+                yield current_converted
             batch_index += 1
             current = upcoming
+            current_converted = upcoming_converted
         self.iteration += 1
         self.end()
 

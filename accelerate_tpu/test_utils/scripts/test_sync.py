@@ -40,7 +40,10 @@ def _run(accum_steps: int, micro_batches):
     GradientState._reset_state()
     PartialState._reset_state()
 
-    accelerator = Accelerator(gradient_accumulation_steps=accum_steps)
+    # The oracle asserts bit-level equality of accumulated vs full-batch
+    # updates — an fp32 exactness property; pin precision so a launcher-level
+    # --mixed_precision bf16 doesn't (correctly) propagate in and break it.
+    accelerator = Accelerator(gradient_accumulation_steps=accum_steps, mixed_precision="no")
     model, _, _ = _make_model_and_data()
     optimizer = torch.optim.SGD(model.parameters(), lr=0.1)
     model, optimizer = accelerator.prepare(model, optimizer)
@@ -91,7 +94,7 @@ def test_grads_differ_until_sync():
     PartialState._reset_state()
 
     _, xs, ys = _make_model_and_data()
-    accelerator = Accelerator(gradient_accumulation_steps=2)
+    accelerator = Accelerator(gradient_accumulation_steps=2, mixed_precision="no")
     model, _, _ = _make_model_and_data()
     optimizer = torch.optim.SGD(model.parameters(), lr=0.1)
     model, optimizer = accelerator.prepare(model, optimizer)

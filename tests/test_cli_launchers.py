@@ -118,3 +118,21 @@ def test_debug_launcher_object_collectives():
     )
     assert res.returncode == 0, res.stderr[-2000:]
     assert "OBJECTS_OK" in res.stdout
+
+
+def test_launch_module_flag(tmp_path):
+    """accelerate-tpu launch -m pkg.module parity (reference launch --module)."""
+    pkg = tmp_path / "fakepkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "payload.py").write_text("import os; print('MODULE_RAN', os.environ.get('ACCELERATE_MIXED_PRECISION'))\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(tmp_path) + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli", "launch",
+         "--mixed_precision", "bf16", "-m", "fakepkg.payload"],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert res.returncode == 0, res.stderr[-1500:]
+    assert "MODULE_RAN bf16" in res.stdout

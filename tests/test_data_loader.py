@@ -246,3 +246,29 @@ def test_set_epoch_propagates():
     dl = DataLoaderShard(base, put_on_device=False)
     dl.set_epoch(3)
     assert sampler.epoch == 3
+
+
+def test_device_transfer_prefetched_one_ahead():
+    """Double-buffering: batch n+1's device placement is issued before batch n
+    is yielded (reference MpDeviceLoader background preload)."""
+    from torch.utils.data import DataLoader as TorchDataLoader
+
+    from accelerate_tpu.data_loader import DataLoaderShard
+
+    events = []
+
+    class RecordingShard(DataLoaderShard):
+        def _convert(self, batch):
+            events.append(("convert", int(batch[0])))
+            return batch
+
+    dl = RecordingShard(TorchDataLoader(list(range(4)), batch_size=1), put_on_device=False)
+    for batch in dl:
+        events.append(("yield", int(batch[0])))
+    converts = [i for kind, i in events if kind == "convert"]
+    yields = [i for kind, i in events if kind == "yield"]
+    assert yields == [0, 1, 2, 3]
+    assert converts == [0, 1, 2, 3]
+    # Batch 1 must be converted before batch 0 is yielded, etc.
+    for n in range(1, 4):
+        assert events.index(("convert", n)) < events.index(("yield", n - 1))
