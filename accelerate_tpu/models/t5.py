@@ -212,22 +212,7 @@ def apply(
     t = decoder_input_ids.shape[1]
     act_spec = P(("dcn_dp", "dp", "fsdp"), None, None)
 
-    enc_mask = None
-    if attention_mask is not None:
-        valid = attention_mask.astype(bool)
-        enc_mask = valid[:, None, :] & valid[:, :, None]
-    enc_bias = _rel_bias(params["enc_rel_bias"].astype(jnp.float32), s, s, c, bidirectional=True)
-
-    x = params["shared_embed"].astype(c.dtype)[input_ids]
-    x = _constrain(x, act_spec)
-
-    def enc_body(carry, lp):
-        return _enc_layer(carry, lp, c=c, bias=enc_bias, mask=enc_mask, act_spec=act_spec)
-
-    if c.remat:
-        enc_body = jax.checkpoint(enc_body, policy=jax.checkpoint_policies.nothing_saveable)
-    x, _ = jax.lax.scan(enc_body, x, params["encoder"])
-    enc_out = _rms_norm(x, params["enc_final_ln"], c.rms_eps)
+    enc_out = encode(params, input_ids, c, attention_mask, act_spec=act_spec)
 
     dec_bias = _rel_bias(params["dec_rel_bias"].astype(jnp.float32), t, t, c, bidirectional=False)
     self_mask = jnp.broadcast_to(jnp.tril(jnp.ones((t, t), bool)), (b, t, t))
@@ -269,3 +254,166 @@ def loss_fn(params: dict, batch: dict, config: T5Config) -> jax.Array:
         attention_mask=batch.get("attention_mask"),
     )
     return cross_entropy(logits, labels, weights)
+
+
+# ---------------------------------------------------------------------------
+# Encoder-decoder KV-cache inference
+# ---------------------------------------------------------------------------
+#
+# Cross-attention K/V depend only on the encoder output, so they are computed
+# ONCE at prefill; the decoder self-attention carries a per-layer KV cache like
+# the causal families (models/generation.py driver shapes).
+
+
+def _rel_bias_at(table: jax.Array, q_positions: jax.Array, k_len: int, c: "T5Config"):
+    """Relative bias for queries at absolute ``q_positions`` ([T]) against keys
+    0..k_len — the decode-time generalization of ``_rel_bias``."""
+    mem = jnp.arange(k_len)[None, :]
+    buckets = _relative_buckets(mem - q_positions[:, None], c.num_buckets, c.max_distance, False)
+    return table[buckets].transpose(2, 0, 1)  # [H, T, k_len]
+
+
+def encode(params: dict, input_ids: jax.Array, config: "T5Config",
+           attention_mask: Optional[jax.Array] = None, act_spec=None) -> jax.Array:
+    """Encoder stack only -> [B, S, D] (shared by apply and generation)."""
+    c = config
+    b, s = input_ids.shape
+    enc_mask = None
+    if attention_mask is not None:
+        valid = attention_mask.astype(bool)
+        enc_mask = valid[:, None, :] & valid[:, :, None]
+    enc_bias = _rel_bias(params["enc_rel_bias"].astype(jnp.float32), s, s, c, bidirectional=True)
+    x = params["shared_embed"].astype(c.dtype)[input_ids]
+    if act_spec is not None:
+        x = _constrain(x, act_spec)
+
+    def enc_body(carry, lp):
+        return _enc_layer(carry, lp, c=c, bias=enc_bias, mask=enc_mask, act_spec=act_spec)
+
+    if c.remat:
+        enc_body = jax.checkpoint(enc_body, policy=jax.checkpoint_policies.nothing_saveable)
+    x, _ = jax.lax.scan(enc_body, x, params["encoder"])
+    return _rms_norm(x, params["enc_final_ln"], c.rms_eps)
+
+
+def init_decoder_cache(params: dict, enc_out: jax.Array, config: "T5Config", max_len: int) -> dict:
+    """Self-attn KV cache + precomputed per-layer cross-attention K/V."""
+    c = config
+    b, s, _ = enc_out.shape
+    hd, nh = c.head_dim, c.num_heads
+
+    def cross_kv(lp):
+        k = (enc_out @ lp["cross_wk"].astype(c.dtype)).reshape(b, s, nh, hd)
+        v = (enc_out @ lp["cross_wv"].astype(c.dtype)).reshape(b, s, nh, hd)
+        return k, v
+
+    cross_k, cross_v = jax.lax.map(cross_kv, params["decoder"])
+    from .generation import make_kv_cache
+
+    cache = make_kv_cache(c.num_layers, b, max_len, nh, hd, c.dtype)
+    cache["cross_k"] = cross_k  # [L, B, S, H, hd]
+    cache["cross_v"] = cross_v
+    return cache
+
+
+def decode_cached(
+    params: dict,
+    decoder_input_ids: jax.Array,
+    config: "T5Config",
+    cache: dict,
+    attention_mask: Optional[jax.Array] = None,
+) -> tuple[jax.Array, dict]:
+    """Decoder forward over new tokens at positions index..index+T with
+    self-attn cache read/write and precomputed cross K/V."""
+    from .generation import check_cache_room
+
+    c = config
+    b, t = decoder_input_ids.shape
+    hd, nh = c.head_dim, c.num_heads
+    index = cache["index"]
+    max_len = cache["k"].shape[2]
+    check_cache_room(index, t, max_len)
+    s = cache["cross_k"].shape[2]  # encoder length lives in the cross cache
+
+    positions = index + jnp.arange(t)
+    bias = _rel_bias_at(params["dec_rel_bias"].astype(jnp.float32), positions, max_len, c)
+    k_pos = jnp.arange(max_len)
+    self_mask = jnp.broadcast_to(positions[:, None] >= k_pos[None, :], (b, t, max_len))
+    cross_mask = None
+    if attention_mask is not None:
+        cross_mask = jnp.broadcast_to(attention_mask.astype(bool)[:, None, :], (b, t, s))
+
+    y = params["shared_embed"].astype(c.dtype)[decoder_input_ids]
+
+    def body(carry, xs):
+        lp, ck, cv, xk, xv = xs
+        x = carry
+        # Self-attention against the cache.
+        h = _rms_norm(x, lp["ln_attn"], c.rms_eps)
+        q = (h @ lp["wq"].astype(c.dtype)).reshape(b, t, nh, hd)
+        k = (h @ lp["wk"].astype(c.dtype)).reshape(b, t, nh, hd)
+        v = (h @ lp["wv"].astype(c.dtype)).reshape(b, t, nh, hd)
+        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, index, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, index, 0, 0))
+        scores = jnp.einsum("bshd,bthd->bhst", q, ck).astype(jnp.float32) + bias[None]
+        scores = jnp.where(self_mask[:, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+        attn = jnp.einsum("bhst,bthd->bshd", probs, cv).reshape(b, t, nh * hd)
+        x = x + attn @ lp["wo"].astype(c.dtype)
+        # Cross-attention against precomputed encoder K/V.
+        h = _rms_norm(x, lp["ln_cross"], c.rms_eps)
+        q = (h @ lp["cross_wq"].astype(c.dtype)).reshape(b, t, nh, hd)
+        scores = jnp.einsum("bshd,bthd->bhst", q, xk).astype(jnp.float32)
+        if cross_mask is not None:
+            scores = jnp.where(cross_mask[:, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(xv.dtype)
+        attn = jnp.einsum("bhst,bthd->bshd", probs, xv).reshape(b, t, nh * hd)
+        x = x + attn @ lp["cross_wo"].astype(c.dtype)
+        # MLP.
+        h = _rms_norm(x, lp["ln_mlp"], c.rms_eps)
+        x = x + jax.nn.relu(h @ lp["w_up"].astype(c.dtype)) @ lp["w_down"].astype(c.dtype)
+        return x, (ck, cv)
+
+    y, (new_k, new_v) = jax.lax.scan(
+        body, y, (params["decoder"], cache["k"], cache["v"], cache["cross_k"], cache["cross_v"])
+    )
+    y = _rms_norm(y, params["dec_final_ln"], c.rms_eps)
+    head = params["shared_embed"].T.astype(c.dtype) / np.sqrt(c.hidden_size)
+    logits = (y @ head).astype(jnp.float32)
+    new_cache = dict(cache)
+    new_cache.update({"k": new_k, "v": new_v, "index": index + t})
+    return logits, new_cache
+
+
+def generate(
+    params: dict,
+    input_ids: jax.Array,
+    config: "T5Config",
+    max_new_tokens: int,
+    decoder_start_token_id: int = 0,
+    temperature: float = 0.0,
+    key=None,
+    attention_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Seq2seq generation: encode once, then autoregressive decode with the
+    self-attn cache + precomputed cross K/V.  Returns decoder ids
+    ``[B, 1 + max_new_tokens]`` (leading start token)."""
+    from .generation import generate_loop
+
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1 for seq2seq generation")
+    c = config
+    b = input_ids.shape[0]
+    enc_out = encode(params, input_ids, c, attention_mask)
+
+    def _init_cache(cfg, batch_size, max_len):
+        return init_decoder_cache(params, enc_out, cfg, max_len)
+
+    def _apply_cached(p, ids, cfg, cache):
+        return decode_cached(p, ids, cfg, cache, attention_mask)
+
+    start = jnp.full((b, 1), decoder_start_token_id, jnp.int32)
+    return generate_loop(
+        _apply_cached, _init_cache, params, start, c,
+        max_new_tokens, temperature=temperature, key=key,
+    )
