@@ -1,0 +1,37 @@
+# Test-suite splits mirroring the reference Makefile:25-77.
+
+.PHONY: test test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels quality
+
+PYTEST = python -m pytest -q
+
+test:
+	$(PYTEST) tests/
+
+# Everything except big-modeling / engine dialects / CLI / examples.
+test_core:
+	$(PYTEST) tests/ --ignore=tests/test_big_modeling.py \
+	  --ignore=tests/test_engine_dialects.py --ignore=tests/test_cli_commands.py \
+	  --ignore=tests/test_cli_launchers.py --ignore=tests/test_examples.py \
+	  --ignore=tests/test_by_feature_examples.py
+
+test_big_modeling:
+	$(PYTEST) tests/test_big_modeling.py tests/test_quantization.py tests/test_native_io.py
+
+test_cli:
+	$(PYTEST) tests/test_cli_commands.py tests/test_cli_launchers.py
+
+test_fsdp:
+	$(PYTEST) tests/test_llama.py tests/test_checkpoint_formats.py tests/test_engine_dialects.py
+
+test_tp:
+	$(PYTEST) tests/test_llama_sp.py tests/test_ulysses.py tests/test_pipeline.py
+
+test_examples:
+	$(PYTEST) tests/test_examples.py tests/test_by_feature_examples.py
+
+test_kernels:
+	$(PYTEST) tests/test_flash_attention.py tests/test_pallas_attention.py \
+	  tests/test_ring_attention.py tests/test_moe.py tests/test_fp8.py
+
+bench:
+	python bench.py
