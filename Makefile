@@ -1,6 +1,6 @@
 # Test-suite splits mirroring the reference Makefile:25-77.
 
-.PHONY: test test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels quality
+.PHONY: test test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels bench
 
 PYTEST = python -m pytest -q
 

@@ -62,11 +62,12 @@ class LlamaConfig:
     remat_policy: str = "nothing"
     # "einsum": materialize scores (fast at short seq, supports padding masks).
     # "flash": blockwise online-softmax (ops/flash_attention.py).
-    # "pallas": fused Pallas MXU kernel (ops/pallas_attention.py) — fastest on
-    #   a single chip; not GSPMD-partitionable, so "auto" only picks it when
-    #   the computation is single-device.
-    # "auto": pallas on a 1-chip TPU, else flash for long sequences without
-    #   padding masks.
+    # "pallas": fused Pallas MXU kernel (ops/pallas_attention.py); on a
+    #   sharded (non-sp) mesh it runs per-device under shard_map
+    #   (pallas_attention_spmd) since pallas_call is opaque to GSPMD.
+    # "auto": pallas on TPU (single chip, or a non-sp mesh whose batch/head
+    #   shapes divide the data/tp axes), else flash for long sequences
+    #   without padding masks.
     attention_impl: str = "auto"
     # Sequence-parallel attention implementation when the mesh has sp > 1:
     # "ring" rotates K/V via neighbor ppermute (works for any head count);
@@ -276,10 +277,11 @@ def _flash_block(s: int):
     return pick_block(s) or (s if s <= 1024 else None)
 
 
-def _use_pallas(c: "LlamaConfig", s: int) -> bool:
-    """Pick the fused Pallas kernel: explicit opt-in always; "auto" only when
-    single-device (pallas_call is opaque to GSPMD — a sharded mesh would force
-    an all-gather of activations around it)."""
+def _use_pallas(c: "LlamaConfig", s: int, b: int, h: int, kh: int) -> bool:
+    """Pick the fused Pallas kernel.  Explicit opt-in always; "auto" on TPU
+    when single-device, or on a multi-device non-sp mesh whose batch/head
+    shapes divide the data/tp axes (the spmd shard_map wrapper then runs the
+    kernel per-device; sp>1 needs ring/ulysses instead)."""
     if c.attention_impl == "pallas":
         return True
     if c.attention_impl != "auto" or s < 1024 or _flash_block(s) is None:
@@ -288,11 +290,26 @@ def _use_pallas(c: "LlamaConfig", s: int) -> bool:
         from ..ops.pallas_attention import pallas_available
     except ImportError:
         return False
-    return (
-        pallas_available()
-        and jax.default_backend() == "tpu"
-        and jax.device_count() == 1
-    )
+    if not pallas_available() or jax.default_backend() != "tpu":
+        return False
+    if jax.device_count() == 1:
+        return True
+    from ..state import AcceleratorState
+
+    if not AcceleratorState._shared_state:
+        return False
+    mesh = AcceleratorState().mesh
+    if mesh is None or ("sp" in mesh.axis_names and mesh.shape["sp"] > 1):
+        return False
+    from ..ops.ring_attention import tp_head_axis
+    from ..parallel.mesh import data_axes
+
+    n_batch_shards = 1
+    for a in data_axes(mesh):
+        n_batch_shards *= mesh.shape[a]
+    tp = mesh.shape.get("tp", 1)
+    head_ok = tp == 1 or tp_head_axis(mesh, h, kh) is not None
+    return b % n_batch_shards == 0 and head_ok
 
 
 def _mm(h: jax.Array, w: jax.Array, c: LlamaConfig) -> jax.Array:
@@ -331,8 +348,8 @@ def attention_block(x, p, c, mask, positions) -> jax.Array:
             from ..ops.ring_attention import ring_attention
 
             attn = ring_attention(q, k, v, mesh=None, axis_name="sp", causal=True)
-    elif mask is None and _use_pallas(c, s):
-        from ..ops.pallas_attention import pallas_attention
+    elif mask is None and _use_pallas(c, s, b, c.num_heads, c.num_kv_heads):
+        from ..ops.pallas_attention import pallas_attention_spmd
 
         blk = _flash_block(s)
         if blk is None:
@@ -340,7 +357,9 @@ def attention_block(x, p, c, mask, positions) -> jax.Array:
                 f"attention_impl='pallas' needs a sequence length divisible by "
                 f"64/128/256/512 (VMEM tiling); got seq_len={s}"
             )
-        attn = pallas_attention(q, k, v, causal=True, block_size=blk)
+        # On a sharded (non-sp) mesh the spmd wrapper runs the kernel
+        # per-device under shard_map; trivial meshes take the plain call.
+        attn = pallas_attention_spmd(q, k, v, causal=True, block_size=blk)
     elif mask is None and (
         c.attention_impl == "flash" or (c.attention_impl == "auto" and s >= 1024)
     ) and _flash_block(s) is not None:

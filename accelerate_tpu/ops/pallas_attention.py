@@ -40,7 +40,7 @@ try:  # pallas TPU backend is absent on some CPU-only installs
 except Exception:  # pragma: no cover
     pltpu = None
 
-__all__ = ["pallas_attention", "pallas_available"]
+__all__ = ["pallas_attention", "pallas_attention_spmd", "pallas_available"]
 
 _NEG_INF = -1e30  # finite: avoids inf-inf NaNs inside the exp bookkeeping
 
@@ -398,3 +398,56 @@ def pallas_attention(
     scale = float(1.0 / np.sqrt(d))
     out = _mha(qh, kk, vv, scale, causal, blk, blk, interpret)
     return out.transpose(0, 2, 1, 3)
+
+
+def pallas_attention_spmd(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh=None,
+    *,
+    causal: bool = True,
+    block_size: int = 512,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Pallas attention on a multi-device mesh.
+
+    ``pallas_call`` is opaque to GSPMD, so the kernel is placed under
+    ``shard_map``: batch stays sharded over the data axes and heads over
+    ``tp`` (shared policy with ring/ulysses) — each device runs the fused
+    kernel on its own shard with zero cross-device traffic (the sequence
+    axis is NOT sharded here; use ring/ulysses for sp).  Falls back to the
+    plain call when the mesh is trivial.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import data_axes
+    from .ring_attention import shard_map, tp_head_axis
+
+    if mesh is None:
+        from ..state import AcceleratorState
+
+        if AcceleratorState._shared_state:
+            mesh = AcceleratorState().mesh
+    if mesh is None:
+        # Same mesh source the models' sharding constraints consult: a mesh
+        # installed via jax.set_mesh without an AcceleratorState still routes
+        # through shard_map instead of silently running GSPMD-opaque.
+        from ..parallel.sharding import _abstract_mesh
+
+        am = _abstract_mesh()
+        if am is not None and not am.empty and am.axis_names:
+            mesh = am
+    if mesh is None or mesh.size == 1:
+        return pallas_attention(q, k, v, causal=causal, block_size=block_size, interpret=interpret)
+    if "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
+        raise ValueError("pallas_attention_spmd does not shard the sequence axis; use ring/ulysses for sp>1")
+
+    batch_axes = data_axes(mesh)
+    head_axis = tp_head_axis(mesh, q.shape[2], k.shape[2])
+    spec = P(batch_axes if batch_axes else None, None, head_axis, None)
+
+    def body(q, k, v):
+        return pallas_attention(q, k, v, causal=causal, block_size=block_size, interpret=interpret)
+
+    return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)

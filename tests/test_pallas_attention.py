@@ -99,3 +99,35 @@ def test_llama_pallas_impl_matches_einsum():
     np.testing.assert_allclose(
         np.asarray(out_e, np.float32), np.asarray(out_p, np.float32), atol=2e-2, rtol=2e-2
     )
+
+
+def test_pallas_spmd_on_mesh_matches_dense():
+    """shard_map-wrapped kernel on a dp x tp mesh (interpret mode) vs dense."""
+    from accelerate_tpu import AcceleratorState, ParallelismConfig
+    from accelerate_tpu.ops.pallas_attention import pallas_attention_spmd
+
+    state = AcceleratorState(parallelism_config=ParallelismConfig(dp=4, tp=2))
+    mesh = state.mesh
+    b, s, h, d = 4, 256, 4, 64
+    ks = jax.random.split(jax.random.key(9), 3)
+    q = jax.random.normal(ks[0], (b, s, h, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, h, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, h, d), jnp.float32)
+
+    ref = _dense_reference(q, k, v, causal=True)
+    out = jax.jit(
+        lambda q, k, v: pallas_attention_spmd(
+            q, k, v, mesh=mesh, causal=True, block_size=128, interpret=True
+        )
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_pallas_spmd_rejects_sp_mesh():
+    from accelerate_tpu import AcceleratorState, ParallelismConfig
+    from accelerate_tpu.ops.pallas_attention import pallas_attention_spmd
+
+    state = AcceleratorState(parallelism_config=ParallelismConfig(dp=2, sp=4))
+    q = jnp.zeros((2, 64, 4, 16), jnp.float32)
+    with pytest.raises(ValueError, match="ring/ulysses"):
+        pallas_attention_spmd(q, q, q, mesh=state.mesh, causal=True, interpret=True)
