@@ -891,22 +891,25 @@ class Accelerator:
         return model
 
     def clip_grad_norm_(self, parameters=None, max_norm: float = 1.0, norm_type: float = 2.0):
-        """Arm global-norm clipping for the next optimizer step and return the
-        current accumulated grad norm (reference ``accelerator.py:2565``)."""
+        """Arm global-norm clipping for the next optimizer step (one-shot, like
+        the reference's in-place call ``accelerator.py:2565``) and return the
+        current accumulated grad norm."""
         import optax
 
         for opt in self._optimizers:
-            opt._clip_norm = float(max_norm)
+            opt._clip_norm_once = float(max_norm)
         for model in self._models:
             if model._accum_grads is not None:
                 return _jax_to_torch(optax.global_norm(model._accum_grads))
         return None
 
-    def clip_grad_value_(self, parameters, clip_value: float):
-        raise NotImplementedError(
-            "clip_grad_value_ is not supported on the TPU backend (same limitation the "
-            "reference has under FSDP); use clip_grad_norm_."
-        )
+    def clip_grad_value_(self, parameters=None, clip_value: float = 1.0):
+        """Arm elementwise gradient clipping for the next optimizer step
+        (one-shot; reference ``accelerator.py:2630``.  The reference disallows
+        this under FSDP/DeepSpeed — here it composes with any sharding, since
+        the clip is fused into the jitted update)."""
+        for opt in self._optimizers:
+            opt._clip_value_once = float(clip_value)
 
     # -- collectives / metrics ------------------------------------------------
 

@@ -325,10 +325,15 @@ def _mm(h: jax.Array, w: jax.Array, c: LlamaConfig) -> jax.Array:
     return h @ w.astype(c.dtype)
 
 
-def attention_block(x, p, c, mask, positions) -> jax.Array:
+def attention_block(x, p, c, mask, positions, kv_valid=None) -> jax.Array:
     """Pre-norm attention sub-block with residual: shared by llama and the MoE
     models (mixtral) — both get the ring-attention (sp) and fp8 paths from one
-    implementation."""
+    implementation.
+
+    ``mask`` is a full [B, S, S] mask for callers with non-causal patterns;
+    ``kv_valid`` [B, S] is the padding mask for causal batches — kept factored
+    so the flash/ring/ulysses paths never materialize an [S, S] mask.
+    """
     hd = c.head_dim_
     h = _rms_norm(x, p["ln_attn"], c.rms_eps)
     b, s, _ = h.shape
@@ -337,18 +342,22 @@ def attention_block(x, p, c, mask, positions) -> jax.Array:
     v = _mm(h, p["wv"], c).reshape(b, s, c.num_kv_heads, hd)
     q, k = _rope(q, k, positions, c.rope_theta)
     if _sp_active():
-        # Sequence-parallel path over the sp axis (padding masks unsupported
-        # here; pretraining-style dense batches).  mixtral shares this block —
-        # getattr default covers configs without the knob.
+        # Sequence-parallel path over the sp axis; kv_valid (sequence-sharded)
+        # rides the ring / all-gathers in the ulysses body.  mixtral shares
+        # this block — getattr default covers configs without the knob.
         if getattr(c, "sp_impl", "ring") == "ulysses":
             from ..ops.ulysses_attention import ulysses_attention
 
-            attn = ulysses_attention(q, k, v, mesh=None, axis_name="sp", causal=True)
+            attn = ulysses_attention(
+                q, k, v, mesh=None, axis_name="sp", causal=True, kv_valid=kv_valid
+            )
         else:
             from ..ops.ring_attention import ring_attention
 
-            attn = ring_attention(q, k, v, mesh=None, axis_name="sp", causal=True)
-    elif mask is None and _use_pallas(c, s, b, c.num_heads, c.num_kv_heads):
+            attn = ring_attention(
+                q, k, v, mesh=None, axis_name="sp", causal=True, kv_valid=kv_valid
+            )
+    elif mask is None and kv_valid is None and _use_pallas(c, s, b, c.num_heads, c.num_kv_heads):
         from ..ops.pallas_attention import pallas_attention_spmd
 
         blk = _flash_block(s)
@@ -363,22 +372,24 @@ def attention_block(x, p, c, mask, positions) -> jax.Array:
     elif mask is None and (
         c.attention_impl == "flash" or (c.attention_impl == "auto" and s >= 1024)
     ) and _flash_block(s) is not None:
-        # mask=None signals pure-causal (no padding) — the flash path's only
-        # supported masking.
         from ..ops.flash_attention import flash_attention
 
-        attn = flash_attention(q, k, v, causal=True, block_size=_flash_block(s))
+        attn = flash_attention(
+            q, k, v, causal=True, block_size=_flash_block(s), kv_valid=kv_valid
+        )
     else:
         if mask is None:
             mask = jnp.broadcast_to(jnp.tril(jnp.ones((s, s), bool)), (b, s, s))
+            if kv_valid is not None:
+                mask = mask & kv_valid.astype(bool)[:, None, :]
         attn = _attention(q, k, v, mask, c.num_heads // c.num_kv_heads)
     return x + _mm(attn.reshape(b, s, c.num_heads * hd), p["wo"], c)
 
 
-def _layer(carry, layer_params, *, config: LlamaConfig, mask, positions, act_spec):
+def _layer(carry, layer_params, *, config: LlamaConfig, mask, positions, act_spec, kv_valid=None):
     c = config
     p = layer_params
-    x = attention_block(carry, p, c, mask, positions)
+    x = attention_block(carry, p, c, mask, positions, kv_valid=kv_valid)
 
     h = _rms_norm(x, p["ln_mlp"], c.rms_eps)
     gate = jax.nn.silu(_mm(h, p["w_gate"], c))
@@ -401,31 +412,20 @@ def apply(
     b, s = input_ids.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s), (b, s))
-    if _sp_active():
-        # Ring attention builds block-local causal masks internally; materializing
-        # a (b, s, s) mask here would be O(s^2) memory — exactly what the ring
-        # path exists to avoid at long context.
-        if attention_mask is not None:
-            raise NotImplementedError(
-                "attention_mask is not supported on the sequence-parallel (sp>1) path "
-                "yet — ring attention applies causal masking only. Use dense packed "
-                "batches, or an sp=1 mesh for padded batches."
-            )
-        mask = None
-    elif attention_mask is not None:
-        causal = jnp.tril(jnp.ones((s, s), bool))
-        mask = jnp.broadcast_to(causal, (b, s, s)) & attention_mask[:, None, :].astype(bool)
-    else:
-        # mask=None == pure causal: lets attention_block pick the flash path
-        # (the einsum path rebuilds the causal mask locally).
-        mask = None
+    # Padding stays factored as a [B, S] key-validity vector all the way down —
+    # every attention path (flash blocks, ring chunks, ulysses all-gather,
+    # einsum) applies it without materializing a [B, S, S] mask here.
+    kv_valid = attention_mask.astype(bool) if attention_mask is not None else None
 
     x = embed_tokens(params, input_ids, c)
     act_spec = P(("dcn_dp", "dp", "fsdp"), "sp", None)
     x = _maybe_constrain(x, act_spec)
 
     def body(carry, lp):
-        return _layer(carry, lp, config=c, mask=mask, positions=positions, act_spec=act_spec)
+        return _layer(
+            carry, lp, config=c, mask=None, positions=positions, act_spec=act_spec,
+            kv_valid=kv_valid,
+        )
 
     if c.remat:
         body = jax.checkpoint(body, policy=_remat_policy(c.remat_policy))

@@ -56,6 +56,10 @@ class MixtralConfig:
     param_dtype: Any = jnp.float32
     remat: bool = True
     fp8: bool = False  # route attention matmuls through ops/fp8.py (expert FFN stays bf16)
+    # Attention implementation knobs shared with llama (attention_block):
+    # "auto"/"einsum"/"flash"/"pallas"; sp_impl picks ring vs ulysses at sp>1.
+    attention_impl: str = "auto"
+    sp_impl: str = "ring"
 
     @property
     def head_dim_(self) -> int:
@@ -181,11 +185,14 @@ def init_params(config: MixtralConfig, key: jax.Array) -> dict:
     return jax.tree_util.tree_unflatten(treedef, [init_one(s, k) for s, k in zip(leaves, keys)])
 
 
-def _layer(carry, layer_params, *, config: MixtralConfig, mask, positions, act_spec, capacity):
+def _layer(
+    carry, layer_params, *, config: MixtralConfig, mask, positions, act_spec, capacity,
+    kv_valid=None,
+):
     x, aux_acc = carry
     c = config
     p = layer_params
-    x = _llama.attention_block(x, p, c, mask, positions)
+    x = _llama.attention_block(x, p, c, mask, positions, kv_valid=kv_valid)
 
     h = _llama._rms_norm(x, p["ln_mlp"], c.rms_eps)
     y, aux = moe_ffn(
@@ -221,10 +228,9 @@ def apply(
     b, s = input_ids.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s), (b, s))
-    causal = jnp.tril(jnp.ones((s, s), bool))
-    mask = jnp.broadcast_to(causal, (b, s, s))
-    if attention_mask is not None:
-        mask = mask & attention_mask[:, None, :].astype(bool)
+    # Padding stays factored as a [B, S] key-validity vector (see llama.apply):
+    # attention_block picks flash/ring/ulysses without an [S, S] mask.
+    kv_valid = attention_mask.astype(bool) if attention_mask is not None else None
 
     x = params["embed"].astype(c.dtype)[input_ids]
     act_spec = P(("dcn_dp", "dp", "fsdp"), "sp", None)
@@ -239,7 +245,8 @@ def apply(
 
     def body(carry, lp):
         return _layer(
-            carry, lp, config=c, mask=mask, positions=positions, act_spec=act_spec, capacity=capacity
+            carry, lp, config=c, mask=None, positions=positions, act_spec=act_spec,
+            capacity=capacity, kv_valid=kv_valid,
         )
 
     if c.remat:

@@ -39,22 +39,28 @@ def pick_block(s: int) -> Optional[int]:
     return None
 
 
-def _block_step(carry, kv, *, scale, blk_k, causal):
+def _block_step(carry, kv, *, scale, blk_k, causal, has_valid):
     """One K/V block against all queries with online-softmax accumulation.
 
     carry: (m [B,H,Sq], l [B,H,Sq], o [B,Sq,H,d], q [B,Sq,K,G,d], q_pos [Sq])
-    kv: (k_blk [B,blk,K,d], v_blk [B,blk,K,d], k_start scalar)
+    kv: (k_blk [B,blk,K,d], v_blk [B,blk,K,d], k_start scalar,
+         valid_blk [B,blk] key-validity when ``has_valid``)
     """
     m_prev, l_prev, o_prev, q, q_pos = carry
-    k_blk, v_blk, k_start = kv
+    k_blk, v_blk, k_start, valid_blk = kv
     b, sq, kh, g, d = q.shape
 
     scores = jnp.einsum("bskgd,btkd->bkgst", q, k_blk).astype(jnp.float32) * scale
     scores = scores.reshape(b, kh * g, sq, blk_k)
+    mask = None
     if causal:
         k_pos = k_start + jnp.arange(blk_k)
-        mask = q_pos[:, None] >= k_pos[None, :]  # [Sq, blk]
-        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        mask = (q_pos[:, None] >= k_pos[None, :])[None, None]  # [1,1,Sq,blk]
+    if has_valid:
+        vm = valid_blk[:, None, None, :]  # [B,1,1,blk]
+        mask = vm if mask is None else mask & vm
+    if mask is not None:
+        scores = jnp.where(mask, scores, -jnp.inf)
 
     m_cur = jnp.max(scores, axis=-1)
     m_new = jnp.maximum(m_prev, m_cur)
@@ -81,12 +87,13 @@ def flash_attention(
     *,
     causal: bool = True,
     block_size: int = 512,
+    kv_valid: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Causal GQA attention without materializing the score matrix.
 
     q: [B, S, H, d]; k, v: [B, S, K, d] with H = K * groups.  Returns
-    [B, S, H, d] in q.dtype.  Padding masks are not supported (same
-    restriction as the ring path — dense packed batches).
+    [B, S, H, d] in q.dtype.  ``kv_valid`` [B, S] (bool) marks valid keys for
+    padded batches; queries whose keys are all invalid produce zeros.
     """
     b, s, h, d = q.shape
     kh = k.shape[2]
@@ -102,16 +109,27 @@ def flash_attention(
     v_blocks = v.reshape(b, n_blocks, blk, kh, d).transpose(1, 0, 2, 3, 4)
     starts = jnp.arange(n_blocks) * blk
     q_pos = jnp.arange(s)
+    has_valid = kv_valid is not None
+    if has_valid:
+        valid_blocks = kv_valid.astype(bool).reshape(b, n_blocks, blk).transpose(1, 0, 2)
+    else:
+        # Dummy scan operand keeping one xs structure for both modes (dead code
+        # under has_valid=False; XLA drops it).
+        valid_blocks = jnp.ones((n_blocks, b, 1), bool)
 
     m0 = jnp.full((b, h, s), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((b, h, s), jnp.float32)
     o0 = jnp.zeros((b, s, h, d), jnp.float32)
 
-    step = functools.partial(_block_step, scale=scale, blk_k=blk, causal=causal)
+    step = functools.partial(
+        _block_step, scale=scale, blk_k=blk, causal=causal, has_valid=has_valid
+    )
     # Remat each block step: backward recomputes score tiles (flash behavior)
     # instead of saving n_blocks of them.
     step = jax.checkpoint(step, policy=jax.checkpoint_policies.nothing_saveable)
-    (m, l, o, _, _), _ = jax.lax.scan(step, (m0, l0, o0, qg, q_pos), (k_blocks, v_blocks, starts))
+    (m, l, o, _, _), _ = jax.lax.scan(
+        step, (m0, l0, o0, qg, q_pos), (k_blocks, v_blocks, starts, valid_blocks)
+    )
 
     l = jnp.maximum(l, 1e-30)
     out = o / l.transpose(0, 2, 1)[..., None]

@@ -108,21 +108,25 @@ def _block_attention(q, k, v, mask, m_prev, l_prev, o_prev, scale):
     return m_new, l_new, o_new
 
 
-def full_sequence_attention(q, k, v, causal: bool = True) -> jax.Array:
+def full_sequence_attention(q, k, v, causal: bool = True, kv_valid=None) -> jax.Array:
     """Full-sequence attention on local data — the shared non-ring path: flash
     (blockwise) when an MXU-friendly block divides S, otherwise one dense block
     through the same online-softmax math.  Used as the sp=1 fallback here and
-    as the per-device local attention inside ulysses_attention."""
+    as the per-device local attention inside ulysses_attention.
+
+    ``kv_valid`` [B, S] (bool) marks valid keys for padded batches."""
     b, s, h, d = q.shape
     from .flash_attention import flash_attention, pick_block
 
     blk = pick_block(s)
     if blk is not None and s > blk:
-        return flash_attention(q, k, v, causal=causal, block_size=blk)
+        return flash_attention(q, k, v, causal=causal, block_size=blk, kv_valid=kv_valid)
     if causal:
         mask = jnp.tril(jnp.ones((s, s), bool))[None, None]
     else:
         mask = jnp.ones((1, 1, s, s), bool)
+    if kv_valid is not None:
+        mask = mask & kv_valid.astype(bool)[:, None, None, :]
     m0 = jnp.full((b, h, s), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((b, h, s), jnp.float32)
     o0 = jnp.zeros((b, s, h, d), jnp.float32)
@@ -130,8 +134,11 @@ def full_sequence_attention(q, k, v, causal: bool = True) -> jax.Array:
     return (o / jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]).astype(q.dtype)
 
 
-def _ring_body(q, k, v, *, axis_name: str, causal: bool, vary_axes: tuple = ()):
-    """Per-device body under shard_map: local q stays, k/v rotate ``n`` times."""
+def _ring_body(
+    q, k, v, kv_valid, *, axis_name: str, causal: bool, has_valid: bool, vary_axes: tuple = ()
+):
+    """Per-device body under shard_map: local q stays, k/v (and their validity
+    chunk, for padded batches) rotate ``n`` times."""
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, sq, h, d = q.shape
@@ -148,7 +155,7 @@ def _ring_body(q, k, v, *, axis_name: str, causal: bool, vary_axes: tuple = ()):
     local_pos = jnp.arange(sq)
 
     def step(r, carry):
-        k_r, v_r, m, l, o = carry
+        k_r, v_r, valid_r, m, l, o = carry
         src = (idx - r) % n  # ring position whose K/V we currently hold
         if causal:
             # Block-level causality + intra-block triangle when src == idx.
@@ -157,15 +164,18 @@ def _ring_body(q, k, v, *, axis_name: str, causal: bool, vary_axes: tuple = ()):
             mask = (q_pos[:, None] >= k_pos[None, :])[None, None, :, :]
         else:
             mask = jnp.ones((1, 1, sq, k_r.shape[1]), bool)
+        if has_valid:
+            mask = mask & valid_r[:, None, None, :]
         m, l, o = _block_attention(q, k_r, v_r, mask, m, l, o, scale)
         # Rotate upward: device i sends to i+1 and receives i-1's block, so after
         # r hops we hold chunk (i - r) % n — matching `src` above.
         perm = [(i, (i + 1) % n) for i in range(n)]
         k_next = jax.lax.ppermute(k_r, axis_name, perm)
         v_next = jax.lax.ppermute(v_r, axis_name, perm)
-        return k_next, v_next, m, l, o
+        valid_next = jax.lax.ppermute(valid_r, axis_name, perm) if has_valid else valid_r
+        return k_next, v_next, valid_next, m, l, o
 
-    k_f, v_f, m, l, o = jax.lax.fori_loop(0, n, step, (k, v, m0, l0, o0))
+    _, _, _, m, l, o = jax.lax.fori_loop(0, n, step, (k, v, kv_valid, m0, l0, o0))
     l_safe = jnp.maximum(l, 1e-20)
     out = o / l_safe.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
@@ -178,15 +188,19 @@ def ring_attention(
     mesh: Optional[Mesh] = None,
     axis_name: str = "sp",
     causal: bool = True,
+    kv_valid: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Sequence-parallel attention: [B, S, H, d] x [B, S, K, d] -> [B, S, H, d]
     with S sharded over ``axis_name``.
 
+    ``kv_valid`` [B, S] (bool, sequence-sharded like K/V) marks valid keys for
+    padded batches; the validity chunk rides the ring alongside its K/V block,
+    so masking stays O(S/n) per device (never a global [S, S] mask).
     Falls back to a single dense block when the axis is size 1 / absent.
     """
     mesh = resolve_sp_mesh(mesh, axis_name)
     if mesh is None:
-        return full_sequence_attention(q, k, v, causal=causal)
+        return full_sequence_attention(q, k, v, causal=causal, kv_valid=kv_valid)
 
     # Keep the batch dim sharded over the data axes inside the ring (avoids a
     # batch all-gather at the shard_map boundary), and the head dim over tp when
@@ -198,15 +212,23 @@ def ring_attention(
     head_axis = tp_head_axis(mesh, q.shape[2], k.shape[2])
     vary = batch_axes + (axis_name,) + ((head_axis,) if head_axis else ())
     spec = P(batch_axes if batch_axes else None, axis_name, head_axis, None)
+    has_valid = kv_valid is not None
+    if has_valid:
+        kv_valid = kv_valid.astype(bool)
+    else:
+        # Dummy operand keeping one shard_map signature for both modes (dead
+        # code under has_valid=False; XLA drops it).
+        kv_valid = jnp.ones(q.shape[:2], bool)
+    valid_spec = P(batch_axes if batch_axes else None, axis_name)
     body = functools.partial(
-        _ring_body, axis_name=axis_name, causal=causal, vary_axes=vary
+        _ring_body, axis_name=axis_name, causal=causal, has_valid=has_valid, vary_axes=vary
     )
     return shard_map(
         body,
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=(spec, spec, spec, valid_spec),
         out_specs=spec,
-    )(q, k, v)
+    )(q, k, v, kv_valid)
 
 
 def ring_self_attention(x_q, x_k, x_v, **kwargs):

@@ -46,10 +46,11 @@ def _kv_expansion(num_q_heads: int, num_kv_heads: int, n: int) -> int:
     return target // num_kv_heads
 
 
-def _ulysses_body(q, k, v, *, axis_name: str, causal: bool):
+def _ulysses_body(q, k, v, kv_valid, *, axis_name: str, causal: bool, has_valid: bool):
     """Per-device body under shard_map.
 
-    In:  q [B, S/n, H, d]; k, v [B, S/n, K, d] (sequence-sharded).
+    In:  q [B, S/n, H, d]; k, v [B, S/n, K, d] (sequence-sharded);
+         kv_valid [B, S/n] key validity when ``has_valid``.
     Out: [B, S/n, H, d].
     """
     n = jax.lax.psum(1, axis_name)
@@ -69,7 +70,12 @@ def _ulysses_body(q, k, v, *, axis_name: str, causal: bool):
         jax.lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1, tiled=True
     )
     qh, kh_, vh = a2a(q), a2a(k), a2a(v)
-    out = full_sequence_attention(qh, kh_, vh, causal=causal)  # [B, S, H/n, d]
+    valid_full = None
+    if has_valid:
+        # Local attention spans the FULL sequence here, so each device needs the
+        # whole [B, S] validity vector (cheap: bools, no quadratic blowup).
+        valid_full = jax.lax.all_gather(kv_valid, axis_name, axis=1, tiled=True)
+    out = full_sequence_attention(qh, kh_, vh, causal=causal, kv_valid=valid_full)
     # head-sharded -> seq-sharded.
     return jax.lax.all_to_all(out, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True)
 
@@ -81,13 +87,16 @@ def ulysses_attention(
     mesh: Optional[Mesh] = None,
     axis_name: str = "sp",
     causal: bool = True,
+    kv_valid: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Sequence-parallel attention, all-to-all variant.  Same contract as
     ``ring_attention``: [B, S, H, d] x [B, S, K, d] -> [B, S, H, d] with S
-    sharded over ``axis_name``; dense fallback when the axis is trivial."""
+    sharded over ``axis_name``; ``kv_valid`` [B, S] (bool, sequence-sharded)
+    marks valid keys for padded batches; dense fallback when the axis is
+    trivial."""
     mesh = resolve_sp_mesh(mesh, axis_name)
     if mesh is None:
-        return full_sequence_attention(q, k, v, causal=causal)
+        return full_sequence_attention(q, k, v, causal=causal, kv_valid=kv_valid)
 
     n = mesh.shape[axis_name]
     # Shard heads over tp too when both divisions work out (shared policy with
@@ -106,10 +115,20 @@ def ulysses_attention(
 
     batch_axes = tuple(a for a in data_axes(mesh) if a != axis_name)
     spec = P(batch_axes if batch_axes else None, axis_name, head_axis, None)
-    body = functools.partial(_ulysses_body, axis_name=axis_name, causal=causal)
+    has_valid = kv_valid is not None
+    if has_valid:
+        kv_valid = kv_valid.astype(bool)
+    else:
+        # Dummy operand keeping one shard_map signature for both modes (dead
+        # code under has_valid=False; XLA drops it).
+        kv_valid = jnp.ones(q.shape[:2], bool)
+    valid_spec = P(batch_axes if batch_axes else None, axis_name)
+    body = functools.partial(
+        _ulysses_body, axis_name=axis_name, causal=causal, has_valid=has_valid
+    )
     return shard_map(
         body,
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=(spec, spec, spec, valid_spec),
         out_specs=spec,
-    )(q, k, v)
+    )(q, k, v, kv_valid)

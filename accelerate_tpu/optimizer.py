@@ -27,15 +27,21 @@ __all__ = ["AcceleratedOptimizer"]
 
 
 @partial(jax.jit, donate_argnums=(1, 2), static_argnums=(0,))
-def _update_step(tx_update, params, opt_state, grads, clip_norm):
+def _update_step(tx_update, params, opt_state, grads, clip_norm, clip_value):
     """One optimizer update, jitted once per (tx, clip) structure.
 
-    ``clip_norm`` < 0 disables clipping (static python float would retrigger
-    compilation; pass as array).
+    ``clip_norm`` / ``clip_value`` < 0 disable the respective clip (static
+    python floats would retrigger compilation; pass as arrays); 0 is a real
+    clip that zeroes gradients, matching torch's ``clip_grad_{norm,value}_(0)``.
+    Value clip (elementwise, reference ``clip_grad_value_``) applies first,
+    then norm clip — matching a torch loop that calls both before ``step()``.
     """
+    grads = jax.tree_util.tree_map(
+        lambda g: jnp.where(clip_value >= 0, jnp.clip(g, -clip_value, clip_value), g), grads
+    )
     gnorm = optax.global_norm(grads)
     scale = jnp.where(
-        clip_norm > 0, jnp.minimum(1.0, clip_norm / jnp.maximum(gnorm, 1e-12)), 1.0
+        clip_norm >= 0, jnp.minimum(1.0, clip_norm / jnp.maximum(gnorm, 1e-12)), 1.0
     )
     grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
     updates, new_opt_state = tx_update(grads, opt_state, params)
@@ -67,7 +73,15 @@ class AcceleratedOptimizer:
         self.accelerator_state = AcceleratorState() if AcceleratorState._shared_state else None
         self.opt_state = None
         self._step_was_skipped = False
-        self._clip_norm = -1.0  # <0: disabled
+        # Persistent clips (<0: disabled) — set by engine-dialect config
+        # (e.g. ds_config gradient_clipping) and applied every step.
+        self._clip_norm = -1.0
+        self._clip_value = -1.0
+        # One-shot overrides armed by accelerator.clip_grad_{norm,value}_ and
+        # consumed by the next real update — the reference's calls mutate
+        # grads once per invocation, not forever after.
+        self._clip_norm_once: Optional[float] = None
+        self._clip_value_once: Optional[float] = None
         self._step_count = 0
         if model is not None:
             self._init_state()
@@ -108,12 +122,17 @@ class AcceleratedOptimizer:
             self._step_was_skipped = True
             return
         grads = self.model._consume_grads()
+        clip_norm = self._clip_norm if self._clip_norm_once is None else self._clip_norm_once
+        clip_value = self._clip_value if self._clip_value_once is None else self._clip_value_once
+        self._clip_norm_once = None
+        self._clip_value_once = None
         new_params, self.opt_state, gnorm = _update_step(
             self.tx.update,
             self.model.params,
             self.opt_state,
             grads,
-            jnp.asarray(self._clip_norm, jnp.float32),
+            jnp.asarray(clip_norm, jnp.float32),
+            jnp.asarray(clip_value, jnp.float32),
         )
         self.model._set_params(new_params)
         self._last_grad_norm = gnorm

@@ -48,39 +48,54 @@ def stack_pipeline_stages(layer_params: Any, num_stages: int) -> Any:
 
 
 def pipeline_apply(
-    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_fn: Callable[[Any, Any], Any],
     stage_params: Any,
-    x: jax.Array,
+    x: Any,
     *,
     num_micro_batches: int,
-    state_spec: Optional[Sequence] = None,
-) -> jax.Array:
+    state_spec: Optional[Any] = None,
+) -> Any:
     """Run ``x`` through ``num_stages`` sequential stages with a GPipe microbatch
     schedule.
 
     ``stage_fn(params_for_one_stage, activations) -> activations`` is the
     per-stage body; it is vmapped over the leading stage dim of ``stage_params``.
-    ``x`` is [B, ...]; the batch dim is split into ``num_micro_batches``.
-    ``state_spec`` optionally gives the PartitionSpec *of one microbatch's
-    activations* ([mb, ...]); the stage buffer is constrained to
-    ``P("pp", *state_spec)`` so GSPMD keeps stages on their own pp ranks.
+    ``x`` is a [B, ...] array — or a pytree of them (each leaf must return from
+    ``stage_fn`` with the same shape/dtype; pass-through leaves like an
+    attention mask ride the schedule alongside their microbatch).  The batch
+    dim is split into ``num_micro_batches``.  ``state_spec`` optionally gives
+    the PartitionSpec *of one microbatch's activations* ([mb, ...]) — a single
+    spec-tuple for an array ``x``, or a matching pytree of spec-tuples; the
+    stage buffer is constrained to ``P("pp", *state_spec)`` so GSPMD keeps
+    stages on their own pp ranks.
     """
     S = jax.tree.leaves(stage_params)[0].shape[0]
     M = num_micro_batches
-    B = x.shape[0]
+    leaves = jax.tree.leaves(x)
+    B = leaves[0].shape[0]
+    if any(a.shape[0] != B for a in leaves):
+        raise ValueError("all pipeline inputs must share the batch dim")
     if B % M:
         raise ValueError(f"batch {B} not divisible by num_micro_batches {M}")
     mb = B // M
-    micro = x.reshape(M, mb, *x.shape[1:])
+    micro = jax.tree.map(lambda a: a.reshape(M, mb, *a.shape[1:]), x)
 
+    treedef = jax.tree.structure(x)
     if state_spec is None:
-        state_spec = (None,) * (x.ndim)
-    micro_p = P(None, *state_spec)
-    state_p = P("pp", *state_spec)
+        spec_leaves = [(None,) * a.ndim for a in leaves]
+    else:
+        # One spec-tuple per leaf of ``x`` (flatten_up_to keeps each tuple
+        # whole instead of descending into it).
+        spec_leaves = [tuple(sp) for sp in treedef.flatten_up_to(state_spec)]
+    micro_p = treedef.unflatten([P(None, *sp) for sp in spec_leaves])
+    state_p = treedef.unflatten([P("pp", *sp) for sp in spec_leaves])
 
-    micro = constrain(micro, micro_p)
-    state = jnp.zeros((S, mb, *x.shape[1:]), x.dtype)
-    outputs = jnp.zeros_like(micro)
+    def _constrain_tree(t, specs):
+        return jax.tree.map(constrain, t, specs)
+
+    micro = _constrain_tree(micro, micro_p)
+    state = jax.tree.map(lambda a: jnp.zeros((S, mb, *a.shape[1:]), a.dtype), x)
+    outputs = jax.tree.map(jnp.zeros_like, micro)
     vstage = jax.vmap(stage_fn)
 
     def tick(carry, t):
@@ -88,23 +103,32 @@ def pipeline_apply(
         # Inject microbatch t into the stage-0 slot (past t >= M this re-injects
         # the last microbatch; its output lands outside the valid window and is
         # never written to `outputs`).
-        inj = jax.lax.dynamic_index_in_dim(micro, jnp.minimum(t, M - 1), 0, keepdims=False)
-        state = jax.lax.dynamic_update_index_in_dim(state, inj.astype(state.dtype), 0, 0)
-        state = constrain(state, state_p)
+        inj = jax.tree.map(
+            lambda m: jax.lax.dynamic_index_in_dim(m, jnp.minimum(t, M - 1), 0, keepdims=False),
+            micro,
+        )
+        state = jax.tree.map(
+            lambda s_, i: jax.lax.dynamic_update_index_in_dim(s_, i.astype(s_.dtype), 0, 0),
+            state,
+            inj,
+        )
+        state = _constrain_tree(state, state_p)
         state = vstage(stage_params, state)
-        state = constrain(state, state_p)
+        state = _constrain_tree(state, state_p)
         # Stage S-1 just finished microbatch t-(S-1).  Writes with t < S-1 clamp
         # to slot 0 and are later overwritten by the valid t = S-1 write.
-        out = jax.lax.index_in_dim(state, S - 1, 0, keepdims=False)
+        out = jax.tree.map(lambda s_: jax.lax.index_in_dim(s_, S - 1, 0, keepdims=False), state)
         idx = jnp.maximum(t - (S - 1), 0)
-        outputs = jax.lax.dynamic_update_index_in_dim(outputs, out, idx, 0)
+        outputs = jax.tree.map(
+            lambda o, u: jax.lax.dynamic_update_index_in_dim(o, u, idx, 0), outputs, out
+        )
         # Advance the pipeline: stage i's output becomes stage i+1's input.
-        state = jnp.roll(state, 1, axis=0)
+        state = jax.tree.map(lambda s_: jnp.roll(s_, 1, axis=0), state)
         return (state, outputs), None
 
     (state, outputs), _ = jax.lax.scan(tick, (state, outputs), jnp.arange(M + S - 1))
-    outputs = constrain(outputs, micro_p)
-    return outputs.reshape(B, *x.shape[1:])
+    outputs = _constrain_tree(outputs, micro_p)
+    return jax.tree.map(lambda o, a: o.reshape(B, *a.shape[1:]), outputs, x)
 
 
 # ---------------------------------------------------------------------------
@@ -124,14 +148,10 @@ def pipeline_llama_apply(
     """Pipelined llama forward: embed + head replicated across stages (they are
     fsdp/tp-sharded anyway), decoder layers pipelined over ``pp``.
 
-    Limitations (as on the sp path): causal masking only, default positions.
+    Padded batches: the [B, S] key-validity vector rides the pipeline schedule
+    alongside its microbatch's activations (a pass-through state leaf), so each
+    stage masks with the right microbatch's padding.  Default positions only.
     """
-    if attention_mask is not None:
-        raise NotImplementedError(
-            "attention_mask is not supported on the pipeline-parallel path yet — "
-            "the pp schedule applies causal masking only. Use dense packed "
-            "batches, or a pp=1 mesh for padded batches."
-        )
     from ..models import llama
 
     from .mesh import DATA_AXES
@@ -139,10 +159,6 @@ def pipeline_llama_apply(
     c = config
     b, s = input_ids.shape
     mb = b // num_micro_batches
-    # mask=None == pure causal: attention_block builds its own causal mask on
-    # the einsum path and may pick the flash path per config (this pp path
-    # already rejects padding masks above).
-    mask = None
     positions = jnp.broadcast_to(jnp.arange(s), (mb, s))
     data_spec = DATA_AXES
 
@@ -150,11 +166,13 @@ def pipeline_llama_apply(
     x = constrain(x, P(data_spec, None, None))
 
     stage_layers = stack_pipeline_stages(params["layers"], num_stages)
+    has_valid = attention_mask is not None
 
-    def stage_fn(lp, h):
+    def run_layers(lp, h, kv_valid=None):
         def body(carry, one_layer):
             return llama._layer(
-                carry, one_layer, config=c, mask=mask, positions=positions, act_spec=None
+                carry, one_layer, config=c, mask=None, positions=positions,
+                act_spec=None, kv_valid=kv_valid,
             )
 
         if c.remat:
@@ -162,13 +180,28 @@ def pipeline_llama_apply(
         h, _ = jax.lax.scan(body, h, lp)
         return h
 
-    x = pipeline_apply(
-        stage_fn,
-        stage_layers,
-        x,
-        num_micro_batches=num_micro_batches,
-        state_spec=(data_spec, None, None),
-    )
+    if has_valid:
+        state = {"h": x, "valid": attention_mask.astype(bool)}
+
+        def stage_fn(lp, st):
+            return {"h": run_layers(lp, st["h"], kv_valid=st["valid"]), "valid": st["valid"]}
+
+        out = pipeline_apply(
+            stage_fn,
+            stage_layers,
+            state,
+            num_micro_batches=num_micro_batches,
+            state_spec={"h": (data_spec, None, None), "valid": (data_spec, None)},
+        )
+        x = out["h"]
+    else:
+        x = pipeline_apply(
+            lambda lp, h: run_layers(lp, h),
+            stage_layers,
+            x,
+            num_micro_batches=num_micro_batches,
+            state_spec=(data_spec, None, None),
+        )
 
     return llama.unembed(params, x, c)
 

@@ -224,3 +224,22 @@ def test_unwrap_model_roundtrips_weights():
     unwrapped = accelerator.unwrap_model(prepared)
     assert float(unwrapped.a) == pytest.approx(1.5)
     assert float(unwrapped.b) == pytest.approx(-0.5)
+
+
+def test_clip_grad_value():
+    accelerator = Accelerator(split_batches=True)
+    ds = RegressionDataset(length=16)
+    dl = DataLoader(list(ds), batch_size=16, collate_fn=_collate)
+    model = RegressionModelWithLoss()
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    model, opt, dl = accelerator.prepare(model, opt, dl)
+    batch = next(iter(dl))
+    with accelerator.accumulate(model):
+        out = model(x=batch["x"], y=batch["y"])
+        accelerator.backward(out.loss)
+        accelerator.clip_grad_value_(model.parameters(), clip_value=1e-4)
+        before = float(np.asarray(model.params["a"]))
+        opt.step()
+        after = float(np.asarray(model.params["a"]))
+        # Elementwise clip to 1e-4 with lr 0.1 -> step bounded by 1e-5.
+        assert abs(after - before) <= 1.1e-5

@@ -89,9 +89,46 @@ def test_flash_block_selection_and_validation():
         llama.LlamaConfig.tiny(remat_policy="everything")
 
 
-def test_padding_mask_falls_back_to_einsum():
-    """attention_mask forces the einsum path even when flash is preferred —
-    outputs must respect padding."""
+def test_flash_kv_valid_matches_einsum():
+    """flash_attention with a key-validity padding mask matches the einsum
+    oracle with the equivalent combined causal+padding mask."""
+    q, k, v = _qkv(b=2, s=128)
+    b, s = q.shape[:2]
+    valid = jnp.ones((b, s), bool).at[0, 96:].set(False).at[1, 50:].set(False)
+    mask = jnp.broadcast_to(jnp.tril(jnp.ones((s, s), bool)), (b, s, s)) & valid[:, None, :]
+    ref = _attention(q, k, v, mask, q.shape[2] // k.shape[2])
+    out = flash_attention(q, k, v, causal=True, block_size=64, kv_valid=valid)
+    # Compare only valid query rows: the einsum oracle gives padded queries
+    # uniform-softmax garbage, flash gives them zeros — both are discarded.
+    vq = np.asarray(valid)[:, :, None, None]
+    np.testing.assert_allclose(
+        np.asarray(out) * vq, np.asarray(ref) * vq, rtol=1e-5, atol=1e-5
+    )
+
+
+def test_flash_kv_valid_gradients():
+    q, k, v = _qkv(b=1, s=128)
+    valid = jnp.ones((1, 128), bool).at[0, 100:].set(False)
+
+    def f_flash(q, k, v):
+        return (flash_attention(q, k, v, causal=True, block_size=64, kv_valid=valid)[
+            :, :100
+        ] ** 2).sum()
+
+    def f_ref(q, k, v):
+        s = q.shape[1]
+        mask = jnp.broadcast_to(jnp.tril(jnp.ones((s, s), bool)), (1, s, s)) & valid[:, None, :]
+        return (_attention(q, k, v, mask, q.shape[2] // k.shape[2])[:, :100] ** 2).sum()
+
+    g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+def test_padding_mask_stays_on_flash_path():
+    """attention_mask now runs through the flash path (kv_valid) when flash is
+    preferred — outputs must respect padding."""
     cfg = llama.LlamaConfig.tiny(dtype=jnp.float32, attention_impl="flash")
     params = llama.init_params(cfg, jax.random.key(0))
     ids = jax.random.randint(jax.random.key(1), (1, 64), 0, cfg.vocab_size)

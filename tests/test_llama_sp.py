@@ -22,3 +22,29 @@ def test_llama_sp_loss_matches_dense():
     sb = {"input_ids": jax.device_put(batch["input_ids"], data_sharding(state.mesh))}
     sp_loss = float(jax.jit(lambda p, b: llama.loss_fn(p, b, cfg))(params, sb))
     assert abs(dense_loss - sp_loss) < 3e-3, (dense_loss, sp_loss)
+
+
+def test_llama_sp_padded_batch_matches_dense():
+    """Padding masks on the sequence-parallel path: the [B, S] validity vector
+    rides the ring / all-gathers in ulysses; loss must match the dense masked
+    path."""
+    for sp_impl in ("ring", "ulysses"):
+        cfg = llama.LlamaConfig.tiny(sp_impl=sp_impl)
+        params = llama.init_params(cfg, jax.random.key(0))
+        ids = jax.random.randint(jax.random.key(1), (4, 32), 0, cfg.vocab_size)
+        am = np.ones((4, 32), np.int32)
+        am[0, 20:] = 0
+        am[2, 9:] = 0
+        batch = {"input_ids": ids, "attention_mask": jax.numpy.asarray(am)}
+        dense_loss = float(jax.jit(lambda p, b: llama.loss_fn(p, b, cfg))(params, batch))
+
+        state = AcceleratorState(parallelism_config=ParallelismConfig(dp=2, sp=4))
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sparams = jax.device_put(params, NamedSharding(state.mesh, P()))
+        sb = {k: jax.device_put(v, data_sharding(state.mesh)) for k, v in batch.items()}
+        sp_loss = float(jax.jit(lambda p, b: llama.loss_fn(p, b, cfg))(sparams, sb))
+        assert abs(dense_loss - sp_loss) < 3e-3, (sp_impl, dense_loss, sp_loss)
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()

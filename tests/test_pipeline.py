@@ -118,3 +118,29 @@ def test_prepare_pippy_requires_pp_axis():
     AcceleratorState(parallelism_config=ParallelismConfig(dp=8))
     with pytest.raises(ValueError):
         prepare_pippy(params, cfg)
+
+
+def test_pipeline_padded_batch_matches_dense():
+    """attention_mask rides the pipeline schedule with its microbatch."""
+    cfg = llama.LlamaConfig.tiny(num_layers=4)
+    params = llama.init_params(cfg, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (8, 32), 0, cfg.vocab_size)
+    am = np.ones((8, 32), np.int32)
+    am[1, 20:] = 0
+    am[5, 7:] = 0
+    am = jnp.asarray(am)
+    batch = {"input_ids": ids, "attention_mask": am}
+    dense_loss = float(jax.jit(lambda p: llama.loss_fn(p, batch, cfg))(params))
+
+    state = AcceleratorState(parallelism_config=ParallelismConfig(pp=4, dp=2))
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharded = jax.device_put(params, NamedSharding(state.mesh, P()))
+    sb = {k: jax.device_put(v, data_sharding(state.mesh)) for k, v in batch.items()}
+
+    @jax.jit
+    def pp_loss(p, b):
+        return pl.pipeline_llama_loss_fn(p, b, cfg, num_stages=4, num_micro_batches=2)
+
+    piped = float(pp_loss(sharded, sb))
+    assert abs(dense_loss - piped) < 3e-3, (dense_loss, piped)
