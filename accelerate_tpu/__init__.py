@@ -10,7 +10,10 @@ __version__ = "0.1.0"
 
 from .state import AcceleratorState, GradientState, PartialState
 from .utils import (
+    AutocastKwargs,
     DataLoaderConfiguration,
+    DDPCommunicationHookType,
+    DeepSpeedPlugin,
     DistributedDataParallelKwargs,
     DistributedInitKwargs,
     DistributedType,
@@ -46,6 +49,10 @@ def __getattr__(name):
         from .utils.memory import find_executable_batch_size
 
         return find_executable_batch_size
+    if name == "is_rich_available":
+        from .utils.imports import is_rich_available
+
+        return is_rich_available
     if name in ("notebook_launcher", "debug_launcher"):
         from . import launchers
 

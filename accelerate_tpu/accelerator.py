@@ -781,10 +781,13 @@ class Accelerator:
             prepared = AcceleratedOptimizer(tx, model=model, torch_optimizer=optimizer, initial_lr=lr)
         else:
             prepared = AcceleratedOptimizer(optimizer, model=model)
-        if self._dialect_grad_clip is not None:
+        if self._dialect_grad_clip is not None and float(self._dialect_grad_clip) > 0:
             # DS/Megatron configs carry gradient_clipping; the engines applied it
             # automatically, so the dialect must too (reference utils/deepspeed.py
-            # fills "gradient_clipping" into the engine config).
+            # fills "gradient_clipping" into the engine config).  DeepSpeed's
+            # documented disabled value is 0.0 — which must NOT arm the clip
+            # (the jitted update treats 0 as "zero the grads", torch parity for
+            # the explicit clip_grad_norm_(0) call only).
             prepared._clip_norm = float(self._dialect_grad_clip)
         self._optimizers.append(prepared)
         return prepared

@@ -98,6 +98,14 @@ def default_config_command(args):
     print(f"Default configuration saved to {path}")
 
 
+def write_basic_config(mixed_precision: str = "no", save_location: str = None) -> str:
+    """Programmatic default-config writer (reference
+    ``commands/config/default.py:36`` — used by notebooks/CI to skip the
+    questionnaire).  Returns the written path."""
+    cfg = ClusterConfig(mixed_precision=str(mixed_precision))
+    return save_config(cfg, save_location or DEFAULT_CONFIG_FILE)
+
+
 def register_subcommand(subparsers):
     parser = subparsers.add_parser("config", help="Create the launch configuration")
     parser.add_argument("--config_file", default=None)

@@ -29,9 +29,19 @@ __all__ = [
     "DistributedInitKwargs",
     "InitProcessGroupKwargs",
     "GradScalerKwargs",
+    "DDPCommunicationHookType",
     "DistributedDataParallelKwargs",
     "AutocastKwargs",
     "FP8RecipeKwargs",
+    "TERecipeKwargs",
+    "AORecipeKwargs",
+    "MSAMPRecipeKwargs",
+    "FP8BackendType",
+    "SageMakerDistributedType",
+    "ComputeEnvironment",
+    "LoggerType",
+    "TensorInformation",
+    "TorchDynamoPlugin",
     "ProfileKwargs",
     "GradientAccumulationPlugin",
     "ParallelismConfig",
@@ -110,10 +120,28 @@ class RNGType(BaseEnum):
 
 
 class DynamoBackend(BaseEnum):
-    """Accepted for CLI/config compatibility; everything compiles through XLA here."""
+    """Accepted for CLI/config compatibility; everything compiles through XLA
+    here.  Full reference vocabulary (reference ``DynamoBackend``) so migrated
+    config files parse; only NO/XLA/OPENXLA/INDUCTOR change behavior (and all
+    of them mean "XLA" on TPU)."""
 
     NO = "NO"
+    EAGER = "EAGER"
+    AOT_EAGER = "AOT_EAGER"
     INDUCTOR = "INDUCTOR"
+    AOT_TS_NVFUSER = "AOT_TS_NVFUSER"
+    NVPRIMS_NVFUSER = "NVPRIMS_NVFUSER"
+    CUDAGRAPHS = "CUDAGRAPHS"
+    OFI = "OFI"
+    FX2TRT = "FX2TRT"
+    ONNXRT = "ONNXRT"
+    TENSORRT = "TENSORRT"
+    AOT_TORCHXLA_TRACE_ONCE = "AOT_TORCHXLA_TRACE_ONCE"
+    TORCHXLA_TRACE_ONCE = "TORCHXLA_TRACE_ONCE"
+    IPEX = "IPEX"
+    TVM = "TVM"
+    HQT = "HQT"
+    OPENXLA = "OPENXLA"
     XLA = "XLA"
 
 
@@ -173,6 +201,20 @@ class GradScalerKwargs(KwargsHandler):
     enabled: bool = True
 
 
+class DDPCommunicationHookType(str, enum.Enum):
+    """Gradient-communication compression hooks (reference
+    ``utils/dataclasses.py:130-149``).  str-valued so members compare equal to
+    their config strings.  On TPU only the reduced-precision hooks map to a
+    native concept (bf16/fp16 gradient storage); the PowerSGD variants exist
+    for API parity and are rejected with an explanation at validation."""
+
+    NO = "no"
+    FP16 = "fp16"
+    BF16 = "bf16"
+    POWER_SGD = "power_sgd"
+    BATCHED_POWER_SGD = "batched_power_sgd"
+
+
 @dataclass
 class DistributedDataParallelKwargs(KwargsHandler):
     """DDP tuning knobs (reference ``utils/dataclasses.py:151-226``).
@@ -195,6 +237,17 @@ class DistributedDataParallelKwargs(KwargsHandler):
     comm_hook: str = "no"  # "no" | "fp16" | "bf16" (powerSGD not supported)
 
     def __post_init__(self):
+        if isinstance(self.comm_hook, DDPCommunicationHookType):
+            self.comm_hook = self.comm_hook.value
+        if self.comm_hook in (
+            DDPCommunicationHookType.POWER_SGD,
+            DDPCommunicationHookType.BATCHED_POWER_SGD,
+        ):
+            raise ValueError(
+                "PowerSGD communication hooks are torch-DDP-specific low-rank "
+                "compression; on TPU the gradient all-reduce is compiled by XLA "
+                "over ICI — use comm_hook='bf16' for reduced-precision storage"
+            )
         if self.comm_hook not in ("no", "fp16", "bf16"):
             raise ValueError(
                 f"comm_hook must be 'no', 'fp16' or 'bf16', got {self.comm_hook!r}"
@@ -236,6 +289,141 @@ class FP8RecipeKwargs(KwargsHandler):
             raise ValueError("amax_compute_algo must be 'max' or 'most_recent'")
         if self.scaling not in ("current", "delayed"):
             raise ValueError("scaling must be 'current' or 'delayed'")
+
+
+@dataclass
+class TERecipeKwargs(FP8RecipeKwargs):
+    """TransformerEngine-dialect fp8 recipe (reference ``utils/dataclasses.py:
+    316``).  TE itself is CUDA-only; the knobs map onto ``ops/fp8.py``'s XLA
+    float8 path (HYBRID/E4M3 formats, delayed scaling with amax history)."""
+
+    use_autocast_during_eval: bool = False
+    override_linear_precision: tuple = (False, False, False)
+
+    def __post_init__(self):
+        env = os.environ
+        self.margin = int(env.get("ACCELERATE_FP8_MARGIN", self.margin))
+        self.interval = int(env.get("ACCELERATE_FP8_INTERVAL", self.interval))
+        self.fp8_format = env.get("ACCELERATE_FP8_FORMAT", self.fp8_format)
+        self.amax_history_len = int(env.get("ACCELERATE_FP8_AMAX_HISTORY_LEN", self.amax_history_len))
+        self.amax_compute_algo = env.get("ACCELERATE_FP8_AMAX_COMPUTE_ALGO", self.amax_compute_algo)
+        super().__post_init__()
+
+
+@dataclass
+class AORecipeKwargs(KwargsHandler):
+    """torchao-dialect fp8 recipe (reference ``utils/dataclasses.py:297``):
+    stateless per-tensor dynamic ("current") scaling with a module filter —
+    exactly ``FP8RecipeKwargs(scaling="current")`` plus the filter hook."""
+
+    config: Optional[Any] = None
+    module_filter_func: Optional[Callable] = None
+
+    def to_fp8_recipe(self) -> FP8RecipeKwargs:
+        return FP8RecipeKwargs(scaling="current")
+
+
+@dataclass
+class MSAMPRecipeKwargs(KwargsHandler):
+    """MS-AMP-dialect fp8 recipe (reference ``utils/dataclasses.py:392``).
+    ``opt_level`` controls which states go fp8 in MS-AMP; here it only selects
+    the matmul recipe (weights/grads) — optimizer state stays fp32."""
+
+    opt_level: str = "O2"
+
+    def __post_init__(self):
+        self.opt_level = os.environ.get("ACCELERATE_FP8_OPT_LEVEL", self.opt_level)
+        if self.opt_level not in ("O1", "O2"):
+            raise ValueError(f"`opt_level` must be 'O1' or 'O2', got {self.opt_level!r}")
+
+    def to_fp8_recipe(self) -> FP8RecipeKwargs:
+        return FP8RecipeKwargs()
+
+
+class FP8BackendType(str, enum.Enum):
+    """Reference ``FP8BackendType``: which fp8 engine serves the recipe.  One
+    native backend here (XLA float8); the enum exists so configs round-trip."""
+
+    TE = "TE"
+    MSAMP = "MSAMP"
+    AO = "AO"
+    XLA = "XLA"
+
+
+class SageMakerDistributedType(str, enum.Enum):
+    """Reference ``SageMakerDistributedType`` — config-file vocabulary only
+    (SageMaker is AWS/CUDA infrastructure; see COVERAGE.md §2.8)."""
+
+    NO = "NO"
+    DATA_PARALLEL = "DATA_PARALLEL"
+    MODEL_PARALLEL = "MODEL_PARALLEL"
+
+
+class ComputeEnvironment(str, enum.Enum):
+    """Reference ``ComputeEnvironment`` — config-file vocabulary."""
+
+    LOCAL_MACHINE = "LOCAL_MACHINE"
+    AMAZON_SAGEMAKER = "AMAZON_SAGEMAKER"
+
+
+class LoggerType(BaseEnum):
+    """Supported tracker backends (reference ``LoggerType``; the registry
+    lives in ``tracking.py LOGGER_TYPE_TO_CLASS``)."""
+
+    ALL = "all"
+    AIM = "aim"
+    TENSORBOARD = "tensorboard"
+    WANDB = "wandb"
+    COMETML = "comet_ml"
+    MLFLOW = "mlflow"
+    CLEARML = "clearml"
+    DVCLIVE = "dvclive"
+    JSONL = "jsonl"
+
+
+@dataclass
+class TensorInformation:
+    """Shape+dtype record used when broadcasting object structures
+    (reference ``TensorInformation``)."""
+
+    shape: Any
+    dtype: Any
+
+
+@dataclass
+class TorchDynamoPlugin(KwargsHandler):
+    """torch.compile configuration (reference ``TorchDynamoPlugin``
+    ``utils/dataclasses.py:1002``): consumed by the torch-bridge ingestion
+    path; on the native JAX path everything is already XLA-compiled, so only
+    ``disable`` has an effect there.  Reads the ``ACCELERATE_DYNAMO_*`` env
+    contract set by the launcher."""
+
+    backend: Any = None
+    mode: Optional[str] = None
+    fullgraph: Optional[bool] = None
+    dynamic: Optional[bool] = None
+    options: Any = None
+    disable: bool = False
+
+    def __post_init__(self):
+        prefix = "ACCELERATE_DYNAMO_"
+        if self.backend is None:
+            self.backend = os.environ.get(prefix + "BACKEND", "no")
+        if isinstance(self.backend, str):
+            self.backend = DynamoBackend(self.backend.upper())
+        if self.mode is None:
+            self.mode = os.environ.get(prefix + "MODE", "default")
+        if self.mode not in ("default", "reduce-overhead", "max-autotune"):
+            raise ValueError(f"invalid dynamo mode {self.mode!r}")
+        if self.fullgraph is None:
+            self.fullgraph = str_to_bool(os.environ.get(prefix + "USE_FULLGRAPH", "False")) == 1
+        if self.dynamic is None:
+            self.dynamic = str_to_bool(os.environ.get(prefix + "USE_DYNAMIC", "False")) == 1
+
+    def to_dict(self) -> dict:
+        out = copy.deepcopy(self.__dict__)
+        out["backend"] = self.backend.value.lower()
+        return out
 
 
 @dataclass

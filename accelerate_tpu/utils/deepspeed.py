@@ -268,3 +268,102 @@ class DummyScheduler:
         self.warmup_num_steps = warmup_num_steps
         self.lr_scheduler_callable = lr_scheduler_callable
         self.kwargs = kwargs
+
+
+class DeepSpeedEngineWrapper:
+    """Reference ``utils/deepspeed.py:253``: under DeepSpeed, ``backward()``
+    runs backward + step + zero_grad in one engine call.  Dialect equivalent:
+    wrap the prepared model/optimizer pair so ``backward`` drives the same
+    fused jitted update the native path uses."""
+
+    def __init__(self, engine):
+        self.engine = engine  # (model, optimizer) pair or prepared model
+
+    def backward(self, loss, **kwargs):
+        from ..state import GradientState
+
+        if isinstance(self.engine, (tuple, list)):
+            model, optimizer = self.engine
+        else:
+            model, optimizer = self.engine, None
+        model.backward(loss)
+        if optimizer is not None and GradientState().sync_gradients:
+            optimizer.step()
+            optimizer.zero_grad()
+
+
+class DeepSpeedOptimizerWrapper:
+    """Reference ``utils/deepspeed.py:280``: step/zero_grad are no-ops because
+    the engine wrapper already ran them inside ``backward``."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+
+    def step(self):
+        pass
+
+    def zero_grad(self, set_to_none=None):
+        pass
+
+    @property
+    def step_was_skipped(self) -> bool:
+        return getattr(self.optimizer, "step_was_skipped", False)
+
+    def __getattr__(self, name):
+        return getattr(self.optimizer, name)
+
+
+class DeepSpeedSchedulerWrapper:
+    """Reference ``utils/deepspeed.py:310``: scheduler stepping is owned by the
+    engine; user calls are no-ops."""
+
+    def __init__(self, scheduler, optimizers):
+        self.scheduler = scheduler
+        self.optimizers = optimizers
+
+    def step(self):
+        pass
+
+    def __getattr__(self, name):
+        return getattr(self.scheduler, name)
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def GatheredParameters(params, modifier_rank=None, fwd_module=None, enabled=True):
+    """Reference ``utils/deepspeed.py GatheredParameters``: under ZeRO-3 torch
+    params are sharded and must be all-gathered before host-side access.  JAX
+    global arrays are addressable through their shards transparently (and
+    ``jax.device_get`` assembles the full value), so this is a no-op context
+    kept for migrated scripts."""
+    yield
+
+
+def map_pytorch_optim_to_deepspeed(optimizer):
+    """Reference ``utils/deepspeed.py map_pytorch_optim_to_deepspeed``: pick a
+    DeepSpeed fused optimizer class for a torch optimizer.  Here the optimizer
+    is lowered to optax by ``Accelerator.prepare`` regardless; returns the
+    input unchanged."""
+    return optimizer
+
+
+def deepspeed_required(func):
+    """Decorator (reference ``utils/deepspeed.py deepspeed_required``): guard a
+    function to DeepSpeed-dialect runs."""
+    import functools
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        from ..state import AcceleratorState
+
+        state = AcceleratorState() if AcceleratorState._shared_state else None
+        if state is None or get_active_deepspeed_plugin(state) is None:
+            raise AssertionError(
+                "DeepSpeed is not enabled — pass a DeepSpeedPlugin (or ds_config) "
+                "to Accelerator before calling this function."
+            )
+        return func(*args, **kwargs)
+
+    return wrapper

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import contextlib
 import os
-from typing import Any
+from typing import Any, Optional
 
 __all__ = [
     "str_to_bool",
@@ -63,6 +63,60 @@ def are_libraries_initialized(*library_names: str) -> list[str]:
     return [lib for lib in library_names if lib in sys.modules]
 
 
+def convert_dict_to_env_variables(current_env: dict) -> list[str]:
+    """Render an env dict as ``KEY=value\\n`` lines, dropping entries whose key
+    or value contains shell-unsafe characters (reference
+    ``utils/environment.py:34`` — feeds the launcher's env file)."""
+    import warnings
+
+    forbidden = (";", "\n", "<", ">", " ")
+    valid = []
+    for key, value in current_env.items():
+        if len(key) >= 1 and len(value) >= 1 and all(c not in key + value for c in forbidden):
+            valid.append(f"{key}={value}\n")
+        else:
+            warnings.warn(f"Skipping {key}={value} — contains forbidden characters")
+    return valid
+
+
+def purge_accelerate_environment(func_or_cls):
+    """Decorator restoring all ``ACCELERATE_*`` env vars after the decorated
+    function / every test method of the decorated class runs (reference
+    ``utils/environment.py:362`` — test isolation against env leakage)."""
+    import functools
+    import inspect
+    from contextlib import contextmanager
+
+    prefix = "ACCELERATE_"
+
+    @contextmanager
+    def _guard():
+        saved = {k: v for k, v in os.environ.items() if k.startswith(prefix)}
+        try:
+            yield
+        finally:
+            for key in [k for k in os.environ if k.startswith(prefix)]:
+                if key in saved:
+                    os.environ[key] = saved[key]
+                else:
+                    del os.environ[key]
+            for key, value in saved.items():
+                os.environ.setdefault(key, value)
+
+    if inspect.isclass(func_or_cls):
+        for name, attr in list(vars(func_or_cls).items()):
+            if callable(attr) and (name.startswith("test") or name in ("setUp", "tearDown")):
+                setattr(func_or_cls, name, purge_accelerate_environment(attr))
+        return func_or_cls
+
+    @functools.wraps(func_or_cls)
+    def wrapper(*args, **kwargs):
+        with _guard():
+            return func_or_cls(*args, **kwargs)
+
+    return wrapper
+
+
 @contextlib.contextmanager
 def patch_environment(**kwargs: Any):
     """Temporarily set environment variables; restore previous values on exit.
@@ -96,3 +150,37 @@ def clear_environment():
     finally:
         os.environ.clear()
         os.environ.update(saved)
+
+
+def get_gpu_info() -> tuple[list, int]:
+    """Reference ``utils/environment.py:116`` (pynvml enumeration).  No CUDA
+    devices exist on a TPU host: ([], 0)."""
+    return [], 0
+
+
+def check_cuda_p2p_ib_support() -> bool:
+    """Reference ``utils/environment.py:147``: False only for RTX-4000-series
+    consumer cards.  Irrelevant on TPU (ICI handles peer traffic): True."""
+    return True
+
+
+def set_numa_affinity(local_process_index: int, verbose: Optional[bool] = None) -> None:
+    """Reference ``utils/environment.py:273`` pins each rank to the NUMA node
+    of its GPU.  One process per TPU host here, so there is nothing to pin;
+    kept callable for migrated launch scripts."""
+    return None
+
+
+def get_ccl_version() -> str:
+    """Reference ``utils/imports.py:91``: oneCCL version (CPU collectives
+    backend).  Not used on the JAX/ICI path."""
+    return "0.0.0"
+
+
+def install_xla(upgrade: bool = False) -> None:
+    """Reference ``utils/torch_xla.py:20`` pip-installs torch_xla wheels in
+    Colab.  JAX ships with TPU support here — nothing to install."""
+    raise RuntimeError(
+        "install_xla is a torch_xla/Colab helper; this framework runs TPUs through "
+        "JAX which is already installed."
+    )

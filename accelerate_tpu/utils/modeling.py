@@ -18,6 +18,7 @@ import json
 import os
 import re
 from collections import OrderedDict, defaultdict
+from collections.abc import Mapping
 from typing import Optional, Union
 
 import numpy as np
@@ -432,3 +433,281 @@ def get_state_dict_offloaded_model(model) -> dict:
             f"module onload failures: {failures}"
         )
     return state_dict
+
+
+# ---------------------------------------------------------------------------
+# Reference parity helpers (reference utils/modeling.py + utils/other.py) —
+# the size/tied-parameter/offload toolkit around the device-map planner.
+# ---------------------------------------------------------------------------
+
+
+def convert_file_size_to_int(size) -> int:
+    """"1GiB"/"500MB"/int -> bytes (reference ``utils/modeling.py:109``)."""
+    return int(_to_bytes(size))
+
+
+def get_max_layer_size(modules, module_sizes: dict, no_split_module_classes) -> tuple:
+    """Largest indivisible-layer size in bytes + the layer names realizing it
+    (reference ``utils/modeling.py:709``).  A "layer" is a leaf module or one
+    whose class is listed in ``no_split_module_classes``."""
+    max_size, layer_names = 0, []
+    queue = list(modules)
+    while queue:
+        name, module = queue.pop(0)
+        children = list(module.named_children()) if hasattr(module, "named_children") else []
+        if not children or module.__class__.__name__ in (no_split_module_classes or []):
+            size = module_sizes.get(name, 0)
+            if size > max_size:
+                max_size, layer_names = size, [name]
+            elif size == max_size:
+                layer_names.append(name)
+        else:
+            queue = [(f"{name}.{n}", v) for n, v in children] + queue
+    return max_size, layer_names
+
+
+def calculate_maximum_sizes(model) -> tuple:
+    """(total size, largest-layer size) of a torch model (reference
+    ``utils/modeling.py:1055``; drives ``accelerate estimate-memory``)."""
+    sizes = compute_module_sizes(model)
+    no_split = getattr(model, "_no_split_modules", None) or []
+    modules_to_treat = (
+        list(model.named_parameters(recurse=False))
+        + list(model.named_children())
+        + list(model.named_buffers(recurse=False))
+    )
+    largest_layer = get_max_layer_size(modules_to_treat, sizes, no_split)
+    return sizes[""], largest_layer
+
+
+def find_device(data):
+    """Device of the first tensor found in a nested container (reference
+    ``utils/operations.py``); understands torch tensors and jax arrays."""
+    import jax
+
+    if isinstance(data, Mapping):
+        for obj in data.values():
+            device = find_device(obj)
+            if device is not None:
+                return device
+    elif isinstance(data, (tuple, list)):
+        for obj in data:
+            device = find_device(obj)
+            if device is not None:
+                return device
+    elif isinstance(data, jax.Array):
+        return next(iter(data.devices()))
+    else:
+        import torch
+
+        if isinstance(data, torch.Tensor):
+            return data.device
+    return None
+
+
+def copy_tensor_to_devices(tensor):
+    """Replicate a tensor onto every local device (reference
+    ``utils/operations.py copy_tensor_to_devices``, an XLA-only helper).  JAX
+    native: one fully-replicated global array instead of a per-device list."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import Mesh
+
+    arr = tensor if isinstance(tensor, jax.Array) else jnp_asarray(tensor)
+    mesh = Mesh(np.array(jax.local_devices()), ("replica",))
+    return jax.device_put(arr, NamedSharding(mesh, P()))
+
+
+def jnp_asarray(tensor):
+    import jax.numpy as jnp
+
+    try:
+        import torch
+
+        if isinstance(tensor, torch.Tensor):
+            return jnp.asarray(tensor.detach().cpu().numpy())
+    except ImportError:
+        pass
+    return jnp.asarray(np.asarray(tensor))
+
+
+def id_tensor_storage(tensor) -> tuple:
+    """Unique (device, ptr, size) identifier of a tensor's backing storage
+    (reference ``utils/other.py id_tensor_storage``); tied torch parameters
+    share one storage and therefore one id."""
+    import jax
+
+    if isinstance(tensor, jax.Array):
+        try:
+            ptr = tensor.unsafe_buffer_pointer()
+        except Exception:
+            ptr = id(tensor)
+        return (next(iter(tensor.devices())), ptr, tensor.nbytes)
+    try:
+        storage = tensor.untyped_storage()
+        return (tensor.device, storage.data_ptr(), storage.nbytes())
+    except Exception:
+        # meta tensors have no real storage: identity by object.
+        return (tensor.device, id(tensor), 0)
+
+
+def check_tied_parameters_in_config(model) -> bool:
+    """True when the model's (transformers) config declares weight tying
+    (reference ``utils/modeling.py check_tied_parameters_in_config``)."""
+    import inspect
+
+    if "PreTrainedModel" not in [c.__name__ for c in inspect.getmro(model.__class__)]:
+        return False
+    config = getattr(model, "config", None)
+    decoder_config = (
+        config.get_text_config(decoder=True)
+        if config is not None and hasattr(config, "get_text_config")
+        else config
+    )
+    tied_word = bool(
+        decoder_config is not None
+        and getattr(decoder_config, "tie_word_embeddings", False)
+        and model.get_output_embeddings() is not None
+    )
+    tied_enc_dec = bool(config is not None and getattr(config, "tie_encoder_decoder", False))
+    tied_module = any(hasattr(m, "_tie_weights") for m in model.modules())
+    return tied_word or tied_enc_dec or tied_module
+
+
+def _param_device_from_map(param_name: str, device_map: dict):
+    while param_name:
+        if param_name in device_map:
+            return device_map[param_name]
+        param_name = param_name.rpartition(".")[0]
+    return device_map.get("", None)
+
+
+def check_tied_parameters_on_same_device(tied_params, device_map) -> None:
+    """Warn when a tied-parameter group is split across devices (reference
+    ``utils/modeling.py check_tied_parameters_on_same_device``)."""
+    import logging
+
+    logger = logging.getLogger(__name__)
+    for group in tied_params:
+        devices = {p: _param_device_from_map(p, device_map) for p in group}
+        if len(set(devices.values())) > 1:
+            logger.warning(
+                f"Tied parameters are on different devices: {devices}. "
+                "Please modify your custom device map or set `device_map='auto'`."
+            )
+
+
+def retie_parameters(model, tied_params) -> None:
+    """Restore parameter sharing broken by hook attachment / meta init
+    (reference ``utils/modeling.py retie_parameters``): point every name in a
+    tied group at the first materialized (non-meta) parameter."""
+    import torch
+
+    for group in tied_params:
+        anchor = None
+        for name in group:
+            module = model
+            *path, leaf = name.split(".")
+            for part in path:
+                module = getattr(module, part)
+            param = getattr(module, leaf)
+            if param.device != torch.device("meta"):
+                anchor = param
+                break
+        if anchor is None:
+            continue
+        for name in group:
+            module = model
+            *path, leaf = name.split(".")
+            for part in path:
+                module = getattr(module, part)
+            setattr(module, leaf, anchor)
+
+
+def has_offloaded_params(module) -> bool:
+    """True when the module carries an AlignDevicesHook with offloading enabled
+    (reference ``utils/modeling.py has_offloaded_params``)."""
+    from ..hooks import AlignDevicesHook
+
+    hook = getattr(module, "_hf_hook", None)
+    return isinstance(hook, AlignDevicesHook) and hook.offload
+
+
+def load_offloaded_weights(model, index: dict, offload_folder: str) -> None:
+    """Load every weight recorded in an offload ``index.json`` back into the
+    model (reference ``utils/modeling.py load_offloaded_weights``)."""
+    if not index:
+        return
+    from ..hooks import set_module_tensor_to_device
+    from .offload import load_offloaded_weight
+
+    for param_name, metadata in index.items():
+        weight = load_offloaded_weight(os.path.join(offload_folder, f"{param_name}.dat"), metadata)
+        set_module_tensor_to_device(model, param_name, "cpu", value=weight)
+
+
+def load_state_dict(checkpoint_file: str, device_map: Optional[dict] = None) -> dict:
+    """Load one checkpoint shard (safetensors or torch pickle) to host memory
+    (reference ``utils/modeling.py load_state_dict``; device placement happens
+    later at dispatch — on TPU host RAM is the staging tier)."""
+    return _load_state_dict(checkpoint_file)
+
+
+def clean_state_dict_for_safetensors(state_dict: dict) -> dict:
+    """Drop duplicate shared-storage tensors and make the rest contiguous so
+    safetensors will serialize the dict (reference ``utils/other.py
+    clean_state_dict_for_safetensors``)."""
+    import torch
+
+    seen: dict = {}
+    cleaned = {}
+    for name, tensor in state_dict.items():
+        if isinstance(tensor, torch.Tensor):
+            key = id_tensor_storage(tensor)
+            if key in seen and tensor.device != torch.device("meta"):
+                continue
+            seen[key] = name
+            cleaned[name] = tensor.contiguous()
+        else:
+            cleaned[name] = tensor
+    return cleaned
+
+
+def extract_submodules_state_dict(state_dict: dict, submodule_names) -> dict:
+    """Sub-dict of entries belonging to the given submodules, with the prefix
+    stripped (reference ``utils/offload.py extract_submodules_state_dict``)."""
+    out = {}
+    for name in submodule_names:
+        out.update(
+            {
+                k[len(name) + 1:]: v
+                for k, v in state_dict.items()
+                if k == name or k.startswith(name + ".")
+            }
+        )
+    return out
+
+
+def get_mixed_precision_context_manager(native_amp: bool = False, autocast_kwargs=None):
+    """Context manager for the torch-bridge eval path (reference
+    ``utils/modeling.py:2044``).  On TPU the dtype policy is compiled into the
+    step (``MixedPrecisionPolicy``), so this matters only for host-side torch
+    execution: returns torch.autocast over CPU when requested."""
+    import contextlib
+
+    import torch
+
+    if not native_amp:
+        return contextlib.nullcontext()
+    kwargs = {} if autocast_kwargs is None else dict(autocast_kwargs)
+    kwargs.pop("cache_enabled", None)
+    return torch.autocast(device_type="cpu", dtype=torch.bfloat16, **kwargs)
+
+
+def get_grad_scaler(distributed_type=None, **kwargs):
+    """Reference ``utils/modeling.py:2087``: a GradScaler for fp16 loops.  bf16
+    needs no scaling on TPU; the returned (CPU) scaler keeps the torch-shaped
+    API for migrated loops."""
+    import torch
+
+    return torch.amp.GradScaler("cpu", **kwargs)

@@ -163,3 +163,21 @@ class OffloadedWeightsLoader(Mapping):
 
     def __len__(self):
         return len(self.all_keys)
+
+
+class PrefixedDataset(Mapping):
+    """Key-prefix view over a weights mapping (reference ``utils/offload.py:
+    104``): lets a submodule's hook address its slice of a flat weights map."""
+
+    def __init__(self, dataset: Mapping, prefix: str):
+        self.dataset = dataset
+        self.prefix = prefix
+
+    def __getitem__(self, key):
+        return self.dataset[f"{self.prefix}{key}"]
+
+    def __iter__(self):
+        return iter(key for key in self.dataset if key.startswith(self.prefix))
+
+    def __len__(self):
+        return len(self.dataset)

@@ -108,6 +108,11 @@ def to_jax(x: Any) -> jax.Array:
     return jnp.asarray(to_numpy(x))
 
 
+def is_namedtuple(data) -> bool:
+    """Duck-typed namedtuple check (reference ``utils/operations.py:65``)."""
+    return isinstance(data, tuple) and hasattr(data, "_asdict") and hasattr(data, "_fields")
+
+
 def honor_type(obj, generator):
     """Build an instance of ``type(obj)`` from a generator, honoring namedtuples.
 
@@ -576,3 +581,15 @@ def convert_outputs_to_fp32(model_forward):
 
     forward.__wrapped__ = model_forward
     return forward
+
+
+class CannotPadNestedTensorWarning(UserWarning):
+    """Reference ``utils/operations.py``: raised-when-warned that nested
+    tensors cannot be padded by ``pad_across_processes``."""
+
+
+def is_tensor_information(x) -> bool:
+    """Reference ``utils/operations.py``: TensorInformation instance check."""
+    from .dataclasses import TensorInformation
+
+    return isinstance(x, TensorInformation)

@@ -121,6 +121,56 @@ def get_module_children_bottom_up(model, return_fqns: bool = False) -> list:
     return out
 
 
+def get_pretty_name(obj) -> str:
+    """Readable name for any object (reference ``utils/other.py:268``) — used
+    by checkpoint logging for registered custom objects."""
+    if not hasattr(obj, "__qualname__") and not hasattr(obj, "__name__"):
+        obj = getattr(obj, "__class__", obj)
+    for attr in ("__qualname__", "__name__"):
+        if hasattr(obj, attr):
+            return getattr(obj, attr)
+    return str(obj)
+
+
+def merge_dicts(source: dict, destination: dict) -> dict:
+    """Recursively merge ``source`` into ``destination`` (reference
+    ``utils/other.py:281``; used by the DeepSpeed-dialect config fill)."""
+    for key, value in source.items():
+        if isinstance(value, dict):
+            merge_dicts(value, destination.setdefault(key, {}))
+        else:
+            destination[key] = value
+    return destination
+
+
+def is_port_in_use(port: Optional[int] = None) -> bool:
+    """True when localhost:``port`` already has a listener (reference
+    ``utils/other.py:299``) — guards double launcher invocations."""
+    import socket
+
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        return s.connect_ex(("localhost", int(port or 29500))) == 0
+
+
+def recursive_getattr(obj, attr: str):
+    """Dotted-path getattr, e.g. ``recursive_getattr(m, "layer.weight")``
+    (reference ``utils/other.py:338``)."""
+    out = obj
+    for part in attr.split("."):
+        out = getattr(out, part)
+    return out
+
+
+def convert_bytes(size) -> str:
+    """Human unit string for a byte count (reference ``utils/other.py:310``)."""
+    size = float(size)
+    for unit in ("bytes", "KB", "MB", "GB", "TB"):
+        if size < 1024.0:
+            return f"{round(size, 2)} {unit}"
+        size /= 1024.0
+    return f"{round(size, 2)} PB"
+
+
 def tqdm(*args, main_process_only: bool = True, **kwargs):
     """tqdm that renders only on the main process (reference ``utils/tqdm.py``)."""
     from tqdm.auto import tqdm as _tqdm
@@ -141,3 +191,11 @@ def install_rich_traceback() -> None:
         install(show_locals=False)
     except ImportError:
         pass
+
+
+def wait_for_everyone() -> None:
+    """Module-level barrier (reference ``utils/other.py:138`` →
+    ``PartialState().wait_for_everyone()``)."""
+    from ..state import PartialState
+
+    PartialState().wait_for_everyone()

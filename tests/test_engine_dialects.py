@@ -213,3 +213,18 @@ def test_deepspeed_dialect_trains_like_fsdp():
         )
     )
     assert abs(ds_loss - fsdp_loss) < 1e-5, (ds_loss, fsdp_loss)
+
+
+def test_ds_gradient_clipping_zero_means_disabled():
+    """DeepSpeed's documented disabled value `gradient_clipping: 0.0` must NOT
+    arm the clip (0 would zero every gradient in the jitted update)."""
+    import torch
+
+    cfg = dict(ZERO3_CONFIG)
+    cfg["gradient_clipping"] = 0.0
+    plugin = DeepSpeedPlugin(hf_ds_config=cfg)
+    acc = Accelerator(deepspeed_plugin=plugin)
+    model = torch.nn.Linear(4, 1)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    model, opt = acc.prepare(model, opt)
+    assert opt._clip_norm == -1.0  # disabled sentinel, not an armed 0-clip
