@@ -99,6 +99,12 @@ def data_sharding(mesh: Mesh) -> NamedSharding:
 def spec_from_rules(path: str, ndim: int, rules: list[tuple[str, P]]) -> Optional[P]:
     for pattern, spec in rules:
         if re.search(pattern, path):
+            if len(spec) > ndim:
+                # Rule written for a higher-rank tensor under the same path
+                # prefix (e.g. an `embeddings/` matrix rule hitting a norm
+                # scale): replicate instead of producing an invalid sharding.
+                # Shorter-than-rank specs are legal (trailing dims replicate).
+                continue
             return spec
     return None
 

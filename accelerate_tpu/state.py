@@ -95,7 +95,19 @@ class PartialState:
         init_kwargs = kwargs.pop("init_kwargs", None) or DistributedInitKwargs()
 
         if cpu:
-            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            # Force CPU even when the environment pre-selects a device platform
+            # (e.g. a tunneled-TPU image exporting JAX_PLATFORMS): setdefault
+            # alone would silently keep the accelerator.  Safe before first
+            # backend use; afterwards clear_backends re-probes on next use.
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            if (jax.config.jax_platforms or "") != "cpu":
+                jax.config.update("jax_platforms", "cpu")
+                try:
+                    from jax.extend.backend import clear_backends
+
+                    clear_backends()
+                except Exception:
+                    pass
 
         self._maybe_init_distributed(init_kwargs)
 

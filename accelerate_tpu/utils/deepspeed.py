@@ -286,7 +286,18 @@ class DeepSpeedEngineWrapper:
             model, optimizer = self.engine
         else:
             model, optimizer = self.engine, None
-        model.backward(loss)
+        accelerator = getattr(model, "accelerator", None)
+        if accelerator is not None:
+            # PreparedModel: route through the owning Accelerator so the loss
+            # lands on the gradient-accumulation buffer as usual.
+            accelerator.backward(loss)
+        elif hasattr(loss, "backward"):
+            loss.backward()
+        else:
+            raise TypeError(
+                "DeepSpeedEngineWrapper needs a prepared model (or a torch loss "
+                f"with .backward); got model={type(model).__name__}"
+            )
         if optimizer is not None and GradientState().sync_gradients:
             optimizer.step()
             optimizer.zero_grad()

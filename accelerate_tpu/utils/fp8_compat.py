@@ -46,11 +46,10 @@ def filter_linear_layers(module, fqn: str, layers_to_filter) -> bool:
 
 def filter_first_and_last_linear_layers(module, fqn: str) -> bool:
     """Reference ``utils/ao.py:72``: skip the first and last linear layers
-    (embed/unembed-adjacent) — the standard fp8 training recipe."""
-    root = getattr(filter_first_and_last_linear_layers, "_model", None)
-    if root is None:
-        return True
-    names = _linear_names(root)
+    (embed/unembed-adjacent) — the standard fp8 training recipe.  ``module``
+    is the ROOT model being converted (matching the reference, whose filter
+    scans the passed module for its first/last linears)."""
+    names = _linear_names(module)
     if not names:
         return True
     return fqn not in (names[0], names[-1])
@@ -93,24 +92,47 @@ def has_4bit_bnb_layers(model) -> bool:
     )
 
 
+class _FP8CallProxy:
+    """Callable proxy arming the fp8 recipe around ``model(...)`` for models
+    without a patchable ``forward`` attribute (``instance.__call__ = ...`` is
+    ignored by Python's type-level lookup, so patching it would silently run
+    full precision)."""
+
+    def __init__(self, model, recipe):
+        object.__setattr__(self, "_fp8_model", model)
+        object.__setattr__(self, "_fp8_recipe", recipe)
+
+    def __call__(self, *args, **kwargs):
+        from ..ops.fp8 import fp8_autowrap
+
+        with fp8_autowrap(self._fp8_recipe):
+            return self._fp8_model(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_fp8_model"), name)
+
+    def __setattr__(self, name, value):
+        setattr(object.__getattribute__(self, "_fp8_model"), name, value)
+
+
 def apply_fp8_autowrap(model, fp8_recipe_handler=None):
     """Reference ``utils/transformer_engine.py:136``: wrap the model forward in
-    fp8 autocast.  Native: arm ``ops/fp8.fp8_autowrap`` around ``__call__`` so
-    every projection matmul takes the scaled-float8 path."""
+    fp8 autocast.  Native: arm ``ops/fp8.fp8_autowrap`` around the forward so
+    every projection matmul takes the scaled-float8 path.  Use the RETURN
+    value (for forward-less models it is a delegating proxy, not the input)."""
     from ..ops.fp8 import fp8_autowrap
 
-    forward = model.forward if hasattr(model, "forward") else model.__call__
-
-    @functools.wraps(forward)
-    def wrapped(*args, **kwargs):
-        with fp8_autowrap(fp8_recipe_handler):
-            return forward(*args, **kwargs)
-
     if hasattr(model, "forward"):
+        forward = model.forward
+
+        @functools.wraps(forward)
+        def wrapped(*args, **kwargs):
+            with fp8_autowrap(fp8_recipe_handler):
+                return forward(*args, **kwargs)
+
         model.forward = wrapped
-    else:
-        model.__call__ = wrapped
-    return model
+        return model
+    return _FP8CallProxy(model, fp8_recipe_handler)
 
 
 def contextual_fp8_autocast(model_forward, fp8_recipe, use_during_eval: bool = False):
@@ -134,6 +156,5 @@ def convert_model_to_fp8_ao(model, config=None, module_filter_func: Optional[Cal
     """Reference ``utils/ao.py:104``: torchao float8 conversion with a module
     filter.  Native equivalent of :func:`convert_model` with the
     current-scaling recipe."""
-    filter_first_and_last_linear_layers._model = model
     model._fp8_ao_converted = True
     return apply_fp8_autowrap(model, None)

@@ -249,9 +249,7 @@ def _torch_backend_available(probe) -> bool:
 
 
 def is_cuda_available() -> bool:
-    import torch
-
-    return _torch_backend_available(lambda: torch.cuda.is_available())
+    return _torch_backend_available(lambda: __import__("torch").cuda.is_available())
 
 
 def is_mps_available(min_version: str | None = None) -> bool:

@@ -498,10 +498,13 @@ def find_device(data):
     elif isinstance(data, jax.Array):
         return next(iter(data.devices()))
     else:
-        import torch
+        from .imports import is_available
 
-        if isinstance(data, torch.Tensor):
-            return data.device
+        if is_available("torch"):
+            import torch
+
+            if isinstance(data, torch.Tensor):
+                return data.device
     return None
 
 

@@ -167,7 +167,10 @@ class OffloadedWeightsLoader(Mapping):
 
 class PrefixedDataset(Mapping):
     """Key-prefix view over a weights mapping (reference ``utils/offload.py:
-    104``): lets a submodule's hook address its slice of a flat weights map."""
+    104``): lets a submodule's hook address its slice of a flat weights map by
+    unprefixed name.  Unlike the reference (whose ``__iter__`` yields the
+    still-prefixed keys, so ``dict(pd)`` raises), iteration yields the
+    STRIPPED keys — a consistent Mapping."""
 
     def __init__(self, dataset: Mapping, prefix: str):
         self.dataset = dataset
@@ -177,7 +180,8 @@ class PrefixedDataset(Mapping):
         return self.dataset[f"{self.prefix}{key}"]
 
     def __iter__(self):
-        return iter(key for key in self.dataset if key.startswith(self.prefix))
+        n = len(self.prefix)
+        return iter(key[n:] for key in self.dataset if key.startswith(self.prefix))
 
     def __len__(self):
-        return len(self.dataset)
+        return sum(1 for key in self.dataset if key.startswith(self.prefix))

@@ -1,0 +1,8 @@
+"""Prints the Accelerator state produced by the current config/env — the
+reference's `run_me.py` smoke payload for every template in this folder."""
+
+from accelerate_tpu import Accelerator
+
+accelerator = Accelerator()
+accelerator.print(f"Accelerator state from the current environment:\n{accelerator.state}")
+accelerator.end_training()

@@ -1,0 +1,19 @@
+#!/bin/bash
+#SBATCH --job-name=atpu-pod
+#SBATCH --nodes=4
+#SBATCH --ntasks-per-node=1
+#SBATCH --output=%x_%j.out
+
+# Multi-host slice: one launcher task per host.  Host 0 of the allocation is
+# the JAX distributed coordinator (reference submit_multinode.sh wires
+# MASTER_ADDR the same way for torchrun).
+export COORD_ADDR=$(scontrol show hostnames "$SLURM_JOB_NODELIST" | head -n 1)
+export COORD_PORT=8476
+
+srun accelerate-tpu launch \
+    --num_machines "$SLURM_NNODES" \
+    --machine_rank "$SLURM_NODEID" \
+    --main_process_ip "$COORD_ADDR" \
+    --main_process_port "$COORD_PORT" \
+    --mixed_precision bf16 \
+    examples/complete_nlp_example.py --checkpointing_steps epoch

@@ -1,0 +1,17 @@
+#!/bin/bash
+#SBATCH --job-name=atpu-pod-fsdp
+#SBATCH --nodes=4
+#SBATCH --ntasks-per-node=1
+#SBATCH --output=%x_%j.out
+
+# Multi-host FSDP (ZeRO-3-equivalent GSPMD sharding over every chip in the
+# slice); pairs with examples/slurm/fsdp_config.yaml.
+export COORD_ADDR=$(scontrol show hostnames "$SLURM_JOB_NODELIST" | head -n 1)
+
+srun accelerate-tpu launch \
+    --config_file examples/slurm/fsdp_config.yaml \
+    --num_machines "$SLURM_NNODES" \
+    --machine_rank "$SLURM_NODEID" \
+    --main_process_ip "$COORD_ADDR" \
+    --main_process_port 8476 \
+    examples/complete_nlp_example.py --checkpointing_steps epoch

@@ -1,0 +1,10 @@
+#!/bin/bash
+#SBATCH --job-name=atpu-single
+#SBATCH --nodes=1
+#SBATCH --ntasks-per-node=1
+#SBATCH --output=%x_%j.out
+
+# Single TPU host: all local chips, data-parallel by default.
+srun accelerate-tpu launch \
+    --mixed_precision bf16 \
+    examples/complete_nlp_example.py --checkpointing_steps epoch
