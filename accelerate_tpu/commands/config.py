@@ -42,6 +42,9 @@ class ClusterConfig:
     use_fsdp: bool = False
     fsdp_sharding_strategy: str = "FULL_SHARD"
     fsdp_min_num_params: int = 0
+    # DeepSpeed dialect: a ds_config.json consumed at prepare time
+    # (utils/deepspeed.py); flows to workers via ACCELERATE_DEEPSPEED_CONFIG_FILE.
+    deepspeed_config_file: Optional[str] = None
     downcast_bf16: bool = False
     # Pod management (consumed by `accelerate-tpu tpu-config`).
     tpu_name: Optional[str] = None

@@ -61,6 +61,8 @@ def launch_command_parser(subparsers=None):
     parser.add_argument("--use_fsdp", action="store_true", default=None)
     parser.add_argument("--fsdp_sharding_strategy", default=None)
     parser.add_argument("--fsdp_min_num_params", type=int, default=None)
+    parser.add_argument("--deepspeed_config_file", default=None,
+                        help="ds_config.json consumed as a config dialect")
     parser.add_argument("--fsdp_cpu_offload", action="store_true", default=None)
     # Misc
     parser.add_argument("--debug", action="store_true", help="ACCELERATE_DEBUG_MODE=1")
@@ -98,6 +100,9 @@ def _merge(args, cfg: ClusterConfig):
         "use_fsdp": pick(args.use_fsdp, cfg.use_fsdp),
         "fsdp_sharding_strategy": pick(args.fsdp_sharding_strategy, cfg.fsdp_sharding_strategy),
         "fsdp_min_num_params": pick(args.fsdp_min_num_params, cfg.fsdp_min_num_params),
+        "deepspeed_config_file": pick(
+            getattr(args, "deepspeed_config_file", None), cfg.deepspeed_config_file
+        ),
     }
     return merged
 
@@ -115,6 +120,9 @@ def build_env(merged: dict, debug: bool = False, cpu: bool = False) -> dict:
         env["ACCELERATE_USE_FSDP"] = "1"
         env["FSDP_SHARDING_STRATEGY"] = str(merged["fsdp_sharding_strategy"])
         env["FSDP_MIN_NUM_PARAMS"] = str(merged["fsdp_min_num_params"])
+    if merged.get("deepspeed_config_file"):
+        env["ACCELERATE_USE_DEEPSPEED"] = "true"
+        env["ACCELERATE_DEEPSPEED_CONFIG_FILE"] = str(merged["deepspeed_config_file"])
     if debug:
         env["ACCELERATE_DEBUG_MODE"] = "1"
     if cpu:

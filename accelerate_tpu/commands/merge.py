@@ -15,14 +15,50 @@ import os
 import numpy as np
 
 
+def _is_orbax_checkpoint(path: str) -> bool:
+    names = set(os.listdir(path))
+    return bool(names & {"_METADATA", "_CHECKPOINT_METADATA", "manifest.ocdbt"}) or any(
+        os.path.isdir(os.path.join(path, n)) and n in ("d", "ocdbt.process_0") for n in names
+    )
+
+
+def _merge_orbax(in_dir: str, out_dir: str) -> None:
+    """Consolidate an orbax sharded export (``checkpointing.save_sharded_model``
+    under SHARDED_STATE_DICT) into one safetensors file: restore to host
+    (orbax assembles the full arrays) and flatten dotted keys."""
+    import jax
+    import orbax.checkpoint as ocp
+    from safetensors.numpy import save_file
+
+    restored = ocp.StandardCheckpointer().restore(os.path.abspath(in_dir))
+
+    flat = {}
+
+    def walk(tree, prefix=""):
+        if isinstance(tree, dict):
+            for k, v in tree.items():
+                walk(v, f"{prefix}{k}.")
+            return
+        flat[prefix[:-1]] = np.asarray(jax.device_get(tree))
+
+    walk(restored)
+    save_file(flat, os.path.join(out_dir, "model.safetensors"))
+    print(f"Merged orbax sharded checkpoint -> {out_dir}/model.safetensors ({len(flat)} tensors)")
+
+
 def merge_command(args):
     from safetensors.numpy import load_file, save_file
 
     in_dir = args.checkpoint_dir
     out_dir = args.output_path
     os.makedirs(out_dir, exist_ok=True)
+    if os.path.isdir(in_dir) and _is_orbax_checkpoint(in_dir):
+        return _merge_orbax(in_dir, out_dir)
+    # Numeric rank order — lexicographic would interleave shard 10 before 2
+    # and silently scramble the concatenation.
     shard_files = sorted(
-        f for f in os.listdir(in_dir) if f.startswith("model_shard_") and f.endswith(".safetensors")
+        (f for f in os.listdir(in_dir) if f.startswith("model_shard_") and f.endswith(".safetensors")),
+        key=lambda f: int(f[len("model_shard_"):-len(".safetensors")]),
     )
     if not shard_files:
         # Already consolidated: copy through.

@@ -94,7 +94,11 @@ class PartialState:
         self.debug = parse_flag_from_env("ACCELERATE_DEBUG_MODE")
         init_kwargs = kwargs.pop("init_kwargs", None) or DistributedInitKwargs()
 
-        if cpu:
+        # An explicit JAX_PLATFORMS=cpu in the environment is a user decision
+        # too: some images install a sitecustomize that rewrites the jax
+        # config to a device platform at import (overriding the env var), and
+        # probing an unreachable tunneled device can block forever.
+        if cpu or os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
             # Force CPU even when the environment pre-selects a device platform
             # (e.g. a tunneled-TPU image exporting JAX_PLATFORMS): setdefault
             # alone would silently keep the accelerator.  Safe before first
@@ -140,6 +144,16 @@ class PartialState:
             "ACCELERATE_COORDINATOR_ADDRESS"
         )
         if coordinator is None:
+            # Real TPU pod without an explicit coordinator: JAX auto-discovers
+            # the coordinator + process index from TPU-VM metadata.  Strictly
+            # opt-in via the launcher's pod marker (TPU-ish env vars like
+            # TPU_WORKER_HOSTNAMES also appear on single-host images, where a
+            # bare initialize() would fail).
+            if os.environ.get("ACCELERATE_TPU_POD") == "1":
+                from jax._src import distributed as _jax_distributed
+
+                if getattr(_jax_distributed.global_state, "client", None) is None:
+                    jax.distributed.initialize()
             return
         num_processes = init_kwargs.num_processes or int(
             os.environ.get("ACCELERATE_NUM_PROCESSES", 1)

@@ -1,0 +1,134 @@
+"""Launchable dataloader-semantics check (reference
+``test_utils/scripts/test_distributed_data_loop.py``, 410 LoC):
+even_batches behavior, join_uneven_inputs, dispatcher vs shard modes, and
+dataloader state_dict round-trips — run under a real multi-process cluster or
+standalone on one process.
+
+Run standalone or through the launcher:
+    accelerate-tpu launch -m accelerate_tpu.test_utils.scripts.test_distributed_data_loop
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+import torch
+from torch.utils.data import DataLoader, TensorDataset
+
+
+def _make_accelerator(even_batches: bool = True, dispatch_batches=None):
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    from accelerate_tpu.utils import DataLoaderConfiguration
+
+    cfg = DataLoaderConfiguration(even_batches=even_batches, dispatch_batches=dispatch_batches)
+    return Accelerator(dataloader_config=cfg)
+
+
+def _dataset(n: int) -> TensorDataset:
+    return TensorDataset(torch.arange(n, dtype=torch.float32).reshape(-1, 1))
+
+
+def _batch_sizes(accelerator, dataset_size: int, batch_size: int) -> list:
+    dl = accelerator.prepare(DataLoader(_dataset(dataset_size), batch_size=batch_size))
+    return [batch[0].shape[0] for batch in dl]
+
+
+def test_default_ensures_even_batch_sizes():
+    """even_batches=True (default): uneven tails are topped up by wrapping to
+    the dataset start, so every batch a process sees has the SAME shape —
+    required for the compiled step (one trace).  The global batch is
+    batch_size x data-parallel device count."""
+    accelerator = _make_accelerator(even_batches=True)
+    import jax
+
+    n_shards = max(jax.device_count(), accelerator.num_processes)
+    sizes = _batch_sizes(accelerator, 2 * n_shards + 1, 2)
+    # Every step's global batch divides evenly across the data shards (the
+    # uneven tail is wrapped up to the next multiple), and all non-final
+    # steps share one shape.
+    assert all(s % n_shards == 0 for s in sizes), sizes
+    assert len(set(sizes[:-1])) <= 1, sizes
+    accelerator.print(f"even_batches=True ok (sizes={sizes})")
+
+
+def test_can_disable_even_batches():
+    """even_batches=False on the mesh: a global jax.Array batch must still
+    divide across the data shards, so shard-divisibility padding remains (the
+    documented reason ``join_uneven_inputs`` is a no-op here); the knob only
+    changes the cross-PROCESS index math.  gather_for_metrics drops the
+    padded duplicates either way."""
+    accelerator = _make_accelerator(even_batches=False)
+    import jax
+
+    n_shards = max(jax.device_count(), accelerator.num_processes)
+    n = 2 * n_shards + 1
+    sizes = _batch_sizes(accelerator, n, 2)
+    assert all(s % n_shards == 0 for s in sizes), sizes
+    assert sum(sizes) >= n, (sizes, n)  # no sample dropped
+    accelerator.print(f"even_batches=False ok (sizes={sizes})")
+
+
+def test_join_uneven_inputs_warns():
+    """join_uneven_inputs is a documented no-op (shapes are equalized before
+    the mesh) — it must still be usable as a context manager."""
+    accelerator = _make_accelerator(even_batches=True)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        with accelerator.join_uneven_inputs([], even_batches=False):
+            pass
+    assert any("no-op" in str(x.message) for x in w), [str(x.message) for x in w]
+    accelerator.print("join_uneven_inputs ok")
+
+
+def test_dispatch_mode_matches_shard_mode():
+    """Dispatcher (rank-0 reads + broadcast) must deliver the same ordered
+    sample STREAM as per-process sharding.  Batch shaping differs by design:
+    shard mode scales the global batch by the data-shard count, the
+    dispatcher keeps the loader's batch and pads each to shard divisibility —
+    so compare deduplicated sample order, not shapes."""
+
+    def stream(acc):
+        seen, out = set(), []
+        for b in acc.prepare(DataLoader(_dataset(16), batch_size=4)):
+            for v in np.asarray(b[0]).ravel().tolist():
+                if v not in seen:  # drop divisibility-padding duplicates
+                    seen.add(v)
+                    out.append(v)
+        return out
+
+    shard_vals = stream(_make_accelerator(dispatch_batches=False))
+    disp_vals = stream(_make_accelerator(dispatch_batches=True))
+    assert shard_vals == disp_vals, (shard_vals, disp_vals)
+    print("dispatcher parity ok")
+
+
+def test_dataloader_state_dict_roundtrip():
+    accelerator = _make_accelerator()
+    dl = accelerator.prepare(DataLoader(_dataset(16), batch_size=4))
+    it = iter(dl)
+    next(it)
+    sd = dl.state_dict() if hasattr(dl, "state_dict") else None
+    if sd is not None:
+        dl.load_state_dict(sd)
+    accelerator.print("dataloader state_dict ok")
+
+
+def main():
+    test_default_ensures_even_batch_sizes()
+    test_can_disable_even_batches()
+    test_join_uneven_inputs_warns()
+    test_dispatch_mode_matches_shard_mode()
+    test_dataloader_state_dict_roundtrip()
+    from accelerate_tpu.state import PartialState
+
+    PartialState().print("test_distributed_data_loop: all checks passed")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Launchable sharded-save + merge check (reference
+``test_utils/scripts/test_merge_weights.py``): train a step under
+SHARDED_STATE_DICT, save per-process shards, consolidate with
+``merge_fsdp_weights``, and verify the merged weights equal the live ones.
+
+Run standalone or through the launcher:
+    accelerate-tpu launch -m accelerate_tpu.test_utils.scripts.test_merge_weights
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+import numpy as np
+
+
+def main():
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.test_utils.training import RegressionModelWithLoss
+    from accelerate_tpu.utils import FullyShardedDataParallelPlugin, merge_fsdp_weights
+    from accelerate_tpu.utils.fsdp_utils import save_fsdp_model
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+    plugin = FullyShardedDataParallelPlugin(state_dict_type="SHARDED_STATE_DICT")
+    accelerator = Accelerator(fsdp_plugin=plugin)
+    model = accelerator.prepare(RegressionModelWithLoss())
+
+    with tempfile.TemporaryDirectory() as work:
+        save_fsdp_model(plugin, accelerator, model, work)
+        shard_dir = os.path.join(work, "model_0")
+        assert os.path.isdir(shard_dir), os.listdir(work)
+
+        out_dir = os.path.join(work, "merged")
+        merge_fsdp_weights(shard_dir, out_dir, safe_serialization=True)
+        merged_path = os.path.join(out_dir, "model.safetensors")
+        assert os.path.exists(merged_path), os.listdir(out_dir)
+
+        from safetensors.numpy import load_file
+
+        import jax
+
+        merged = load_file(merged_path)
+        live = {k: np.asarray(v) for k, v in jax.device_get(model.params).items()}
+        for key, value in live.items():
+            np.testing.assert_allclose(merged[key], value, rtol=1e-6, atol=1e-6)
+
+    accelerator.print("test_merge_weights: merged weights match live params")
+
+
+if __name__ == "__main__":
+    main()

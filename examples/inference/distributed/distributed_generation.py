@@ -12,6 +12,11 @@ import os
 
 if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
     os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    # Honor the explicit CPU request even on images whose sitecustomize
+    # rewrites the jax config to a device platform at import.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 

@@ -8,6 +8,11 @@ import os
 
 if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
     os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    # Honor the explicit CPU request even on images whose sitecustomize
+    # rewrites the jax config to a device platform at import.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 
@@ -22,6 +27,12 @@ from accelerate_tpu.parallel.sharding import data_sharding, shard_params
 
 def main():
     n = jax.device_count()
+    if n < 2:
+        raise SystemExit(
+            "This example needs >=2 devices for a pp axis. On one machine run it "
+            "on the virtual CPU mesh:  JAX_PLATFORMS=cpu "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 python " + __file__
+        )
     pp = 4 if n % 4 == 0 else 2
     state = AcceleratorState(parallelism_config=ParallelismConfig(pp=pp, dp=n // pp))
 

@@ -1,11 +1,16 @@
 """Remote multi-host launcher (analog of the reference
 ``examples/multigpu_remote_launcher.py``, which fans a training function out
 to remote GPUs via runhouse): fan a command out to every VM of a TPU pod via
-the ``tpu-config`` gcloud ssh machinery, wiring the coordinator env on each
-worker.
+the ``tpu-config`` gcloud ssh machinery.
 
-Run:  python examples/multitpu_remote_launcher.py --tpu_name my-pod \
-          --tpu_zone us-central2-b -- python train.py --bf16
+No coordinator env is needed on a real TPU pod: with the
+``ACCELERATE_TPU_POD=1`` marker, ``PartialState`` calls
+``jax.distributed.initialize()`` bare and JAX discovers the coordinator and
+each host's process index from TPU-VM metadata.
+
+Run (prints the gcloud command; add --run to execute it):
+    python examples/multitpu_remote_launcher.py --tpu_name my-pod \
+        --tpu_zone us-central2-b -- accelerate-tpu launch train.py
 """
 
 import argparse
@@ -16,9 +21,8 @@ def main():
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--tpu_name", required=True)
     parser.add_argument("--tpu_zone", required=True)
-    parser.add_argument("--num_machines", type=int, default=None,
-                        help="hosts in the pod (default: let gcloud target all workers)")
-    parser.add_argument("--main_process_port", type=int, default=8476)
+    parser.add_argument("--run", action="store_true",
+                        help="Execute the gcloud fan-out (default: print it)")
     parser.add_argument("cmd", nargs=argparse.REMAINDER,
                         help="command to run on every worker (prefix with --)")
     args = parser.parse_args()
@@ -26,25 +30,17 @@ def main():
     if not cmd:
         parser.error("pass the training command after --")
 
-    # Worker 0's internal address doubles as the coordinator; each worker
-    # learns its rank from the gcloud worker index env.
-    inner = (
-        "ACCELERATE_COORDINATOR_ADDRESS=${TPU_WORKER_0_IP}:%d "
-        "ACCELERATE_PROCESS_ID=${TPU_WORKER_ID} "
-        % args.main_process_port
-    ) + shlex.join(cmd)
-
     from accelerate_tpu.commands.tpu import tpu_command
 
     ns = argparse.Namespace(
         config_file=None,
         tpu_name=args.tpu_name,
         tpu_zone=args.tpu_zone,
-        command=[inner],
+        command=["ACCELERATE_TPU_POD=1 " + shlex.join(cmd)],
         command_file=None,
         install_accelerate=False,
         accelerate_version="latest",
-        debug=True,  # print the gcloud fan-out; drop for a real pod
+        debug=not args.run,
     )
     tpu_command(ns)
 

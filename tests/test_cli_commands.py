@@ -90,3 +90,44 @@ def test_from_accelerate_command_writes_yaml(tmp_path):
     assert data["distributed_type"] == "TPU_JAX"
     with pytest.raises(FileExistsError):
         from_accelerate_command(args)
+
+
+def test_merge_weights_numeric_shard_order(tmp_path):
+    """12 shards must concatenate in rank order, not lexicographic (10 < 2)."""
+    import argparse
+    import json
+
+    import numpy as np
+    from safetensors.numpy import load_file, save_file
+
+    from accelerate_tpu.commands.merge import merge_command
+
+    in_dir, out_dir = tmp_path / "in", tmp_path / "out"
+    in_dir.mkdir()
+    n = 12
+    for r in range(n):
+        save_file(
+            {"w": np.full((2, 3), float(r), np.float32)},
+            str(in_dir / f"model_shard_{r}.safetensors"),
+        )
+    (in_dir / "shard_index.json").write_text(json.dumps({"w": {"concat_axis": 0}}))
+    merge_command(argparse.Namespace(checkpoint_dir=str(in_dir), output_path=str(out_dir)))
+    merged = load_file(str(out_dir / "model.safetensors"))["w"]
+    expected = np.concatenate([np.full((2, 3), float(r), np.float32) for r in range(n)], axis=0)
+    np.testing.assert_array_equal(merged, expected)
+
+
+def test_launch_env_carries_deepspeed_config(tmp_path):
+    """--deepspeed_config_file flows into the worker env contract."""
+    import argparse
+
+    from accelerate_tpu.commands.config import ClusterConfig
+    from accelerate_tpu.commands.launch import _merge, build_env, launch_command_parser
+
+    parser = launch_command_parser()
+    ds = tmp_path / "ds.json"
+    ds.write_text("{}")
+    args = parser.parse_args(["--deepspeed_config_file", str(ds), "script.py"])
+    env = build_env(_merge(args, ClusterConfig()))
+    assert env["ACCELERATE_USE_DEEPSPEED"] == "true"
+    assert env["ACCELERATE_DEEPSPEED_CONFIG_FILE"] == str(ds)

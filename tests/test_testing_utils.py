@@ -149,3 +149,17 @@ def test_test_sync_script():
         timeout=240,
     )
     assert "test_sync: success" in out.stdout
+
+
+def test_shipped_distributed_data_loop_script():
+    """The launchable test_distributed_data_loop payload passes in-process
+    (reference ships test_distributed_data_loop.py the same way, §2.10)."""
+    from accelerate_tpu.test_utils.scripts import test_distributed_data_loop as script
+
+    script.main()
+
+
+def test_shipped_merge_weights_script():
+    from accelerate_tpu.test_utils.scripts import test_merge_weights as script
+
+    script.main()
