@@ -55,11 +55,15 @@ def merge_command(args):
     if os.path.isdir(in_dir) and _is_orbax_checkpoint(in_dir):
         return _merge_orbax(in_dir, out_dir)
     # Numeric rank order — lexicographic would interleave shard 10 before 2
-    # and silently scramble the concatenation.
-    shard_files = sorted(
-        (f for f in os.listdir(in_dir) if f.startswith("model_shard_") and f.endswith(".safetensors")),
-        key=lambda f: int(f[len("model_shard_"):-len(".safetensors")]),
+    # and silently scramble the concatenation.  The regex also keeps stray
+    # non-rank files (model_shard_backup.safetensors) out of the merge.
+    import re
+
+    shard_matches = sorted(
+        (m for m in (re.fullmatch(r"model_shard_(\d+)\.safetensors", f) for f in os.listdir(in_dir)) if m),
+        key=lambda m: int(m.group(1)),
     )
+    shard_files = [m.group(0) for m in shard_matches]
     if not shard_files:
         # Already consolidated: copy through.
         src = os.path.join(in_dir, "model.safetensors")

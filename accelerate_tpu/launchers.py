@@ -56,6 +56,9 @@ def notebook_launcher(
     """
     import jax
 
+    from .state import honor_cpu_platform_env
+
+    honor_cpu_platform_env()
     platform = jax.default_backend()
     if platform in ("tpu", "axon") or not num_processes or num_processes <= 1:
         with patch_environment(ACCELERATE_MIXED_PRECISION=mixed_precision):

@@ -50,6 +50,27 @@ def is_initialized() -> bool:
     return AcceleratorState._shared_state != {}
 
 
+def honor_cpu_platform_env() -> None:
+    """Force the CPU platform when the environment explicitly asks for it
+    (``JAX_PLATFORMS=cpu``) but the jax config says otherwise.
+
+    Some images install a sitecustomize that rewrites ``jax_platforms`` to a
+    device platform at import, overriding the env var — and probing an
+    unreachable tunneled device can block forever, so the env request must win
+    BEFORE the first backend probe.  Safe any time: clear_backends re-probes
+    on next use."""
+    if os.environ.get("JAX_PLATFORMS", "").strip() != "cpu":
+        return
+    if (jax.config.jax_platforms or "") != "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            from jax.extend.backend import clear_backends
+
+            clear_backends()
+        except Exception:
+            pass
+
+
 def _probe_platform() -> str:
     try:
         return jax.default_backend()
@@ -98,20 +119,9 @@ class PartialState:
         # too: some images install a sitecustomize that rewrites the jax
         # config to a device platform at import (overriding the env var), and
         # probing an unreachable tunneled device can block forever.
-        if cpu or os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-            # Force CPU even when the environment pre-selects a device platform
-            # (e.g. a tunneled-TPU image exporting JAX_PLATFORMS): setdefault
-            # alone would silently keep the accelerator.  Safe before first
-            # backend use; afterwards clear_backends re-probes on next use.
+        if cpu:
             os.environ["JAX_PLATFORMS"] = "cpu"
-            if (jax.config.jax_platforms or "") != "cpu":
-                jax.config.update("jax_platforms", "cpu")
-                try:
-                    from jax.extend.backend import clear_backends
-
-                    clear_backends()
-                except Exception:
-                    pass
+        honor_cpu_platform_env()
 
         self._maybe_init_distributed(init_kwargs)
 

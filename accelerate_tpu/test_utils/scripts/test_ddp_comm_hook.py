@@ -1,0 +1,63 @@
+"""Launchable comm-hook check (reference
+``test_utils/scripts/test_ddp_comm_hook.py``): train the regression fixture
+under each gradient-communication hook ("no"/"fp16"/"bf16") and assert the
+final weights agree — reduced-precision gradient STORAGE must not change
+where training converges (bf16 holds ~3 decimal digits; the fixture's
+gradients are O(1)).
+
+Run standalone or through the launcher:
+    accelerate-tpu launch -m accelerate_tpu.test_utils.scripts.test_ddp_comm_hook
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import torch
+from torch.utils.data import DataLoader
+
+
+def _train(comm_hook: str) -> float:
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModelWithLoss
+    from accelerate_tpu.utils import DistributedDataParallelKwargs, set_seed
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    accelerator = Accelerator(
+        kwargs_handlers=[DistributedDataParallelKwargs(comm_hook=comm_hook)]
+    )
+    set_seed(42)
+
+    def collate(items):
+        return {
+            "x": torch.stack([torch.as_tensor(i["x"], dtype=torch.float32) for i in items]),
+            "y": torch.stack([torch.as_tensor(i["y"], dtype=torch.float32) for i in items]),
+        }
+
+    dl = DataLoader(list(RegressionDataset(length=64)), batch_size=16, collate_fn=collate)
+    model = RegressionModelWithLoss()
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    model, opt, dl = accelerator.prepare(model, opt, dl)
+    for _ in range(3):
+        for batch in dl:
+            out = model(x=batch["x"], y=batch["y"])
+            accelerator.backward(out.loss)
+            opt.step()
+            opt.zero_grad()
+    return float(np.asarray(model.params["a"]))
+
+
+def main():
+    results = {hook: _train(hook) for hook in ("no", "fp16", "bf16")}
+    baseline = results["no"]
+    for hook, value in results.items():
+        assert abs(value - baseline) < 5e-2, (hook, value, baseline)
+    from accelerate_tpu.state import PartialState
+
+    PartialState().print(f"test_ddp_comm_hook: converged equally under {results}")
+
+
+if __name__ == "__main__":
+    main()

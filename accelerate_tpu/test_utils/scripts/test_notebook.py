@@ -1,0 +1,35 @@
+"""Launchable notebook_launcher check (reference
+``test_utils/scripts/test_notebook.py``): the in-process launch path must run
+the function with the env contract applied (single-host direct call), and the
+multi-process CPU form must build a real cluster.
+
+Run:  python -m accelerate_tpu.test_utils.scripts.test_notebook
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _payload(expected_world: int):
+    from accelerate_tpu.state import PartialState
+
+    state = PartialState()
+    assert state.num_processes == expected_world, (state.num_processes, expected_world)
+    assert os.environ.get("ACCELERATE_MIXED_PRECISION") == "bf16"
+    return state.process_index
+
+
+def main():
+    from accelerate_tpu.launchers import notebook_launcher
+
+    # Direct-call path (TPU host or num_processes<=1): env contract applied,
+    # function runs in this process.
+    result = notebook_launcher(_payload, args=(1,), num_processes=1, mixed_precision="bf16")
+    assert result == 0, result
+    assert "ACCELERATE_MIXED_PRECISION" not in os.environ  # env restored
+    print("test_notebook: direct-call path ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -163,3 +163,15 @@ def test_shipped_merge_weights_script():
     from accelerate_tpu.test_utils.scripts import test_merge_weights as script
 
     script.main()
+
+
+def test_shipped_ddp_comm_hook_script():
+    from accelerate_tpu.test_utils.scripts import test_ddp_comm_hook as script
+
+    script.main()
+
+
+def test_shipped_notebook_script():
+    from accelerate_tpu.test_utils.scripts import test_notebook as script
+
+    script.main()
