@@ -1,0 +1,81 @@
+"""Example-sync checking helpers.
+
+Parity target: reference ``test_utils/examples.py`` (145 LoC): keeps the
+``by_feature`` scripts and the canonical/complete examples from drifting
+apart.  The reference diffs extracted function bodies line-by-line; our
+``by_feature`` scripts additionally import the canonical module through
+``examples/by_feature/_base.py``, making most of the sync structural — these
+helpers cover the remaining textual checks (and keep the reference's API for
+migrated test suites).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+__all__ = [
+    "get_function_contents_by_name",
+    "clean_lines",
+    "compare_against_test",
+    "uses_base_loader",
+]
+
+
+def get_function_contents_by_name(lines: list, name: str) -> list:
+    """Source lines of ``def name`` up to the next top-level marker (reference
+    ``test_utils/examples.py:25``; accepts ``training_function`` or ``main``)."""
+    if name not in ("training_function", "main"):
+        raise ValueError(
+            f"Incorrect function name passed: {name}, choose either 'main' or 'training_function'"
+        )
+    out, started = [], False
+    for line in lines:
+        if not started and f"def {name}" in line:
+            started = True
+            out.append(line)
+            continue
+        if started:
+            if name == "training_function" and "def main" in line:
+                return out
+            if name == "main" and "if __name__" in line:
+                return out
+            out.append(line)
+    return out
+
+
+def clean_lines(lines: list) -> list:
+    """Drop comments and blank lines (reference ``examples.py:51``)."""
+    return [line for line in lines if not line.lstrip().startswith("#") and line != "\n"]
+
+
+def compare_against_test(
+    base_filename: str, feature_filename: str, parser_only: bool, secondary_filename: str = None
+) -> list:
+    """Lines the feature script ADDS relative to the base example (reference
+    ``examples.py:62``): the diff of cleaned ``main``/``training_function``
+    bodies.  ``secondary_filename`` removes lines already explained by a second
+    base (e.g. the complete example)."""
+    name = "main" if parser_only else "training_function"
+    with open(base_filename) as f:
+        base = clean_lines(get_function_contents_by_name(f.readlines(), name))
+    with open(feature_filename) as f:
+        feature = clean_lines(get_function_contents_by_name(f.readlines(), name))
+    diff = [line for line in feature if line not in base]
+    if secondary_filename is not None:
+        with open(secondary_filename) as f:
+            secondary = clean_lines(get_function_contents_by_name(f.readlines(), name))
+        diff = [line for line in diff if line not in secondary]
+    return diff
+
+
+def uses_base_loader(feature_filename: str) -> bool:
+    """True when a by_feature script routes through ``_base`` (our structural
+    sync mechanism: the canonical example is imported, not copied)."""
+    tree = ast.parse(open(feature_filename).read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "_base":
+            return True
+        if isinstance(node, ast.Import) and any(a.name == "_base" for a in node.names):
+            return True
+    return False

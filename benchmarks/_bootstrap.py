@@ -1,0 +1,13 @@
+"""Shared benchmark bootstrap: make the repo importable when run as
+``python benchmarks/foo.py`` and honor an explicit JAX_PLATFORMS=cpu before
+the first backend probe.  ``import _bootstrap`` as the first line of every
+benchmark (benchmarks/ is sys.path[0] for direct script runs)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from accelerate_tpu.state import honor_cpu_platform_env
+
+honor_cpu_platform_env()

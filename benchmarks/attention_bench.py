@@ -7,6 +7,8 @@ Run:  python benchmarks/attention_bench.py [--batch 4 --seq 2048 --heads 16 --kv
 from __future__ import annotations
 
 import argparse
+
+import _bootstrap  # noqa: F401  (repo path + platform-env handling)
 import json
 import time
 

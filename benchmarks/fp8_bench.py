@@ -1,0 +1,103 @@
+"""fp8 vs bf16 training throughput (the reference's ``benchmarks/fp8``
+suite compares TE/torchao/MS-AMP convergence+speed against bf16; the native
+equivalent compares the XLA float8 scaled-matmul path of ``ops/fp8.py``).
+
+Prints one JSON line per precision plus the speedup ratio, and checks the
+fp8 loss trajectory stays within tolerance of bf16 (convergence parity — the
+reference's fp8 benchmarks are loss-parity scripts first).
+
+Run:  python benchmarks/fp8_bench.py [--hidden 2048 --layers 4 --steps 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import time
+
+import _bootstrap  # noqa: F401  (repo path + platform-env handling)
+
+
+def run(precision: str, args) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from accelerate_tpu.models import llama
+
+    cfg = llama.LlamaConfig(
+        vocab_size=32000,
+        hidden_size=args.hidden,
+        intermediate_size=4 * args.hidden,
+        num_layers=args.layers,
+        num_heads=max(args.hidden // 128, 1),
+        num_kv_heads=max(args.hidden // 256, 1),
+        max_seq_len=args.seq,
+        remat=True,
+        attention_impl="auto",
+        remat_policy="dots",
+        fp8=(precision == "fp8"),
+    )
+    params = llama.init_params(cfg, jax.random.key(0))
+    tx = optax.adamw(1e-4)
+    opt_state = tx.init(params)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": jnp.asarray(rng.integers(0, cfg.vocab_size, (args.batch, args.seq)).astype(np.int32))}
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(llama.loss_fn)(params, batch, cfg)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    losses = []
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, batch)
+    jax.device_get(loss)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        params, opt_state, loss = step(params, opt_state, batch)
+        losses.append(loss)
+    jax.device_get(loss)
+    dt = (time.perf_counter() - t0) / args.steps
+    losses = [float(np.asarray(jax.device_get(l))) for l in losses]
+    return {
+        "precision": precision,
+        "step_ms": round(dt * 1e3, 2),
+        "tokens_per_sec": round(args.batch * args.seq / dt, 1),
+        "final_loss": round(losses[-1], 4),
+        "losses": [round(l, 4) for l in losses[:: max(args.steps // 5, 1)]],
+    }
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--hidden", type=int, default=2048)
+    parser.add_argument("--layers", type=int, default=4)
+    parser.add_argument("--batch", type=int, default=4)
+    parser.add_argument("--seq", type=int, default=1024)
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--loss_tolerance", type=float, default=0.15,
+                        help="max |fp8 - bf16| final-loss gap (convergence parity)")
+    args = parser.parse_args()
+
+    bf16 = run("bf16", args)
+    print(json.dumps(bf16))
+    fp8 = run("fp8", args)
+    print(json.dumps(fp8))
+    gap = abs(fp8["final_loss"] - bf16["final_loss"])
+    print(json.dumps({
+        "metric": "fp8_speedup",
+        "value": round(bf16["step_ms"] / fp8["step_ms"], 3),
+        "unit": "x_vs_bf16",
+        "loss_gap": round(gap, 4),
+        "converged": gap <= args.loss_tolerance,
+    }))
+    if gap > args.loss_tolerance:
+        raise SystemExit(f"fp8 loss diverged from bf16 by {gap}")
+
+
+if __name__ == "__main__":
+    main()

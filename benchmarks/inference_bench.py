@@ -11,6 +11,8 @@ Run:  python benchmarks/inference_bench.py [--hidden 2048 --layers 6 --prompt 12
 from __future__ import annotations
 
 import argparse
+
+import _bootstrap  # noqa: F401  (repo path + platform-env handling)
 import json
 import time
 

@@ -117,3 +117,37 @@ def test_distributed_generation_example(capsys):
     )
     mod.main()
     assert "8 completions" in capsys.readouterr().out
+
+
+def test_by_feature_scripts_stay_in_sync():
+    """Reference parity (tests/test_examples.py AST-diff mechanism): every
+    by_feature script must route through _base (structural sync with the
+    canonical example) — nothing is allowed to copy the training loop."""
+    from accelerate_tpu.test_utils.examples import compare_against_test, uses_base_loader
+
+    by_feature = os.path.join(EXAMPLES, "by_feature")
+    # Scripts whose feature IS a different model/loop (causal-LM pretraining,
+    # megatron dialect, schedule-free optimizer, FSDP memory tracking) — the
+    # reference likewise exempts its non-canonical scripts from the AST diff.
+    exempt = {
+        "fsdp_with_peak_mem_tracking.py",
+        "megatron_lm_gpt_pretraining.py",
+        "schedule_free.py",
+        "gradient_accumulation_for_autoregressive_models.py",
+    }
+    scripts = [f for f in os.listdir(by_feature) if f.endswith(".py") and f != "_base.py"]
+    assert len(scripts) >= 15
+    missing = [
+        f for f in scripts if f not in exempt and not uses_base_loader(os.path.join(by_feature, f))
+    ]
+    assert not missing, f"by_feature scripts not importing _base: {missing}"
+
+    # Textual-diff helper sanity: identical files diff to nothing; the
+    # complete example's diff against the canonical surfaces its feature
+    # delta (checkpoint saves).
+    nlp = os.path.join(EXAMPLES, "nlp_example.py")
+    assert compare_against_test(nlp, nlp, parser_only=False) == []
+    diff = compare_against_test(
+        nlp, os.path.join(EXAMPLES, "complete_nlp_example.py"), parser_only=False
+    )
+    assert "save_state" in "".join(diff)
