@@ -601,6 +601,16 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
         )
         self.slice_fn = slice_fn or slice_tensors
         self.iteration = 0
+        # Micro-batches assembled per step: batch-size semantics must match the
+        # shard path (script batch_size is PER data shard — reference
+        # ``_fetch_batches`` reads num_processes batches; device shards are the
+        # "processes" of the mesh).  Without a mesh this is the host count.
+        if split_batches:
+            self._num_parts = 1
+        elif self._placer is not None and self._placer.num_data_shards > 1:
+            self._num_parts = self._placer.num_data_shards
+        else:
+            self._num_parts = max(self.state.num_processes, 1)
 
     @property
     def dataset(self):
@@ -609,13 +619,13 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
     def __len__(self):
         n = len(self.base_loader)
         if not self.split_batches:
-            n = math.ceil(n / self.state.num_processes)
+            n = math.ceil(n / self._num_parts)
         return n - self.skip_batches
 
     @property
     def total_batch_size(self) -> int:
         bs = getattr(self.base_loader, "batch_size", 1) or 1
-        return bs if self.split_batches else bs * self.state.num_processes
+        return bs if self.split_batches else bs * self._num_parts
 
     @property
     def total_dataset_length(self) -> int:
@@ -629,8 +639,8 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
             self.dataset.set_epoch(epoch)
 
     def _fetch_global_batch(self, iterator):
-        """Process 0 assembles the global batch (num_processes micro-batches unless
-        split_batches) and broadcasts structure + payload."""
+        """Process 0 assembles the global batch (one micro-batch per data shard
+        unless split_batches) and broadcasts structure + payload."""
         from .utils.operations import broadcast_object_list, concatenate
 
         stop = False
@@ -641,7 +651,7 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
                     batch = next(iterator)
                 else:
                     parts = []
-                    for _ in range(self.state.num_processes):
+                    for _ in range(self._num_parts):
                         try:
                             parts.append(next(iterator))
                         except StopIteration:

@@ -41,6 +41,9 @@ def get_function_contents_by_name(lines: list, name: str) -> list:
             if name == "main" and "if __name__" in line:
                 return out
             out.append(line)
+    if not out:
+        # A missing function must FAIL the sync check, not diff as empty.
+        raise ValueError(f"no `def {name}` found in the given source lines")
     return out
 
 

@@ -87,23 +87,17 @@ def test_join_uneven_inputs_warns():
 
 
 def test_dispatch_mode_matches_shard_mode():
-    """Dispatcher (rank-0 reads + broadcast) must deliver the same ordered
-    sample STREAM as per-process sharding.  Batch shaping differs by design:
-    shard mode scales the global batch by the data-shard count, the
-    dispatcher keeps the loader's batch and pads each to shard divisibility —
-    so compare deduplicated sample order, not shapes."""
+    """Dispatcher (rank-0 reads + broadcast) must deliver the same batches as
+    per-process sharding: both scale the script's per-shard batch_size by the
+    data-shard count (the dispatcher assembles one micro-batch per shard,
+    reference ``_fetch_batches``)."""
 
-    def stream(acc):
-        seen, out = set(), []
-        for b in acc.prepare(DataLoader(_dataset(16), batch_size=4)):
-            for v in np.asarray(b[0]).ravel().tolist():
-                if v not in seen:  # drop divisibility-padding duplicates
-                    seen.add(v)
-                    out.append(v)
-        return out
+    def batches(acc):
+        return [np.asarray(b[0]).ravel().tolist() for b in acc.prepare(
+            DataLoader(_dataset(16), batch_size=4))]
 
-    shard_vals = stream(_make_accelerator(dispatch_batches=False))
-    disp_vals = stream(_make_accelerator(dispatch_batches=True))
+    shard_vals = batches(_make_accelerator(dispatch_batches=False))
+    disp_vals = batches(_make_accelerator(dispatch_batches=True))
     assert shard_vals == disp_vals, (shard_vals, disp_vals)
     print("dispatcher parity ok")
 

@@ -272,3 +272,23 @@ def test_device_transfer_prefetched_one_ahead():
     # Batch 1 must be converted before batch 0 is yielded, etc.
     for n in range(1, 4):
         assert events.index(("convert", n)) < events.index(("yield", n - 1))
+
+
+def test_dispatcher_scales_batch_by_data_shards():
+    """Batch-size semantics parity with the shard path: the script's
+    batch_size is PER data shard, so on the 8-device mesh the dispatcher
+    assembles 8 micro-batches into one global batch per step."""
+    import jax
+
+    AcceleratorState()
+    mesh = AcceleratorState().mesh
+    dl = DataLoaderDispatcher(_make_loader(64, 4), put_on_device=True, mesh=mesh)
+    n_shards = jax.device_count()
+    assert dl.total_batch_size == 4 * n_shards
+    batches = list(dl)
+    assert len(batches) == 64 // (4 * n_shards), len(batches)
+    first = batches[0]
+    arr = first[0] if isinstance(first, (list, tuple)) else first
+    import numpy as np
+
+    assert np.asarray(arr).shape[0] == 4 * n_shards
