@@ -601,13 +601,12 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
         )
         self.slice_fn = slice_fn or slice_tensors
         self.iteration = 0
-        # Micro-batches assembled per step: batch-size semantics must match the
-        # shard path (script batch_size is PER data shard — reference
-        # ``_fetch_batches`` reads num_processes batches; device shards are the
-        # "processes" of the mesh).  Without a mesh this is the host count.
-        if split_batches:
-            self._num_parts = 1
-        elif self._placer is not None and self._placer.num_data_shards > 1:
+        # Micro-batches assembled per step (only consulted when not
+        # split_batches): batch-size semantics must match the shard path
+        # (script batch_size is PER data shard — reference ``_fetch_batches``
+        # reads num_processes batches; device shards are the "processes" of
+        # the mesh).  Without a mesh this is the host count.
+        if self._placer is not None and self._placer.num_data_shards > 1:
             self._num_parts = self._placer.num_data_shards
         else:
             self._num_parts = max(self.state.num_processes, 1)

@@ -1,0 +1,59 @@
+"""Import hygiene.
+
+Parity target: reference ``tests/test_imports.py`` (import-time budget): the
+package import must stay cheap and must NOT eagerly pull heavy optional
+dependencies or initialize a JAX backend."""
+
+import subprocess
+import sys
+
+import pytest
+
+
+def _run(code: str) -> str:
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=240,
+        cwd="/root/repo",
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu", "HOME": "/root"},
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    return out.stdout
+
+
+def test_import_does_not_pull_heavy_optionals():
+    """`import accelerate_tpu` must not import torch, transformers, orbax,
+    tensorboard, or any tracker backend (they load lazily at use)."""
+    heavy = ["torch", "transformers", "orbax", "tensorboard", "wandb", "mlflow", "flax"]
+    out = _run(
+        "import sys\n"
+        "import accelerate_tpu\n"
+        f"print([m for m in {heavy!r} if m in sys.modules])\n"
+    )
+    assert out.strip() == "[]", f"heavy modules imported eagerly: {out}"
+
+
+def test_import_does_not_initialize_backend():
+    """Importing the package must not create a JAX backend client (that would
+    lock the platform choice before PartialState can steer it)."""
+    out = _run(
+        "import accelerate_tpu\n"
+        "from jax._src import xla_bridge\n"
+        "print(xla_bridge._backends)\n"
+    )
+    assert out.strip() == "{}", f"backend initialized at import: {out}"
+
+
+def test_import_time_budget():
+    """Wall-clock budget for `import accelerate_tpu` (the reference enforces
+    one with import_timer); generous bound to stay CI-stable."""
+    out = _run(
+        "import time\n"
+        "t0 = time.perf_counter()\n"
+        "import accelerate_tpu\n"
+        "print(time.perf_counter() - t0)\n"
+    )
+    seconds = float(out.strip())
+    assert seconds < 20.0, f"import took {seconds:.1f}s"
