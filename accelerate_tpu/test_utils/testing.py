@@ -212,16 +212,9 @@ require_pippy = _require_import(_imports.is_pippy_available, "pipeline inference
 require_import_timer = _require_import(_imports.is_import_timer_available, "import timer")
 
 
-def require_multi_gpu(test_case):
-    """Reference semantics: gate on >1 CUDA device (always skips on a TPU
-    host — use require_multi_device for mesh tests)."""
-    try:
-        import torch
-
-        ok = torch.cuda.device_count() > 1
-    except ImportError:
-        ok = False
-    return unittest.skipUnless(ok, "test requires multiple CUDA devices")(test_case)
+require_multi_gpu = _require_import(
+    _imports.is_multi_gpu_available, "multiple CUDA devices"
+)  # reference semantics: CUDA count — use require_multi_device for mesh tests
 
 
 def require_huggingface_suite(test_case):

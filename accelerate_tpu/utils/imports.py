@@ -44,6 +44,7 @@ __all__ = [
     "is_fp16_available",
     "is_fp8_available",
     "is_cuda_available",
+    "is_multi_gpu_available",
     "is_mps_available",
     "is_npu_available",
     "is_mlu_available",
@@ -250,6 +251,10 @@ def _torch_backend_available(probe) -> bool:
 
 def is_cuda_available() -> bool:
     return _torch_backend_available(lambda: __import__("torch").cuda.is_available())
+
+
+def is_multi_gpu_available() -> bool:
+    return _torch_backend_available(lambda: __import__("torch").cuda.device_count() > 1)
 
 
 def is_mps_available(min_version: str | None = None) -> bool:

@@ -4,14 +4,11 @@ Parity target: reference ``tests/test_hooks.py`` (459 LoC): the ModelHook
 protocol, forward wrapping, append/sequential composition, detach/restore,
 device alignment, and layerwise casting."""
 
-import numpy as np
-import pytest
 import torch
 
 from accelerate_tpu.hooks import (
     AlignDevicesHook,
     CpuOffload,
-    LayerwiseCastingHook,
     ModelHook,
     SequentialHook,
     add_hook_to_module,

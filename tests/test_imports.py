@@ -4,20 +4,23 @@ Parity target: reference ``tests/test_imports.py`` (import-time budget): the
 package import must stay cheap and must NOT eagerly pull heavy optional
 dependencies or initialize a JAX backend."""
 
+import os
 import subprocess
 import sys
 
-import pytest
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _run(code: str) -> str:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
     out = subprocess.run(
         [sys.executable, "-c", code],
         capture_output=True,
         text=True,
         timeout=240,
-        cwd="/root/repo",
-        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu", "HOME": "/root"},
+        cwd=REPO_ROOT,
+        env=env,
     )
     assert out.returncode == 0, out.stderr[-2000:]
     return out.stdout
@@ -37,13 +40,23 @@ def test_import_does_not_pull_heavy_optionals():
 
 def test_import_does_not_initialize_backend():
     """Importing the package must not create a JAX backend client (that would
-    lock the platform choice before PartialState can steer it)."""
+    lock the platform choice before PartialState can steer it).  The backend
+    registry is jax-internal; if a jax upgrade moves it, report SKIP rather
+    than failing for an unrelated reason."""
     out = _run(
         "import accelerate_tpu\n"
-        "from jax._src import xla_bridge\n"
-        "print(xla_bridge._backends)\n"
+        "try:\n"
+        "    from jax._src import xla_bridge\n"
+        "    print('initialized' if xla_bridge._backends else 'clean')\n"
+        "except AttributeError:\n"
+        "    print('SKIP')\n"
     )
-    assert out.strip() == "{}", f"backend initialized at import: {out}"
+    value = out.strip()
+    if value == "SKIP":
+        import pytest
+
+        pytest.skip("jax internal backend registry moved")
+    assert value == "clean", f"backend initialized at import: {out}"
 
 
 def test_import_time_budget():

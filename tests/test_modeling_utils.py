@@ -1,0 +1,124 @@
+"""Modeling-utils toolkit tests.
+
+Parity target: reference ``tests/test_modeling_utils.py`` (1047 LoC) for the
+helpers around the device-map planner: tied parameters, size calculators,
+offload loaders, state-dict cleaning, and dtype helpers."""
+
+import numpy as np
+import pytest
+import torch
+
+from accelerate_tpu.utils.modeling import (
+    calculate_maximum_sizes,
+    check_tied_parameters_on_same_device,
+    clean_state_dict_for_safetensors,
+    compute_module_sizes,
+    convert_file_size_to_int,
+    dtype_byte_size,
+    extract_submodules_state_dict,
+    find_device,
+    find_tied_parameters,
+    get_max_layer_size,
+    id_tensor_storage,
+    load_offloaded_weights,
+    load_state_dict,
+    retie_parameters,
+)
+
+
+class TiedModel(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.embed = torch.nn.Linear(8, 4, bias=False)
+        self.head = torch.nn.Linear(8, 4, bias=False)
+        self.head.weight = self.embed.weight  # tie
+
+
+def test_find_and_retie_tied_parameters():
+    model = TiedModel()
+    tied = find_tied_parameters(model)
+    flat = sorted(p for group in tied for p in group)
+    assert flat == ["embed.weight", "head.weight"], tied
+    # Break the tie (hook attachment does this), then restore it.
+    model.head.weight = torch.nn.Parameter(model.embed.weight.detach().clone())
+    assert model.head.weight is not model.embed.weight
+    retie_parameters(model, tied)
+    assert model.head.weight is model.embed.weight
+
+
+def test_id_tensor_storage_identifies_shared_storage():
+    a = torch.zeros(4)
+    view = a[:2]
+    b = torch.zeros(4)
+    assert id_tensor_storage(a) == id_tensor_storage(view)
+    assert id_tensor_storage(a) != id_tensor_storage(b)
+
+
+def test_clean_state_dict_for_safetensors_drops_duplicates():
+    model = TiedModel()
+    sd = model.state_dict(keep_vars=True)
+    cleaned = clean_state_dict_for_safetensors(dict(sd))
+    assert len(cleaned) == 1  # one of the two tied entries dropped
+    assert all(t.is_contiguous() for t in cleaned.values())
+
+
+def test_check_tied_parameters_on_same_device_warns(caplog):
+    import logging
+
+    with caplog.at_level(logging.WARNING):
+        check_tied_parameters_on_same_device(
+            [["embed.weight", "head.weight"]], {"embed": "tpu", "head": "disk"}
+        )
+    assert any("different devices" in r.message for r in caplog.records)
+
+
+def test_size_calculators():
+    model = torch.nn.Sequential(torch.nn.Linear(4, 4), torch.nn.Linear(4, 4))
+    sizes = compute_module_sizes(model)
+    total, (largest, names) = calculate_maximum_sizes(model)
+    assert total == sizes[""] == 2 * (4 * 4 + 4) * 4  # fp32 bytes
+    assert largest == (4 * 4 + 4) * 4 and len(names) == 2  # both layers tie
+    max_size, layer_names = get_max_layer_size(list(model.named_children()), sizes, [])
+    assert max_size == largest
+
+
+def test_convert_file_size_and_dtype_bytes():
+    assert convert_file_size_to_int("1GiB") == 1024**3
+    assert convert_file_size_to_int("500MB") == 500 * 10**6
+    assert dtype_byte_size(torch.bfloat16) == 2
+    assert dtype_byte_size(torch.bool) == pytest.approx(1 / 8)
+
+
+def test_find_device_mixed_containers():
+    import jax.numpy as jnp
+
+    assert str(find_device({"a": [torch.zeros(1)]})) == "cpu"
+    dev = find_device((jnp.zeros(1),))
+    assert dev is not None and dev.platform in ("cpu", "tpu")
+    assert find_device({"n": 3}) is None
+
+
+def test_load_offloaded_weights_roundtrip(tmp_path):
+    from accelerate_tpu.utils.offload import offload_weight, save_offload_index
+
+    model = torch.nn.Linear(3, 3, bias=False)
+    target = np.full((3, 3), 7.0, np.float32)
+    index = offload_weight(torch.from_numpy(target), "weight", str(tmp_path), {})
+    save_offload_index(index, str(tmp_path))
+    load_offloaded_weights(model, index, str(tmp_path))
+    np.testing.assert_array_equal(model.weight.detach().numpy(), target)
+
+
+def test_extract_submodules_state_dict():
+    sd = {"enc.w": 1, "enc.b": 2, "dec.w": 3, "enc": 4}
+    out = extract_submodules_state_dict(sd, ["enc"])
+    assert out == {"w": 1, "b": 2, "": 4}
+
+
+def test_load_state_dict_safetensors(tmp_path):
+    from safetensors.numpy import save_file
+
+    path = str(tmp_path / "w.safetensors")
+    save_file({"w": np.arange(4, dtype=np.float32)}, path)
+    sd = load_state_dict(path)
+    np.testing.assert_array_equal(sd["w"], np.arange(4, dtype=np.float32))
