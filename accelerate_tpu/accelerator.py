@@ -25,8 +25,6 @@ model to a pure JAX function and the imperative loop drives *compiled* steps:
 from __future__ import annotations
 
 import contextlib
-import functools
-import math
 import os
 import warnings
 from typing import Any, Callable, Optional, Union
@@ -39,14 +37,13 @@ import jax.numpy as jnp
 from .data_loader import DataLoaderDispatcher, DataLoaderShard, prepare_data_loader, skip_first_batches
 from .optimizer import AcceleratedOptimizer
 from .scheduler import AcceleratedScheduler
-from .state import AcceleratorState, GradientState, PartialState
+from .state import AcceleratorState, GradientState
 from .utils.dataclasses import (
     DataLoaderConfiguration,
     DistributedType,
     FullyShardedDataParallelPlugin,
     GradientAccumulationPlugin,
     KwargsHandler,
-    MixedPrecisionPolicy,
     ParallelismConfig,
     ProfileKwargs,
     ProjectConfiguration,
@@ -54,17 +51,12 @@ from .utils.dataclasses import (
 )
 from .utils.imports import is_torch_available
 from .utils.operations import (
-    broadcast,
-    broadcast_object_list,
-    concatenate,
     convert_to_fp32,
-    find_batch_size,
     gather,
     gather_object,
     pad_across_processes,
     recursively_apply,
     reduce,
-    send_to_device,
     to_jax,
     to_numpy,
 )
@@ -1057,7 +1049,7 @@ class Accelerator:
     # -- trackers (minimal; full suite in tracking.py) ------------------------
 
     def init_trackers(self, project_name: str, config=None, init_kwargs=None):
-        from .tracking import filter_trackers, init_trackers
+        from .tracking import init_trackers
 
         self.trackers = init_trackers(self.log_with, project_name, config, init_kwargs, self)
 

@@ -19,7 +19,6 @@ import os
 from typing import Optional, Union
 
 from .hooks import (
-    AlignDevicesHook,
     CpuOffload,
     UserCpuOffloadHook,
     add_hook_to_module,
@@ -28,9 +27,7 @@ from .hooks import (
 )
 from .utils.modeling import (
     check_device_map,
-    compute_module_sizes,
     get_balanced_memory,
-    get_max_memory,
     infer_auto_device_map,
     load_checkpoint_in_model,
 )

@@ -7,9 +7,8 @@ questionnaire; the config file feeds ``launch`` exactly like the reference's.
 
 from __future__ import annotations
 
-import argparse
 import os
-from dataclasses import asdict, dataclass, field
+from dataclasses import asdict, dataclass
 from typing import Optional
 
 import yaml

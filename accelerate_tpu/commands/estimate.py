@@ -7,7 +7,6 @@ skeleton on the meta device, print total / largest-layer sizes per dtype
 
 from __future__ import annotations
 
-import argparse
 
 
 def _format_bytes(n: float) -> str:

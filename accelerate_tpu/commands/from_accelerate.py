@@ -15,7 +15,6 @@ choice onto the GSPMD mesh —
 
 from __future__ import annotations
 
-import argparse
 
 import yaml
 

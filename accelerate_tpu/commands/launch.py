@@ -24,7 +24,6 @@ import argparse
 import os
 import subprocess
 import sys
-from typing import Optional
 
 from .config import ClusterConfig, load_config
 

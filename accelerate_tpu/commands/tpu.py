@@ -7,7 +7,6 @@ dependencies / run setup on every worker of a pod slice.
 
 from __future__ import annotations
 
-import argparse
 import os
 import shutil
 import subprocess

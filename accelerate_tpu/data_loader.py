@@ -27,16 +27,14 @@ from __future__ import annotations
 import contextlib
 import math
 import warnings
-from typing import Any, Callable, Iterable, Iterator, Optional, Union
+from typing import Callable, Iterable, Iterator, Optional
 
 import numpy as np
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
 from .state import AcceleratorState, GradientState, PartialState
-from .utils.dataclasses import RNGType
 from .utils.imports import is_torch_available
 from .utils.operations import (
     find_batch_size,

@@ -15,7 +15,7 @@ loop) the hooks behave exactly like the reference's.
 from __future__ import annotations
 
 import functools
-from typing import Any, Mapping, Optional
+from typing import Mapping, Optional
 
 import numpy as np
 

@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import os
 import socket
-import sys
 import traceback
 from typing import Callable
 
@@ -61,9 +60,42 @@ def notebook_launcher(
     honor_cpu_platform_env()
     platform = jax.default_backend()
     if platform in ("tpu", "axon") or not num_processes or num_processes <= 1:
-        with patch_environment(ACCELERATE_MIXED_PRECISION=mixed_precision):
-            return function(*args)
-    return debug_launcher(function, args=args, num_processes=num_processes)
+        # Elastic retry (reference ``notebook_launcher(max_restarts=...)`` →
+        # torchelastic): re-invoke the function on failure up to max_restarts
+        # times.  JAX state is process-global, so restarts reuse the backend.
+        attempts = max(int(max_restarts), 0) + 1
+        last_exc = None
+        for attempt in range(attempts):
+            try:
+                with patch_environment(ACCELERATE_MIXED_PRECISION=mixed_precision):
+                    return function(*args)
+            except Exception as exc:  # noqa: BLE001 — elastic restart boundary
+                last_exc = exc
+                if attempt + 1 < attempts:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "notebook_launcher attempt %d/%d failed (%s); restarting",
+                        attempt + 1, attempts, exc,
+                    )
+        raise last_exc
+    # Multi-process path: same elastic semantics — each restart re-forms the
+    # whole worker cluster (torchelastic restarts the full group too).
+    attempts = max(int(max_restarts), 0) + 1
+    last_exc = None
+    for attempt in range(attempts):
+        try:
+            return debug_launcher(function, args=args, num_processes=num_processes)
+        except Exception as exc:  # noqa: BLE001 — elastic restart boundary
+            last_exc = exc
+            if attempt + 1 < attempts:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "notebook_launcher cluster attempt %d/%d failed (%s); restarting",
+                    attempt + 1, attempts, exc,
+                )
+    raise last_exc
 
 
 def _worker_entry(fn, args, env: dict, rank: int, queue):

@@ -13,10 +13,8 @@ already replicated — matching the reference on 1 process).
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
-import jax.numpy as jnp
 
 from .accelerator import Accelerator, PreparedModel
 

@@ -18,7 +18,6 @@ TPU-first choices:
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Any, Optional
 
 import numpy as np

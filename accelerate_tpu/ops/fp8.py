@@ -27,7 +27,6 @@ import contextlib
 import functools
 from typing import Any, Optional
 
-import numpy as np
 
 import jax
 import jax.numpy as jnp

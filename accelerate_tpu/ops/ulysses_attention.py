@@ -22,7 +22,6 @@ from __future__ import annotations
 import functools
 from typing import Optional
 
-import numpy as np
 
 import jax
 import jax.numpy as jnp

@@ -15,7 +15,7 @@ jitted step already reduced over data axes by GSPMD.
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -18,7 +18,7 @@ exchanging activations over NCCL P2P with a hand-written schedule:
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Sequence
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp

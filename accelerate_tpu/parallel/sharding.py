@@ -25,7 +25,7 @@ optimization auto-wrap exists for.
 from __future__ import annotations
 
 import re
-from typing import Any, Callable, Optional
+from typing import Any, Optional
 
 import numpy as np
 

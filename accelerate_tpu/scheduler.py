@@ -12,7 +12,6 @@ inject into the optax hyperparams — or (b) any callable ``step -> lr``.
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Union
 
 from .state import AcceleratorState, GradientState
 

@@ -22,7 +22,6 @@ from __future__ import annotations
 import contextlib
 import logging
 import os
-import threading
 from functools import partial, wraps
 from typing import Any, Callable, Optional
 

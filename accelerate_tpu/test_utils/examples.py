@@ -12,7 +12,6 @@ migrated test suites).
 from __future__ import annotations
 
 import ast
-import os
 
 __all__ = [
     "get_function_contents_by_name",

@@ -7,7 +7,6 @@ import numpy as np
 
 
 def check_cluster_formed(expected: int):
-    import jax
 
     from accelerate_tpu.state import PartialState
 

@@ -15,7 +15,7 @@ import functools
 import json
 import os
 import time
-from typing import Any, Optional, Union
+from typing import Optional
 
 from .logging import get_logger
 from .state import PartialState

@@ -11,14 +11,12 @@ from __future__ import annotations
 
 import copy
 import enum
-import functools
 import os
-import warnings
 from dataclasses import dataclass, field
 from datetime import timedelta
-from typing import Any, Callable, Iterable, Optional
+from typing import Any, Callable, Optional
 
-from .environment import parse_flag_from_env, str_to_bool
+from .environment import str_to_bool
 
 __all__ = [
     "DistributedType",
@@ -636,7 +634,19 @@ class PipelineParallelPlugin:
 
     pp_size: int = 1
     num_micro_batches: int = 1
-    schedule: str = "gpipe"  # "gpipe" | "1f1b" (round 2+)
+    schedule: str = "gpipe"
+
+    def __post_init__(self):
+        if self.schedule != "gpipe":
+            # Don't silently run a different schedule than requested.  The
+            # jitted pipeline differentiates the scan, so backward interleaving
+            # (1F1B) is an XLA scheduling concern, not a hand-written schedule;
+            # "gpipe" is the only explicit schedule.
+            raise ValueError(
+                f"schedule={self.schedule!r} is not supported: the compiled "
+                "pipeline runs a GPipe microbatch scan (backward is derived by "
+                "autodiff). Use schedule='gpipe'."
+            )
 
 
 @dataclass

@@ -13,7 +13,6 @@ scripts written against the reference keep working unmodified.
 from __future__ import annotations
 
 import os
-from typing import Any, Optional
 
 __all__ = [
     "save_fsdp_model",

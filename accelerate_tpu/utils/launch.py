@@ -13,8 +13,7 @@ keeps working.
 from __future__ import annotations
 
 import os
-import sys
-from typing import Any, Optional
+from typing import Any
 
 __all__ = [
     "PrepareForLaunch",

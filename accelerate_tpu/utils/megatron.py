@@ -309,7 +309,6 @@ class T5TrainStep(AbstractTrainStep):
         return get_batch
 
     def get_loss_func(self, accelerator=None):
-        from ..models import t5
 
         def loss_func(batch, logits):
             import jax.numpy as jnp

@@ -17,7 +17,6 @@ train step by GSPMD and never appear here.
 
 from __future__ import annotations
 
-import os
 import pickle
 from functools import wraps
 from typing import Any, Callable, Mapping, Optional

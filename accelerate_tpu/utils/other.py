@@ -5,7 +5,6 @@ wrapper (reference ``utils/tqdm.py``) and rich traceback installer
 
 from __future__ import annotations
 
-import os
 import platform
 import warnings
 from typing import Any, Optional

@@ -23,9 +23,8 @@ constraint torch.compile/XLA impose).
 from __future__ import annotations
 
 import collections
-import math
 import operator
-from typing import Any, Callable, Optional
+from typing import Any, Callable
 
 import numpy as np
 

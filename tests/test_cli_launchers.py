@@ -136,3 +136,24 @@ def test_launch_module_flag(tmp_path):
     )
     assert res.returncode == 0, res.stderr[-1500:]
     assert "MODULE_RAN bf16" in res.stdout
+
+
+def test_notebook_launcher_max_restarts():
+    """Elastic retry on the direct-call path: a function failing twice then
+    succeeding completes under max_restarts=2 and fails under 1."""
+    from accelerate_tpu.launchers import notebook_launcher
+
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    assert notebook_launcher(flaky, num_processes=1, max_restarts=2) == "ok"
+    assert calls["n"] == 3
+
+    calls["n"] = 0
+    with pytest.raises(RuntimeError, match="transient"):
+        notebook_launcher(flaky, num_processes=1, max_restarts=1)
