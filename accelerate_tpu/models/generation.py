@@ -46,12 +46,42 @@ def check_cache_room(index, new_tokens: int, max_len: int) -> None:
         )
 
 
-def select_token(logits: jax.Array, temperature: float, key, i) -> jax.Array:
-    """Greedy argmax (temperature<=0) or categorical sample at step ``i``."""
+def select_token(
+    logits: jax.Array,
+    temperature: float,
+    key,
+    i,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jax.Array:
+    """Greedy argmax (temperature<=0) or filtered categorical sample at step
+    ``i``.  ``top_k > 0`` keeps only the k highest logits; ``top_p < 1`` keeps
+    the smallest set of tokens whose cumulative probability reaches p (the
+    top-1 token is always kept).  Both are static, jit-friendly filters
+    (sort + mask — no dynamic shapes)."""
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    sorted_desc = None  # shared by the two filters — at most ONE vocab sort
+    if top_k > 0:
+        k = min(int(top_k), logits.shape[-1])
+        # Partial selection; the descending top-k values double as the sorted
+        # prefix for the top_p pass (masked-out tokens carry zero probability,
+        # so the softmax over the k survivors equals the full masked softmax).
+        sorted_desc = jax.lax.top_k(logits, k)[0]
+        logits = jnp.where(logits < sorted_desc[..., -1:], -jnp.inf, logits)
+    if top_p < 1.0:
+        if sorted_desc is None:
+            sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # A token is cut when the mass BEFORE it already reaches p (so the
+        # token that crosses the threshold is kept, and top-1 always is).
+        cut = (cum - probs) >= top_p
+        cutoff = jnp.min(jnp.where(cut, jnp.inf, sorted_desc), axis=-1, keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     step_key = jax.random.fold_in(key, i)
-    return jax.random.categorical(step_key, logits / temperature, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(step_key, logits, axis=-1).astype(jnp.int32)
 
 
 def generate_loop(
@@ -64,8 +94,14 @@ def generate_loop(
     temperature: float = 0.0,
     key: Optional[jax.Array] = None,
     max_len: Optional[int] = None,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ) -> jax.Array:
     """Dense prompt ``[B, S]`` -> ``[B, S + max_new_tokens]``."""
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
     b, s = input_ids.shape
     total = s + max_new_tokens
     if max_len is None:
@@ -81,12 +117,12 @@ def generate_loop(
 
     cache = init_cache(config, b, max_len)
     logits, cache = apply_cached(params, input_ids, config, cache)
-    next_tok = select_token(logits[:, -1], temperature, key, 0)
+    next_tok = select_token(logits[:, -1], temperature, key, 0, top_k=top_k, top_p=top_p)
 
     def step(carry, i):
         tok, cache, key = carry
         logits, cache = apply_cached(params, tok[:, None], config, cache)
-        nxt = select_token(logits[:, -1], temperature, key, i)
+        nxt = select_token(logits[:, -1], temperature, key, i, top_k=top_k, top_p=top_p)
         return (nxt, cache, key), tok
 
     (last, _, _), toks = jax.lax.scan(

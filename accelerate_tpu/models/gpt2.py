@@ -279,6 +279,8 @@ def generate(
     temperature: float = 0.0,
     key=None,
     max_len=None,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ) -> jax.Array:
     """Autoregressive generation (one compiled XLA program; see
     models/generation.py)."""
@@ -287,4 +289,5 @@ def generate(
     return generate_loop(
         apply_cached, init_cache, params, input_ids, config,
         max_new_tokens, temperature=temperature, key=key, max_len=max_len,
+        top_k=top_k, top_p=top_p,
     )

@@ -574,6 +574,8 @@ def generate(
     temperature: float = 0.0,
     key: Optional[jax.Array] = None,
     max_len: Optional[int] = None,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ) -> jax.Array:
     """Greedy (temperature=0) or sampled autoregressive generation.
 
@@ -586,4 +588,5 @@ def generate(
     return generate_loop(
         apply_cached, init_cache, params, input_ids, config,
         max_new_tokens, temperature=temperature, key=key, max_len=max_len,
+        top_k=top_k, top_p=top_p,
     )

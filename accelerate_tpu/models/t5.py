@@ -394,6 +394,8 @@ def generate(
     temperature: float = 0.0,
     key=None,
     attention_mask: Optional[jax.Array] = None,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ) -> jax.Array:
     """Seq2seq generation: encode once, then autoregressive decode with the
     self-attn cache + precomputed cross K/V.  Returns decoder ids
@@ -416,4 +418,5 @@ def generate(
     return generate_loop(
         _apply_cached, _init_cache, params, start, c,
         max_new_tokens, temperature=temperature, key=key,
+        top_k=top_k, top_p=top_p,
     )

@@ -195,3 +195,65 @@ def test_t5_decode_cached_padded_encoder_parity():
     cache = t5.init_decoder_cache(params, enc_out, cfg, max_len=4)
     cached, _ = t5.decode_cached(params, dec_ids, cfg, cache, attention_mask=mask)
     np.testing.assert_allclose(np.asarray(dense), np.asarray(cached), atol=1e-4, rtol=1e-4)
+
+
+def test_top_k_one_equals_greedy():
+    """top_k=1 sampling must reproduce greedy decoding regardless of key."""
+    import jax
+
+    from accelerate_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (2, 8), 0, cfg.vocab_size)
+    greedy = llama.generate(params, ids, cfg, max_new_tokens=6)
+    k1 = llama.generate(
+        params, ids, cfg, max_new_tokens=6, temperature=1.0, key=jax.random.key(7), top_k=1
+    )
+    assert (np.asarray(greedy) == np.asarray(k1)).all()
+
+
+def test_top_p_filter_masks_tail():
+    """select_token with a small top_p only ever samples the top token of a
+    peaked distribution; with top_p=1 the tail stays reachable."""
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models.generation import select_token
+
+    # Peaked logits: token 0 holds ~88% of the mass.
+    logits = jnp.asarray([[4.0, 2.0, 1.0, 0.0]])
+    key = jax.random.key(0)
+    picks_filtered = {
+        int(select_token(logits, 1.0, key, i, top_p=0.5)[0]) for i in range(200)
+    }
+    assert picks_filtered == {0}, picks_filtered
+    picks_full = {int(select_token(logits, 1.0, key, i, top_p=1.0)[0]) for i in range(200)}
+    assert len(picks_full) > 1, picks_full
+
+
+def test_top_k_filter_bounds_support():
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models.generation import select_token
+
+    logits = jnp.asarray([[0.0, 0.1, 0.2, 0.3, 5.0]])
+    key = jax.random.key(0)
+    picks = {int(select_token(logits, 2.0, key, i, top_k=2)[0]) for i in range(200)}
+    assert picks <= {3, 4}, picks
+
+
+def test_sampling_validation():
+    import jax
+    import pytest
+
+    from accelerate_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (1, 4), 0, cfg.vocab_size)
+    with pytest.raises(ValueError, match="top_p"):
+        llama.generate(params, ids, cfg, 2, temperature=1.0, key=jax.random.key(0), top_p=0.0)
+    with pytest.raises(ValueError, match="top_k"):
+        llama.generate(params, ids, cfg, 2, temperature=1.0, key=jax.random.key(0), top_k=-1)
