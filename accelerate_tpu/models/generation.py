@@ -102,6 +102,12 @@ def generate_loop(
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if top_k < 0:
         raise ValueError(f"top_k must be >= 0, got {top_k}")
+    if temperature <= 0.0 and (top_k > 0 or top_p < 1.0):
+        raise ValueError(
+            "top_k/top_p filter a SAMPLED distribution; greedy decoding "
+            "(temperature<=0, the default) would silently ignore them — pass "
+            "temperature>0 (with a PRNG key) to sample."
+        )
     b, s = input_ids.shape
     total = s + max_new_tokens
     if max_len is None:

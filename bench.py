@@ -8,6 +8,7 @@ Baseline (BASELINE.md): ≥45% MFU for Llama-family FSDP training on v5e —
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -109,25 +110,75 @@ def _run(
     }
 
 
-def main():
-    # Rung 1 is the tuned path; rung 2 is the proven-conservative fallback on
-    # the same model (einsum attention, full remat); further rungs step the
-    # model down.  A SIGALRM watchdog bounds each rung so a pathological
-    # compile can't eat the whole bench budget.
-    ladder = [
-        # batch 8 measured +0.7 MFU points over batch 4 on v5e (0.604 vs
-        # 0.597); 12/16 fail to compile (HBM), seq 4096 and flash both lose.
-        ("llama-509m", 2048, 6, 8192, 8, 2048, "pallas", "dots"),
-        ("llama-509m", 2048, 6, 8192, 4, 2048, "pallas", "dots"),
-        ("llama-509m", 2048, 6, 8192, 4, 2048, "flash", "dots"),
-        ("llama-509m", 2048, 6, 8192, 4, 2048, "einsum", "nothing"),
-        ("llama-310m", 1536, 6, 6144, 4, 2048, "einsum", "nothing"),
-        ("llama-128m", 1024, 4, 4096, 4, 1024, "einsum", "nothing"),
-    ]
-    import signal
+LADDER = [
+    # Rung 1 is the tuned path; later rungs are proven-conservative fallbacks
+    # on the same model (einsum attention, full remat) then smaller models.
+    # batch 8 measured +0.7 MFU points over batch 4 on v5e (0.604 vs 0.597);
+    # 12/16 fail to compile (HBM), seq 4096 and flash both lose.
+    ("llama-509m", 2048, 6, 8192, 8, 2048, "pallas", "dots"),
+    ("llama-509m", 2048, 6, 8192, 4, 2048, "pallas", "dots"),
+    ("llama-509m", 2048, 6, 8192, 4, 2048, "flash", "dots"),
+    ("llama-509m", 2048, 6, 8192, 4, 2048, "einsum", "nothing"),
+    ("llama-310m", 1536, 6, 6144, 4, 2048, "einsum", "nothing"),
+    ("llama-128m", 1024, 4, 4096, 4, 1024, "einsum", "nothing"),
+]
 
-    def _alarm(signum, frame):
-        raise TimeoutError("bench rung exceeded time budget")
+# Test hook: lets the smoke tests exercise the rung-subprocess machinery with
+# CPU-sized configs (a real rung takes minutes on CPU).
+if os.environ.get("BENCH_LADDER_JSON"):
+    LADDER = [tuple(r) for r in json.loads(os.environ["BENCH_LADDER_JSON"])]
+
+
+def _run_rung_subprocess(rung_index: int, timeout_s: int):
+    """Run one ladder rung in a KILLABLE subprocess.
+
+    A half-up device tunnel can hang a compile inside a C call, where neither
+    SIGALRM nor Python-level timeouts fire — only killing the process works.
+    Returns (result_dict | None, error_str | None)."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--rung", str(rung_index)],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {timeout_s}s"
+    if proc.returncode != 0:
+        return None, (proc.stderr or "")[-200:].replace("\n", " ")
+    # Last brace-prefixed line is the result; tolerate spurious brace lines —
+    # a parse failure steps the ladder down instead of killing the bench.
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except ValueError:
+                return None, f"unparseable result line: {line[:80]}"
+    return None, "no result line"
+
+
+def _honor_cpu_env():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from accelerate_tpu.state import honor_cpu_platform_env
+
+    honor_cpu_platform_env()
+
+
+def main():
+    _honor_cpu_env()
+    if "--probe" in sys.argv:
+        import jax
+
+        print(jax.device_count(), jax.devices()[0].device_kind)
+        return
+    if "--rung" in sys.argv:
+        idx = int(sys.argv[sys.argv.index("--rung") + 1])
+        name, d, layers, f, b, s, impl, policy = LADDER[idx]
+        print(json.dumps(_run(name, d, layers, f, b, s, impl, policy)))
+        return
 
     # Fast-fail when the device backend is unreachable (e.g. wedged TPU
     # tunnel).  The probe MUST be a subprocess: backend init blocks inside a C
@@ -136,15 +187,15 @@ def main():
 
     try:
         probe = subprocess.run(
-            [sys.executable, "-c", "import jax; print(jax.device_count(), jax.devices()[0].device_kind)"],
+            [sys.executable, os.path.abspath(__file__), "--probe"],
             capture_output=True,
             text=True,
-            timeout=120,
+            timeout=180,
         )
         ok = probe.returncode == 0
         detail = probe.stdout.strip() if ok else probe.stderr[-300:]
     except subprocess.TimeoutExpired:
-        ok, detail = False, "no response in 120s"
+        ok, detail = False, "no response in 180s"
     if not ok:
         print(
             json.dumps(
@@ -162,24 +213,11 @@ def main():
 
     result = None
     errors = []
-    for name, d, layers, f, b, s, impl, policy in ladder:
-        try:
-            signal.signal(signal.SIGALRM, _alarm)
-            signal.alarm(420)
-            try:
-                result = _run(name, d, layers, f, b, s, impl, policy)
-            finally:
-                signal.alarm(0)
+    for i, (name, _, _, _, _, _, impl, _) in enumerate(LADDER):
+        result, err = _run_rung_subprocess(i, timeout_s=480)
+        if result is not None:
             break
-        except Exception as e:  # OOM, compile failure or timeout: step down
-            errors.append(f"{name}/{impl}: {type(e).__name__}")
-            import gc
-
-            import jax
-
-            jax.clear_caches()
-            gc.collect()
-            continue
+        errors.append(f"{name}/{impl}: {err}")
     if result is None:
         print(json.dumps({"metric": "train_mfu", "value": 0.0, "unit": "mfu_fraction", "vs_baseline": 0.0, "error": ";".join(errors)}))
         sys.exit(1)

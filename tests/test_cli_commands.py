@@ -131,3 +131,28 @@ def test_launch_env_carries_deepspeed_config(tmp_path):
     env = build_env(_merge(args, ClusterConfig()))
     assert env["ACCELERATE_USE_DEEPSPEED"] == "true"
     assert env["ACCELERATE_DEEPSPEED_CONFIG_FILE"] == str(ds)
+
+
+def test_bench_ladder_subprocess_machinery():
+    """bench.py's rung-in-killable-subprocess driver produces the single JSON
+    result line (tiny CPU-sized ladder via the BENCH_LADDER_JSON test hook)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_LADDER_JSON"] = json.dumps([["tiny", 64, 2, 128, 2, 64, "einsum", "nothing"]])
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py")],
+        capture_output=True, text=True, timeout=720, env=env, cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr[-500:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip().startswith("{")]
+    result = json.loads(lines[-1])
+    # CPU MFU rounds to ~0; success is the absence of an error and a real
+    # detail block from the measured rung.
+    assert result["metric"] == "train_mfu" and "error" not in result
+    assert result["detail"]["tokens_per_sec"] > 0
