@@ -138,3 +138,110 @@ def generate_loop(
         jnp.concatenate([toks.T, last[:, None]], axis=1) if max_new_tokens > 1 else last[:, None]
     )
     return jnp.concatenate([input_ids, generated], axis=1)
+
+
+def beam_search(
+    apply_cached: Callable,
+    init_cache: Callable,
+    params,
+    input_ids: jax.Array,
+    config,
+    max_new_tokens: int,
+    num_beams: int = 4,
+    length_penalty: float = 1.0,
+    eos_token_id: Optional[int] = None,
+    max_len: Optional[int] = None,
+) -> jax.Array:
+    """Beam search over the shared KV cache — one compiled XLA program.
+
+    Dense prompt ``[B, S]`` -> best sequence ``[B, S + max_new_tokens]``.
+    Each step scores ``num_beams * vocab`` continuations, keeps the top
+    ``num_beams``, and reorders the cache rows to follow their beams (the
+    same reorder torch generation does, here a ``jnp.take`` inside the scan).
+    Beams that emit ``eos_token_id`` freeze: their score stops accumulating
+    and they pad with EOS.  Final ranking divides by ``length**length_penalty``
+    (>1 favors longer sequences, <1 shorter).
+    """
+    if max_new_tokens < 1:
+        raise ValueError("beam search needs max_new_tokens >= 1")
+    if num_beams < 1:
+        raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+    b, s = input_ids.shape
+    kbeams = num_beams
+    total = s + max_new_tokens
+    if max_len is None:
+        max_len = total
+    if total > max_len:
+        raise ValueError(f"prompt ({s}) + max_new_tokens ({max_new_tokens}) > max_len ({max_len})")
+
+    # Prefill ONCE at batch B (all beams share the prompt — tiling the prompt
+    # would multiply prefill FLOPs/HBM by K), then tile the cache rows per beam.
+    cache = init_cache(config, b, max_len)
+    logits, cache = apply_cached(params, input_ids, config, cache)
+    cache = jax.tree.map(
+        lambda leaf: jnp.repeat(leaf, kbeams, axis=1)
+        if leaf.ndim >= 2 and leaf.shape[1] == b
+        else leaf,
+        cache,
+    )
+    logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)  # [B, V]
+    vocab = logp.shape[-1]
+
+    # First expansion: the top-K tokens of the single (shared) beam.
+    scores, tokens = jax.lax.top_k(logp, kbeams)  # [B, K]
+    tokens = tokens.astype(jnp.int32)
+    finished = (
+        tokens == eos_token_id if eos_token_id is not None else jnp.zeros_like(tokens, bool)
+    )
+    lengths = jnp.ones((b, kbeams), jnp.int32)
+
+    out = jnp.zeros((b, kbeams, max_new_tokens), jnp.int32)
+    out = out.at[:, :, 0].set(tokens)
+
+    batch_offsets = (jnp.arange(b) * kbeams)[:, None]  # [B, 1]
+
+    def step(carry, i):
+        tokens, scores, finished, lengths, out, cache = carry
+        logits, new_cache = apply_cached(
+            params, tokens.reshape(b * kbeams, 1), config, cache
+        )
+        logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
+        logp = logp.reshape(b, kbeams, vocab)
+        if eos_token_id is not None:
+            # Frozen beams only continue with EOS at zero added score.
+            frozen = jnp.full((vocab,), -jnp.inf).at[eos_token_id].set(0.0)
+            logp = jnp.where(finished[:, :, None], frozen[None, None, :], logp)
+        cand = (scores[:, :, None] + logp).reshape(b, kbeams * vocab)
+        new_scores, flat_idx = jax.lax.top_k(cand, kbeams)
+        beam_idx = (flat_idx // vocab).astype(jnp.int32)  # [B, K] source beam
+        new_tokens = (flat_idx % vocab).astype(jnp.int32)
+
+        gather_rows = (batch_offsets + beam_idx).reshape(-1)  # [B*K] cache rows
+
+        def reorder(leaf):
+            if leaf.ndim >= 2 and leaf.shape[1] == b * kbeams:
+                return jnp.take(leaf, gather_rows, axis=1)
+            return leaf
+
+        cache = jax.tree.map(reorder, new_cache)
+        out = jnp.take_along_axis(out, beam_idx[:, :, None], axis=1)
+        out = out.at[:, :, i].set(new_tokens)
+        prev_finished = jnp.take_along_axis(finished, beam_idx, axis=1)
+        lengths = jnp.take_along_axis(lengths, beam_idx, axis=1) + (~prev_finished)
+        if eos_token_id is not None:
+            finished = prev_finished | (new_tokens == eos_token_id)
+        else:
+            finished = prev_finished
+        return (new_tokens, new_scores, finished, lengths, out, cache), None
+
+    if max_new_tokens > 1:
+        (tokens, scores, finished, lengths, out, cache), _ = jax.lax.scan(
+            step,
+            (tokens, scores, finished, lengths, out, cache),
+            jnp.arange(1, max_new_tokens),
+        )
+
+    ranked = scores / (lengths.astype(jnp.float32) ** length_penalty)
+    best = jnp.argmax(ranked, axis=1)  # [B]
+    best_out = jnp.take_along_axis(out, best[:, None, None], axis=1)[:, 0]  # [B, max_new]
+    return jnp.concatenate([input_ids, best_out], axis=1)

@@ -291,3 +291,23 @@ def generate(
         max_new_tokens, temperature=temperature, key=key, max_len=max_len,
         top_k=top_k, top_p=top_p,
     )
+
+
+def generate_beam(
+    params: dict,
+    input_ids: jax.Array,
+    config: GPT2Config,
+    max_new_tokens: int,
+    num_beams: int = 4,
+    length_penalty: float = 1.0,
+    eos_token_id=None,
+    max_len=None,
+) -> jax.Array:
+    """Beam-search generation (see ``models/generation.py beam_search``)."""
+    from .generation import beam_search
+
+    return beam_search(
+        apply_cached, init_cache, params, input_ids, config, max_new_tokens,
+        num_beams=num_beams, length_penalty=length_penalty,
+        eos_token_id=eos_token_id, max_len=max_len,
+    )

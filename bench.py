@@ -148,16 +148,16 @@ def _run_rung_subprocess(rung_index: int, timeout_s: int):
         return None, f"timeout after {timeout_s}s"
     if proc.returncode != 0:
         return None, (proc.stderr or "")[-200:].replace("\n", " ")
-    # Last brace-prefixed line is the result; tolerate spurious brace lines —
-    # a parse failure steps the ladder down instead of killing the bench.
+    # Scan from the end for the LAST parseable JSON line — spurious
+    # brace-prefixed library output (before or after the result) is skipped.
     for line in reversed(proc.stdout.splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
                 return json.loads(line), None
             except ValueError:
-                return None, f"unparseable result line: {line[:80]}"
-    return None, "no result line"
+                continue
+    return None, "no parseable result line"
 
 
 def _honor_cpu_env():
