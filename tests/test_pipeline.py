@@ -144,3 +144,23 @@ def test_pipeline_padded_batch_matches_dense():
 
     piped = float(pp_loss(sharded, sb))
     assert abs(dense_loss - piped) < 3e-3, (dense_loss, piped)
+
+
+def test_pipeline_composes_with_sequence_parallelism():
+    """pp x sp on one mesh: ring attention (shard_map over sp) runs inside the
+    vmapped pipeline stage body and still matches the dense loss."""
+    cfg = llama.LlamaConfig.tiny(num_layers=4)
+    params = llama.init_params(cfg, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (4, 32), 0, cfg.vocab_size)
+    batch = {"input_ids": ids}
+    dense_loss = float(jax.jit(lambda p, b: llama.loss_fn(p, b, cfg))(params, batch))
+
+    state = AcceleratorState(parallelism_config=ParallelismConfig(pp=2, sp=2, dp=2))
+    from accelerate_tpu.parallel.sharding import shard_params
+
+    sparams = shard_params(params, state.mesh, llama.param_specs(cfg))
+    sb = {"input_ids": jax.device_put(ids, data_sharding(state.mesh))}
+    pp_loss = float(jax.jit(
+        lambda p, b: pl.pipeline_llama_loss_fn(p, b, cfg, num_stages=2, num_micro_batches=2)
+    )(sparams, sb))
+    assert abs(dense_loss - pp_loss) < 3e-3, (dense_loss, pp_loss)
