@@ -175,3 +175,22 @@ def test_shipped_notebook_script():
     from accelerate_tpu.test_utils.scripts import test_notebook as script
 
     script.main()
+
+
+def test_accelerate_test_smoke_payload():
+    """The full `accelerate-tpu test` payload (RNG sync, dataloader prep,
+    training_check across precisions, split_between_processes, triggers) runs
+    green — the reference wires the same script behind `accelerate test`."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli", "test"],
+        capture_output=True, text=True, timeout=540, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    assert "Test is a success" in out.stdout
