@@ -919,13 +919,44 @@ class Accelerator:
             all_tensors = True
         except TypeError:
             all_tensors = False
-        if not all_tensors or use_gather_object:
-            data = gather_object([input_data])
+        object_mode = not all_tensors or use_gather_object
+        if object_mode:
+            # Reference semantics (operations.py:440): each process contributes
+            # its LIST of samples; the gather flattens one level, so the result
+            # is the concatenated sample list — not a list of per-process
+            # batches.
+            data = gather_object(
+                input_data if isinstance(input_data, (list, tuple)) else [input_data]
+            )
         else:
             data = self.gather(input_data)
+            pad = getattr(self.gradient_state, "device_pad_rows", 0)
+            batch_rows = getattr(self.gradient_state, "device_batch_rows", 0)
+            if pad and batch_rows:
+                # Drop the rows the device placer appended to make this batch
+                # shard-divisible.  The gather concatenates per-process blocks
+                # along dim 0, and every process pads its own tail, so the
+                # duplicates sit at the end of each block.  Only tensors whose
+                # gathered leading dim matches the padded batch are trimmed —
+                # a [C] per-class vector or [C, C] confusion matrix gathered
+                # mid-epoch passes through untouched.
+                n_proc = self.num_processes
+
+                def _drop_pad(t):
+                    if getattr(t, "ndim", 0) == 0 or t.shape[0] != n_proc * batch_rows:
+                        return t
+                    kept = t.reshape(n_proc, batch_rows, *t.shape[1:])[:, : batch_rows - pad]
+                    return kept.reshape(n_proc * (batch_rows - pad), *t.shape[1:])
+
+                data = recursively_apply(_drop_pad, data)
 
         try:
             if self.gradient_state.end_of_dataloader and self.gradient_state.remainder > 0:
+                if object_mode:
+                    # Flat sample list: plain slice (recursively_apply would
+                    # descend into the samples themselves).
+                    return data[: self.gradient_state.remainder]
+
                 def _truncate(t):
                     return t[: self.gradient_state.remainder]
 

@@ -111,17 +111,34 @@ def init_on_device(device, include_buffers: bool = False):
             torch.nn.Module.register_buffer = old_register_buffer
 
 
+def _dedup_state_dict(model, convert) -> dict:
+    """name -> converted tensor, converting each underlying storage ONCE so
+    tied weights do not duplicate host RAM (same rule as dispatch_model)."""
+    converted: dict[int, object] = {}
+    out = {}
+    for n, p in model.state_dict(keep_vars=True).items():
+        if _on_meta(p):
+            continue
+        key = id(p)
+        if key not in converted:
+            converted[key] = convert(p)
+        out[n] = converted[key]
+    return out
+
+
 def cpu_offload(model, execution_device=None, offload_buffers: bool = False, state_dict=None):
     """Whole-model CPU offload (reference ``big_modeling.py:173``): weights live in
     a host state dict, staged per-submodule at forward."""
     if state_dict is None:
-        state_dict = {n: p.detach().cpu() for n, p in model.state_dict().items()}
+        state_dict = _dedup_state_dict(model, lambda p: p.detach().cpu())
     attach_align_device_hook(
         model,
         execution_device=execution_device or "cpu",
         offload=True,
         weights_map=state_dict,
         offload_buffers=offload_buffers,
+        tied_params_map={},
+        tied_names=_tied_name_map(model),
     )
     return model
 
@@ -138,7 +155,7 @@ def cpu_offload_with_hook(model, execution_device=None, prev_module_hook: Option
 def disk_offload(model, offload_dir: str, execution_device=None, offload_buffers: bool = False):
     """Whole-model disk offload (reference ``big_modeling.py:239``)."""
     os.makedirs(offload_dir, exist_ok=True)
-    offload_state_dict(offload_dir, {n: _tensor_to_numpy(p) for n, p in model.state_dict().items()})
+    offload_state_dict(offload_dir, _dedup_state_dict(model, _tensor_to_numpy))
     weights_map = OffloadedWeightsLoader(save_folder=offload_dir)
     attach_align_device_hook(
         model,
@@ -146,8 +163,17 @@ def disk_offload(model, offload_dir: str, execution_device=None, offload_buffers
         offload=True,
         weights_map=weights_map,
         offload_buffers=offload_buffers,
+        tied_params_map={},
+        tied_names=_tied_name_map(model),
     )
     return model
+
+
+def _tied_name_map(model) -> dict:
+    """full weight name -> canonical group name, for tied-parameter dedup."""
+    from .utils.modeling import find_tied_parameters
+
+    return {n: group[0] for group in find_tied_parameters(model) for n in group}
 
 
 def dispatch_model(
@@ -182,11 +208,10 @@ def dispatch_model(
     weights_map = None
     if disk_modules or cpu_modules:
         if state_dict is None:
-            state_dict = {
-                n: _tensor_to_numpy(p)
-                for n, p in model.state_dict().items()
-                if not _on_meta(p)
-            }
+            # Tied parameters convert ONCE: state_dict() lists each tied weight
+            # under every name, and a per-name numpy conversion would duplicate
+            # the host RAM the offload tier exists to save.
+            state_dict = _dedup_state_dict(model, _tensor_to_numpy)
         if disk_modules and offload_dir is not None:
             disk_sd = {
                 n: v
@@ -206,12 +231,23 @@ def dispatch_model(
     # torch "tpu" device).
     execution_device = {name: "cpu" for name in device_map}
     offload = {name: tier in ("cpu", "disk") for name, tier in device_map.items()}
+
+    # Tied-parameter dedup (reference big_modeling.py:410-424): one shared map
+    # so a weight tied across modules materializes ONCE per staging device —
+    # keyed by the group's canonical name (our weights_map is name-addressed;
+    # the reference keys by data_ptr because its map is tensor-addressed).
+    tied_params_map: dict = {}
+    tied_names = _tied_name_map(model)
+
     attach_align_device_hook_on_blocks(
         model,
         execution_device=execution_device,
         offload=offload,
         weights_map=weights_map,
         offload_buffers=offload_buffers,
+        skip_keys=skip_keys,
+        tied_params_map=tied_params_map,
+        tied_names=tied_names,
     )
     if weights_map is not None:
         from .hooks import wire_sequential_prefetch

@@ -75,24 +75,66 @@ def _ask(prompt: str, default, cast=str):
     return cast(raw) if raw else default
 
 
+def _yes(raw) -> bool:
+    from ..utils.environment import str_to_bool
+
+    try:
+        return bool(str_to_bool(str(raw)))
+    except ValueError:
+        return False
+
+
 def config_command(args):
     if getattr(args, "default", False):
         return default_config_command(args)
+    if getattr(args, "update", False):
+        return update_config_command(args)
     cfg = ClusterConfig()
+    # Cluster questions mirroring the reference questionnaire
+    # (commands/config/cluster.py), keeping only ones with native TPU meaning.
     cfg.num_machines = _ask("How many machines (hosts)?", 1, int)
     if cfg.num_machines > 1:
         cfg.machine_rank = _ask("Rank of this machine?", 0, int)
         cfg.main_process_ip = _ask("Main process IP?", "127.0.0.1")
         cfg.main_process_port = _ask("Main process port?", 29500, int)
     cfg.mixed_precision = _ask("Mixed precision (no/bf16/fp16/fp8)?", "bf16")
-    cfg.use_fsdp = _ask("Use FSDP parameter sharding (yes/no)?", "no") in ("yes", "y", "true", "1")
+    cfg.gradient_accumulation_steps = _ask("Gradient accumulation steps?", 1, int)
+    cfg.use_fsdp = _yes(_ask("Use FSDP parameter sharding (yes/no)?", "no"))
     if cfg.use_fsdp:
         cfg.fsdp = _ask("FSDP axis size (0=all devices)?", 0, int) or 0
-        cfg.fsdp_sharding_strategy = _ask("Sharding strategy?", "FULL_SHARD")
+        cfg.fsdp_sharding_strategy = _ask(
+            "Sharding strategy (FULL_SHARD/SHARD_GRAD_OP/NO_SHARD/HYBRID_SHARD)?", "FULL_SHARD"
+        )
+        cfg.fsdp_min_num_params = _ask("Min params per wrapped block (0=every block)?", 0, int)
     cfg.tp = _ask("Tensor-parallel size?", 1, int)
-    cfg.sp = _ask("Sequence-parallel size?", 1, int)
+    cfg.sp = _ask("Sequence-parallel size (ring/ulysses long-context)?", 1, int)
+    cfg.pp = _ask("Pipeline-parallel size?", 1, int)
+    cfg.ep = _ask("Expert-parallel size (MoE)?", 1, int)
+    if _yes(_ask("Train with a DeepSpeed config dialect (yes/no)?", "no")):
+        cfg.deepspeed_config_file = _ask("Path to ds_config.json?", "ds_config.json")
+    if cfg.num_machines > 1 and _yes(_ask("Is this a GCP TPU pod (yes/no)?", "no")):
+        cfg.tpu_name = _ask("TPU pod name?", None)
+        cfg.tpu_zone = _ask("TPU zone?", None)
     path = save_config(cfg, getattr(args, "config_file", None) or DEFAULT_CONFIG_FILE)
     print(f"Configuration saved to {path}")
+
+
+def update_config_command(args):
+    """Migrate an existing config file to the current schema (reference
+    ``commands/config/update.py``): unknown keys drop with a note, missing
+    keys fill with defaults, the result is rewritten in place."""
+    path = getattr(args, "config_file", None) or DEFAULT_CONFIG_FILE
+    if not os.path.exists(path):
+        raise SystemExit(f"No config file at {path}; run `accelerate-tpu config` first.")
+    with open(path) as f:
+        data = yaml.safe_load(f) or {}
+    known = set(ClusterConfig.__dataclass_fields__)
+    dropped = sorted(k for k in data if k not in known)
+    cfg = ClusterConfig(**{k: v for k, v in data.items() if k in known})
+    save_config(cfg, path)
+    note = f" (dropped unknown keys: {', '.join(dropped)})" if dropped else ""
+    print(f"Updated {path} to the current schema{note}")
+    return dropped
 
 
 def default_config_command(args):
@@ -112,4 +154,6 @@ def register_subcommand(subparsers):
     parser = subparsers.add_parser("config", help="Create the launch configuration")
     parser.add_argument("--config_file", default=None)
     parser.add_argument("--default", action="store_true", help="Write defaults without prompting")
+    parser.add_argument("--update", action="store_true",
+                        help="Migrate an existing config file to the current schema")
     parser.set_defaults(func=config_command)

@@ -9,11 +9,37 @@ from .config import DEFAULT_CONFIG_FILE, load_config
 
 
 def _probe_devices(timeout_s: float = 20.0) -> dict:
-    """Backend probe in a daemon thread with a deadline: a tunneled TPU whose
-    link is down blocks client creation forever, and an env report must never
-    hang (the reference's env command touches no device at all)."""
+    """Backend probe with a deadline: a tunneled TPU whose link is down blocks
+    client creation forever, and an env report must never hang (the
+    reference's env command touches no device at all).
+
+    First gate: the shared killable-subprocess probe
+    (``utils/device_probe.py`` — also used by ``bench.py`` and first-touch
+    state bring-up).  On a device platform, its "<count> <kind>" answer IS the
+    report — re-initializing the backend in-process would double the latency
+    and re-expose the hang risk; the richer in-process query runs only on the
+    cpu backend (cheap, cannot wedge)."""
     import os
     import threading
+
+    import jax
+
+    from ..utils.device_probe import probe_device_backend
+
+    platforms = (jax.config.jax_platforms or "").strip()
+    device_platform = platforms and any(
+        p.strip() != "cpu" for p in platforms.split(",") if p.strip()
+    )
+    if device_platform:
+        ok, detail = probe_device_backend(timeout_s=timeout_s)
+        if not ok:
+            return {"JAX backend": f"UNREACHABLE ({detail})"}
+        count, _, kind = detail.partition(" ")
+        return {
+            "JAX backend": platforms.split(",")[0],
+            "Device count": count,
+            "Device kind": kind,
+        }
 
     result: dict = {}
 

@@ -66,14 +66,33 @@ def convert_config(src: dict) -> ClusterConfig:
         cfg.fsdp_sharding_strategy = "FULL_SHARD" if stage == 3 else "SHARD_GRAD_OP"
         if ds_cfg.get("gradient_accumulation_steps"):
             cfg.gradient_accumulation_steps = int(ds_cfg["gradient_accumulation_steps"])
+        if ds_cfg.get("deepspeed_config_file"):
+            # A full ds_config.json keeps flowing through the dialect
+            # (utils/deepspeed.py consumes it at prepare time).
+            cfg.deepspeed_config_file = str(ds_cfg["deepspeed_config_file"])
     elif dist == "MEGATRON_LM":
         mlm = src.get("megatron_lm_config", {}) or {}
         cfg.tp = int(mlm.get("megatron_lm_tp_degree", 1))
         cfg.pp = int(mlm.get("megatron_lm_pp_degree", 1))
+        if str(mlm.get("megatron_lm_use_distributed_optimizer", "")).lower() in ("1", "true", "yes"):
+            cfg.use_fsdp = True
+            cfg.fsdp = 0
+            cfg.fsdp_sharding_strategy = "SHARD_GRAD_OP"
     elif dist == "TP":
         tp_cfg = src.get("tp_config", {}) or {}
         cfg.tp = int(tp_cfg.get("tp_size", 1))
-    # Everything else (NO/MULTI_GPU/MULTI_CPU/XLA/...) -> dp over all devices.
+    elif dist in ("XLA", "TPU"):
+        # Reference TPU config: downcast_bf16/XLA_USE_BF16 become the explicit
+        # bf16 policy; the mesh covers all chips (dp auto).
+        if str(src.get("downcast_bf16", "")).lower() in ("1", "true", "yes"):
+            cfg.downcast_bf16 = True
+            if cfg.mixed_precision in ("no", "None"):
+                cfg.mixed_precision = "bf16"
+        if src.get("tpu_name"):
+            cfg.tpu_name = str(src["tpu_name"])
+        if src.get("tpu_zone"):
+            cfg.tpu_zone = str(src["tpu_zone"])
+    # Everything else (NO/MULTI_GPU/MULTI_CPU/...) -> dp over all devices.
     return cfg
 
 

@@ -30,12 +30,68 @@ from .config import ClusterConfig, load_config
 __all__ = ["launch_command", "launch_command_parser", "register_subcommand"]
 
 
+# Reference flags (commands/launch.py:141-770) with NO native meaning on TPU.
+# Each entry: flag dest -> why it does not apply / what to use instead.  A set
+# flag WARNS (never crashes) so reference launch commands run unmodified.
+_UNSUPPORTED_FLAGS = {
+    "multi_gpu": "the GSPMD mesh covers every chip automatically; drop the flag",
+    "gpu_ids": "chip selection is topology-driven (JAX mesh); use --num_processes / mesh axes",
+    "use_xpu": "XPU is Intel GPU infrastructure; this backend targets TPU",
+    "ipex": "IPEX is an Intel CPU/GPU optimizer; XLA owns TPU compilation",
+    "dynamo_backend": "torch.compile/dynamo has no role on TPU — the whole step is XLA-compiled natively",
+    "dynamo_mode": "see --dynamo_backend",
+    "dynamo_use_fullgraph": "see --dynamo_backend",
+    "dynamo_use_dynamic": "see --dynamo_backend",
+    "rdzv_backend": "torchelastic rendezvous is replaced by the jax.distributed coordinator; use --main_process_ip/--main_process_port",
+    "rdzv_conf": "see --rdzv_backend",
+    "same_network": "see --rdzv_backend",
+    "role": "torchelastic-only; one process per TPU host",
+    "log_dir": "torchelastic log redirection; use shell redirection per host",
+    "tee": "torchelastic-only; use shell redirection per host",
+    "max_restarts": "elastic restarts apply to notebook_launcher(max_restarts=...); the CLI launcher runs one attempt per host",
+    "monitor_interval": "see --max_restarts",
+    "mpirun_hostfile": "MPI launch is replaced by per-host jax.distributed bring-up; run this command on every host with --machine_rank",
+    "mpirun_ccl": "see --mpirun_hostfile",
+    "deepspeed_hostfile": "DeepSpeed pdsh/mpi multi-node launch is replaced by per-host bring-up (--machine_rank per host)",
+    "deepspeed_exclusion_filter": "see --deepspeed_hostfile",
+    "deepspeed_inclusion_filter": "see --deepspeed_hostfile",
+    "deepspeed_multinode_launcher": "see --deepspeed_hostfile",
+    "deepspeed_moe_layer_cls_names": "MoE layers route through the native ep mesh axis (ops/moe.py); no ZeRO-3 leaf marking needed",
+    "enable_cpu_affinity": "host-side NUMA pinning is not load-bearing for single-controller TPU hosts",
+    "downcast_bf16": "XLA_DOWNCAST_BF16 is an XRT-era flag; dtype policy is explicit here (--mixed_precision bf16)",
+    "fp8_opt_level": "MS-AMP-specific; the native fp8 path has one backend (ops/fp8.py recipe kwargs)",
+    "fp8_override_linear_precision": "TransformerEngine-specific; use the native recipe kwargs",
+    "fp8_use_autocast_during_eval": "TE-specific; eval dtype follows the step's mixed-precision policy",
+    "fsdp_backward_prefetch": "GSPMD/XLA schedules all-gathers automatically; no manual prefetch knob",
+    "fsdp_forward_prefetch": "see --fsdp_backward_prefetch",
+    "fsdp_sync_module_states": "parameters are sharded jax arrays built from one host copy; nothing to broadcast",
+    "fsdp_use_orig_params": "functional params make the flat-param distinction moot",
+    "fsdp_cpu_ram_efficient_loading": "streaming checkpoint load is the default (utils/modeling.py load_checkpoint_in_model)",
+    "quiet": None,  # native: suppress launcher banner
+    # num_cpu_threads_per_process is NATIVE (build_env exports OMP_NUM_THREADS)
+    # — deliberately not listed here.
+}
+
+
+def _flag_bool(value) -> bool:
+    """Boolean-ish CLI/config value -> bool.  Reference flags pass booleans as
+    strings ('--fsdp_offload_params false'), where plain truthiness would
+    invert the request."""
+    if value is None:
+        return False
+    if isinstance(value, bool):
+        return value
+    from ..utils.environment import str_to_bool
+
+    return bool(str_to_bool(str(value)))
+
+
 def launch_command_parser(subparsers=None):
     if subparsers is not None:
         parser = subparsers.add_parser("launch", help="Launch a training script on TPU hosts")
     else:
         parser = argparse.ArgumentParser("accelerate-tpu launch")
-    # Hardware / topology
+    # Hardware / topology (reference "Hardware Selection"/"Resource Selection")
     parser.add_argument("--config_file", default=None)
     parser.add_argument("--num_machines", type=int, default=None, help="Number of hosts")
     parser.add_argument("--machine_rank", type=int, default=None, help="This host's rank")
@@ -44,27 +100,117 @@ def launch_command_parser(subparsers=None):
     parser.add_argument("--num_processes", type=int, default=None,
                         help="Total host processes (defaults to num_machines)")
     parser.add_argument("--cpu", action="store_true", help="Force CPU execution")
+    parser.add_argument("--multi_gpu", action="store_true", default=None)
+    parser.add_argument("--gpu_ids", default=None)
+    parser.add_argument("--use_xpu", action="store_true", default=None)
+    parser.add_argument("--ipex", action="store_true", default=None)
     parser.add_argument("--debug_cpu", type=int, default=0,
                         help="Spawn N local CPU processes as a simulated cluster")
+    parser.add_argument("--quiet", "-q", action="store_true", default=None)
     # Precision / accumulation
     parser.add_argument("--mixed_precision", default=None, choices=["no", "fp16", "bf16", "fp8"])
     parser.add_argument("--gradient_accumulation_steps", type=int, default=None)
-    # Mesh axes
+    parser.add_argument("--gradient_clipping", type=float, default=None)
+    # Dynamo group (reference commands/launch.py:240-270) — no TPU meaning.
+    parser.add_argument("--dynamo_backend", default=None)
+    parser.add_argument("--dynamo_mode", default=None)
+    parser.add_argument("--dynamo_use_fullgraph", action="store_true", default=None)
+    parser.add_argument("--dynamo_use_dynamic", action="store_true", default=None)
+    # Elastic / rendezvous group — torchelastic-only.
+    parser.add_argument("--rdzv_backend", default=None)
+    parser.add_argument("--rdzv_conf", default=None)
+    parser.add_argument("--same_network", action="store_true", default=None)
+    parser.add_argument("--role", default=None)
+    parser.add_argument("--log_dir", default=None)
+    parser.add_argument("--tee", default=None)
+    parser.add_argument("--max_restarts", type=int, default=None)
+    parser.add_argument("--monitor_interval", type=float, default=None)
+    parser.add_argument("--num_cpu_threads_per_process", type=int, default=None)
+    parser.add_argument("--enable_cpu_affinity", action="store_true", default=None)
+    # MPI group.
+    parser.add_argument("--mpirun_hostfile", default=None)
+    parser.add_argument("--mpirun_ccl", type=int, default=None)
+    # TPU group (reference: tpu_launcher/tpu_pod_launcher).
+    parser.add_argument("--tpu", action="store_true", default=None,
+                        help="Accepted for reference parity (TPU is the default here)")
+    parser.add_argument("--tpu_cluster", "--tpu_use_cluster", action="store_true", default=None,
+                        dest="tpu_cluster", help="Pod fan-out via `accelerate-tpu tpu-config`")
+    parser.add_argument("--no_tpu_cluster", action="store_false", dest="tpu_cluster")
+    parser.add_argument("--tpu_use_sudo", action="store_true", default=None)
+    parser.add_argument("--vm", action="append", default=None)
+    parser.add_argument("--env", action="append", default=None,
+                        help="Extra VAR=VALUE pairs for the worker environment")
+    parser.add_argument("--main_training_function", default=None,
+                        help="Exported as ACCELERATE_MAIN_TRAINING_FUNCTION (notebook/pod entry)")
+    parser.add_argument("--downcast_bf16", action="store_true", default=None)
+    # Mesh axes (native)
     parser.add_argument("--dp", type=int, default=None)
     parser.add_argument("--fsdp_size", type=int, default=None)
     parser.add_argument("--tp_size", type=int, default=None)
     parser.add_argument("--sp_size", type=int, default=None)
     parser.add_argument("--pp_size", type=int, default=None)
     parser.add_argument("--ep_size", type=int, default=None)
-    # FSDP strategy
+    # FSDP group (reference commands/launch.py:507-610) — FSDP_* env contract.
     parser.add_argument("--use_fsdp", action="store_true", default=None)
     parser.add_argument("--fsdp_sharding_strategy", default=None)
+    parser.add_argument("--fsdp_reshard_after_forward", default=None,
+                        help="FSDP2 spelling of the sharding strategy")
     parser.add_argument("--fsdp_min_num_params", type=int, default=None)
+    parser.add_argument("--fsdp_offload_params", default=None)
+    parser.add_argument("--fsdp_cpu_offload", action="store_true", default=None)
+    parser.add_argument("--fsdp_auto_wrap_policy", default=None)
+    parser.add_argument("--fsdp_transformer_layer_cls_to_wrap", default=None)
+    parser.add_argument("--fsdp_state_dict_type", default=None)
+    parser.add_argument("--fsdp_activation_checkpointing", default=None)
+    parser.add_argument("--fsdp_backward_prefetch", default=None)
+    parser.add_argument("--fsdp_forward_prefetch", default=None)
+    parser.add_argument("--fsdp_sync_module_states", default=None)
+    parser.add_argument("--fsdp_use_orig_params", default=None)
+    parser.add_argument("--fsdp_cpu_ram_efficient_loading", default=None)
+    parser.add_argument("--fsdp_version", type=int, default=None,
+                        help="1 and 2 map to the same GSPMD sharding")
+    # DeepSpeed group (reference commands/launch.py:610-700) — config dialect.
+    parser.add_argument("--use_deepspeed", action="store_true", default=None)
     parser.add_argument("--deepspeed_config_file", default=None,
                         help="ds_config.json consumed as a config dialect")
-    parser.add_argument("--fsdp_cpu_offload", action="store_true", default=None)
+    parser.add_argument("--zero_stage", type=int, default=None)
+    parser.add_argument("--offload_optimizer_device", default=None)
+    parser.add_argument("--offload_param_device", default=None)
+    parser.add_argument("--offload_optimizer_nvme_path", default=None)
+    parser.add_argument("--offload_param_nvme_path", default=None)
+    parser.add_argument("--zero3_init_flag", default=None)
+    parser.add_argument("--zero3_save_16bit_model", default=None)
+    parser.add_argument("--deepspeed_hostfile", default=None)
+    parser.add_argument("--deepspeed_exclusion_filter", default=None)
+    parser.add_argument("--deepspeed_inclusion_filter", default=None)
+    parser.add_argument("--deepspeed_multinode_launcher", default=None)
+    parser.add_argument("--deepspeed_moe_layer_cls_names", default=None)
+    # Megatron-LM group — MEGATRON_LM_* env contract.
+    parser.add_argument("--use_megatron_lm", action="store_true", default=None)
+    parser.add_argument("--megatron_lm_tp_degree", type=int, default=None)
+    parser.add_argument("--megatron_lm_pp_degree", type=int, default=None)
+    parser.add_argument("--megatron_lm_num_micro_batches", type=int, default=None)
+    parser.add_argument("--megatron_lm_sequence_parallelism", default=None)
+    parser.add_argument("--megatron_lm_recompute_activations", default=None)
+    parser.add_argument("--megatron_lm_use_distributed_optimizer", default=None)
+    parser.add_argument("--megatron_lm_gradient_clipping", type=float, default=None)
+    # FP8 recipe group — native recipe kwargs (ops/fp8.py).
+    parser.add_argument("--fp8_backend", default=None)
+    parser.add_argument("--fp8_format", default=None)
+    parser.add_argument("--fp8_margin", type=int, default=None)
+    parser.add_argument("--fp8_interval", type=int, default=None)
+    parser.add_argument("--fp8_amax_history_len", type=int, default=None)
+    parser.add_argument("--fp8_amax_compute_algo", default=None)
+    parser.add_argument("--fp8_opt_level", default=None)
+    parser.add_argument("--fp8_override_linear_precision", default=None)
+    parser.add_argument("--fp8_use_autocast_during_eval", action="store_true", default=None)
+    # SageMaker group — documented out-of-scope (utils/launch.py:147).
+    parser.add_argument("--aws_access_key_id", default=None)
+    parser.add_argument("--aws_secret_access_key", default=None)
     # Misc
     parser.add_argument("--debug", action="store_true", help="ACCELERATE_DEBUG_MODE=1")
+    parser.add_argument("--dry_run", action="store_true",
+                        help="Print the resolved worker env contract as JSON and exit")
     parser.add_argument("-m", "--module", action="store_true",
                         help="Run the training script as a python module (python -m)")
     parser.add_argument("--no_python", action="store_true",
@@ -73,6 +219,25 @@ def launch_command_parser(subparsers=None):
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
     parser.set_defaults(func=launch_command)
     return parser
+
+
+def _warn_unsupported(args) -> list[str]:
+    """Warn (never crash) for reference flags with no TPU meaning; returns the
+    warning list for tests/--dry_run introspection."""
+    import warnings
+
+    notes = []
+    for dest, why in _UNSUPPORTED_FLAGS.items():
+        if why is None:
+            continue
+        val = getattr(args, dest, None)
+        # Identity checks: `0 in (None, False)` would be True and silently
+        # swallow the warning for explicit zero values.
+        if val is not None and val is not False:
+            notes.append(f"--{dest}: unsupported on TPU — {why}")
+    for note in notes:
+        warnings.warn(note)
+    return notes
 
 
 def _merge(args, cfg: ClusterConfig):
@@ -103,6 +268,45 @@ def _merge(args, cfg: ClusterConfig):
             getattr(args, "deepspeed_config_file", None), cfg.deepspeed_config_file
         ),
     }
+    # Reference-surface knobs that flow straight into env vars the plugins
+    # already read (FSDP_* / ACCELERATE_DEEPSPEED_* / MEGATRON_LM_* contract).
+    merged["gradient_clipping"] = pick(
+        getattr(args, "gradient_clipping", None), getattr(cfg, "gradient_clipping", None)
+    )
+    for dest in (
+        "fsdp_offload_params",
+        "fsdp_cpu_offload",
+        "fsdp_auto_wrap_policy",
+        "fsdp_transformer_layer_cls_to_wrap",
+        "fsdp_state_dict_type",
+        "fsdp_activation_checkpointing",
+        "fsdp_reshard_after_forward",
+        "fsdp_version",
+        "use_deepspeed",
+        "zero_stage",
+        "offload_optimizer_device",
+        "offload_param_device",
+        "zero3_init_flag",
+        "zero3_save_16bit_model",
+        "use_megatron_lm",
+        "megatron_lm_tp_degree",
+        "megatron_lm_pp_degree",
+        "megatron_lm_num_micro_batches",
+        "megatron_lm_sequence_parallelism",
+        "megatron_lm_recompute_activations",
+        "megatron_lm_use_distributed_optimizer",
+        "megatron_lm_gradient_clipping",
+        "fp8_backend",
+        "fp8_format",
+        "fp8_margin",
+        "fp8_interval",
+        "fp8_amax_history_len",
+        "fp8_amax_compute_algo",
+        "main_training_function",
+        "num_cpu_threads_per_process",
+        "env",
+    ):
+        merged[dest] = pick(getattr(args, dest, None), getattr(cfg, dest, None))
     return merged
 
 
@@ -117,11 +321,67 @@ def build_env(merged: dict, debug: bool = False, cpu: bool = False) -> dict:
             env[f"ACCELERATE_PARALLELISM_{axis.upper()}"] = str(size)
     if merged["use_fsdp"]:
         env["ACCELERATE_USE_FSDP"] = "1"
-        env["FSDP_SHARDING_STRATEGY"] = str(merged["fsdp_sharding_strategy"])
+        strategy = merged["fsdp_sharding_strategy"]
+        if merged.get("fsdp_reshard_after_forward") is not None:
+            # FSDP2 spelling: true == FULL_SHARD, false == SHARD_GRAD_OP.
+            strategy = (
+                "FULL_SHARD" if _flag_bool(merged["fsdp_reshard_after_forward"]) else "SHARD_GRAD_OP"
+            )
+        env["FSDP_SHARDING_STRATEGY"] = str(strategy)
         env["FSDP_MIN_NUM_PARAMS"] = str(merged["fsdp_min_num_params"])
-    if merged.get("deepspeed_config_file"):
+        if _flag_bool(merged.get("fsdp_offload_params")) or _flag_bool(merged.get("fsdp_cpu_offload")):
+            env["FSDP_CPU_OFFLOAD"] = "1"
+        if merged.get("fsdp_transformer_layer_cls_to_wrap"):
+            env["FSDP_TRANSFORMER_CLS_TO_WRAP"] = str(merged["fsdp_transformer_layer_cls_to_wrap"])
+        if merged.get("fsdp_state_dict_type"):
+            env["FSDP_STATE_DICT_TYPE"] = str(merged["fsdp_state_dict_type"])
+        if _flag_bool(merged.get("fsdp_activation_checkpointing")):
+            env["FSDP_ACTIVATION_CHECKPOINTING"] = "1"
+    if merged.get("deepspeed_config_file") or merged.get("use_deepspeed"):
         env["ACCELERATE_USE_DEEPSPEED"] = "true"
-        env["ACCELERATE_DEEPSPEED_CONFIG_FILE"] = str(merged["deepspeed_config_file"])
+        if merged.get("deepspeed_config_file"):
+            env["ACCELERATE_DEEPSPEED_CONFIG_FILE"] = str(merged["deepspeed_config_file"])
+        for dest, var in (
+            ("zero_stage", "ACCELERATE_DEEPSPEED_ZERO_STAGE"),
+            ("offload_optimizer_device", "ACCELERATE_DEEPSPEED_OFFLOAD_OPTIMIZER_DEVICE"),
+            ("offload_param_device", "ACCELERATE_DEEPSPEED_OFFLOAD_PARAM_DEVICE"),
+            ("zero3_init_flag", "ACCELERATE_DEEPSPEED_ZERO3_INIT"),
+            ("zero3_save_16bit_model", "ACCELERATE_DEEPSPEED_ZERO3_SAVE_16BIT_MODEL"),
+        ):
+            if merged.get(dest) is not None:
+                env[var] = str(merged[dest])
+    if merged.get("use_megatron_lm"):
+        env["ACCELERATE_USE_MEGATRON_LM"] = "true"
+        for dest, var in (
+            ("megatron_lm_tp_degree", "MEGATRON_LM_TP_DEGREE"),
+            ("megatron_lm_pp_degree", "MEGATRON_LM_PP_DEGREE"),
+            ("megatron_lm_num_micro_batches", "MEGATRON_LM_NUM_MICRO_BATCHES"),
+            ("megatron_lm_sequence_parallelism", "MEGATRON_LM_SEQUENCE_PARALLELISM"),
+            ("megatron_lm_recompute_activations", "MEGATRON_LM_RECOMPUTE_ACTIVATIONS"),
+            ("megatron_lm_use_distributed_optimizer", "MEGATRON_LM_USE_DISTRIBUTED_OPTIMIZER"),
+            ("megatron_lm_gradient_clipping", "MEGATRON_LM_GRADIENT_CLIPPING"),
+        ):
+            if merged.get(dest) is not None:
+                env[var] = str(merged[dest])
+    if merged.get("gradient_clipping") is not None:
+        env["ACCELERATE_GRADIENT_CLIPPING"] = str(merged["gradient_clipping"])
+    for dest, var in (
+        ("fp8_backend", "ACCELERATE_FP8_BACKEND"),
+        ("fp8_format", "ACCELERATE_FP8_FORMAT"),
+        ("fp8_margin", "ACCELERATE_FP8_MARGIN"),
+        ("fp8_interval", "ACCELERATE_FP8_INTERVAL"),
+        ("fp8_amax_history_len", "ACCELERATE_FP8_AMAX_HISTORY_LEN"),
+        ("fp8_amax_compute_algo", "ACCELERATE_FP8_AMAX_COMPUTE_ALGO"),
+        ("main_training_function", "ACCELERATE_MAIN_TRAINING_FUNCTION"),
+    ):
+        if merged.get(dest) is not None:
+            env[var] = str(merged[dest])
+    if merged.get("num_cpu_threads_per_process"):
+        env["OMP_NUM_THREADS"] = str(merged["num_cpu_threads_per_process"])
+    for pair in merged.get("env") or []:
+        key, _, value = str(pair).partition("=")
+        if key:
+            env[key] = value
     if debug:
         env["ACCELERATE_DEBUG_MODE"] = "1"
     if cpu:
@@ -148,10 +408,27 @@ def _script_cmd(args) -> list:
 
 
 def launch_command(args):
+    if getattr(args, "aws_access_key_id", None) or getattr(args, "aws_secret_access_key", None):
+        from ..utils.launch import prepare_sagemager_args_inputs
+
+        prepare_sagemager_args_inputs(None, args)  # documented out-of-scope error
+    _warn_unsupported(args)
     cfg = load_config(args.config_file)
     merged = _merge(args, cfg)
     if args.num_processes:
         merged["num_processes"] = args.num_processes
+
+    if getattr(args, "dry_run", False):
+        import json
+
+        env = build_env(merged, debug=args.debug, cpu=args.cpu)
+        contract = {
+            k: v
+            for k, v in env.items()
+            if k.startswith(("ACCELERATE_", "FSDP_", "MEGATRON_LM_", "OMP_", "JAX_"))
+        }
+        print(json.dumps(contract, indent=2, sort_keys=True))
+        return
 
     if args.debug_cpu and args.debug_cpu > 1:
         return _debug_cpu_launch(args, merged)

@@ -39,6 +39,13 @@ def _merge_orbax(in_dir: str, out_dir: str) -> None:
             for k, v in tree.items():
                 walk(v, f"{prefix}{k}.")
             return
+        # Orbax can restore list/tuple nodes; np.asarray on one would stack the
+        # whole sequence under a single flattened key (or raise on ragged
+        # members) — recurse with index keys to keep the structure explicit.
+        if isinstance(tree, (list, tuple)):
+            for i, v in enumerate(tree):
+                walk(v, f"{prefix}{i}.")
+            return
         flat[prefix[:-1]] = np.asarray(jax.device_get(tree))
 
     walk(restored)

@@ -379,6 +379,13 @@ class _GlobalBatchPlacer:
         sharding = NamedSharding(self.mesh, PartitionSpec(self._data_axes))
         local_shards = self.local_data_shards
         multi_host = jax.process_count() > 1
+        # Rows added to THIS batch to make it shard-divisible, plus the padded
+        # per-host row count; the owning loader publishes both on GradientState
+        # so gather_for_metrics can drop exactly the duplicates — and ONLY from
+        # tensors whose leading dim is the padded batch (not from arbitrary
+        # gathered vectors).
+        self.last_pad_rows = 0
+        self.last_batch_rows = 0
 
         def _place(t):
             arr = to_numpy(t)
@@ -386,9 +393,7 @@ class _GlobalBatchPlacer:
                 return self._wrap(arr, jax.device_put(arr, NamedSharding(self.mesh, PartitionSpec())))
             if arr.shape[0] % local_shards != 0:
                 # Pad the batch dim by repeating the final row so GSPMD can split
-                # it; device-level analog of even_batches wraparound.  The true
-                # batch size is tracked by GradientState.remainder for
-                # gather_for_metrics dedup.
+                # it; device-level analog of even_batches wraparound.
                 if not self._warned_pad:
                     warnings.warn(
                         f"Per-host batch dim {arr.shape[0]} not divisible by {local_shards} local "
@@ -398,6 +403,11 @@ class _GlobalBatchPlacer:
                     self._warned_pad = True
                 pad = local_shards - arr.shape[0] % local_shards
                 arr = np.concatenate([arr, np.repeat(arr[-1:], pad, axis=0)], axis=0)
+                # Rows recorded from the PADDED leaf itself — a non-batch leaf
+                # with a larger leading dim must not disable the pad-drop
+                # (gather_for_metrics matches on n_proc * last_batch_rows).
+                self.last_pad_rows = max(self.last_pad_rows, pad)
+                self.last_batch_rows = max(self.last_batch_rows, arr.shape[0])
             if multi_host:
                 # ``arr`` must be exactly this host's shard of the global batch.
                 return self._wrap(arr, jax.make_array_from_process_local_data(sharding, arr))
@@ -429,6 +439,8 @@ class DataLoaderStateMixin:
         self.gradient_state._add_dataloader(self)
 
     def end(self):
+        self.gradient_state.device_pad_rows = 0
+        self.gradient_state.device_batch_rows = 0
         self.gradient_state._remove_dataloader(self)
 
 
@@ -536,29 +548,42 @@ class DataLoaderShard(DataLoaderStateMixin):
             return
         batch_index = 0
         current_converted = None
+        current_pad = (0, 0)
+
+        def _convert_tracked(b):
+            out = self._convert(b)
+            if self._placer is None:
+                return out, (0, 0)
+            return out, (self._placer.last_pad_rows, self._placer.last_batch_rows)
+
         while True:
             if current_converted is None and batch_index >= self.skip_batches:
-                current_converted = self._convert(current)
+                current_converted, current_pad = _convert_tracked(current)
             try:
                 upcoming = next(iterator)
             except StopIteration:
                 self.end_of_dataloader = True
                 self._update_state_dict()
                 if batch_index >= self.skip_batches:
+                    self.gradient_state.device_pad_rows = current_pad[0]
+                    self.gradient_state.device_batch_rows = current_pad[1]
                     yield current_converted
                 break
             # Double buffering (reference MpDeviceLoader's background preload,
             # data_loader.py:643-693): issue batch n+1's async device transfer
             # BEFORE yielding batch n, so the H2D overlaps the user's step.
-            upcoming_converted = (
-                self._convert(upcoming) if batch_index + 1 >= self.skip_batches else None
-            )
+            if batch_index + 1 >= self.skip_batches:
+                upcoming_converted, upcoming_pad = _convert_tracked(upcoming)
+            else:
+                upcoming_converted, upcoming_pad = None, (0, 0)
             self._update_state_dict()
             if batch_index >= self.skip_batches:
+                self.gradient_state.device_pad_rows = current_pad[0]
+                self.gradient_state.device_batch_rows = current_pad[1]
                 yield current_converted
             batch_index += 1
             current = upcoming
-            current_converted = upcoming_converted
+            current_converted, current_pad = upcoming_converted, upcoming_pad
         self.iteration += 1
         self.end()
 
@@ -713,7 +738,10 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
                     num_processes=self.state.num_processes,
                 )
         if self._placer is not None:
-            return self._placer(global_batch)
+            placed = self._placer(global_batch)
+            self.gradient_state.device_pad_rows = self._placer.last_pad_rows
+            self.gradient_state.device_batch_rows = self._placer.last_batch_rows
+            return placed
         return global_batch
 
 
@@ -835,11 +863,16 @@ def prepare_data_loader(
     sampler = get_sampler(dataloader)
 
     if isinstance(dataset, torch.utils.data.IterableDataset):
-        if split_batches:
-            host_batch_size = (dataloader.batch_size or 1) // num_processes
-            shard_batch_size = dataloader.batch_size or 1
+        if dataloader.batch_size is None:
+            # Sample streaming (reference: batch_size=None passes items through
+            # unbatched); multi-host shards round-robin by sample.
+            host_batch_size = None
+            shard_batch_size = 1
+        elif split_batches:
+            host_batch_size = dataloader.batch_size // num_processes
+            shard_batch_size = dataloader.batch_size
         else:
-            host_batch_size = (dataloader.batch_size or 1) * local_shards
+            host_batch_size = dataloader.batch_size * local_shards
             shard_batch_size = host_batch_size
         new_dataset = (
             IterableDatasetShard(

@@ -37,6 +37,33 @@ __all__ = [
 ]
 
 
+def _send_to_torch_device(obj, device, skip_keys=None):
+    """Recursively move torch tensors to a torch device, skipping Mapping keys
+    in ``skip_keys`` at every nesting level (reference ``send_to_device``
+    semantics, but torch-side: hooks run in the eager torch world — the jax
+    transfer happens in the lowered bridge, not here)."""
+    import torch
+
+    if isinstance(skip_keys, str):
+        skip_keys = [skip_keys]
+    skip_keys = skip_keys or []
+    if isinstance(obj, Mapping):
+        return type(obj)(
+            {
+                k: (v if k in skip_keys else _send_to_torch_device(v, device, skip_keys))
+                for k, v in obj.items()
+            }
+        )
+    if isinstance(obj, (tuple, list)):
+        from .utils.operations import honor_type
+
+        # honor_type reconstructs namedtuples (type(obj)(generator) cannot).
+        return honor_type(obj, (_send_to_torch_device(t, device, skip_keys) for t in obj))
+    if isinstance(obj, torch.Tensor):
+        return obj.to(device)
+    return obj
+
+
 class ModelHook:
     """Reference ``hooks.py:43-98`` protocol."""
 
@@ -141,9 +168,24 @@ def named_module_tensors(module, include_buffers: bool = True, recurse: bool = F
             yield name, buf
 
 
-def set_module_tensor_to_device(module, tensor_name: str, device, value=None, dtype=None):
+def set_module_tensor_to_device(
+    module,
+    tensor_name: str,
+    device,
+    value=None,
+    dtype=None,
+    tied_params_map: Optional[dict] = None,
+    tied_key=None,
+):
     """Move/replace one tensor of a torch module (reference
-    ``utils/modeling.py set_module_tensor_to_device``)."""
+    ``utils/modeling.py set_module_tensor_to_device``).
+
+    ``tied_params_map``/``tied_key``: dedup storage for tied parameters
+    (reference ``big_modeling.py:410-424``): when the map already holds a
+    materialized tensor for ``(tied_key, device)``, that tensor is REUSED (the
+    new Parameter shares its storage — no second allocation); otherwise the
+    freshly materialized tensor is recorded so later tied siblings reuse it.
+    """
     import torch
 
     if "." in tensor_name:
@@ -153,7 +195,13 @@ def set_module_tensor_to_device(module, tensor_name: str, device, value=None, dt
         tensor_name = splits[-1]
     is_buffer = tensor_name in module._buffers
     old = module._buffers[tensor_name] if is_buffer else module._parameters[tensor_name]
-    if value is not None:
+
+    cached = None
+    if tied_params_map is not None and tied_key is not None:
+        cached = tied_params_map.setdefault(tied_key, {}).get(str(device))
+    if cached is not None:
+        new_tensor = cached
+    elif value is not None:
         if isinstance(value, np.ndarray) or not isinstance(value, torch.Tensor):
             arr = np.asarray(value)
             if arr.dtype.name == "bfloat16":  # ml_dtypes bfloat16 -> torch view
@@ -165,12 +213,16 @@ def set_module_tensor_to_device(module, tensor_name: str, device, value=None, dt
         new_tensor = value.to(device)
     else:
         new_tensor = old.to(device)
+    if cached is None and tied_params_map is not None and tied_key is not None and str(device) != "meta":
+        tied_params_map[tied_key][str(device)] = new_tensor
     if is_buffer:
         module._buffers[tensor_name] = new_tensor
     else:
         requires_grad = (
             bool(old.requires_grad) if old is not None else False
         ) and new_tensor.is_floating_point()
+        # torch.nn.Parameter shares the data storage — tied reuse stays a
+        # single allocation per device.
         module._parameters[tensor_name] = torch.nn.Parameter(new_tensor, requires_grad=requires_grad)
 
 
@@ -191,6 +243,9 @@ class AlignDevicesHook(ModelHook):
         weights_map: Optional[Mapping] = None,
         offload_buffers: bool = False,
         place_submodules: bool = False,
+        skip_keys=None,
+        tied_params_map: Optional[dict] = None,
+        tied_names: Optional[Mapping] = None,
     ):
         self.execution_device = execution_device or "cpu"
         self.offload = offload
@@ -198,11 +253,25 @@ class AlignDevicesHook(ModelHook):
         self.weights_map = weights_map
         self.offload_buffers = offload_buffers
         self.place_submodules = place_submodules
+        # Input/output pytree keys that must NOT be moved between devices
+        # (reference hooks.py:253 ``skip_keys`` — e.g. a past_key_values cache
+        # the caller wants to keep where it is).
+        self.skip_keys = skip_keys
+        # Tied-parameter dedup (reference big_modeling.py:410-424):
+        # ``tied_names`` maps a full weight name -> its group's canonical key;
+        # ``tied_params_map[canonical][device]`` holds the one materialized
+        # tensor every tied sibling shares on that device.
+        self.tied_params_map = tied_params_map
+        self.tied_names = tied_names or {}
+        self._tied_added: set = set()
         self.original_devices = {}
         self.input_device = None
         # Weight keys of upcoming block(s), queued on the native prefetch pool
         # at this block's pre_forward (wired by wire_sequential_prefetch).
         self.prefetch_next: list = []
+
+    def _tied_key(self, full_name):
+        return self.tied_names.get(full_name) if self.tied_params_map is not None else None
 
     def init_hook(self, module):
         if self.offload:
@@ -219,8 +288,17 @@ class AlignDevicesHook(ModelHook):
             ):
                 set_module_tensor_to_device(module, name, "meta")
         elif self.execution_device not in (None, "cpu"):
+            prefix = getattr(module, "_hook_weights_prefix", "")
             for name, _ in named_module_tensors(module, recurse=self.place_submodules):
-                set_module_tensor_to_device(module, name, self.execution_device)
+                # Resident placement: tied weights materialize ONCE per device
+                # across all hooked modules (persistent dedup).
+                set_module_tensor_to_device(
+                    module,
+                    name,
+                    self.execution_device,
+                    tied_params_map=self.tied_params_map,
+                    tied_key=self._tied_key(prefix + name),
+                )
         return module
 
     def pre_forward(self, module, *args, **kwargs):
@@ -240,8 +318,27 @@ class AlignDevicesHook(ModelHook):
             for name, _ in named_module_tensors(
                 module, include_buffers=self.offload_buffers, recurse=self.place_submodules
             ):
-                value = self.weights_map[prefix + name]
-                set_module_tensor_to_device(module, name, self.execution_device, value=value)
+                tied_key = self._tied_key(prefix + name)
+                already = (
+                    tied_key is not None
+                    and str(self.execution_device) in self.tied_params_map.get(tied_key, {})
+                )
+                # A tied sibling already materialized this weight on the
+                # execution device: skip the weights_map load entirely.
+                value = None if already else self.weights_map[prefix + name]
+                if tied_key is not None and not already:
+                    self._tied_added.add(tied_key)
+                set_module_tensor_to_device(
+                    module,
+                    name,
+                    self.execution_device,
+                    value=value,
+                    tied_params_map=self.tied_params_map,
+                    tied_key=tied_key,
+                )
+        if self.skip_keys is not None and self.execution_device not in (None, "cpu"):
+            args = _send_to_torch_device(args, self.execution_device, self.skip_keys)
+            kwargs = _send_to_torch_device(kwargs, self.execution_device, self.skip_keys)
         return args, kwargs
 
     def post_forward(self, module, output):
@@ -250,11 +347,15 @@ class AlignDevicesHook(ModelHook):
                 module, include_buffers=self.offload_buffers, recurse=self.place_submodules
             ):
                 set_module_tensor_to_device(module, name, "meta")
+            # Free the tied tensors THIS hook materialized (reference
+            # hooks.py:386-397): siblings inside this forward reused them;
+            # keeping them would pin the dedup copy in RAM past the block.
+            if self.tied_params_map is not None:
+                for key in self._tied_added:
+                    self.tied_params_map.get(key, {}).pop(str(self.execution_device), None)
+                self._tied_added.clear()
         if self.io_same_device and self.input_device is not None:
-            import torch
-
-            if isinstance(output, torch.Tensor):
-                output = output.to(self.input_device)
+            output = _send_to_torch_device(output, self.input_device, self.skip_keys)
         return output
 
     def detach_hook(self, module):
@@ -275,6 +376,9 @@ def attach_align_device_hook(
     weights_map: Optional[Mapping] = None,
     offload_buffers: bool = False,
     module_name: str = "",
+    skip_keys=None,
+    tied_params_map: Optional[dict] = None,
+    tied_names: Optional[Mapping] = None,
 ):
     """Attach AlignDevicesHooks to every leaf module holding weights (reference
     ``hooks.py:460``)."""
@@ -288,6 +392,9 @@ def attach_align_device_hook(
                 offload=offload,
                 weights_map=weights_map,
                 offload_buffers=offload_buffers,
+                skip_keys=skip_keys,
+                tied_params_map=tied_params_map,
+                tied_names=tied_names,
             ),
             append=True,
         )
@@ -300,6 +407,9 @@ def attach_align_device_hook(
             weights_map=weights_map,
             offload_buffers=offload_buffers,
             module_name=full,
+            skip_keys=skip_keys,
+            tied_params_map=tied_params_map,
+            tied_names=tied_names,
         )
 
 
@@ -310,6 +420,9 @@ def attach_align_device_hook_on_blocks(
     weights_map: Optional[Mapping] = None,
     offload_buffers: bool = False,
     module_name: str = "",
+    skip_keys=None,
+    tied_params_map: Optional[dict] = None,
+    tied_names: Optional[Mapping] = None,
 ):
     """Per-block variant driven by a device map (reference ``hooks.py:555``).
 
@@ -330,10 +443,21 @@ def attach_align_device_hook_on_blocks(
                 weights_map=weights_map,
                 offload_buffers=offload_buffers,
                 module_name=module_name,
+                skip_keys=skip_keys,
+                tied_params_map=tied_params_map,
+                tied_names=tied_names,
             )
         else:
+            module._hook_weights_prefix = f"{module_name}." if module_name else ""
             add_hook_to_module(
-                module, AlignDevicesHook(execution_device[module_name], io_same_device=not module_name)
+                module,
+                AlignDevicesHook(
+                    execution_device[module_name],
+                    io_same_device=not module_name,
+                    skip_keys=skip_keys,
+                    tied_params_map=tied_params_map,
+                    tied_names=tied_names,
+                ),
             )
         return
     for child_name, child in module.named_children():
@@ -345,6 +469,9 @@ def attach_align_device_hook_on_blocks(
             weights_map=weights_map,
             offload_buffers=offload_buffers,
             module_name=full,
+            skip_keys=skip_keys,
+            tied_params_map=tied_params_map,
+            tied_names=tied_names,
         )
 
 

@@ -161,6 +161,15 @@ def beam_search(
     Beams that emit ``eos_token_id`` freeze: their score stops accumulating
     and they pad with EOS.  Final ranking divides by ``length**length_penalty``
     (>1 favors longer sequences, <1 shorter).
+
+    Cache contract: every cache leaf with ``ndim >= 2`` MUST carry the batch
+    on **axis 1** (the bundled families' ``[L, B, max_len, K, hd]`` layout from
+    :func:`make_kv_cache` does).  Beam tiling/reordering identifies
+    batch-bearing leaves by ``leaf.shape[1] == batch`` (then ``== batch*K``
+    inside the scan); a custom ``init_cache`` whose batch lives on another
+    axis — or a non-batch leaf whose axis-1 size coincides with the batch —
+    is silently mis-tiled.  Scalar/1-D leaves (e.g. the write index) are
+    left untouched.
     """
     if max_new_tokens < 1:
         raise ValueError("beam search needs max_new_tokens >= 1")
@@ -186,6 +195,11 @@ def beam_search(
     )
     logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)  # [B, V]
     vocab = logp.shape[-1]
+    if kbeams > vocab:
+        raise ValueError(
+            f"num_beams ({kbeams}) > vocab_size ({vocab}): top_k cannot select "
+            "more beams than there are tokens"
+        )
 
     # First expansion: the top-K tokens of the single (shared) beam.
     scores, tokens = jax.lax.top_k(logp, kbeams)  # [B, K]

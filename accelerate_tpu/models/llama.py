@@ -409,12 +409,17 @@ def apply(
     """Forward pass: token ids [B, S] -> logits [B, S, V] (fp32)."""
     c = config
     b, s = input_ids.shape
-    if positions is None:
-        positions = jnp.broadcast_to(jnp.arange(s), (b, s))
     # Padding stays factored as a [B, S] key-validity vector all the way down —
     # every attention path (flash blocks, ring chunks, ulysses all-gather,
     # einsum) applies it without materializing a [B, S, S] mask here.
     kv_valid = attention_mask.astype(bool) if attention_mask is not None else None
+    if positions is None:
+        if kv_valid is not None:
+            # Upstream-stack semantics: positions count real tokens, so
+            # left-padded prompts get correct RoPE offsets.
+            positions = jnp.maximum(jnp.cumsum(kv_valid.astype(jnp.int32), axis=-1) - 1, 0)
+        else:
+            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
 
     x = embed_tokens(params, input_ids, c)
     act_spec = P(("dcn_dp", "dp", "fsdp"), "sp", None)

@@ -150,7 +150,11 @@ def pipeline_llama_apply(
 
     Padded batches: the [B, S] key-validity vector rides the pipeline schedule
     alongside its microbatch's activations (a pass-through state leaf), so each
-    stage masks with the right microbatch's padding.  Default positions only.
+    stage masks with the right microbatch's padding.  When a mask is supplied,
+    RoPE positions are derived from it as ``cumsum(mask) - 1`` (clipped at 0)
+    and ride the schedule too, so left-padded prompts get the same positions
+    the upstream stack derives from ``attention_mask``; without a mask,
+    positions are ``arange(S)`` (dense batches).
     """
     from ..models import llama
 
@@ -168,10 +172,11 @@ def pipeline_llama_apply(
     stage_layers = stack_pipeline_stages(params["layers"], num_stages)
     has_valid = attention_mask is not None
 
-    def run_layers(lp, h, kv_valid=None):
+    def run_layers(lp, h, kv_valid=None, pos=None):
         def body(carry, one_layer):
             return llama._layer(
-                carry, one_layer, config=c, mask=None, positions=positions,
+                carry, one_layer, config=c, mask=None,
+                positions=positions if pos is None else pos,
                 act_spec=None, kv_valid=kv_valid,
             )
 
@@ -181,17 +186,29 @@ def pipeline_llama_apply(
         return h
 
     if has_valid:
-        state = {"h": x, "valid": attention_mask.astype(bool)}
+        valid = attention_mask.astype(bool)
+        # Mask-derived positions (upstream-stack semantics): padded slots clip
+        # to 0, real tokens count from 0 regardless of left/right padding.
+        mask_positions = jnp.maximum(jnp.cumsum(valid.astype(jnp.int32), axis=-1) - 1, 0)
+        state = {"h": x, "valid": valid, "pos": mask_positions}
 
         def stage_fn(lp, st):
-            return {"h": run_layers(lp, st["h"], kv_valid=st["valid"]), "valid": st["valid"]}
+            return {
+                "h": run_layers(lp, st["h"], kv_valid=st["valid"], pos=st["pos"]),
+                "valid": st["valid"],
+                "pos": st["pos"],
+            }
 
         out = pipeline_apply(
             stage_fn,
             stage_layers,
             state,
             num_micro_batches=num_micro_batches,
-            state_spec={"h": (data_spec, None, None), "valid": (data_spec, None)},
+            state_spec={
+                "h": (data_spec, None, None),
+                "valid": (data_spec, None),
+                "pos": (data_spec, None),
+            },
         )
         x = out["h"]
     else:

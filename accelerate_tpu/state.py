@@ -122,6 +122,18 @@ class PartialState:
             os.environ["JAX_PLATFORMS"] = "cpu"
         honor_cpu_platform_env()
 
+        # First-touch pre-flight (shared with bench.py / `accelerate-tpu env`):
+        # a wedged device tunnel blocks backend init inside a C call forever;
+        # probe it in a killable subprocess so bring-up fails in seconds with
+        # an actionable error.  No-op when the platform is cpu-only, cached
+        # per process, opt-out via ACCELERATE_DEVICE_PREFLIGHT=0.
+        if not cpu:
+            from .utils.device_probe import preflight_check
+
+            preflight_check(
+                timeout_s=float(os.environ.get("ACCELERATE_DEVICE_PREFLIGHT_TIMEOUT_S", "60"))
+            )
+
         self._maybe_init_distributed(init_kwargs)
 
         self.platform = _probe_platform()
@@ -552,6 +564,12 @@ class GradientState:
                 else {}
             )
             self._is_xla_gradients_synced = False
+            # Per-process rows the device placer appended to the CURRENT batch
+            # to make it shard-divisible, and the resulting padded per-process
+            # row count; gather_for_metrics drops the pads — only from tensors
+            # whose leading dim matches device_batch_rows.
+            self.device_pad_rows = 0
+            self.device_batch_rows = 0
         if gradient_accumulation_plugin is not None and self.plugin_kwargs != (
             gradient_accumulation_plugin.to_kwargs()
         ):
@@ -596,13 +614,40 @@ class GradientState:
     def _set_sync_gradients(self, sync_gradients: bool) -> None:
         self.sync_gradients = sync_gradients
 
+    # The registry holds WEAK references (reference state.py:1191 "weakref'd
+    # active-dataloader stack"): an abandoned mid-iteration loader must not be
+    # pinned alive by the singleton.
+    @property
+    def active_dataloader(self):
+        ref = self.__dict__.get("_active_dataloader_ref")
+        return ref() if ref is not None else None
+
+    @active_dataloader.setter
+    def active_dataloader(self, dataloader) -> None:
+        import weakref
+
+        self._active_dataloader_ref = (
+            weakref.ref(dataloader) if dataloader is not None else None
+        )
+
     def _add_dataloader(self, dataloader) -> None:
+        import weakref
+
         self.active_dataloader = dataloader
-        self.dataloader_references.append(dataloader)
+        self.dataloader_references.append(weakref.ref(dataloader))
 
     def _remove_dataloader(self, dataloader) -> None:
-        self.dataloader_references.remove(dataloader)
-        self.active_dataloader = self.dataloader_references[-1]
+        kept = [None]
+        for ref in self.dataloader_references:
+            if ref is None:
+                continue
+            obj = ref()
+            if obj is None or obj is dataloader:
+                continue
+            kept.append(ref)
+        self.dataloader_references = kept
+        top = kept[-1]
+        self.active_dataloader = top() if top is not None else None
 
     @classmethod
     def _reset_state(cls) -> None:

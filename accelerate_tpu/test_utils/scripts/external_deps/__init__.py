@@ -1,0 +1,3 @@
+"""Bound-enforcing regression scripts (reference
+``test_utils/scripts/external_deps/`` — there they need transformers/datasets;
+here they are self-contained synthetic tasks, same oracles)."""

@@ -1,0 +1,103 @@
+"""``gather_for_metrics`` oracle vs single-process ground truth (reference
+``external_deps/test_metrics.py:307``).
+
+Contract under test: metrics computed from ``gather_for_metrics`` outputs on N
+processes equal the single-process metric exactly — the dedup must drop the
+even-batches padding (tail wraparound) and nothing else, for tensors, tensor
+tuples, and non-tensor objects, on both map-style and dispatcher/iterable
+paths.
+
+Run:
+    accelerate-tpu launch -m accelerate_tpu.test_utils.scripts.external_deps.test_metrics
+"""
+
+from __future__ import annotations
+
+
+def _accuracy(preds, labels) -> float:
+    import numpy as np
+
+    return float((np.asarray(preds) == np.asarray(labels)).mean())
+
+
+def test_metric_parity_uneven_tail(accelerator):
+    """Dataset length not divisible by (batch x processes): gathered sample
+    count equals the dataset length and the metric matches exactly."""
+    import torch
+    from torch.utils.data import DataLoader
+
+    n = 77  # deliberately awkward vs batch 8 x N processes
+    torch.manual_seed(0)
+    labels = torch.randint(0, 2, (n,))
+    # "Model": predicts label correctly except every 7th sample.
+    preds = labels.clone()
+    preds[::7] ^= 1
+    baseline = _accuracy(preds, labels)
+
+    ds = [{"pred": preds[i], "label": labels[i]} for i in range(n)]
+    dl = accelerator.prepare(DataLoader(ds, batch_size=8))
+    got_preds, got_labels = [], []
+    for batch in dl:
+        p, l = accelerator.gather_for_metrics((batch["pred"], batch["label"]))
+        got_preds.append(p)
+        got_labels.append(l)
+    got_preds = torch.cat(got_preds)
+    got_labels = torch.cat(got_labels)
+    assert got_preds.shape[0] == n, (got_preds.shape, n)
+    distributed = _accuracy(got_preds, got_labels)
+    assert abs(distributed - baseline) < 1e-9, (distributed, baseline)
+    accelerator.print(f"uneven-tail parity OK: accuracy {distributed:.4f} over {n}")
+
+
+def test_metric_parity_iterable(accelerator):
+    """Dispatcher path (iterable dataset): same count + parity contract."""
+    import torch
+    from torch.utils.data import DataLoader, IterableDataset
+
+    n = 30
+
+    class Stream(IterableDataset):
+        def __iter__(self):
+            for i in range(n):
+                yield {"x": torch.tensor([float(i)])}
+
+    dl = accelerator.prepare(DataLoader(Stream(), batch_size=4))
+    seen = []
+    for batch in dl:
+        seen.append(accelerator.gather_for_metrics(batch["x"]))
+    total = torch.cat(seen)
+    assert total.shape[0] == n, (total.shape, n)
+    expected = sum(range(n))
+    assert float(total.sum()) == expected, (float(total.sum()), expected)
+    accelerator.print(f"iterable parity OK: {n} samples, checksum {expected}")
+
+
+def test_gather_non_tensor_objects(accelerator):
+    """use_gather_object path: python objects survive the dedup."""
+    from torch.utils.data import DataLoader
+
+    n = 21
+    ds = [{"tag": f"s{i}"} for i in range(n)]
+    dl = accelerator.prepare(DataLoader(ds, batch_size=4, collate_fn=lambda b: [s["tag"] for s in b]))
+    got = []
+    for batch in dl:
+        got.extend(accelerator.gather_for_metrics(batch, use_gather_object=True))
+    assert len(got) == n, (len(got), n)
+    assert sorted(got) == sorted(f"s{i}" for i in range(n)), got[:5]
+    accelerator.print(f"object-gather parity OK: {n} objects")
+
+
+def main():
+    from accelerate_tpu import Accelerator
+
+    accelerator = Accelerator()
+    if accelerator.is_main_process:
+        print("**Testing gather_for_metrics parity**")
+    test_metric_parity_uneven_tail(accelerator)
+    test_metric_parity_iterable(accelerator)
+    test_gather_non_tensor_objects(accelerator)
+    accelerator.end_training()
+
+
+if __name__ == "__main__":
+    main()

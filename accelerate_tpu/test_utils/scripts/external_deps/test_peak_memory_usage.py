@@ -1,0 +1,86 @@
+"""Peak-memory ceiling regression (reference
+``external_deps/test_peak_memory_usage.py:314``: train one epoch, assert peak
+memory <= ``--peak_memory_upper_bound_mb``).
+
+TPU-native measurement: ``device.memory_stats()['peak_bytes_in_use']`` — the
+XLA allocator's high-water mark in HBM, the direct analog of the reference's
+``torch.cuda.max_memory_allocated``.  On backends without allocator stats
+(virtual CPU mesh) it falls back to the process RSS high-water mark
+(``ru_maxrss``), so the script is launchable everywhere; the bound only has
+HBM meaning on a real chip.
+
+Run:
+    accelerate-tpu launch -m accelerate_tpu.test_utils.scripts.external_deps.test_peak_memory_usage \
+        -- --peak_memory_upper_bound_mb 2000
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def measure_peak_mb() -> tuple[float, str]:
+    """(peak_mb, source): device allocator high-water mark, else process RSS."""
+    import jax
+
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        if stats and "peak_bytes_in_use" in stats:
+            return stats["peak_bytes_in_use"] / 2**20, "device.peak_bytes_in_use"
+    except Exception:
+        pass
+    import resource
+
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 2**10, "ru_maxrss"
+
+
+def training_function(args) -> float:
+    import torch
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils import set_seed
+
+    from .test_performance import get_dataloaders, make_model
+
+    set_seed(args.seed)
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    train_dl, _ = get_dataloaders(batch_size=args.batch_size)
+    model = make_model()
+    optimizer = torch.optim.AdamW(model.parameters(), lr=args.lr)
+    model, optimizer, train_dl = accelerator.prepare(model, optimizer, train_dl)
+
+    model.train()
+    for step, batch in enumerate(train_dl):
+        if step >= args.max_steps:
+            break
+        labels = batch.pop("labels")
+        logits = model(**batch)
+        loss = torch.nn.functional.cross_entropy(logits, labels)
+        accelerator.backward(loss)
+        optimizer.step()
+        optimizer.zero_grad()
+
+    peak_mb, source = measure_peak_mb()
+    accelerator.print(f"peak memory: {peak_mb:.1f} MB ({source})")
+    if args.peak_memory_upper_bound_mb is not None:
+        assert peak_mb <= args.peak_memory_upper_bound_mb, (
+            f"Peak memory {peak_mb:.1f} MB ({source}) exceeds the ceiling "
+            f"{args.peak_memory_upper_bound_mb} MB"
+        )
+    accelerator.end_training()
+    return peak_mb
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--peak_memory_upper_bound_mb", type=float, default=None)
+    parser.add_argument("--max_steps", type=int, default=16)
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--lr", type=float, default=2e-3)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--mixed_precision", type=str, default="no")
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,144 @@
+"""Performance lower-bound regression (reference
+``external_deps/test_performance.py:298``: train, evaluate, assert best metric
+>= ``--performance_lower_bound``).
+
+The reference trains BERT on GLUE/MRPC; this image has no network egress, so
+the task is a self-contained paraphrase classifier on synthetic pairs (same
+shape as ``examples/nlp_example.py``) — learnable to ~1.0 accuracy in one
+epoch, giving the bound real teeth.
+
+Run:
+    accelerate-tpu launch -m accelerate_tpu.test_utils.scripts.external_deps.test_performance \
+        -- --performance_lower_bound 0.9
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+VOCAB, SEQ = 512, 32
+
+
+def _make_pairs(n: int, seed: int):
+    """Positives are shuffled copies of sentence A; negatives independent."""
+    rng = np.random.default_rng(seed)
+    a = rng.integers(1, VOCAB, (n, SEQ))
+    labels = rng.integers(0, 2, n)
+    b = np.where(
+        labels[:, None] == 1, rng.permuted(a, axis=1), rng.integers(1, VOCAB, (n, SEQ))
+    )
+    return a, b, labels
+
+
+def get_dataloaders(batch_size: int):
+    import torch
+    from torch.utils.data import DataLoader
+
+    def to_samples(a, b, labels):
+        return [
+            {
+                "input_ids_a": torch.tensor(a[i]),
+                "input_ids_b": torch.tensor(b[i]),
+                "labels": int(labels[i]),
+            }
+            for i in range(len(labels))
+        ]
+
+    def collate(samples):
+        return {
+            "input_ids_a": torch.stack([s["input_ids_a"] for s in samples]),
+            "input_ids_b": torch.stack([s["input_ids_b"] for s in samples]),
+            "labels": torch.tensor([s["labels"] for s in samples]),
+        }
+
+    train = to_samples(*_make_pairs(512, seed=0))
+    val = to_samples(*_make_pairs(128, seed=1))
+    return (
+        DataLoader(train, shuffle=True, collate_fn=collate, batch_size=batch_size),
+        DataLoader(val, shuffle=False, collate_fn=collate, batch_size=32),
+    )
+
+
+def make_model():
+    import torch
+
+    class PairClassifier(torch.nn.Module):
+        def __init__(self, vocab=VOCAB, dim=64):
+            super().__init__()
+            self.embed = torch.nn.Embedding(vocab, dim)
+            self.head = torch.nn.Sequential(
+                torch.nn.Linear(4 * dim, 128), torch.nn.GELU(), torch.nn.Linear(128, 2)
+            )
+
+        def forward(self, input_ids_a, input_ids_b):
+            a = self.embed(input_ids_a).mean(dim=1)
+            b = self.embed(input_ids_b).mean(dim=1)
+            feats = torch.cat([a, b, torch.abs(a - b), a * b], dim=1)
+            return self.head(feats)
+
+    return PairClassifier()
+
+
+def training_function(args) -> float:
+    import torch
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils import set_seed
+
+    set_seed(args.seed)
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    train_dl, eval_dl = get_dataloaders(batch_size=args.batch_size)
+    model = make_model()
+    optimizer = torch.optim.AdamW(model.parameters(), lr=args.lr)
+    model, optimizer, train_dl, eval_dl = accelerator.prepare(
+        model, optimizer, train_dl, eval_dl
+    )
+
+    best = 0.0
+    for epoch in range(args.num_epochs):
+        model.train()
+        for batch in train_dl:
+            labels = batch.pop("labels")
+            logits = model(**batch)
+            loss = torch.nn.functional.cross_entropy(logits, labels)
+            accelerator.backward(loss)
+            optimizer.step()
+            optimizer.zero_grad()
+        model.eval()
+        correct = total = 0
+        for batch in eval_dl:
+            labels = batch.pop("labels")
+            with torch.no_grad():
+                logits = model(**batch)
+            preds = logits.argmax(dim=-1)
+            preds, labels = accelerator.gather_for_metrics((preds, labels))
+            correct += int((preds == labels).sum())
+            total += int(labels.numel())
+        acc = correct / max(total, 1)
+        accelerator.print(f"epoch {epoch}: accuracy {acc:.3f}")
+        best = max(best, acc)
+
+    if args.performance_lower_bound is not None:
+        assert args.performance_lower_bound <= best, (
+            f"Best performance metric {best} is lower than the lower bound "
+            f"{args.performance_lower_bound}"
+        )
+    accelerator.end_training()
+    return best
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--performance_lower_bound", type=float, default=None)
+    parser.add_argument("--num_epochs", type=int, default=2)
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--lr", type=float, default=2e-3)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--mixed_precision", type=str, default="no")
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -154,6 +154,19 @@ class TensorBoardTracker(GeneralTracker):
         self.writer.flush()
 
     @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs):
+        """Log a dict of image batches (reference ``tracking.py:253``): each
+        value is an [N, H, W, C] (or [N, C, H, W]) array."""
+        import numpy as np
+
+        explicit_format = kwargs.pop("dataformats", None)
+        for k, v in values.items():
+            arr = np.asarray(v)
+            dataformats = explicit_format or ("NHWC" if arr.shape[-1] in (1, 3, 4) else "NCHW")
+            self.writer.add_images(k, arr, global_step=step, dataformats=dataformats, **kwargs)
+        self.writer.flush()
+
+    @on_main_process
     def finish(self):
         self.writer.close()
 
@@ -183,6 +196,34 @@ class WandBTracker(GeneralTracker):
     @on_main_process
     def log(self, values: dict, step: Optional[int] = None, **kwargs):
         self.run.log(values, step=step, **kwargs)
+
+    @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs):
+        """Log image lists as ``wandb.Image``s (reference ``tracking.py:343``)."""
+        import wandb
+
+        for k, v in values.items():
+            self.log({k: [wandb.Image(image) for image in v]}, step=step, **kwargs)
+
+    @on_main_process
+    def log_table(
+        self,
+        table_name: str,
+        columns: Optional[list] = None,
+        data: Optional[list] = None,
+        dataframe=None,
+        step: Optional[int] = None,
+        **kwargs,
+    ):
+        """Log a ``wandb.Table`` from columns+data or a dataframe (reference
+        ``tracking.py:362``)."""
+        import wandb
+
+        self.log(
+            {table_name: wandb.Table(columns=columns, data=data, dataframe=dataframe)},
+            step=step,
+            **kwargs,
+        )
 
     @on_main_process
     def finish(self):
@@ -251,6 +292,20 @@ class AimTracker(GeneralTracker):
             self.writer.track(v, name=k, step=step, **kwargs)
 
     @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, kwargs: Optional[dict] = None):
+        """Track images as ``aim.Image``s (reference ``tracking.py:553``);
+        ``kwargs`` may hold per-call dicts under "aim_image" and "track"."""
+        import aim
+
+        aim_image_kw = (kwargs or {}).get("aim_image", {})
+        track_kw = (kwargs or {}).get("track", {})
+        for k, v in values.items():
+            img, caption = v if isinstance(v, tuple) else (v, "")
+            self.writer.track(
+                aim.Image(img, caption=caption, **aim_image_kw), name=k, step=step, **track_kw
+            )
+
+    @on_main_process
     def finish(self):
         self.writer.close()
 
@@ -288,6 +343,27 @@ class MLflowTracker(GeneralTracker):
 
         metrics = {k: float(v) for k, v in values.items() if _is_scalar(v)}
         mlflow.log_metrics(metrics, step=step)
+
+    @on_main_process
+    def log_figure(self, figure, artifact_file: str, **save_kwargs):
+        """Log a matplotlib figure as an artifact (reference ``tracking.py:728``)."""
+        import mlflow
+
+        mlflow.log_figure(figure, artifact_file, **save_kwargs)
+
+    @on_main_process
+    def log_artifact(self, local_path: str, artifact_path: Optional[str] = None):
+        """Upload one local file as an artifact (reference ``tracking.py:764``)."""
+        import mlflow
+
+        mlflow.log_artifact(local_path, artifact_path)
+
+    @on_main_process
+    def log_artifacts(self, local_dir: str, artifact_path: Optional[str] = None):
+        """Upload a local directory of artifacts (reference ``tracking.py:747``)."""
+        import mlflow
+
+        mlflow.log_artifacts(local_dir, artifact_path)
 
     @on_main_process
     def finish(self):
@@ -330,6 +406,42 @@ class ClearMLTracker(GeneralTracker):
             clearml_logger.report_scalar(
                 title=title, series=series, value=float(v), iteration=step, **kwargs
             )
+
+    @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs):
+        """Report images to the ClearML debug-samples tab (reference
+        ``tracking.py:870``)."""
+        clearml_logger = self.task.get_logger()
+        for k, v in values.items():
+            title, _, series = k.partition("/")
+            series = series or title
+            clearml_logger.report_image(
+                title=title, series=series, iteration=step, image=v, **kwargs
+            )
+
+    @on_main_process
+    def log_table(
+        self,
+        table_name: str,
+        columns: Optional[list] = None,
+        data: Optional[list] = None,
+        dataframe=None,
+        step: Optional[int] = None,
+        **kwargs,
+    ):
+        """Report a table from columns+data or a dataframe (reference
+        ``tracking.py:888``)."""
+        if dataframe is None:
+            if columns is None or data is None:
+                raise ValueError(
+                    "log_table needs either a `dataframe` or both `columns` and `data`"
+                )
+            dataframe = [list(columns)] + [list(row) for row in data]
+        title, _, series = table_name.partition("/")
+        series = series or title
+        self.task.get_logger().report_table(
+            title=title, series=series, iteration=step, table_plot=dataframe, **kwargs
+        )
 
     @on_main_process
     def finish(self):

@@ -1,0 +1,114 @@
+"""Deadline-bounded device-backend probe — the single shared pre-flight.
+
+A tunneled TPU whose compile helper is wedged blocks *inside a C call* on the
+first backend touch (even ``jax.devices()``), where neither ``SIGALRM`` nor
+thread joins can interrupt it.  The only reliable guard is probing in a
+KILLABLE subprocess with a wall-clock deadline.  This module is used by
+``bench.py``, ``accelerate-tpu env`` and first-touch ``PartialState``
+bring-up so every entry point fails in seconds with an actionable error
+instead of hanging (reference behavior: ``commands/env.py`` touches no device
+at all; our tunneled-TPU platform needs the active check).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Optional
+
+__all__ = ["probe_device_backend", "preflight_check", "DeviceUnreachableError"]
+
+# Printed by the probe subprocess on success: "<count> <device kind>".
+# A sitecustomize may rewrite jax_platforms at interpreter start, overriding
+# JAX_PLATFORMS — re-apply the env var in-process so the probe measures the
+# platform the parent will actually use (honor_cpu_platform_env semantics).
+_PROBE_SNIPPET = (
+    "import os, jax; "
+    "p = os.environ.get('JAX_PLATFORMS', '').strip(); "
+    "p and jax.config.update('jax_platforms', p); "
+    "d = jax.devices(); print(len(d), d[0].device_kind, flush=True)"
+)
+
+_ACTIONABLE = (
+    "device backend unreachable: {detail}. The device tunnel may be wedged "
+    "(it can recover on its own). For CPU-only work set JAX_PLATFORMS=cpu "
+    "(accelerate_tpu.state.honor_cpu_platform_env() applies it even when a "
+    "sitecustomize overrides the env var); to skip this pre-flight set "
+    "ACCELERATE_DEVICE_PREFLIGHT=0."
+)
+
+
+class DeviceUnreachableError(RuntimeError):
+    """Raised by :func:`preflight_check` when the backend never answers."""
+
+
+def probe_device_backend(
+    timeout_s: float = 60.0,
+    retries: int = 1,
+    retry_wait_s: float = 10.0,
+    env: Optional[dict] = None,
+) -> tuple[bool, str]:
+    """Probe the default JAX backend in a killable subprocess.
+
+    Each attempt is a fresh interpreter, which is also the only true "backend
+    reset" for a wedged tunnel — in-process ``clear_backends()`` cannot unwedge
+    a blocked C call.  Returns ``(ok, detail)`` where ``detail`` is
+    ``"<count> <kind>"`` on success or the failure reason.
+    """
+    detail = "unknown"
+    for attempt in range(max(1, retries)):
+        if attempt:
+            time.sleep(retry_wait_s)
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _PROBE_SNIPPET],
+                capture_output=True,
+                text=True,
+                timeout=timeout_s,
+                env=env if env is not None else os.environ.copy(),
+            )
+        except subprocess.TimeoutExpired:
+            detail = f"no response in {timeout_s:.0f}s (attempt {attempt + 1}/{retries})"
+            continue
+        if proc.returncode == 0 and proc.stdout.strip():
+            return True, proc.stdout.strip().splitlines()[-1]
+        detail = (proc.stderr or "probe produced no output")[-300:].replace("\n", " ")
+    return False, detail
+
+
+_preflight_cache: Optional[tuple[bool, str]] = None
+
+
+def preflight_check(timeout_s: float = 60.0) -> tuple[bool, str]:
+    """First-touch pre-flight for state bring-up.
+
+    Runs at most once per process (cached), ONLY when the configured platform
+    list names a non-cpu device platform (e.g. a sitecustomize forcing
+    ``axon,cpu`` for a tunneled TPU — the scenario that can block backend init
+    forever).  An unset platform list (plain CPU host, default config) skips
+    the probe: no tunnel is configured, so nothing can wedge, and a subprocess
+    jax import per worker would be pure startup tax.  Opt out entirely with
+    ``ACCELERATE_DEVICE_PREFLIGHT=0``.  Raises :class:`DeviceUnreachableError`
+    with an actionable message on failure.
+    """
+    global _preflight_cache
+    if os.environ.get("ACCELERATE_DEVICE_PREFLIGHT", "1").lower() in ("0", "false", "no"):
+        return True, "preflight disabled"
+    import jax
+
+    platforms = (jax.config.jax_platforms or "").strip()
+    if not platforms:
+        return True, "no explicit device platform configured"
+    if all(p.strip() == "cpu" for p in platforms.split(",") if p.strip()):
+        return True, "cpu-only platform"
+    if _preflight_cache is not None:
+        if not _preflight_cache[0]:
+            raise DeviceUnreachableError(_ACTIONABLE.format(detail=_preflight_cache[1]))
+        return _preflight_cache
+    ok, detail = probe_device_backend(timeout_s=timeout_s)
+    _preflight_cache = (ok, detail)
+    if not ok:
+        raise DeviceUnreachableError(_ACTIONABLE.format(detail=detail))
+    return ok, detail

@@ -28,7 +28,7 @@ from typing import Optional
 
 from .dataclasses import FullyShardedDataParallelPlugin, ParallelismConfig
 
-__all__ = ["MegatronLMPlugin"]
+__all__ = ["MegatronLMPlugin", "megatron_pipeline_loss_fn"]
 
 
 def _env_int(key: str, default: Optional[int]) -> Optional[int]:
@@ -197,9 +197,14 @@ class MegatronLMSchedulerWrapper:
 class MegatronEngine:
     """Reference ``utils/megatron_lm.py:925``: owns ``train_step`` /
     ``eval_step``.  Dialect equivalent: one call runs
-    backward+clip+step+zero_grad through the prepared objects (the pipelined
-    schedule, when pp>1, lives inside the compiled loss via
-    ``parallel/pipeline.py``)."""
+    backward+clip+step+zero_grad through the prepared objects.
+
+    Pipeline scheduling: for native model families, build the loss with
+    :func:`megatron_pipeline_loss_fn` (or ``GPTTrainStep.get_forward_step_func``)
+    — ``pp_degree``/``num_micro_batches`` compile into a GPipe ``lax.scan``
+    schedule (``parallel/pipeline.py``).  A torch-ingested module runs
+    GSPMD-sharded WITHOUT a microbatch schedule (its params are not
+    stage-stackable); see COVERAGE.md "Megatron dialect"."""
 
     def __init__(self, accelerator, model, optimizer, scheduler):
         self.accelerator = accelerator
@@ -247,12 +252,35 @@ class AbstractTrainStep:
         raise NotImplementedError
 
 
+def megatron_pipeline_loss_fn(plugin: "MegatronLMPlugin", config):
+    """Build the pipelined causal-LM loss for a native model family, honoring
+    the plugin's schedule knobs (reference ``utils/megatron_lm.py:1034-1055``,
+    where micro-batch iterators drive Megatron's ``forward_backward_func``).
+
+    ``pp_degree`` becomes the stage count and ``num_micro_batches`` the GPipe
+    schedule depth of ``parallel/pipeline.py``; with ``pp_degree == 1`` the
+    dense loss is returned (microbatching then lives in grad accumulation,
+    exactly like Megatron with a single pipeline stage)."""
+    from ..models import llama
+
+    pp = plugin.pp_degree or 1
+    if pp <= 1:
+        return lambda params, batch: llama.loss_fn(params, batch, config)
+    from ..parallel.pipeline import pipeline_llama_loss_fn
+
+    micro = max(plugin.num_micro_batches or 1, 1)
+    return lambda params, batch: pipeline_llama_loss_fn(
+        params, batch, config, num_stages=pp, num_micro_batches=micro
+    )
+
+
 class GPTTrainStep(AbstractTrainStep):
     """Reference ``utils/megatron_lm.py:587``: causal-LM batches; loss is
     next-token cross-entropy (``models/llama.py cross_entropy``)."""
 
     def __init__(self, accelerator=None, args=None):
         super().__init__("GPTTrainStep")
+        self._plugin = getattr(accelerator, "megatron_lm_plugin", None)
 
     def get_batch_func(self, accelerator=None, megatron_dataset_flag=False):
         def get_batch(data_iterator):
@@ -269,6 +297,18 @@ class GPTTrainStep(AbstractTrainStep):
             return llama.cross_entropy(logits, labels, weights)
 
         return loss_func
+
+    def get_forward_step_func(self, config=None):
+        """Pipelined forward+loss over the pp axis (native model families).
+
+        Reference ``utils/megatron_lm.py:612-640`` returns the function
+        Megatron's pipeline engine drives; here the returned callable IS the
+        jittable loss — the schedule is compiled in, not driven by a runtime
+        engine."""
+        if config is None:
+            raise ValueError("get_forward_step_func needs the model config (e.g. LlamaConfig)")
+        plugin = self._plugin or MegatronLMPlugin()
+        return megatron_pipeline_loss_fn(plugin, config)
 
 
 class BertTrainStep(AbstractTrainStep):

@@ -167,6 +167,32 @@ def _honor_cpu_env():
     honor_cpu_platform_env()
 
 
+def _acquire_device(deadline_s: float, attempt_timeout_s: float, wait_s: float):
+    """Bounded device acquisition: killable-subprocess probes until the backend
+    answers or the wall-clock window closes.  Each attempt is a fresh
+    interpreter — the only real "backend reset" for a wedged tunnel (an
+    in-process clear_backends cannot unwedge a blocked C call).  Returns
+    (ok, detail, attempts)."""
+    from accelerate_tpu.utils.device_probe import probe_device_backend
+
+    t0 = time.monotonic()
+    attempts = 0
+    detail = "no attempts"
+    # First attempt with a SHORT timeout: a healthy tunnel answers in a few
+    # seconds, so a wedge is detected fast instead of after 180s.
+    timeout = min(60.0, attempt_timeout_s)
+    while True:
+        attempts += 1
+        ok, detail = probe_device_backend(timeout_s=timeout, retries=1)
+        if ok:
+            return True, detail, attempts
+        print(f"# probe attempt {attempts} failed: {detail}", file=sys.stderr, flush=True)
+        timeout = attempt_timeout_s
+        if time.monotonic() - t0 + wait_s + timeout > deadline_s:
+            return False, detail, attempts
+        time.sleep(wait_s)
+
+
 def main():
     _honor_cpu_env()
     if "--probe" in sys.argv:
@@ -180,22 +206,14 @@ def main():
         print(json.dumps(_run(name, d, layers, f, b, s, impl, policy)))
         return
 
-    # Fast-fail when the device backend is unreachable (e.g. wedged TPU
-    # tunnel).  The probe MUST be a subprocess: backend init blocks inside a C
-    # call, which a SIGALRM-based timeout cannot interrupt.
-    import subprocess
-
-    try:
-        probe = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--probe"],
-            capture_output=True,
-            text=True,
-            timeout=180,
-        )
-        ok = probe.returncode == 0
-        detail = probe.stdout.strip() if ok else probe.stderr[-300:]
-    except subprocess.TimeoutExpired:
-        ok, detail = False, "no response in 180s"
+    # Fast-fail (then retry, bounded) when the device backend is unreachable
+    # (e.g. wedged TPU tunnel).  Probes MUST be subprocesses: backend init
+    # blocks inside a C call, which a SIGALRM-based timeout cannot interrupt.
+    ok, detail, attempts = _acquire_device(
+        deadline_s=float(os.environ.get("BENCH_PROBE_WINDOW_S", "900")),
+        attempt_timeout_s=float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "120")),
+        wait_s=float(os.environ.get("BENCH_PROBE_WAIT_S", "60")),
+    )
     if not ok:
         print(
             json.dumps(
@@ -204,22 +222,40 @@ def main():
                     "value": 0.0,
                     "unit": "mfu_fraction",
                     "vs_baseline": 0.0,
-                    "error": f"device backend unreachable: {detail}",
+                    "error": f"device backend unreachable after {attempts} probes: {detail}",
                 }
             )
         )
         sys.exit(1)
-    print(f"# bench devices: {detail}", file=sys.stderr)
+    print(f"# bench devices: {detail} ({attempts} probe attempts)", file=sys.stderr)
 
     result = None
-    errors = []
-    for i, (name, _, _, _, _, _, impl, _) in enumerate(LADDER):
+    rung_log = []
+    rung_cfg = None
+    for i, rung in enumerate(LADDER):
+        name, _, _, _, batch, seq, impl, policy = rung
         result, err = _run_rung_subprocess(i, timeout_s=480)
+        # Per-rung emission: a later crash can no longer zero the round — the
+        # outcome of every attempted rung is in the final JSON and on stderr.
+        status = "ok" if result is not None else err
+        rung_log.append({"rung": i, "config": f"{name}/b{batch}/s{seq}/{impl}/{policy}", "status": status})
+        print(f"# rung {i} {rung_log[-1]['config']}: {status}", file=sys.stderr, flush=True)
         if result is not None:
+            rung_cfg = rung_log[-1]["config"]
             break
-        errors.append(f"{name}/{impl}: {err}")
     if result is None:
-        print(json.dumps({"metric": "train_mfu", "value": 0.0, "unit": "mfu_fraction", "vs_baseline": 0.0, "error": ";".join(errors)}))
+        print(
+            json.dumps(
+                {
+                    "metric": "train_mfu",
+                    "value": 0.0,
+                    "unit": "mfu_fraction",
+                    "vs_baseline": 0.0,
+                    "error": "all rungs failed",
+                    "detail": {"rungs": rung_log},
+                }
+            )
+        )
         sys.exit(1)
     print(
         json.dumps(
@@ -230,10 +266,12 @@ def main():
                 "vs_baseline": round(result["mfu"] / 0.45, 4),
                 "detail": {
                     "config": result["config"],
+                    "rung": rung_cfg,
                     "params": result["params"],
                     "tokens_per_sec": round(result["tokens_per_sec"], 1),
                     "step_ms": round(result["step_ms"], 2),
                     "loss": round(result["loss"], 4),
+                    "rungs": rung_log,
                 },
             }
         )

@@ -292,3 +292,125 @@ def test_dispatcher_scales_batch_by_data_shards():
     import numpy as np
 
     assert np.asarray(arr).shape[0] == 4 * n_shards
+
+
+def test_batch_sampler_varying_batch_size_no_even():
+    """Reference tests/test_data_loader.py:351 — a pre-batched list with
+    varying batch sizes deals round-robin when even_batches=False."""
+    batches = [[0, 1, 2], [3, 4], [5, 6, 7, 8], [9, 10, 11], [12, 13]]
+    shards = [
+        BatchSamplerShard(batches, num_processes=2, process_index=i, even_batches=False)
+        for i in range(2)
+    ]
+    assert len(shards[0]) == 3 and len(shards[1]) == 2
+    assert list(shards[0]) == [[0, 1, 2], [5, 6, 7, 8], [12, 13]]
+    assert list(shards[1]) == [[3, 4], [9, 10, 11]]
+
+
+def test_iterable_dataset_none_batch_size():
+    """Reference :418 — batch_size=None streams single samples through
+    prepare unchanged."""
+    import torch
+    from torch.utils.data import DataLoader
+
+    class Simple(torch.utils.data.IterableDataset):
+        def __iter__(self):
+            yield from (torch.tensor(i) for i in range(12))
+
+    dl = prepare_data_loader(DataLoader(Simple(), batch_size=None), put_on_device=False)
+    seen = [int(d) for d in dl]
+    assert seen == list(range(12))
+
+
+def test_random_iterable_shard_properties():
+    """Reference check_iterable_dataset_shards invariants on a RANDOM-length
+    iterable: equal shard lengths, shard_batch_size multiples, interleaved
+    coverage of the stream (with wraparound padding unless drop_last)."""
+    import random
+
+    class RandomIterable:
+        def __init__(self, max_length=20):
+            self.max_length = max_length
+
+        def __iter__(self):
+            n = random.randint(1, self.max_length)
+            yield from (random.random() for _ in range(n))
+
+    for max_length in (20, 2):
+        for drop_last in (False, True):
+            for split in (False, True):
+                ds = RandomIterable(max_length)
+                random.seed(42)
+                reference = list(ds)
+                lists = []
+                for p in range(2):
+                    random.seed(42)
+                    lists.append(
+                        list(
+                            IterableDatasetShard(
+                                ds, batch_size=4, drop_last=drop_last,
+                                num_processes=2, process_index=p, split_batches=split,
+                            )
+                        )
+                    )
+                shard_bs = 2 if split else 4
+                assert len(lists[0]) == len(lists[1])
+                assert len(lists[0]) % shard_bs == 0
+                observed = []
+                for idx in range(0, len(lists[0]), shard_bs):
+                    for l in lists:
+                        observed += l[idx : idx + shard_bs]
+                if not drop_last:
+                    while len(reference) < len(observed):
+                        reference += reference
+                assert observed == reference[: len(observed)], (max_length, drop_last, split)
+
+
+@pytest.mark.parametrize("num_processes", [1, 2])
+def test_reproducibility_across_processes(num_processes):
+    """Reference :426 — same seed => every process sees the same shuffled
+    order (seedable sampler sync)."""
+    import torch
+    from torch.utils.data import DataLoader
+
+    from accelerate_tpu.utils import set_seed
+
+    orders = []
+    for p in range(num_processes):
+        set_seed(21)
+        dl = prepare_data_loader(
+            DataLoader(list(range(6)), batch_size=1, shuffle=True),
+            num_processes=1,  # order parity is about the seed, not the shard
+            put_on_device=False,
+            use_seedable_sampler=True,
+        )
+        orders.append([int(x[0]) for x in dl])
+    assert all(o == orders[0] for o in orders), orders
+
+
+def test_abandoned_dataloader_not_pinned_by_gradient_state():
+    """Reference :531 — deleting an object mid-iteration must free the loader
+    (GradientState keeps only weak references)."""
+    import gc
+    import weakref
+
+    import torch
+    from torch.utils.data import DataLoader
+
+    class Holder:
+        def __init__(self):
+            self.dataloader = prepare_data_loader(
+                DataLoader(list(range(16)), batch_size=4), put_on_device=False
+            )
+            self.iter = iter(self.dataloader)
+
+        def __call__(self):
+            return next(self.iter)
+
+    holder = Holder()
+    first = holder()
+    assert [int(x) for x in first] == [0, 1, 2, 3]
+    loader_ref = weakref.ref(holder.dataloader)
+    del holder
+    gc.collect()
+    assert loader_ref() is None, "GradientState pinned an abandoned dataloader"

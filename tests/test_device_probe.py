@@ -1,0 +1,73 @@
+"""Shared deadline-bounded device probe (utils/device_probe.py)."""
+
+import os
+
+import pytest
+
+from accelerate_tpu.utils import device_probe
+
+
+def test_probe_succeeds_on_cpu_backend():
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    ok, detail = device_probe.probe_device_backend(timeout_s=120.0, env=env)
+    assert ok, detail
+    # "<count> <kind>"
+    assert detail.split()[0].isdigit()
+
+
+def test_probe_timeout_is_bounded_and_reported():
+    ok, detail = device_probe.probe_device_backend(timeout_s=0.01, retries=2, retry_wait_s=0.0)
+    assert not ok
+    assert "no response" in detail
+    assert "2/2" in detail
+
+
+def test_preflight_cpu_platform_fast_path(monkeypatch):
+    import jax
+
+    assert (jax.config.jax_platforms or "") == "cpu", "test suite must run cpu-forced"
+    ok, detail = device_probe.preflight_check()
+    assert ok and detail == "cpu-only platform"
+
+
+def test_preflight_skips_when_no_platform_configured(monkeypatch):
+    """Unset jax_platforms (plain CPU host): no subprocess tax at bring-up."""
+    import jax
+
+    monkeypatch.setattr(
+        device_probe, "probe_device_backend",
+        lambda **kw: (_ for _ in ()).throw(AssertionError("probe must not run")),
+    )
+    monkeypatch.setattr(device_probe, "_preflight_cache", None)
+    jax.config.update("jax_platforms", "")
+    try:
+        ok, detail = device_probe.preflight_check()
+        assert ok and "no explicit device platform" in detail
+    finally:
+        jax.config.update("jax_platforms", "cpu")
+
+
+def test_preflight_env_optout(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_DEVICE_PREFLIGHT", "0")
+    ok, detail = device_probe.preflight_check()
+    assert ok and "disabled" in detail
+
+
+def test_preflight_raises_actionable_error(monkeypatch):
+    import jax
+
+    monkeypatch.setattr(
+        device_probe, "probe_device_backend", lambda **kw: (False, "no response in 1s")
+    )
+    monkeypatch.setattr(device_probe, "_preflight_cache", None)
+    jax.config.update("jax_platforms", "tpu,cpu")
+    try:
+        with pytest.raises(device_probe.DeviceUnreachableError, match="JAX_PLATFORMS=cpu"):
+            device_probe.preflight_check()
+        # Cached negative result re-raises without re-probing.
+        with pytest.raises(device_probe.DeviceUnreachableError):
+            device_probe.preflight_check()
+    finally:
+        jax.config.update("jax_platforms", "cpu")
+        device_probe._preflight_cache = None

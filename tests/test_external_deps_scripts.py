@@ -1,0 +1,99 @@
+"""Bound-enforcing regression scripts (reference ``external_deps/``) run
+through the real launcher — perf lower bound, peak-memory ceiling, and the
+gather_for_metrics-vs-single-process oracle."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _launch(module: str, *script_args, num_processes: int = 1, timeout: int = 240):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    cmd = [
+        sys.executable,
+        "-m",
+        "accelerate_tpu.commands.accelerate_cli",
+        "launch",
+        "--num_processes",
+        str(num_processes),
+        "-m",
+        module,
+    ]
+    if script_args:
+        cmd += list(script_args)
+    res = subprocess.run(
+        cmd, capture_output=True, text=True, cwd=REPO, env=env, timeout=timeout
+    )
+    assert res.returncode == 0, f"stdout:\n{res.stdout}\nstderr:\n{res.stderr}"
+    return res
+
+
+def test_performance_lower_bound_enforced():
+    """Green at a bound the synthetic task clears; the assert has teeth (the
+    task trains to ~1.0, bound 0.9)."""
+    res = _launch(
+        "accelerate_tpu.test_utils.scripts.external_deps.test_performance",
+        "--performance_lower_bound",
+        "0.9",
+        "--num_epochs",
+        "1",
+    )
+    assert "accuracy" in res.stdout
+
+
+def test_performance_bound_fails_when_unreachable():
+    """An impossible bound must FAIL the script (proves enforcement)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    res = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "accelerate_tpu.test_utils.scripts.external_deps.test_performance",
+            "--performance_lower_bound",
+            "1.1",
+            "--num_epochs",
+            "1",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        env=env,
+        timeout=240,
+    )
+    assert res.returncode != 0
+    assert "lower than the lower bound" in res.stderr
+
+
+def test_peak_memory_ceiling_enforced():
+    """Green under a generous ceiling chosen from a green run (~600 MB RSS on
+    the CPU backend; 8 GB leaves headroom across jax versions)."""
+    res = _launch(
+        "accelerate_tpu.test_utils.scripts.external_deps.test_peak_memory_usage",
+        "--peak_memory_upper_bound_mb",
+        "8000",
+        "--max_steps",
+        "4",
+    )
+    assert "peak memory" in res.stdout
+
+
+def test_metrics_oracle_single_process():
+    _launch("accelerate_tpu.test_utils.scripts.external_deps.test_metrics")
+
+
+@pytest.mark.slow
+def test_metrics_oracle_two_processes():
+    """The real contract: dedup across a 2-process jax.distributed cluster."""
+    _launch(
+        "accelerate_tpu.test_utils.scripts.external_deps.test_metrics",
+        num_processes=2,
+        timeout=360,
+    )

@@ -316,6 +316,21 @@ def test_beam_search_escapes_greedy_trap():
     assert list(np.asarray(out)[0, 1:]) == [1, 2], np.asarray(out)
 
 
+def test_beam_search_rejects_num_beams_over_vocab():
+    import jax
+    import pytest
+
+    from accelerate_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (1, 4), 0, cfg.vocab_size)
+    with pytest.raises(ValueError, match="num_beams"):
+        llama.generate_beam(
+            params, ids, cfg, max_new_tokens=2, num_beams=cfg.vocab_size + 1
+        )
+
+
 def test_beam_search_smoke_on_llama_and_gpt2():
     import jax
 

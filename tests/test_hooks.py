@@ -146,3 +146,133 @@ def test_layerwise_casting_hooks():
     out = model(torch.randn(2, 3))
     assert out.dtype == torch.float32
     remove_hook_from_submodules(model)
+
+
+class _CountingWeights(dict):
+    """weights_map that counts __getitem__ per key."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.loads = []
+
+    def __getitem__(self, key):
+        self.loads.append(key)
+        return super().__getitem__(key)
+
+
+class _TiedPairModule(torch.nn.Module):
+    """One module carrying the SAME Parameter under two names."""
+
+    def __init__(self):
+        super().__init__()
+        self.weight = torch.nn.Parameter(torch.randn(4, 4))
+        self.weight2 = self.weight  # registers the same Parameter twice
+
+    def forward(self, x):
+        return x @ self.weight.T + x @ self.weight2.T
+
+
+def test_tied_params_materialize_once_per_window():
+    """Tied weights offloaded to a weights_map load ONCE per forward window and
+    share storage (reference big_modeling.py:410-424 tied_params_map)."""
+    from accelerate_tpu.utils.modeling import find_tied_parameters
+
+    model = _TiedPairModule()
+    groups = find_tied_parameters(model)
+    assert groups == [["weight", "weight2"]], groups
+    weights = _CountingWeights(
+        {k: v.detach().clone() for k, v in model.state_dict().items()}
+    )
+    tied_names = {n: g[0] for g in groups for n in g}
+    tied_map: dict = {}
+    attach_align_device_hook(
+        model,
+        execution_device="cpu",
+        offload=True,
+        weights_map=weights,
+        tied_params_map=tied_map,
+        tied_names=tied_names,
+    )
+    hook = model._hf_hook
+    hook.pre_forward(model)
+    # One load, second name reuses the same storage.
+    assert weights.loads == ["weight"], weights.loads
+    assert model.weight.data_ptr() == model.weight2.data_ptr()
+    out = model.forward(torch.randn(2, 4))  # hooked forward would re-run pre
+    assert out.shape == (2, 4)
+    hook.post_forward(model, out)
+    # Window closed: dedup entry freed, weights back on meta.
+    assert tied_map.get("weight", {}) == {}
+    assert model.weight.device.type == "meta"
+    remove_hook_from_module(model)
+
+
+def test_tied_params_full_forward_counts():
+    """End-to-end hooked forward of the tied module: exactly one load per
+    window even though two names materialize."""
+    from accelerate_tpu.utils.modeling import find_tied_parameters
+
+    model = _TiedPairModule()
+    ref = model.forward(torch.ones(2, 4))
+    groups = find_tied_parameters(model)
+    weights = _CountingWeights({k: v.detach().clone() for k, v in model.state_dict().items()})
+    tied_names = {n: g[0] for g in groups for n in g}
+    attach_align_device_hook(
+        model,
+        execution_device="cpu",
+        offload=True,
+        weights_map=weights,
+        tied_params_map={},
+        tied_names=tied_names,
+    )
+    out = model(torch.ones(2, 4))
+    assert weights.loads == ["weight"], weights.loads
+    torch.testing.assert_close(out, ref)
+
+
+def test_dispatch_model_tied_state_dict_single_host_copy(tmp_path):
+    """dispatch_model's auto state dict converts a tied weight once: both names
+    point at the SAME numpy array (host RAM halved at rest)."""
+    from accelerate_tpu.big_modeling import dispatch_model
+
+    class TiedLM(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.embed = torch.nn.Embedding(12, 8)
+            self.head = torch.nn.Linear(8, 12, bias=False)
+            self.head.weight = self.embed.weight
+
+        def forward(self, ids):
+            return self.head(self.embed(ids))
+
+    model = TiedLM()
+    ref = model(torch.arange(6).reshape(2, 3))
+    dispatch_model(model, {"embed": "cpu", "head": "cpu"})
+    hooks = [m._hf_hook for _, m in model.named_modules() if hasattr(m, "_hf_hook")]
+    align = [h for h in hooks if isinstance(h, AlignDevicesHook) and h.offload]
+    assert align, "expected offloading hooks"
+    wm = align[0].weights_map
+    assert wm.state_dict["embed.weight"] is wm.state_dict["head.weight"]
+    out = model(torch.arange(6).reshape(2, 3))
+    torch.testing.assert_close(out, ref)
+    remove_hook_from_submodules(model)
+
+
+def test_align_hook_skip_keys_on_output():
+    """io_same_device output move honors skip_keys (reference hooks.py:400)."""
+    recorded = {}
+
+    class Dict2Dev(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.lin = torch.nn.Linear(3, 3)
+
+        def forward(self, x):
+            return {"moved": self.lin(x), "kept": torch.ones(1)}
+
+    model = Dict2Dev()
+    hook = AlignDevicesHook(execution_device="cpu", io_same_device=True, skip_keys=["kept"])
+    add_hook_to_module(model, hook)
+    out = model(torch.randn(2, 3))
+    assert set(out) == {"moved", "kept"}
+    remove_hook_from_module(model)

@@ -1,9 +1,12 @@
-"""Composed-mesh loss-parity matrix.
+"""Composed-mesh parity matrix: loss, per-leaf grads, and one optimizer step.
 
-Every parallelism axis must COMPOSE: the sharded loss on each mixed mesh must
-match the dense single-device loss (the strongest cheap correctness oracle —
-a mis-specified sharding or collective shows up as a numeric mismatch).
-Covers llama over fsdp/tp/sp/dp mixes and mixtral (MoE) over ep mixes.
+Every parallelism axis must COMPOSE: on each mixed mesh, the sharded loss,
+every gradient leaf, and the parameter delta of one optimizer step must match
+the dense single-device run (oracle semantics of reference
+``test_utils/scripts/test_sync.py:29-43``, applied to a mesh).  A
+mis-specified sharding that only corrupts the backward — e.g. a wrong psum
+axis on a grad — fails the grad assertions even when the forward loss agrees.
+Covers llama over fsdp/tp/sp/dp/pp mixes and mixtral (MoE) over ep mixes.
 """
 
 import jax
@@ -21,6 +24,8 @@ LLAMA_MESHES = [
     dict(tp=2, sp=2, dp=2),
     dict(fsdp=2, tp=2, sp=2),
     dict(dp=4, tp=2),
+    dict(pp=2, fsdp=2, dp=2),
+    dict(pp=2, sp=2, dp=2),
 ]
 MIXTRAL_MESHES = [
     dict(ep=2, fsdp=2, dp=2),
@@ -33,43 +38,102 @@ def _ids(vocab):
     return np.random.default_rng(0).integers(0, vocab, (8, 32)).astype(np.int32)
 
 
+def _loss_fn(cfg, mesh_axes, family):
+    pp = mesh_axes.get("pp", 1)
+    if pp > 1:
+        from accelerate_tpu.parallel.pipeline import pipeline_llama_loss_fn
+
+        return lambda p, b: pipeline_llama_loss_fn(
+            p, b, cfg, num_stages=pp, num_micro_batches=2
+        )
+    return lambda p, b: family.loss_fn(p, b, cfg)
+
+
+def _step_fn(loss_fn, tx):
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return loss, grads, jax.tree.map(lambda p, u: p + u, params, updates)
+
+    return jax.jit(step)
+
+
+def _assert_tree_close(dense_tree, sharded_tree, what, mesh_axes, atol, rtol):
+    flat_d, treedef = jax.tree.flatten(dense_tree)
+    flat_s = jax.tree.leaves(sharded_tree)
+    keys = [str(k) for k, _ in jax.tree_util.tree_flatten_with_path(dense_tree)[0]]
+    for key, d, s in zip(keys, flat_d, flat_s):
+        np.testing.assert_allclose(
+            np.asarray(d, np.float32),
+            np.asarray(s, np.float32),
+            atol=atol,
+            rtol=rtol,
+            err_msg=f"{what} leaf {key} diverged on mesh {mesh_axes}",
+        )
+
+
+def _run_matrix_case(family, cfg, params, ids, dense_ref, mesh_axes, atol_loss):
+    import optax
+
+    tx = optax.sgd(0.1)
+    dense_loss, dense_grads, dense_new = dense_ref
+
+    state = AcceleratorState(parallelism_config=ParallelismConfig(**mesh_axes))
+    sp = shard_params(params, state.mesh, family.param_specs(cfg))
+    sb = {"input_ids": jax.device_put(ids, data_sharding(state.mesh))}
+    step = _step_fn(_loss_fn(cfg, mesh_axes, family), tx)
+    loss, grads, new_params = step(sp, tx.init(sp), sb)
+
+    assert abs(float(loss) - dense_loss) < atol_loss, (mesh_axes, float(loss), dense_loss)
+    # Backward parity: every grad leaf (a wrong collective shows up here even
+    # when the loss matches).
+    _assert_tree_close(dense_grads, grads, "grad", mesh_axes, atol=3e-2, rtol=5e-2)
+    # Update parity: the param delta of one optimizer step.  Deltas are
+    # computed in numpy — an eager jnp subtract would run under the ambient
+    # mesh context against single-device dense arrays.
+    _np = lambda t: jax.tree.map(lambda x: np.asarray(x, np.float32), t)
+    dense_delta = jax.tree.map(lambda n, p: n - p, _np(dense_new), _np(params))
+    sharded_delta = jax.tree.map(lambda n, p: n - p, _np(new_params), _np(sp))
+    _assert_tree_close(dense_delta, sharded_delta, "update", mesh_axes, atol=3e-3, rtol=5e-2)
+
+
 @pytest.fixture(scope="module")
 def llama_dense():
+    import optax
+
     cfg = llama.LlamaConfig.tiny()
     params = llama.init_params(cfg, jax.random.key(0))
     ids = _ids(cfg.vocab_size)
-    dense = float(
-        jax.jit(lambda p, b: llama.loss_fn(p, b, cfg))(params, {"input_ids": jax.numpy.asarray(ids)})
-    )
-    return cfg, params, ids, dense
+    tx = optax.sgd(0.1)
+    step = _step_fn(lambda p, b: llama.loss_fn(p, b, cfg), tx)
+    loss, grads, new_params = step(params, tx.init(params), {"input_ids": jax.numpy.asarray(ids)})
+    return cfg, params, ids, (float(loss), jax.device_get(grads), jax.device_get(new_params))
 
 
-@pytest.mark.parametrize("mesh_axes", LLAMA_MESHES, ids=lambda m: "x".join(f"{k}{v}" for k, v in m.items()))
+@pytest.mark.parametrize(
+    "mesh_axes", LLAMA_MESHES, ids=lambda m: "x".join(f"{k}{v}" for k, v in m.items())
+)
 def test_llama_mesh_matrix(mesh_axes, llama_dense):
-    cfg, params, ids, dense = llama_dense
-    state = AcceleratorState(parallelism_config=ParallelismConfig(**mesh_axes))
-    sp = shard_params(params, state.mesh, llama.param_specs(cfg))
-    sb = {"input_ids": jax.device_put(ids, data_sharding(state.mesh))}
-    loss = float(jax.jit(lambda p, b: llama.loss_fn(p, b, cfg))(sp, sb))
-    assert abs(loss - dense) < 3e-3, (mesh_axes, loss, dense)
+    cfg, params, ids, dense_ref = llama_dense
+    _run_matrix_case(llama, cfg, params, ids, dense_ref, mesh_axes, atol_loss=3e-3)
 
 
 @pytest.fixture(scope="module")
 def mixtral_dense():
+    import optax
+
     cfg = mixtral.MixtralConfig.tiny()
     params = mixtral.init_params(cfg, jax.random.key(0))
     ids = _ids(cfg.vocab_size)
-    dense = float(
-        jax.jit(lambda p, b: mixtral.loss_fn(p, b, cfg))(params, {"input_ids": jax.numpy.asarray(ids)})
-    )
-    return cfg, params, ids, dense
+    tx = optax.sgd(0.1)
+    step = _step_fn(lambda p, b: mixtral.loss_fn(p, b, cfg), tx)
+    loss, grads, new_params = step(params, tx.init(params), {"input_ids": jax.numpy.asarray(ids)})
+    return cfg, params, ids, (float(loss), jax.device_get(grads), jax.device_get(new_params))
 
 
-@pytest.mark.parametrize("mesh_axes", MIXTRAL_MESHES, ids=lambda m: "x".join(f"{k}{v}" for k, v in m.items()))
+@pytest.mark.parametrize(
+    "mesh_axes", MIXTRAL_MESHES, ids=lambda m: "x".join(f"{k}{v}" for k, v in m.items())
+)
 def test_mixtral_mesh_matrix(mesh_axes, mixtral_dense):
-    cfg, params, ids, dense = mixtral_dense
-    state = AcceleratorState(parallelism_config=ParallelismConfig(**mesh_axes))
-    sp = shard_params(params, state.mesh, mixtral.param_specs(cfg))
-    sb = {"input_ids": jax.device_put(ids, data_sharding(state.mesh))}
-    loss = float(jax.jit(lambda p, b: mixtral.loss_fn(p, b, cfg))(sp, sb))
-    assert abs(loss - dense) < 5e-3, (mesh_axes, loss, dense)
+    cfg, params, ids, dense_ref = mixtral_dense
+    _run_matrix_case(mixtral, cfg, params, ids, dense_ref, mesh_axes, atol_loss=5e-3)

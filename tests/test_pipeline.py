@@ -146,6 +146,50 @@ def test_pipeline_padded_batch_matches_dense():
     assert abs(dense_loss - piped) < 3e-3, (dense_loss, piped)
 
 
+def test_left_padded_positions_match_unpadded_dense():
+    """Mask-derived RoPE positions: a left-padded prompt's valid slots produce
+    the same logits as the unpadded prompt (dense path)."""
+    cfg = llama.LlamaConfig.tiny(num_layers=2)
+    params = llama.init_params(cfg, jax.random.key(0))
+    short = jax.random.randint(jax.random.key(1), (2, 12), 0, cfg.vocab_size)
+    dense = np.asarray(jax.jit(lambda p, i: llama.apply(p, i, cfg))(params, short))
+
+    pad = 4
+    padded = jnp.concatenate([jnp.zeros((2, pad), short.dtype), short], axis=1)
+    mask = jnp.concatenate(
+        [jnp.zeros((2, pad), jnp.int32), jnp.ones((2, 12), jnp.int32)], axis=1
+    )
+    out = np.asarray(
+        jax.jit(lambda p, i, m: llama.apply(p, i, cfg, attention_mask=m))(params, padded, mask)
+    )
+    np.testing.assert_allclose(dense, out[:, pad:], atol=2e-2, rtol=1e-2)
+
+
+def test_left_padded_pipeline_matches_dense_masked():
+    """Pipeline path derives positions from the mask exactly like dense."""
+    cfg = llama.LlamaConfig.tiny(num_layers=4)
+    params = llama.init_params(cfg, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (8, 32), 0, cfg.vocab_size)
+    am = np.ones((8, 32), np.int32)
+    am[0, :10] = 0  # left padding
+    am[3, :5] = 0
+    am = jnp.asarray(am)
+    batch = {"input_ids": ids, "attention_mask": am}
+    dense_loss = float(jax.jit(lambda p: llama.loss_fn(p, batch, cfg))(params))
+
+    state = AcceleratorState(parallelism_config=ParallelismConfig(pp=4, dp=2))
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharded = jax.device_put(params, NamedSharding(state.mesh, P()))
+    sb = {k: jax.device_put(v, data_sharding(state.mesh)) for k, v in batch.items()}
+    piped = float(
+        jax.jit(
+            lambda p, b: pl.pipeline_llama_loss_fn(p, b, cfg, num_stages=4, num_micro_batches=2)
+        )(sharded, sb)
+    )
+    assert abs(dense_loss - piped) < 3e-3, (dense_loss, piped)
+
+
 def test_pipeline_composes_with_sequence_parallelism():
     """pp x sp on one mesh: ring attention (shard_map over sp) runs inside the
     vmapped pipeline stage body and still matches the dense loss."""

@@ -109,3 +109,120 @@ def test_tensorboard_tracker_writes_events(tmp_path):
     t.finish()
     files = os.listdir(tmp_path / "tb_run")
     assert any("tfevents" in f for f in files)
+
+
+def test_tensorboard_log_images_writes_events(tmp_path):
+    pytest.importorskip("torch.utils.tensorboard")
+    import numpy as np
+
+    t = tracking.TensorBoardTracker("tb_imgs", logging_dir=str(tmp_path))
+    t.log_images({"samples": np.zeros((2, 8, 8, 3), np.uint8)}, step=1)
+    t.finish()
+    files = os.listdir(tmp_path / "tb_imgs")
+    assert any("tfevents" in f for f in files)
+
+
+class _FakeWandbModule:
+    """Minimal wandb stand-in recording Image/Table construction (the
+    reference tests mock the SDK the same way)."""
+
+    class Image:
+        def __init__(self, data):
+            self.data = data
+
+    class Table:
+        def __init__(self, columns=None, data=None, dataframe=None):
+            self.columns, self.data, self.dataframe = columns, data, dataframe
+
+
+def test_wandb_log_images_and_table(monkeypatch):
+    import sys
+
+    monkeypatch.setitem(sys.modules, "wandb", _FakeWandbModule())
+    t = tracking.WandBTracker.__new__(tracking.WandBTracker)
+    logged = []
+    t.run = type("Run", (), {"log": lambda self, values, step=None, **kw: logged.append((step, values))})()
+    t.main_process_only = True
+
+    t.log_images({"gen": ["img0", "img1"]}, step=5)
+    (step, values), = logged
+    assert step == 5
+    assert [im.data for im in values["gen"]] == ["img0", "img1"]
+
+    logged.clear()
+    t.log_table("preds", columns=["x", "y"], data=[[1, 2]], step=7)
+    (step, values), = logged
+    assert step == 7
+    assert values["preds"].columns == ["x", "y"]
+    assert values["preds"].data == [[1, 2]]
+
+
+def test_clearml_log_table_requires_data():
+    t = tracking.ClearMLTracker.__new__(tracking.ClearMLTracker)
+
+    class _Logger:
+        def __init__(self):
+            self.tables = []
+
+        def report_table(self, **kw):
+            self.tables.append(kw)
+
+    logger = _Logger()
+    t.task = type("Task", (), {"get_logger": lambda self: logger})()
+    with pytest.raises(ValueError, match="log_table"):
+        t.log_table("t")
+    t.log_table("scores/val", columns=["a"], data=[[1]], step=2)
+    (kw,) = logger.tables
+    assert kw["title"] == "scores" and kw["series"] == "val"
+    assert kw["table_plot"] == [["a"], [1]]
+    assert kw["iteration"] == 2
+
+
+def test_mlflow_artifact_hooks_forward(monkeypatch):
+    import sys
+
+    calls = []
+
+    class _FakeMLflow:
+        @staticmethod
+        def log_figure(fig, path, **kw):
+            calls.append(("figure", path))
+
+        @staticmethod
+        def log_artifact(local, artifact_path=None):
+            calls.append(("artifact", local, artifact_path))
+
+        @staticmethod
+        def log_artifacts(local, artifact_path=None):
+            calls.append(("artifacts", local, artifact_path))
+
+    monkeypatch.setitem(sys.modules, "mlflow", _FakeMLflow())
+    t = tracking.MLflowTracker.__new__(tracking.MLflowTracker)
+    t.main_process_only = True
+    t.log_figure(object(), "fig.png")
+    t.log_artifact("/tmp/a.txt", "arts")
+    t.log_artifacts("/tmp/dir")
+    assert calls == [
+        ("figure", "fig.png"),
+        ("artifact", "/tmp/a.txt", "arts"),
+        ("artifacts", "/tmp/dir", None),
+    ]
+
+
+def test_log_table_wrong_args_clearml_parity():
+    """columns+data and dataframe are mutually composable the same way as the
+    reference: dataframe wins, bare columns raise."""
+    t = tracking.ClearMLTracker.__new__(tracking.ClearMLTracker)
+
+    class _Logger:
+        def __init__(self):
+            self.tables = []
+
+        def report_table(self, **kw):
+            self.tables.append(kw)
+
+    logger = _Logger()
+    t.task = type("Task", (), {"get_logger": lambda self: logger})()
+    df = [["h"], ["v"]]
+    t.log_table("tab", dataframe=df)
+    assert logger.tables[0]["table_plot"] is df
