@@ -261,12 +261,49 @@ class PreparedModel:
     # fused-mode bookkeeping --------------------------------------------------
 
     def _tag_loss(self, torch_loss):
-        if self._pending is not None:
-            self._tagged_losses[id(torch_loss)] = self._pending
-            self._pending = None
+        if self._pending is None:
+            return
+        key = id(torch_loss)
+        entry = {"pending": self._pending, "consumed": False}
+        self._tagged_losses[key] = entry
+        self._pending = None
+        # Make the materialized loss a DIFFERENTIABLE leaf: torch ops derived
+        # from it (loss / n, loss + aux, ...) build a real autograd graph, and
+        # backward() on the derived tensor delivers d(derived)/d(loss) here —
+        # the chain-rule factor the jax-side grads must be scaled by.  This
+        # widens fused mode to "any torch graph OF the loss scalar" (bridge
+        # mode already covers graphs of the logits).  Torch-parity side effect:
+        # the loss requires grad, exactly like a torch criterion's output —
+        # log it with float(loss) / loss.item() / loss.detach(), not
+        # np.asarray(loss).
+        import torch
+
+        if isinstance(torch_loss, torch.Tensor) and torch_loss.dtype.is_floating_point:
+            torch_loss.requires_grad_(True)
+            model = self
+
+            def _route_grad(grad):
+                if entry["consumed"]:
+                    # Torch parity: a second backward through the same forward
+                    # must not silently drop the gradient.
+                    raise RuntimeError(
+                        "Trying to backward through the same prepared-model forward a "
+                        "second time: re-run the forward before calling backward again."
+                    )
+                entry["consumed"] = True
+                # Entry removed immediately — the grad pytree must not stay
+                # pinned until the next zero_grad.
+                model._tagged_losses.pop(key, None)
+                model._accumulate(entry["pending"][1], float(grad))
+
+            torch_loss.register_hook(_route_grad)
 
     def _grads_for_loss(self, torch_loss):
-        return self._tagged_losses.pop(id(torch_loss), None)
+        entry = self._tagged_losses.pop(id(torch_loss), None)
+        if entry is None or entry["consumed"]:
+            return None
+        entry["consumed"] = True
+        return entry["pending"]
 
     def _accumulate(self, grads, scale: float):
         scaled = jax.tree_util.tree_map(lambda g: g * scale, grads)
@@ -812,7 +849,16 @@ class Accelerator:
                         _, grads = pending
                         model._accumulate(grads, scale)
                         return
-                # bridge mode: flow through torch autograd into the jax vjp
+                if not loss.requires_grad:
+                    raise RuntimeError(
+                        "accelerator.backward() received a torch tensor with no autograd "
+                        "graph and no prepared-model tag. Pass the loss returned by the "
+                        "model (outputs.loss), a torch expression derived from it, or a "
+                        "loss computed from model outputs with torch ops."
+                    )
+                # Torch autograd flows into the jax side: through the bridge
+                # vjp (bridge mode) or the tagged-loss grad hooks (fused mode
+                # with a derived loss), scaled by the accumulation factor.
                 (loss * scale).backward(**kwargs)
                 return
         if isinstance(loss, jax.Array):
@@ -860,14 +906,52 @@ class Accelerator:
 
     @contextlib.contextmanager
     def join_uneven_inputs(self, joinables, even_batches=None):
-        """Reference ``accelerator.py:1169``: torch Join for uneven inputs.  Uneven
-        inputs cannot reach the mesh (even_batches/padding guarantee shape), so
-        this warns and passes through — same behavior the reference has on XLA."""
+        """Reference ``accelerator.py:1169``: torch Join for uneven inputs.  The
+        Join sync itself is a warn-noop here (uneven inputs cannot reach the
+        mesh — even_batches/padding guarantee shape; same behavior the
+        reference has on XLA), but the ``even_batches`` override keeps its
+        reference semantics: prepared MAP-STYLE dataloaders temporarily switch
+        their batch sampler's even_batches inside the context (restored on
+        exit); iterable loaders warn, as in the reference."""
         warnings.warn(
             "join_uneven_inputs is a no-op on the TPU backend: batches are equalized "
             "by even_batches/padding before reaching the mesh."
         )
-        yield
+        overridden: list = []
+        iterable_seen = False
+        if even_batches is not None:
+            for dl in self._dataloaders:
+                sampler = getattr(dl, "batch_sampler", None)
+                if sampler is not None and hasattr(sampler, "even_batches"):
+                    overridden.append((sampler, sampler.even_batches))
+                    sampler.even_batches = even_batches
+                else:
+                    iterable_seen = True
+            if iterable_seen:
+                warnings.warn(
+                    "Overriding even_batches is only supported for map-style datasets; "
+                    "iterable dataloaders keep their behavior."
+                )
+        try:
+            yield
+        finally:
+            for sampler, prev in overridden:
+                sampler.even_batches = prev
+
+    # Pickling (reference test_distributed_data_loop.py test_pickle_accelerator):
+    # prepared objects hold compiled steps / device arrays / live loaders —
+    # process-local by nature.  The pickle carries the CONFIG (plugins, state
+    # singletons via their own reducers); handles re-register on prepare().
+    _UNPICKLABLE_ATTRS = ("_models", "_optimizers", "_schedulers", "_dataloaders", "trackers")
+
+    def __getstate__(self):
+        out = {k: v for k, v in self.__dict__.items() if k not in self._UNPICKLABLE_ATTRS}
+        return out
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        for attr in self._UNPICKLABLE_ATTRS:
+            setattr(self, attr, [])
 
     def unwrap_model(self, model, keep_fp32_wrapper: bool = True, keep_torch_compile: bool = True):
         """Return the original torch module with CURRENT trained weights copied in

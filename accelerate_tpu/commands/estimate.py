@@ -1,12 +1,39 @@
 """``accelerate-tpu estimate-memory`` — per-dtype model memory table.
 
-Parity target: reference ``commands/estimate.py`` (312 LoC): load the model
-skeleton on the meta device, print total / largest-layer sizes per dtype
-(training estimate = 4x inference: params + grads + 2 optimizer moments).
+Parity target: reference ``commands/estimate.py`` (312 LoC): resolve a model
+to a skeleton (meta device — zero real memory), print largest-layer / total /
+training sizes per dtype (training ~= 4x inference for adam: params + grads +
+2 fp32-ish moments).
+
+Resolution ladder (this image has no network egress, so the Hub path of the
+reference is replaced by things that work offline):
+
+1. native family presets — ``llama3-8b``, ``mixtral-8x7b``, ``gpt2``,
+   ``llama-tiny``/… compute the table from the config's closed-form
+   ``num_params()`` (no tensor is ever built);
+2. a local transformers checkpoint/config directory (``AutoConfig`` +
+   ``init_empty_weights`` meta skeleton);
+3. a Hub model id — attempted last; fails with a clear offline error.
+
+Extras beyond the reference: ``--hbm_gb`` prints the minimum fsdp ways for
+the training footprint to fit per chip; ``--json`` emits one machine-readable
+line.
 """
 
 from __future__ import annotations
 
+import json as _json
+
+_BYTES_PER = {
+    "float32": 4.0,
+    "float16": 2.0,
+    "bfloat16": 2.0,
+    "fp8": 1.0,
+    "int8": 1.0,
+    "int4": 0.5,
+    "int2": 0.25,
+}
+_DEFAULT_DTYPES = ["float32", "bfloat16", "int8", "int4"]
 
 
 def _format_bytes(n: float) -> str:
@@ -17,41 +44,125 @@ def _format_bytes(n: float) -> str:
     return f"{n:.2f} PB"
 
 
-def estimate_command(args):
+def _native_presets() -> dict:
+    """name -> zero-cost config factory for the bundled model families."""
+    from ..models import gpt2, llama, mixtral
+
+    return {
+        "llama3-8b": llama.LlamaConfig.llama3_8b,
+        "llama-tiny": llama.LlamaConfig.tiny,
+        "mixtral-8x7b": mixtral.MixtralConfig.mixtral_8x7b,
+        "mixtral-tiny": mixtral.MixtralConfig.tiny,
+        "gpt2": gpt2.GPT2Config.gpt2_small,
+        "gpt2-tiny": gpt2.GPT2Config.tiny,
+    }
+
+
+def _native_estimate(name: str):
+    """(total_f32_bytes, largest_layer_f32_bytes) from a preset config —
+    closed-form, no arrays."""
+    factory = _native_presets().get(name.lower())
+    if factory is None:
+        return None
+    cfg = factory()
+    total = cfg.num_params() * 4
+    # Largest single block: token embedding vs one decoder layer.
+    embed = cfg.vocab_size * cfg.hidden_size * 4
+    layers = getattr(cfg, "num_layers", 1) or 1
+    per_layer = max((total - embed) // layers, 0)
+    return total, max(embed, per_layer)
+
+
+def _skeleton_estimate(model_name: str, trust_remote_code: bool):
+    """(total_f32_bytes, largest_layer_f32_bytes) via a meta-device skeleton."""
     from ..big_modeling import init_empty_weights
     from ..utils.modeling import compute_module_sizes
 
-    try:
-        from transformers import AutoConfig, AutoModel
+    from transformers import AutoConfig, AutoModel
 
-        config = AutoConfig.from_pretrained(args.model_name, trust_remote_code=args.trust_remote_code)
-        with init_empty_weights():
-            model = AutoModel.from_config(config, trust_remote_code=args.trust_remote_code)
-    except Exception as e:
-        raise SystemExit(f"Could not build model skeleton for {args.model_name}: {e}")
-
-    dtypes = args.dtypes or ["float32", "bfloat16", "int8", "int4"]
-    bytes_per = {"float32": 4, "float16": 2, "bfloat16": 2, "int8": 1, "int4": 0.5}
+    config = AutoConfig.from_pretrained(model_name, trust_remote_code=trust_remote_code)
+    with init_empty_weights():
+        model = AutoModel.from_config(config, trust_remote_code=trust_remote_code)
     sizes = compute_module_sizes(model)
-    total_f32 = sizes[""]
-    largest_f32 = max((v for k, v in sizes.items() if k.count(".") == 0 and k), default=total_f32)
+    total = sizes[""]
+    largest = max((v for k, v in sizes.items() if k.count(".") == 0 and k), default=total)
+    return total, largest
 
-    print(f"Memory estimate for {args.model_name}:")
+
+def build_rows(total_f32: float, largest_f32: float, dtypes, hbm_gb=None) -> list[dict]:
+    import math
+
+    rows = []
+    for dt in dtypes:
+        if dt not in _BYTES_PER:
+            raise SystemExit(f"Unknown dtype {dt!r}; options: {sorted(_BYTES_PER)}")
+        factor = _BYTES_PER[dt] / 4.0
+        total = total_f32 * factor
+        row = {
+            "dtype": dt,
+            "largest_layer": largest_f32 * factor,
+            "total": total,
+            # Reference rule of thumb: params + grads + 2 adam moments.
+            "training": total * 4,
+        }
+        if hbm_gb:
+            row["min_fsdp_ways"] = max(1, math.ceil(row["training"] / (hbm_gb * 1024**3)))
+        rows.append(row)
+    return rows
+
+
+def estimate_command(args):
+    native = _native_estimate(args.model_name)
+    if native is not None:
+        total_f32, largest_f32 = native
+        source = "native preset"
+    else:
+        try:
+            total_f32, largest_f32 = _skeleton_estimate(args.model_name, args.trust_remote_code)
+            source = "meta skeleton"
+        except Exception as e:
+            presets = ", ".join(sorted(_native_presets()))
+            raise SystemExit(
+                f"Could not build model skeleton for {args.model_name!r}: {e}\n"
+                f"(no network egress — use a local checkpoint path or a native "
+                f"preset: {presets})"
+            )
+
+    rows = build_rows(total_f32, largest_f32, args.dtypes or _DEFAULT_DTYPES, hbm_gb=args.hbm_gb)
+
+    if args.json:
+        payload = {"model": args.model_name, "source": source, "rows": rows}
+        if args.hbm_gb:
+            payload["hbm_gb"] = args.hbm_gb
+        print(_json.dumps(payload))
+        return rows
+
+    print(f"Memory estimate for {args.model_name} ({source}):")
     header = f"{'dtype':>10} | {'largest layer':>14} | {'total size':>12} | {'training (adam)':>16}"
     print(header)
     print("-" * len(header))
-    for dt in dtypes:
-        factor = bytes_per.get(dt, 4) / 4
-        total = total_f32 * factor
+    for r in rows:
         print(
-            f"{dt:>10} | {_format_bytes(largest_f32 * factor):>14} | "
-            f"{_format_bytes(total):>12} | {_format_bytes(total * 4):>16}"
+            f"{r['dtype']:>10} | {_format_bytes(r['largest_layer']):>14} | "
+            f"{_format_bytes(r['total']):>12} | {_format_bytes(r['training']):>16}"
         )
+    if args.hbm_gb:
+        for r in rows:
+            ways = r["min_fsdp_ways"]
+            fits = "fits on 1 chip" if ways == 1 else f"needs fsdp>={ways} to train"
+            print(f"  {r['dtype']}: {fits} at {args.hbm_gb} GB HBM/chip")
+    return rows
 
 
 def register_subcommand(subparsers):
     parser = subparsers.add_parser("estimate-memory", help="Estimate model memory usage")
-    parser.add_argument("model_name", type=str)
-    parser.add_argument("--dtypes", nargs="+", default=None)
+    parser.add_argument("model_name", type=str,
+                        help="Native preset (llama3-8b, mixtral-8x7b, gpt2, ...), local "
+                             "checkpoint path, or Hub id (needs network)")
+    parser.add_argument("--dtypes", nargs="+", default=None,
+                        help=f"Any of {sorted(_BYTES_PER)}")
     parser.add_argument("--trust_remote_code", action="store_true")
+    parser.add_argument("--hbm_gb", type=float, default=None,
+                        help="Per-chip HBM to compute minimum fsdp ways for training")
+    parser.add_argument("--json", action="store_true", help="One machine-readable JSON line")
     parser.set_defaults(func=estimate_command)

@@ -938,6 +938,14 @@ def prepare_data_loader(
             * scale,
             drop_last=getattr(batch_sampler, "drop_last", False),
         )
+    # Wrap even for num_processes == 1 (reference does the same): with
+    # even_batches the tail batch wraps to FULL size, so every batch has one
+    # static shape — a single XLA trace, no tail recompile/padding; the
+    # wraparound duplicates are dropped by gather_for_metrics' remainder dedup.
+    # Exception: a custom batch sampler with no fixed batch_size cannot be
+    # equalized — single-process keeps it unwrapped (even_batches needs a
+    # target size), matching the pre-existing behavior for bucket samplers.
+    wrap = num_processes > 1 or getattr(batch_sampler, "batch_size", None) is not None
     new_batch_sampler = (
         BatchSamplerShard(
             batch_sampler,
@@ -946,7 +954,7 @@ def prepare_data_loader(
             split_batches=split_batches,
             even_batches=even_batches,
         )
-        if num_processes > 1
+        if wrap
         else batch_sampler
     )
 

@@ -377,6 +377,19 @@ class PartialState:
         """Test hook (reference ``AccelerateTestCase`` resets singletons)."""
         cls._shared_state.clear()
 
+    # Live jax.Device handles are process-local and unpicklable; drop them and
+    # re-attach to the live Borg state on load — or, in a FRESH process,
+    # re-derive the handle from the local backend (see AcceleratorState).
+    def __getstate__(self):
+        return {k: v for k, v in self.__dict__.items() if k != "device"}
+
+    def __setstate__(self, state):
+        self.__dict__ = self._shared_state
+        if not self._shared_state:
+            self._shared_state.update(state)
+            honor_cpu_platform_env()
+            self.device = jax.local_devices()[0]
+
     def __repr__(self) -> str:
         return (
             f"Distributed environment: {self.distributed_type}\n"
@@ -533,6 +546,27 @@ class AcceleratorState:
         if reset_partial_state:
             PartialState._reset_state()
 
+    # Pickling (reference test_distributed_data_loop.py test_pickle_accelerator):
+    # live backend handles (devices, the mesh) are process-local and
+    # unpicklable; drop them and RE-ATTACH to the live Borg state on load.
+    _UNPICKLABLE_KEYS = ("mesh", "device")
+
+    def __getstate__(self):
+        return {
+            k: v for k, v in self.__dict__.items() if k not in self._UNPICKLABLE_KEYS
+        }
+
+    def __setstate__(self, state):
+        self.__dict__ = self._shared_state
+        if not self._shared_state:
+            self._shared_state.update(state)
+            # Fresh process: rebuild the mesh from the pickled parallelism
+            # config over THIS process's devices and reinstall the global
+            # context (device counts may differ across hosts; the axis layout
+            # is what the pickle preserves).
+            self.mesh = self._build_mesh(self.parallelism_config)
+            jax.set_mesh(self.mesh)
+
     def __repr__(self) -> str:
         return (
             repr(self.__dict__.get("_partial", PartialState()))
@@ -652,6 +686,22 @@ class GradientState:
     @classmethod
     def _reset_state(cls) -> None:
         cls._shared_state.clear()
+
+    # Weak dataloader references cannot pickle (and would be dead in another
+    # process anyway); drop them and re-attach to the live Borg state on load.
+    def __getstate__(self):
+        return {
+            k: v
+            for k, v in self.__dict__.items()
+            if k not in ("dataloader_references", "_active_dataloader_ref")
+        }
+
+    def __setstate__(self, state):
+        self.__dict__ = self._shared_state
+        if not self._shared_state:
+            self._shared_state.update(state)
+            self.dataloader_references = [None]
+            self._active_dataloader_ref = None
 
     def __repr__(self) -> str:
         return (

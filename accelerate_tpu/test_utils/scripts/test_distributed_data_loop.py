@@ -90,16 +90,105 @@ def test_dispatch_mode_matches_shard_mode():
     """Dispatcher (rank-0 reads + broadcast) must deliver the same batches as
     per-process sharding: both scale the script's per-shard batch_size by the
     data-shard count (the dispatcher assembles one micro-batch per shard,
-    reference ``_fetch_batches``)."""
+    reference ``_fetch_batches``).  The dataset divides the global batch so no
+    even_batches wraparound is in play (only shard mode wraps — see
+    test_small_dataset_wraps_to_full_batch)."""
+    import jax
+
+    n = 8 * jax.device_count()  # two full global batches at batch_size 4
 
     def batches(acc):
         return [np.asarray(b[0]).ravel().tolist() for b in acc.prepare(
-            DataLoader(_dataset(16), batch_size=4))]
+            DataLoader(_dataset(n), batch_size=4))]
 
     shard_vals = batches(_make_accelerator(dispatch_batches=False))
     disp_vals = batches(_make_accelerator(dispatch_batches=True))
     assert shard_vals == disp_vals, (shard_vals, disp_vals)
     print("dispatcher parity ok")
+
+
+def test_small_dataset_wraps_to_full_batch():
+    """Reference BatchSamplerShard semantics: a dataset smaller than one
+    global batch wraps around so the compiled step still sees ONE static
+    shape (reference test table: range(2) with batch 3 -> [[0,1,0]])."""
+    import jax
+
+    global_batch = 4 * jax.device_count()
+    accelerator = _make_accelerator(even_batches=True)
+    dl = accelerator.prepare(DataLoader(_dataset(global_batch // 2), batch_size=4))
+    sizes = [np.asarray(b[0]).shape[0] for b in dl]
+    assert sizes == [global_batch], sizes
+    print(f"small-dataset wraparound ok (sizes={sizes})")
+
+
+def test_join_can_override_even_batches():
+    """Reference :195 — even_batches temporarily overridden inside the join
+    context for prepared map-style loaders, restored on exit."""
+    accelerator = _make_accelerator(even_batches=True)
+    train_dl = accelerator.prepare(DataLoader(_dataset(8), batch_size=2))
+    valid_dl = accelerator.prepare(DataLoader(_dataset(8), batch_size=2))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with accelerator.join_uneven_inputs([], even_batches=False):
+            assert train_dl.batch_sampler.even_batches is False
+            assert valid_dl.batch_sampler.even_batches is False
+    assert train_dl.batch_sampler.even_batches is True
+    assert valid_dl.batch_sampler.even_batches is True
+    accelerator.print("join override ok")
+
+
+def test_join_mixed_type_dataloaders():
+    """Reference :214/:237 — iterable loaders skip the override without
+    AttributeError and raise the map-style-only warning."""
+
+    class Stream(torch.utils.data.IterableDataset):
+        def __iter__(self):
+            yield from (torch.tensor([float(i)]) for i in range(4))
+
+    accelerator = _make_accelerator(even_batches=True)
+    accelerator.prepare(DataLoader(Stream(), batch_size=1))
+    batch_dl = accelerator.prepare(DataLoader(_dataset(4), batch_size=1))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        with accelerator.join_uneven_inputs([], even_batches=False):
+            assert batch_dl.batch_sampler.even_batches is False
+    assert any("map-style" in str(x.message) for x in w), [str(x.message) for x in w]
+    assert batch_dl.batch_sampler.even_batches is True
+    accelerator.print("join mixed-type ok")
+
+
+def test_pickle_accelerator():
+    """Reference :250 — the accelerator round-trips through pickle.  Same
+    process: the restore re-attaches to the live Borg state (identity).  The
+    REAL contract is the fresh-process restore: device/mesh are rebuilt from
+    the pickled config over the new process's backend."""
+    import pickle
+    import subprocess
+    import sys
+    import tempfile
+
+    accelerator = _make_accelerator()
+    accelerator.prepare(DataLoader(_dataset(16), batch_size=4))
+    restored = pickle.loads(pickle.dumps(accelerator))
+    assert restored.state.__dict__ == accelerator.state.__dict__
+
+    with tempfile.NamedTemporaryFile(suffix=".pkl", delete=False) as f:
+        pickle.dump(accelerator, f)
+        path = f.name
+    probe = (
+        "import os, pickle, jax; "
+        "jax.config.update('jax_platforms', 'cpu'); "
+        "from jax.extend.backend import clear_backends; clear_backends(); "
+        f"acc = pickle.load(open({path!r}, 'rb')); "
+        "assert acc.state.mesh is not None; "
+        "assert acc.state.device is not None; "
+        "print('mesh axes', dict(acc.state.mesh.shape))"
+    )
+    env = dict(__import__('os').environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run([sys.executable, "-c", probe], capture_output=True, text=True, env=env)
+    assert res.returncode == 0, res.stderr[-500:]
+    accelerator.print("pickle ok (same-process + fresh-process restore)")
 
 
 def test_dataloader_state_dict_roundtrip():
@@ -117,7 +206,11 @@ def main():
     test_default_ensures_even_batch_sizes()
     test_can_disable_even_batches()
     test_join_uneven_inputs_warns()
+    test_join_can_override_even_batches()
+    test_join_mixed_type_dataloaders()
+    test_pickle_accelerator()
     test_dispatch_mode_matches_shard_mode()
+    test_small_dataset_wraps_to_full_batch()
     test_dataloader_state_dict_roundtrip()
     from accelerate_tpu.state import PartialState
 

@@ -243,3 +243,84 @@ def test_clip_grad_value():
         after = float(np.asarray(model.params["a"]))
         # Elementwise clip to 1e-4 with lr 0.1 -> step bounded by 1e-5.
         assert abs(after - before) <= 1.1e-5
+
+
+def test_backward_on_derived_loss_fused_mode():
+    """Fused mode with a loss DERIVED by torch ops (loss * 2) must train
+    identically to a plain run whose loss is 2x (same grads via the tagged
+    leaf's autograd hook) — the reference's 'any torch graph' contract applied
+    to graphs of the loss scalar."""
+    accelerator = Accelerator(split_batches=True)
+    ds = RegressionDataset(length=32)
+    dl = DataLoader(list(ds), batch_size=16, collate_fn=_collate)
+    model = RegressionModelWithLoss()
+    opt = torch.optim.SGD(model.parameters(), lr=0.05)
+    model, opt, dl = accelerator.prepare(model, opt, dl)
+    batch = next(iter(dl))
+    out = model(x=batch["x"], y=batch["y"])
+    derived = out.loss * 2 + 0.0 * torch.ones(())  # breaks the id-tag chain
+    accelerator.backward(derived)
+    g2 = np.asarray(model._accum_grads["a"])
+    model._clear_grads()
+
+    out = model(x=batch["x"], y=batch["y"])
+    accelerator.backward(out.loss)  # direct tag path
+    g1 = np.asarray(model._accum_grads["a"])
+    np.testing.assert_allclose(g2, 2 * g1, rtol=1e-5)
+
+
+def test_backward_on_summed_losses_two_forwards():
+    """Two fused forwards summed into one torch expression: both pending grad
+    sets accumulate (each scaled by its chain-rule factor)."""
+    accelerator = Accelerator(split_batches=True)
+    ds = RegressionDataset(length=32)
+    dl = DataLoader(list(ds), batch_size=16, collate_fn=_collate)
+    model = RegressionModelWithLoss()
+    opt = torch.optim.SGD(model.parameters(), lr=0.05)
+    model, opt, dl = accelerator.prepare(model, opt, dl)
+    batch = next(iter(dl))
+
+    out1 = model(x=batch["x"], y=batch["y"])
+    l1 = out1.loss
+    accelerator.backward(l1)
+    g_single = np.asarray(model._accum_grads["a"]).copy()
+    model._clear_grads()
+
+    out1 = model(x=batch["x"], y=batch["y"])
+    l1 = out1.loss
+    out2 = model(x=batch["x"], y=batch["y"])
+    l2 = out2.loss
+    accelerator.backward(l1 + l2)  # derived graph over two tags
+    g_sum = np.asarray(model._accum_grads["a"])
+    np.testing.assert_allclose(g_sum, 2 * g_single, rtol=1e-5)
+
+
+def test_backward_detached_loss_raises_actionable_error():
+    accelerator = Accelerator(split_batches=True)
+    ds = RegressionDataset(length=32)
+    dl = DataLoader(list(ds), batch_size=16, collate_fn=_collate)
+    model = RegressionModelWithLoss()
+    opt = torch.optim.SGD(model.parameters(), lr=0.05)
+    model, opt, dl = accelerator.prepare(model, opt, dl)
+    batch = next(iter(dl))
+    out = model(x=batch["x"], y=batch["y"])
+    detached = out.loss.detach().clone()
+    with pytest.raises(RuntimeError, match="outputs.loss"):
+        accelerator.backward(detached)
+
+
+def test_backward_twice_on_same_forward_raises():
+    """Torch parity: a second backward through the same fused forward raises
+    instead of silently dropping the gradient."""
+    accelerator = Accelerator(split_batches=True)
+    ds = RegressionDataset(length=32)
+    dl = DataLoader(list(ds), batch_size=16, collate_fn=_collate)
+    model = RegressionModelWithLoss()
+    opt = torch.optim.SGD(model.parameters(), lr=0.05)
+    model, opt, dl = accelerator.prepare(model, opt, dl)
+    batch = next(iter(dl))
+    out = model(x=batch["x"], y=batch["y"])
+    loss = out.loss
+    accelerator.backward(loss)
+    with pytest.raises(RuntimeError, match="second time"):
+        accelerator.backward(loss * 1.0)

@@ -380,3 +380,61 @@ def test_config_update_migrates_and_drops_unknown(tmp_path):
     cfg = load_config(str(path))
     assert cfg.mixed_precision == "fp16" and cfg.tp == 4
     assert cfg.num_machines == 1  # defaults filled
+
+
+def test_estimate_memory_native_preset_and_json(capsys):
+    """estimate-memory on a native preset: closed-form table, no tensors; the
+    llama3-8b fp32 total must be ~8B params x 4 bytes."""
+    from accelerate_tpu.commands.estimate import estimate_command
+
+    rows = estimate_command(argparse.Namespace(
+        model_name="llama3-8b", dtypes=["float32", "bfloat16", "int4"],
+        trust_remote_code=False, hbm_gb=16.0, json=False,
+    ))
+    out = capsys.readouterr().out
+    assert "native preset" in out and "needs fsdp>=" in out
+    f32 = rows[0]
+    assert 7.5e9 * 4 < f32["total"] < 8.6e9 * 4
+    assert f32["training"] == f32["total"] * 4
+    int4 = rows[2]
+    assert abs(int4["total"] - f32["total"] / 8) < 1e-3
+
+    rows2 = estimate_command(argparse.Namespace(
+        model_name="gpt2", dtypes=None, trust_remote_code=False, hbm_gb=None, json=True,
+    ))
+    out = capsys.readouterr().out
+    import json as json_mod
+
+    payload = json_mod.loads(out)
+    assert payload["model"] == "gpt2" and len(payload["rows"]) == 4
+    assert rows2[0]["dtype"] == "float32"
+
+
+def test_estimate_memory_local_transformers_config(tmp_path, capsys):
+    """A local transformers config dir resolves through the meta skeleton."""
+    import json as json_mod
+
+    cfg = {
+        "architectures": ["BertModel"], "model_type": "bert",
+        "hidden_size": 32, "num_attention_heads": 2, "num_hidden_layers": 2,
+        "intermediate_size": 64, "vocab_size": 128, "max_position_embeddings": 64,
+    }
+    (tmp_path / "config.json").write_text(json_mod.dumps(cfg))
+    from accelerate_tpu.commands.estimate import estimate_command
+
+    rows = estimate_command(argparse.Namespace(
+        model_name=str(tmp_path), dtypes=["float32"], trust_remote_code=False,
+        hbm_gb=None, json=False,
+    ))
+    assert "meta skeleton" in capsys.readouterr().out
+    assert rows[0]["total"] > 0
+
+
+def test_estimate_memory_unknown_model_offline_error():
+    from accelerate_tpu.commands.estimate import estimate_command
+
+    with pytest.raises(SystemExit, match="native preset|Could not build"):
+        estimate_command(argparse.Namespace(
+            model_name="no-such/model-xyz", dtypes=None, trust_remote_code=False,
+            hbm_gb=None, json=False,
+        ))
