@@ -291,10 +291,13 @@ class PreparedModel:
                         "second time: re-run the forward before calling backward again."
                     )
                 entry["consumed"] = True
-                # Entry removed immediately — the grad pytree must not stay
-                # pinned until the next zero_grad.
+                # Release both references — the dict entry AND the pending
+                # pytree held by this closure (a retained loss tensor keeps the
+                # hook alive, which must not pin a model-sized grad tree).
                 model._tagged_losses.pop(key, None)
-                model._accumulate(entry["pending"][1], float(grad))
+                pending = entry["pending"]
+                entry["pending"] = None
+                model._accumulate(pending[1], float(grad))
 
             torch_loss.register_hook(_route_grad)
 
@@ -303,7 +306,9 @@ class PreparedModel:
         if entry is None or entry["consumed"]:
             return None
         entry["consumed"] = True
-        return entry["pending"]
+        pending = entry["pending"]
+        entry["pending"] = None  # the hook closure must not pin the grads
+        return pending
 
     def _accumulate(self, grads, scale: float):
         scaled = jax.tree_util.tree_map(lambda g: g * scale, grads)

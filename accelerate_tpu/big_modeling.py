@@ -126,7 +126,8 @@ def _dedup_state_dict(model, convert) -> dict:
     return out
 
 
-def cpu_offload(model, execution_device=None, offload_buffers: bool = False, state_dict=None):
+def cpu_offload(model, execution_device=None, offload_buffers: bool = False, state_dict=None,
+                preload_module_classes=None):
     """Whole-model CPU offload (reference ``big_modeling.py:173``): weights live in
     a host state dict, staged per-submodule at forward."""
     if state_dict is None:
@@ -139,6 +140,7 @@ def cpu_offload(model, execution_device=None, offload_buffers: bool = False, sta
         offload_buffers=offload_buffers,
         tied_params_map={},
         tied_names=_tied_name_map(model),
+        preload_module_classes=preload_module_classes,
     )
     return model
 
@@ -152,7 +154,8 @@ def cpu_offload_with_hook(model, execution_device=None, prev_module_hook: Option
     return model, user_hook
 
 
-def disk_offload(model, offload_dir: str, execution_device=None, offload_buffers: bool = False):
+def disk_offload(model, offload_dir: str, execution_device=None, offload_buffers: bool = False,
+                 preload_module_classes=None):
     """Whole-model disk offload (reference ``big_modeling.py:239``)."""
     os.makedirs(offload_dir, exist_ok=True)
     offload_state_dict(offload_dir, _dedup_state_dict(model, _tensor_to_numpy))
@@ -165,6 +168,7 @@ def disk_offload(model, offload_dir: str, execution_device=None, offload_buffers
         offload_buffers=offload_buffers,
         tied_params_map={},
         tied_names=_tied_name_map(model),
+        preload_module_classes=preload_module_classes,
     )
     return model
 
@@ -248,6 +252,7 @@ def dispatch_model(
         skip_keys=skip_keys,
         tied_params_map=tied_params_map,
         tied_names=tied_names,
+        preload_module_classes=preload_module_classes,
     )
     if weights_map is not None:
         from .hooks import wire_sequential_prefetch

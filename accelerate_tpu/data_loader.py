@@ -338,6 +338,10 @@ class _GlobalBatchPlacer:
 
             self._data_axes = data_axes(mesh)
         self._warned_pad = False
+        # Always defined — the no-mesh path never sets them in __call__, and
+        # DataLoaderShard reads them after every conversion.
+        self.last_pad_rows = 0
+        self.last_batch_rows = 0
 
     @property
     def num_data_shards(self) -> int:

@@ -379,10 +379,21 @@ def attach_align_device_hook(
     skip_keys=None,
     tied_params_map: Optional[dict] = None,
     tied_names: Optional[Mapping] = None,
+    preload_module_classes: Optional[list] = None,
 ):
     """Attach AlignDevicesHooks to every leaf module holding weights (reference
-    ``hooks.py:460``)."""
-    directs = list(named_module_tensors(module, recurse=False))
+    ``hooks.py:460``).
+
+    ``preload_module_classes``: class names whose WHOLE subtree materializes at
+    that module's own pre-forward (``place_submodules=True``) — required when a
+    forward uses child weights functionally (``F.linear(x, self.sub.weight)``)
+    so the child's forward (and its hook) never runs.
+    """
+    preload = (
+        preload_module_classes is not None
+        and type(module).__name__ in preload_module_classes
+    )
+    directs = list(named_module_tensors(module, recurse=preload))
     if directs:
         module._hook_weights_prefix = f"{module_name}." if module_name else ""
         add_hook_to_module(
@@ -392,12 +403,15 @@ def attach_align_device_hook(
                 offload=offload,
                 weights_map=weights_map,
                 offload_buffers=offload_buffers,
+                place_submodules=preload,
                 skip_keys=skip_keys,
                 tied_params_map=tied_params_map,
                 tied_names=tied_names,
             ),
             append=True,
         )
+    if preload:
+        return  # the whole subtree is owned by this module's hook
     for child_name, child in module.named_children():
         full = f"{module_name}.{child_name}" if module_name else child_name
         attach_align_device_hook(
@@ -410,6 +424,7 @@ def attach_align_device_hook(
             skip_keys=skip_keys,
             tied_params_map=tied_params_map,
             tied_names=tied_names,
+            preload_module_classes=preload_module_classes,
         )
 
 
@@ -423,6 +438,7 @@ def attach_align_device_hook_on_blocks(
     skip_keys=None,
     tied_params_map: Optional[dict] = None,
     tied_names: Optional[Mapping] = None,
+    preload_module_classes: Optional[list] = None,
 ):
     """Per-block variant driven by a device map (reference ``hooks.py:555``).
 
@@ -446,6 +462,7 @@ def attach_align_device_hook_on_blocks(
                 skip_keys=skip_keys,
                 tied_params_map=tied_params_map,
                 tied_names=tied_names,
+                preload_module_classes=preload_module_classes,
             )
         else:
             module._hook_weights_prefix = f"{module_name}." if module_name else ""
@@ -472,6 +489,7 @@ def attach_align_device_hook_on_blocks(
             skip_keys=skip_keys,
             tied_params_map=tied_params_map,
             tied_names=tied_names,
+            preload_module_classes=preload_module_classes,
         )
 
 

@@ -144,6 +144,25 @@ class AcceleratedOptimizer:
         """Parity: reference ``optimizer_step_was_skipped`` (``accelerator.py:3764``)."""
         return self._step_was_skipped
 
+    # Pickling (reference tests/test_optimizer.py:26): the optax transform is
+    # a closure (unpicklable) and the model holds compiled steps — both drop;
+    # the transform rebuilds from the picklable shadow torch optimizer, and
+    # the model re-pairs at the next prepare() (same contract as Accelerator).
+    def __getstate__(self):
+        state = {k: v for k, v in self.__dict__.items() if k not in ("tx", "model")}
+        state["opt_state"] = jax.device_get(self.opt_state) if self.opt_state is not None else None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self.model = None
+        if self.torch_optimizer is not None:
+            from .utils.torch_bridge import convert_optimizer
+
+            self.tx, _ = convert_optimizer(self.torch_optimizer)
+        else:
+            self.tx = None
+
     def state_dict(self) -> dict:
         return {
             "opt_state": jax.device_get(self.opt_state),

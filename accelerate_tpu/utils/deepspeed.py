@@ -154,6 +154,9 @@ class DeepSpeedPlugin:
             off_par = cfg.get_value("zero_optimization.offload_param.device")
             if off_par is not None and off_par != "auto":
                 self.offload_param_device = off_par
+            save16 = cfg.get_value("zero_optimization.stage3_gather_16bit_weights_on_model_save")
+            if save16 is not None and save16 != "auto":
+                self.zero3_save_16bit_model = bool(save16)
         if self.zero_stage not in _ZERO_TO_STRATEGY:
             raise ValueError(f"zero_stage must be 0..3, got {self.zero_stage}")
         if self.zero3_init_flag is None:

@@ -216,3 +216,171 @@ def test_layerwise_casting_hooks():
     torch.testing.assert_close(out, expected, atol=0.05, rtol=0.05)
     assert m.linear1.weight.dtype == torch.bfloat16
     remove_hook_from_submodules(m)
+
+
+class ModelWithUnusedSubModules(nn.Module):
+    """Reference fixture analog: submodules whose weights are used FUNCTIONALLY
+    (torch.nn.functional.linear) rather than via the submodule's forward."""
+
+    def __init__(self):
+        super().__init__()
+        self.linear1 = nn.Linear(3, 4)
+        self.linear2 = nn.Linear(4, 5)
+
+    def forward(self, x):
+        import torch.nn.functional as F
+
+        return F.linear(F.linear(x, self.linear1.weight, self.linear1.bias),
+                        self.linear2.weight, self.linear2.bias)
+
+
+def test_cpu_offload_with_unused_submodules():
+    """Reference :222 — functional use of offloaded weights still works when
+    the owning modules are preloaded as one block."""
+    import torch
+
+    from accelerate_tpu.big_modeling import cpu_offload
+    from accelerate_tpu.hooks import remove_hook_from_submodules
+
+    model = ModelWithUnusedSubModules()
+    x = torch.randn(2, 3)
+    expected = model(x)
+    # preload: the root's hook materializes the WHOLE subtree before forward —
+    # the functional access never triggers the leaf hooks (reference
+    # preload_module_classes contract).
+    cpu_offload(
+        model, execution_device="cpu",
+        preload_module_classes=["ModelWithUnusedSubModules"],
+    )
+    out = model(x)
+    torch.testing.assert_close(expected, out, atol=1e-5, rtol=1e-5)
+    remove_hook_from_submodules(model)
+
+
+def test_dispatch_model_and_remove_hook(tmp_path):
+    """Reference :317 — after remove_hook_from_submodules the model is plain
+    torch again: weights resident, .to() restored."""
+    import torch
+
+    from accelerate_tpu.big_modeling import dispatch_model
+    from accelerate_tpu.hooks import remove_hook_from_submodules
+
+    model = ModelForTest()
+    x = torch.randn(2, 3)
+    expected = model(x)
+    dispatch_model(
+        model,
+        {"linear1": "cpu", "batchnorm": "disk", "linear2": "disk"},
+        offload_dir=str(tmp_path / "off"),
+    )
+    torch.testing.assert_close(expected, model(x), atol=1e-5, rtol=1e-5)
+    with pytest.raises(RuntimeError, match="dispatched"):
+        model.to("cpu")
+    remove_hook_from_submodules(model)
+    model.to = model._original_to
+    model.to("cpu")
+    torch.testing.assert_close(expected, model(x), atol=1e-5, rtol=1e-5)
+
+
+def test_dispatch_model_with_non_persistent_buffers(tmp_path):
+    """Reference :356 — non-persistent buffers ride dispatch without entries
+    in the offload index."""
+    import torch
+
+    from accelerate_tpu.big_modeling import dispatch_model
+
+    class BufMod(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.register_buffer("scale", torch.full((1,), 2.0), persistent=False)
+            self.lin = nn.Linear(3, 3)
+
+        def forward(self, x):
+            return self.lin(x) * self.scale
+
+    model = BufMod()
+    x = torch.randn(2, 3)
+    expected = model(x)
+    dispatch_model(model, {"": "cpu"}, offload_dir=str(tmp_path / "off"))
+    torch.testing.assert_close(expected, model(x), atol=1e-5, rtol=1e-5)
+
+
+def test_dispatch_model_tied_weights_forward(tmp_path):
+    """Reference :368 — tied weights stay tied through dispatch; forward
+    parity on a tied-embedding LM head."""
+    import torch
+
+    from accelerate_tpu.big_modeling import dispatch_model
+
+    class TiedLM(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.embed = nn.Embedding(12, 8)
+            self.head = nn.Linear(8, 12, bias=False)
+            self.head.weight = self.embed.weight
+
+        def forward(self, ids):
+            return self.head(self.embed(ids))
+
+    model = TiedLM()
+    ids = torch.arange(6).reshape(2, 3)
+    expected = model(ids)
+    dispatch_model(
+        model,
+        {"embed": "disk", "head": "disk"},
+        offload_dir=str(tmp_path / "off"),
+    )
+    torch.testing.assert_close(expected, model(ids), atol=1e-5, rtol=1e-5)
+
+
+def test_dispatch_model_force_hooks(tmp_path):
+    """Reference :773 — force_hooks attaches the machinery even when every
+    block fits the first tier."""
+    import torch
+
+    from accelerate_tpu.big_modeling import dispatch_model
+
+    model = ModelForTest()
+    x = torch.randn(2, 3)
+    expected = model(x)
+    dispatch_model(model, {"": "tpu"}, force_hooks=True)
+    torch.testing.assert_close(expected, model(x), atol=1e-5, rtol=1e-5)
+
+
+def test_load_checkpoint_and_dispatch_device_map_none(tmp_path):
+    """Reference :806 — device_map=None loads everything resident, no hooks."""
+    import torch
+    from safetensors.torch import save_file
+
+    from accelerate_tpu.big_modeling import init_empty_weights, load_checkpoint_and_dispatch
+
+    src = ModelForTest()
+    sd = {k: v.clone() for k, v in src.state_dict().items()}
+    save_file(sd, str(tmp_path / "model.safetensors"))
+    with init_empty_weights():
+        model = ModelForTest()
+    model = load_checkpoint_and_dispatch(model, str(tmp_path / "model.safetensors"), device_map=None)
+    x = torch.randn(2, 3)
+    src.eval(), model.eval()
+    torch.testing.assert_close(src(x), model(x), atol=1e-5, rtol=1e-5)
+    assert not hasattr(model, "_hf_hook")
+
+
+def test_cpu_offload_with_hook_chain():
+    """Reference :904 — cpu_offload_with_hook: running module N offloads
+    module N-1 (sequential pipeline pattern)."""
+    import torch
+
+    from accelerate_tpu.big_modeling import cpu_offload_with_hook
+
+    m1 = nn.Linear(3, 3)
+    m2 = nn.Linear(3, 3)
+    x = torch.randn(2, 3)
+    expected = m2(m1(x))
+    m1, hook1 = cpu_offload_with_hook(m1, execution_device="cpu")
+    m2, hook2 = cpu_offload_with_hook(m2, execution_device="cpu", prev_module_hook=hook1)
+    out = m2(m1(x))
+    torch.testing.assert_close(expected, out, atol=1e-5, rtol=1e-5)
+    hook2.offload()
+    hook1.remove()
+    hook2.remove()

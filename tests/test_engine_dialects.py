@@ -272,3 +272,69 @@ def test_gpt_train_step_forward_func_requires_config():
     step = GPTTrainStep()
     with pytest.raises(ValueError, match="config"):
         step.get_forward_step_func()
+
+
+# ---------------------------------------------------------------------------
+# Reference fixture-file matrix (tests/deepspeed/ds_config_zero*.json) and
+# autofill depth (reference test_deepspeed.py config-autofill unit tests).
+# ---------------------------------------------------------------------------
+
+import os as _os
+
+_FIXTURES = _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), "fixtures", "deepspeed")
+
+
+@pytest.mark.parametrize("name,stage,strategy", [
+    ("ds_config_zero2.json", 2, "SHARD_GRAD_OP"),
+    ("ds_config_zero3.json", 3, "FULL_SHARD"),
+])
+def test_reference_fixture_configs_parse(name, stage, strategy):
+    plugin = DeepSpeedPlugin(hf_ds_config=_os.path.join(_FIXTURES, name))
+    assert plugin.zero_stage == stage
+    assert plugin.sharding_strategy == strategy
+    cfg = plugin.hf_ds_config
+    assert cfg.is_auto("train_micro_batch_size_per_gpu")
+    assert cfg.is_auto("gradient_accumulation_steps")
+
+
+def test_fill_auto_resolves_runtime_facts():
+    """Reference accelerator.py:1941-1998 — auto fields resolve from the
+    dataloader batch size and world size; explicit values are untouched."""
+    plugin = DeepSpeedPlugin(
+        hf_ds_config=_os.path.join(_FIXTURES, "ds_config_zero2.json"),
+        gradient_accumulation_steps=4,
+        gradient_clipping=0.5,
+    )
+    plugin.fill_auto(train_micro_batch_size_per_gpu=16, num_devices=8)
+    cfg = plugin.hf_ds_config
+    assert cfg.get_value("train_micro_batch_size_per_gpu") == 16
+    assert cfg.get_value("train_batch_size") == 16 * 4 * 8
+    assert cfg.get_value("gradient_accumulation_steps") == 4
+    assert cfg.get_value("gradient_clipping") == 0.5
+    # Non-auto values survive untouched.
+    assert cfg.get_value("zero_optimization.stage") == 2
+    assert cfg.get_value("steps_per_print") == 2000
+
+
+def test_fill_auto_keeps_explicit_clipping():
+    plugin = DeepSpeedPlugin(hf_ds_config=_os.path.join(_FIXTURES, "ds_config_zero3.json"))
+    plugin.fill_auto(train_micro_batch_size_per_gpu=2, num_devices=4)
+    # zero3 fixture pins gradient_clipping=1.0 explicitly.
+    assert plugin.hf_ds_config.get_value("gradient_clipping") == 1.0
+
+
+def test_zero2_cpu_offload_maps_to_host_placement():
+    """offload_optimizer.device=cpu in the fixture must mark the dialect's
+    FSDP plugin for host offload (reference zero2 offload contract)."""
+    plugin = DeepSpeedPlugin(hf_ds_config=_os.path.join(_FIXTURES, "ds_config_zero2.json"))
+    fsdp = plugin.to_fsdp_plugin()
+    assert fsdp.cpu_offload is True
+    assert fsdp.sharding_strategy == "SHARD_GRAD_OP"
+
+
+def test_zero3_16bit_save_flag_surfaces():
+    plugin = DeepSpeedPlugin(hf_ds_config=_os.path.join(_FIXTURES, "ds_config_zero3.json"))
+    assert plugin.hf_ds_config.get_value(
+        "zero_optimization.stage3_gather_16bit_weights_on_model_save"
+    ) is True
+    assert plugin.zero3_save_16bit_model

@@ -1,0 +1,121 @@
+"""FSDP plugin env-contract + sharding-spec matrix.
+
+Parity target: reference ``tests/fsdp/test_fsdp.py`` (652 LoC) plugin-env unit
+tests — every ``FSDP_*`` env var must reconstruct the plugin field, and each
+sharding strategy must produce the right GSPMD placement (the TPU meaning of
+the reference's wrap/strategy assertions); re-run for fsdp_version 1 and 2
+like the reference's v1/v2 ``run()`` override.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from accelerate_tpu import AcceleratorState, ParallelismConfig
+from accelerate_tpu.parallel.sharding import make_param_specs
+from accelerate_tpu.utils.dataclasses import FullyShardedDataParallelPlugin
+
+STRATEGIES = ["FULL_SHARD", "SHARD_GRAD_OP", "NO_SHARD", "HYBRID_SHARD"]
+
+
+@pytest.mark.parametrize("version", [1, 2])
+@pytest.mark.parametrize("strategy", STRATEGIES)
+def test_env_reconstructs_strategy(monkeypatch, strategy, version):
+    """Reference test_fsdp.py sharding-strategy env matrix, both name and the
+    reference's 1..4 int spelling, for FSDP v1 and v2."""
+    monkeypatch.setenv("FSDP_SHARDING_STRATEGY", strategy)
+    plugin = FullyShardedDataParallelPlugin(fsdp_version=version)
+    assert plugin.sharding_strategy == strategy
+
+    monkeypatch.setenv("FSDP_SHARDING_STRATEGY", str(STRATEGIES.index(strategy) + 1))
+    plugin = FullyShardedDataParallelPlugin(fsdp_version=version)
+    assert plugin.sharding_strategy == strategy
+
+
+def test_env_reconstructs_all_fields(monkeypatch):
+    monkeypatch.setenv("FSDP_SHARDING_STRATEGY", "SHARD_GRAD_OP")
+    monkeypatch.setenv("FSDP_MIN_NUM_PARAMS", "2000")
+    monkeypatch.setenv("FSDP_CPU_OFFLOAD", "true")
+    monkeypatch.setenv("FSDP_STATE_DICT_TYPE", "full_state_dict")
+    monkeypatch.setenv("FSDP_ACTIVATION_CHECKPOINTING", "1")
+    monkeypatch.setenv("FSDP_TRANSFORMER_CLS_TO_WRAP", "LlamaDecoderLayer,GPT2Block")
+    plugin = FullyShardedDataParallelPlugin()
+    assert plugin.sharding_strategy == "SHARD_GRAD_OP"
+    assert plugin.min_num_params == 2000
+    assert plugin.cpu_offload is True
+    assert plugin.state_dict_type == "FULL_STATE_DICT"
+    assert plugin.activation_checkpointing is True
+    assert plugin.transformer_cls_names_to_wrap == ["LlamaDecoderLayer", "GPT2Block"]
+
+
+def test_invalid_strategy_raises():
+    with pytest.raises(ValueError, match="sharding_strategy"):
+        FullyShardedDataParallelPlugin(sharding_strategy="ZERO_INFINITY")
+
+
+def _axes(spec):
+    """Flatten a PartitionSpec (or None) into its named axes."""
+    if spec is None:
+        return []
+    out = []
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        out.extend(entry if isinstance(entry, tuple) else (entry,))
+    return out
+
+
+def _specs_for(strategy: str, min_num_params: int = 0):
+    AcceleratorState._reset_state()
+    state = AcceleratorState(parallelism_config=ParallelismConfig(fsdp=8))
+    plugin = FullyShardedDataParallelPlugin(
+        sharding_strategy=strategy, min_num_params=min_num_params
+    )
+    params = {
+        "big": np.zeros((1024, 64), np.float32),   # 65k params
+        "small": np.zeros((8,), np.float32),
+    }
+    return make_param_specs(params, state.mesh, plugin)
+
+
+@pytest.mark.parametrize("strategy", STRATEGIES)
+def test_strategy_to_gspmd_placement(strategy):
+    """The TPU meaning of each strategy: FULL/HYBRID shard the params on the
+    fsdp axis; SHARD_GRAD_OP/NO_SHARD keep params replicated (grads/opt-state
+    sharding is decided at optimizer build, ZeRO-2 style)."""
+    specs = _specs_for(strategy)
+    big = specs["big"]
+    if strategy in ("FULL_SHARD", "HYBRID_SHARD"):
+        assert "fsdp" in _axes(big), (strategy, big)
+    else:
+        assert "fsdp" not in _axes(big), (strategy, big)
+
+
+def test_min_num_params_keeps_small_arrays_replicated():
+    """Reference auto-wrap min_num_params: arrays under the threshold stay
+    replicated even under FULL_SHARD."""
+    specs = _specs_for("FULL_SHARD", min_num_params=1000)
+    assert "fsdp" not in _axes(specs["small"]), specs["small"]
+    assert "fsdp" in _axes(specs["big"]), specs["big"]
+
+
+def test_fsdp_versions_map_to_same_design():
+    """fsdp_version 1 and 2 produce identical placement (both are the one
+    GSPMD design; the reference needs two separate code paths)."""
+    AcceleratorState._reset_state()
+    state = AcceleratorState(parallelism_config=ParallelismConfig(fsdp=8))
+    params = {"w": np.zeros((512, 64), np.float32)}
+    s1 = make_param_specs(params, state.mesh, FullyShardedDataParallelPlugin(fsdp_version=1))
+    s2 = make_param_specs(params, state.mesh, FullyShardedDataParallelPlugin(fsdp_version=2))
+    assert s1 == s2
+
+
+def test_cpu_offload_flows_into_host_sharding():
+    """cpu_offload=True marks the plugin for host-memory placement of sharded
+    state (the dryrun/mesh tests exercise the actual placement); here the
+    contract is the flag survives env + ctor precedence."""
+    plugin = FullyShardedDataParallelPlugin(cpu_offload=True)
+    assert plugin.cpu_offload is True
+    assert plugin.shards_parameters  # FULL_SHARD default still shards

@@ -195,3 +195,75 @@ def test_version_post_release_and_rc_ordering():
     assert compare_versions("1.2.3.post1", ">=", "1.2.3")
     assert compare_versions("0.4.0rc2", ">", "0.4.0rc1")
     assert not compare_versions("0.4.0rc1", ">=", "0.4.0rc2")
+
+
+def test_kwargs_handlers_route_to_named_slots():
+    """Reference tests/test_kwargs_handlers.py — each handler lands in its
+    accelerator slot; duplicates and unknown types raise."""
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.utils import (
+        AutocastKwargs,
+        DistributedDataParallelKwargs,
+        GradScalerKwargs,
+        ProfileKwargs,
+    )
+
+    for cls in (AcceleratorState, GradientState, PartialState):
+        cls._reset_state()
+    ddp = DistributedDataParallelKwargs(comm_hook="bf16")
+    scaler = GradScalerKwargs(init_scale=1024, growth_factor=2)
+    autocast = AutocastKwargs(enabled=False)
+    profile = ProfileKwargs()
+    acc = Accelerator(kwargs_handlers=[ddp, scaler, autocast, profile])
+    assert acc.ddp_handler is ddp
+    assert acc.scaler_handler is scaler
+    assert acc.autocast_handler is autocast
+    assert acc.profile_handler is profile
+
+    for cls in (AcceleratorState, GradientState, PartialState):
+        cls._reset_state()
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="only pass one"):
+        Accelerator(kwargs_handlers=[AutocastKwargs(), AutocastKwargs()])
+    for cls in (AcceleratorState, GradientState, PartialState):
+        cls._reset_state()
+    with _pytest.raises(ValueError, match="Unsupported kwargs handler"):
+        Accelerator(kwargs_handlers=[object()])
+
+
+def test_grad_scaler_kwargs_apply():
+    """GradScalerKwargs fields reach the scaler config under fp16 (reference
+    test_grad_scaler_kwargs, minus the CUDA requirement)."""
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.utils import GradScalerKwargs
+
+    for cls in (AcceleratorState, GradientState, PartialState):
+        cls._reset_state()
+    handler = GradScalerKwargs(init_scale=1024, growth_factor=3.0)
+    acc = Accelerator(mixed_precision="fp16", kwargs_handlers=[handler])
+    assert acc.mixed_precision == "fp16"
+    kw = handler.to_kwargs()
+    # growth_factor default is 2.0 (torch GradScaler) — only diffs survive.
+    assert kw == {"init_scale": 1024, "growth_factor": 3.0}
+
+
+def test_ddp_comm_hook_flows_to_grad_dtype():
+    """DistributedDataParallelKwargs.comm_hook selects the bf16 grad-sync
+    dtype on prepared models (our comm-hook analog)."""
+    import torch
+
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.utils import DistributedDataParallelKwargs
+
+    for cls in (AcceleratorState, GradientState, PartialState):
+        cls._reset_state()
+    acc = Accelerator(kwargs_handlers=[DistributedDataParallelKwargs(comm_hook="bf16")])
+    model = torch.nn.Linear(2, 2)
+    prepared = acc.prepare(model)
+    import jax.numpy as jnp
+
+    assert prepared._grad_sync_dtype == jnp.bfloat16
