@@ -78,6 +78,12 @@ class LlamaConfig:
     # (the reference's fp8 bridges likewise skip first/last layers,
     # utils/ao.py:104).
     fp8: bool = False
+    # "dense": logits [B,S,V] materialize in fp32 (fastest at tiny vocab).
+    # "chunked": ops/chunked_ce.py streams the head matmul over vocab tiles
+    #   with an online logsumexp — peak HBM drops by the full logits tensor
+    #   (+ its cotangent), the binding constraint on batch size at real vocab.
+    loss_impl: str = "dense"
+    loss_chunk_size: int = 4096
 
     def __post_init__(self):
         if self.attention_impl not in ("auto", "einsum", "flash", "pallas"):
@@ -89,6 +95,8 @@ class LlamaConfig:
             raise ValueError(f"remat_policy must be 'nothing' or 'dots', got {self.remat_policy!r}")
         if self.sp_impl not in ("ring", "ulysses"):
             raise ValueError(f"sp_impl must be 'ring' or 'ulysses', got {self.sp_impl!r}")
+        if self.loss_impl not in ("dense", "chunked"):
+            raise ValueError(f"loss_impl must be 'dense' or 'chunked', got {self.loss_impl!r}")
 
     @property
     def head_dim_(self) -> int:
@@ -407,6 +415,20 @@ def apply(
     attention_mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Forward pass: token ids [B, S] -> logits [B, S, V] (fp32)."""
+    hidden = apply_hidden(params, input_ids, config, positions, attention_mask)
+    return (hidden @ lm_head(params, config)).astype(jnp.float32)
+
+
+def apply_hidden(
+    params: dict,
+    input_ids: jax.Array,
+    config: LlamaConfig,
+    positions: Optional[jax.Array] = None,
+    attention_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Trunk forward: token ids [B, S] -> final-normed hidden [B, S, d]
+    (compute dtype) — the chunked loss consumes this directly so the full
+    logits tensor never exists."""
     c = config
     b, s = input_ids.shape
     # Padding stays factored as a [B, S] key-validity vector all the way down —
@@ -434,7 +456,7 @@ def apply(
     if c.remat:
         body = jax.checkpoint(body, policy=_remat_policy(c.remat_policy))
     x, _ = jax.lax.scan(body, x, params["layers"])
-    return unembed(params, x, c)
+    return final_norm(params, x, c)
 
 
 def _remat_policy(name: str):
@@ -451,12 +473,21 @@ def embed_tokens(params: dict, input_ids: jax.Array, config: LlamaConfig) -> jax
     return params["embed"].astype(config.dtype)[input_ids]
 
 
+def final_norm(params: dict, x: jax.Array, config: LlamaConfig) -> jax.Array:
+    """The pre-head RMS norm (shared by the dense and chunked loss paths)."""
+    return _rms_norm(x, params["final_norm"], config.rms_eps)
+
+
+def lm_head(params: dict, config: LlamaConfig) -> jax.Array:
+    """The [d, V] head matrix in compute dtype (transposed view when tied)."""
+    head = params["embed"].T if config.tie_embeddings else params["lm_head"]
+    return head.astype(config.dtype)
+
+
 def unembed(params: dict, x: jax.Array, config: LlamaConfig) -> jax.Array:
     """Final norm + LM head -> fp32 logits — shared by the dense and
     pipeline-parallel paths."""
-    x = _rms_norm(x, params["final_norm"], config.rms_eps)
-    head = params["embed"].T if config.tie_embeddings else params["lm_head"]
-    return (x @ head.astype(config.dtype)).astype(jnp.float32)
+    return (final_norm(params, x, config) @ lm_head(params, config)).astype(jnp.float32)
 
 
 def labels_and_weights(batch: dict) -> tuple[jax.Array, jax.Array]:
@@ -491,8 +522,21 @@ def loss_fn(
     batch: dict,
     config: LlamaConfig,
 ) -> jax.Array:
-    """Next-token cross-entropy, fp32, mean over non-padded targets."""
+    """Next-token cross-entropy, fp32, mean over non-padded targets.
+
+    ``config.loss_impl == "chunked"`` computes the same loss through
+    ``ops/chunked_ce.py`` without ever materializing the [B, S, V] logits —
+    the HBM that usually caps the batch size."""
     labels, weights = labels_and_weights(batch)
+    if config.loss_impl == "chunked":
+        from ..ops.chunked_ce import chunked_cross_entropy
+
+        x = apply_hidden(
+            params, batch["input_ids"], config, attention_mask=batch.get("attention_mask")
+        )
+        return chunked_cross_entropy(
+            x, lm_head(params, config), labels, weights, config.loss_chunk_size
+        )
     logits = apply(params, batch["input_ids"], config, attention_mask=batch.get("attention_mask"))
     return cross_entropy(logits, labels, weights)
 

@@ -1,0 +1,90 @@
+"""Chunked-vocab cross-entropy — the LM-head loss without the logits tensor.
+
+The standard path materializes fp32 logits ``[B, S, V]`` (2 GB at the bench
+shapes: 8 x 2048 x 32000 x 4B) plus their cotangent in the backward — the
+single largest HBM spike in llama training and the binding constraint on
+batch size.  This op streams the head matmul over vocab chunks with an online
+logsumexp (same trick flash attention uses over keys), so peak memory is one
+``[B, S, chunk]`` tile; autodiff through the ``lax.scan`` recomputes tiles in
+the backward instead of saving them.
+
+No reference counterpart (the reference delegates the loss to user torch
+code); this is TPU-native capability in service of BASELINE.md's MFU target.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["chunked_cross_entropy"]
+
+
+def chunked_cross_entropy(
+    x: jax.Array,
+    head: jax.Array,
+    labels: jax.Array,
+    weights: jax.Array,
+    chunk_size: int = 4096,
+) -> jax.Array:
+    """Weighted-mean token CE of ``softmax(x @ head)`` without full logits.
+
+    ``x``: activations ``[B, S, d]`` (compute dtype — the matmul runs on the
+    MXU in that dtype; statistics accumulate in fp32).
+    ``head``: LM head ``[d, V]``.
+    ``labels``: int ``[B, S]``; ``weights``: fp32 ``[B, S]``.
+
+    Equivalent to ``cross_entropy(x @ head, labels, weights)`` up to fp32
+    rounding: per token, ``loss = logsumexp(logits) - logits[label]``.
+    """
+    d, v = head.shape
+    if v % chunk_size != 0:
+        # One clean remainder chunk keeps shapes static inside the scan.
+        pad = chunk_size - v % chunk_size
+        head = jnp.concatenate([head, jnp.full((d, pad), 0, head.dtype)], axis=1)
+        # Padded columns get -inf logits via a validity mask, not zero weights:
+        # a zero logit would pollute the logsumexp.
+        valid_cols = jnp.arange(head.shape[1]) < v
+    else:
+        valid_cols = None
+    n_chunks = head.shape[1] // chunk_size
+    head_tiles = head.reshape(d, n_chunks, chunk_size).transpose(1, 0, 2)  # [C, d, chunk]
+
+    labels = labels.astype(jnp.int32)
+
+    def tile(carry, inputs):
+        m, s, label_logit = carry  # running max, sumexp at m, label logit
+        tile_head, c_idx = inputs
+        logits = (x @ tile_head).astype(jnp.float32)  # [B, S, chunk]
+        if valid_cols is not None:
+            col0 = c_idx * chunk_size
+            mask = jax.lax.dynamic_slice_in_dim(valid_cols, col0, chunk_size)
+            logits = jnp.where(mask[None, None, :], logits, -jnp.inf)
+        tile_max = jnp.max(logits, axis=-1)
+        new_m = jnp.maximum(m, tile_max)
+        # Rescale the old sum to the new max; add this tile's mass.
+        s = s * jnp.exp(m - new_m) + jnp.sum(jnp.exp(logits - new_m[..., None]), axis=-1)
+        # Label logit if the label falls in this tile.
+        offset = labels - c_idx * chunk_size
+        in_tile = (offset >= 0) & (offset < chunk_size)
+        safe = jnp.clip(offset, 0, chunk_size - 1)
+        got = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+        label_logit = jnp.where(in_tile, got, label_logit)
+        return (new_m, s, label_logit), None
+
+    b, s_len = labels.shape
+    init = (
+        jnp.full((b, s_len), -jnp.inf, jnp.float32),
+        jnp.zeros((b, s_len), jnp.float32),
+        jnp.zeros((b, s_len), jnp.float32),
+    )
+    # WITHOUT remat, scan's VJP would stack per-tile residuals ([C, B, S,
+    # chunk] fp32 — the very logits-sized footprint this op exists to avoid);
+    # checkpointing the body makes the backward recompute each tile from the
+    # carried fp32 statistics instead.
+    tile = jax.checkpoint(tile, policy=jax.checkpoint_policies.nothing_saveable)
+    (m, s, label_logit), _ = jax.lax.scan(
+        tile, init, (head_tiles, jnp.arange(n_chunks, dtype=jnp.int32))
+    )
+    token_loss = (m + jnp.log(s)) - label_logit  # logsumexp - label logit
+    return jnp.sum(token_loss * weights) / jnp.maximum(jnp.sum(weights), 1.0)

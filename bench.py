@@ -40,6 +40,7 @@ def _run(
     seq: int,
     attention_impl: str = "flash",
     remat_policy: str = "dots",
+    loss_impl: str = "dense",
 ):
     import jax
     import jax.numpy as jnp
@@ -61,6 +62,9 @@ def _run(
         # layer — measured +3.4 MFU points over einsum+nothing_saveable on v5e.
         attention_impl=attention_impl,
         remat_policy=remat_policy,
+        # "chunked" streams the LM-head loss over vocab tiles — removes the
+        # [B,S,32000] fp32 logits (+cotangent) HBM spike entirely.
+        loss_impl=loss_impl,
     )
     params = llama.init_params(cfg, jax.random.key(0))
     tx = optax.adamw(1e-4)
@@ -111,17 +115,30 @@ def _run(
 
 
 LADDER = [
-    # Rung 1 is the tuned path; later rungs are proven-conservative fallbacks
-    # on the same model (einsum attention, full remat) then smaller models.
-    # batch 8 measured +0.7 MFU points over batch 4 on v5e (0.604 vs 0.597);
-    # 12/16 fail to compile (HBM), seq 4096 and flash both lose.
-    ("llama-509m", 2048, 6, 8192, 8, 2048, "pallas", "dots"),
-    ("llama-509m", 2048, 6, 8192, 4, 2048, "pallas", "dots"),
-    ("llama-509m", 2048, 6, 8192, 4, 2048, "flash", "dots"),
-    ("llama-509m", 2048, 6, 8192, 4, 2048, "einsum", "nothing"),
-    ("llama-310m", 1536, 6, 6144, 4, 2048, "einsum", "nothing"),
-    ("llama-128m", 1024, 4, 4096, 4, 1024, "einsum", "nothing"),
+    # Rung 0: the PROVEN round-1 path (0.604 MFU on v5e) — an unmeasured
+    # variant must never shadow it (the ladder stops at the first success).
+    # Later rungs are conservative fallbacks (einsum attention, full remat)
+    # then smaller models.  batch 8 measured +0.7 MFU points over batch 4 on
+    # v5e (0.604 vs 0.597); 12/16 fail to compile (HBM) with the dense loss;
+    # seq 4096 and flash both lose.
+    ("llama-509m", 2048, 6, 8192, 8, 2048, "pallas", "dots", "dense"),
+    ("llama-509m", 2048, 6, 8192, 4, 2048, "pallas", "dots", "dense"),
+    ("llama-509m", 2048, 6, 8192, 4, 2048, "flash", "dots", "dense"),
+    ("llama-509m", 2048, 6, 8192, 4, 2048, "einsum", "nothing", "dense"),
+    ("llama-310m", 1536, 6, 6144, 4, 2048, "einsum", "nothing", "dense"),
+    ("llama-128m", 1024, 4, 4096, 4, 1024, "einsum", "nothing", "dense"),
 ]
+
+# Opt-in candidates (unmeasured on hardware; a failed remote compile can wedge
+# the device tunnel, so bigger batches must be requested explicitly):
+# BENCH_TRY_CHUNKED=1 leads with the chunked-vocab loss at the proven batch —
+# remat'd scan removes the [B,S,V] logits (+cotangent) HBM spike
+# (ops/chunked_ce.py); BENCH_TRY_BIG=1 additionally tries the larger batch
+# that freed HBM may admit.
+if os.environ.get("BENCH_TRY_CHUNKED") or os.environ.get("BENCH_TRY_BIG"):
+    LADDER.insert(0, ("llama-509m", 2048, 6, 8192, 8, 2048, "pallas", "dots", "chunked"))
+if os.environ.get("BENCH_TRY_BIG"):
+    LADDER.insert(0, ("llama-509m", 2048, 6, 8192, 12, 2048, "pallas", "dots", "chunked"))
 
 # Test hook: lets the smoke tests exercise the rung-subprocess machinery with
 # CPU-sized configs (a real rung takes minutes on CPU).
@@ -202,8 +219,10 @@ def main():
         return
     if "--rung" in sys.argv:
         idx = int(sys.argv[sys.argv.index("--rung") + 1])
-        name, d, layers, f, b, s, impl, policy = LADDER[idx]
-        print(json.dumps(_run(name, d, layers, f, b, s, impl, policy)))
+        rung = LADDER[idx]
+        name, d, layers, f, b, s, impl, policy = rung[:8]
+        loss_impl = rung[8] if len(rung) > 8 else "dense"
+        print(json.dumps(_run(name, d, layers, f, b, s, impl, policy, loss_impl)))
         return
 
     # Fast-fail (then retry, bounded) when the device backend is unreachable
@@ -233,7 +252,9 @@ def main():
     rung_log = []
     rung_cfg = None
     for i, rung in enumerate(LADDER):
-        name, _, _, _, batch, seq, impl, policy = rung
+        name, _, _, _, batch, seq, impl, policy = rung[:8]
+        if len(rung) > 8:
+            policy = f"{policy}/{rung[8]}"
         result, err = _run_rung_subprocess(i, timeout_s=480)
         # Per-rung emission: a later crash can no longer zero the round — the
         # outcome of every attempted rung is in the final JSON and on stderr.
