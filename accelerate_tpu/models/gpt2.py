@@ -39,6 +39,15 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = True
+    # "chunked" streams the (tied) LM-head loss over vocab tiles
+    # (ops/chunked_ce.py) — at GPT-2's 50257 vocab the dense fp32 logits are
+    # the single largest activation; same knob as LlamaConfig.loss_impl.
+    loss_impl: str = "dense"
+    loss_chunk_size: int = 4096
+
+    def __post_init__(self):
+        if self.loss_impl not in ("dense", "chunked"):
+            raise ValueError(f"loss_impl must be 'dense' or 'chunked', got {self.loss_impl!r}")
 
     @property
     def head_dim(self) -> int:
@@ -179,6 +188,12 @@ def _layer(carry, p, *, c: GPT2Config, mask, act_spec):
     return x, None
 
 
+def lm_head(params: dict, config: GPT2Config) -> jax.Array:
+    """The tied [d, V] head (wte transposed) in compute dtype — single source
+    for apply() and the chunked loss (mirrors llama.lm_head)."""
+    return params["wte"].astype(config.dtype).T
+
+
 def apply(
     params: dict,
     input_ids: jax.Array,
@@ -186,6 +201,17 @@ def apply(
     attention_mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Token ids [B, S] -> fp32 logits [B, S, V] (tied lm head)."""
+    hidden = apply_hidden(params, input_ids, config, attention_mask)
+    return (hidden @ lm_head(params, config)).astype(jnp.float32)
+
+
+def apply_hidden(
+    params: dict,
+    input_ids: jax.Array,
+    config: GPT2Config,
+    attention_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Trunk forward -> final-LN hidden [B, S, d] (compute dtype)."""
     c = config
     b, s = input_ids.shape
     mask = jnp.broadcast_to(jnp.tril(jnp.ones((s, s), bool)), (b, s, s))
@@ -202,12 +228,20 @@ def apply(
     if c.remat:
         body = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)
     x, _ = jax.lax.scan(body, x, params["layers"])
-    x = _layer_norm(x, params["final_ln_scale"], params["final_ln_bias"], c.layer_norm_eps)
-    return (x @ params["wte"].astype(c.dtype).T).astype(jnp.float32)
+    return _layer_norm(x, params["final_ln_scale"], params["final_ln_bias"], c.layer_norm_eps)
 
 
 def loss_fn(params: dict, batch: dict, config: GPT2Config) -> jax.Array:
     labels, weights = labels_and_weights(batch)
+    if config.loss_impl == "chunked":
+        from ..ops.chunked_ce import chunked_cross_entropy
+
+        hidden = apply_hidden(
+            params, batch["input_ids"], config, attention_mask=batch.get("attention_mask")
+        )
+        return chunked_cross_entropy(
+            hidden, lm_head(params, config), labels, weights, config.loss_chunk_size
+        )
     logits = apply(params, batch["input_ids"], config, attention_mask=batch.get("attention_mask"))
     return cross_entropy(logits, labels, weights)
 

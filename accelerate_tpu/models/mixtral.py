@@ -60,6 +60,21 @@ class MixtralConfig:
     # "auto"/"einsum"/"flash"/"pallas"; sp_impl picks ring vs ulysses at sp>1.
     attention_impl: str = "auto"
     sp_impl: str = "ring"
+    # "chunked" streams the LM-head loss over vocab tiles (ops/chunked_ce.py)
+    # — no [B, S, V] logits tensor; same knob as LlamaConfig.loss_impl.
+    loss_impl: str = "dense"
+    loss_chunk_size: int = 4096
+
+    def __post_init__(self):
+        if self.attention_impl not in ("auto", "einsum", "flash", "pallas"):
+            raise ValueError(
+                "attention_impl must be 'auto', 'einsum', 'flash' or 'pallas', "
+                f"got {self.attention_impl!r}"
+            )
+        if self.sp_impl not in ("ring", "ulysses"):
+            raise ValueError(f"sp_impl must be 'ring' or 'ulysses', got {self.sp_impl!r}")
+        if self.loss_impl not in ("dense", "chunked"):
+            raise ValueError(f"loss_impl must be 'dense' or 'chunked', got {self.loss_impl!r}")
 
     @property
     def head_dim_(self) -> int:
@@ -216,6 +231,12 @@ def _layer(
     return (x, aux_acc), None
 
 
+def lm_head(params: dict, config: MixtralConfig) -> jax.Array:
+    """The [d, V] head in compute dtype — single source for apply() and the
+    chunked loss (mirrors llama.lm_head)."""
+    return params["lm_head"].astype(config.dtype)
+
+
 def apply(
     params: dict,
     input_ids: jax.Array,
@@ -224,6 +245,20 @@ def apply(
     attention_mask: Optional[jax.Array] = None,
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
     """Forward pass: token ids [B, S] -> (logits [B, S, V] fp32, mean aux losses)."""
+    hidden, aux = apply_hidden(params, input_ids, config, positions, attention_mask)
+    logits = (hidden @ lm_head(params, config)).astype(jnp.float32)
+    return logits, aux
+
+
+def apply_hidden(
+    params: dict,
+    input_ids: jax.Array,
+    config: MixtralConfig,
+    positions: Optional[jax.Array] = None,
+    attention_mask: Optional[jax.Array] = None,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Trunk forward -> (final-normed hidden [B, S, d], mean aux losses) —
+    the chunked loss consumes the hidden directly (no logits tensor)."""
     c = config
     b, s = input_ids.shape
     if positions is None:
@@ -253,17 +288,29 @@ def apply(
         body = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)
     (x, aux), _ = jax.lax.scan(body, (x, aux0), params["layers"])
     aux = {k: v / c.num_layers for k, v in aux.items()}
-
-    x = _llama._rms_norm(x, params["final_norm"], c.rms_eps)
-    logits = (x @ params["lm_head"].astype(c.dtype)).astype(jnp.float32)
-    return logits, aux
+    return _llama._rms_norm(x, params["final_norm"], c.rms_eps), aux
 
 
 def loss_fn(params: dict, batch: dict, config: MixtralConfig) -> jax.Array:
-    """Next-token cross-entropy + router aux losses (Switch/ST-MoE recipe)."""
+    """Next-token cross-entropy + router aux losses (Switch/ST-MoE recipe).
+
+    ``config.loss_impl == "chunked"`` streams the head matmul over vocab
+    tiles (``ops/chunked_ce.py``) — no [B, S, V] logits tensor."""
     labels, weights = labels_and_weights(batch)
-    logits, aux = apply(params, batch["input_ids"], config, attention_mask=batch.get("attention_mask"))
-    ce = cross_entropy(logits, labels, weights)
+    if config.loss_impl == "chunked":
+        from ..ops.chunked_ce import chunked_cross_entropy
+
+        hidden, aux = apply_hidden(
+            params, batch["input_ids"], config, attention_mask=batch.get("attention_mask")
+        )
+        ce = chunked_cross_entropy(
+            hidden, lm_head(params, config), labels, weights, config.loss_chunk_size
+        )
+    else:
+        logits, aux = apply(
+            params, batch["input_ids"], config, attention_mask=batch.get("attention_mask")
+        )
+        ce = cross_entropy(logits, labels, weights)
     return (
         ce
         + config.router_aux_coef * aux["load_balancing_loss"]

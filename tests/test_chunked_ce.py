@@ -107,3 +107,38 @@ def test_chunked_on_fsdp_mesh_matches_dense():
 def test_invalid_loss_impl_rejected():
     with pytest.raises(ValueError, match="loss_impl"):
         llama.LlamaConfig.tiny(loss_impl="streamed")
+
+
+def test_mixtral_loss_impl_chunked_matches_dense():
+    from accelerate_tpu.models import mixtral
+
+    cfg_d = mixtral.MixtralConfig.tiny()
+    cfg_c = mixtral.MixtralConfig.tiny(loss_impl="chunked", loss_chunk_size=64)
+    params = mixtral.init_params(cfg_d, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (4, 16), 0, cfg_d.vocab_size)
+    batch = {"input_ids": ids}
+    dense = float(jax.jit(lambda p: mixtral.loss_fn(p, batch, cfg_d))(params))
+    chunked = float(jax.jit(lambda p: mixtral.loss_fn(p, batch, cfg_c))(params))
+    assert abs(dense - chunked) < 2e-3, (dense, chunked)
+
+
+def test_gpt2_loss_impl_chunked_matches_dense():
+    from accelerate_tpu.models import gpt2
+
+    cfg_d = gpt2.GPT2Config.tiny()
+    cfg_c = gpt2.GPT2Config.tiny(loss_impl="chunked", loss_chunk_size=64)
+    params = gpt2.init_params(cfg_d, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (4, 16), 0, cfg_d.vocab_size)
+    batch = {"input_ids": ids}
+    dense = float(jax.jit(lambda p: gpt2.loss_fn(p, batch, cfg_d))(params))
+    chunked = float(jax.jit(lambda p: gpt2.loss_fn(p, batch, cfg_c))(params))
+    assert abs(dense - chunked) < 2e-3, (dense, chunked)
+
+
+def test_family_invalid_loss_impl_rejected():
+    from accelerate_tpu.models import gpt2, mixtral
+
+    with pytest.raises(ValueError, match="loss_impl"):
+        mixtral.MixtralConfig.tiny(loss_impl="nope")
+    with pytest.raises(ValueError, match="loss_impl"):
+        gpt2.GPT2Config.tiny(loss_impl="nope")
