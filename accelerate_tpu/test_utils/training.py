@@ -59,3 +59,14 @@ class RegressionModelWithLoss(_torch().nn.Module):
 
         pred = x * self.a + self.b
         return {"loss": F.mse_loss(pred, y), "logits": pred}
+
+
+def regression_collate(samples):
+    """Batch RegressionDataset samples into {'x','y'} float tensors — the one
+    collate every distributed check shares."""
+    import numpy as np
+
+    torch = _torch()
+    xs = np.stack([np.atleast_1d(s["x"]) for s in samples]).astype("float32")
+    ys = np.stack([np.atleast_1d(s["y"]) for s in samples]).astype("float32")
+    return {"x": torch.from_numpy(xs), "y": torch.from_numpy(ys)}

@@ -17,11 +17,7 @@ from accelerate_tpu.utils import GradientAccumulationPlugin
 from accelerate_tpu.test_utils.training import RegressionDataset
 
 
-def _collate(samples):
-    return {
-        "x": torch.tensor([np.atleast_1d(s["x"]) for s in samples], dtype=torch.float32),
-        "y": torch.tensor([np.atleast_1d(s["y"]) for s in samples], dtype=torch.float32),
-    }
+from accelerate_tpu.test_utils.training import regression_collate as _collate
 
 
 def _prepared(step_scheduler_with_optimizer=True, split_batches=False, lr=1.0):

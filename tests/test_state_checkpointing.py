@@ -1,0 +1,132 @@
+"""Checkpoint lifecycle matrix (reference ``tests/test_state_checkpointing.py``):
+save-limit pruning, automatic naming + automatic loading, custom-object
+registration, and scheduler state across the save/load round trip."""
+
+import os
+
+import numpy as np
+import pytest
+import torch
+from torch.utils.data import DataLoader
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModel
+from accelerate_tpu.utils import ProjectConfiguration
+
+
+from accelerate_tpu.test_utils.training import regression_collate as _collate
+
+
+def _setup(tmp_path, **proj_kwargs):
+    accelerator = Accelerator(
+        project_config=ProjectConfiguration(project_dir=str(tmp_path), **proj_kwargs)
+    )
+    model = RegressionModel()
+    optimizer = torch.optim.SGD(model.parameters(), lr=0.1)
+    scheduler = torch.optim.lr_scheduler.LambdaLR(optimizer, lr_lambda=lambda n: 1 / (1 + n))
+    dl = DataLoader(list(RegressionDataset(length=16)), batch_size=8, collate_fn=_collate)
+    model, optimizer, dl, scheduler = accelerator.prepare(model, optimizer, dl, scheduler)
+    return accelerator, model, optimizer, dl, scheduler
+
+
+def _train_steps(accelerator, model, optimizer, scheduler, dl, n=2):
+    it = iter(dl)
+    for _ in range(n):
+        try:
+            batch = next(it)
+        except StopIteration:
+            it = iter(dl)
+            batch = next(it)
+        loss = torch.nn.functional.mse_loss(model(batch["x"]), batch["y"])
+        accelerator.backward(loss)
+        optimizer.step()
+        scheduler.step()
+        optimizer.zero_grad()
+
+
+def test_with_save_limit(tmp_path):
+    """Reference :108 — total_limit prunes the oldest automatic checkpoints."""
+    accelerator, model, optimizer, dl, scheduler = _setup(
+        tmp_path, automatic_checkpoint_naming=True, total_limit=1
+    )
+    accelerator.save_state()
+    accelerator.save_state()
+    accelerator.save_state()
+    ckpts = sorted(os.listdir(tmp_path / "checkpoints"))
+    assert len(ckpts) == 1, ckpts
+
+
+def test_automatic_naming_iterates(tmp_path):
+    accelerator, model, optimizer, dl, scheduler = _setup(
+        tmp_path, automatic_checkpoint_naming=True
+    )
+    accelerator.save_state()
+    accelerator.save_state()
+    ckpts = sorted(os.listdir(tmp_path / "checkpoints"))
+    assert ckpts == ["checkpoint_0", "checkpoint_1"], ckpts
+
+
+def test_automatic_loading_restores_latest(tmp_path):
+    """Reference :335 — load_state() with no path restores the newest
+    automatic checkpoint."""
+    accelerator, model, optimizer, dl, scheduler = _setup(
+        tmp_path, automatic_checkpoint_naming=True
+    )
+    _train_steps(accelerator, model, optimizer, scheduler, dl, n=1)
+    accelerator.save_state()  # checkpoint_0
+    state_at_0 = {k: np.asarray(v).copy() for k, v in model.state_dict().items()}
+    _train_steps(accelerator, model, optimizer, scheduler, dl, n=2)
+    accelerator.save_state()  # checkpoint_1
+    state_at_1 = {k: np.asarray(v).copy() for k, v in model.state_dict().items()}
+    assert any(
+        not np.allclose(state_at_0[k], state_at_1[k]) for k in state_at_0
+    ), "training did not change weights; oracle is vacuous"
+
+    _train_steps(accelerator, model, optimizer, scheduler, dl, n=1)
+    # The pre-load state must differ from checkpoint_1, or a no-op load_state
+    # would pass vacuously.
+    drifted = {k: np.asarray(v).copy() for k, v in model.state_dict().items()}
+    assert any(not np.allclose(drifted[k], state_at_1[k]) for k in drifted)
+    accelerator.load_state()  # no path -> newest (checkpoint_1)
+    for k, v in model.state_dict().items():
+        np.testing.assert_allclose(np.asarray(v), state_at_1[k], atol=1e-6, err_msg=k)
+
+
+def test_invalid_registration(tmp_path):
+    """Reference :298 — objects without state_dict/load_state_dict refuse."""
+    accelerator, *_ = _setup(tmp_path)
+    with pytest.raises(ValueError, match="state_dict"):
+        accelerator.register_for_checkpointing(object())
+
+
+def test_registered_object_roundtrip(tmp_path):
+    class Counter:
+        def __init__(self):
+            self.steps = 0
+
+        def state_dict(self):
+            return {"steps": self.steps}
+
+        def load_state_dict(self, sd):
+            self.steps = sd["steps"]
+
+    accelerator, model, optimizer, dl, scheduler = _setup(tmp_path)
+    counter = Counter()
+    accelerator.register_for_checkpointing(counter)
+    counter.steps = 7
+    accelerator.save_state(str(tmp_path / "ck"))
+    counter.steps = 99
+    accelerator.load_state(str(tmp_path / "ck"))
+    assert counter.steps == 7
+
+
+def test_with_scheduler_state_roundtrip(tmp_path):
+    """Reference :312 — the lr schedule position survives save/load."""
+    accelerator, model, optimizer, dl, scheduler = _setup(tmp_path)
+    _train_steps(accelerator, model, optimizer, scheduler, dl, n=3)
+    lr_at_save = scheduler.get_last_lr()
+    accelerator.save_state(str(tmp_path / "ck"))
+    _train_steps(accelerator, model, optimizer, scheduler, dl, n=2)
+    assert scheduler.get_last_lr() != lr_at_save
+    accelerator.load_state(str(tmp_path / "ck"))
+    assert scheduler.get_last_lr() == lr_at_save
