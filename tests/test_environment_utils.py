@@ -1,0 +1,111 @@
+"""Environment-manipulation helpers (reference ``tests/test_utils.py``
+:134-:180 and :424/:461+): patch/clear/purge env contracts."""
+
+import os
+import warnings
+
+import pytest
+
+from accelerate_tpu.utils.environment import (
+    clear_environment,
+    convert_dict_to_env_variables,
+    patch_environment,
+    purge_accelerate_environment,
+)
+
+
+def test_patch_environment_sets_and_removes():
+    """Reference :134 — keys exist inside the context, vanish after."""
+    assert "ATPU_TEST_A" not in os.environ
+    with patch_environment(atpu_test_a="1", ATPU_TEST_B="two"):
+        assert os.environ["ATPU_TEST_A"] == "1"
+        assert os.environ["ATPU_TEST_B"] == "two"
+    assert "ATPU_TEST_A" not in os.environ
+    assert "ATPU_TEST_B" not in os.environ
+
+
+def test_patch_environment_key_exists_restores_previous():
+    """Reference :142 — pre-existing values come back after the context."""
+    os.environ["ATPU_TEST_C"] = "original"
+    try:
+        with patch_environment(atpu_test_c="patched"):
+            assert os.environ["ATPU_TEST_C"] == "patched"
+        assert os.environ["ATPU_TEST_C"] == "original"
+    finally:
+        os.environ.pop("ATPU_TEST_C", None)
+
+
+def test_patch_environment_restores_on_error():
+    """Reference :161 — the restore happens even when the body raises."""
+    os.environ["ATPU_TEST_D"] = "original"
+    try:
+        with pytest.raises(RuntimeError, match="boom"):
+            with patch_environment(atpu_test_d="patched"):
+                raise RuntimeError("boom")
+        assert os.environ["ATPU_TEST_D"] == "original"
+    finally:
+        os.environ.pop("ATPU_TEST_D", None)
+
+
+def test_clear_environment_empties_and_restores():
+    """Reference :171 — os.environ is empty inside, identical after."""
+    os.environ["ATPU_TEST_E"] = "kept"
+    try:
+        before = dict(os.environ)
+        with clear_environment():
+            assert "ATPU_TEST_E" not in os.environ
+            os.environ["ATPU_TEST_TEMP"] = "gone-after"
+        assert dict(os.environ) == before
+        assert "ATPU_TEST_TEMP" not in os.environ
+    finally:
+        os.environ.pop("ATPU_TEST_E", None)
+
+
+def test_convert_dict_to_env_variables_filters_invalid():
+    """Reference :424 — shell-unsafe entries drop with a warning; valid ones
+    serialize as KEY=VALUE lines (trailing newline, as the launcher's env
+    file expects)."""
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = convert_dict_to_env_variables(
+            {"ACCELERATE_DEBUG_MODE": "1", "BAD_ENV_NAME": "<mything", "OTHER_ENV": "2"}
+        )
+    assert out == ["ACCELERATE_DEBUG_MODE=1\n", "OTHER_ENV=2\n"]
+    assert any("BAD_ENV_NAME" in str(x.message) for x in w)
+
+
+def test_purge_accelerate_environment_function_wrapper():
+    """Reference :461+ — ACCELERATE_* vars SET INSIDE the decorated function
+    are cleaned up after it; pre-existing values are restored (the decorator
+    guards against leakage, it does not hide vars during the call)."""
+    os.environ["ACCELERATE_PURGE_PROBE"] = "outside"
+
+    @purge_accelerate_environment
+    def inner():
+        assert os.environ["ACCELERATE_PURGE_PROBE"] == "outside"  # visible inside
+        os.environ["ACCELERATE_PURGE_PROBE"] = "mutated"
+        os.environ["ACCELERATE_PURGE_NEW"] = "leaked"
+
+    try:
+        inner()
+        assert os.environ["ACCELERATE_PURGE_PROBE"] == "outside"  # restored
+        assert "ACCELERATE_PURGE_NEW" not in os.environ  # leak removed
+    finally:
+        os.environ.pop("ACCELERATE_PURGE_PROBE", None)
+        os.environ.pop("ACCELERATE_PURGE_NEW", None)
+
+
+def test_purge_accelerate_environment_class_wrapper():
+    """Class decoration wraps test methods with the same guard."""
+    os.environ.pop("ACCELERATE_PURGE_PROBE2", None)
+
+    @purge_accelerate_environment
+    class Holder:
+        def test_probe(self):
+            os.environ["ACCELERATE_PURGE_PROBE2"] = "leaked"
+
+    try:
+        Holder().test_probe()
+        assert "ACCELERATE_PURGE_PROBE2" not in os.environ
+    finally:
+        os.environ.pop("ACCELERATE_PURGE_PROBE2", None)
