@@ -171,3 +171,46 @@ def test_torch_max_forms_lower():
 
     out = model(torch.randn(2, 4))
     assert np.asarray(out.detach()).shape == (2, 4)
+
+
+def test_send_to_device_handles_namedtuples_and_nesting():
+    """Reference tests/test_utils.py:77/:402 — namedtuple containers (incl.
+    subclasses) survive send_to_device with their type; skip_keys honored at
+    every Mapping depth."""
+    from collections import namedtuple
+
+    import torch
+
+    from accelerate_tpu.utils.operations import send_to_device
+
+    Point = namedtuple("Point", ["x", "y"])
+
+    class SubPoint(Point):
+        pass
+
+    payload = {
+        "pt": Point(torch.ones(2), torch.zeros(2)),
+        "sub": SubPoint(torch.ones(1), torch.ones(1)),
+        "nested": {"keep": torch.ones(3), "move": torch.ones(3)},
+    }
+    out = send_to_device(payload, None, skip_keys=["keep"])
+    assert type(out["pt"]) is Point
+    assert type(out["sub"]) is SubPoint
+    import jax
+
+    assert isinstance(out["pt"].x, jax.Array)
+    # skip_keys leaves the skipped leaf untouched (still a torch tensor).
+    assert isinstance(out["nested"]["keep"], torch.Tensor)
+    assert isinstance(out["nested"]["move"], jax.Array)
+
+
+def test_honor_type_namedtuple_reconstruction():
+    from collections import namedtuple
+
+    from accelerate_tpu.utils.operations import honor_type
+
+    Point = namedtuple("Point", ["x", "y"])
+    rebuilt = honor_type(Point(1, 2), iter([10, 20]))
+    assert type(rebuilt) is Point and rebuilt == Point(10, 20)
+    assert honor_type([1, 2], iter([3, 4])) == [3, 4]
+    assert honor_type((1, 2), iter([3, 4])) == (3, 4)

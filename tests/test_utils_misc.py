@@ -267,3 +267,37 @@ def test_ddp_comm_hook_flows_to_grad_dtype():
     import jax.numpy as jnp
 
     assert prepared._grad_sync_dtype == jnp.bfloat16
+
+
+def test_logger_in_order_single_process(caplog):
+    """in_order=True serializes by rank (single process: logs once, after the
+    rank-0 barrier)."""
+    import logging as logging_mod
+
+    from accelerate_tpu.logging import get_logger
+    from accelerate_tpu.state import PartialState
+
+    PartialState()  # ensure state exists so the rank loop runs
+    root_level = logging_mod.root.level  # get_logger mutates root (upstream parity)
+    try:
+        logger = get_logger("atpu.test.in_order", log_level="INFO")
+        with caplog.at_level(logging_mod.INFO, logger="atpu.test.in_order"):
+            logger.info("ordered hello", in_order=True)
+        assert sum("ordered hello" in r.message for r in caplog.records) == 1
+    finally:
+        logging_mod.root.setLevel(root_level)
+
+
+def test_logger_log_level_env(monkeypatch, caplog):
+    """ACCELERATE_LOG_LEVEL drives the default level (reference get_logger)."""
+    import logging as logging_mod
+
+    monkeypatch.setenv("ACCELERATE_LOG_LEVEL", "ERROR")
+    from accelerate_tpu.logging import get_logger
+
+    root_level = logging_mod.root.level  # get_logger mutates root (upstream parity)
+    try:
+        logger = get_logger("atpu.test.level_env")
+        assert logger.logger.level == logging_mod.ERROR
+    finally:
+        logging_mod.root.setLevel(root_level)
