@@ -40,6 +40,14 @@ class T5Config:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = False
+    # "chunked" streams the (tied, 1/sqrt(d)-scaled) LM-head loss over vocab
+    # tiles (ops/chunked_ce.py) — same knob as LlamaConfig.loss_impl.
+    loss_impl: str = "dense"
+    loss_chunk_size: int = 4096
+
+    def __post_init__(self):
+        if self.loss_impl not in ("dense", "chunked"):
+            raise ValueError(f"loss_impl must be 'dense' or 'chunked', got {self.loss_impl!r}")
 
     @classmethod
     def tiny(cls, **kw) -> "T5Config":
@@ -199,6 +207,12 @@ def _dec_layer(carry, p, *, c: T5Config, bias, self_mask, enc_out, cross_mask, a
     return x, None
 
 
+def lm_head(params: dict, config: T5Config) -> jax.Array:
+    """Tied head in compute dtype, scaled by 1/sqrt(d) (T5 convention) —
+    single source for apply() and the chunked loss."""
+    return params["shared_embed"].T.astype(config.dtype) / np.sqrt(config.hidden_size)
+
+
 def apply(
     params: dict,
     input_ids: jax.Array,
@@ -207,6 +221,18 @@ def apply(
     attention_mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """(encoder ids [B, S], decoder ids [B, T]) -> fp32 logits [B, T, V]."""
+    hidden = apply_hidden(params, input_ids, decoder_input_ids, config, attention_mask)
+    return (hidden @ lm_head(params, config)).astype(jnp.float32)
+
+
+def apply_hidden(
+    params: dict,
+    input_ids: jax.Array,
+    decoder_input_ids: jax.Array,
+    config: T5Config,
+    attention_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Encoder+decoder trunk -> final-normed decoder hidden [B, T, d]."""
     c = config
     b, s = input_ids.shape
     t = decoder_input_ids.shape[1]
@@ -232,20 +258,33 @@ def apply(
     if c.remat:
         dec_body = jax.checkpoint(dec_body, policy=jax.checkpoint_policies.nothing_saveable)
     y, _ = jax.lax.scan(dec_body, y, params["decoder"])
-    y = _rms_norm(y, params["dec_final_ln"], c.rms_eps)
-    # Tied head, scaled by 1/sqrt(d) (T5 convention).
-    head = params["shared_embed"].T.astype(c.dtype) / np.sqrt(c.hidden_size)
-    return (y @ head).astype(jnp.float32)
+    return _rms_norm(y, params["dec_final_ln"], c.rms_eps)
 
 
 def loss_fn(params: dict, batch: dict, config: T5Config) -> jax.Array:
     """Seq2seq cross-entropy: batch needs input_ids, decoder_input_ids, labels
-    (and optional attention_mask); labels < 0 are ignored."""
+    (and optional attention_mask); labels < 0 are ignored.
+
+    ``config.loss_impl == "chunked"`` streams the head matmul over vocab
+    tiles (``ops/chunked_ce.py``) — no [B, T, V] logits tensor."""
     from .llama import cross_entropy
 
     labels = batch["labels"]
     weights = (labels >= 0).astype(jnp.float32)
     labels = jnp.maximum(labels, 0)
+    if config.loss_impl == "chunked":
+        from ..ops.chunked_ce import chunked_cross_entropy
+
+        hidden = apply_hidden(
+            params,
+            batch["input_ids"],
+            batch["decoder_input_ids"],
+            config,
+            attention_mask=batch.get("attention_mask"),
+        )
+        return chunked_cross_entropy(
+            hidden, lm_head(params, config), labels, weights, config.loss_chunk_size
+        )
     logits = apply(
         params,
         batch["input_ids"],
@@ -378,8 +417,7 @@ def decode_cached(
         body, y, (params["decoder"], cache["k"], cache["v"], cache["cross_k"], cache["cross_v"])
     )
     y = _rms_norm(y, params["dec_final_ln"], c.rms_eps)
-    head = params["shared_embed"].T.astype(c.dtype) / np.sqrt(c.hidden_size)
-    logits = (y @ head).astype(jnp.float32)
+    logits = (y @ lm_head(params, c)).astype(jnp.float32)
     new_cache = dict(cache)
     new_cache.update({"k": new_k, "v": new_v, "index": index + t})
     return logits, new_cache

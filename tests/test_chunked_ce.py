@@ -136,9 +136,27 @@ def test_gpt2_loss_impl_chunked_matches_dense():
 
 
 def test_family_invalid_loss_impl_rejected():
-    from accelerate_tpu.models import gpt2, mixtral
+    from accelerate_tpu.models import gpt2, mixtral, t5
 
     with pytest.raises(ValueError, match="loss_impl"):
         mixtral.MixtralConfig.tiny(loss_impl="nope")
     with pytest.raises(ValueError, match="loss_impl"):
         gpt2.GPT2Config.tiny(loss_impl="nope")
+    with pytest.raises(ValueError, match="loss_impl"):
+        t5.T5Config.tiny(loss_impl="nope")
+
+
+def test_t5_loss_impl_chunked_matches_dense():
+    from accelerate_tpu.models import t5
+
+    cfg_d = t5.T5Config.tiny()
+    cfg_c = t5.T5Config.tiny(loss_impl="chunked", loss_chunk_size=64)
+    params = t5.init_params(cfg_d, jax.random.key(0))
+    enc = jax.random.randint(jax.random.key(1), (2, 12), 0, cfg_d.vocab_size)
+    dec = jax.random.randint(jax.random.key(2), (2, 8), 0, cfg_d.vocab_size)
+    labels = jax.random.randint(jax.random.key(3), (2, 8), 0, cfg_d.vocab_size)
+    labels = labels.at[1, 5:].set(-100)  # ignored positions
+    batch = {"input_ids": enc, "decoder_input_ids": dec, "labels": labels}
+    dense = float(jax.jit(lambda p: t5.loss_fn(p, batch, cfg_d))(params))
+    chunked = float(jax.jit(lambda p: t5.loss_fn(p, batch, cfg_c))(params))
+    assert abs(dense - chunked) < 2e-3, (dense, chunked)
