@@ -213,10 +213,15 @@ def _acquire_device(deadline_s: float, attempt_timeout_s: float, wait_s: float):
 def main():
     _honor_cpu_env()
     if "--probe" in sys.argv:
-        import jax
+        # Probe through the killable-subprocess machinery: an in-process
+        # jax.devices() on a wedged tunnel blocks inside a C call forever.
+        from accelerate_tpu.utils.device_probe import probe_device_backend
 
-        print(jax.device_count(), jax.devices()[0].device_kind)
-        return
+        ok, detail = probe_device_backend(
+            timeout_s=float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "90")), retries=1
+        )
+        print(detail)
+        sys.exit(0 if ok else 1)
     if "--rung" in sys.argv:
         idx = int(sys.argv[sys.argv.index("--rung") + 1])
         rung = LADDER[idx]

@@ -1,0 +1,171 @@
+"""All-round opportunistic bench runner.
+
+The TPU tunnel on this platform wedges for hours at a time (BENCH_r01/r02 both
+recorded ``device backend unreachable``) and ``bench.py`` only tries during the
+driver's ~15-minute end-of-round window — so a recovery window anywhere else in
+the round is missed.  This runner closes that gap: launched at round start, it
+probes the backend every ``--interval`` seconds for the whole round and, on the
+first healthy probe, immediately runs
+
+1. the full ``bench.py`` ladder (proven rung first),
+2. the chunked-vocab-CE candidate (``BENCH_TRY_CHUNKED=1``),
+3. ``benchmarks/big_model_inference_bench.py`` (offload table),
+
+writing each artifact as soon as it lands so a later re-wedge cannot zero the
+round.  Every probe (success or failure) is appended to a JSONL log that gets
+committed either way — it is the round's proof of whether the tunnel ever
+answered.
+
+Usage:  python benchmarks/opportunistic_bench.py --hours 10.5 --interval 600
+Artifacts (repo root):
+  benchmarks/probe_log_r03.jsonl   — one line per probe attempt
+  BENCH_opportunistic.json         — bench.py ladder output (on success)
+  BENCH_opportunistic_chunked.json — chunked-CE rung output (on success)
+  BENCH_big_model.json             — offload bench output (on success)
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _log(path: str, record: dict) -> None:
+    record["ts"] = datetime.datetime.now(datetime.timezone.utc).isoformat(timespec="seconds")
+    with open(path, "a") as f:
+        f.write(json.dumps(record) + "\n")
+    print(json.dumps(record), flush=True)
+
+
+def _last_json_line(stdout: str | bytes | None):
+    """Scan stdout from the end for the last parseable JSON line (tolerant of
+    spurious brace-prefixed library output, same contract as bench.py's
+    rung-subprocess parser)."""
+    if stdout is None:
+        return None
+    if isinstance(stdout, bytes):
+        stdout = stdout.decode(errors="replace")
+    for line in reversed(stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
+
+
+def _run_bench(
+    cmd_env: dict,
+    out_path: str,
+    timeout_s: int,
+    log_path: str,
+    label: str,
+    require_rung_substr: str | None = None,
+) -> bool:
+    env = os.environ.copy()
+    env.update(cmd_env)
+    # The tunnel is proven up at this point; keep bench's own probe window short.
+    env.setdefault("BENCH_PROBE_WINDOW_S", "240")
+    env.setdefault("BENCH_PROBE_TIMEOUT_S", "90")
+    stdout, rc, timed_out = None, None, False
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=env,
+            cwd=REPO,
+        )
+        stdout, rc = proc.stdout, proc.returncode
+    except subprocess.TimeoutExpired as e:
+        # Partial stdout still carries per-rung results — a late hang must not
+        # zero the artifact, and the JSONL must record what happened.
+        stdout, timed_out = e.stdout, True
+    result = _last_json_line(stdout)
+    if timed_out:
+        _log(log_path, {"bench": label, "timeout_s": timeout_s, "partial_result": result})
+    if result is None:
+        return False
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+    if rc != 0 or result.get("value", 0) <= 0:
+        return False
+    if require_rung_substr is not None:
+        # BENCH_TRY_CHUNKED keeps the dense rungs as fallbacks, so exit 0 with
+        # value>0 can mean "dense won" — only count success if the winning rung
+        # is actually the requested variant.
+        return require_rung_substr in str(result.get("detail", {}).get("rung", ""))
+    return True
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hours", type=float, default=10.5)
+    ap.add_argument("--interval", type=float, default=600.0)
+    ap.add_argument("--probe-timeout", type=float, default=90.0)
+    ap.add_argument("--log", default=os.path.join(REPO, "benchmarks", "probe_log_r03.jsonl"))
+    args = ap.parse_args()
+
+    from accelerate_tpu.utils.device_probe import probe_device_backend
+
+    deadline = time.monotonic() + args.hours * 3600
+    attempt = 0
+    while time.monotonic() < deadline:
+        attempt += 1
+        ok, detail = probe_device_backend(timeout_s=args.probe_timeout, retries=1)
+        _log(args.log, {"attempt": attempt, "ok": ok, "detail": detail})
+        if ok:
+            results = {}
+            # Worst case for the ladder: 240s probe window + 6 rungs x 480s =
+            # ~3120s; give real margin above that.
+            results["ladder"] = _run_bench(
+                {}, os.path.join(REPO, "BENCH_opportunistic.json"), 4500, args.log, "ladder"
+            )
+            results["chunked"] = _run_bench(
+                {"BENCH_TRY_CHUNKED": "1"},
+                os.path.join(REPO, "BENCH_opportunistic_chunked.json"),
+                4500,
+                args.log,
+                "chunked",
+                require_rung_substr="chunked",
+            )
+            stdout = None
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.join(REPO, "benchmarks", "big_model_inference_bench.py")],
+                    capture_output=True,
+                    text=True,
+                    timeout=1800,
+                    cwd=REPO,
+                )
+                stdout, rc = proc.stdout, proc.returncode
+            except subprocess.TimeoutExpired as e:
+                stdout, rc = e.stdout, -1
+                _log(args.log, {"bench": "big_model", "timeout_s": 1800})
+            big = _last_json_line(stdout)
+            if big is not None:
+                with open(os.path.join(REPO, "BENCH_big_model.json"), "w") as f:
+                    json.dump(big, f, indent=1)
+                    f.write("\n")
+            results["big_model"] = rc == 0 and big is not None
+            _log(args.log, {"attempt": attempt, "bench_results": results})
+            if results["ladder"]:
+                return  # headline number captured; artifacts are on disk
+            # Tunnel answered the probe but the bench failed — keep looping,
+            # it may have re-wedged mid-run.
+        time.sleep(max(0.0, min(args.interval, deadline - time.monotonic())))
+
+
+if __name__ == "__main__":
+    main()
