@@ -297,7 +297,14 @@ class PreparedModel:
                 model._tagged_losses.pop(key, None)
                 pending = entry["pending"]
                 entry["pending"] = None
-                model._accumulate(pending[1], float(grad))
+                if grad.numel() != 1:
+                    raise RuntimeError(
+                        "Fused-mode losses are scalars, so backward(gradient=...) with a "
+                        f"non-scalar cotangent (shape {tuple(grad.shape)}) cannot be routed "
+                        "to the jax-side gradients. Reduce the loss to a scalar before "
+                        "backward, or use bridge mode for per-element cotangents."
+                    )
+                model._accumulate(pending[1], float(grad.reshape(())))
 
             torch_loss.register_hook(_route_grad)
 
@@ -792,6 +799,7 @@ class Accelerator:
             mesh=self.mesh,
             output_type="torch",  # user-land torch ops (criteria/metrics) work
             # unchanged; the jitted model picks up `._atpu_jax` with no re-transfer
+            static_shape_tail=getattr(cfg, "static_shape_tail", False),
         )
         self._dataloaders.append(prepared)
         return prepared

@@ -58,7 +58,8 @@ _UNSUPPORTED_FLAGS = {
     "deepspeed_multinode_launcher": "see --deepspeed_hostfile",
     "deepspeed_moe_layer_cls_names": "MoE layers route through the native ep mesh axis (ops/moe.py); no ZeRO-3 leaf marking needed",
     "enable_cpu_affinity": "host-side NUMA pinning is not load-bearing for single-controller TPU hosts",
-    "downcast_bf16": "XLA_DOWNCAST_BF16 is an XRT-era flag; dtype policy is explicit here (--mixed_precision bf16)",
+    # downcast_bf16 is NOT listed here: it maps to mixed_precision="bf16" in
+    # _merge (same conversion from_accelerate.py applies to migrated configs).
     "fp8_opt_level": "MS-AMP-specific; the native fp8 path has one backend (ops/fp8.py recipe kwargs)",
     "fp8_override_linear_precision": "TransformerEngine-specific; use the native recipe kwargs",
     "fp8_use_autocast_during_eval": "TE-specific; eval dtype follows the step's mixed-precision policy",
@@ -240,6 +241,26 @@ def _warn_unsupported(args) -> list[str]:
     return notes
 
 
+def _resolve_mixed_precision(args, cfg: ClusterConfig):
+    """CLI > config, with the reference's TPU knob mapped rather than dropped:
+    ``--downcast_bf16`` (XRT-era XLA_DOWNCAST_BF16) means "run in bf16", which
+    here is the explicit ``mixed_precision='bf16'`` policy — the same
+    conversion ``from_accelerate`` applies to migrated configs."""
+    mp = args.mixed_precision if args.mixed_precision is not None else cfg.mixed_precision
+    downcast = _flag_bool(getattr(args, "downcast_bf16", None)) or _flag_bool(
+        getattr(cfg, "downcast_bf16", None)
+    )
+    if downcast and mp in (None, "no", "None"):
+        import warnings
+
+        warnings.warn(
+            "--downcast_bf16 maps to mixed_precision='bf16' on this backend "
+            "(XLA_DOWNCAST_BF16 is an XRT-era flag; dtype policy is explicit here)."
+        )
+        return "bf16"
+    return mp
+
+
 def _merge(args, cfg: ClusterConfig):
     """CLI flags override config file (reference ``_validate_launch_command``
     ``commands/launch.py:987-1166``)."""
@@ -251,7 +272,7 @@ def _merge(args, cfg: ClusterConfig):
         "machine_rank": pick(args.machine_rank, cfg.machine_rank),
         "main_process_ip": pick(args.main_process_ip, cfg.main_process_ip),
         "main_process_port": pick(args.main_process_port, cfg.main_process_port),
-        "mixed_precision": pick(args.mixed_precision, cfg.mixed_precision),
+        "mixed_precision": _resolve_mixed_precision(args, cfg),
         "gradient_accumulation_steps": pick(
             args.gradient_accumulation_steps, cfg.gradient_accumulation_steps
         ),

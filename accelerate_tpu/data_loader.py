@@ -437,14 +437,20 @@ class DataLoaderStateMixin:
 
     def begin(self):
         self.reset()
+        # Snapshot the singleton's pad bookkeeping: a nested loader (eval loop
+        # inside a train iteration) must not clobber the OUTER loader's
+        # counters — end() restores this snapshot instead of zeroing, so a
+        # gather_for_metrics on the outer padded batch still dedups.
+        self._outer_pad_rows = getattr(self.gradient_state, "device_pad_rows", 0)
+        self._outer_batch_rows = getattr(self.gradient_state, "device_batch_rows", 0)
         with contextlib.suppress(Exception):
             length = getattr(self.dataset, "total_dataset_length", len(self.dataset))
             self.remainder = length % self.total_batch_size
         self.gradient_state._add_dataloader(self)
 
     def end(self):
-        self.gradient_state.device_pad_rows = 0
-        self.gradient_state.device_batch_rows = 0
+        self.gradient_state.device_pad_rows = getattr(self, "_outer_pad_rows", 0)
+        self.gradient_state.device_batch_rows = getattr(self, "_outer_batch_rows", 0)
         self.gradient_state._remove_dataloader(self)
 
 
@@ -779,6 +785,7 @@ def prepare_data_loader(
     use_stateful_dataloader: bool = False,
     mesh: Optional[jax.sharding.Mesh] = None,
     output_type: str = "jax",
+    static_shape_tail: bool = False,
 ):
     """Shard a (torch) dataloader for the current topology and wrap it for global
     device placement.
@@ -942,14 +949,19 @@ def prepare_data_loader(
             * scale,
             drop_last=getattr(batch_sampler, "drop_last", False),
         )
-    # Wrap even for num_processes == 1 (reference does the same): with
-    # even_batches the tail batch wraps to FULL size, so every batch has one
-    # static shape — a single XLA trace, no tail recompile/padding; the
-    # wraparound duplicates are dropped by gather_for_metrics' remainder dedup.
-    # Exception: a custom batch sampler with no fixed batch_size cannot be
-    # equalized — single-process keeps it unwrapped (even_batches needs a
-    # target size), matching the pre-existing behavior for bucket samplers.
-    wrap = num_processes > 1 or getattr(batch_sampler, "batch_size", None) is not None
+    # Reference parity ("No change if no multiprocess", reference
+    # data_loader.py:1190): at num_processes == 1 the sampler is left alone by
+    # default.  ``static_shape_tail=True`` opts single-process loaders into the
+    # same even_batches wrap used for sharding, so the tail batch wraps to FULL
+    # size and every batch has one static shape (a single XLA trace, no tail
+    # recompile/padding).  The wrap duplicates leading samples into the final
+    # batch — gather_for_metrics' remainder dedup drops them for metrics, but
+    # the training loss on that step sees them, hence opt-in.  A custom batch
+    # sampler with no fixed batch_size can never be equalized (even_batches
+    # needs a target size) and stays unwrapped either way.
+    wrap = num_processes > 1 or (
+        static_shape_tail and getattr(batch_sampler, "batch_size", None) is not None
+    )
     new_batch_sampler = (
         BatchSamplerShard(
             batch_sampler,

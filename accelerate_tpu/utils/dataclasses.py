@@ -701,6 +701,14 @@ class DataLoaderConfiguration:
     data_seed: Optional[int] = None
     non_blocking: bool = False
     use_stateful_dataloader: bool = False
+    # TPU extension (no reference counterpart): wrap SINGLE-process map-style
+    # loaders in BatchSamplerShard so the tail batch wraps to full size and
+    # every batch has one static shape (a single XLA trace, no tail
+    # recompile).  The wraparound duplicates the first samples into the final
+    # batch — gather_for_metrics dedups them, but raw training loss on that
+    # step includes the duplicates — so this is opt-in; the default follows
+    # the reference, which never reshards at num_processes == 1.
+    static_shape_tail: bool = False
 
 
 @dataclass

@@ -438,3 +438,25 @@ def test_estimate_memory_unknown_model_offline_error():
             model_name="no-such/model-xyz", dtypes=None, trust_remote_code=False,
             hbm_gb=None, json=False,
         ))
+
+
+def test_downcast_bf16_maps_to_mixed_precision():
+    """--downcast_bf16 converts to mixed_precision='bf16' (advisor r2): the CLI
+    now applies the same mapping from_accelerate uses for migrated configs,
+    instead of only warning."""
+    import warnings as _warnings
+
+    from accelerate_tpu.commands.config import ClusterConfig
+    from accelerate_tpu.commands.launch import _merge, launch_command_parser
+
+    parser = launch_command_parser()
+    args = parser.parse_args(["--downcast_bf16", "train.py"])
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        merged = _merge(args, ClusterConfig())
+    assert merged["mixed_precision"] == "bf16"
+    assert any("downcast_bf16" in str(w.message) for w in caught)
+
+    # An explicit --mixed_precision wins over the mapped knob.
+    args = parser.parse_args(["--downcast_bf16", "--mixed_precision", "fp8", "train.py"])
+    assert _merge(args, ClusterConfig())["mixed_precision"] == "fp8"

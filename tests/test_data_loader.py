@@ -414,3 +414,44 @@ def test_abandoned_dataloader_not_pinned_by_gradient_state():
     del holder
     gc.collect()
     assert loader_ref() is None, "GradientState pinned an abandoned dataloader"
+
+
+def test_single_process_tail_not_duplicated():
+    """Reference parity ('No change if no multiprocess', reference
+    data_loader.py:1190): at num_processes==1 the sampler is left alone by
+    default, so the tail batch is SHORT — no silently duplicated samples in the
+    training loss (advisor r2, medium)."""
+    dl = prepare_data_loader(_make_loader(10, 4), put_on_device=False)
+    batches = [np.asarray(b) for b in dl]
+    assert [len(b) for b in batches] == [4, 4, 2]
+    np.testing.assert_array_equal(np.concatenate(batches)[:, 0], np.arange(10))
+
+
+def test_single_process_static_shape_tail_opt_in():
+    """static_shape_tail=True opts single-process loaders into the even_batches
+    wrap: one static batch shape (single XLA trace), tail wraps to the leading
+    samples (dropped later by gather_for_metrics' remainder dedup)."""
+    dl = prepare_data_loader(_make_loader(10, 4), put_on_device=False, static_shape_tail=True)
+    batches = [np.asarray(b) for b in dl]
+    assert [len(b) for b in batches] == [4, 4, 4]
+    np.testing.assert_array_equal(batches[2][:, 0], np.array([8, 9, 0, 1]))
+
+
+def test_nested_dataloader_restores_pad_counters():
+    """An eval loader iterated INSIDE a train iteration must not clobber the
+    outer loader's device-pad bookkeeping (advisor r2): end() restores the
+    counters snapshotted at begin(), so gather_for_metrics on the outer padded
+    batch still dedups."""
+    AcceleratorState()  # 8-device mesh -> tail of 4 rows padded by 4
+    gs = GradientState()
+    outer = prepare_data_loader(_make_loader(36, 4))
+    inner = prepare_data_loader(_make_loader(64, 4))
+    saw_padded_tail = False
+    for _ in outer:
+        if gs.end_of_dataloader and gs.device_pad_rows > 0:
+            saw_padded_tail = True
+            pad, rows = gs.device_pad_rows, gs.device_batch_rows
+            for _ in inner:
+                pass
+            assert (gs.device_pad_rows, gs.device_batch_rows) == (pad, rows)
+    assert saw_padded_tail, "test setup: outer loader never produced a padded tail"
