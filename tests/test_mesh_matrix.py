@@ -58,21 +58,34 @@ def _step_fn(loss_fn, tx):
     return jax.jit(step)
 
 
-def _assert_tree_close(dense_tree, sharded_tree, what, mesh_axes, atol, rtol):
+def _assert_tree_close(dense_tree, sharded_tree, what, mesh_axes, atol, rtol, max_relnorm):
+    """Per-leaf elementwise closeness AND a per-leaf relative-error norm
+    ``||d - s|| / ||d||``: a uniformly mis-scaled leaf (wrong psum average —
+    every element off by the same factor) passes a loose elementwise check but
+    shows up as relnorm ≈ |1 - scale|, far above bf16 noise.  Bounds are set
+    ~3x above the measured maxima of the correct implementation on the 8-device
+    CPU mesh (llama grads: 1.34e-3 abs / 2.29e-2 relnorm; mixtral: 5.21e-3 /
+    7.87e-2 — MoE routing amplifies bf16 noise through the top-k gate)."""
     flat_d, treedef = jax.tree.flatten(dense_tree)
     flat_s = jax.tree.leaves(sharded_tree)
     keys = [str(k) for k, _ in jax.tree_util.tree_flatten_with_path(dense_tree)[0]]
     for key, d, s in zip(keys, flat_d, flat_s):
+        d = np.asarray(d, np.float32)
+        s = np.asarray(s, np.float32)
         np.testing.assert_allclose(
-            np.asarray(d, np.float32),
-            np.asarray(s, np.float32),
-            atol=atol,
-            rtol=rtol,
+            d, s, atol=atol, rtol=rtol,
             err_msg=f"{what} leaf {key} diverged on mesh {mesh_axes}",
+        )
+        relnorm = float(np.linalg.norm(d - s) / (np.linalg.norm(d) + 1e-12))
+        assert relnorm < max_relnorm, (
+            f"{what} leaf {key} rel-error norm {relnorm:.3e} >= {max_relnorm} on "
+            f"mesh {mesh_axes} (uniform mis-scaling?)"
         )
 
 
-def _run_matrix_case(family, cfg, params, ids, dense_ref, mesh_axes, atol_loss):
+def _run_matrix_case(
+    family, cfg, params, ids, dense_ref, mesh_axes, atol_loss, atol_grad, max_relnorm
+):
     import optax
 
     tx = optax.sgd(0.1)
@@ -87,14 +100,21 @@ def _run_matrix_case(family, cfg, params, ids, dense_ref, mesh_axes, atol_loss):
     assert abs(float(loss) - dense_loss) < atol_loss, (mesh_axes, float(loss), dense_loss)
     # Backward parity: every grad leaf (a wrong collective shows up here even
     # when the loss matches).
-    _assert_tree_close(dense_grads, grads, "grad", mesh_axes, atol=3e-2, rtol=5e-2)
-    # Update parity: the param delta of one optimizer step.  Deltas are
-    # computed in numpy — an eager jnp subtract would run under the ambient
-    # mesh context against single-device dense arrays.
+    _assert_tree_close(
+        dense_grads, grads, "grad", mesh_axes,
+        atol=atol_grad, rtol=5e-2, max_relnorm=max_relnorm,
+    )
+    # Update parity: the param delta of one optimizer step (sgd lr=0.1 scales
+    # grads by 0.1, hence the 10x-tighter atol).  Deltas are computed in numpy
+    # — an eager jnp subtract would run under the ambient mesh context against
+    # single-device dense arrays.
     _np = lambda t: jax.tree.map(lambda x: np.asarray(x, np.float32), t)
     dense_delta = jax.tree.map(lambda n, p: n - p, _np(dense_new), _np(params))
     sharded_delta = jax.tree.map(lambda n, p: n - p, _np(new_params), _np(sp))
-    _assert_tree_close(dense_delta, sharded_delta, "update", mesh_axes, atol=3e-3, rtol=5e-2)
+    _assert_tree_close(
+        dense_delta, sharded_delta, "update", mesh_axes,
+        atol=atol_grad / 10, rtol=5e-2, max_relnorm=max_relnorm,
+    )
 
 
 @pytest.fixture(scope="module")
@@ -115,7 +135,10 @@ def llama_dense():
 )
 def test_llama_mesh_matrix(mesh_axes, llama_dense):
     cfg, params, ids, dense_ref = llama_dense
-    _run_matrix_case(llama, cfg, params, ids, dense_ref, mesh_axes, atol_loss=3e-3)
+    _run_matrix_case(
+        llama, cfg, params, ids, dense_ref, mesh_axes,
+        atol_loss=3e-3, atol_grad=4e-3, max_relnorm=7e-2,
+    )
 
 
 @pytest.fixture(scope="module")
@@ -136,4 +159,7 @@ def mixtral_dense():
 )
 def test_mixtral_mesh_matrix(mesh_axes, mixtral_dense):
     cfg, params, ids, dense_ref = mixtral_dense
-    _run_matrix_case(mixtral, cfg, params, ids, dense_ref, mesh_axes, atol_loss=5e-3)
+    _run_matrix_case(
+        mixtral, cfg, params, ids, dense_ref, mesh_axes,
+        atol_loss=5e-3, atol_grad=1.6e-2, max_relnorm=2.5e-1,
+    )
