@@ -817,6 +817,19 @@ class Accelerator:
             )
         model = self._models[-1]
         if isinstance(optimizer, torch.optim.Optimizer):
+            # Pair by PARAMETER IDENTITY, not recency: with several models under
+            # one Accelerator (reference test_ds_multiple_model.py), each torch
+            # optimizer holds references to its own model's parameters — pairing
+            # with _models[-1] would route every optimizer's step to the last
+            # prepared model.
+            opt_param_ids = {id(p) for g in optimizer.param_groups for p in g["params"]}
+            for candidate in reversed(self._models):
+                original = getattr(candidate, "module", None)
+                if original is not None and any(
+                    id(p) in opt_param_ids for p in original.parameters()
+                ):
+                    model = candidate
+                    break
             from .utils.torch_bridge import convert_optimizer
 
             tx, lr = convert_optimizer(optimizer)

@@ -515,6 +515,25 @@ class DataLoaderShard(DataLoaderStateMixin):
         return len(self.base_loader) - self.skip_batches
 
     @property
+    def batch_size(self):
+        """Per-device micro batch (reference ``DataLoader.batch_size``
+        semantics: the script's batch_size is PER data shard).  Consumed by
+        the DeepSpeed-dialect ``fill_auto`` to resolve
+        ``train_micro_batch_size_per_gpu: auto``."""
+        total = self.total_batch_size
+        if total is None:
+            return None
+        mesh = getattr(self._placer, "mesh", None)
+        if mesh is None:
+            return total
+        from .parallel.mesh import data_axes
+
+        shards = 1
+        for a in data_axes(mesh):
+            shards *= mesh.shape[a]
+        return max(total // max(shards, 1), 1)
+
+    @property
     def total_batch_size(self) -> int:
         if self._total_batch_size is not None:
             return self._total_batch_size

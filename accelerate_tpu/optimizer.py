@@ -107,6 +107,18 @@ class AcceleratedOptimizer:
     def set_learning_rate(self, lr: float):
         if self.opt_state is not None and hasattr(self.opt_state, "hyperparams"):
             self.opt_state.hyperparams["learning_rate"] = jnp.asarray(lr, jnp.float32)
+        # Keep the torch-visible surface consistent: user code (and the
+        # reference's checkpoint-resume asserts) reads the lr back through
+        # ``optimizer.param_groups[0]["lr"]``, which lives on the shadow torch
+        # optimizer — a torch scheduler's load_state_dict does NOT write it.
+        # ONLY when the groups share one lr: per-group schedules are advanced
+        # by the torch scheduler's own step(), and overwriting distinct group
+        # lrs with lr[0] would collapse them onto group 0's schedule.
+        if self.torch_optimizer is not None:
+            groups = self.torch_optimizer.param_groups
+            if len({float(g["lr"]) for g in groups}) <= 1:
+                for group in groups:
+                    group["lr"] = lr
 
     def zero_grad(self, set_to_none: bool = True):
         """Clear accumulated gradients — only when a sync step just happened

@@ -1,0 +1,143 @@
+"""Launchable checkpoint-resume regression (reference
+``external_deps/test_checkpointing.py:269``).
+
+Reference flow: train epochs, ``save_state`` per epoch alongside a
+``state_{epoch}.json`` recording (accuracy, scheduler lr, optimizer lr,
+epoch); a second launch with ``--resume_from_checkpoint epoch_N`` must
+``load_state``, re-evaluate, and ASSERT all four recorded values match —
+a wrong optimizer/scheduler restore or a stale param tree fails loudly.
+
+The reference trains BERT on GLUE/MRPC; with no network egress the task is
+the same self-contained paraphrase classifier as ``test_performance``
+(learnable to ~1.0, so resumed accuracy is a sharp oracle, not noise).
+
+Run (two launches):
+    accelerate-tpu launch -m ...external_deps.test_checkpointing -- \
+        --output_dir /tmp/ckpt --partial_train_epoch 1
+    accelerate-tpu launch -m ...external_deps.test_checkpointing -- \
+        --output_dir /tmp/ckpt --resume_from_checkpoint /tmp/ckpt/epoch_0
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+from .test_performance import get_dataloaders, make_model
+
+
+def evaluation_loop(accelerator, model, eval_dl) -> float:
+    import torch
+
+    model.eval()
+    correct = total = 0
+    for batch in eval_dl:
+        labels = batch.pop("labels")
+        with torch.no_grad():
+            logits = model(**batch)
+        preds = logits.argmax(dim=-1)
+        preds, labels = accelerator.gather_for_metrics((preds, labels))
+        correct += int((preds == labels).sum())
+        total += int(labels.numel())
+    return correct / max(total, 1)
+
+
+def training_function(args) -> None:
+    import torch
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils import set_seed
+
+    set_seed(args.seed)
+    accelerator = Accelerator()
+    train_dl, eval_dl = get_dataloaders(batch_size=args.batch_size)
+    model = make_model()
+    optimizer = torch.optim.AdamW(model.parameters(), lr=args.lr)
+    # Linear decay: the lr CHANGES every epoch, so a resume that fails to
+    # restore the scheduler/optimizer is caught by the lr asserts below.
+    max_steps = len(train_dl) * args.num_epochs
+    lr_scheduler = torch.optim.lr_scheduler.LambdaLR(
+        optimizer, lambda step: max(0.1, 1.0 - step / max_steps)
+    )
+    model, optimizer, train_dl, eval_dl, lr_scheduler = accelerator.prepare(
+        model, optimizer, train_dl, eval_dl, lr_scheduler
+    )
+
+    starting_epoch = 0
+    ending_epoch = args.num_epochs
+    if args.partial_train_epoch is not None:
+        ending_epoch = args.partial_train_epoch
+
+    if args.resume_from_checkpoint:
+        accelerator.load_state(args.resume_from_checkpoint)
+        epoch_string = args.resume_from_checkpoint.split("epoch_")[1]
+        state_epoch_num = ""
+        for char in epoch_string:
+            if char.isdigit():
+                state_epoch_num += char
+            else:
+                break
+        starting_epoch = int(state_epoch_num) + 1
+        accuracy = evaluation_loop(accelerator, model, eval_dl)
+        accelerator.print("resumed checkpoint performance:", accuracy)
+        accelerator.print("resumed checkpoint's scheduler's lr:", lr_scheduler.get_last_lr()[0])
+        accelerator.print("resumed optimizer's lr:", optimizer.param_groups[0]["lr"])
+        with open(os.path.join(args.output_dir, f"state_{starting_epoch - 1}.json")) as f:
+            resumed = json.load(f)
+        # Reference asserts (test_checkpointing.py:186-193), same oracles:
+        assert resumed["accuracy"] == accuracy, (
+            f"Accuracy mismatch, loading from checkpoint failed: "
+            f"{resumed['accuracy']} != {accuracy}"
+        )
+        assert resumed["lr"] == lr_scheduler.get_last_lr()[0], (
+            "Scheduler learning rate mismatch, loading from checkpoint failed"
+        )
+        assert resumed["optimizer_lr"] == optimizer.param_groups[0]["lr"], (
+            "Optimizer learning rate mismatch, loading from checkpoint failed"
+        )
+        assert resumed["epoch"] == starting_epoch - 1, (
+            "Epoch mismatch, loading from checkpoint failed"
+        )
+        accelerator.print("resume OK")
+        return
+
+    state = {}
+    for epoch in range(starting_epoch, ending_epoch):
+        model.train()
+        for batch in train_dl:
+            labels = batch.pop("labels")
+            logits = model(**batch)
+            loss = torch.nn.functional.cross_entropy(logits, labels)
+            accelerator.backward(loss)
+            optimizer.step()
+            lr_scheduler.step()
+            optimizer.zero_grad()
+        output_dir = os.path.join(args.output_dir, f"epoch_{epoch}")
+        accelerator.save_state(output_dir)
+        state["accuracy"] = evaluation_loop(accelerator, model, eval_dl)
+        state["lr"] = lr_scheduler.get_last_lr()[0]
+        state["optimizer_lr"] = optimizer.param_groups[0]["lr"]
+        state["epoch"] = epoch
+        accelerator.print(f"epoch {epoch}:", state)
+        accelerator.wait_for_everyone()
+        if accelerator.is_main_process:
+            with open(os.path.join(args.output_dir, f"state_{epoch}.json"), "w") as f:
+                json.dump(state, f)
+    accelerator.end_training()
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--output_dir", type=str, default=".")
+    parser.add_argument("--resume_from_checkpoint", type=str, default=None)
+    parser.add_argument("--partial_train_epoch", type=int, default=None)
+    parser.add_argument("--num_epochs", type=int, default=2)
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--lr", type=float, default=2e-3)
+    parser.add_argument("--seed", type=int, default=42)
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

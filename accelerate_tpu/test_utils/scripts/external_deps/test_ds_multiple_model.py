@@ -1,0 +1,208 @@
+"""Multiple models under one Accelerator with DeepSpeed-dialect configs
+(reference ``external_deps/test_ds_multiple_model.py:332``).
+
+Reference scenarios, same oracles, native engines:
+
+1. **train + frozen inference model**: a trainable classifier plus a frozen
+   "noise" model whose output scales the loss.  The noise model's parameter
+   must be bit-identical after training (it has no optimizer), training must
+   still clear an accuracy bound through the scaled loss, and the
+   training/inference plugins must swap via ``select()`` /
+   ``get_active_deepspeed_plugin`` exactly like the reference's
+   zero2-train/zero3-inference pairing.
+2. **two models training simultaneously**: two classifiers, two optimizers,
+   one accelerator.  Both must train (params move, bound cleared) and
+   stepping one optimizer must not touch the other model's params
+   (no cross-contamination).
+
+The zero2/zero3 configs use "auto" fields resolved by ``fill_auto`` at
+prepare time, mirroring the reference's model_only ds_config jsons.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from .test_performance import get_dataloaders, make_model
+
+
+def _zero_config(stage: int) -> dict:
+    return {
+        "zero_optimization": {"stage": stage},
+        "train_micro_batch_size_per_gpu": "auto",
+        "gradient_accumulation_steps": "auto",
+        "gradient_clipping": "auto",
+    }
+
+
+def _flat_params(model) -> np.ndarray:
+    """Flatten a prepared model's parameters (jax arrays) or a torch module's
+    tensors into one comparable vector."""
+    import jax
+
+    if hasattr(model, "params"):
+        leaves = jax.tree.leaves(model.params)
+        return np.concatenate([np.asarray(p, np.float32).ravel() for p in leaves])
+    return np.concatenate(
+        [p.detach().float().cpu().numpy().ravel() for p in model.parameters()]
+    )
+
+
+def _accuracy(accelerator, model, eval_dl) -> float:
+    import torch
+
+    model.eval()
+    correct = total = 0
+    for batch in eval_dl:
+        labels = batch.pop("labels")
+        with torch.no_grad():
+            logits = model(**batch)
+        preds = logits.argmax(dim=-1)
+        preds, labels = accelerator.gather_for_metrics((preds, labels))
+        correct += int((preds == labels).sum())
+        total += int(labels.numel())
+    return correct / max(total, 1)
+
+
+def single_model_training(args) -> None:
+    """Scenario 1: one model trains while a second, frozen model runs
+    inference whose outputs shape the training loss (the reference's
+    zero2-train / zero3-inference pairing, test_ds_multiple_model.py:107)."""
+    import torch
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.utils import set_seed
+    from accelerate_tpu.utils.deepspeed import DeepSpeedPlugin, get_active_deepspeed_plugin
+
+    set_seed(args.seed)
+    train_plugin = DeepSpeedPlugin(hf_ds_config=_zero_config(2))
+    inference_plugin = DeepSpeedPlugin(hf_ds_config=_zero_config(3))
+
+    accelerator = Accelerator(deepspeed_plugin=train_plugin)
+    assert get_active_deepspeed_plugin(accelerator.state) is train_plugin
+
+    train_dl, eval_dl = get_dataloaders(batch_size=args.batch_size)
+    student, teacher = make_model(), make_model()
+    optimizer = torch.optim.AdamW(student.parameters(), lr=args.lr)
+    student, optimizer, train_dl, eval_dl = accelerator.prepare(
+        student, optimizer, train_dl, eval_dl
+    )
+    # The inference model is prepared WITHOUT an optimizer under the zero3
+    # plugin (the reference swaps plugins per model via select()).
+    inference_plugin.select()
+    assert get_active_deepspeed_plugin() is inference_plugin
+    teacher = accelerator.prepare(teacher)
+    teacher_before = _flat_params(teacher)
+    train_plugin.select()
+    assert get_active_deepspeed_plugin() is train_plugin
+
+    # Train the student on CE plus a small consistency term against the frozen
+    # teacher's logits (computed under no_grad — pure inference).
+    for _ in range(args.num_epochs):
+        student.train()
+        for batch in train_dl:
+            labels = batch.pop("labels")
+            with torch.no_grad():
+                teacher_logits = teacher(**batch).detach()
+            logits = student(**batch)
+            loss = torch.nn.functional.cross_entropy(logits, labels)
+            loss = loss + 0.05 * torch.nn.functional.mse_loss(logits, teacher_logits)
+            accelerator.backward(loss)
+            optimizer.step()
+            optimizer.zero_grad()
+    acc = _accuracy(accelerator, student, eval_dl)
+    accelerator.print(f"scenario1 accuracy {acc:.3f}")
+    assert acc >= args.performance_lower_bound, (
+        f"scenario1: accuracy {acc} lower than the lower bound {args.performance_lower_bound}"
+    )
+    teacher_after = _flat_params(teacher)
+    assert np.array_equal(teacher_before, teacher_after), (
+        "scenario1: the frozen inference model's parameters changed during training"
+    )
+    accelerator.end_training()
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+
+def multiple_model_training(args) -> None:
+    """Scenario 2: two models, two optimizers, one accelerator."""
+    import torch
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils import set_seed
+    from accelerate_tpu.utils.deepspeed import DeepSpeedPlugin
+
+    set_seed(args.seed)
+    accelerator = Accelerator(deepspeed_plugin=DeepSpeedPlugin(hf_ds_config=_zero_config(2)))
+    train_dl, eval_dl = get_dataloaders(batch_size=args.batch_size)
+    model_a, model_b = make_model(), make_model()
+    opt_a = torch.optim.AdamW(model_a.parameters(), lr=args.lr)
+    opt_b = torch.optim.AdamW(model_b.parameters(), lr=args.lr)
+    model_a, opt_a, model_b, opt_b, train_dl, eval_dl = accelerator.prepare(
+        model_a, opt_a, model_b, opt_b, train_dl, eval_dl
+    )
+
+    a_start, b_start = _flat_params(model_a), _flat_params(model_b)
+
+    # Step ONLY model A for one batch: B must be untouched (the reference's
+    # independent-engine contract).
+    batch = next(iter(train_dl))
+    labels = batch.pop("labels")
+    logits = model_a(**batch)
+    accelerator.backward(torch.nn.functional.cross_entropy(logits, labels))
+    opt_a.step()
+    opt_a.zero_grad()
+    assert not np.array_equal(a_start, _flat_params(model_a)), (
+        "scenario2: stepping optimizer A did not update model A"
+    )
+    assert np.array_equal(b_start, _flat_params(model_b)), (
+        "scenario2: stepping optimizer A leaked into model B"
+    )
+
+    # Now train both simultaneously; both must clear the bound.
+    for _ in range(args.num_epochs):
+        model_a.train(), model_b.train()
+        for batch in train_dl:
+            labels = batch.pop("labels")
+            loss_a = torch.nn.functional.cross_entropy(model_a(**batch), labels)
+            accelerator.backward(loss_a)
+            opt_a.step()
+            opt_a.zero_grad()
+            loss_b = torch.nn.functional.cross_entropy(model_b(**batch), labels)
+            accelerator.backward(loss_b)
+            opt_b.step()
+            opt_b.zero_grad()
+    acc_a = _accuracy(accelerator, model_a, eval_dl)
+    acc_b = _accuracy(accelerator, model_b, eval_dl)
+    accelerator.print(f"scenario2 accuracies {acc_a:.3f} {acc_b:.3f}")
+    for name, acc in (("A", acc_a), ("B", acc_b)):
+        assert acc >= args.performance_lower_bound, (
+            f"scenario2: model {name} accuracy {acc} lower than the lower bound "
+            f"{args.performance_lower_bound}"
+        )
+    accelerator.end_training()
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--performance_lower_bound", type=float, default=0.9)
+    parser.add_argument("--num_epochs", type=int, default=1)
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--lr", type=float, default=2e-3)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument(
+        "--scenario", choices=["single", "multiple", "both"], default="both"
+    )
+    args = parser.parse_args()
+    if args.scenario in ("single", "both"):
+        single_model_training(args)
+    if args.scenario in ("multiple", "both"):
+        multiple_model_training(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -28,6 +28,7 @@ from typing import Callable, Optional
 from ..utils import imports as _imports
 
 __all__ = [
+    "ensure_virtual_devices",
     "get_backend",
     "device_count",
     "require_cpu",
@@ -92,6 +93,28 @@ __all__ = [
 # ---------------------------------------------------------------------------
 # backend matrix
 # ---------------------------------------------------------------------------
+
+
+def ensure_virtual_devices(n_devices: int) -> None:
+    """Guarantee ``XLA_FLAGS`` requests at least ``n_devices`` virtual CPU
+    devices.  Must run BEFORE the first jax backend-client creation (the flag
+    locks in then); an existing larger count is kept, a smaller one raised.
+    Shared by the driver's multichip dryrun and the pp/sharding payload
+    scripts."""
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    elif int(m.group(1)) < n_devices:
+        os.environ["XLA_FLAGS"] = re.sub(
+            r"--xla_force_host_platform_device_count=\d+",
+            f"--xla_force_host_platform_device_count={n_devices}",
+            flags,
+        )
 
 
 def get_backend() -> tuple[str, int, Callable[[], int]]:

@@ -11,27 +11,44 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _launch(module: str, *script_args, num_processes: int = 1, timeout: int = 240):
+def _run_module(
+    module: str,
+    *script_args,
+    num_processes: int = 1,
+    timeout: int = 240,
+    through_launcher: bool = True,
+    extra_env: dict | None = None,
+    expect_failure: bool = False,
+):
+    """Run a payload module, through the real launcher or directly."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO
-    cmd = [
-        sys.executable,
-        "-m",
-        "accelerate_tpu.commands.accelerate_cli",
-        "launch",
-        "--num_processes",
-        str(num_processes),
-        "-m",
-        module,
-    ]
+    if extra_env:
+        env.update(extra_env)
+    if through_launcher:
+        cmd = [
+            sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+            "launch", "--num_processes", str(num_processes), "-m", module,
+        ]
+    else:
+        cmd = [sys.executable, "-m", module]
     if script_args:
         cmd += list(script_args)
     res = subprocess.run(
         cmd, capture_output=True, text=True, cwd=REPO, env=env, timeout=timeout
     )
-    assert res.returncode == 0, f"stdout:\n{res.stdout}\nstderr:\n{res.stderr}"
+    if expect_failure:
+        assert res.returncode != 0, f"expected failure, got rc 0; stdout:\n{res.stdout}"
+    else:
+        assert res.returncode == 0, f"stdout:\n{res.stdout}\nstderr:\n{res.stderr}"
     return res
+
+
+def _launch(module: str, *script_args, num_processes: int = 1, timeout: int = 240):
+    return _run_module(
+        module, *script_args, num_processes=num_processes, timeout=timeout
+    )
 
 
 def test_performance_lower_bound_enforced():
@@ -49,26 +66,11 @@ def test_performance_lower_bound_enforced():
 
 def test_performance_bound_fails_when_unreachable():
     """An impossible bound must FAIL the script (proves enforcement)."""
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO
-    res = subprocess.run(
-        [
-            sys.executable,
-            "-m",
-            "accelerate_tpu.test_utils.scripts.external_deps.test_performance",
-            "--performance_lower_bound",
-            "1.1",
-            "--num_epochs",
-            "1",
-        ],
-        capture_output=True,
-        text=True,
-        cwd=REPO,
-        env=env,
-        timeout=240,
+    res = _run_module(
+        "accelerate_tpu.test_utils.scripts.external_deps.test_performance",
+        "--performance_lower_bound", "1.1", "--num_epochs", "1",
+        through_launcher=False, expect_failure=True,
     )
-    assert res.returncode != 0
     assert "lower than the lower bound" in res.stderr
 
 
@@ -97,3 +99,55 @@ def test_metrics_oracle_two_processes():
         num_processes=2,
         timeout=360,
     )
+
+
+def test_checkpointing_save_then_resume(tmp_path):
+    """Reference external_deps/test_checkpointing.py:269 — train+save, then a
+    SECOND launch resumes and asserts accuracy/scheduler-lr/optimizer-lr/epoch
+    all match the recorded state."""
+    out = str(tmp_path / "ckpt")
+    os.makedirs(out, exist_ok=True)
+    _launch(
+        "accelerate_tpu.test_utils.scripts.external_deps.test_checkpointing",
+        "--output_dir", out, "--partial_train_epoch", "1",
+    )
+    res = _launch(
+        "accelerate_tpu.test_utils.scripts.external_deps.test_checkpointing",
+        "--output_dir", out, "--resume_from_checkpoint", os.path.join(out, "epoch_0"),
+    )
+    assert "resume OK" in res.stdout
+
+
+def test_ds_multiple_model_scenarios():
+    """Reference external_deps/test_ds_multiple_model.py:332 — frozen-teacher
+    training and two-optimizer simultaneous training under DS-dialect configs."""
+    res = _launch(
+        "accelerate_tpu.test_utils.scripts.external_deps.test_ds_multiple_model",
+        "--performance_lower_bound", "0.9",
+        timeout=480,
+    )
+    assert "scenario1 accuracy" in res.stdout
+    assert "scenario2 accuracies" in res.stdout
+
+
+def test_pippy_inference_parity():
+    """Reference external_deps/test_pippy.py:117 — pipelined logits must MATCH
+    the dense forward (stronger than the reference's output-on-last-rank check)."""
+    res = _launch(
+        "accelerate_tpu.test_utils.scripts.external_deps.test_pippy",
+        timeout=480,
+    )
+    assert "pippy OK" in res.stdout
+
+
+def test_zero3_integration_preinitialized_state():
+    """Reference external_deps/test_zero3_integration.py:59 — user-initialized
+    PartialState, then a zero3-dialect Accelerator attaches (FULL_SHARD mapping,
+    autos resolved, params sharded, one step runs)."""
+    res = _run_module(
+        "accelerate_tpu.test_utils.scripts.external_deps.test_zero3_integration",
+        through_launcher=False, timeout=480,
+        extra_env={"XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+    )
+    assert "zero3 integration OK" in res.stdout
+    assert "strategy=FULL_SHARD" in res.stdout

@@ -146,3 +146,73 @@ def test_optimizer_pickling():
     # Stepping without a re-paired model is a skipped step, not a crash.
     restored.step()
     assert restored.step_was_skipped
+
+
+def test_per_group_lrs_survive_scheduler_steps():
+    """A multi-group torch optimizer (distinct lrs) driven by StepLR must keep
+    each group on its OWN schedule — set_learning_rate only syncs the torch
+    groups when they share one lr (code-review r3 regression repro)."""
+    import torch
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.test_utils import RegressionModelWithLoss
+
+    accelerator = Accelerator(split_batches=True)
+    model = RegressionModelWithLoss()
+    opt = torch.optim.AdamW(
+        [
+            {"params": [model.a], "lr": 1e-3},
+            {"params": [model.b], "lr": 1e-4},
+        ]
+    )
+    sched = torch.optim.lr_scheduler.StepLR(opt, step_size=1, gamma=0.9)
+    model, opt, sched = accelerator.prepare(model, opt, sched)
+
+    x = torch.randn(8, 1)
+    y = 3 * x + 1
+    out = model(x=x, y=y)
+    accelerator.backward(out.loss)
+    opt.step()
+    sched.step()
+    lrs = [g["lr"] for g in opt.param_groups]
+    assert lrs[0] == pytest.approx(9e-4)
+    assert lrs[1] == pytest.approx(9e-5), f"group 1 collapsed onto group 0: {lrs}"
+
+
+def test_uniform_group_lr_synced_after_scheduler_restore():
+    """The single-lr case DOES sync the torch-visible lr on scheduler
+    state_dict restore (checkpoint-resume contract: optimizer.param_groups[0]
+    ['lr'] must match the restored schedule)."""
+    import torch
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.test_utils import RegressionModelWithLoss
+
+    accelerator = Accelerator(split_batches=True)
+    model = RegressionModelWithLoss()
+    opt = torch.optim.AdamW(model.parameters(), lr=1e-3)
+    sched = torch.optim.lr_scheduler.StepLR(opt, step_size=1, gamma=0.5)
+    model, opt, sched = accelerator.prepare(model, opt, sched)
+
+    x = torch.randn(8, 1)
+    y = 3 * x + 1
+    for _ in range(2):
+        out = model(x=x, y=y)
+        accelerator.backward(out.loss)
+        opt.step()
+        sched.step()
+    saved = sched.state_dict()
+    expected_lr = sched.get_last_lr()[0]
+
+    # Fresh stack restores the schedule; the torch-visible lr must follow.
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
+    accelerator2 = Accelerator(split_batches=True)
+    model2 = RegressionModelWithLoss()
+    opt2 = torch.optim.AdamW(model2.parameters(), lr=1e-3)
+    sched2 = torch.optim.lr_scheduler.StepLR(opt2, step_size=1, gamma=0.5)
+    model2, opt2, sched2 = accelerator2.prepare(model2, opt2, sched2)
+    sched2.load_state_dict(saved)
+    assert sched2.get_last_lr()[0] == pytest.approx(expected_lr)
+    assert opt2.param_groups[0]["lr"] == pytest.approx(expected_lr)
