@@ -325,6 +325,13 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, **save
                     pickle.dump(
                         {"epoch": sampler.epoch, "initial_seed": sampler.initial_seed}, f
                     )
+            if getattr(dl, "use_stateful_dataloader", False):
+                # Mid-epoch position (reference checkpointing.py:134-138
+                # ``dl_state_dict.bin``): load_state resumes the loader at the
+                # recorded batch.
+                name = "dl_state_dict.bin" if i == 0 else f"dl_state_dict_{i}.bin"
+                with open(os.path.join(output_dir, name), "wb") as f:
+                    pickle.dump(dl.state_dict(), f)
         for i, obj in enumerate(accelerator._custom_objects):
             save_custom_state(obj, output_dir, i)
 
@@ -379,6 +386,12 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None, **load_
                 st = pickle.load(f)
             sampler.epoch = st["epoch"]
             sampler.initial_seed = st["initial_seed"]
+        dl_path = os.path.join(
+            input_dir, "dl_state_dict.bin" if i == 0 else f"dl_state_dict_{i}.bin"
+        )
+        if os.path.exists(dl_path) and getattr(dl, "use_stateful_dataloader", False):
+            with open(dl_path, "rb") as f:
+                dl.load_state_dict(pickle.load(f))
     for i, obj in enumerate(accelerator._custom_objects):
         load_custom_state(obj, input_dir, i)
 

@@ -443,6 +443,7 @@ class DataLoaderStateMixin:
         # gather_for_metrics on the outer padded batch still dedups.
         self._outer_pad_rows = getattr(self.gradient_state, "device_pad_rows", 0)
         self._outer_batch_rows = getattr(self.gradient_state, "device_batch_rows", 0)
+        self._yielded = self.skip_batches
         with contextlib.suppress(Exception):
             length = getattr(self.dataset, "total_dataset_length", len(self.dataset))
             self.remainder = length % self.total_batch_size
@@ -452,6 +453,37 @@ class DataLoaderStateMixin:
         self.gradient_state.device_pad_rows = getattr(self, "_outer_pad_rows", 0)
         self.gradient_state.device_batch_rows = getattr(self, "_outer_batch_rows", 0)
         self.gradient_state._remove_dataloader(self)
+
+    # -- stateful-dataloader contract (reference DataLoaderAdapter over
+    # torchdata's StatefulDataLoader, data_loader.py:418-498).  Native design:
+    # the loader tracks its user-visible batch position directly, so no
+    # torchdata dependency and no prefetch adjustment is needed — ``_yielded``
+    # is advanced at the yield site, which by construction excludes the
+    # one-batch lookahead (the reference subtracts prefetched batches in
+    # adjust_state_dict_for_prefetch, data_loader.py:462).
+
+    def state_dict(self) -> dict:
+        """Mid-epoch position: ``batches_yielded`` user-visible batches this
+        epoch plus the epoch counter.  Valid while iterating (the batch the
+        caller currently holds counts as yielded)."""
+        return {
+            "batches_yielded": getattr(self, "_yielded", 0),
+            "iteration": self.iteration,
+        }
+
+    def load_state_dict(self, state_dict: dict) -> None:
+        """Resume mid-epoch: the NEXT iteration skips the recorded batches
+        (consumed once — subsequent epochs run in full), and the epoch counter
+        is restored so ``set_epoch``-driven sampler shuffles line up."""
+        self.skip_batches = int(state_dict.get("batches_yielded", 0))
+        self.iteration = int(state_dict.get("iteration", 0))
+        self._yielded = self.skip_batches
+        self._skip_once = True
+
+    def _consume_skip_once(self):
+        if getattr(self, "_skip_once", False):
+            self.skip_batches = 0
+            self._skip_once = False
 
 
 class DataLoaderShard(DataLoaderStateMixin):
@@ -476,6 +508,7 @@ class DataLoaderShard(DataLoaderStateMixin):
         output_type: str = "jax",
         _drop_last: bool = False,
         _non_blocking: bool = False,
+        use_stateful_dataloader: bool = False,
         **kwargs,
     ):
         self.base_loader = base_loader
@@ -484,8 +517,10 @@ class DataLoaderShard(DataLoaderStateMixin):
         self.synchronized_generator = synchronized_generator
         self.skip_batches = skip_batches
         self.put_on_device = put_on_device
+        self.use_stateful_dataloader = use_stateful_dataloader
         self.gradient_state = GradientState()
         self.iteration = 0
+        self._yielded = 0
         self._placer = (
             _GlobalBatchPlacer(mesh, non_blocking, device=device, output_type=output_type)
             if put_on_device
@@ -592,10 +627,10 @@ class DataLoaderShard(DataLoaderStateMixin):
                 upcoming = next(iterator)
             except StopIteration:
                 self.end_of_dataloader = True
-                self._update_state_dict()
                 if batch_index >= self.skip_batches:
                     self.gradient_state.device_pad_rows = current_pad[0]
                     self.gradient_state.device_batch_rows = current_pad[1]
+                    self._yielded = batch_index + 1
                     yield current_converted
                 break
             # Double buffering (reference MpDeviceLoader's background preload,
@@ -605,21 +640,17 @@ class DataLoaderShard(DataLoaderStateMixin):
                 upcoming_converted, upcoming_pad = _convert_tracked(upcoming)
             else:
                 upcoming_converted, upcoming_pad = None, (0, 0)
-            self._update_state_dict()
             if batch_index >= self.skip_batches:
                 self.gradient_state.device_pad_rows = current_pad[0]
                 self.gradient_state.device_batch_rows = current_pad[1]
+                self._yielded = batch_index + 1
                 yield current_converted
             batch_index += 1
             current = upcoming
             current_converted, current_pad = upcoming_converted, upcoming_pad
         self.iteration += 1
+        self._consume_skip_once()
         self.end()
-
-    def _update_state_dict(self):
-        # StatefulDataLoader support lands with checkpointing (reference
-        # data_loader.py:462 adjust_state_dict_for_prefetch).
-        pass
 
 
 class DataLoaderDispatcher(DataLoaderStateMixin):
@@ -646,6 +677,8 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
         self.base_loader = base_loader
         self.split_batches = split_batches
         self.skip_batches = skip_batches
+        self.use_stateful_dataloader = kwargs.pop("use_stateful_dataloader", False)
+        self._yielded = 0
         self.state = PartialState()
         self.gradient_state = GradientState()
         self._placer = (
@@ -736,13 +769,16 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
                     if bs is not None:
                         self.remainder = bs % self.total_batch_size or self.remainder
                     if batch_index - 1 >= self.skip_batches:
+                        self._yielded = batch_index
                         yield self._emit(prev)
                 break
             if prev is not None and batch_index - 1 >= self.skip_batches:
+                self._yielded = batch_index
                 yield self._emit(prev)
             prev = batch
             batch_index += 1
         self.iteration += 1
+        self._consume_skip_once()
         self.end()
 
     def _emit(self, global_batch):
@@ -866,6 +902,7 @@ def prepare_data_loader(
             slice_fn=slice_fn_for_dispatch,
             non_blocking=non_blocking,
             output_type=output_type,
+            use_stateful_dataloader=use_stateful_dataloader,
         )
 
     if not is_torch_loader:
@@ -884,6 +921,7 @@ def prepare_data_loader(
             mesh=mesh,
             non_blocking=non_blocking,
             output_type=output_type,
+            use_stateful_dataloader=use_stateful_dataloader,
         )
 
     import torch.utils.data
@@ -932,6 +970,7 @@ def prepare_data_loader(
             mesh=mesh,
             non_blocking=non_blocking,
             output_type=output_type,
+            use_stateful_dataloader=use_stateful_dataloader,
             total_batch_size=(dataloader.batch_size or 1)
             * (1 if split_batches else total_shards),
         )
@@ -1009,6 +1048,7 @@ def prepare_data_loader(
         mesh=mesh,
         non_blocking=non_blocking,
         output_type=output_type,
+        use_stateful_dataloader=use_stateful_dataloader,
     )
 
 

@@ -455,3 +455,108 @@ def test_nested_dataloader_restores_pad_counters():
                 pass
             assert (gs.device_pad_rows, gs.device_batch_rows) == (pad, rows)
     assert saw_padded_tail, "test setup: outer loader never produced a padded tail"
+
+
+# -- stateful-dataloader contract (reference tests/test_data_loader.py:593-675,
+# DataLoaderAdapter over torchdata's StatefulDataLoader; here the position
+# tracking is native, so no torchdata dependency) -----------------------------
+
+
+def test_dataloader_state_dict_midepoch_resume():
+    """state_dict() mid-epoch records the batches consumed; a fresh loader
+    restored from it yields exactly the remaining batches (reference
+    test_dataloader_state_dict)."""
+    dl = prepare_data_loader(
+        _make_loader(32, 4), put_on_device=False, use_stateful_dataloader=True
+    )
+    it = iter(dl)
+    seen = [np.asarray(next(it))[0, 0] for _ in range(3)]
+    sd = dl.state_dict()
+    assert sd["batches_yielded"] == 3
+    del it
+
+    dl2 = prepare_data_loader(
+        _make_loader(32, 4), put_on_device=False, use_stateful_dataloader=True
+    )
+    dl2.load_state_dict(sd)
+    rest = [np.asarray(b) for b in dl2]
+    assert len(rest) == 8 - 3
+    np.testing.assert_array_equal(rest[0][:, 0], np.arange(12, 16))
+    # The skip is consumed once: the NEXT epoch runs in full.
+    assert len([b for b in dl2]) == 8
+
+
+def test_dataloader_state_dict_prefetch_adjusted():
+    """The one-batch lookahead must NOT count as yielded: after consuming k
+    batches the recorded position is k (reference
+    adjust_state_dict_for_prefetch, data_loader.py:462)."""
+    dl = prepare_data_loader(
+        _make_loader(40, 4), put_on_device=False, use_stateful_dataloader=True
+    )
+    consumed = 0
+    for _ in dl:
+        consumed += 1
+        assert dl.state_dict()["batches_yielded"] == consumed
+    assert consumed == 10
+
+
+def test_dispatcher_state_dict_midepoch_resume():
+    """Dispatcher variant (reference test_dataloader_dispatcher_state_dict)."""
+    dl = prepare_data_loader(
+        _make_loader(32, 4),
+        put_on_device=False,
+        dispatch_batches=True,
+        use_stateful_dataloader=True,
+    )
+    it = iter(dl)
+    for _ in range(2):
+        next(it)
+    sd = dl.state_dict()
+    assert sd["batches_yielded"] == 2
+    del it
+
+    dl2 = prepare_data_loader(
+        _make_loader(32, 4),
+        put_on_device=False,
+        dispatch_batches=True,
+        use_stateful_dataloader=True,
+    )
+    dl2.load_state_dict(sd)
+    rest = [np.asarray(b) for b in dl2]
+    assert len(rest) == 8 - 2
+    np.testing.assert_array_equal(rest[0][:, 0], np.arange(8, 12))
+
+
+def test_save_state_includes_dataloader_position(tmp_path):
+    """Accelerator.save_state/load_state round-trips the mid-epoch position
+    when use_stateful_dataloader is on (reference checkpointing.py:134-138
+    dl_state_dict.bin)."""
+    import torch
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils.dataclasses import DataLoaderConfiguration
+
+    acc = Accelerator(
+        dataloader_config=DataLoaderConfiguration(use_stateful_dataloader=True)
+    )
+    dl = acc.prepare(_make_loader(96, 4))
+    it = iter(dl)
+    next(it)
+    next(it)
+    acc.save_state(str(tmp_path / "ckpt"))
+    del it
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    from accelerate_tpu.state import PartialState
+
+    PartialState._reset_state()
+    acc2 = Accelerator(
+        dataloader_config=DataLoaderConfiguration(use_stateful_dataloader=True)
+    )
+    dl2 = acc2.prepare(_make_loader(96, 4))
+    acc2.load_state(str(tmp_path / "ckpt"))
+    assert dl2.skip_batches == 2
+    batches = [np.asarray(b) for b in dl2]
+    assert len(batches) == 1  # 96 / 32-global-batch = 3 total, 2 consumed
+    np.testing.assert_array_equal(np.sort(batches[0][:, 0])[:4], np.arange(64, 68))
