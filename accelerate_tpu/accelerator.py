@@ -635,6 +635,12 @@ class Accelerator:
     def sync_gradients(self) -> bool:
         return self.gradient_state.sync_gradients
 
+    @sync_gradients.setter
+    def sync_gradients(self, value: bool):
+        # Reference accelerator.py mutable-state contract
+        # (tests/test_accelerator.py:191): writes flow to the GradientState.
+        self.gradient_state.sync_gradients = value
+
     @property
     def gradient_accumulation_steps(self) -> int:
         return self.gradient_state.num_steps
@@ -775,6 +781,7 @@ class Accelerator:
         prepared = PreparedModel(apply_fn, params, buffers, self, original_module=original)
         if evaluation_mode:
             prepared.eval()
+        prepared._is_accelerate_prepared = True
         self._models.append(prepared)
         return prepared
 
@@ -801,6 +808,7 @@ class Accelerator:
             # unchanged; the jitted model picks up `._atpu_jax` with no re-transfer
             static_shape_tail=getattr(cfg, "static_shape_tail", False),
         )
+        prepared._is_accelerate_prepared = True
         self._dataloaders.append(prepared)
         return prepared
 
@@ -844,6 +852,7 @@ class Accelerator:
             # (the jitted update treats 0 as "zero the grads", torch parity for
             # the explicit clip_grad_norm_(0) call only).
             prepared._clip_norm = float(self._dialect_grad_clip)
+        prepared._is_accelerate_prepared = True
         self._optimizers.append(prepared)
         return prepared
 
@@ -857,6 +866,7 @@ class Accelerator:
             step_with_optimizer=self.step_scheduler_with_optimizer,
             split_batches=self.dataloader_config.split_batches,
         )
+        prepared._is_accelerate_prepared = True
         self._schedulers.append(prepared)
         return prepared
 
@@ -993,7 +1003,11 @@ class Accelerator:
                 model.module.load_state_dict(sd, strict=False)
                 return model.module
             return model
-        return model
+        from .utils.other import extract_model_from_parallel
+
+        return extract_model_from_parallel(
+            model, keep_fp32_wrapper=keep_fp32_wrapper, keep_torch_compile=keep_torch_compile
+        )
 
     def clip_grad_norm_(self, parameters=None, max_norm: float = 1.0, norm_type: float = 2.0):
         """Arm global-norm clipping for the next optimizer step (one-shot, like
@@ -1175,13 +1189,18 @@ class Accelerator:
         self._async_checkpointers = []
 
     def free_memory(self, *objects):
-        """Reference ``accelerator.py:3497``: drop references + clear caches."""
+        """Reference ``accelerator.py:3497``: drop references + clear caches.
+        Returns one None per input so callers can overwrite their handles
+        (reference release_memory contract)."""
+        from .utils.memory import release_memory
+
         self._models.clear()
         self._optimizers.clear()
         self._schedulers.clear()
         self._dataloaders.clear()
         self.step = 0
-        jax.clear_caches()
+        # release_memory's clear_device_cache already runs jax.clear_caches().
+        objects = release_memory(*objects)
         return objects
 
     def clear(self, *objects):

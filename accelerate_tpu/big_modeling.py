@@ -220,7 +220,7 @@ def dispatch_model(
             disk_sd = {
                 n: v
                 for n, v in state_dict.items()
-                if any(n == m or n.startswith(m + ".") for m in disk_modules)
+                if any(m == "" or n == m or n.startswith(m + ".") for m in disk_modules)
             }
             if disk_sd:
                 os.makedirs(offload_dir, exist_ok=True)

@@ -136,6 +136,13 @@ class BatchSamplerShard:
                 "You need `even_batches=False` when the batch sampler has no fixed batch size."
             )
 
+    def set_epoch(self, epoch: int):
+        # Custom batch samplers that reshuffle per epoch (reference
+        # test_data_loader.py:517 SimpleBatchSampler) must still hear
+        # set_epoch once wrapped in a shard.
+        if hasattr(self.batch_sampler, "set_epoch"):
+            self.batch_sampler.set_epoch(epoch)
+
     @property
     def total_length(self) -> int:
         return len(self.batch_sampler)
@@ -343,6 +350,29 @@ class _GlobalBatchPlacer:
         self.last_pad_rows = 0
         self.last_batch_rows = 0
 
+    # Live jax.Device / Mesh handles are process-local and unpicklable
+    # (reference test_accelerator.py:649 test_can_pickle_dataloader): drop them
+    # on pickle, re-attach to the process's AcceleratorState mesh on load.
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["mesh"] = None
+        state["device"] = None
+        state["_had_mesh"] = self.mesh is not None
+        state["_had_device"] = self.device is not None
+        return state
+
+    def __setstate__(self, state):
+        had_mesh = state.pop("_had_mesh", False)
+        had_device = state.pop("_had_device", False)
+        self.__dict__.update(state)
+        if had_mesh:
+            from .parallel.mesh import data_axes
+
+            self.mesh = AcceleratorState().mesh
+            self._data_axes = data_axes(self.mesh)
+        if had_device:
+            self.device = AcceleratorState().device
+
     @property
     def num_data_shards(self) -> int:
         if self.mesh is None or not self._data_axes:
@@ -434,6 +464,23 @@ class DataLoaderStateMixin:
     def reset(self):
         self.end_of_dataloader = False
         self.remainder = -1
+
+    # The GradientState borg holds weakrefs to live loaders — rebuild it on
+    # unpickle instead of serializing it (loaders must pickle, reference
+    # test_can_pickle_dataloader).
+    def __getstate__(self):
+        state = {k: v for k, v in self.__dict__.items() if k != "gradient_state"}
+        if state.get("device") is not None:
+            state["device"] = None
+            state["_had_device"] = True
+        return state
+
+    def __setstate__(self, state):
+        had_device = state.pop("_had_device", False)
+        self.__dict__.update(state)
+        self.gradient_state = GradientState()
+        if had_device:
+            self.device = AcceleratorState().device
 
     def begin(self):
         self.reset()
@@ -649,6 +696,10 @@ class DataLoaderShard(DataLoaderStateMixin):
             current = upcoming
             current_converted, current_pad = upcoming_converted, upcoming_pad
         self.iteration += 1
+        # A state_dict taken between epochs must record position 0 of the NEXT
+        # epoch — leaving _yielded at the full count would make a resumed run
+        # silently skip that entire epoch.
+        self._yielded = 0
         self._consume_skip_once()
         self.end()
 
@@ -778,6 +829,10 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
             prev = batch
             batch_index += 1
         self.iteration += 1
+        # A state_dict taken between epochs must record position 0 of the NEXT
+        # epoch — leaving _yielded at the full count would make a resumed run
+        # silently skip that entire epoch.
+        self._yielded = 0
         self._consume_skip_once()
         self.end()
 
@@ -1099,6 +1154,7 @@ def skip_first_batches(dataloader, num_batches: int = 0):
             mesh=dataloader._placer.mesh if dataloader._placer else None,
             slice_fn=dataloader.slice_fn,
             output_type=dataloader._placer.output_type if dataloader._placer else "jax",
+            use_stateful_dataloader=dataloader.use_stateful_dataloader,
         )
         return out
     if isinstance(dataloader, DataLoaderShard):
@@ -1112,5 +1168,6 @@ def skip_first_batches(dataloader, num_batches: int = 0):
             mesh=dataloader._placer.mesh if dataloader._placer else None,
             output_type=dataloader._placer.output_type if dataloader._placer else "jax",
             total_batch_size=dataloader._total_batch_size,
+            use_stateful_dataloader=dataloader.use_stateful_dataloader,
         )
     return SkipDataLoader(dataloader, skip_batches=num_batches, put_on_device=False)

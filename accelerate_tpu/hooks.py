@@ -208,6 +208,22 @@ def set_module_tensor_to_device(
                 value = torch.from_numpy(arr.view(np.uint16).copy()).view(torch.bfloat16)
             else:
                 value = torch.as_tensor(arr)
+        if (
+            old is not None
+            and tuple(old.shape) != tuple(value.shape)
+            and old.numel() == value.numel()
+            and (old.dim() == 0 or value.dim() == 0)
+        ):
+            # Scalar buffers (e.g. num_batches_tracked) round-trip through the
+            # npz/safetensors path as shape (1,); size-1 rank mismatches are a
+            # serialization artifact, not a real shape error.
+            value = value.reshape(tuple(old.shape))
+        if old is not None and tuple(old.shape) != tuple(value.shape):
+            raise ValueError(
+                f'Trying to set a tensor of shape {tuple(value.shape)} in "{tensor_name}" '
+                f"whose shape is {tuple(old.shape)}; shapes must match exactly "
+                "(reference set_module_tensor_to_device contract)."
+            )
         if dtype is not None:
             value = value.to(dtype)
         new_tensor = value.to(device)

@@ -105,6 +105,18 @@ class PartialState:
         "platform",
     ]
 
+    def __getattr__(self, name: str):
+        # Reference state.py contract (tests/test_accelerator.py:133): a stale
+        # handle used after _reset_state() gets an actionable hint, but only
+        # for attributes the state is known to own.
+        if name in type(self)._known_attrs:
+            raise AttributeError(
+                f"`{type(self).__name__}` object has no attribute `{name}`. "
+                f"This happens if `{type(self).__name__}._reset_state()` was "
+                "called on a live handle; construct a fresh instance."
+            )
+        raise AttributeError(f"'{type(self).__name__}' object has no attribute '{name}'")
+
     def __init__(self, cpu: bool = False, **kwargs):
         self.__dict__ = self._shared_state
         if self.initialized:
@@ -519,6 +531,13 @@ class AcceleratorState:
 
         return build_mesh(cfg)
 
+    _known_attrs = PartialState._known_attrs + [
+        "mesh",
+        "mixed_precision",
+        "parallelism_config",
+        "dynamo_plugin",
+    ]
+
     # Pass-throughs to PartialState (reference AcceleratorState mirrors them).
     def __getattr__(self, name: str):
         if name in ("_shared_state", "_partial", "initialized"):
@@ -526,7 +545,15 @@ class AcceleratorState:
         partial_state = self.__dict__.get("_partial")
         if partial_state is not None and hasattr(partial_state, name):
             return getattr(partial_state, name)
-        raise AttributeError(f"AcceleratorState has no attribute {name!r}")
+        if name in type(self)._known_attrs:
+            # Reference contract (tests/test_accelerator.py:154): stale handle
+            # after _reset_state() gets the actionable hint.
+            raise AttributeError(
+                f"`AcceleratorState` object has no attribute `{name}`. "
+                "This happens if `AcceleratorState._reset_state()` was called "
+                "on a live handle; construct a fresh instance."
+            )
+        raise AttributeError(f"'AcceleratorState' object has no attribute '{name}'")
 
     @property
     def initialized(self) -> bool:

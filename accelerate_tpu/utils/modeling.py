@@ -17,6 +17,7 @@ import contextlib
 import json
 import os
 import re
+import warnings
 from collections import OrderedDict, defaultdict
 from collections.abc import Mapping
 from typing import Optional, Union
@@ -60,23 +61,34 @@ def named_module_tensors(module, include_buffers: bool = True, recurse: bool = T
             yield name, b
 
 
+def _tensor_nbytes(name, tensor, dtype=None, special_dtypes=None) -> int:
+    n = int(np.prod(tuple(tensor.shape))) or 1
+    if special_dtypes is not None and name in special_dtypes:
+        return int(n * dtype_byte_size(special_dtypes[name]))
+    if dtype is not None and tensor.is_floating_point():
+        return int(n * dtype_byte_size(dtype))
+    return int(n * dtype_byte_size(tensor.dtype))
+
+
+def _accumulate_tensor_sizes(named_tensors, dtype=None, special_dtypes=None) -> dict[str, int]:
+    """Per-module-prefix byte totals for an iterable of (name, tensor); the ""
+    key is the grand total."""
+    sizes: dict[str, int] = defaultdict(int)
+    for name, tensor in named_tensors:
+        nbytes = _tensor_nbytes(name, tensor, dtype=dtype, special_dtypes=special_dtypes)
+        sizes[""] += nbytes
+        parts = name.split(".")
+        for i in range(1, len(parts)):
+            sizes[".".join(parts[:i])] += nbytes
+    return dict(sizes)
+
+
 def compute_module_sizes(model, dtype=None, special_dtypes=None) -> dict[str, int]:
     """Byte size of each submodule (reference ``utils/modeling.py:655``); the ""
     key is the whole model."""
-    module_sizes: dict[str, int] = defaultdict(int)
-    for name, tensor in named_module_tensors(model, recurse=True):
-        size = int(np.prod(tuple(tensor.shape))) or 1
-        if special_dtypes is not None and name in special_dtypes:
-            nbytes = size * dtype_byte_size(special_dtypes[name])
-        elif dtype is not None and tensor.is_floating_point():
-            nbytes = size * dtype_byte_size(dtype)
-        else:
-            nbytes = size * dtype_byte_size(tensor.dtype)
-        module_sizes[""] += int(nbytes)
-        parts = name.split(".")
-        for i in range(1, len(parts)):
-            module_sizes[".".join(parts[:i])] += int(nbytes)
-    return dict(module_sizes)
+    return _accumulate_tensor_sizes(
+        named_module_tensors(model, recurse=True), dtype=dtype, special_dtypes=special_dtypes
+    )
 
 
 def _tpu_hbm_bytes() -> int:
@@ -155,6 +167,38 @@ def find_tied_parameters(model) -> list[list[str]]:
     return [names for names in seen.values() if len(names) > 1]
 
 
+def compute_module_total_buffer_size(model, dtype=None, special_dtypes=None) -> int:
+    """Total byte size of the model's buffers (reference
+    ``utils/modeling.py compute_module_total_buffer_size``)."""
+    return _module_buffer_sizes(model, dtype=dtype, special_dtypes=special_dtypes).get("", 0)
+
+
+def _module_buffer_sizes(model, dtype=None, special_dtypes=None) -> dict[str, int]:
+    """Per-module byte size of buffers only; the "" key is the total."""
+    return _accumulate_tensor_sizes(
+        model.named_buffers(recurse=True), dtype=dtype, special_dtypes=special_dtypes
+    )
+
+
+def clean_device_map(device_map: dict, module_name: str = "") -> dict:
+    """Collapse a device map in place: a subtree whose entries all share one
+    tier becomes a single entry (reference ``utils/modeling.py
+    clean_device_map``); a fully uniform map becomes ``{"": tier}``."""
+    prefix = f"{module_name}." if module_name else ""
+    keys = [k for k in device_map if k == module_name or k.startswith(prefix)]
+    values = {device_map[k] for k in keys}
+    if len(values) == 1 and len(keys) > 1:
+        tier = values.pop()
+        for k in keys:
+            del device_map[k]
+        device_map[module_name] = tier
+    elif len(values) > 1:
+        children = {k[len(prefix):].split(".")[0] for k in keys if k != module_name}
+        for child in sorted(children):
+            clean_device_map(device_map, f"{prefix}{child}")
+    return device_map
+
+
 def infer_auto_device_map(
     model,
     max_memory: Optional[dict] = None,
@@ -164,70 +208,244 @@ def infer_auto_device_map(
     verbose: bool = False,
     offload_buffers: bool = False,
     clean_result: bool = True,
+    fallback_allocation: bool = False,
 ) -> "OrderedDict[str, str]":
     """Greedy block→tier allocator over the memory budget.
 
-    Parity: reference ``utils/modeling.py:1281-1588``.  Tiers are tried in order
-    (tpu → cpu → disk); a module too big for the current tier is recursed into
-    unless its class is in ``no_split_module_classes``.
+    Parity: reference ``utils/modeling.py:1281-1588``.  Tiers are tried in
+    order (tpu → cpu → disk); a module too big for the current tier is recursed
+    into unless its class is in ``no_split_module_classes``.  Like the
+    reference (``modeling.py:1099``), an unbounded "disk" tier is implicitly
+    appended, so allocation never fails unless the user explicitly caps every
+    tier including disk.
+
+    Divergence from the reference, documented: the budget is a pure *weight*
+    budget — the reference reserves the largest no-split layer on every GPU as
+    streaming headroom unconditionally; here that reservation only kicks in
+    under ``fallback_allocation=True`` (where offloaded execution genuinely
+    streams units through the device) so exact-budget maps stay predictable.
+
+    ``fallback_allocation=True`` (reference ``modeling.py:1523-1539``): when
+    offloading happens, accelerator tiers reserve headroom for the largest
+    no-split unit being streamed, and a tier that would otherwise end up empty
+    is given the largest leaf that fits, so some compute always stays on
+    device.
+
+    With ``offload_buffers=True`` buffers are streamed at execution time and
+    excluded from residency accounting; otherwise, if the buffers of offloaded
+    modules cannot sit alongside any accelerator tier's allocation, a warning
+    suggests ``offload_buffers=True`` (reference ``modeling.py:1555-1572``).
     """
+    import logging
+
+    logger = logging.getLogger(__name__)
     max_memory = get_max_memory(max_memory)
     no_split = set(no_split_module_classes or [])
     sizes = compute_module_sizes(model, dtype=dtype, special_dtypes=special_dtypes)
+    buf_sizes = _module_buffer_sizes(model, dtype=dtype, special_dtypes=special_dtypes)
+    if offload_buffers:
+        alloc_sizes = {k: v - buf_sizes.get(k, 0) for k, v in sizes.items()}
+    else:
+        alloc_sizes = sizes
     tiers = list(max_memory.keys())
-    remaining = {k: float(v) for k, v in max_memory.items()}
-    device_map: "OrderedDict[str, str]" = OrderedDict()
-    tier_idx = 0
-
+    if "disk" not in tiers:
+        tiers.append("disk")
+    budgets = {t: float(max_memory[t]) if t in max_memory else float("inf") for t in tiers}
+    accel_tiers = [t for t in tiers if t not in ("cpu", "disk")]
     tied_groups = find_tied_parameters(model)
 
-    def assign(name: str, module) -> None:
-        nonlocal tier_idx
-        size = sizes.get(name, 0)
-        while tier_idx < len(tiers):
-            tier = tiers[tier_idx]
-            if size <= remaining[tier]:
-                device_map[name] = tier
-                remaining[tier] -= size
-                return
-            # Too big for what's left on this tier: split if allowed...
-            children = list(module.named_children()) if module is not None else []
-            if children and type(module).__name__ not in no_split:
-                # Direct parameters of this module (not in any child) get their
-                # own full-path entries so check_device_map finds them.
-                for pname, p in module.named_parameters(recurse=False):
-                    full = f"{name}.{pname}" if name else pname
-                    psize = int(np.prod(tuple(p.shape)) * dtype_byte_size(p.dtype))
-                    tier2 = tiers[tier_idx]
-                    device_map[full] = tier2
-                    remaining[tier2] -= psize
-                for child_name, child in children:
-                    assign(f"{name}.{child_name}" if name else child_name, child)
-                return
-            # ...else move to the next tier.
-            tier_idx += 1
-        raise ValueError(f"Model does not fit in the provided max_memory (stuck at {name!r}).")
+    def _psize(name: str, p) -> int:
+        return _tensor_nbytes(name, p, dtype=dtype, special_dtypes=special_dtypes)
 
-    # Root-level direct parameters first (execution-order locality).
-    for pname, p in model.named_parameters(recurse=False):
-        psize = int(np.prod(tuple(p.shape)) * dtype_byte_size(p.dtype))
-        while tier_idx < len(tiers) and psize > remaining[tiers[tier_idx]]:
-            tier_idx += 1
-        if tier_idx >= len(tiers):
-            raise ValueError(f"Model does not fit in the provided max_memory (param {pname!r}).")
-        device_map[pname] = tiers[tier_idx]
-        remaining[tiers[tier_idx]] -= psize
-    for child_name, child in model.named_children():
-        assign(child_name, child)
+    def _split_walk(entry: str, module):
+        """The one no-split descent rule, shared by allocation, streaming-unit
+        sizing, and fallback promotion: yields ("param", full_name, param) for
+        direct parameters of split-open intermediates and ("leaf", name,
+        module) for no-split units."""
+        stack = [(entry, module)]
+        while stack:
+            nm, mod = stack.pop()
+            kids = list(mod.named_children())
+            if kids and type(mod).__name__ not in no_split:
+                for pname, p in mod.named_parameters(recurse=False):
+                    yield "param", (f"{nm}.{pname}" if nm else pname), p
+                for kn, km in kids:
+                    stack.append((f"{nm}.{kn}" if nm else kn, km))
+            else:
+                yield "leaf", nm, mod
 
-    # Tied parameters must share a tier with their group leader.
-    for group in tied_groups:
-        owners = [device_map.get(_module_of(n)) for n in group if _module_of(n) in device_map]
-        if owners:
-            for n in group:
-                mod = _module_of(n)
-                if mod in device_map:
-                    device_map[mod] = owners[0]
+    def run(headroom: float) -> tuple["OrderedDict[str, str]", dict]:
+        remaining = {
+            t: budgets[t] - (headroom if t in accel_tiers else 0) for t in tiers
+        }
+        used = {t: 0.0 for t in tiers}
+        device_map: "OrderedDict[str, str]" = OrderedDict()
+        tier_idx = 0
+
+        def take(name: str, tier: str, size: float) -> None:
+            device_map[name] = tier
+            remaining[tier] -= size
+            used[tier] += size
+
+        def assign(name: str, module) -> None:
+            nonlocal tier_idx
+            size = alloc_sizes.get(name, 0)
+            while tier_idx < len(tiers):
+                tier = tiers[tier_idx]
+                if size <= remaining[tier]:
+                    take(name, tier, size)
+                    return
+                children = list(module.named_children()) if module is not None else []
+                if children and type(module).__name__ not in no_split:
+                    for pname, p in module.named_parameters(recurse=False):
+                        full = f"{name}.{pname}" if name else pname
+                        take(full, tiers[tier_idx], _psize(full, p))
+                    for child_name, child in children:
+                        assign(f"{name}.{child_name}" if name else child_name, child)
+                    return
+                tier_idx += 1
+            raise ValueError(
+                f"Model does not fit in the provided max_memory (stuck at {name!r})."
+            )
+
+        for pname, p in model.named_parameters(recurse=False):
+            psize = _psize(pname, p)
+            while tier_idx < len(tiers) and psize > remaining[tiers[tier_idx]]:
+                tier_idx += 1
+            if tier_idx >= len(tiers):
+                raise ValueError(
+                    f"Model does not fit in the provided max_memory (param {pname!r})."
+                )
+            take(pname, tiers[tier_idx], psize)
+        for child_name, child in model.named_children():
+            assign(child_name, child)
+
+        # Tied parameters must share a tier: co-locate the group on the
+        # earliest member tier with room for the stragglers, else push it
+        # later (budget-checked — a blind move could overflow max_memory).
+        order = {t: i for i, t in enumerate(tiers)}
+        for group in tied_groups:
+            mods = sorted({_module_of(n) for n in group if _module_of(n) in device_map})
+            gtiers = {device_map[m] for m in mods}
+            if len(gtiers) <= 1:
+                continue
+            start = min(order[t] for t in gtiers)
+            for ti in range(start, len(tiers)):
+                t = tiers[ti]
+                movers = [m for m in mods if device_map[m] != t]
+                cost = sum(alloc_sizes.get(m, 0) for m in movers)
+                if cost <= remaining[t]:
+                    for m in movers:
+                        src = device_map[m]
+                        sz = alloc_sizes.get(m, 0)
+                        remaining[src] += sz
+                        used[src] -= sz
+                        device_map[m] = t
+                        remaining[t] -= sz
+                        used[t] += sz
+                    break
+            # If even the final user-capped tier lacks room, the map stays
+            # mixed; check_tied_parameters_on_same_device warns downstream.
+        return device_map, used
+
+    device_map, used = run(0.0)
+    tied_names = {n for group in tied_groups for n in group}
+
+    def _offloaded(dm) -> list:
+        return [k for k, v in dm.items() if v in ("cpu", "disk")]
+
+    def _leaves_under(entry: str) -> list:
+        """No-split leaf modules (name, size) within a device-map entry."""
+        try:
+            sub = model.get_submodule(entry) if entry else model
+        except AttributeError:
+            # Parameter-level entry (direct param of a split-open module):
+            # alloc_sizes only holds module prefixes, so size it directly.
+            try:
+                return [(entry, _psize(entry, model.get_parameter(entry)))]
+            except AttributeError:
+                return [(entry, alloc_sizes.get(entry, 0))]
+        return [
+            (nm, _psize(nm, obj) if kind == "param" else alloc_sizes.get(nm, 0))
+            for kind, nm, obj in _split_walk(entry, sub)
+        ]
+
+    if fallback_allocation and accel_tiers and _offloaded(device_map):
+        # Offloaded execution streams no-split units (layers) through the
+        # device: reserve room for the largest such unit, then make sure every
+        # accelerator tier hosts at least its largest fitting leaf.
+        stream_unit = max(
+            (
+                size
+                for entry in _offloaded(device_map)
+                for _, size in _leaves_under(entry)
+            ),
+            default=0,
+        )
+        map0, used0 = device_map, used
+        try:
+            device_map, used = run(float(stream_unit))
+        except ValueError:
+            pass  # headroom made it infeasible; keep the headroom-free map
+        for t in accel_tiers:
+            if used[t] > 0:
+                continue
+            candidates = sorted(
+                (
+                    (size, leaf, entry)
+                    for entry in _offloaded(device_map)
+                    for leaf, size in _leaves_under(entry)
+                    if 0 < size <= budgets[t] - stream_unit
+                    and not any(
+                        n == leaf or n.startswith(leaf + ".") for n in tied_names
+                    )
+                ),
+                reverse=True,
+            )
+            if candidates:
+                size, leaf, entry = candidates[0]
+                if leaf != entry:
+                    # Split the parent entry at no-split granularity (the
+                    # cleanup pass re-collapses uniform siblings afterwards) so
+                    # no entry ever lands underneath the promoted leaf.
+                    old_tier = device_map.pop(entry)
+                    sub = model.get_submodule(entry) if entry else model
+                    for _kind, nm, _obj in _split_walk(entry, sub):
+                        device_map[nm] = old_tier
+                device_map[leaf] = t
+                used[t] += size
+        if any(used[t] == 0 < used0[t] for t in accel_tiers):
+            # The streaming headroom starved a tier the plain greedy pass had
+            # filled, and no fallback leaf fit either: keep the better map.
+            device_map, used = map0, used0
+
+    if _offloaded(device_map):
+        # An empty accelerator tier is only a problem when offloading actually
+        # happened — a model that fits on earlier tiers simply doesn't need it.
+        for t in accel_tiers:
+            if used[t] == 0:
+                logger.warning(
+                    f"insufficient memory on tier {t!r}: no module fits its "
+                    f"budget ({budgets[t]:.0f} bytes); work that could have "
+                    "run there was offloaded instead."
+                )
+
+    if not offload_buffers:
+        offloaded_buf = sum(
+            buf_sizes.get(k, 0) for k, v in device_map.items() if v in ("cpu", "disk")
+        )
+        if offloaded_buf > 0 and accel_tiers and not any(
+            budgets[t] - used[t] >= offloaded_buf for t in accel_tiers
+        ):
+            warnings.warn(
+                "Current model requires the buffers of offloaded modules "
+                f"({int(offloaded_buf)} bytes) to be resident on an accelerator tier "
+                "during execution, but no tier has room alongside its allocation. "
+                "Pass offload_buffers=True to stream them instead."
+            )
+
+    if clean_result:
+        device_map = clean_device_map(device_map)
     return device_map
 
 
@@ -267,15 +485,20 @@ def load_checkpoint_in_model(
     if offload_folder is not None:
         os.makedirs(offload_folder, exist_ok=True)
 
+    unexpected_keys: list[str] = []
     for file in files:
         state_dict = _load_state_dict(file)
         for name, value in state_dict.items():
             target = _target_for(name, device_map)
-            if dtype is not None and hasattr(value, "astype"):
-                import torch
+            if dtype is not None:
+                if isinstance(value, np.ndarray):
+                    if np.issubdtype(value.dtype, np.floating):
+                        value = value.astype(_np_dtype(dtype))
+                else:
+                    import torch
 
-                if isinstance(value, np.ndarray) and np.issubdtype(value.dtype, np.floating):
-                    value = value.astype(_np_dtype(dtype))
+                    if isinstance(value, torch.Tensor) and value.is_floating_point():
+                        value = value.to(dtype)
             if target == "disk":
                 if offload_folder is None:
                     raise ValueError("offload_folder required when device_map has 'disk' entries")
@@ -283,9 +506,20 @@ def load_checkpoint_in_model(
             else:
                 try:
                     set_module_tensor_to_device(model, name, "cpu", value=value)
-                except (AttributeError, KeyError) as e:
-                    if strict:
-                        raise
+                except (AttributeError, KeyError):
+                    # Only a missing attribute path means "unexpected key";
+                    # conversion failures (TypeError etc.) must surface.
+                    unexpected_keys.append(name)
+    if unexpected_keys:
+        # Reference contract (test_modeling_utils.py:502): extra checkpoint
+        # keys raise under strict=True and warn otherwise.
+        msg = (
+            f"Checkpoint at {checkpoint!r} contains keys the model does not "
+            f"use: {sorted(unexpected_keys)}."
+        )
+        if strict:
+            raise RuntimeError(f"Error loading state_dict: unexpected keys. {msg}")
+        warnings.warn(msg)
     if offload_folder is not None and offload_index:
         save_offload_index(offload_index, offload_folder)
 
@@ -293,8 +527,19 @@ def load_checkpoint_in_model(
 def _np_dtype(dtype):
     import torch
 
-    mapping = {torch.float32: np.float32, torch.float16: np.float16}
-    return mapping.get(dtype, np.float32)
+    if dtype == torch.bfloat16:
+        # numpy has no native bfloat16; ml_dtypes ships with jax.
+        import ml_dtypes
+
+        return ml_dtypes.bfloat16
+    mapping = {
+        torch.float64: np.float64,
+        torch.float32: np.float32,
+        torch.float16: np.float16,
+    }
+    if dtype not in mapping:
+        raise ValueError(f"Unsupported target dtype for checkpoint downcast: {dtype}")
+    return mapping[dtype]
 
 
 def _checkpoint_files(checkpoint: str) -> list[str]:

@@ -33,9 +33,23 @@ def extract_model_from_parallel(model, keep_fp32_wrapper: bool = True, keep_torc
         acc = model.accelerator
         return acc.unwrap_model(model, keep_fp32_wrapper=keep_fp32_wrapper,
                                 keep_torch_compile=keep_torch_compile)
-    inner = getattr(model, "_orig_mod", None)
-    if inner is not None and not keep_torch_compile:
-        return inner
+    compiled = model if hasattr(model, "_orig_mod") else None
+    if compiled is not None:
+        model = compiled._orig_mod
+    # Peel distributed containers (DataParallel/DDP expose .module).
+    try:
+        import torch
+
+        wrappers = (torch.nn.DataParallel, torch.nn.parallel.DistributedDataParallel)
+        while isinstance(model, wrappers):
+            model = model.module
+    except ImportError:
+        pass
+    if compiled is not None and keep_torch_compile:
+        # Reference utils/other.py: keep the compile wrapper, re-pointed at
+        # the unwrapped module.
+        compiled._orig_mod = model
+        return compiled
     return model
 
 

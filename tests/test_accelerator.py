@@ -324,3 +324,207 @@ def test_backward_twice_on_same_forward_raises():
     accelerator.backward(loss)
     with pytest.raises(RuntimeError, match="second time"):
         accelerator.backward(loss * 1.0)
+
+
+# -- reference tests/test_accelerator.py depth pass (round 3) ------------------
+
+
+def _components(n=16):
+    import torch
+    from torch.utils.data import DataLoader
+
+    model = torch.nn.Linear(2, 4)
+    optimizer = torch.optim.AdamW(model.parameters(), lr=1e-3)
+    scheduler = torch.optim.lr_scheduler.LambdaLR(optimizer, lambda s: 1.0)
+    ds = [(torch.randn(2), torch.randn(4)) for _ in range(n)]
+    return model, optimizer, scheduler, DataLoader(ds, batch_size=4), DataLoader(ds, batch_size=4)
+
+
+def test_partial_state_after_reset():
+    """Reference :133 — stale handles after _reset_state raise an actionable
+    hint, but only for known attributes."""
+    from accelerate_tpu.state import PartialState
+
+    state = PartialState()
+    assert state.num_processes > 0
+    with pytest.raises(AttributeError) as excinfo:
+        state.someotherthing
+    assert "_reset_state()" not in str(excinfo.value)
+
+    PartialState._reset_state()
+    with pytest.raises(AttributeError) as excinfo:
+        state.num_processes
+    assert "_reset_state()" in str(excinfo.value)
+
+    state.someotherthing = "MyValue"
+    assert state.someotherthing == "MyValue"
+
+
+def test_accelerator_state_after_reset():
+    """Reference :154 — same contract through AcceleratorState."""
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.state import AcceleratorState
+
+    accelerator = Accelerator()
+    assert accelerator.num_processes > 0
+    with pytest.raises(AttributeError) as excinfo:
+        accelerator.state.someotherthing
+    assert "_reset_state()" not in str(excinfo.value)
+
+    AcceleratorState._reset_state()
+    with pytest.raises(AttributeError) as excinfo:
+        accelerator.state.mesh
+    assert "_reset_state()" in str(excinfo.value)
+
+    accelerator.state.someotherthing = "MyValue"
+    assert accelerator.state.someotherthing == "MyValue"
+
+
+def test_mutable_states():
+    """Reference :191 — accelerator-level writes flow to GradientState."""
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.state import GradientState
+
+    accelerator = Accelerator()
+    state = GradientState()
+    assert state.num_steps == 1
+    accelerator.gradient_accumulation_steps = 4
+    assert state.num_steps == 4
+    assert state.sync_gradients is True
+    accelerator.sync_gradients = False
+    assert state.sync_gradients is False
+    GradientState._reset_state()
+
+
+def test_prepared_objects_are_referenced():
+    """Reference :203 — every prepared object is tracked on the accelerator."""
+    from accelerate_tpu import Accelerator
+
+    accelerator = Accelerator()
+    model, optimizer, scheduler, train_dl, valid_dl = _components()
+    pm, po, ps, ptd, pvd = accelerator.prepare(model, optimizer, scheduler, train_dl, valid_dl)
+    assert pm in accelerator._models
+    assert po in accelerator._optimizers
+    assert ps in accelerator._schedulers
+    assert ptd in accelerator._dataloaders
+    assert pvd in accelerator._dataloaders
+
+
+def test_prepared_objects_are_referenced_with_stateful_dataloader():
+    """Reference :696 — stateful config produces loaders with the state_dict
+    contract and tracks them."""
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils.dataclasses import DataLoaderConfiguration
+
+    accelerator = Accelerator(dataloader_config=DataLoaderConfiguration(use_stateful_dataloader=True))
+    model, optimizer, scheduler, train_dl, valid_dl = _components()
+    pm, po, ps, ptd, pvd = accelerator.prepare(model, optimizer, scheduler, train_dl, valid_dl)
+    for dl in (ptd, pvd):
+        assert dl in accelerator._dataloaders
+        assert dl.use_stateful_dataloader
+        assert callable(dl.state_dict) and callable(dl.load_state_dict)
+
+
+def test_free_memory_dereferences_prepared_components():
+    """Reference :222 — free_memory empties the registries and returns None
+    per handle."""
+    from accelerate_tpu import Accelerator
+
+    accelerator = Accelerator()
+    accelerator.free_memory()
+    model, optimizer, scheduler, train_dl, valid_dl = _components()
+    out = accelerator.prepare(model, optimizer, scheduler, train_dl, valid_dl)
+    out = accelerator.free_memory(*out)
+    assert all(o is None for o in out)
+    assert not accelerator._models
+    assert not accelerator._optimizers
+    assert not accelerator._schedulers
+    assert not accelerator._dataloaders
+
+
+def test_accelerator_none_passthrough():
+    """Reference :420 — None flows through prepare unchanged."""
+    from accelerate_tpu import Accelerator
+
+    accelerator = Accelerator()
+    model, optimizer, scheduler, train_dl, valid_dl = _components()
+    *_, dummy = accelerator.prepare(model, optimizer, scheduler, train_dl, valid_dl, None)
+    assert dummy is None
+
+
+def test_is_accelerator_prepared():
+    """Reference :432 — prepared objects carry _is_accelerate_prepared; plain
+    passthrough objects don't."""
+    from accelerate_tpu import Accelerator
+
+    accelerator = Accelerator()
+    model, optimizer, scheduler, train_dl, valid_dl = _components()
+    dummy = [1, 2, 3]
+    pm, po, ps, ptd, pvd, pdummy = accelerator.prepare(
+        model, optimizer, scheduler, train_dl, valid_dl, dummy
+    )
+    assert getattr(pdummy, "_is_accelerate_prepared", False) is False
+    for obj in (pm, po, ps, ptd, pvd):
+        assert getattr(obj, "_is_accelerate_prepared", False) is True, obj
+
+
+def test_can_unwrap_model_and_pickle():
+    """Reference :610 — unwrap returns a working, picklable torch module with
+    the trained weights."""
+    import pickle
+
+    import torch
+
+    from accelerate_tpu import Accelerator
+
+    accelerator = Accelerator()
+    model = _components()[0]
+    inputs = torch.randn(10, 2)
+    prepared = accelerator.prepare(model)
+    unwrapped = accelerator.unwrap_model(prepared, keep_fp32_wrapper=False)
+    out = unwrapped(inputs)
+    loaded = pickle.loads(pickle.dumps(unwrapped))
+    np.testing.assert_allclose(
+        loaded(inputs).detach().numpy(), out.detach().numpy(), atol=1e-6
+    )
+
+
+def test_can_unwrap_distributed_compiled_model():
+    """Reference :624/:636 — compile + DataParallel peel in both
+    keep_torch_compile modes."""
+    import torch
+
+    from accelerate_tpu import Accelerator
+
+    accelerator = Accelerator()
+    model = _components()[0]
+    compiled_model = torch.compile(model)
+    distributed_compiled = torch.compile(torch.nn.DataParallel(model))
+
+    kept = accelerator.unwrap_model(distributed_compiled, keep_torch_compile=True)
+    assert kept._orig_mod is compiled_model._orig_mod
+
+    removed = accelerator.unwrap_model(distributed_compiled, keep_torch_compile=False)
+    assert removed is compiled_model._orig_mod
+
+
+@pytest.mark.parametrize("dispatch_batches", [True, False])
+def test_can_pickle_dataloader(dispatch_batches):
+    """Reference :649 — prepared loaders pickle and replay identically."""
+    import pickle
+
+    import torch
+    from torch.utils.data import DataLoader
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils.dataclasses import DataLoaderConfiguration
+
+    accelerator = Accelerator(
+        dataloader_config=DataLoaderConfiguration(dispatch_batches=dispatch_batches)
+    )
+    ds = [torch.tensor([float(i)]) for i in range(16)]
+    dl = accelerator.prepare(DataLoader(ds, batch_size=2))
+    before = [np.asarray(getattr(b, "_atpu_jax", b)).tolist() for b in dl]
+    restored = pickle.loads(pickle.dumps(dl))
+    after = [np.asarray(getattr(b, "_atpu_jax", b)).tolist() for b in restored]
+    assert before == after

@@ -560,3 +560,136 @@ def test_save_state_includes_dataloader_position(tmp_path):
     batches = [np.asarray(b) for b in dl2]
     assert len(batches) == 1  # 96 / 32-global-batch = 3 total, 2 consumed
     np.testing.assert_array_equal(np.sort(batches[0][:, 0])[:4], np.arange(64, 68))
+
+
+# -- skip/wrapper/epoch contract (reference tests/test_data_loader.py:455-531) --
+
+
+def test_skip_batch_sampler():
+    """Reference :455 — SkipBatchSampler drops the first N batches."""
+    from accelerate_tpu.data_loader import SkipBatchSampler
+
+    bs = BatchSampler(SequentialSampler(range(16)), batch_size=4, drop_last=False)
+    skipped = SkipBatchSampler(bs, 2)
+    assert list(skipped) == [[8, 9, 10, 11], [12, 13, 14, 15]]
+    assert len(skipped) == 2
+    assert skipped.total_length == 4
+
+
+def test_skip_data_loader():
+    """Reference :490 — SkipDataLoader yields everything after skip_batches."""
+    from accelerate_tpu.data_loader import SkipDataLoader
+
+    dl = SkipDataLoader(
+        DataLoader(list(range(16)), batch_size=4), skip_batches=2, put_on_device=False
+    )
+    assert [t.tolist() for t in dl] == [[8, 9, 10, 11], [12, 13, 14, 15]]
+
+
+def test_loader_wrapper_contract():
+    """Reference :460/:647 test_dataloader_inheritance analog.  The reference
+    dynamically rebuilds DataLoaderAdapter's bases and asserts instance-of
+    relations; here wrappers are plain composition, so the contract is: every
+    wrapper is a DataLoaderStateMixin, quacks like the inner loader
+    (dataset/batch_sampler/len/batch_size), and exposes the wrapped loader.
+    """
+    from accelerate_tpu.data_loader import DataLoaderStateMixin, SkipDataLoader
+
+    base = DataLoader(list(range(16)), batch_size=4)
+    skip_dl = SkipDataLoader(base, skip_batches=2, put_on_device=False)
+    shard = DataLoaderShard(base, put_on_device=False)
+    disp = DataLoaderDispatcher(base, put_on_device=False)
+
+    for wrapper in (skip_dl, shard, disp):
+        assert isinstance(wrapper, DataLoaderStateMixin)
+        assert wrapper.base_loader is base
+        assert wrapper.dataset == base.dataset
+        assert wrapper.total_batch_size == 4
+    assert isinstance(skip_dl, DataLoaderShard)  # Skip specializes Shard
+    assert len(shard) == 4 and len(skip_dl) == 2
+    # Class-level access to an instance attribute must raise, mirroring the
+    # reference's `DataLoaderShard.base_dataloader` AttributeError assert.
+    with pytest.raises(AttributeError):
+        _ = DataLoaderShard.base_loader
+
+
+def test_end_of_dataloader_flag_both_iterations():
+    """Reference :499 — the LOADER's own flag flips exactly on the final batch,
+    and again on a second full iteration."""
+    dl = DataLoaderShard(DataLoader(list(range(16)), batch_size=4), put_on_device=False)
+    for _ in range(2):
+        for idx, _batch in enumerate(dl):
+            assert dl.end_of_dataloader == (idx == 3)
+
+
+def test_end_of_dataloader_dispatcher_both_iterations():
+    """Reference :508 — dispatcher variant of the loader-flag sequencing."""
+    dl = DataLoaderDispatcher(DataLoader(list(range(16)), batch_size=4), put_on_device=False)
+    for _ in range(2):
+        for idx, _batch in enumerate(dl):
+            assert dl.end_of_dataloader == (idx == 3)
+
+
+def test_set_epoch_in_batch_sampler():
+    """Reference :517 — set_epoch reaches a CUSTOM batch sampler through the
+    shard wrapper chain."""
+
+    class EpochBatchSampler:
+        def __init__(self, n, batch_size):
+            self.n, self.batch_size, self.drop_last, self.epoch = n, batch_size, False, 0
+
+        def set_epoch(self, epoch):
+            self.epoch = epoch
+
+        def __iter__(self):
+            idx = list(range(self.n))
+            for i in range(0, self.n, self.batch_size):
+                yield idx[i : i + self.batch_size]
+
+        def __len__(self):
+            return math.ceil(self.n / self.batch_size)
+
+    sampler = EpochBatchSampler(16, 4)
+    base = DataLoader(list(range(16)), batch_sampler=sampler)
+    dl = prepare_data_loader(base, put_on_device=False)
+    assert sampler.epoch == 0
+    dl.set_epoch(1)
+    assert sampler.epoch == 1
+
+
+def test_dataloader_state_dict_epoch_boundary():
+    """A state_dict taken BETWEEN epochs (the standard save-per-epoch pattern)
+    must restore to the start of the next epoch, not skip it wholesale."""
+    dl = prepare_data_loader(
+        _make_loader(32, 4), put_on_device=False, use_stateful_dataloader=True
+    )
+    assert len(list(dl)) == 8  # full epoch
+    sd = dl.state_dict()
+    assert sd["batches_yielded"] == 0 and sd["iteration"] == 1
+
+    dl2 = prepare_data_loader(
+        _make_loader(32, 4), put_on_device=False, use_stateful_dataloader=True
+    )
+    dl2.load_state_dict(sd)
+    assert len(list(dl2)) == 8  # next epoch runs in full
+
+    # Dispatcher variant.
+    dd = prepare_data_loader(
+        _make_loader(32, 4), put_on_device=False, dispatch_batches=True,
+        use_stateful_dataloader=True,
+    )
+    assert len(list(dd)) == 8
+    assert dd.state_dict()["batches_yielded"] == 0
+
+
+def test_skip_first_batches_keeps_stateful_flag():
+    """skip_first_batches must propagate use_stateful_dataloader so a resumed
+    loader keeps checkpointing its mid-epoch position (r3 review)."""
+    for kwargs in ({}, {"dispatch_batches": True}):
+        dl = prepare_data_loader(
+            _make_loader(32, 4), put_on_device=False, use_stateful_dataloader=True, **kwargs
+        )
+        dl2 = skip_first_batches(dl, 2)
+        assert dl2.use_stateful_dataloader
+        list(dl2)
+        assert dl2.state_dict()["batches_yielded"] == 0  # epoch completed

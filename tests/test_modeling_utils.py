@@ -218,22 +218,29 @@ def test_infer_auto_device_map_no_split_keeps_block_whole():
         max_memory={"tpu": half_block, "cpu": 10_000_000},
         no_split_module_classes=["Block"],
     )
-    # block1 does NOT fit and must not split: everything lands on cpu...
-    assert dm["block1"] == "cpu" and dm["block2"] == "cpu"
+    # block1 does NOT fit and must not split: everything lands on cpu (a
+    # uniform map collapses to the root entry under clean_result).
+    assert dm == {"": "cpu"}
     # ...but without the constraint the half-fitting child stays on tpu.
     dm2 = infer_auto_device_map(model, max_memory={"tpu": half_block, "cpu": 10_000_000})
     assert dm2["block1.linear1"] == "tpu"
     assert dm2["block1.linear2"] == "cpu"
 
 
-def test_infer_auto_device_map_raises_when_nothing_fits():
+def test_infer_auto_device_map_nothing_fits_spills_to_implicit_disk():
+    """Reference modeling.py:1099 — an unbounded "disk" tier is implicitly
+    appended, so allocation never fails on its own; the error surfaces later
+    at load time (offload_folder required).  Raising still happens when the
+    user explicitly caps every tier including disk."""
     import pytest
 
     from accelerate_tpu.utils.modeling import infer_auto_device_map
 
     model = _nested_model()
+    dm = infer_auto_device_map(model, max_memory={"tpu": 4})
+    assert dm == {"": "disk"}
     with pytest.raises(ValueError, match="does not fit"):
-        infer_auto_device_map(model, max_memory={"tpu": 4})
+        infer_auto_device_map(model, max_memory={"tpu": 4, "disk": 8})
 
 
 def test_infer_auto_device_map_tied_weights_same_tier():
@@ -254,7 +261,9 @@ def test_infer_auto_device_map_tied_weights_same_tier():
     model = Tied()
     sizes = compute_module_sizes(model)
     dm = infer_auto_device_map(
-        model, max_memory={"tpu": sizes["embed"] + sizes["mid"] + 4, "cpu": 10_000_000}
+        model,
+        max_memory={"tpu": sizes["embed"] + sizes["mid"] + 4, "cpu": 10_000_000},
+        clean_result=False,
     )
     assert dm["embed"] == dm["head"], dm
 
@@ -396,3 +405,447 @@ def test_get_state_dict_offloaded_model_roundtrip(tmp_path):
     assert set(sd) == set(ref_sd)
     for k in ref_sd:
         torch.testing.assert_close(torch.as_tensor(sd[k]), ref_sd[k])
+
+
+# -- reference tests/test_modeling_utils.py depth pass (round 3) ---------------
+
+
+def test_named_tensors():
+    """Reference :206 — named_module_tensors buffer/recurse combinations."""
+    import torch
+
+    from accelerate_tpu.utils.modeling import named_module_tensors
+
+    model = torch.nn.Sequential()
+    model.add_module("linear", torch.nn.Linear(4, 4))
+    model.register_buffer("top_buf", torch.zeros(2))
+    model.linear.register_buffer("leaf_buf", torch.zeros(3))
+
+    all_names = [n for n, _ in named_module_tensors(model)]
+    assert set(all_names) == {"linear.weight", "linear.bias", "top_buf", "linear.leaf_buf"}
+    no_buf = [n for n, _ in named_module_tensors(model, include_buffers=False)]
+    assert set(no_buf) == {"linear.weight", "linear.bias"}
+    shallow = [n for n, _ in named_module_tensors(model, recurse=False)]
+    assert shallow == ["top_buf"]
+
+
+def test_set_module_tensor_checks_shape():
+    """Reference :196 — mismatched value shape raises a descriptive error."""
+    import torch
+
+    from accelerate_tpu.hooks import set_module_tensor_to_device
+
+    model = torch.nn.Linear(4, 4)
+    with pytest.raises(ValueError, match="shape"):
+        set_module_tensor_to_device(model, "weight", "cpu", value=torch.zeros(5, 5))
+
+
+def test_set_module_tensor_meta_to_cpu():
+    """Reference :171 — a meta parameter materializes on cpu from a value.
+    (The gpu-motion variants :176-:187 are N/A here: device placement is
+    XLA-side; torch modules are host/meta only.)"""
+    import torch
+
+    from accelerate_tpu.big_modeling import init_empty_weights
+    from accelerate_tpu.hooks import set_module_tensor_to_device
+
+    with init_empty_weights():
+        model = torch.nn.Linear(3, 3)
+    assert model.weight.device.type == "meta"
+    set_module_tensor_to_device(model, "weight", "cpu", value=torch.ones(3, 3))
+    set_module_tensor_to_device(model, "bias", "cpu", value=torch.zeros(3))
+    assert model.weight.device.type == "cpu"
+    assert float(model.weight.sum()) == 9.0
+
+
+def test_compute_module_total_buffer_size():
+    """Reference :332 — buffers-only accounting."""
+    import torch
+
+    from accelerate_tpu.utils.modeling import compute_module_total_buffer_size
+
+    model = torch.nn.Sequential()
+    model.add_module("linear", torch.nn.Linear(4, 4))
+    model.linear.register_buffer("b1", torch.zeros(10, 2))
+    model.register_buffer("b2", torch.zeros(5))
+    assert compute_module_total_buffer_size(model) == (20 + 5) * 4
+    assert compute_module_total_buffer_size(model, dtype=torch.float16) == (20 + 5) * 2
+
+
+def test_clean_device_map():
+    """Reference :520 — uniform subtrees collapse, mixed ones stay split."""
+    from accelerate_tpu.utils.modeling import clean_device_map
+
+    dm = {
+        "block1.linear1": "tpu",
+        "block1.linear2": "tpu",
+        "block2.linear1": "tpu",
+        "block2.linear2": "cpu",
+    }
+    out = clean_device_map(dict(dm))
+    assert out == {"block1": "tpu", "block2.linear1": "tpu", "block2.linear2": "cpu"}
+    uniform = {"a.x": "cpu", "a.y": "cpu", "b": "cpu"}
+    assert clean_device_map(dict(uniform)) == {"": "cpu"}
+
+
+def test_load_checkpoint_in_model_unexpected_keys(tmp_path):
+    """Reference :502 — extra checkpoint keys warn by default, raise under
+    strict=True."""
+    import warnings as _warnings
+
+    import torch
+
+    from accelerate_tpu.utils.modeling import load_checkpoint_in_model
+
+    model = torch.nn.Linear(4, 4)
+    sd = {
+        "weight": torch.zeros(4, 4),
+        "bias": torch.zeros(4),
+        "bias2": torch.zeros(4),
+    }
+    path = tmp_path / "pytorch_model.bin"
+    torch.save(sd, path)
+    with _warnings.catch_warnings(record=True) as w:
+        _warnings.simplefilter("always")
+        load_checkpoint_in_model(model, str(path))
+    assert any("bias2" in str(x.message) for x in w)
+
+    with pytest.raises(RuntimeError, match="unexpected keys"):
+        load_checkpoint_in_model(model, str(path), strict=True)
+
+
+def _buffered_model():
+    import torch
+
+    model = torch.nn.Sequential()
+    model.add_module("linear1", torch.nn.Linear(4, 8))       # 160 B params
+    model.add_module("linear2", torch.nn.Linear(8, 8))       # 288 B params
+    model.add_module("linear3", torch.nn.Linear(8, 4))       # 144 B params
+    model.linear1.register_buffer("buf1", torch.zeros(20))   # 80 B
+    model.linear2.register_buffer("buf2", torch.zeros(40))   # 160 B
+    model.linear3.register_buffer("buf3", torch.zeros(30))   # 120 B
+    return model
+
+
+def test_infer_auto_device_map_with_buffer_check():
+    """Reference :677 — offloaded buffers that cannot sit alongside the device
+    allocation warn unless offload_buffers=True."""
+    import warnings as _warnings
+
+    from accelerate_tpu.utils.modeling import infer_auto_device_map
+
+    model = _buffered_model()
+    # linear1 (160+80=240) fits; offloaded buffers = 160+120 = 280 > slack 10.
+    with pytest.warns(UserWarning, match="offload_buffers"):
+        dm = infer_auto_device_map(model, max_memory={"tpu": 250, "cpu": "1GB"})
+    assert dm["linear1"] == "tpu" and dm["linear2"] == "cpu" and dm["linear3"] == "cpu"
+
+    # offload_buffers=True streams them: no warning, weight-only budgeting.
+    with _warnings.catch_warnings(record=True) as w:
+        _warnings.simplefilter("always")
+        dm = infer_auto_device_map(
+            model, max_memory={"tpu": 250, "cpu": "1GB"}, offload_buffers=True
+        )
+    assert not w
+    assert dm["linear1"] == "tpu"
+
+
+def test_infer_auto_device_map_with_buffer_check_and_multi_devices():
+    """Reference :700 — a second accelerator tier with room for the offloaded
+    buffers silences the warning; shrinking it brings the warning back."""
+    import warnings as _warnings
+
+    from accelerate_tpu.utils.modeling import infer_auto_device_map
+
+    model = _buffered_model()
+    # tier0 takes linear1 (240), tier1 takes linear2 (448) with 132 slack —
+    # enough for linear3's offloaded 120-byte buffer.
+    with _warnings.catch_warnings(record=True) as w:
+        _warnings.simplefilter("always")
+        dm = infer_auto_device_map(
+            model, max_memory={"tpu:0": 250, "tpu:1": 580, "cpu": "1GB"}
+        )
+    assert not w
+    assert dm["linear1"] == "tpu:0" and dm["linear2"] == "tpu:1"
+    assert dm["linear3"] == "cpu"
+
+    # No tier has slack for the offloaded buffers -> warn.
+    with pytest.warns(UserWarning, match="offload_buffers"):
+        infer_auto_device_map(model, max_memory={"tpu:0": 250, "tpu:1": 460, "cpu": "1GB"})
+
+    # ...unless buffers are streamed.
+    with _warnings.catch_warnings(record=True) as w:
+        _warnings.simplefilter("always")
+        infer_auto_device_map(
+            model,
+            max_memory={"tpu:0": 250, "tpu:1": 460, "cpu": "1GB"},
+            offload_buffers=True,
+        )
+    assert not w
+
+
+def test_infer_auto_device_map_with_fallback_allocation(caplog):
+    """Reference :733 — without fallback the tier starves once the first
+    oversized leaf advances the greedy pointer; with fallback the largest
+    fitting leaf is pulled back on device."""
+    import logging
+    from collections import OrderedDict as OD
+
+    import torch
+
+    from accelerate_tpu.utils.modeling import infer_auto_device_map
+
+    inner = torch.nn.Sequential(
+        OD(
+            [
+                ("linear1", torch.nn.Linear(10, 4)),   # 176 B
+                ("linear2", torch.nn.Linear(4, 4)),    # 80 B
+                ("linear3", torch.nn.Linear(4, 8)),    # 168 B
+            ]
+        )
+    )
+    model = torch.nn.Sequential(OD([("module", inner)]))
+
+    # 170: linear1 (176) misses, pointer advances, tier ends empty -> log.
+    with caplog.at_level(logging.WARNING):
+        dm = infer_auto_device_map(model, max_memory={"tpu": 170})
+    assert all(v != "tpu" for v in dm.values())
+    assert any("insufficient memory" in r.message for r in caplog.records)
+
+    caplog.clear()
+    with caplog.at_level(logging.WARNING):
+        dm = infer_auto_device_map(
+            model, max_memory={"tpu": 256}, fallback_allocation=True
+        )
+    assert not any("insufficient memory" in r.message for r in caplog.records)
+    # Streaming headroom (largest offloaded leaf, 176) leaves 80: linear2 fits.
+    assert dm == {"module.linear1": "disk", "module.linear2": "tpu", "module.linear3": "disk"}
+
+
+def test_infer_auto_device_map_with_fallback_allocation_no_fit(caplog):
+    """Reference :767 — when no leaf fits even with fallback, the tier stays
+    empty and the insufficient-memory diagnostic fires."""
+    import logging
+    from collections import OrderedDict as OD
+
+    import torch
+
+    from accelerate_tpu.utils.modeling import infer_auto_device_map
+
+    inner = torch.nn.Sequential(
+        OD([(f"linear{i}", torch.nn.Linear(10, 10)) for i in (1, 2, 3)])
+    )
+    model = torch.nn.Sequential(OD([("module", inner)]))
+    with caplog.at_level(logging.WARNING):
+        dm = infer_auto_device_map(
+            model, max_memory={"tpu": 30}, fallback_allocation=True
+        )
+    assert all(v != "tpu" for v in dm.values())
+    assert any("insufficient memory" in r.message for r in caplog.records)
+
+
+def test_infer_auto_device_map_with_fallback_allocation_partial_fit():
+    """Reference :792 — fallback splits an offloaded block so some of it runs
+    on device."""
+    from collections import OrderedDict as OD
+
+    import torch
+
+    from accelerate_tpu.utils.modeling import infer_auto_device_map
+
+    class CustomModule(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.submodule1 = torch.nn.Linear(20, 20)  # 1680 B
+            self.submodule2 = torch.nn.Linear(20, 20)
+
+    model = torch.nn.Sequential(
+        OD([("module1", CustomModule()), ("module2", CustomModule()), ("module3", CustomModule())])
+    )
+    dm = infer_auto_device_map(model, max_memory={"tpu": 5000}, fallback_allocation=True)
+    assigned = [k for k, v in dm.items() if v == "tpu"]
+    assert assigned, dm
+
+
+def test_infer_auto_device_map_with_fallback_allocation_tied_weights():
+    """Reference :812 — a fully fitting tied model collapses to the root
+    entry; fallback never splits a tied group."""
+    import torch
+
+    from accelerate_tpu.utils.modeling import infer_auto_device_map
+
+    class TiedWeightsModel(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.linear1 = torch.nn.Linear(10, 10)
+            self.linear2 = torch.nn.Linear(10, 10)
+            self.linear2.weight = self.linear1.weight
+
+    model = TiedWeightsModel()
+    dm = infer_auto_device_map(model, max_memory={"tpu": 600}, fallback_allocation=True)
+    assert dm == {"": "tpu"}
+
+
+def test_infer_auto_device_map_with_fallback_allocation_and_buffers():
+    """Reference :831 — fallback composes with the buffer-residency warning."""
+    from collections import OrderedDict as OD
+
+    import torch
+
+    from accelerate_tpu.utils.modeling import infer_auto_device_map
+
+    model = torch.nn.Sequential(
+        OD(
+            [
+                ("linear1", torch.nn.Linear(10, 10)),
+                ("batchnorm", torch.nn.BatchNorm1d(10)),
+                ("linear2", torch.nn.Linear(10, 10)),
+            ]
+        )
+    )
+    model.linear1.register_buffer("buffer1", torch.zeros(5))
+    model.batchnorm.register_buffer("buffer2", torch.zeros(5))
+    model.linear2.register_buffer("buffer3", torch.zeros(5))
+
+    with pytest.warns(UserWarning, match="offload_buffers"):
+        dm = infer_auto_device_map(
+            model, max_memory={"tpu": 500}, fallback_allocation=True, offload_buffers=False
+        )
+    assert any(v == "tpu" for v in dm.values()), dm
+    assert any(v != "tpu" for v in dm.values()), dm
+
+
+def test_get_balanced_memory_splits_budget():
+    """Reference :859 — multi-tier balance spreads the model instead of
+    front-loading tier 0; low_zero shrinks tier 0's share."""
+    from accelerate_tpu.utils.modeling import (
+        compute_module_sizes,
+        get_balanced_memory,
+        infer_auto_device_map,
+    )
+
+    model = _nested_model()
+    total = compute_module_sizes(model)[""]
+    generous = {"tpu:0": 10 * total, "tpu:1": 10 * total, "cpu": 10 * total}
+    mm = get_balanced_memory(model, max_memory=generous)
+    # Balanced budgets cover the model but stop tier 0 swallowing it whole.
+    assert mm["tpu:0"] < 10 * total
+    assert mm["tpu:0"] + mm["tpu:1"] >= total
+    dm = infer_auto_device_map(model, max_memory=mm, clean_result=False)
+    assert {v for v in dm.values() if v != "cpu"} == {"tpu:0", "tpu:1"}, dm
+
+    low = get_balanced_memory(model, max_memory=generous, low_zero=True)
+    assert low["tpu:0"] < mm["tpu:0"]
+
+
+def test_infer_auto_device_map_unused_tier_no_false_warning(caplog):
+    """A roomy second tier the model never needs must NOT log the
+    insufficient-memory diagnostic (r3 review)."""
+    import logging
+
+    from accelerate_tpu.utils.modeling import infer_auto_device_map
+
+    model = _nested_model()
+    with caplog.at_level(logging.WARNING):
+        dm = infer_auto_device_map(
+            model, max_memory={"tpu:0": 1 << 30, "tpu:1": 1 << 30}
+        )
+    assert dm == {"": "tpu:0"}
+    assert not any("insufficient memory" in r.message for r in caplog.records)
+
+
+def test_fallback_split_respects_no_split_leaves():
+    """When fallback promotes a leaf out of an offloaded entry, no stale entry
+    may survive underneath any promoted or re-tiered no-split leaf (r3
+    review: nested direct params inside a no-split block were pinned to the
+    old tier)."""
+    from collections import OrderedDict as OD
+
+    import torch
+
+    from accelerate_tpu.utils.modeling import check_device_map, infer_auto_device_map
+
+    class Inner(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.proj = torch.nn.Linear(8, 8)
+            self.direct = torch.nn.Parameter(torch.zeros(4, 4))
+
+    class NoSplitBlock(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.inner = Inner()
+
+    # Sizes: l1 = l2 = 676 B, block = 352 B, budget 1200.  The plain pass puts
+    # l1+block on tpu and offloads l2; the 676-byte streaming headroom then
+    # empties the tier, so fallback promotes `block` OUT of the whole-entry
+    # "module2" -> the entry-split path runs.
+    model = torch.nn.Sequential(
+        OD(
+            [
+                ("module1", torch.nn.Sequential(OD([("l1", torch.nn.Linear(12, 13))]))),
+                (
+                    "module2",
+                    torch.nn.Sequential(
+                        OD([("l2", torch.nn.Linear(12, 13)), ("block", NoSplitBlock())])
+                    ),
+                ),
+            ]
+        )
+    )
+    dm = infer_auto_device_map(
+        model,
+        max_memory={"tpu": 1200},
+        no_split_module_classes=["NoSplitBlock"],
+        fallback_allocation=True,
+        clean_result=False,
+    )
+    check_device_map(model, dm)
+    # The no-split block is one unit: nothing may be mapped beneath it.
+    block_entries = [k for k in dm if k.startswith("module2.block.")]
+    assert not block_entries, dm
+    assert dm.get("module2.block") == "tpu", dm
+    # Everything else streams from disk.
+    for k, v in dm.items():
+        if k != "module2.block":
+            assert v == "disk", dm
+
+
+def test_load_checkpoint_in_model_dtype_torch_bin(tmp_path):
+    """dtype= must downcast torch-format checkpoints too, not only
+    safetensors (r3 review)."""
+    import torch
+
+    from accelerate_tpu.utils.modeling import load_checkpoint_in_model
+
+    model = torch.nn.Linear(4, 4)
+    path = tmp_path / "pytorch_model.bin"
+    torch.save({"weight": torch.ones(4, 4), "bias": torch.zeros(4)}, path)
+    load_checkpoint_in_model(model, str(path), dtype=torch.float16)
+    assert model.weight.dtype == torch.float16
+
+
+def test_tied_group_colocation_respects_budget():
+    """Tied co-location must not blow the tier budget: when the follower's
+    own params don't fit beside the owner, the whole group moves to a later
+    tier instead (r3 review — confirmed HBM over-allocation)."""
+    import torch
+
+    from accelerate_tpu.utils.modeling import compute_module_sizes, infer_auto_device_map
+
+    class Tied(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.emb = torch.nn.Embedding(10, 4)        # 160 B (shared storage)
+            self.head = torch.nn.Linear(4, 10)          # bias 40 B unshared
+            self.head.weight = self.emb.weight
+
+    model = Tied()
+    sizes = compute_module_sizes(model)
+    # emb alone fits with 1 byte to spare; head's bias does not.
+    dm = infer_auto_device_map(
+        model,
+        max_memory={"tpu": sizes["emb"] + 1, "cpu": 10_000_000},
+        clean_result=False,
+    )
+    assert dm["emb"] == dm["head"] == "cpu", dm
