@@ -955,7 +955,11 @@ class Accelerator:
         )
         overridden: list = []
         iterable_seen = False
-        if even_batches is not None:
+        # Reference parity (accelerator.py:1251): at a single process the whole
+        # context is a nullcontext — no override, no map-style warning (the
+        # single-process prepare path keeps the plain torch BatchSampler, which
+        # has no even_batches knob).
+        if even_batches is not None and self.num_processes > 1:
             for dl in self._dataloaders:
                 sampler = getattr(dl, "batch_sampler", None)
                 if sampler is not None and hasattr(sampler, "even_batches"):

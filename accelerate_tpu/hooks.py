@@ -224,7 +224,10 @@ def set_module_tensor_to_device(
                 f"whose shape is {tuple(old.shape)}; shapes must match exactly "
                 "(reference set_module_tensor_to_device contract)."
             )
-        if dtype is not None:
+        if dtype is not None and (value.is_floating_point() or value.is_complex()):
+            # Reference contract: int/uint/bool tensors (e.g. BatchNorm's
+            # num_batches_tracked counter) keep their dtype when a float
+            # target dtype is given.
             value = value.to(dtype)
         new_tensor = value.to(device)
     else:

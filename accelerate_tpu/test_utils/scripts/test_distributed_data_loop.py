@@ -39,14 +39,29 @@ def _batch_sizes(accelerator, dataset_size: int, batch_size: int) -> list:
     return [batch[0].shape[0] for batch in dl]
 
 
+def _verify_batch_sizes(accelerator, dataset_size, batch_size, expected_p0, expected_p1):
+    """Reference :100 ``verify_dataloader_batch_sizes`` — per-process batch
+    size lists must match exactly."""
+    sizes = _batch_sizes(accelerator, dataset_size, batch_size)
+    if accelerator.process_index == 0:
+        assert sizes == expected_p0, (sizes, expected_p0)
+    elif accelerator.process_index == 1:
+        assert sizes == expected_p1, (sizes, expected_p1)
+
+
 def test_default_ensures_even_batch_sizes():
     """even_batches=True (default): uneven tails are topped up by wrapping to
     the dataset start, so every batch a process sees has the SAME shape —
-    required for the compiled step (one trace).  The global batch is
-    batch_size x data-parallel device count."""
+    required for the compiled step (one trace).  On a 2-process cluster the
+    per-process size lists are reference-exact (reference :120)."""
     accelerator = _make_accelerator(even_batches=True)
     import jax
 
+    if accelerator.num_processes == 2:
+        _verify_batch_sizes(accelerator, 3, 1, [1, 1], [1, 1])
+        _verify_batch_sizes(accelerator, 7, 2, [2, 2], [2, 2])
+        accelerator.print("even_batches=True ok (reference-exact per-process sizes)")
+        return
     n_shards = max(jax.device_count(), accelerator.num_processes)
     sizes = _batch_sizes(accelerator, 2 * n_shards + 1, 2)
     # Every step's global batch divides evenly across the data shards (the
@@ -58,14 +73,20 @@ def test_default_ensures_even_batch_sizes():
 
 
 def test_can_disable_even_batches():
-    """even_batches=False on the mesh: a global jax.Array batch must still
-    divide across the data shards, so shard-divisibility padding remains (the
-    documented reason ``join_uneven_inputs`` is a no-op here); the knob only
-    changes the cross-PROCESS index math.  gather_for_metrics drops the
-    padded duplicates either way."""
+    """even_batches=False: the cross-process index math stops topping up the
+    tail — later ranks see genuinely smaller/fewer batches.  On a 2-process
+    cluster the per-process size lists are reference-exact (reference :142:
+    ds=3/bs=1 -> [1,1]/[1]; ds=7/bs=2 -> [2,2]/[2,1]).  Single-process,
+    shard-divisibility padding remains (a global jax.Array must divide across
+    local devices); gather_for_metrics drops pad duplicates either way."""
     accelerator = _make_accelerator(even_batches=False)
     import jax
 
+    if accelerator.num_processes == 2:
+        _verify_batch_sizes(accelerator, 3, 1, [1, 1], [1])
+        _verify_batch_sizes(accelerator, 7, 2, [2, 2], [2, 1])
+        accelerator.print("even_batches=False ok (reference-exact per-process sizes)")
+        return
     n_shards = max(jax.device_count(), accelerator.num_processes)
     n = 2 * n_shards + 1
     sizes = _batch_sizes(accelerator, n, 2)
@@ -117,16 +138,37 @@ def test_small_dataset_wraps_to_full_batch():
     accelerator = _make_accelerator(even_batches=True)
     dl = accelerator.prepare(DataLoader(_dataset(global_batch // 2), batch_size=4))
     sizes = [np.asarray(b[0]).shape[0] for b in dl]
-    assert sizes == [global_batch], sizes
+    if accelerator.num_processes == 1:
+        # Single-process tail parity (reference 'No change if no multiprocess',
+        # data_loader.py:1190): no wraparound duplication — the batch is only
+        # padded up to device-divisibility (pad rows deduped by
+        # gather_for_metrics).
+        n_dev = jax.device_count()
+        assert all(s % n_dev == 0 for s in sizes), sizes
+        assert sum(sizes) >= global_batch // 2, sizes
+    else:
+        # Multi-process: the dataset (half a global batch) wraps to ONE full
+        # global batch; each process sees its local slice of it.
+        assert sizes == [global_batch // accelerator.num_processes], sizes
     print(f"small-dataset wraparound ok (sizes={sizes})")
 
 
 def test_join_can_override_even_batches():
     """Reference :195 — even_batches temporarily overridden inside the join
-    context for prepared map-style loaders, restored on exit."""
+    context for prepared map-style loaders, restored on exit.  At a single
+    process the context is a nullcontext (reference accelerator.py:1251 —
+    DistributedType.NO skips the override entirely; the plain torch
+    BatchSampler has no even_batches knob)."""
     accelerator = _make_accelerator(even_batches=True)
     train_dl = accelerator.prepare(DataLoader(_dataset(8), batch_size=2))
     valid_dl = accelerator.prepare(DataLoader(_dataset(8), batch_size=2))
+    if accelerator.num_processes == 1:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with accelerator.join_uneven_inputs([], even_batches=False):
+                assert not hasattr(train_dl.batch_sampler, "even_batches")
+        accelerator.print("join override skipped (single process: nullcontext parity)")
+        return
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
         with accelerator.join_uneven_inputs([], even_batches=False):
@@ -139,7 +181,8 @@ def test_join_can_override_even_batches():
 
 def test_join_mixed_type_dataloaders():
     """Reference :214/:237 — iterable loaders skip the override without
-    AttributeError and raise the map-style-only warning."""
+    AttributeError and raise the map-style-only warning (multi-process only;
+    single process is a nullcontext, see test_join_can_override_even_batches)."""
 
     class Stream(torch.utils.data.IterableDataset):
         def __iter__(self):
@@ -148,6 +191,13 @@ def test_join_mixed_type_dataloaders():
     accelerator = _make_accelerator(even_batches=True)
     accelerator.prepare(DataLoader(Stream(), batch_size=1))
     batch_dl = accelerator.prepare(DataLoader(_dataset(4), batch_size=1))
+    if accelerator.num_processes == 1:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with accelerator.join_uneven_inputs([], even_batches=False):
+                pass
+        accelerator.print("join mixed-type skipped (single process: nullcontext parity)")
+        return
     with warnings.catch_warnings(record=True) as w:
         warnings.simplefilter("always")
         with accelerator.join_uneven_inputs([], even_batches=False):
@@ -191,6 +241,68 @@ def test_pickle_accelerator():
     accelerator.print("pickle ok (same-process + fresh-process restore)")
 
 
+def test_gather_for_metrics_epoch_completeness():
+    """Reference :266 ``test_data_loader`` — after a full epoch over a
+    non-divisible dataset, ``gather_for_metrics`` must return every element
+    exactly once: the even_batches wraparound duplicates are dropped, nothing
+    is lost across processes."""
+    accelerator = _make_accelerator(even_batches=True)
+    import jax
+
+    n_shards = max(jax.device_count(), accelerator.num_processes)
+    n = 4 * n_shards + 3  # forces a padded/wrapped tail batch
+    dl = accelerator.prepare(DataLoader(_dataset(n), batch_size=2))
+    seen = []
+    for batch in dl:
+        gathered = accelerator.gather_for_metrics(batch[0])
+        seen.extend(np.asarray(gathered).ravel().tolist())
+    assert sorted(set(seen)) == [float(i) for i in range(n)], (sorted(set(seen)), n)
+    assert len(seen) == n, (len(seen), n)  # duplicates deduped, nothing dropped
+    accelerator.print(f"gather_for_metrics epoch completeness ok (n={n})")
+
+
+def test_stateful_dataloader_mid_epoch_resume():
+    """Reference :283 ``test_stateful_dataloader`` — state_dict mid-epoch on a
+    prepared stateful loader; a fresh prepared loader restored from it yields
+    exactly the remaining batches, identical content, on every process."""
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.utils import DataLoaderConfiguration
+
+    def make():
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        from accelerate_tpu import Accelerator
+
+        cfg = DataLoaderConfiguration(use_stateful_dataloader=True)
+        return Accelerator(dataloader_config=cfg)
+
+    import jax
+
+    accelerator = make()
+    n_shards = max(jax.device_count(), accelerator.num_processes)
+    n = 16 * n_shards
+
+    dl = accelerator.prepare(DataLoader(_dataset(n), batch_size=2))
+    sd = None
+    untrained = []
+    for step, batch in enumerate(dl):
+        if step == 1:
+            sd = dl.state_dict()
+        if step >= 2:
+            untrained.append(np.asarray(batch[0]))
+    assert sd is not None and sd["batches_yielded"] == 2, sd
+
+    accelerator2 = make()
+    dl2 = accelerator2.prepare(DataLoader(_dataset(n), batch_size=2))
+    dl2.load_state_dict(sd)
+    resumed = [np.asarray(b[0]) for b in dl2]
+    assert len(resumed) == len(untrained), (len(resumed), len(untrained))
+    for b1, b2 in zip(untrained, resumed):
+        assert np.array_equal(b1, b2), (b1, b2)
+    accelerator2.print(f"stateful mid-epoch resume ok ({len(resumed)} batches replayed)")
+
+
 def test_dataloader_state_dict_roundtrip():
     accelerator = _make_accelerator()
     dl = accelerator.prepare(DataLoader(_dataset(16), batch_size=4))
@@ -202,16 +314,33 @@ def test_dataloader_state_dict_roundtrip():
     accelerator.print("dataloader state_dict ok")
 
 
+# Single roster shared by main() and the multi-process cluster worker
+# (debug_workers.run_data_loop_suite) so the two paths cannot drift.
+# test_pickle_accelerator spawns a fresh-process restore probe, which is
+# single-process-only (inside a cluster each rank would spawn its own).
+ALL_TESTS = (
+    test_default_ensures_even_batch_sizes,
+    test_can_disable_even_batches,
+    test_join_uneven_inputs_warns,
+    test_join_can_override_even_batches,
+    test_join_mixed_type_dataloaders,
+    test_dispatch_mode_matches_shard_mode,
+    test_small_dataset_wraps_to_full_batch,
+    test_gather_for_metrics_epoch_completeness,
+    test_stateful_dataloader_mid_epoch_resume,
+    test_dataloader_state_dict_roundtrip,
+)
+
+
+def run_all(skip=()):
+    for test in ALL_TESTS:
+        if test.__name__ not in skip:
+            test()
+
+
 def main():
-    test_default_ensures_even_batch_sizes()
-    test_can_disable_even_batches()
-    test_join_uneven_inputs_warns()
-    test_join_can_override_even_batches()
-    test_join_mixed_type_dataloaders()
+    run_all()
     test_pickle_accelerator()
-    test_dispatch_mode_matches_shard_mode()
-    test_small_dataset_wraps_to_full_batch()
-    test_dataloader_state_dict_roundtrip()
     from accelerate_tpu.state import PartialState
 
     PartialState().print("test_distributed_data_loop: all checks passed")

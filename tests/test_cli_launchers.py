@@ -120,6 +120,29 @@ def test_debug_launcher_object_collectives():
     assert "OBJECTS_OK" in res.stdout
 
 
+@pytest.mark.slow
+def test_data_loop_payload_on_two_process_cluster():
+    """The full distributed-data-loop payload (even_batches=False, dispatcher
+    parity, join_uneven_inputs override, gather_for_metrics completeness,
+    stateful mid-epoch resume) across TWO OS processes on a real
+    jax.distributed cluster — reference runs the same payload under torchrun
+    (test_utils/scripts/test_distributed_data_loop.py)."""
+    code = (
+        "from accelerate_tpu.launchers import debug_launcher;"
+        "from accelerate_tpu.test_utils.scripts.debug_workers import run_data_loop_suite;"
+        "debug_launcher(run_data_loop_suite, args=(2,), num_processes=2);"
+        "print('DATA_LOOP_OK')"
+    )
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, timeout=300, cwd="/root/repo", env=env
+    )
+    assert res.returncode == 0, res.stderr[-3000:]
+    assert "DATA_LOOP_OK" in res.stdout
+
+
 def test_launch_module_flag(tmp_path):
     """accelerate-tpu launch -m pkg.module parity (reference launch --module)."""
     pkg = tmp_path / "fakepkg"

@@ -109,6 +109,22 @@ def test_set_module_tensor_to_device_value():
     torch.testing.assert_close(model.weight.detach(), new_w)
 
 
+def test_set_module_tensor_keeps_integer_dtype():
+    """Reference contract: a float target dtype must NOT convert int/bool
+    tensors (e.g. BatchNorm's num_batches_tracked counter)."""
+    bn = torch.nn.BatchNorm1d(4)
+    set_module_tensor_to_device(
+        bn, "num_batches_tracked", "cpu", value=torch.tensor(5), dtype=torch.bfloat16
+    )
+    assert bn.num_batches_tracked.dtype == torch.int64
+    assert int(bn.num_batches_tracked) == 5
+    # Float tensors DO convert.
+    set_module_tensor_to_device(
+        bn, "running_mean", "cpu", value=torch.zeros(4), dtype=torch.bfloat16
+    )
+    assert bn.running_mean.dtype == torch.bfloat16
+
+
 def test_align_devices_hook_offloads_and_onloads():
     model = _linear()
     weights = {k: v.detach().clone() for k, v in model.state_dict().items()}
