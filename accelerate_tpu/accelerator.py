@@ -24,6 +24,7 @@ model to a pure JAX function and the imperative loop drives *compiled* steps:
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import os
 import warnings
@@ -544,6 +545,10 @@ class Accelerator:
         self._schedulers: list[AcceleratedScheduler] = []
         self._dataloaders: list = []
         self._custom_objects: list = []
+        # save_state/load_state pre-hooks (reference accelerator.py:3054-3118):
+        # registered callables run before state is written/read.
+        self._save_state_pre_hooks: "OrderedDict" = collections.OrderedDict()
+        self._load_state_pre_hooks: "OrderedDict" = collections.OrderedDict()
         self.flag_tensor = None
         self.trackers: list = []
         self.log_with = log_with if isinstance(log_with, (list, tuple)) else ([log_with] if log_with else [])
@@ -982,7 +987,10 @@ class Accelerator:
     # prepared objects hold compiled steps / device arrays / live loaders —
     # process-local by nature.  The pickle carries the CONFIG (plugins, state
     # singletons via their own reducers); handles re-register on prepare().
-    _UNPICKLABLE_ATTRS = ("_models", "_optimizers", "_schedulers", "_dataloaders", "trackers")
+    _UNPICKLABLE_ATTRS = (
+        "_models", "_optimizers", "_schedulers", "_dataloaders", "trackers",
+        "_save_state_pre_hooks", "_load_state_pre_hooks",
+    )
 
     def __getstate__(self):
         out = {k: v for k, v in self.__dict__.items() if k not in self._UNPICKLABLE_ATTRS}
@@ -991,7 +999,8 @@ class Accelerator:
     def __setstate__(self, state):
         self.__dict__.update(state)
         for attr in self._UNPICKLABLE_ATTRS:
-            setattr(self, attr, [])
+            fresh = collections.OrderedDict() if attr.endswith("_pre_hooks") else []
+            setattr(self, attr, fresh)
 
     def unwrap_model(self, model, keep_fp32_wrapper: bool = True, keep_torch_compile: bool = True):
         """Return the original torch module with CURRENT trained weights copied in
@@ -1151,6 +1160,26 @@ class Accelerator:
                 shutil.rmtree(out_dir, ignore_errors=True)
 
     # -- persistence (full impl in checkpointing.py) --------------------------
+
+    def register_save_state_pre_hook(self, hook: Callable):
+        """Register ``hook(models, weights, output_dir)`` to run inside
+        ``save_state`` before anything is written (reference
+        ``accelerator.py:3054``).  Returns a removable handle."""
+        import torch.utils.hooks as torch_hooks
+
+        handle = torch_hooks.RemovableHandle(self._save_state_pre_hooks)
+        self._save_state_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_load_state_pre_hook(self, hook: Callable):
+        """Register ``hook(models, input_dir)`` to run inside ``load_state``
+        before weights are restored (reference ``accelerator.py:3118``).
+        Returns a removable handle."""
+        import torch.utils.hooks as torch_hooks
+
+        handle = torch_hooks.RemovableHandle(self._load_state_pre_hooks)
+        self._load_state_pre_hooks[handle.id] = hook
+        return handle
 
     def save_state(self, output_dir: Optional[str] = None, **save_model_func_kwargs):
         from .checkpointing import save_accelerator_state

@@ -96,14 +96,18 @@ def save_model_weights(
     safe_serialization: bool = True,
     weights_name: str = WEIGHTS_NAME,
     max_shard_size="10GB",
+    state_dict: Optional[dict] = None,
 ):
     """Save a prepared model's consolidated weights (reference ``save_model``
     ``accelerator.py:3048``).  Weights above ``max_shard_size`` split into
     ``model-0000i-of-0000N.safetensors`` files plus a
     ``model.safetensors.index.json`` weight map (reference sharded export,
-    ``accelerator.py:3110-3157``)."""
+    ``accelerator.py:3110-3157``).  An explicit ``state_dict`` overrides the
+    model's own (the save_state pre-hook contract: hook mutations are what get
+    written)."""
     os.makedirs(save_directory, exist_ok=True)
-    state_dict = model.state_dict()
+    if state_dict is None:
+        state_dict = model.state_dict()
     arrays = {k: np.ascontiguousarray(np.asarray(v)) for k, v in state_dict.items()}
     stem = weights_name.rsplit(".", 1)[0]
     if not safe_serialization:
@@ -183,6 +187,15 @@ def load_model_weights(model, input_dir, weights_name: str = WEIGHTS_NAME):
         stem = weights_name.rsplit(".", 1)[0]
         with open(os.path.join(input_dir, f"{stem}.pkl"), "rb") as f:
             state_dict = pickle.load(f)
+    import torch
+
+    if isinstance(model, torch.nn.Module):
+        # safetensors.numpy hands back ndarrays; torch's load_state_dict
+        # requires tensors.
+        state_dict = {
+            k: torch.from_numpy(v) if isinstance(v, np.ndarray) else v
+            for k, v in state_dict.items()
+        }
     model.load_state_dict(state_dict)
 
 
@@ -283,6 +296,17 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, **save
     os.makedirs(output_dir, exist_ok=True)
     state = accelerator.state
 
+    # save_state pre-hooks (reference accelerator.py:2992-3005): run before
+    # anything is written, with the models and their CURRENT weights.  Hook
+    # mutations of the weights list are what gets saved (reference contract) —
+    # the non-sharded save below writes these dicts, not a re-extraction.
+    pre_hooks = list(getattr(accelerator, "_save_state_pre_hooks", {}).values())
+    hook_weights = None
+    if pre_hooks:
+        hook_weights = [accelerator.get_state_dict(m, unwrap=False) for m in accelerator._models]
+        for hook in pre_hooks:
+            hook(accelerator._models, hook_weights, output_dir)
+
     sharded = _use_sharded_save(accelerator)
     if sharded:
         # A still-running async save from the previous save_state must finish
@@ -306,7 +330,12 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, **save
         if not sharded:
             for i, model in enumerate(accelerator._models):
                 name = WEIGHTS_NAME if i == 0 else f"{MODEL_NAME}_{i}.safetensors"
-                save_model_weights(model, output_dir, weights_name=name)
+                save_model_weights(
+                    model,
+                    output_dir,
+                    weights_name=name,
+                    state_dict=None if hook_weights is None else hook_weights[i],
+                )
         for i, opt in enumerate(accelerator._optimizers):
             name = f"{OPTIMIZER_NAME}.bin" if i == 0 else f"{OPTIMIZER_NAME}_{i}.bin"
             with open(os.path.join(output_dir, name), "wb") as f:
@@ -357,6 +386,11 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None, **load_
         input_dir = os.path.join(base, existing[-1])
     if input_dir is None:
         raise ValueError("input_dir required")
+
+    # load_state pre-hooks (reference accelerator.py:3106-3112): run before
+    # any state is restored.
+    for hook in list(getattr(accelerator, "_load_state_pre_hooks", {}).values()):
+        hook(accelerator._models, input_dir)
 
     for i, model in enumerate(accelerator._models):
         orbax_dir = os.path.join(input_dir, f"{MODEL_NAME}_orbax" if i == 0 else f"{MODEL_NAME}_{i}_orbax")

@@ -35,6 +35,7 @@ __all__ = [
     "find_tied_parameters",
     "check_device_map",
     "align_module_device",
+    "get_state_dict_from_offload",
     "get_state_dict_offloaded_model",
 ]
 
@@ -870,6 +871,38 @@ def retie_parameters(model, tied_params) -> None:
             for part in path:
                 module = getattr(module, part)
             setattr(module, leaf, anchor)
+
+
+def get_state_dict_from_offload(
+    module,
+    module_name: str,
+    state_dict: dict,
+    device_to_put_offload="cpu",
+) -> dict:
+    """Materialize ONE (possibly offloaded) module's tensors into
+    ``state_dict`` on the requested device (reference
+    ``utils/modeling.py:1747``).  Keys are matched as
+    ``<parent-of-module_name>.<tensor-name>``; values are cloned inside the
+    onload window so they stay valid after the module's weights are released.
+    """
+    import torch
+
+    root = module_name[: module_name.rfind(".")]
+    # Do not move parameters if the module is not offloaded (reference skips
+    # the device move and reads in place).
+    if not has_offloaded_params(module):
+        device_to_put_offload = None
+    with align_module_device(module, device_to_put_offload):
+        for m_key, params in module.state_dict().items():
+            key = f"{root}.{m_key}"
+            if key in state_dict:
+                value = params.detach()
+                if device_to_put_offload is not None:
+                    value = value.to(torch.device(device_to_put_offload))
+                # Clone: align_module_device restores the original placement on
+                # exit, which would otherwise invalidate the captured tensor.
+                state_dict[key] = value.clone()
+    return state_dict
 
 
 def has_offloaded_params(module) -> bool:

@@ -120,10 +120,12 @@ LADDER = [
     # shadow it (the ladder stops at the first success).  Later rungs are
     # conservative fallbacks (einsum attention, full remat) then smaller
     # models.  batch 8 measured +0.7 MFU points over batch 4 on v5e (0.604 vs
-    # 0.597); 12/16 fail to compile (HBM) with the dense loss; seq 4096 and
+    # 0.597); 10/12/16 fail to compile (HBM) with the dense loss; seq 4096 and
     # flash both lose.  Chunked-vocab CE measured r3: b8 0.5863, b10 0.5790,
     # b12/s4096 OOM — loses at every feasible shape here (see
-    # docs/performance.md #5), so dense stays rung 0.
+    # docs/performance.md #5), so dense stays rung 0.  remat "nothing" at b8
+    # also measured r3: 0.5711 — saving every activation costs more HBM
+    # traffic than "dots" recomputes.
     ("llama-509m", 2048, 6, 8192, 8, 2048, "pallas", "dots", "dense"),
     ("llama-509m", 2048, 6, 8192, 4, 2048, "pallas", "dots", "dense"),
     ("llama-509m", 2048, 6, 8192, 4, 2048, "flash", "dots", "dense"),
