@@ -306,6 +306,14 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, **save
         hook_weights = [accelerator.get_state_dict(m, unwrap=False) for m in accelerator._models]
         for hook in pre_hooks:
             hook(accelerator._models, hook_weights, output_dir)
+        if _use_sharded_save(accelerator):
+            logger.warning(
+                "save_state pre-hooks ran, but the sharded (orbax) save writes the "
+                "live model params directly — mutations of the hook's weights list "
+                "are NOT applied on this path. Use a consolidated save "
+                "(state_dict_type != SHARDED_STATE_DICT) if the hook must edit "
+                "what gets written."
+            )
 
     sharded = _use_sharded_save(accelerator)
     if sharded:

@@ -150,6 +150,13 @@ class AcceleratedOptimizer:
         self._last_grad_norm = gnorm
         self._step_was_skipped = False
         self._step_count += 1
+        if self.torch_optimizer is not None:
+            # Keep the shadow's step bookkeeping in sync: torch LR schedulers
+            # warn "scheduler.step() before optimizer.step()" otherwise (the
+            # optax path never calls the shadow's step()).  Current torch
+            # checks _opt_called; older versions compared _step_count.
+            self.torch_optimizer._opt_called = True
+            self.torch_optimizer._step_count = getattr(self.torch_optimizer, "_step_count", 0) + 1
 
     @property
     def step_was_skipped(self) -> bool:

@@ -87,6 +87,99 @@ def test_gather_non_tensor_objects(accelerator):
     accelerator.print(f"object-gather parity OK: {n} objects")
 
 
+def _f1(preds, labels) -> float:
+    import numpy as np
+
+    p, l = np.asarray(preds), np.asarray(labels)
+    tp = float(((p == 1) & (l == 1)).sum())
+    fp = float(((p == 1) & (l == 0)).sum())
+    fn = float(((p == 0) & (l == 1)).sum())
+    denom = 2 * tp + fp + fn
+    return (2 * tp / denom) if denom else 1.0
+
+
+def test_model_prediction_parity(dispatch_batches: bool, split_batches: bool):
+    """Reference ``test_mrpc`` (:121-148): a real model evaluated through the
+    prepared (dispatcher/split) pipeline must produce EXACTLY the
+    single-process baseline metrics (accuracy and F1), for every
+    (dispatch_batches, split_batches) combination."""
+    import math
+
+    import numpy as np
+    import torch
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.utils import DataLoaderConfiguration, set_seed
+
+    from .test_performance import get_dataloaders, make_model
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    cfg = DataLoaderConfiguration(dispatch_batches=dispatch_batches, split_batches=split_batches)
+    accelerator = Accelerator(dataloader_config=cfg)
+    set_seed(7)
+    train_dl_raw, eval_dl = get_dataloaders(batch_size=16)
+    model = make_model()
+    # Short plain-torch pretrain so predictions span both classes and F1 has
+    # teeth (the reference evaluates a Hub-finetuned checkpoint).
+    opt = torch.optim.AdamW(model.parameters(), lr=2e-3)
+    model.train()
+    for i, batch in enumerate(train_dl_raw):
+        if i >= 3:
+            break
+        labels = batch.pop("labels")
+        loss = torch.nn.functional.cross_entropy(model(**batch), labels)
+        loss.backward()
+        opt.step()
+        opt.zero_grad()
+
+    # Baseline: plain torch, no acceleration.
+    model.eval()
+    base_preds, base_labels = [], []
+    for batch in eval_dl:
+        labels = batch.pop("labels")
+        with torch.no_grad():
+            logits = model(**batch)
+        base_preds.append(logits.argmax(dim=-1))
+        base_labels.append(labels)
+    baseline = {
+        "accuracy": _accuracy(torch.cat(base_preds), torch.cat(base_labels)),
+        "f1": _f1(torch.cat(base_preds), torch.cat(base_labels)),
+    }
+    # Both classes must appear or the F1 parity check is vacuous.
+    assert len(torch.cat(base_preds).unique()) == 2, "degenerate predictions"
+
+    # Distributed: same model through the prepared pipeline + gather_for_metrics.
+    _, eval_dl2 = get_dataloaders(batch_size=16)
+    ddp_model, prepared_dl = accelerator.prepare(model, eval_dl2)
+    got_preds, got_labels = [], []
+    for batch in prepared_dl:
+        labels = batch.pop("labels")
+        with torch.no_grad():
+            logits = ddp_model(**batch)
+        preds = torch.as_tensor(np.asarray(logits)).argmax(dim=-1)
+        preds, labels = accelerator.gather_for_metrics((preds, labels))
+        got_preds.append(torch.as_tensor(np.asarray(preds)))
+        got_labels.append(torch.as_tensor(np.asarray(labels)))
+    distributed = {
+        "accuracy": _accuracy(torch.cat(got_preds), torch.cat(got_labels)),
+        "f1": _f1(torch.cat(got_preds), torch.cat(got_labels)),
+    }
+
+    for key in ("accuracy", "f1"):
+        assert math.isclose(baseline[key], distributed[key]), (
+            f"Baseline and Distributed are not the same for key {key}:\n"
+            f"\tBaseline: {baseline[key]}\n\tDistributed: {distributed[key]}\n"
+            f"\t(dispatch_batches={dispatch_batches}, split_batches={split_batches})"
+        )
+    accelerator.print(
+        f"prediction parity OK (dispatch={dispatch_batches}, split={split_batches}): "
+        f"acc {distributed['accuracy']:.4f}, f1 {distributed['f1']:.4f}"
+    )
+
+
 def main():
     from accelerate_tpu import Accelerator
 
@@ -96,6 +189,10 @@ def main():
     test_metric_parity_uneven_tail(accelerator)
     test_metric_parity_iterable(accelerator)
     test_gather_non_tensor_objects(accelerator)
+    # Reference main() sweeps the (dispatch, split) matrix (:196-207).
+    for dispatch_batches in (False, True):
+        for split_batches in (False, True):
+            test_model_prediction_parity(dispatch_batches, split_batches)
     accelerator.end_training()
 
 

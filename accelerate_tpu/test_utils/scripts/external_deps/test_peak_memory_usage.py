@@ -1,13 +1,15 @@
 """Peak-memory ceiling regression (reference
-``external_deps/test_peak_memory_usage.py:314``: train one epoch, assert peak
-memory <= ``--peak_memory_upper_bound_mb``).
+``external_deps/test_peak_memory_usage.py:314``: per-epoch ``TorchTracemalloc``
+tracking of begin/end/peak memory, asserting each epoch's train peak <=
+``--peak_memory_upper_bound_mb``).
 
-TPU-native measurement: ``device.memory_stats()['peak_bytes_in_use']`` — the
-XLA allocator's high-water mark in HBM, the direct analog of the reference's
-``torch.cuda.max_memory_allocated``.  On backends without allocator stats
-(virtual CPU mesh) it falls back to the process RSS high-water mark
-(``ru_maxrss``), so the script is launchable everywhere; the bound only has
-HBM meaning on a real chip.
+TPU-native measurement: ``device.memory_stats()`` — the XLA allocator's
+``bytes_in_use`` / ``peak_bytes_in_use`` in HBM, the direct analog of the
+reference's ``torch.cuda.memory_allocated`` / ``max_memory_allocated``.  Host
+memory is tracked alongside via ``tracemalloc`` + RSS, like the reference's
+cpu counters.  On backends without allocator stats (virtual CPU mesh) the
+device numbers fall back to the RSS high-water mark so the script stays
+launchable everywhere; the bound only has HBM meaning on a real chip.
 
 Run:
     accelerate-tpu launch -m accelerate_tpu.test_utils.scripts.external_deps.test_peak_memory_usage \
@@ -17,24 +19,66 @@ Run:
 from __future__ import annotations
 
 import argparse
+import gc
+import tracemalloc
 
 
-def measure_peak_mb() -> tuple[float, str]:
-    """(peak_mb, source): device allocator high-water mark, else process RSS."""
+def b2mb(x: float) -> float:
+    """Bytes to megabytes (reference :42)."""
+    return round(x / 2**20, 2)
+
+
+def _device_bytes() -> tuple[float, float, str]:
+    """(bytes_in_use, peak_bytes_in_use, source)."""
     import jax
 
     try:
         stats = jax.local_devices()[0].memory_stats()
         if stats and "peak_bytes_in_use" in stats:
-            return stats["peak_bytes_in_use"] / 2**20, "device.peak_bytes_in_use"
+            return (
+                float(stats.get("bytes_in_use", 0)),
+                float(stats["peak_bytes_in_use"]),
+                "device",
+            )
     except Exception:
         pass
     import resource
 
-    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 2**10, "ru_maxrss"
+    rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024.0
+    return rss, rss, "ru_maxrss"
 
 
-def training_function(args) -> float:
+class DeviceTracemalloc:
+    """Reference ``TorchTracemalloc`` (:48-113) rebuilt on XLA allocator
+    stats: records device begin/used/peaked and host begin/used/peaked for
+    the enclosed block."""
+
+    def __enter__(self):
+        gc.collect()
+        self.device_begin, self.device_peak_begin, self.source = _device_bytes()
+        tracemalloc.start()
+        self.cpu_begin = tracemalloc.get_traced_memory()[0]
+        return self
+
+    def __exit__(self, *exc):
+        gc.collect()
+        self.device_end, device_peak_end, _ = _device_bytes()
+        self.used = b2mb(self.device_end - self.device_begin)
+        # XLA's peak_bytes_in_use is a process-lifetime high-water mark with
+        # no reset API (torch.cuda has reset_peak_memory_stats; XLA doesn't).
+        # Attribute a peak to THIS block only if the mark moved inside it;
+        # otherwise this block stayed under an earlier peak and contributes 0.
+        if device_peak_end > self.device_peak_begin:
+            self.peaked = b2mb(device_peak_end - self.device_begin)
+        else:
+            self.peaked = 0.0
+        cpu_now, cpu_peak = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        self.cpu_used = b2mb(cpu_now - self.cpu_begin)
+        self.cpu_peaked = b2mb(cpu_peak - self.cpu_begin)
+
+
+def training_function(args) -> dict:
     import torch
 
     from accelerate_tpu import Accelerator
@@ -49,31 +93,45 @@ def training_function(args) -> float:
     optimizer = torch.optim.AdamW(model.parameters(), lr=args.lr)
     model, optimizer, train_dl = accelerator.prepare(model, optimizer, train_dl)
 
-    model.train()
-    for step, batch in enumerate(train_dl):
-        if step >= args.max_steps:
-            break
-        labels = batch.pop("labels")
-        logits = model(**batch)
-        loss = torch.nn.functional.cross_entropy(logits, labels)
-        accelerator.backward(loss)
-        optimizer.step()
-        optimizer.zero_grad()
-
-    peak_mb, source = measure_peak_mb()
-    accelerator.print(f"peak memory: {peak_mb:.1f} MB ({source})")
-    if args.peak_memory_upper_bound_mb is not None:
-        assert peak_mb <= args.peak_memory_upper_bound_mb, (
-            f"Peak memory {peak_mb:.1f} MB ({source}) exceeds the ceiling "
-            f"{args.peak_memory_upper_bound_mb} MB"
+    train_total_peak_memory = {}
+    for epoch in range(args.num_epochs):
+        model.train()
+        with DeviceTracemalloc() as tracemalloc_ctx:
+            for step, batch in enumerate(train_dl):
+                if args.max_steps is not None and step >= args.max_steps:
+                    break
+                labels = batch.pop("labels")
+                logits = model(**batch)
+                loss = torch.nn.functional.cross_entropy(logits, labels)
+                accelerator.backward(loss)
+                optimizer.step()
+                optimizer.zero_grad()
+        # Reference :243-256 — print the full begin/used/peaked ledger.
+        accelerator.print(f"epoch {epoch}: memory source {tracemalloc_ctx.source}")
+        accelerator.print(f"Memory before entering the train : {b2mb(tracemalloc_ctx.device_begin)}")
+        accelerator.print(f"Memory consumed at the end of the train (end-begin): {tracemalloc_ctx.used}")
+        accelerator.print(f"Peak Memory consumed during the train (max-begin): {tracemalloc_ctx.peaked}")
+        total = tracemalloc_ctx.peaked + b2mb(tracemalloc_ctx.device_begin)
+        accelerator.print(f"Total Peak Memory consumed during the train (max): {total}")
+        accelerator.print(
+            f"CPU Memory consumed (end-begin): {tracemalloc_ctx.cpu_used}; "
+            f"peak (max-begin): {tracemalloc_ctx.cpu_peaked}"
         )
+        train_total_peak_memory[f"epoch-{epoch}"] = total
+        if args.peak_memory_upper_bound_mb is not None:
+            assert train_total_peak_memory[f"epoch-{epoch}"] <= args.peak_memory_upper_bound_mb, (
+                f"Peak memory {train_total_peak_memory[f'epoch-{epoch}']:.1f} MB "
+                f"({tracemalloc_ctx.source}) exceeds the ceiling "
+                f"{args.peak_memory_upper_bound_mb} MB in epoch {epoch}"
+            )
     accelerator.end_training()
-    return peak_mb
+    return train_total_peak_memory
 
 
 def main():
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--peak_memory_upper_bound_mb", type=float, default=None)
+    parser.add_argument("--num_epochs", type=int, default=2)
     parser.add_argument("--max_steps", type=int, default=16)
     parser.add_argument("--batch_size", type=int, default=16)
     parser.add_argument("--lr", type=float, default=2e-3)

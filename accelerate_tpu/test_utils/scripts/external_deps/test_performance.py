@@ -81,6 +81,17 @@ def make_model():
     return PairClassifier()
 
 
+def _data_shards(accelerator) -> int:
+    """Data-parallel shard count — the factor AcceleratedScheduler advances
+    the single-process schedule by per step (scheduler.py:69-82)."""
+    from accelerate_tpu.parallel.mesh import data_axes
+
+    shards = 1
+    for a in data_axes(accelerator.state.mesh):
+        shards *= accelerator.state.mesh.shape[a]
+    return max(shards, 1)
+
+
 def training_function(args) -> float:
     import torch
 
@@ -95,8 +106,19 @@ def training_function(args) -> float:
     model, optimizer, train_dl, eval_dl = accelerator.prepare(
         model, optimizer, train_dl, eval_dl
     )
+    # Linear decay to exactly zero over the run (reference uses
+    # get_linear_schedule_with_warmup with num_warmup_steps=0 and asserts the
+    # lr after the FIRST optimizer step and lr == 0 at the end,
+    # external_deps/test_performance.py:176-225).
+    shards = _data_shards(accelerator)
+    total_sched_steps = len(train_dl) * args.num_epochs * shards
+    raw_sched = torch.optim.lr_scheduler.LambdaLR(
+        optimizer.torch_optimizer, lambda step: max(0.0, 1.0 - step / total_sched_steps)
+    )
+    lr_scheduler = accelerator.prepare(raw_sched)
 
     best = 0.0
+    first_step_checked = False
     for epoch in range(args.num_epochs):
         model.train()
         for batch in train_dl:
@@ -105,7 +127,16 @@ def training_function(args) -> float:
             loss = torch.nn.functional.cross_entropy(logits, labels)
             accelerator.backward(loss)
             optimizer.step()
+            lr_scheduler.step()
             optimizer.zero_grad()
+            if not first_step_checked:
+                first_step_checked = True
+                expected = args.lr * max(0.0, 1.0 - shards / total_sched_steps)
+                got = lr_scheduler.get_last_lr()[0]
+                assert abs(got - expected) < 1e-12, (
+                    f"Wrong lr after first optimizer step: got {got}, expected {expected} "
+                    f"(shards={shards}, total={total_sched_steps})"
+                )
         model.eval()
         correct = total = 0
         for batch in eval_dl:
@@ -120,10 +151,26 @@ def training_function(args) -> float:
         accelerator.print(f"epoch {epoch}: accuracy {acc:.3f}")
         best = max(best, acc)
 
+    # Reference :221 — the schedule decayed to exactly zero.
+    assert lr_scheduler.get_last_lr()[0] == 0, (
+        f"Wrong lr at end of training: got {lr_scheduler.get_last_lr()[0]}, expected 0"
+    )
+
     if args.performance_lower_bound is not None:
         assert args.performance_lower_bound <= best, (
             f"Best performance metric {best} is lower than the lower bound "
             f"{args.performance_lower_bound}"
+        )
+
+    if args.output_dir is not None:
+        # Reference :232-244 — wait_for_everyone + save; the safetensors
+        # weights file must exist afterwards.
+        import os
+
+        accelerator.wait_for_everyone()
+        accelerator.save_model(accelerator.unwrap_model(model), args.output_dir)
+        assert os.path.exists(os.path.join(args.output_dir, "model.safetensors")), (
+            f"model.safetensors missing from {args.output_dir}"
         )
     accelerator.end_training()
     return best
@@ -132,6 +179,7 @@ def training_function(args) -> float:
 def main():
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--performance_lower_bound", type=float, default=None)
+    parser.add_argument("--output_dir", type=str, default=None)
     parser.add_argument("--num_epochs", type=int, default=2)
     parser.add_argument("--batch_size", type=int, default=16)
     parser.add_argument("--lr", type=float, default=2e-3)

@@ -59,7 +59,7 @@ def test_performance_lower_bound_enforced():
         "--performance_lower_bound",
         "0.9",
         "--num_epochs",
-        "1",
+        "2",
     )
     assert "accuracy" in res.stdout
 
@@ -84,7 +84,7 @@ def test_peak_memory_ceiling_enforced():
         "--max_steps",
         "4",
     )
-    assert "peak memory" in res.stdout
+    assert "Total Peak Memory consumed during the train" in res.stdout
 
 
 def test_metrics_oracle_single_process():
