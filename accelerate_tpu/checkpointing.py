@@ -34,6 +34,9 @@ from .utils.random import rng_registry
 
 logger = get_logger(__name__)
 
+# One-shot flag for the sharded-save + pre-hook weights warning.
+_warned_sharded_hook_weights = False
+
 MODEL_NAME = "model"
 OPTIMIZER_NAME = "optimizer"
 SCHEDULER_NAME = "scheduler"
@@ -296,6 +299,8 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, **save
     os.makedirs(output_dir, exist_ok=True)
     state = accelerator.state
 
+    sharded = _use_sharded_save(accelerator)
+
     # save_state pre-hooks (reference accelerator.py:2992-3005): run before
     # anything is written, with the models and their CURRENT weights.  Hook
     # mutations of the weights list are what gets saved (reference contract) —
@@ -306,16 +311,17 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, **save
         hook_weights = [accelerator.get_state_dict(m, unwrap=False) for m in accelerator._models]
         for hook in pre_hooks:
             hook(accelerator._models, hook_weights, output_dir)
-        if _use_sharded_save(accelerator):
-            logger.warning(
-                "save_state pre-hooks ran, but the sharded (orbax) save writes the "
-                "live model params directly — mutations of the hook's weights list "
-                "are NOT applied on this path. Use a consolidated save "
-                "(state_dict_type != SHARDED_STATE_DICT) if the hook must edit "
-                "what gets written."
-            )
-
-    sharded = _use_sharded_save(accelerator)
+        if sharded:
+            global _warned_sharded_hook_weights
+            if not _warned_sharded_hook_weights:
+                _warned_sharded_hook_weights = True
+                logger.warning(
+                    "save_state pre-hooks ran, but the sharded (orbax) save writes "
+                    "the live model params directly — mutations of the hook's "
+                    "weights list are NOT applied on this path. Use a consolidated "
+                    "save (state_dict_type != SHARDED_STATE_DICT) if the hook must "
+                    "edit what gets written."
+                )
     if sharded:
         # A still-running async save from the previous save_state must finish
         # before its directory can be replaced.

@@ -367,7 +367,9 @@ def attention_block(x, p, c, mask, positions, kv_valid=None) -> jax.Array:
     elif mask is None and kv_valid is None and _use_pallas(c, s, b, c.num_heads, c.num_kv_heads):
         from ..ops.pallas_attention import pallas_attention_spmd
 
-        blk = _flash_block(s)
+        from ..ops.flash_attention import pick_block_pallas
+
+        blk = pick_block_pallas(s, head_dim=q.shape[-1])
         if blk is None:
             raise ValueError(
                 f"attention_impl='pallas' needs a sequence length divisible by "

@@ -30,13 +30,39 @@ import jax.numpy as jnp
 __all__ = ["flash_attention", "pick_block"]
 
 
-def pick_block(s: int) -> Optional[int]:
-    """Largest MXU-friendly block size dividing ``s`` (None when none does) —
-    the single block-ladder used by the flash path pickers."""
-    for b in (512, 256, 128, 64):
+def pick_block(s: int, ladder: tuple = (512, 256, 128, 64)) -> Optional[int]:
+    """Largest MXU-friendly block size from ``ladder`` dividing ``s`` (None
+    when none does) — the single block-ladder used by the flash/pallas path
+    pickers.  ``ACCELERATE_ATTN_BLOCK`` overrides when it is a positive
+    integer dividing ``s`` (tuning knob; see docs/performance.md for the
+    measured ladder — 1024 wins on the fused pallas path where VMEM allows,
+    512 elsewhere)."""
+    import os
+
+    override = os.environ.get("ACCELERATE_ATTN_BLOCK")
+    if override:
+        try:
+            value = int(override)
+        except ValueError:
+            raise ValueError(
+                f"ACCELERATE_ATTN_BLOCK must be a positive integer, got {override!r}"
+            ) from None
+        if value <= 0:
+            raise ValueError(f"ACCELERATE_ATTN_BLOCK must be positive, got {value}")
+        if s % value == 0:
+            return value
+    for b in ladder:
         if s % b == 0:
             return b
     return None
+
+
+def pick_block_pallas(s: int, head_dim: int) -> Optional[int]:
+    """Block ladder for the fused Pallas kernel: prefers 1024 where the
+    larger K/V tile fits VMEM (head_dim <= 128) — measured 0.6355 vs 0.6041
+    MFU at 512 on v5e b8/s2048 (docs/performance.md)."""
+    ladder = (1024, 512, 256, 128, 64) if head_dim <= 128 else (512, 256, 128, 64)
+    return pick_block(s, ladder=ladder)
 
 
 def _block_step(carry, kv, *, scale, blk_k, causal, has_valid):

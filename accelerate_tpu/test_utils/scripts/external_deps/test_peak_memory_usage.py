@@ -72,6 +72,10 @@ class DeviceTracemalloc:
             self.peaked = b2mb(device_peak_end - self.device_begin)
         else:
             self.peaked = 0.0
+        # Lifetime high-water mark — the ceiling assert uses this so a spike
+        # BEFORE the first tracked block (e.g. during prepare/opt-state init)
+        # can't slip under the bound.
+        self.lifetime_peak = b2mb(device_peak_end)
         cpu_now, cpu_peak = tracemalloc.get_traced_memory()
         tracemalloc.stop()
         self.cpu_used = b2mb(cpu_now - self.cpu_begin)
@@ -111,7 +115,12 @@ def training_function(args) -> dict:
         accelerator.print(f"Memory before entering the train : {b2mb(tracemalloc_ctx.device_begin)}")
         accelerator.print(f"Memory consumed at the end of the train (end-begin): {tracemalloc_ctx.used}")
         accelerator.print(f"Peak Memory consumed during the train (max-begin): {tracemalloc_ctx.peaked}")
-        total = tracemalloc_ctx.peaked + b2mb(tracemalloc_ctx.device_begin)
+        # The bound is enforced on the LIFETIME high-water mark (prepare-time
+        # spikes count); the epoch-local 'peaked' above is attribution only.
+        total = max(
+            tracemalloc_ctx.peaked + b2mb(tracemalloc_ctx.device_begin),
+            tracemalloc_ctx.lifetime_peak,
+        )
         accelerator.print(f"Total Peak Memory consumed during the train (max): {total}")
         accelerator.print(
             f"CPU Memory consumed (end-begin): {tracemalloc_ctx.cpu_used}; "

@@ -301,3 +301,63 @@ def test_logger_log_level_env(monkeypatch, caplog):
         assert logger.logger.level == logging_mod.ERROR
     finally:
         logging_mod.root.setLevel(root_level)
+
+
+# -- reference tests/test_utils.py parity: find_device / check_os_kernel /
+# shared-memory save ----------------------------------------------------------
+
+
+def test_find_device():
+    """Reference test_find_device: first tensor's device in nested data."""
+    import jax
+    import torch
+
+    from accelerate_tpu.utils import find_device
+
+    t = torch.zeros(2)
+    assert find_device({"a": [t]}) == t.device
+    arr = jax.numpy.zeros(2)
+    assert find_device({"x": (arr,)}) in arr.devices()
+    assert find_device([1, "s"]) is None
+
+
+def test_check_os_kernel_warns_only_below_min(monkeypatch):
+    """Reference test_check_os_kernel_*: warn iff Linux and release < 5.5."""
+    import platform
+    import warnings as _warnings
+
+    from accelerate_tpu.utils.other import check_os_kernel
+
+    monkeypatch.setattr(platform, "system", lambda: "Linux")
+    monkeypatch.setattr(platform, "release", lambda: "5.4.0-generic")
+    with pytest.warns(UserWarning, match="5.4.0"):
+        check_os_kernel()
+
+    monkeypatch.setattr(platform, "release", lambda: "6.1.0")
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        check_os_kernel()
+
+    monkeypatch.setattr(platform, "system", lambda: "Darwin")
+    monkeypatch.setattr(platform, "release", lambda: "1.0.0")
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        check_os_kernel()
+
+
+def test_save_safetensor_shared_memory(tmp_path):
+    """Reference test_save_safetensor_shared_memory: tensors sharing storage
+    save cleanly through the safe-serialization path and load back equal."""
+    import torch
+
+    from accelerate_tpu.utils.other import load, save
+
+    base = torch.arange(8, dtype=torch.float32)
+    view = base[:4]  # shares storage with base
+    path = tmp_path / "shared.safetensors"
+    save({"base": base, "view": view}, path, safe_serialization=True)
+    back = load(path)
+    import numpy as np
+
+    np.testing.assert_array_equal(back["base"], base.numpy())
+    np.testing.assert_array_equal(back["view"], view.numpy())
