@@ -137,7 +137,18 @@ def add_hook_to_module(module, hook: ModelHook, append: bool = False):
             output = old_forward(*args, **kwargs)
         return module._hf_hook.post_forward(module, output)
 
-    module.forward = new_forward
+    # torch.fx GraphModules regenerate `forward` on the CLASS at recompile();
+    # an instance-level override would shadow every future recompile (freeze
+    # the graph — reference hooks.py:178).  Assign on the class there.
+    if "GraphModuleImpl" in str(type(module)):
+        # staticmethod: a plain function on the class would be a descriptor
+        # and re-bind the instance as a spurious first argument (new_forward
+        # already closes over `module`).  Remember the hooked forward so
+        # remove can tell whether a recompile() replaced it in the meantime.
+        module._accelerate_hooked_forward = new_forward
+        type(module).forward = staticmethod(new_forward)
+    else:
+        module.forward = new_forward
     return module
 
 
@@ -146,7 +157,18 @@ def remove_hook_from_module(module, recurse: bool = False):
         module._hf_hook.detach_hook(module)
         delattr(module, "_hf_hook")
     if hasattr(module, "_old_forward"):
-        module.forward = module._old_forward
+        if "GraphModuleImpl" in str(type(module)):
+            # Only restore if OUR hooked forward is still installed — a
+            # recompile() while hooked replaces the class forward with the
+            # edited graph's, which must survive removal.
+            current = type(module).__dict__.get("forward")
+            hooked = getattr(module, "_accelerate_hooked_forward", None)
+            if isinstance(current, staticmethod) and current.__func__ is hooked:
+                type(module).forward = module._old_forward
+            if hooked is not None:
+                delattr(module, "_accelerate_hooked_forward")
+        else:
+            module.forward = module._old_forward
         delattr(module, "_old_forward")
     if recurse:
         for child in module.children():

@@ -34,9 +34,10 @@ def pick_block(s: int, ladder: tuple = (512, 256, 128, 64)) -> Optional[int]:
     """Largest MXU-friendly block size from ``ladder`` dividing ``s`` (None
     when none does) — the single block-ladder used by the flash/pallas path
     pickers.  ``ACCELERATE_ATTN_BLOCK`` overrides when it is a positive
-    integer dividing ``s`` (tuning knob; see docs/performance.md for the
-    measured ladder — 1024 wins on the fused pallas path where VMEM allows,
-    512 elsewhere)."""
+    integer dividing ``s`` — an EXPERT knob applied verbatim on every
+    attention path (pallas/flash/ring), bypassing the ladder and the VMEM
+    head_dim guard; see docs/performance.md for the measured ladder (1024
+    wins on the fused pallas path where VMEM allows, 512 elsewhere)."""
     import os
 
     override = os.environ.get("ACCELERATE_ATTN_BLOCK")
@@ -60,9 +61,11 @@ def pick_block(s: int, ladder: tuple = (512, 256, 128, 64)) -> Optional[int]:
 def pick_block_pallas(s: int, head_dim: int) -> Optional[int]:
     """Block ladder for the fused Pallas kernel: prefers 1024 where the
     larger K/V tile fits VMEM (head_dim <= 128) — measured 0.6355 vs 0.6041
-    MFU at 512 on v5e b8/s2048 (docs/performance.md)."""
+    MFU at 512 on v5e b8/s2048 (docs/performance.md).  Short sequences
+    (s <= 1024) that no ladder entry divides run as ONE block, matching the
+    kernel's own acceptance."""
     ladder = (1024, 512, 256, 128, 64) if head_dim <= 128 else (512, 256, 128, 64)
-    return pick_block(s, ladder=ladder)
+    return pick_block(s, ladder=ladder) or (s if s <= 1024 else None)
 
 
 def _block_step(carry, kv, *, scale, blk_k, causal, has_valid):

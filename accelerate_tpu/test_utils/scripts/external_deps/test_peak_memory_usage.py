@@ -117,10 +117,7 @@ def training_function(args) -> dict:
         accelerator.print(f"Peak Memory consumed during the train (max-begin): {tracemalloc_ctx.peaked}")
         # The bound is enforced on the LIFETIME high-water mark (prepare-time
         # spikes count); the epoch-local 'peaked' above is attribution only.
-        total = max(
-            tracemalloc_ctx.peaked + b2mb(tracemalloc_ctx.device_begin),
-            tracemalloc_ctx.lifetime_peak,
-        )
+        total = tracemalloc_ctx.lifetime_peak
         accelerator.print(f"Total Peak Memory consumed during the train (max): {total}")
         accelerator.print(
             f"CPU Memory consumed (end-begin): {tracemalloc_ctx.cpu_used}; "

@@ -117,12 +117,13 @@ def _run(
 LADDER = [
     # Rung 0: the PROVEN path — 0.6355 MFU driver-verifiable on v5e with the
     # 1024 attention block (round 3; 0.6041 at block 512,
-    # BENCH_opportunistic.json; 0.5202 at block 256).  An unmeasured variant
+    # BENCH_opportunistic.json; 0.5202 at block 256; 2048 = one-block OOMs
+    # VMEM).  An unmeasured variant
     # must never shadow it (the ladder stops at the first success).  Later
     # rungs are conservative fallbacks (einsum attention, full remat) then
     # smaller models.  batch 8 measured +0.7 MFU points over batch 4 on v5e (0.604 vs
-    # 0.597); 10/12/16 fail to compile (HBM) with the dense loss; seq 4096 and
-    # flash both lose.  Chunked-vocab CE measured r3: b8 0.5863, b10 0.5790,
+    # 0.597); 10/12/16 fail to compile (HBM) with the dense loss; seq 4096
+    # reaches 0.6152 at b4/blk1024 (was worse at blk512) and flash loses.  Chunked-vocab CE measured r3: b8 0.5863, b10 0.5790,
     # b12/s4096 OOM — loses at every feasible shape here (see
     # docs/performance.md #5), so dense stays rung 0.  remat "nothing" at b8
     # also measured r3: 0.5711 — saving every activation costs more HBM

@@ -83,6 +83,12 @@ def test_flash_block_selection_and_validation():
     assert _flash_block(1088) == 64
     assert _flash_block(770) == 770  # single block, s <= 1024
     assert _flash_block(1090) is None  # prime-ish long seq -> einsum fallback
+    from accelerate_tpu.ops.flash_attention import pick_block_pallas
+
+    assert pick_block_pallas(2048, head_dim=128) == 1024  # measured-best on v5e
+    assert pick_block_pallas(2048, head_dim=256) == 512  # VMEM guard
+    assert pick_block_pallas(770, head_dim=128) == 770  # single-block fallback
+    assert pick_block_pallas(4096, head_dim=64) == 1024
     with pytest.raises(ValueError, match="attention_impl"):
         llama.LlamaConfig.tiny(attention_impl="Flash")
     with pytest.raises(ValueError, match="remat_policy"):

@@ -292,3 +292,68 @@ def test_align_hook_skip_keys_on_output():
     out = model(torch.randn(2, 3))
     assert set(out) == {"moved", "kept"}
     remove_hook_from_module(model)
+
+
+def test_no_grad_in_hook():
+    """Reference test_no_grad_in_hook: hook.no_grad=True runs the wrapped
+    forward under torch.no_grad, so outputs stop requiring grad."""
+    model = _linear()
+    hook = ScaleInputHook()
+    add_hook_to_module(model, hook)
+    x = torch.randn(2, 3)
+    out = model(x)
+    assert out.requires_grad
+    hook.no_grad = True
+    out = model(x)
+    assert not out.requires_grad
+
+
+def test_add_remove_hook_fx_graph_module():
+    """Reference test_add_remove_hook_fx_graph_module: hooks attach/detach on
+    a torch.fx GraphModule and leave it editable (not frozen) afterwards."""
+    from torch.fx import symbolic_trace
+
+    with torch.no_grad():
+        model = _linear()
+        x = torch.randn(2, 3)
+        out1 = model(x)
+        graph_model = symbolic_trace(model)
+        torch.testing.assert_close(graph_model(x), out1)
+
+        log = []
+        add_hook_to_module(graph_model, RecordingHook("g", log))
+        graph_model(x)
+        assert log == ["g:pre", "g:post"]
+        remove_hook_from_module(graph_model, recurse=True)
+
+        # The graph must remain editable: append a sigmoid node and recompile.
+        output_node = next(n for n in graph_model.graph.nodes if n.op == "output")
+        (prev,) = output_node.args
+        with graph_model.graph.inserting_before(output_node):
+            sig = graph_model.graph.call_function(torch.sigmoid, args=(prev,))
+        output_node.args = (sig,)
+        graph_model.recompile()
+        torch.testing.assert_close(graph_model(x), torch.sigmoid(out1))
+
+
+def test_fx_recompile_while_hooked_survives_removal():
+    """A graph edited + recompiled WHILE hooked keeps the edited forward after
+    remove_hook_from_module (the stale pre-hook forward must not come back)."""
+    from torch.fx import symbolic_trace
+
+    with torch.no_grad():
+        model = _linear()
+        x = torch.randn(2, 3)
+        out1 = model(x)
+        graph_model = symbolic_trace(model)
+        add_hook_to_module(graph_model, ScaleInputHook())
+
+        output_node = next(n for n in graph_model.graph.nodes if n.op == "output")
+        (prev,) = output_node.args
+        with graph_model.graph.inserting_before(output_node):
+            sig = graph_model.graph.call_function(torch.sigmoid, args=(prev,))
+        output_node.args = (sig,)
+        graph_model.recompile()  # replaces the hooked class forward
+
+        remove_hook_from_module(graph_model, recurse=True)
+        torch.testing.assert_close(graph_model(x), torch.sigmoid(out1))
