@@ -118,6 +118,16 @@ def add_hook_to_module(module, hook: ModelHook, append: bool = False):
 
     if hasattr(module, "_hf_hook") and hasattr(module, "_old_forward"):
         old_forward = module._old_forward
+        if "GraphModuleImpl" in str(type(module)):
+            # A recompile() while hooked replaced the class forward with the
+            # edited graph's; wrap THAT, not the stale pre-edit capture.
+            current = type(module).__dict__.get("forward")
+            hooked = getattr(module, "_accelerate_hooked_forward", None)
+            if current is not None and not (
+                isinstance(current, staticmethod) and current.__func__ is hooked
+            ):
+                old_forward = current.__get__(module, type(module))
+                module._old_forward = old_forward
     else:
         old_forward = module.forward
         module._old_forward = old_forward

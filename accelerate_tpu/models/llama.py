@@ -278,10 +278,10 @@ def _attention(q, k, v, mask, num_groups: int):
 
 def _flash_block(s: int):
     """Largest MXU-friendly block dividing ``s`` (None -> einsum fallback);
-    short sequences run as one block."""
+    short sequences (<= 1024) run as one block."""
     from ..ops.flash_attention import pick_block
 
-    return pick_block(s) or (s if s <= 1024 else None)
+    return pick_block(s, max_single_block=1024)
 
 
 def _use_pallas(c: "LlamaConfig", s: int, b: int, h: int, kh: int) -> bool:

@@ -30,7 +30,9 @@ import jax.numpy as jnp
 __all__ = ["flash_attention", "pick_block"]
 
 
-def pick_block(s: int, ladder: tuple = (512, 256, 128, 64)) -> Optional[int]:
+def pick_block(
+    s: int, ladder: tuple = (512, 256, 128, 64), max_single_block: int = 0
+) -> Optional[int]:
     """Largest MXU-friendly block size from ``ladder`` dividing ``s`` (None
     when none does) — the single block-ladder used by the flash/pallas path
     pickers.  ``ACCELERATE_ATTN_BLOCK`` overrides when it is a positive
@@ -55,17 +57,21 @@ def pick_block(s: int, ladder: tuple = (512, 256, 128, 64)) -> Optional[int]:
     for b in ladder:
         if s % b == 0:
             return b
+    # Short sequences that no ladder entry divides run as ONE block, up to
+    # the caller's cap (0 disables the fallback).
+    if 0 < s <= max_single_block:
+        return s
     return None
 
 
 def pick_block_pallas(s: int, head_dim: int) -> Optional[int]:
     """Block ladder for the fused Pallas kernel: prefers 1024 where the
     larger K/V tile fits VMEM (head_dim <= 128) — measured 0.6355 vs 0.6041
-    MFU at 512 on v5e b8/s2048 (docs/performance.md).  Short sequences
-    (s <= 1024) that no ladder entry divides run as ONE block, matching the
-    kernel's own acceptance."""
+    MFU at 512 on v5e b8/s2048 (docs/performance.md).  The single-block
+    fallback for short sequences is capped at the same VMEM-guarded ladder
+    maximum."""
     ladder = (1024, 512, 256, 128, 64) if head_dim <= 128 else (512, 256, 128, 64)
-    return pick_block(s, ladder=ladder) or (s if s <= 1024 else None)
+    return pick_block(s, ladder=ladder, max_single_block=ladder[0])
 
 
 def _block_step(carry, kv, *, scale, blk_k, causal, has_valid):

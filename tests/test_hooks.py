@@ -357,3 +357,31 @@ def test_fx_recompile_while_hooked_survives_removal():
 
         remove_hook_from_module(graph_model, recurse=True)
         torch.testing.assert_close(graph_model(x), torch.sigmoid(out1))
+
+
+def test_fx_rehook_after_recompile_wraps_edited_graph():
+    """Replacing the hook AFTER a mid-hook recompile must wrap the edited
+    graph, not the stale pre-edit forward captured at first attach."""
+    from torch.fx import symbolic_trace
+
+    with torch.no_grad():
+        model = _linear()
+        x = torch.randn(2, 3)
+        out1 = model(x)
+        graph_model = symbolic_trace(model)
+        add_hook_to_module(graph_model, ModelHook())
+
+        output_node = next(n for n in graph_model.graph.nodes if n.op == "output")
+        (prev,) = output_node.args
+        with graph_model.graph.inserting_before(output_node):
+            sig = graph_model.graph.call_function(torch.sigmoid, args=(prev,))
+        output_node.args = (sig,)
+        graph_model.recompile()
+
+        add_hook_to_module(graph_model, ScaleInputHook())  # replace path
+        # pre doubles input, post adds one — applied to the EDITED graph.
+        torch.testing.assert_close(
+            graph_model(x), torch.sigmoid(model(x * 2)) + 1
+        )
+        remove_hook_from_module(graph_model, recurse=True)
+        torch.testing.assert_close(graph_model(x), torch.sigmoid(out1))
