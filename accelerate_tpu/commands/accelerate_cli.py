@@ -15,10 +15,16 @@ from . import tpu as tpu_cmd
 
 
 def main():
+    from ._parser import DualDashParser
+
     parser = argparse.ArgumentParser(
         "accelerate-tpu", usage="accelerate-tpu <command> [<args>]", allow_abbrev=False
     )
-    subparsers = parser.add_subparsers(help="accelerate-tpu command helpers", dest="command")
+    # Every subcommand parser accepts --foo-bar alongside --foo_bar
+    # (reference commands/utils.py CustomArgumentParser semantics).
+    subparsers = parser.add_subparsers(
+        help="accelerate-tpu command helpers", dest="command", parser_class=DualDashParser
+    )
     config_cmd.register_subcommand(subparsers)
     env_cmd.register_subcommand(subparsers)
     launch_cmd.register_subcommand(subparsers)

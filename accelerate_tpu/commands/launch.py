@@ -88,10 +88,12 @@ def _flag_bool(value) -> bool:
 
 
 def launch_command_parser(subparsers=None):
+    from ._parser import DualDashParser
+
     if subparsers is not None:
         parser = subparsers.add_parser("launch", help="Launch a training script on TPU hosts")
     else:
-        parser = argparse.ArgumentParser("accelerate-tpu launch")
+        parser = DualDashParser("accelerate-tpu launch")
     # Hardware / topology (reference "Hardware Selection"/"Resource Selection")
     parser.add_argument("--config_file", default=None)
     parser.add_argument("--num_machines", type=int, default=None, help="Number of hosts")

@@ -67,11 +67,13 @@ def pick_block(
 def pick_block_pallas(s: int, head_dim: int) -> Optional[int]:
     """Block ladder for the fused Pallas kernel: prefers 1024 where the
     larger K/V tile fits VMEM (head_dim <= 128) — measured 0.6355 vs 0.6041
-    MFU at 512 on v5e b8/s2048 (docs/performance.md).  The single-block
-    fallback for short sequences is capped at the same VMEM-guarded ladder
-    maximum."""
+    MFU at 512 on v5e b8/s2048 (docs/performance.md).  Short sequences
+    (s <= 1024) that no ladder entry divides run as ONE block at any
+    head_dim — a single <=1024 block is within the tile budget the ladder
+    guard protects (the guard is about GRID blocks of 1024 at large
+    head_dim), and matches the kernel's own acceptance."""
     ladder = (1024, 512, 256, 128, 64) if head_dim <= 128 else (512, 256, 128, 64)
-    return pick_block(s, ladder=ladder, max_single_block=ladder[0])
+    return pick_block(s, ladder=ladder, max_single_block=1024)
 
 
 def _block_step(carry, kv, *, scale, blk_k, causal, has_valid):

@@ -180,3 +180,23 @@ def test_notebook_launcher_max_restarts():
     calls["n"] = 0
     with pytest.raises(RuntimeError, match="transient"):
         notebook_launcher(flaky, num_processes=1, max_restarts=1)
+
+
+def test_hyphen_and_underscore_flags_equivalent():
+    """Reference tests/test_cli.py test_hyphen/test_underscore: every
+    --foo_bar flag is also accepted as --foo-bar, mixed freely."""
+    parser = launch_command_parser()
+    a = parser.parse_args(
+        ["--num-processes", "4", "--mixed-precision", "bf16", "--use-fsdp", "t.py"]
+    )
+    b = parser.parse_args(
+        ["--num_processes", "4", "--mixed_precision", "bf16", "--use_fsdp", "t.py"]
+    )
+    c = parser.parse_args(  # mix of both spellings
+        ["--num-processes", "4", "--mixed_precision", "bf16", "--use-fsdp", "t.py"]
+    )
+    for args in (a, b, c):
+        assert args.num_processes == 4
+        assert args.mixed_precision == "bf16"
+        assert args.use_fsdp
+        assert args.training_script == "t.py"

@@ -88,6 +88,7 @@ def test_flash_block_selection_and_validation():
     assert pick_block_pallas(2048, head_dim=128) == 1024  # measured-best on v5e
     assert pick_block_pallas(2048, head_dim=256) == 512  # VMEM guard
     assert pick_block_pallas(770, head_dim=128) == 770  # single-block fallback
+    assert pick_block_pallas(770, head_dim=256) == 770  # fallback at any head_dim
     assert pick_block_pallas(4096, head_dim=64) == 1024
     with pytest.raises(ValueError, match="attention_impl"):
         llama.LlamaConfig.tiny(attention_impl="Flash")
