@@ -291,6 +291,8 @@ def load_checkpoint_and_dispatch(
     preload_module_classes=None,
     force_hooks: bool = False,
     strict: bool = False,
+    full_state_dict: bool = True,
+    broadcast_from_rank0: bool = False,
 ):
     """One-call load + plan + dispatch (reference ``big_modeling.py:512``)."""
     if isinstance(device_map, str):
@@ -317,6 +319,8 @@ def load_checkpoint_and_dispatch(
         offload_folder=offload_folder,
         dtype=dtype,
         strict=strict,
+        full_state_dict=full_state_dict,
+        broadcast_from_rank0=broadcast_from_rank0,
     )
     if device_map is None:
         return model

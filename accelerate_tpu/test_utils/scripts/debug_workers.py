@@ -51,6 +51,68 @@ def run_data_loop_suite(expected: int):
     PartialState().wait_for_everyone()
 
 
+def check_broadcast_checkpoint_load(expected: int):
+    """load_checkpoint_in_model(broadcast_from_rank0=True): only rank 0 reads
+    from disk — other ranks pass a NONEXISTENT path and still end up with
+    rank-0's weights (reference
+    tests/test_load_checkpoint_and_dispatch_with_broadcast.py)."""
+    import tempfile
+
+    import torch
+
+    from accelerate_tpu.checkpointing import save_model_weights
+    from accelerate_tpu.state import PartialState
+    from accelerate_tpu.utils import gather_object
+    from accelerate_tpu.utils.modeling import load_checkpoint_in_model
+
+    state = PartialState()
+    assert state.num_processes == expected
+    torch.manual_seed(100 + state.process_index)  # divergent init per rank
+    model = torch.nn.Linear(4, 4)
+
+    if state.is_main_process:
+        ckpt_dir = tempfile.mkdtemp()
+        torch.manual_seed(7)
+        ref = torch.nn.Linear(4, 4)
+        save_model_weights(ref, ckpt_dir)
+    else:
+        ckpt_dir = "/nonexistent/rank-local/never-read"
+    load_checkpoint_in_model(model, ckpt_dir, broadcast_from_rank0=True)
+
+    flat = model.weight.detach().numpy().ravel().tolist()
+    gathered = gather_object([flat])
+    assert len(gathered) == expected
+    for other in gathered[1:]:
+        assert other == gathered[0], "ranks diverged after broadcast load"
+    torch.manual_seed(7)
+    expected_ref = torch.nn.Linear(4, 4)
+    assert np.allclose(flat, expected_ref.weight.detach().numpy().ravel()), (
+        "broadcast weights do not match rank-0's checkpoint"
+    )
+    state.wait_for_everyone()
+
+
+def check_broadcast_load_rank0_failure(expected: int):
+    """A rank-0 read failure under broadcast_from_rank0 raises on EVERY rank
+    (sentinel-first protocol) instead of deadlocking the followers."""
+    import torch
+
+    from accelerate_tpu.state import PartialState
+    from accelerate_tpu.utils.modeling import load_checkpoint_in_model
+
+    state = PartialState()
+    model = torch.nn.Linear(2, 2)
+    try:
+        load_checkpoint_in_model(
+            model, "/nonexistent/everywhere", broadcast_from_rank0=True
+        )
+    except RuntimeError as e:
+        assert "rank 0 failed" in str(e), e
+    else:
+        raise AssertionError("expected a cross-rank RuntimeError")
+    state.wait_for_everyone()
+
+
 def check_split_between_processes(expected: int):
     from accelerate_tpu.state import PartialState
 

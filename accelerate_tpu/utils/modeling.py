@@ -462,6 +462,46 @@ def check_device_map(model, device_map: dict) -> None:
             raise ValueError(f"device_map does not cover parameter {name}")
 
 
+def _rank0_broadcast(state, fn, what: str):
+    """Run ``fn()`` on the main process and broadcast the result.  The
+    sentinel-first protocol turns a rank-0 failure (bad path, corrupt shard)
+    into a clean RuntimeError on EVERY rank instead of deadlocking followers
+    inside the collective."""
+    from .operations import broadcast_object_list
+
+    payload = [None]
+    if state.is_main_process:
+        try:
+            payload = [("ok", fn())]
+        except Exception as e:  # noqa: BLE001 — forwarded to every rank
+            payload = [("error", f"{type(e).__name__}: {e}")]
+    broadcast_object_list(payload, from_process=0)
+    status, value = payload[0]
+    if status == "error":
+        raise RuntimeError(f"rank 0 failed while {what}: {value}")
+    return value
+
+
+class _StreamedShard:
+    """items() view over one checkpoint shard that broadcasts tensors from
+    rank 0 one at a time (peak per-rank memory = one tensor)."""
+
+    def __init__(self, state, shard, keys, file):
+        self._state = state
+        self._shard = shard  # {"sd": dict-on-rank0-or-None}
+        self._keys = keys
+        self._file = file
+
+    def items(self):
+        for k in self._keys:
+            value = _rank0_broadcast(
+                self._state,
+                lambda k=k: self._shard["sd"][k],
+                f"broadcasting {k} from {self._file}",
+            )
+            yield k, value
+
+
 def load_checkpoint_in_model(
     model,
     checkpoint: str,
@@ -471,24 +511,64 @@ def load_checkpoint_in_model(
     offload_state_dict: bool = False,
     offload_buffers: bool = False,
     strict: bool = False,
+    full_state_dict: bool = True,
+    broadcast_from_rank0: bool = False,
 ) -> None:
     """Stream checkpoint shards into the model per device-map target.
 
     Parity: reference ``utils/modeling.py:1783-2043`` — supports a single
     ``.safetensors``/``.bin`` file, a sharded index json, or a folder; "disk"
-    targets go to ``offload_folder`` memmaps.
+    targets go to ``offload_folder`` memmaps.  With ``broadcast_from_rank0``
+    (reference ``tests/test_load_checkpoint_and_dispatch_with_broadcast.py``)
+    only the main process reads from disk; shard contents are broadcast to
+    every other process, which never touches its own ``checkpoint`` path.
+    ``full_state_dict=False`` (per-rank sharded torch-dist checkpoints) has
+    no torch-side meaning here — sharded loads are orbax
+    (``checkpointing.load_sharded_model``).
     """
     from ..hooks import set_module_tensor_to_device
     from .offload import offload_weight, save_offload_index
 
-    files = _checkpoint_files(checkpoint)
+    if not full_state_dict:
+        raise ValueError(
+            "full_state_dict=False (per-rank torch-dist shards) is not a TPU-side "
+            "format; sharded checkpoints load via orbax "
+            "(accelerate_tpu.checkpointing.load_sharded_model)."
+        )
+
+    bcast_state = None
+    if broadcast_from_rank0:
+        from ..state import PartialState
+
+        state = PartialState()
+        if state.num_processes > 1:
+            bcast_state = state
+
+    if bcast_state is not None:
+        files = _rank0_broadcast(
+            bcast_state, lambda: _checkpoint_files(checkpoint), "listing checkpoint files"
+        )
+    else:
+        files = _checkpoint_files(checkpoint)
     offload_index: dict = {}
     if offload_folder is not None:
         os.makedirs(offload_folder, exist_ok=True)
 
     unexpected_keys: list[str] = []
     for file in files:
-        state_dict = _load_state_dict(file)
+        if bcast_state is not None:
+            # Stream tensor-by-tensor so peak memory per rank stays one
+            # tensor, not several copies of a whole (possibly 10GB) shard.
+            shard = {"sd": None}
+
+            def _read_keys(shard=shard, file=file):
+                shard["sd"] = _load_state_dict(file)
+                return list(shard["sd"].keys())
+
+            keys = _rank0_broadcast(bcast_state, _read_keys, f"reading {file}")
+            state_dict = _StreamedShard(bcast_state, shard, keys, file)
+        else:
+            state_dict = _load_state_dict(file)
         for name, value in state_dict.items():
             target = _target_for(name, device_map)
             if dtype is not None:

@@ -200,3 +200,27 @@ def test_hyphen_and_underscore_flags_equivalent():
         assert args.mixed_precision == "bf16"
         assert args.use_fsdp
         assert args.training_script == "t.py"
+
+
+@pytest.mark.slow
+def test_broadcast_checkpoint_load_on_two_process_cluster():
+    """Rank-0-only checkpoint reads: load_checkpoint_in_model with
+    broadcast_from_rank0=True across two OS processes — non-main ranks pass a
+    nonexistent path and still receive rank-0's weights (reference
+    tests/test_load_checkpoint_and_dispatch_with_broadcast.py)."""
+    code = (
+        "from accelerate_tpu.launchers import debug_launcher;"
+        "from accelerate_tpu.test_utils.scripts.debug_workers import ("
+        "check_broadcast_checkpoint_load, check_broadcast_load_rank0_failure);"
+        "debug_launcher(check_broadcast_checkpoint_load, args=(2,), num_processes=2);"
+        "debug_launcher(check_broadcast_load_rank0_failure, args=(2,), num_processes=2);"
+        "print('BROADCAST_LOAD_OK')"
+    )
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, timeout=300, cwd="/root/repo", env=env
+    )
+    assert res.returncode == 0, res.stderr[-3000:]
+    assert "BROADCAST_LOAD_OK" in res.stdout

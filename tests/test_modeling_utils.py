@@ -849,3 +849,34 @@ def test_tied_group_colocation_respects_budget():
         clean_result=False,
     )
     assert dm["emb"] == dm["head"] == "cpu", dm
+
+
+def test_load_checkpoint_full_state_dict_false_raises(tmp_path):
+    """full_state_dict=False is a torch-dist sharded format with no TPU-side
+    meaning; the error points at the orbax path."""
+    import torch
+
+    from accelerate_tpu.checkpointing import save_model_weights
+
+    from accelerate_tpu.utils.modeling import load_checkpoint_in_model
+
+    m = torch.nn.Linear(2, 2)
+    save_model_weights(m, str(tmp_path))
+    with pytest.raises(ValueError, match="orbax"):
+        load_checkpoint_in_model(m, str(tmp_path), full_state_dict=False)
+
+
+def test_load_checkpoint_broadcast_single_process(tmp_path):
+    """broadcast_from_rank0=True on one process degenerates to a plain read."""
+    import torch
+
+    from accelerate_tpu.checkpointing import save_model_weights
+
+    from accelerate_tpu.utils.modeling import load_checkpoint_in_model
+
+    torch.manual_seed(3)
+    ref = torch.nn.Linear(3, 3)
+    save_model_weights(ref, str(tmp_path))
+    model = torch.nn.Linear(3, 3)
+    load_checkpoint_in_model(model, str(tmp_path), broadcast_from_rank0=True)
+    torch.testing.assert_close(model.weight, ref.weight)
