@@ -123,8 +123,9 @@ LADDER = [
     # rungs are conservative fallbacks (einsum attention, full remat) then
     # smaller models.  batch 8 measured +0.7 MFU points over batch 4 on v5e (0.604 vs
     # 0.597); 10/12/16 fail to compile (HBM) with the dense loss; seq 4096
-    # reaches 0.6152 at b4/blk1024 (was worse at blk512) and flash loses.  Chunked-vocab CE measured r3: b8 0.5863, b10 0.5790,
-    # b12/s4096 OOM — loses at every feasible shape here (see
+    # reaches 0.6152 at b4/blk1024 (was worse at blk512) and flash loses.
+    # Chunked-vocab CE measured r3: b8 0.5863 / b10 0.5790 at blk512, 0.6161
+    # at b8/blk1024; b12/s4096 OOM — loses at every feasible shape here (see
     # docs/performance.md #5), so dense stays rung 0.  remat "nothing" at b8
     # also measured r3: 0.5711 — saving every activation costs more HBM
     # traffic than "dots" recomputes.
