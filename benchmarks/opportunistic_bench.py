@@ -153,12 +153,22 @@ def main() -> None:
             except subprocess.TimeoutExpired as e:
                 stdout, rc = e.stdout, -1
                 _log(args.log, {"bench": "big_model", "timeout_s": 1800})
-            big = _last_json_line(stdout)
-            if big is not None:
+            # The bench prints ONE JSON line PER TIER (resident/cpu/disk) —
+            # keep them all as JSONL; writing only the last line would clobber
+            # the table down to one row.
+            tiers = []
+            for line in (stdout or "").splitlines():
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        tiers.append(json.loads(line))
+                    except ValueError:
+                        continue
+            if tiers:
                 with open(os.path.join(REPO, "BENCH_big_model.json"), "w") as f:
-                    json.dump(big, f, indent=1)
-                    f.write("\n")
-            results["big_model"] = rc == 0 and big is not None
+                    for tier in tiers:
+                        f.write(json.dumps(tier) + "\n")
+            results["big_model"] = rc == 0 and bool(tiers)
             _log(args.log, {"attempt": attempt, "bench_results": results})
             if results["ladder"]:
                 return  # headline number captured; artifacts are on disk
