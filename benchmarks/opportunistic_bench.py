@@ -45,22 +45,28 @@ def _log(path: str, record: dict) -> None:
     print(json.dumps(record), flush=True)
 
 
-def _last_json_line(stdout: str | bytes | None):
-    """Scan stdout from the end for the last parseable JSON line (tolerant of
-    spurious brace-prefixed library output, same contract as bench.py's
-    rung-subprocess parser)."""
+def _json_lines(stdout: str | bytes | None) -> list:
+    """All parseable JSON lines in stdout, in order (tolerant of spurious
+    brace-prefixed library output and of TimeoutExpired's undecoded bytes —
+    same contract as bench.py's rung-subprocess parser)."""
     if stdout is None:
-        return None
+        return []
     if isinstance(stdout, bytes):
         stdout = stdout.decode(errors="replace")
-    for line in reversed(stdout.splitlines()):
+    out = []
+    for line in stdout.splitlines():
         line = line.strip()
         if line.startswith("{"):
             try:
-                return json.loads(line)
+                out.append(json.loads(line))
             except ValueError:
                 continue
-    return None
+    return out
+
+
+def _last_json_line(stdout: str | bytes | None):
+    lines = _json_lines(stdout)
+    return lines[-1] if lines else None
 
 
 def _run_bench(
@@ -140,35 +146,35 @@ def main() -> None:
                 "chunked",
                 require_rung_substr="chunked",
             )
-            stdout = None
-            try:
-                proc = subprocess.run(
-                    [sys.executable, os.path.join(REPO, "benchmarks", "big_model_inference_bench.py")],
-                    capture_output=True,
-                    text=True,
-                    timeout=1800,
-                    cwd=REPO,
-                )
-                stdout, rc = proc.stdout, proc.returncode
-            except subprocess.TimeoutExpired as e:
-                stdout, rc = e.stdout, -1
-                _log(args.log, {"bench": "big_model", "timeout_s": 1800})
-            # The bench prints ONE JSON line PER TIER (resident/cpu/disk) —
-            # keep them all as JSONL; writing only the last line would clobber
-            # the table down to one row.
-            tiers = []
-            for line in (stdout or "").splitlines():
-                line = line.strip()
-                if line.startswith("{"):
-                    try:
-                        tiers.append(json.loads(line))
-                    except ValueError:
-                        continue
-            if tiers:
+            # The bench prints ONE JSON line PER TIER (resident/cpu/disk);
+            # run BOTH table configs and keep every row as JSONL with a
+            # config tag — mirroring the committed artifact's shape, so a
+            # refresh never degrades the docs table.
+            all_tiers = []
+            big_ok = True
+            for config, extra in (("d512/L8", []), ("d1024/L16", ["--hidden", "1024", "--layers", "16"])):
+                try:
+                    proc = subprocess.run(
+                        [sys.executable, os.path.join(REPO, "benchmarks", "big_model_inference_bench.py"), *extra],
+                        capture_output=True,
+                        text=True,
+                        timeout=1800,
+                        cwd=REPO,
+                    )
+                    stdout, rc = proc.stdout, proc.returncode
+                except subprocess.TimeoutExpired as e:
+                    stdout, rc = e.stdout, -1
+                    _log(args.log, {"bench": "big_model", "config": config, "timeout_s": 1800})
+                tiers = _json_lines(stdout)
+                for tier in tiers:
+                    tier.setdefault("config", config)
+                all_tiers.extend(tiers)
+                big_ok = big_ok and rc == 0 and bool(tiers)
+            if all_tiers:
                 with open(os.path.join(REPO, "BENCH_big_model.json"), "w") as f:
-                    for tier in tiers:
+                    for tier in all_tiers:
                         f.write(json.dumps(tier) + "\n")
-            results["big_model"] = rc == 0 and bool(tiers)
+            results["big_model"] = big_ok
             _log(args.log, {"attempt": attempt, "bench_results": results})
             if results["ladder"]:
                 return  # headline number captured; artifacts are on disk
