@@ -66,7 +66,7 @@ def pick_block(
 
 def pick_block_pallas(s: int, head_dim: int) -> Optional[int]:
     """Block ladder for the fused Pallas kernel: prefers 1024 where the
-    larger K/V tile fits VMEM (head_dim <= 128) — measured 0.6355 vs 0.6041
+    larger K/V tile fits VMEM (head_dim <= 128) — measured 0.6353 vs 0.6041
     MFU at 512 on v5e b8/s2048 (docs/performance.md).  Short sequences
     (s <= 1024) that no ladder entry divides run as ONE block at any
     head_dim — a single <=1024 block is within the tile budget the ladder

@@ -41,6 +41,7 @@ def _run(
     attention_impl: str = "flash",
     remat_policy: str = "dots",
     loss_impl: str = "dense",
+    param_dtype: str = "f32",
 ):
     import jax
     import jax.numpy as jnp
@@ -65,6 +66,11 @@ def _run(
         # "chunked" streams the LM-head loss over vocab tiles — removes the
         # [B,S,32000] fp32 logits (+cotangent) HBM spike entirely.
         loss_impl=loss_impl,
+        # "bf16" = pure bf16 params, no fp32 master (the reference's
+        # downcast_bf16 TPU semantics): halves param/grad HBM traffic —
+        # measured +2.8 MFU points on v5e.  AdamW moments follow the param
+        # dtype; fp32-master rungs below are the precision-conservative path.
+        param_dtype=jnp.bfloat16 if param_dtype == "bf16" else jnp.float32,
     )
     params = llama.init_params(cfg, jax.random.key(0))
     tx = optax.adamw(1e-4)
@@ -115,13 +121,17 @@ def _run(
 
 
 LADDER = [
-    # Rung 0: the PROVEN path — 0.6355 MFU driver-verifiable on v5e with the
-    # 1024 attention block (round 3; 0.6041 at block 512,
-    # BENCH_opportunistic.json; 0.5202 at block 256; 2048 = one-block OOMs
-    # VMEM).  An unmeasured variant
-    # must never shadow it (the ladder stops at the first success).  Later
-    # rungs are conservative fallbacks (einsum attention, full remat) then
-    # smaller models.  batch 8 measured +0.7 MFU points over batch 4 on v5e (0.604 vs
+    # Rung 0: pure-bf16 params (reference downcast_bf16 TPU semantics) —
+    # 0.6632 MFU measured r3 on v5e; halved param/grad HBM traffic is worth
+    # +2.8 points over the fp32-master rung.  Rung 1: the fp32-master path —
+    # 0.6353 MFU driver-verifiable with the 1024 attention block (0.6041 at
+    # block 512, BENCH_opportunistic.json; 0.5202 at block 256; 2048 =
+    # one-block OOMs VMEM).  An unmeasured variant must never shadow a proven
+    # one (the ladder stops at the first success).  Later rungs are
+    # conservative fallbacks (einsum attention, full remat) then smaller
+    # models.
+    ("llama-509m", 2048, 6, 8192, 8, 2048, "pallas", "dots", "dense", "bf16"),
+    # batch 8 measured +0.7 MFU points over batch 4 on v5e (0.604 vs
     # 0.597); 10/12/16 fail to compile (HBM) with the dense loss; seq 4096
     # reaches 0.6152 at b4/blk1024 (was worse at blk512) and flash loses.
     # Chunked-vocab CE measured r3: b8 0.5863 / b10 0.5790 at blk512, 0.6161
@@ -235,7 +245,8 @@ def main():
         rung = LADDER[idx]
         name, d, layers, f, b, s, impl, policy = rung[:8]
         loss_impl = rung[8] if len(rung) > 8 else "dense"
-        print(json.dumps(_run(name, d, layers, f, b, s, impl, policy, loss_impl)))
+        param_dtype = rung[9] if len(rung) > 9 else "f32"
+        print(json.dumps(_run(name, d, layers, f, b, s, impl, policy, loss_impl, param_dtype)))
         return
 
     # Fast-fail (then retry, bounded) when the device backend is unreachable
@@ -268,6 +279,8 @@ def main():
         name, _, _, _, batch, seq, impl, policy = rung[:8]
         if len(rung) > 8:
             policy = f"{policy}/{rung[8]}"
+        if len(rung) > 9:
+            policy = f"{policy}/{rung[9]}"
         result, err = _run_rung_subprocess(i, timeout_s=480)
         # Per-rung emission: a later crash can no longer zero the round — the
         # outcome of every attempted rung is in the final JSON and on stderr.
