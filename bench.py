@@ -121,15 +121,16 @@ def _run(
 
 
 LADDER = [
-    # Rung 0: pure-bf16 params (reference downcast_bf16 TPU semantics) —
-    # 0.6632 MFU measured r3 on v5e; halved param/grad HBM traffic is worth
-    # +2.8 points over the fp32-master rung.  Rung 1: the fp32-master path —
-    # 0.6353 MFU driver-verifiable with the 1024 attention block (0.6041 at
-    # block 512, BENCH_opportunistic.json; 0.5202 at block 256; 2048 =
-    # one-block OOMs VMEM).  An unmeasured variant must never shadow a proven
-    # one (the ladder stops at the first success).  Later rungs are
-    # conservative fallbacks (einsum attention, full remat) then smaller
-    # models.
+    # Rung 0: pure-bf16 params (reference downcast_bf16 TPU semantics) at the
+    # batch the freed HBM admits — 0.6757 MFU measured r3 on v5e at b10
+    # (b8 0.6632, b12 0.6644; fp32-master can't fit b10).  Rung 1: b8 bf16.
+    # Rung 2: the fp32-master path — 0.6353 MFU driver-verifiable with the
+    # 1024 attention block (0.6041 at block 512, BENCH_opportunistic.json;
+    # 0.5202 at block 256; 2048 = one-block OOMs VMEM).  An unmeasured
+    # variant must never shadow a proven one (the ladder stops at the first
+    # success).  Later rungs are conservative fallbacks (einsum attention,
+    # full remat) then smaller models.
+    ("llama-509m", 2048, 6, 8192, 10, 2048, "pallas", "dots", "dense", "bf16"),
     ("llama-509m", 2048, 6, 8192, 8, 2048, "pallas", "dots", "dense", "bf16"),
     # batch 8 measured +0.7 MFU points over batch 4 on v5e (0.604 vs
     # 0.597); 10/12/16 fail to compile (HBM) with the dense loss; seq 4096

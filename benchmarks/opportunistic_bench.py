@@ -133,9 +133,10 @@ def main() -> None:
         _log(args.log, {"attempt": attempt, "ok": ok, "detail": detail})
         if ok:
             results = {}
-            # Worst case for the ladder: 240s probe window + 7 rungs x 480s
-            # = ~3600s (8 rungs under BENCH_TRY_CHUNKED: ~4080s); keep real
-            # margin above the all-rungs-fail case when adding rungs.
+            # Worst case for the ladder: 240s probe window + 8 rungs x 480s
+            # = ~4080s (9 rungs under BENCH_TRY_CHUNKED: ~4560s); the 5400s
+            # budget leaves ~840s margin in the chunked all-fail case —
+            # re-derive BOTH numbers when adding rungs.
             results["ladder"] = _run_bench(
                 {}, os.path.join(REPO, "BENCH_opportunistic.json"), 5400, args.log, "ladder"
             )
