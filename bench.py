@@ -136,8 +136,9 @@ LADDER = [
     # 0.597); 10/12/16 fail to compile (HBM) with the dense loss; seq 4096
     # reaches 0.6152 at b4/blk1024 (was worse at blk512) and flash loses.
     # Chunked-vocab CE measured r3: b8 0.5863 / b10 0.5790 at blk512, 0.6161
-    # at b8/blk1024; b12/s4096 OOM — loses at every feasible shape here (see
-    # docs/performance.md #5), so dense stays rung 0.  remat "nothing" at b8
+    # at b8/blk1024; b12/s4096 OOM, and b16/chunked/bf16 also OOMs — loses at
+    # every feasible shape here (see docs/performance.md #5), so dense stays
+    # the winning loss impl.  remat "nothing" at b8
     # also measured r3: 0.5711 — saving every activation costs more HBM
     # traffic than "dots" recomputes.
     ("llama-509m", 2048, 6, 8192, 8, 2048, "pallas", "dots", "dense"),
