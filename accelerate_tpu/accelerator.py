@@ -683,6 +683,122 @@ class Accelerator:
     def on_process(self, func=None, process_index=None):
         return self.state.on_process(func, process_index)
 
+    def on_last_process(self, func):
+        """Run only on the last process (reference ``accelerator.py:930``)."""
+        return self.state.on_last_process(func)
+
+    def on_local_process(self, func=None, local_process_index=None):
+        """Run only on the given local process index (reference
+        ``accelerator.py:975``)."""
+        return self.state.on_local_process(func, local_process_index)
+
+    # -- dataloader-config passthrough properties (reference accelerator.py
+    # exposes each knob directly on the façade) ------------------------------
+
+    @property
+    def split_batches(self) -> bool:
+        return self.dataloader_config.split_batches
+
+    @property
+    def dispatch_batches(self):
+        return self.dataloader_config.dispatch_batches
+
+    @property
+    def even_batches(self) -> bool:
+        return self.dataloader_config.even_batches
+
+    @even_batches.setter
+    def even_batches(self, value: bool):
+        self.dataloader_config.even_batches = value
+
+    @property
+    def use_seedable_sampler(self) -> bool:
+        return self.dataloader_config.use_seedable_sampler
+
+    @property
+    def use_stateful_dataloader(self) -> bool:
+        return getattr(self.dataloader_config, "use_stateful_dataloader", False)
+
+    @property
+    def non_blocking(self) -> bool:
+        return getattr(self.dataloader_config, "non_blocking", False)
+
+    @property
+    def logging_dir(self):
+        return self.project_configuration.logging_dir
+
+    @property
+    def is_fsdp2(self) -> bool:
+        """Reference distinguishes FSDP1/FSDP2 engines; both map onto the one
+        GSPMD design here, with the plugin's fsdp_version carried through."""
+        plugin = getattr(self.state, "fsdp_plugin", None)
+        return bool(plugin is not None and getattr(plugin, "fsdp_version", 2) == 2)
+
+    @property
+    def fp8_backend(self) -> Optional[str]:
+        """Reference returns the fp8 engine in use ("TE"/"MSAMP"/"AO"); here
+        the one backend is XLA's scaled-matmul path (ops/fp8.py)."""
+        return "XLA" if self.mixed_precision == "fp8" else None
+
+    @property
+    def optimizer_step_was_skipped(self) -> bool:
+        """Whether the last ``optimizer.step()`` was skipped (overflow /
+        accumulation) — reference ``accelerator.py:2530``."""
+        return any(getattr(opt, "step_was_skipped", False) for opt in self._optimizers)
+
+    def save(self, obj, f, safe_serialization: bool = False):
+        """Save ``obj`` on the main process only (reference
+        ``accelerator.py:2905``; every-node saves follow
+        ``ProjectConfiguration.save_on_each_node``)."""
+        from .utils.other import save
+
+        save(
+            obj,
+            f,
+            save_on_each_node=getattr(self.project_configuration, "save_on_each_node", False),
+            safe_serialization=safe_serialization,
+        )
+
+    def unscale_gradients(self, optimizer=None):
+        """Reference ``accelerator.py:2370``: unscale fp16 AMP gradients.  The
+        optax path carries no loss scaler (bf16 needs none); gradients are
+        already true-scale, so this is a deliberate no-op kept for API parity.
+        """
+
+    def trigger_sync_in_backward(self, model):
+        """Reference ``accelerator.py:2061``: force DDP grad sync on the next
+        backward inside a ``no_sync`` window.  Sync here is bookkeeping (grads
+        accumulate in the buffer until ``sync_gradients`` flips), so arm the
+        flag directly."""
+        self.gradient_state._set_sync_gradients(True)
+
+    def verify_device_map(self, model) -> bool:
+        """True when the model was dispatched with a multi-tier device map
+        (reference ``accelerator.py:3479`` — such models must not be wrapped
+        for distributed training)."""
+        if not is_torch_available():
+            return False  # no torch module can carry a device map
+        import torch
+
+        if not isinstance(model, torch.nn.Module):
+            return False
+        for module in model.modules():
+            device_map = getattr(module, "hf_device_map", None)
+            if device_map is not None and len(set(device_map.values())) > 1:
+                return True
+        return False
+
+    def lomo_backward(self, loss, learning_rate: float):
+        """Reference ``accelerator.py:2580``: fused LOMO backward+step.  The
+        torch lomo-optim package is CUDA-oriented and not part of this image;
+        the native path already fuses grad computation and the optimizer
+        update into one jitted step, which is LOMO's purpose."""
+        raise NotImplementedError(
+            "lomo_backward requires the lomo-optim torch package (not available "
+            "on TPU). The native path fuses backward+step already: prepare a "
+            "torch optimizer and call accelerator.backward(loss); optimizer.step()."
+        )
+
     def split_between_processes(self, inputs, apply_padding: bool = False):
         return self.state.split_between_processes(inputs, apply_padding)
 

@@ -311,7 +311,9 @@ class PartialState:
 
         @wraps(function)
         def wrapper(*args, **kwargs):
-            if self.process_index == process_index:
+            # Reference state.py: a non-distributed (single-process) run always
+            # executes — an omitted/None index must not silently skip the call.
+            if self.process_index == process_index or not self.use_distributed:
                 return function(*args, **kwargs)
 
         return wrapper
@@ -322,7 +324,7 @@ class PartialState:
 
         @wraps(function)
         def wrapper(*args, **kwargs):
-            if self.local_process_index == local_process_index:
+            if self.local_process_index == local_process_index or not self.use_distributed:
                 return function(*args, **kwargs)
 
         return wrapper

@@ -659,3 +659,53 @@ def test_can_pickle_dataloader(dispatch_batches):
     restored = pickle.loads(pickle.dumps(dl))
     after = [np.asarray(getattr(b, "_atpu_jax", b)).tolist() for b in restored]
     assert before == after
+
+
+def test_facade_member_parity(tmp_path):
+    """Reference Accelerator surface: dataloader-config passthrough
+    properties, logging_dir, save, device-map verification, process
+    decorators, and the step-skip/fp8/fsdp2 introspection properties."""
+    from accelerate_tpu.utils import DataLoaderConfiguration
+
+    acc = Accelerator(
+        dataloader_config=DataLoaderConfiguration(even_batches=True, split_batches=True),
+        project_dir=str(tmp_path),
+    )
+    assert acc.split_batches is True
+    assert acc.even_batches is True
+    acc.even_batches = False
+    assert acc.dataloader_config.even_batches is False
+    assert acc.dispatch_batches is None
+    assert acc.use_seedable_sampler in (True, False)
+    assert acc.use_stateful_dataloader is False
+    assert acc.non_blocking in (True, False)
+    assert str(tmp_path) in str(acc.logging_dir)
+    assert acc.fp8_backend is None
+    assert acc.optimizer_step_was_skipped is False
+
+    # save() writes on the main process.
+    target = tmp_path / "obj.pt"
+    acc.save({"x": torch.ones(2)}, str(target))
+    assert target.exists()
+
+    # verify_device_map: plain model False; dispatched multi-tier model True.
+    assert acc.verify_device_map(torch.nn.Linear(2, 2)) is False
+    from accelerate_tpu.big_modeling import dispatch_model
+
+    model = torch.nn.Sequential(torch.nn.Linear(2, 2), torch.nn.Linear(2, 2))
+    dispatch_model(model, device_map={"0": "cpu", "1": "disk"}, offload_dir=str(tmp_path / "off"))
+    assert acc.verify_device_map(model) is True
+
+    # Process decorators (single process: last == local 0 == this one).
+    ran = []
+    acc.on_last_process(lambda: ran.append("last"))()
+    acc.on_local_process(lambda: ran.append("local"), local_process_index=0)()
+    assert ran == ["last", "local"]
+
+    # no-op / bookkeeping helpers keep their contracts.
+    acc.unscale_gradients()
+    acc.gradient_state._set_sync_gradients(False)
+    acc.trigger_sync_in_backward(model)
+    assert acc.sync_gradients is True
+    with pytest.raises(NotImplementedError, match="lomo"):
+        acc.lomo_backward(torch.tensor(1.0), 0.1)
