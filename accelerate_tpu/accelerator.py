@@ -483,10 +483,26 @@ class Accelerator:
                 from .utils.megatron import MegatronLMPlugin
 
                 megatron_lm_plugin = MegatronLMPlugin()
-        self.deepspeed_plugin = deepspeed_plugin
+        # Multi-model DS support (reference accelerator.py + state.py:906-953):
+        # a dict of named plugins registers them all; the FIRST is active.
+        ds_plugins = None
+        if isinstance(deepspeed_plugin, dict):
+            if not deepspeed_plugin:
+                raise ValueError("deepspeed_plugin dict must not be empty")
+            from .utils.deepspeed import DeepSpeedPlugin
+
+            for key, value in deepspeed_plugin.items():
+                if not isinstance(value, DeepSpeedPlugin):
+                    raise TypeError(
+                        f"deepspeed_plugin[{key!r}] must be a DeepSpeedPlugin, got "
+                        f"{type(value).__name__} (raw DS config dicts go through "
+                        "DeepSpeedPlugin(hf_ds_config=...))"
+                    )
+            ds_plugins = dict(deepspeed_plugin)
+            deepspeed_plugin = next(iter(ds_plugins.values()))
+        self._deepspeed_plugin = deepspeed_plugin
         self.megatron_lm_plugin = megatron_lm_plugin
         dialect = deepspeed_plugin or megatron_lm_plugin
-        self._dialect_grad_clip = dialect.gradient_clipping if dialect is not None else None
         if dialect is not None:
             import jax
 
@@ -531,6 +547,8 @@ class Accelerator:
             # Reference parity: the dialect rewrites distributed_type ON THE
             # STATE singleton (``state.py:952-976``) so direct readers agree.
             self.state.deepspeed_plugin = deepspeed_plugin
+            if deepspeed_plugin is not None:
+                self.state.deepspeed_plugins = ds_plugins or {"default": deepspeed_plugin}
             self.state.megatron_lm_plugin = megatron_lm_plugin
             self.state.distributed_type = (
                 DistributedType.DEEPSPEED if deepspeed_plugin is not None else DistributedType.MEGATRON_LM
@@ -730,9 +748,27 @@ class Accelerator:
     @property
     def is_fsdp2(self) -> bool:
         """Reference distinguishes FSDP1/FSDP2 engines; both map onto the one
-        GSPMD design here, with the plugin's fsdp_version carried through."""
-        plugin = getattr(self.state, "fsdp_plugin", None)
-        return bool(plugin is not None and getattr(plugin, "fsdp_version", 2) == 2)
+        GSPMD design here (single predicate lives on the state)."""
+        return self.state.is_fsdp2
+
+    @property
+    def deepspeed_plugin(self):
+        """The ACTIVE DeepSpeed plugin — reads through the state so a
+        ``state.select_deepspeed_plugin(...)`` switch is immediately visible
+        to every facade consumer (prepare's fill_auto, grad clipping)."""
+        state = self.__dict__.get("state")
+        if state is not None:
+            active = state.__dict__.get("deepspeed_plugin")
+            if active is not None:
+                return active
+        return self.__dict__.get("_deepspeed_plugin")
+
+    @property
+    def _dialect_grad_clip(self):
+        """Gradient-clipping value of the ACTIVE engine dialect (follows
+        plugin selection, unlike a value captured at __init__)."""
+        dialect = self.deepspeed_plugin or self.megatron_lm_plugin
+        return dialect.gradient_clipping if dialect is not None else None
 
     @property
     def fp8_backend(self) -> Optional[str]:

@@ -297,6 +297,20 @@ class PartialState:
 
         return wrapper
 
+    @property
+    def default_device(self):
+        """First addressable accelerator device (reference ``state.py``
+        ``default_device`` returns cuda/mps/cpu; here it is the process's
+        first local XLA device)."""
+        import jax
+
+        return jax.local_devices()[0]
+
+    def set_device(self) -> None:
+        """Reference pins ``torch.cuda`` to LOCAL_RANK.  Device binding here
+        is XLA-side — one process per host owns all its local devices and the
+        mesh assigns work — so there is nothing to pin; kept for API parity."""
+
     def on_last_process(self, function: Callable):
         @wraps(function)
         def wrapper(*args, **kwargs):
@@ -311,9 +325,11 @@ class PartialState:
 
         @wraps(function)
         def wrapper(*args, **kwargs):
-            # Reference state.py: a non-distributed (single-process) run always
-            # executes — an omitted/None index must not silently skip the call.
-            if self.process_index == process_index or not self.use_distributed:
+            # A single-PROCESS run always executes — an omitted/None index
+            # must not silently skip the call.  (use_distributed would be the
+            # wrong guard here: it is True for one process over many local
+            # devices, the standard TPU-host setup.)
+            if self.process_index == process_index or self.num_processes == 1:
                 return function(*args, **kwargs)
 
         return wrapper
@@ -324,7 +340,7 @@ class PartialState:
 
         @wraps(function)
         def wrapper(*args, **kwargs):
-            if self.local_process_index == local_process_index or not self.use_distributed:
+            if self.local_process_index == local_process_index or self.num_processes == 1:
                 return function(*args, **kwargs)
 
         return wrapper
@@ -565,6 +581,34 @@ class AcceleratorState:
     def mixed_precision(self) -> str:
         return self._mixed_precision
 
+    @property
+    def is_fsdp2(self) -> bool:
+        """Reference distinguishes FSDP1/FSDP2; both map onto the GSPMD design
+        here, with the plugin's fsdp_version carried through."""
+        plugin = self.__dict__.get("fsdp_plugin")
+        return bool(plugin is not None and getattr(plugin, "fsdp_version", 2) == 2)
+
+    # -- multi-plugin DeepSpeed registry (reference state.py:1163-1180) ------
+
+    def get_deepspeed_plugin(self, name: str):
+        """Fetch a configured named DeepSpeed plugin (reference
+        ``AcceleratorState.get_deepspeed_plugin``)."""
+        plugins = self.__dict__.get("deepspeed_plugins") or {}
+        if name not in plugins:
+            raise ValueError(
+                f"Unknown DeepSpeed plugin {name!r}; configured: {sorted(plugins)}"
+            )
+        return plugins[name]
+
+    def select_deepspeed_plugin(self, name: str):
+        """Make the named plugin active (reference
+        ``AcceleratorState.select_deepspeed_plugin``); subsequent prepares use
+        its engine dialect."""
+        plugin = self.get_deepspeed_plugin(name)
+        plugin.select(_from_accelerator_state=True)
+        self.deepspeed_plugin = plugin
+        return plugin
+
     @classmethod
     def _reset_state(cls, reset_partial_state: bool = False) -> None:
         if cls._shared_state:
@@ -676,6 +720,21 @@ class GradientState:
 
     def _set_sync_gradients(self, sync_gradients: bool) -> None:
         self.sync_gradients = sync_gradients
+
+    @property
+    def is_xla_gradients_synced(self) -> bool:
+        """Reference GradientState XLA flag (state.py:1243): whether gradients
+        are synced for the current step.  Writable like the reference's; when
+        never written, it mirrors the accumulation bookkeeping
+        (``sync_gradients``)."""
+        explicit = self.__dict__.get("_is_xla_gradients_synced")
+        if explicit:
+            return True
+        return bool(self.sync_gradients)
+
+    @is_xla_gradients_synced.setter
+    def is_xla_gradients_synced(self, value: bool) -> None:
+        self._is_xla_gradients_synced = bool(value)
 
     # The registry holds WEAK references (reference state.py:1191 "weakref'd
     # active-dataloader stack"): an abandoned mid-iteration loader must not be

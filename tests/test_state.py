@@ -72,3 +72,64 @@ def test_mixed_precision_reinit_conflict():
     AcceleratorState(mixed_precision="no")
     with pytest.raises(ValueError, match="already initialized"):
         AcceleratorState(mixed_precision="bf16")
+
+
+def test_state_default_device_and_set_device():
+    """Reference PartialState.default_device/set_device: first local device;
+    set_device is a validating no-op on XLA (devices are mesh-assigned)."""
+    s = PartialState()
+    assert s.default_device in jax.local_devices()
+    s.set_device()  # must not raise or change anything
+    assert s.default_device in jax.local_devices()
+
+
+def test_accelerator_state_is_fsdp2():
+    from accelerate_tpu.utils import FullyShardedDataParallelPlugin
+
+    AcceleratorState._reset_state()
+    state = AcceleratorState(fsdp_plugin=FullyShardedDataParallelPlugin())
+    assert state.is_fsdp2 is True
+    AcceleratorState._reset_state()
+    state = AcceleratorState()
+    assert state.is_fsdp2 is False
+
+
+def test_deepspeed_plugin_registry_get_and_select():
+    """Reference multi-plugin registry: a dict of named plugins registers all;
+    the first is active; select_deepspeed_plugin switches."""
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.utils.deepspeed import DeepSpeedPlugin, get_active_deepspeed_plugin
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    train = DeepSpeedPlugin(zero_stage=2)
+    infer = DeepSpeedPlugin(zero_stage=3)
+    acc = Accelerator(deepspeed_plugin={"train": train, "infer": infer})
+    assert acc.state.get_deepspeed_plugin("train") is train
+    assert get_active_deepspeed_plugin(acc.state) is train
+
+    assert acc.deepspeed_plugin is train  # facade reads through the state
+
+    acc.state.select_deepspeed_plugin("infer")
+    assert get_active_deepspeed_plugin(acc.state) is infer
+    # The switch is visible to every facade consumer immediately (prepare's
+    # fill_auto, dialect grad clipping) — not pinned to the first plugin.
+    assert acc.deepspeed_plugin is infer
+    assert acc._dialect_grad_clip == infer.gradient_clipping
+    with pytest.raises(ValueError, match="Unknown DeepSpeed plugin"):
+        acc.state.get_deepspeed_plugin("nope")
+    with pytest.raises(TypeError, match="must be a DeepSpeedPlugin"):
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        Accelerator(deepspeed_plugin={"bad": {"zero_optimization": {"stage": 2}}})
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+
+
+def test_gradient_state_xla_sync_flag_mirrors_sync():
+    gs = GradientState()
+    gs._set_sync_gradients(True)
+    assert gs.is_xla_gradients_synced is True
+    gs._set_sync_gradients(False)
+    assert gs.is_xla_gradients_synced is False
+    gs._set_sync_gradients(True)
