@@ -160,13 +160,30 @@ if os.environ.get("BENCH_TRY_CHUNKED") or os.environ.get("BENCH_TRY_BIG"):
 if os.environ.get("BENCH_TRY_BIG"):
     LADDER.insert(0, ("llama-509m", 2048, 6, 8192, 12, 2048, "pallas", "dots", "chunked"))
 
+# Proof rungs where parameter HBM pressure binds (VERDICT r3 item 1): a 1.39B
+# llama on one v5e — bf16 params (2.78G) + AdamW moments (5.56G) + grads
+# (2.78G) leave ~4.6G for activations, so batch 2 with "dots" remat is the
+# frontier (batch 3 OOMs: 16.40G of 15.75G, measured r4).  Measured r4 ladder:
+# b2/dots/dense 0.6092, b2/dots/chunked 0.5947, b4/nothing 0.5890,
+# b8/nothing/chunked 0.5654, b1/s4096 0.5530.  These run AFTER the headline
+# rung and are attached to the result's detail — proving MFU >= 0.60 where
+# HBM binds without shadowing the 509m champion headline.
+PROOF_RUNGS = [
+    ("llama-1.4b", 2048, 20, 8192, 2, 2048, "pallas", "dots", "dense", "bf16"),
+    ("llama-1.4b", 2048, 20, 8192, 2, 2048, "pallas", "dots", "chunked", "bf16"),
+    ("llama-1.4b", 2048, 20, 8192, 4, 2048, "pallas", "nothing", "dense", "bf16"),
+]
+
 # Test hook: lets the smoke tests exercise the rung-subprocess machinery with
 # CPU-sized configs (a real rung takes minutes on CPU).
 if os.environ.get("BENCH_LADDER_JSON"):
     LADDER = [tuple(r) for r in json.loads(os.environ["BENCH_LADDER_JSON"])]
+    PROOF_RUNGS = []
+if os.environ.get("BENCH_PROOF_LADDER_JSON"):
+    PROOF_RUNGS = [tuple(r) for r in json.loads(os.environ["BENCH_PROOF_LADDER_JSON"])]
 
 
-def _run_rung_subprocess(rung_index: int, timeout_s: int):
+def _run_rung_subprocess(rung_index: int, timeout_s: int, flag: str = "--rung"):
     """Run one ladder rung in a KILLABLE subprocess.
 
     A half-up device tunnel can hang a compile inside a C call, where neither
@@ -176,7 +193,7 @@ def _run_rung_subprocess(rung_index: int, timeout_s: int):
 
     try:
         proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--rung", str(rung_index)],
+            [sys.executable, os.path.abspath(__file__), flag, str(rung_index)],
             capture_output=True,
             text=True,
             timeout=timeout_s,
@@ -242,9 +259,11 @@ def main():
         )
         print(detail)
         sys.exit(0 if ok else 1)
-    if "--rung" in sys.argv:
-        idx = int(sys.argv[sys.argv.index("--rung") + 1])
-        rung = LADDER[idx]
+    if "--rung" in sys.argv or "--proof-rung" in sys.argv:
+        if "--rung" in sys.argv:
+            rung = LADDER[int(sys.argv[sys.argv.index("--rung") + 1])]
+        else:
+            rung = PROOF_RUNGS[int(sys.argv[sys.argv.index("--proof-rung") + 1])]
         name, d, layers, f, b, s, impl, policy = rung[:8]
         loss_impl = rung[8] if len(rung) > 8 else "dense"
         param_dtype = rung[9] if len(rung) > 9 else "f32"
@@ -274,20 +293,21 @@ def main():
         sys.exit(1)
     print(f"# bench devices: {detail} ({attempts} probe attempts)", file=sys.stderr)
 
+    def _cfg_str(rung):
+        name, _, _, _, batch, seq, impl, policy = rung[:8]
+        for extra in rung[8:10]:
+            policy = f"{policy}/{extra}"
+        return f"{name}/b{batch}/s{seq}/{impl}/{policy}"
+
     result = None
     rung_log = []
     rung_cfg = None
     for i, rung in enumerate(LADDER):
-        name, _, _, _, batch, seq, impl, policy = rung[:8]
-        if len(rung) > 8:
-            policy = f"{policy}/{rung[8]}"
-        if len(rung) > 9:
-            policy = f"{policy}/{rung[9]}"
         result, err = _run_rung_subprocess(i, timeout_s=480)
         # Per-rung emission: a later crash can no longer zero the round — the
         # outcome of every attempted rung is in the final JSON and on stderr.
         status = "ok" if result is not None else err
-        rung_log.append({"rung": i, "config": f"{name}/b{batch}/s{seq}/{impl}/{policy}", "status": status})
+        rung_log.append({"rung": i, "config": _cfg_str(rung), "status": status})
         print(f"# rung {i} {rung_log[-1]['config']}: {status}", file=sys.stderr, flush=True)
         if result is not None:
             rung_cfg = rung_log[-1]["config"]
@@ -306,6 +326,45 @@ def main():
             )
         )
         sys.exit(1)
+
+    # HBM-bound proof: run the >=1B-param rungs after the headline so the
+    # round artifact carries MFU evidence off the smallest model.  First
+    # success wins; failures are logged but never zero the headline.
+    proof = None
+    proof_cfg = None
+    for i, rung in enumerate(PROOF_RUNGS):
+        proof, err = _run_rung_subprocess(i, timeout_s=480, flag="--proof-rung")
+        # A parseable-but-foreign JSON line (library noise) must not crash the
+        # already-measured headline below — require the result keys.
+        if proof is not None and not all(
+            k in proof for k in ("mfu", "params", "tokens_per_sec", "step_ms")
+        ):
+            proof, err = None, "unrecognized result payload"
+        status = "ok" if proof is not None else err
+        cfg_str = _cfg_str(rung)
+        rung_log.append({"rung": f"proof-{i}", "config": cfg_str, "status": status})
+        print(f"# proof rung {i} {cfg_str}: {status}", file=sys.stderr, flush=True)
+        if proof is not None:
+            proof_cfg = cfg_str
+            break
+    detail = {
+        "config": result["config"],
+        "rung": rung_cfg,
+        "params": result["params"],
+        "tokens_per_sec": round(result["tokens_per_sec"], 1),
+        "step_ms": round(result["step_ms"], 2),
+        "loss": round(result["loss"], 4),
+        "rungs": rung_log,
+    }
+    if proof is not None:
+        detail["hbm_bound_proof"] = {
+            "config": proof_cfg,
+            "params": proof["params"],
+            "mfu": round(proof["mfu"], 4),
+            "vs_baseline": round(proof["mfu"] / 0.45, 4),
+            "tokens_per_sec": round(proof["tokens_per_sec"], 1),
+            "step_ms": round(proof["step_ms"], 2),
+        }
     print(
         json.dumps(
             {
@@ -313,15 +372,7 @@ def main():
                 "value": round(result["mfu"], 4),
                 "unit": "mfu_fraction",
                 "vs_baseline": round(result["mfu"] / 0.45, 4),
-                "detail": {
-                    "config": result["config"],
-                    "rung": rung_cfg,
-                    "params": result["params"],
-                    "tokens_per_sec": round(result["tokens_per_sec"], 1),
-                    "step_ms": round(result["step_ms"], 2),
-                    "loss": round(result["loss"], 4),
-                    "rungs": rung_log,
-                },
+                "detail": detail,
             }
         )
     )
