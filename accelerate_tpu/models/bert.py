@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.sharding import constrain as _constrain
+from ..parallel.sharding import constrain as _constrain, embed_lookup as _embed_lookup
 from .gpt2 import _layer_norm
 
 __all__ = [
@@ -175,7 +175,7 @@ def apply(
 
     e = params["embeddings"]
     x = (
-        e["word"].astype(c.dtype)[input_ids]
+        _embed_lookup(e["word"], input_ids, c.dtype)
         + e["position"].astype(c.dtype)[:s][None]
         + e["token_type"].astype(c.dtype)[token_type_ids]
     )

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from .llama import cross_entropy, labels_and_weights
-from ..parallel.sharding import constrain as _constrain
+from ..parallel.sharding import constrain as _constrain, embed_lookup as _embed_lookup
 
 __all__ = ["GPT2Config", "init_params", "apply", "loss_fn", "PARTITION_RULES", "param_specs"]
 
@@ -218,7 +218,7 @@ def apply_hidden(
     if attention_mask is not None:
         mask = mask & attention_mask[:, None, :].astype(bool)
 
-    x = params["wte"].astype(c.dtype)[input_ids] + params["wpe"].astype(c.dtype)[:s][None]
+    x = _embed_lookup(params["wte"], input_ids, c.dtype) + params["wpe"].astype(c.dtype)[:s][None]
     act_spec = P(("dcn_dp", "dp", "fsdp"), "sp", None)
     x = _constrain(x, act_spec)
 
@@ -283,6 +283,8 @@ def apply_cached(
         )
 
     positions = index + jnp.arange(s)
+    # Single-token decode keeps the gather: a [B, 1, V] one-hot contraction
+    # would read the whole table per generated token.
     x = params["wte"].astype(c.dtype)[input_ids] + params["wpe"].astype(c.dtype)[positions][None]
 
     k_pos = jnp.arange(max_len)

@@ -228,7 +228,11 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
     return jax.tree_util.tree_unflatten(treedef, [init_one(s, k) for s, k in zip(leaves, keys)])
 
 
-from ..parallel.sharding import _abstract_mesh, constrain as _maybe_constrain  # noqa: E402
+from ..parallel.sharding import (  # noqa: E402
+    _abstract_mesh,
+    constrain as _maybe_constrain,
+    embed_lookup as _embed_lookup,
+)
 
 
 def _sp_active() -> bool:
@@ -472,7 +476,7 @@ def _remat_policy(name: str):
 def embed_tokens(params: dict, input_ids: jax.Array, config: LlamaConfig) -> jax.Array:
     """Token embedding lookup in compute dtype — shared by the dense and
     pipeline-parallel paths."""
-    return params["embed"].astype(config.dtype)[input_ids]
+    return _embed_lookup(params["embed"], input_ids, config.dtype)
 
 
 def final_norm(params: dict, x: jax.Array, config: LlamaConfig) -> jax.Array:

@@ -267,7 +267,7 @@ def apply_hidden(
     # attention_block picks flash/ring/ulysses without an [S, S] mask.
     kv_valid = attention_mask.astype(bool) if attention_mask is not None else None
 
-    x = params["embed"].astype(c.dtype)[input_ids]
+    x = _llama._embed_lookup(params["embed"], input_ids, c.dtype)
     act_spec = P(("dcn_dp", "dp", "fsdp"), "sp", None)
     x = _llama._maybe_constrain(x, act_spec)
     capacity = expert_capacity(s, c.num_experts, c.top_k, c.capacity_factor)
@@ -346,6 +346,8 @@ def apply_cached(
     index = cache["index"]
     check_cache_room(index, s, cache["k"].shape[2])
     positions = jnp.broadcast_to(index + jnp.arange(s), (b, s))
+    # Single-token decode keeps the gather: a [B, 1, V] one-hot contraction
+    # would read the whole table per generated token.
     x = params["embed"].astype(c.dtype)[input_ids]
     capacity = expert_capacity(s, c.num_experts, c.top_k, c.capacity_factor)
 

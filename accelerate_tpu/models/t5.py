@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.sharding import constrain as _constrain
+from ..parallel.sharding import constrain as _constrain, embed_lookup as _embed_lookup
 from .llama import _rms_norm
 
 __all__ = ["T5Config", "init_params", "apply", "loss_fn", "PARTITION_RULES", "param_specs"]
@@ -246,7 +246,7 @@ def apply_hidden(
     if attention_mask is not None:
         cross_mask = jnp.broadcast_to(attention_mask.astype(bool)[:, None, :], (b, t, s))
 
-    y = params["shared_embed"].astype(c.dtype)[decoder_input_ids]
+    y = _embed_lookup(params["shared_embed"], decoder_input_ids, c.dtype)
     y = _constrain(y, act_spec)
 
     def dec_body(carry, lp):
@@ -322,7 +322,7 @@ def encode(params: dict, input_ids: jax.Array, config: "T5Config",
         valid = attention_mask.astype(bool)
         enc_mask = valid[:, None, :] & valid[:, :, None]
     enc_bias = _rel_bias(params["enc_rel_bias"].astype(jnp.float32), s, s, c, bidirectional=True)
-    x = params["shared_embed"].astype(c.dtype)[input_ids]
+    x = _embed_lookup(params["shared_embed"], input_ids, c.dtype)
     if act_spec is not None:
         x = _constrain(x, act_spec)
 
@@ -382,6 +382,8 @@ def decode_cached(
     if attention_mask is not None:
         cross_mask = jnp.broadcast_to(attention_mask.astype(bool)[:, None, :], (b, t, s))
 
+    # Single-token decode keeps the gather: a [B, 1, V] one-hot contraction
+    # would read the whole table per generated token.
     y = params["shared_embed"].astype(c.dtype)[decoder_input_ids]
 
     def body(carry, xs):

@@ -44,6 +44,7 @@ __all__ = [
     "data_sharding",
     "batch_spec",
     "constrain",
+    "embed_lookup",
 ]
 
 
@@ -78,6 +79,42 @@ def constrain(x: jax.Array, spec: P) -> jax.Array:
     if all(dim is None for dim in pruned):
         return x
     return jax.lax.with_sharding_constraint(x, pruned)
+
+
+def embed_lookup(table: jax.Array, input_ids: jax.Array, dtype) -> jax.Array:
+    """Embedding lookup that stays efficient under SPMD model sharding.
+
+    A plain gather from a model-sharded table produces an output whose feature
+    dim inherits the table's ``fsdp``/``tp`` sharding while its batch dim is
+    replicated; re-constraining that onto batch-over-data-axes makes XLA's SPMD
+    partitioner emit "Involuntary full rematerialization" (replicate the whole
+    [B, S, D] activation, then re-partition — a step-time cliff on the DCN path
+    of a multislice mesh).  Expressed as a one-hot matmul, the same lookup
+    partitions like every other weight matmul: XLA all-gathers the table shard
+    (the standard FSDP pattern) and the output comes out batch-sharded with no
+    resharding; the backward becomes an MXU matmul instead of a scatter-add.
+    For in-range ids the numerics are exact either way (one nonzero per
+    one-hot row); out-of-range ids differ — gather wraps negatives / clamps
+    overflow, one-hot returns a zero embedding — both are silent garbage, so
+    callers must pass valid ids (the reference's nn.Embedding errors instead).
+
+    Outside a model-sharded mesh (single device, pure dp) the gather is
+    cheaper, so it stays.  The gate is mesh-axis sizes, not the table's actual
+    layout, so a config that keeps params replicated on an active ``fsdp``
+    axis (SHARD_GRAD_OP-style) pays an unnecessary one-hot contraction —
+    ~2*B*S*V*D FLOPs, about 1% of a training step at bench shapes; the table's
+    true sharding is not visible on traced values in auto-sharding mode.
+    Decode paths (one token per step) keep the gather unconditionally.
+    """
+    m = _abstract_mesh()
+    if (
+        m is not None
+        and not m.empty
+        and any(dict(m.shape).get(a, 1) > 1 for a in ("fsdp", "tp", "sp", "ep"))
+    ):
+        one_hot = jax.nn.one_hot(input_ids, table.shape[0], dtype=dtype)
+        return one_hot @ table.astype(dtype)
+    return table.astype(dtype)[input_ids]
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
