@@ -395,6 +395,32 @@ class PreparedModel:
         self.params = _unflatten_tree(new, self.params)
 
 
+class _RemovableHandle:
+    """Minimal ``torch.utils.hooks.RemovableHandle`` equivalent (id +
+    weak-registry pop) so hook registration stays usable without torch —
+    sibling facade methods guard their torch imports the same way."""
+
+    _next_id = 0
+
+    def __init__(self, registry):
+        import weakref
+
+        self._registry_ref = weakref.ref(registry)
+        self.id = _RemovableHandle._next_id
+        _RemovableHandle._next_id += 1
+
+    def remove(self) -> None:
+        registry = self._registry_ref()
+        if registry is not None:
+            registry.pop(self.id, None)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.remove()
+
+
 def _flatten_tree(tree, prefix="") -> dict:
     out = {}
     if isinstance(tree, dict):
@@ -1317,9 +1343,7 @@ class Accelerator:
         """Register ``hook(models, weights, output_dir)`` to run inside
         ``save_state`` before anything is written (reference
         ``accelerator.py:3054``).  Returns a removable handle."""
-        import torch.utils.hooks as torch_hooks
-
-        handle = torch_hooks.RemovableHandle(self._save_state_pre_hooks)
+        handle = _RemovableHandle(self._save_state_pre_hooks)
         self._save_state_pre_hooks[handle.id] = hook
         return handle
 
@@ -1327,9 +1351,7 @@ class Accelerator:
         """Register ``hook(models, input_dir)`` to run inside ``load_state``
         before weights are restored (reference ``accelerator.py:3118``).
         Returns a removable handle."""
-        import torch.utils.hooks as torch_hooks
-
-        handle = torch_hooks.RemovableHandle(self._load_state_pre_hooks)
+        handle = _RemovableHandle(self._load_state_pre_hooks)
         self._load_state_pre_hooks[handle.id] = hook
         return handle
 

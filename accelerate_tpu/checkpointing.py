@@ -308,20 +308,28 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, **save
     pre_hooks = list(getattr(accelerator, "_save_state_pre_hooks", {}).values())
     hook_weights = None
     if pre_hooks:
-        hook_weights = [accelerator.get_state_dict(m, unwrap=False) for m in accelerator._models]
-        for hook in pre_hooks:
-            hook(accelerator._models, hook_weights, output_dir)
         if sharded:
+            # Reference FSDP behavior (accelerator.py:2992-3005 with
+            # fsdp-sharded models): hooks run with an EMPTY weights list —
+            # consolidating every model's full state dict just to feed hooks
+            # whose mutations the orbax path then discards is exactly the
+            # big-model case where consolidation is most expensive.
+            hook_weights = []
             global _warned_sharded_hook_weights
             if not _warned_sharded_hook_weights:
                 _warned_sharded_hook_weights = True
                 logger.warning(
-                    "save_state pre-hooks ran, but the sharded (orbax) save writes "
-                    "the live model params directly — mutations of the hook's "
-                    "weights list are NOT applied on this path. Use a consolidated "
-                    "save (state_dict_type != SHARDED_STATE_DICT) if the hook must "
-                    "edit what gets written."
+                    "save_state pre-hooks run with an empty weights list on the "
+                    "sharded (orbax) path — the save writes the live model params "
+                    "directly. Use a consolidated save (state_dict_type != "
+                    "SHARDED_STATE_DICT) if the hook must see or edit the weights."
                 )
+        else:
+            hook_weights = [
+                accelerator.get_state_dict(m, unwrap=False) for m in accelerator._models
+            ]
+        for hook in pre_hooks:
+            hook(accelerator._models, hook_weights, output_dir)
     if sharded:
         # A still-running async save from the previous save_state must finish
         # before its directory can be replaced.

@@ -283,9 +283,7 @@ def apply_cached(
         )
 
     positions = index + jnp.arange(s)
-    # Single-token decode keeps the gather: a [B, 1, V] one-hot contraction
-    # would read the whole table per generated token.
-    x = params["wte"].astype(c.dtype)[input_ids] + params["wpe"].astype(c.dtype)[positions][None]
+    x = _embed_lookup(params["wte"], input_ids, c.dtype) + params["wpe"].astype(c.dtype)[positions][None]
 
     k_pos = jnp.arange(max_len)
     mask = positions[:, None] >= k_pos[None, :]  # [S, max_len]

@@ -346,9 +346,7 @@ def apply_cached(
     index = cache["index"]
     check_cache_room(index, s, cache["k"].shape[2])
     positions = jnp.broadcast_to(index + jnp.arange(s), (b, s))
-    # Single-token decode keeps the gather: a [B, 1, V] one-hot contraction
-    # would read the whole table per generated token.
-    x = params["embed"].astype(c.dtype)[input_ids]
+    x = _llama._embed_lookup(params["embed"], input_ids, c.dtype)
     capacity = expert_capacity(s, c.num_experts, c.top_k, c.capacity_factor)
 
     def body(carry, xs):

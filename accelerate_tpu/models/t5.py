@@ -382,9 +382,7 @@ def decode_cached(
     if attention_mask is not None:
         cross_mask = jnp.broadcast_to(attention_mask.astype(bool)[:, None, :], (b, t, s))
 
-    # Single-token decode keeps the gather: a [B, 1, V] one-hot contraction
-    # would read the whole table per generated token.
-    y = params["shared_embed"].astype(c.dtype)[decoder_input_ids]
+    y = _embed_lookup(params["shared_embed"], decoder_input_ids, c.dtype)
 
     def body(carry, xs):
         lp, ck, cv, xk, xv = xs

@@ -54,6 +54,14 @@ def pick_block(
             raise ValueError(f"ACCELERATE_ATTN_BLOCK must be positive, got {value}")
         if s % value == 0:
             return value
+        import warnings
+
+        warnings.warn(
+            f"ACCELERATE_ATTN_BLOCK={value} does not divide the sequence length "
+            f"{s}; the override is ignored and the block ladder decides — this "
+            "tuning run is NOT measuring the requested block.",
+            stacklevel=2,
+        )
     for b in ladder:
         if s % b == 0:
             return b

@@ -104,11 +104,15 @@ def embed_lookup(table: jax.Array, input_ids: jax.Array, dtype) -> jax.Array:
     axis (SHARD_GRAD_OP-style) pays an unnecessary one-hot contraction —
     ~2*B*S*V*D FLOPs, about 1% of a training step at bench shapes; the table's
     true sharding is not visible on traced values in auto-sharding mode.
-    Decode paths (one token per step) keep the gather unconditionally.
+    Decode paths keep the gather: most call it directly, and the trailing-dim-1
+    guard below catches single-token lookups routed through shared embed
+    helpers (a [B, 1, V] one-hot would read the whole table per token).
     """
+    single_token = input_ids.ndim >= 1 and input_ids.shape[-1] == 1
     m = _abstract_mesh()
     if (
-        m is not None
+        not single_token
+        and m is not None
         and not m.empty
         and any(dict(m.shape).get(a, 1) > 1 for a in ("fsdp", "tp", "sp", "ep"))
     ):

@@ -670,7 +670,10 @@ class GradientState:
                 if gradient_accumulation_plugin is not None
                 else {}
             )
-            self._is_xla_gradients_synced = False
+            # None = never explicitly written; the getter then mirrors
+            # sync_gradients.  A written bool (True OR False) is returned
+            # verbatim (reference state.py:1273-1282).
+            self._is_xla_gradients_synced = None
             # Per-process rows the device placer appended to the CURRENT batch
             # to make it shard-divisible, and the resulting padded per-process
             # row count; gather_for_metrics drops the pads — only from tensors
@@ -724,12 +727,13 @@ class GradientState:
     @property
     def is_xla_gradients_synced(self) -> bool:
         """Reference GradientState XLA flag (state.py:1243): whether gradients
-        are synced for the current step.  Writable like the reference's; when
-        never written, it mirrors the accumulation bookkeeping
+        are synced for the current step.  Writable like the reference's — an
+        explicitly-written value (True OR False) is returned verbatim; only
+        when never written does it mirror the accumulation bookkeeping
         (``sync_gradients``)."""
         explicit = self.__dict__.get("_is_xla_gradients_synced")
-        if explicit:
-            return True
+        if explicit is not None:
+            return explicit
         return bool(self.sync_gradients)
 
     @is_xla_gradients_synced.setter

@@ -42,6 +42,7 @@ def _run(
     remat_policy: str = "dots",
     loss_impl: str = "dense",
     param_dtype: str = "f32",
+    vocab_size: int = 32000,
 ):
     import jax
     import jax.numpy as jnp
@@ -50,7 +51,7 @@ def _run(
     from accelerate_tpu.models import llama
 
     cfg = llama.LlamaConfig(
-        vocab_size=32000,
+        vocab_size=vocab_size,
         hidden_size=d,
         intermediate_size=f,
         num_layers=layers,
@@ -110,7 +111,7 @@ def _run(
     attn_flops = 12 * layers * d * seq * seq * batch / 2
     flops_per_step = 6.0 * n_params * tokens_per_step + attn_flops
     mfu = flops_per_step / dt / _peak_flops_per_chip() / jax.device_count()
-    return {
+    out = {
         "config": cfg_name,
         "params": n_params,
         "tokens_per_sec": tokens_per_step / dt,
@@ -118,12 +119,26 @@ def _run(
         "mfu": mfu,
         "loss": float(loss),
     }
+    try:  # peak HBM, where the backend exposes it (not all tunnels do)
+        stats = jax.local_devices()[0].memory_stats() or {}
+        if "peak_bytes_in_use" in stats:
+            out["peak_hbm_gb"] = round(stats["peak_bytes_in_use"] / 1e9, 2)
+    except Exception:
+        pass
+    return out
 
 
 LADDER = [
-    # Rung 0: pure-bf16 params (reference downcast_bf16 TPU semantics) at the
-    # batch the freed HBM admits — 0.6757 MFU measured r3 on v5e at b10
-    # (b8 0.6632, b12 0.6644; fp32-master can't fit b10).  Rung 1: b8 bf16.
+    # Rung 0: llama3-style 128k vocabulary (d2048/L6/f8192, 903M params) at
+    # dense/b6 — 0.8462 MFU measured r4 on v5e: the [B*S, d] x [d, 128256]
+    # head matmul is the most MXU-efficient op in the model, so the realistic
+    # modern vocab size RAISES MFU over the 32k-vocab rungs.  b8 OOMs; the
+    # full dense-vs-chunked table at this vocab is BENCH_chunked_128k.json.
+    ("llama3-903m-v128k", 2048, 6, 8192, 6, 2048, "pallas", "dots", "dense", "bf16", 128256),
+    ("llama3-903m-v128k", 2048, 6, 8192, 4, 2048, "pallas", "dots", "dense", "bf16", 128256),
+    # Next rungs: pure-bf16 params (reference downcast_bf16 TPU semantics) at
+    # the batch the freed HBM admits — 0.6757 MFU measured r3 on v5e at b10
+    # (b8 0.6632, b12 0.6644; fp32-master can't fit b10).  Then b8 bf16.
     # Rung 2: the fp32-master path — 0.6353 MFU driver-verifiable with the
     # 1024 attention block (0.6041 at block 512, BENCH_opportunistic.json;
     # 0.5202 at block 256; 2048 = one-block OOMs VMEM).  An unmeasured
@@ -267,7 +282,12 @@ def main():
         name, d, layers, f, b, s, impl, policy = rung[:8]
         loss_impl = rung[8] if len(rung) > 8 else "dense"
         param_dtype = rung[9] if len(rung) > 9 else "f32"
-        print(json.dumps(_run(name, d, layers, f, b, s, impl, policy, loss_impl, param_dtype)))
+        vocab = rung[10] if len(rung) > 10 else 32000
+        print(
+            json.dumps(
+                _run(name, d, layers, f, b, s, impl, policy, loss_impl, param_dtype, vocab)
+            )
+        )
         return
 
     # Fast-fail (then retry, bounded) when the device backend is unreachable
@@ -295,7 +315,7 @@ def main():
 
     def _cfg_str(rung):
         name, _, _, _, batch, seq, impl, policy = rung[:8]
-        for extra in rung[8:10]:
+        for extra in rung[8:]:
             policy = f"{policy}/{extra}"
         return f"{name}/b{batch}/s{seq}/{impl}/{policy}"
 

@@ -102,3 +102,33 @@ def test_async_sharded_save(tmp_path):
     model._set_params(jax.tree_util.tree_map(lambda x: x + 7.0, model.params))
     load_sharded_model(model, str(tmp_path / "orbax"))
     assert float(np.asarray(model.params["a"])) == pytest.approx(a_val)
+
+
+def test_sharded_save_hooks_get_empty_weights(tmp_path):
+    """Reference FSDP behavior: save_state pre-hooks on the sharded (orbax)
+    path run with an EMPTY weights list — no full state dict is consolidated
+    just to feed hooks whose mutations the sharded writer discards."""
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(fsdp=8),
+        fsdp_plugin=FullyShardedDataParallelPlugin(state_dict_type="SHARDED_STATE_DICT"),
+    )
+    model = _train_prepared_model(acc)
+    seen = {}
+
+    def hook(models, weights, output_dir):
+        seen["weights"] = weights
+        seen["n_models"] = len(models)
+
+    acc.register_save_state_pre_hook(hook)
+    calls = []
+    orig = acc.get_state_dict
+    acc.get_state_dict = lambda *a, **k: calls.append(1) or orig(*a, **k)
+    acc.save_state(str(tmp_path / "ck"))
+    assert seen["weights"] == [] and seen["n_models"] == 1
+    assert calls == []  # no consolidation happened for the hook
+
+    # Round-trip still works.
+    a_val = float(np.asarray(model.params["a"]))
+    model._set_params(jax.tree_util.tree_map(lambda x: x * 0.0, model.params))
+    acc.load_state(str(tmp_path / "ck"))
+    assert float(np.asarray(model.params["a"])) == pytest.approx(a_val)

@@ -147,3 +147,24 @@ def test_padding_mask_stays_on_flash_path():
     np.testing.assert_allclose(
         np.asarray(logits_padded[0, :32]), np.asarray(logits2[0, :32]), rtol=1e-5, atol=1e-5
     )
+
+
+def test_attn_block_override_warns_when_skipped(monkeypatch):
+    """A mis-set ACCELERATE_ATTN_BLOCK (not dividing s) must not be silently
+    ignored — tuning runs would measure the ladder block instead."""
+    import warnings
+
+    from accelerate_tpu.ops.flash_attention import pick_block
+
+    monkeypatch.setenv("ACCELERATE_ATTN_BLOCK", "768")
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        assert pick_block(1024) in (1024, 512, 256, 128)  # ladder decides
+    assert any("does not divide" in str(w.message) for w in caught)
+
+    # A dividing override is honored verbatim, no warning.
+    monkeypatch.setenv("ACCELERATE_ATTN_BLOCK", "256")
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        assert pick_block(1024) == 256
+    assert not any("does not divide" in str(w.message) for w in caught)

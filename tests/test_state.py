@@ -133,3 +133,12 @@ def test_gradient_state_xla_sync_flag_mirrors_sync():
     gs._set_sync_gradients(False)
     assert gs.is_xla_gradients_synced is False
     gs._set_sync_gradients(True)
+    # An explicitly-written value is returned verbatim — including False —
+    # even while sync_gradients says otherwise (reference state.py:1273-1282).
+    gs.is_xla_gradients_synced = False
+    gs._set_sync_gradients(True)
+    assert gs.is_xla_gradients_synced is False
+    gs.is_xla_gradients_synced = True
+    gs._set_sync_gradients(False)
+    assert gs.is_xla_gradients_synced is True
+    GradientState._reset_state()
