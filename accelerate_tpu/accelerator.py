@@ -126,7 +126,12 @@ def _jax_to_torch(x):
         return x
     import torch
 
-    return torch.from_numpy(np.asarray(jax.device_get(x)))
+    arr = np.asarray(jax.device_get(x))
+    if not arr.flags.writeable:
+        # torch.from_numpy on a read-only view warns (and writing through the
+        # tensor would be UB); jax.device_get returns read-only arrays.
+        arr = arr.copy()
+    return torch.from_numpy(arr)
 
 
 def _torch_to_jax_tree(tree):
@@ -379,12 +384,21 @@ class PreparedModel:
         return tree
 
     def state_dict(self) -> dict:
-        """Flat numpy state dict (reference ``get_state_dict`` shape)."""
+        """Flat numpy state dict (reference ``get_state_dict`` shape).  A
+        pipelined bridged model's stacked block leaves are unstacked back to
+        torch per-block names so checkpoints stay loadable by torch/HF and by
+        pp=1 runs."""
         flat = _flatten_tree(jax.device_get(self.params))
         flat.update({f"buffers.{k}": v for k, v in _flatten_tree(jax.device_get(self.buffers)).items()})
+        lowered = getattr(self, "_lowered", None)
+        if lowered is not None and hasattr(lowered, "unstack_state_dict"):
+            flat = lowered.unstack_state_dict(flat)
         return flat
 
     def load_state_dict(self, state_dict: dict):
+        lowered = getattr(self, "_lowered", None)
+        if lowered is not None and hasattr(lowered, "restack_state_dict"):
+            state_dict = lowered.restack_state_dict(state_dict)
         flat = _flatten_tree(self.params)
         new = {}
         for k, v in flat.items():
@@ -951,17 +965,49 @@ class Accelerator:
             params, buffers, rules = model.params, model.buffers, model.partition_rules
             original = None
         else:
-            from .utils.torch_bridge import lower_module
+            from .utils.torch_bridge import TorchLoweringError, lower_module
 
-            lowered = lower_module(model)
+            rules = None
+            lowered = None
+            pp = dict(self.mesh.shape).get("pp", 1)
+            if pp > 1:
+                # Reference capability: the Megatron engine pipelines any model
+                # it wraps (utils/megatron_lm.py:1034-1055).  Native analog:
+                # stack the module's repeated-block chain into the compiled
+                # GPipe scan.  Modules without pipelineable structure fall back
+                # to plain GSPMD — loudly, so pp_degree is never silently inert.
+                from jax.sharding import PartitionSpec as _P
+
+                from .utils.torch_bridge import lower_module_pipelined
+
+                mb = getattr(self.state.pp_plugin, "num_micro_batches", 1) or 1
+                try:
+                    lowered = lower_module_pipelined(model, pp, num_micro_batches=mb)
+                    rules = [(r"\._stacked\.", _P("pp"))]
+                except TorchLoweringError as e:
+                    warnings.warn(
+                        f"pp={pp} requested but this torch module cannot be "
+                        f"pipelined ({e}); it will run GSPMD-sharded WITHOUT a "
+                        "microbatch pipeline schedule — pp_degree buys no "
+                        "pipelining for this model. Restructure the repeated "
+                        "blocks into a ModuleList/Sequential linear chain to "
+                        "enable the compiled GPipe schedule."
+                    )
+            if lowered is None:
+                lowered = lower_module(model)
             apply_fn = lowered.apply
-            params, buffers, rules = lowered.params, lowered.buffers, None
+            params, buffers = lowered.params, lowered.buffers
             original = model
 
         specs = make_param_specs(params, self.mesh, self.state.fsdp_plugin, rules=rules)
         params = shard_params(params, self.mesh, specs)
         buffers = jax.tree_util.tree_map(lambda b: jax.device_put(jnp.asarray(b)), buffers)
         prepared = PreparedModel(apply_fn, params, buffers, self, original_module=original)
+        if original is not None:
+            # Keep the lowering handle: a pipelined lowering stores stacked
+            # block params, and state_dict/unwrap must translate back to torch
+            # per-block names (PipelinedLoweredModule.unstack_state_dict).
+            prepared._lowered = lowered
         if evaluation_mode:
             prepared.eval()
         prepared._is_accelerate_prepared = True
@@ -1187,10 +1233,11 @@ class Accelerator:
             if model.module is not None:
                 import torch
 
-                sd = {
-                    k: torch.from_numpy(np.asarray(v))
-                    for k, v in _flatten_tree(jax.device_get(model.params)).items()
-                }
+                flat = _flatten_tree(jax.device_get(model.params))
+                lowered = getattr(model, "_lowered", None)
+                if lowered is not None and hasattr(lowered, "unstack_state_dict"):
+                    flat = lowered.unstack_state_dict(flat)
+                sd = {k: torch.from_numpy(np.asarray(v)) for k, v in flat.items()}
                 model.module.load_state_dict(sd, strict=False)
                 return model.module
             return model

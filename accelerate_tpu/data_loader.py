@@ -330,8 +330,12 @@ class _GlobalBatchPlacer:
         non_blocking: bool = False,
         device=None,
         output_type: str = "jax",
+        even_batches: bool = True,
     ):
         self.mesh = mesh
+        # even_batches=False is the user saying "never fabricate samples" —
+        # the shard-divisibility pad below then errors instead of repeating.
+        self.even_batches = even_batches
         self.non_blocking = non_blocking  # jax transfers are always async; kept for API parity
         self.device = device
         # "jax": yield global jax.Arrays.  "torch": yield torch views of the host
@@ -427,7 +431,17 @@ class _GlobalBatchPlacer:
                 return self._wrap(arr, jax.device_put(arr, NamedSharding(self.mesh, PartitionSpec())))
             if arr.shape[0] % local_shards != 0:
                 # Pad the batch dim by repeating the final row so GSPMD can split
-                # it; device-level analog of even_batches wraparound.
+                # it; device-level analog of even_batches wraparound.  Repeated
+                # samples mutate training statistics, so this only happens under
+                # even_batches=True (whose epoch-level wraparound already accepts
+                # that trade) — even_batches=False errors instead.
+                if not self.even_batches:
+                    raise RuntimeError(
+                        f"Per-host batch dim {arr.shape[0]} is not divisible by "
+                        f"{local_shards} local data shards and even_batches=False "
+                        "forbids padding by sample repetition. Use a per-shard-"
+                        "divisible batch size, drop_last=True, or even_batches=True."
+                    )
                 if not self._warned_pad:
                     warnings.warn(
                         f"Per-host batch dim {arr.shape[0]} not divisible by {local_shards} local "
@@ -556,6 +570,7 @@ class DataLoaderShard(DataLoaderStateMixin):
         _drop_last: bool = False,
         _non_blocking: bool = False,
         use_stateful_dataloader: bool = False,
+        even_batches: bool = True,
         **kwargs,
     ):
         self.base_loader = base_loader
@@ -565,11 +580,18 @@ class DataLoaderShard(DataLoaderStateMixin):
         self.skip_batches = skip_batches
         self.put_on_device = put_on_device
         self.use_stateful_dataloader = use_stateful_dataloader
+        self.even_batches = even_batches
         self.gradient_state = GradientState()
         self.iteration = 0
         self._yielded = 0
         self._placer = (
-            _GlobalBatchPlacer(mesh, non_blocking, device=device, output_type=output_type)
+            _GlobalBatchPlacer(
+                mesh,
+                non_blocking,
+                device=device,
+                output_type=output_type,
+                even_batches=even_batches,
+            )
             if put_on_device
             else None
         )
@@ -723,17 +745,23 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
         slice_fn: Optional[Callable] = None,
         non_blocking: bool = False,
         output_type: str = "jax",
+        even_batches: bool = True,
         **kwargs,
     ):
         self.base_loader = base_loader
         self.split_batches = split_batches
         self.skip_batches = skip_batches
         self.use_stateful_dataloader = kwargs.pop("use_stateful_dataloader", False)
+        self.even_batches = even_batches
         self._yielded = 0
         self.state = PartialState()
         self.gradient_state = GradientState()
         self._placer = (
-            _GlobalBatchPlacer(mesh, non_blocking, output_type=output_type) if put_on_device else None
+            _GlobalBatchPlacer(
+                mesh, non_blocking, output_type=output_type, even_batches=even_batches
+            )
+            if put_on_device
+            else None
         )
         self.slice_fn = slice_fn or slice_tensors
         self.iteration = 0
@@ -958,6 +986,7 @@ def prepare_data_loader(
             non_blocking=non_blocking,
             output_type=output_type,
             use_stateful_dataloader=use_stateful_dataloader,
+            even_batches=even_batches,
         )
 
     if not is_torch_loader:
@@ -977,6 +1006,7 @@ def prepare_data_loader(
             non_blocking=non_blocking,
             output_type=output_type,
             use_stateful_dataloader=use_stateful_dataloader,
+            even_batches=even_batches,
         )
 
     import torch.utils.data
@@ -1026,6 +1056,7 @@ def prepare_data_loader(
             non_blocking=non_blocking,
             output_type=output_type,
             use_stateful_dataloader=use_stateful_dataloader,
+            even_batches=even_batches,
             total_batch_size=(dataloader.batch_size or 1)
             * (1 if split_batches else total_shards),
         )
@@ -1104,6 +1135,7 @@ def prepare_data_loader(
         non_blocking=non_blocking,
         output_type=output_type,
         use_stateful_dataloader=use_stateful_dataloader,
+        even_batches=even_batches,
     )
 
 
@@ -1155,6 +1187,7 @@ def skip_first_batches(dataloader, num_batches: int = 0):
             slice_fn=dataloader.slice_fn,
             output_type=dataloader._placer.output_type if dataloader._placer else "jax",
             use_stateful_dataloader=dataloader.use_stateful_dataloader,
+            even_batches=getattr(dataloader, "even_batches", True),
         )
         return out
     if isinstance(dataloader, DataLoaderShard):
@@ -1169,5 +1202,6 @@ def skip_first_batches(dataloader, num_batches: int = 0):
             output_type=dataloader._placer.output_type if dataloader._placer else "jax",
             total_batch_size=dataloader._total_batch_size,
             use_stateful_dataloader=dataloader.use_stateful_dataloader,
+            even_batches=getattr(dataloader, "even_batches", True),
         )
     return SkipDataLoader(dataloader, skip_batches=num_batches, put_on_device=False)

@@ -55,6 +55,20 @@ _FMT_MAX = {
 }
 
 
+def fp8_matmul_supported(device_kind: str) -> bool:
+    """Whether ``device_kind`` has hardware fp8 matmul units.
+
+    No shipped TPU generation through v6/Trillium executes float8 on the MXU —
+    XLA emulates via convert-to-bf16, so ``mixed_precision="fp8"`` pays pure
+    conversion overhead there (measured 0.843x vs bf16 on v5e,
+    ``BENCH_fp8.json``).  Unknown / future parts return True — the probe warns
+    only where the slowdown is a known fact.  CPU also returns False (emulated).
+    """
+    kind = device_kind.lower()
+    no_fp8 = ("v2", "v3", "v4", "v5", "v5 lite", "v5e", "v5p", "v6", "trillium", "cpu")
+    return not any(tag in kind for tag in no_fp8)
+
+
 def _fmt_max(dtype) -> float:
     return _FMT_MAX[jnp.dtype(dtype).type if not isinstance(dtype, type) else dtype]
 

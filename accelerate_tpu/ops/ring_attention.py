@@ -150,7 +150,12 @@ def _ring_body(
     # Mark accumulators device-varying over the ring axis so the fori_loop carry
     # type stays consistent (shard_map VMA rules).
     axes = tuple(vary_axes) or (axis_name,)
-    m0, l0, o0 = (jax.lax.pvary(x, axes) for x in (m0, l0, o0))
+    # (pvary was deprecated in jax 0.9 in favor of pcast(..., to="varying");
+    # keep the old spelling as a fallback for older jax.)
+    if hasattr(jax.lax, "pcast"):
+        m0, l0, o0 = (jax.lax.pcast(x, axes, to="varying") for x in (m0, l0, o0))
+    else:
+        m0, l0, o0 = (jax.lax.pvary(x, axes) for x in (m0, l0, o0))
 
     local_pos = jnp.arange(sq)
 

@@ -43,10 +43,23 @@ class AcceleratedScheduler:
         for opt in self.optimizers:
             opt.set_learning_rate(lr)
 
+    def _step_scheduler(self, *args, **kwargs):
+        """Step the wrapped torch scheduler without torch's "lr_scheduler.step()
+        before optimizer.step()" UserWarning: the optimizer here steps inside
+        the jit-compiled optax update, which torch's call-order tracker cannot
+        see, so the warning is a structural false positive."""
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message=".*lr_scheduler.step.*optimizer.step.*"
+            )
+            self.scheduler.step(*args, **kwargs)
+
     def step(self, *args, **kwargs):
         if not self.step_with_optimizer:
             if not self._is_callable:
-                self.scheduler.step(*args, **kwargs)
+                self._step_scheduler(*args, **kwargs)
             self._step_count += 1
             self._apply_lr()
             return
@@ -70,7 +83,7 @@ class AcceleratedScheduler:
         for _ in range(max(num_steps, 1)):
             self._step_count += 1
             if not self._is_callable:
-                self.scheduler.step(*args, **kwargs)
+                self._step_scheduler(*args, **kwargs)
         self._apply_lr()
 
     def get_last_lr(self):

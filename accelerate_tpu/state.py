@@ -22,6 +22,7 @@ from __future__ import annotations
 import contextlib
 import logging
 import os
+import warnings
 from functools import partial, wraps
 from typing import Any, Callable, Optional
 
@@ -478,6 +479,26 @@ class AcceleratorState:
             )
         self._mixed_precision = mixed_precision
         self.dtype_policy = MixedPrecisionPolicy.from_mixed_precision(mixed_precision)
+        if mixed_precision == "fp8":
+            # Capability probe (reference fp8 backend auto-pick pragmatism,
+            # accelerator.py:467-482): fp8 on a part without fp8 MXU is a
+            # measured SLOWDOWN (0.843x vs bf16 on v5e, BENCH_fp8.json) —
+            # warn rather than silently degrade.  Convergence-parity testing
+            # on such parts is still legitimate, so fp8 stays armed.
+            from .ops.fp8 import fp8_matmul_supported
+
+            try:
+                kind = jax.devices()[0].device_kind
+            except Exception:
+                kind = None
+            if kind is not None and not fp8_matmul_supported(kind):
+                warnings.warn(
+                    f"mixed_precision='fp8' on {kind!r}: this part has no fp8 "
+                    "matmul units, so XLA emulates float8 via conversion — "
+                    "measured 0.843x the speed of bf16 on v5e (BENCH_fp8.json). "
+                    "Use mixed_precision='bf16' for speed; keep fp8 only for "
+                    "numerics/parity work on this hardware."
+                )
 
         if fsdp_plugin is None and parse_flag_from_env("ACCELERATE_USE_FSDP"):
             from .utils.dataclasses import FullyShardedDataParallelPlugin

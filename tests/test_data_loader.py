@@ -437,6 +437,7 @@ def test_single_process_static_shape_tail_opt_in():
     np.testing.assert_array_equal(batches[2][:, 0], np.array([8, 9, 0, 1]))
 
 
+@pytest.mark.filterwarnings("ignore:Per-host batch dim")
 def test_nested_dataloader_restores_pad_counters():
     """An eval loader iterated INSIDE a train iteration must not clobber the
     outer loader's device-pad bookkeeping (advisor r2): end() restores the
@@ -693,3 +694,19 @@ def test_skip_first_batches_keeps_stateful_flag():
         assert dl2.use_stateful_dataloader
         list(dl2)
         assert dl2.state_dict()["batches_yielded"] == 0  # epoch completed
+
+
+def test_uneven_device_batch_errors_under_even_batches_false():
+    """even_batches=False means "never fabricate samples": a per-host batch the
+    device shards cannot split evenly must ERROR, not silently repeat the last
+    sample (which mutates training statistics).  even_batches=True keeps the
+    warn-and-pad wraparound analog."""
+    AcceleratorState()  # 8-device mesh
+    loader = prepare_data_loader(_make_loader(36, 4), even_batches=False)
+    with pytest.raises(RuntimeError, match="even_batches=False forbids padding"):
+        for _ in loader:
+            pass
+
+    with pytest.warns(UserWarning, match="Per-host batch dim"):
+        for _ in prepare_data_loader(_make_loader(36, 4), even_batches=True):
+            pass

@@ -165,3 +165,33 @@ def test_llama_fp8_trains_and_tracks_bf16():
     l8 = train(cfg8, params0)
     assert l8[-1] < l8[0] * 0.7, l8  # fp8 path trains
     assert abs(l8[-1] - l16[-1]) < 0.35 * l16[0], (l8, l16)  # tracks bf16 trajectory
+
+
+def test_fp8_capability_probe_warns_on_fp8less_parts():
+    """mixed_precision='fp8' on a part without fp8 MXU must warn (VERDICT r3
+    item 7): on v5e it is a measured 0.843x SLOWDOWN vs bf16, and silence
+    would let users degrade themselves.  CPU (the test platform) is also an
+    emulated-fp8 part, so the warning fires here exactly as on v5e."""
+    import warnings as _warnings
+
+    from accelerate_tpu.ops.fp8 import fp8_matmul_supported
+    from accelerate_tpu.state import AcceleratorState
+
+    assert not fp8_matmul_supported("TPU v5 lite")
+    assert not fp8_matmul_supported("TPU v5p")
+    assert not fp8_matmul_supported("cpu")
+    assert fp8_matmul_supported("SomeFutureChip x9000")
+
+    AcceleratorState._reset_state()
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        AcceleratorState(mixed_precision="fp8")
+    AcceleratorState._reset_state()
+    assert any("no fp8" in str(w.message) for w in caught)
+
+    # bf16 stays silent.
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        AcceleratorState(mixed_precision="bf16")
+    AcceleratorState._reset_state()
+    assert not any("no fp8" in str(w.message) for w in caught)

@@ -208,3 +208,221 @@ def test_pipeline_composes_with_sequence_parallelism():
         lambda p, b: pl.pipeline_llama_loss_fn(p, b, cfg, num_stages=2, num_micro_batches=2)
     )(sparams, sb))
     assert abs(dense_loss - pp_loss) < 3e-3, (dense_loss, pp_loss)
+
+
+# ---------------------------------------------------------------------------
+# Pipelined torch-bridged modules (VERDICT r3 item 6)
+# ---------------------------------------------------------------------------
+
+
+def _toy_torch_decoder(d=16, layers=4, vocab=32, seed=0):
+    import torch
+
+    torch.manual_seed(seed)
+
+    class Block(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = torch.nn.Linear(d, 2 * d)
+            self.fc2 = torch.nn.Linear(2 * d, d)
+            self.ln = torch.nn.LayerNorm(d)
+
+        def forward(self, x):
+            return x + self.fc2(torch.nn.functional.gelu(self.fc1(self.ln(x))))
+
+    class Decoder(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.embed = torch.nn.Embedding(vocab, d)
+            self.blocks = torch.nn.ModuleList([Block() for _ in range(layers)])
+            self.head = torch.nn.Linear(d, vocab, bias=False)
+
+        def forward(self, ids):
+            x = self.embed(ids)
+            for b in self.blocks:
+                x = b(x)
+            return self.head(x)
+
+    return Decoder()
+
+
+def test_pipelined_bridge_matches_plain_lowering():
+    """lower_module_pipelined must produce the same forward as plain
+    lower_module — the GPipe splice is a scheduling change, not a math one."""
+    import torch
+
+    from accelerate_tpu.utils.torch_bridge import lower_module, lower_module_pipelined
+
+    model = _toy_torch_decoder()
+    ids = torch.randint(0, 32, (8, 8))
+
+    AcceleratorState._reset_state()
+    state = AcceleratorState(parallelism_config=ParallelismConfig(pp=2, dp=4))
+    plain = lower_module(model)
+    piped = lower_module_pipelined(model, num_stages=2, num_micro_batches=2)
+    assert piped.n_blocks == 4 and piped.container == "blocks"
+    # Stacked layout: per-block keys collapsed into [L, ...] leaves.
+    assert "blocks._stacked.fc1.weight" in piped.params
+    assert not any(k.startswith("blocks.0.") for k in piped.params)
+
+    out_plain = np.asarray(jax.jit(plain.apply)(plain.params, plain.buffers, ids.numpy()))
+    out_piped = np.asarray(jax.jit(piped.apply)(piped.params, piped.buffers, ids.numpy()))
+    np.testing.assert_allclose(out_plain, out_piped, atol=2e-5, rtol=1e-5)
+
+    # unstack_state_dict restores torch names.
+    flat = {k: np.asarray(v) for k, v in piped.params.items()}
+    unstacked = piped.unstack_state_dict(flat)
+    np.testing.assert_allclose(
+        unstacked["blocks.2.fc1.weight"],
+        model.blocks[2].fc1.weight.detach().numpy(),
+        atol=1e-6,
+    )
+    AcceleratorState._reset_state()
+
+
+def test_prepare_pipelines_bridged_module_under_pp():
+    """Accelerator.prepare with pp>1 pipelines a torch module's block chain:
+    the prepared model trains (bridge mode) and its loss matches the pp=1
+    path on the same data."""
+    import torch
+
+    from accelerate_tpu import Accelerator
+
+    def run(pcfg):
+        AcceleratorState._reset_state()
+        from accelerate_tpu.state import GradientState, PartialState
+
+        GradientState._reset_state()
+        PartialState._reset_state()
+        acc = Accelerator(parallelism_config=pcfg)
+        model = _toy_torch_decoder(seed=3)
+        opt = torch.optim.AdamW(model.parameters(), lr=1e-3)
+        pm, popt = acc.prepare(model, opt)
+        ids = torch.arange(64, dtype=torch.long).reshape(8, 8) % 32
+        losses = []
+        for _ in range(3):
+            logits = pm(ids)
+            loss = torch.nn.functional.cross_entropy(
+                logits.reshape(-1, 32), ids.reshape(-1)
+            )
+            acc.backward(loss)
+            popt.step()
+            popt.zero_grad()
+            losses.append(float(loss))
+        return losses
+
+    base = run(ParallelismConfig(dp=8))
+    piped = run(ParallelismConfig(dp=4, pp=2))
+    np.testing.assert_allclose(base, piped, atol=1e-4, rtol=1e-4)
+    AcceleratorState._reset_state()
+
+
+def test_prepare_warns_when_bridged_module_not_pipelineable():
+    """pp>1 with a module that has no repeated-block chain must warn loudly
+    instead of silently dropping the pipeline schedule."""
+    import warnings as _w
+
+    import torch
+
+    from accelerate_tpu import Accelerator
+
+    AcceleratorState._reset_state()
+    acc = Accelerator(parallelism_config=ParallelismConfig(dp=4, pp=2))
+    model = torch.nn.Linear(4, 4)
+    with _w.catch_warnings(record=True) as caught:
+        _w.simplefilter("always")
+        acc.prepare(model)
+    AcceleratorState._reset_state()
+    assert any("cannot be pipelined" in str(w.message) for w in caught)
+
+
+def test_pipelined_bridge_state_roundtrip_and_unwrap():
+    """Stacked block params must never leak: state_dict/unwrap emit torch
+    per-block names, and load_state_dict accepts either layout."""
+    import torch
+
+    from accelerate_tpu import Accelerator
+
+    AcceleratorState._reset_state()
+    acc = Accelerator(parallelism_config=ParallelismConfig(dp=4, pp=2))
+    model = _toy_torch_decoder(seed=5)
+    ref_w = model.blocks[3].fc2.weight.detach().numpy().copy()
+    pm = acc.prepare(model)
+
+    sd = pm.state_dict()
+    assert "blocks.3.fc2.weight" in sd
+    assert not any("_stacked" in k for k in sd)
+    np.testing.assert_allclose(np.asarray(sd["blocks.3.fc2.weight"]), ref_w, atol=1e-6)
+
+    # unwrap copies trained weights back into the torch module by name.
+    model.blocks[3].fc2.weight.data.zero_()
+    unwrapped = acc.unwrap_model(pm)
+    np.testing.assert_allclose(
+        unwrapped.blocks[3].fc2.weight.detach().numpy(), ref_w, atol=1e-6
+    )
+
+    # Torch-layout dict loads back into the stacked params.
+    pm.load_state_dict(sd)
+    np.testing.assert_allclose(
+        np.asarray(pm.state_dict()["blocks.3.fc2.weight"]), ref_w, atol=1e-6
+    )
+    AcceleratorState._reset_state()
+
+
+def test_pipelined_bridge_skips_shadowing_inner_container():
+    """An inner repeated container with MORE children than the layer stack
+    (MoE experts) must not shadow the pipelineable block chain."""
+    import torch
+
+    from accelerate_tpu.utils.torch_bridge import lower_module_pipelined
+
+    d = 8
+
+    class Expert(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc = torch.nn.Linear(d, d)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    class MoEBlock(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.experts = torch.nn.ModuleList([Expert() for _ in range(8)])
+            self.ln = torch.nn.LayerNorm(d)
+
+        def forward(self, x):
+            h = self.ln(x)
+            out = self.experts[0](h)
+            for e in self.experts[1:]:
+                out = out + e(h)
+            return x + out / 8
+
+    class Net(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.blocks = torch.nn.ModuleList([MoEBlock() for _ in range(4)])
+
+        def forward(self, x):
+            for b in self.blocks:
+                x = b(x)
+            return x
+
+    torch.manual_seed(0)
+    net = Net()
+    AcceleratorState._reset_state()
+    AcceleratorState(parallelism_config=ParallelismConfig(pp=2, dp=4))
+    piped = lower_module_pipelined(net, num_stages=2, num_micro_batches=2)
+    assert piped.container == "blocks" and piped.n_blocks == 4
+    x = torch.randn(4, d)
+    from accelerate_tpu.utils.torch_bridge import lower_module
+
+    plain = lower_module(net)
+    np.testing.assert_allclose(
+        np.asarray(piped.apply(piped.params, piped.buffers, x.numpy())),
+        np.asarray(plain.apply(plain.params, plain.buffers, x.numpy())),
+        atol=2e-5,
+        rtol=1e-5,
+    )
+    AcceleratorState._reset_state()
