@@ -1237,7 +1237,9 @@ class Accelerator:
                 lowered = getattr(model, "_lowered", None)
                 if lowered is not None and hasattr(lowered, "unstack_state_dict"):
                     flat = lowered.unstack_state_dict(flat)
-                sd = {k: torch.from_numpy(np.asarray(v)) for k, v in flat.items()}
+                # np.array(copy) — device_get hands back read-only views that
+                # torch.from_numpy warns about.
+                sd = {k: torch.from_numpy(np.array(v)) for k, v in flat.items()}
                 model.module.load_state_dict(sd, strict=False)
                 return model.module
             return model

@@ -48,6 +48,28 @@ def convert_config(src: dict) -> ClusterConfig:
     cfg.main_process_port = int(port) if port not in (None, "") else None
     cfg.gradient_accumulation_steps = int(src.get("gradient_accumulation_steps", 1))
 
+    def _truthy(v) -> bool:
+        # Single boolean-string domain with the rest of the codebase
+        # (launcher _flag_bool / questionnaire _yes_no use the same parser).
+        from ..utils.environment import str_to_bool
+
+        try:
+            return bool(str_to_bool(str(v)))
+        except ValueError:
+            return False
+
+    # Dynamo config carries over verbatim (inert on the native path, consumed
+    # by torch-bridge ingestion via ACCELERATE_DYNAMO_*).
+    dyn = src.get("dynamo_config", {}) or {}
+    if dyn.get("dynamo_backend"):
+        cfg.dynamo_backend = str(dyn["dynamo_backend"]).lower()
+    if dyn.get("dynamo_mode"):
+        cfg.dynamo_mode = str(dyn["dynamo_mode"])
+    if dyn.get("dynamo_use_fullgraph") is not None:
+        cfg.dynamo_use_fullgraph = _truthy(dyn["dynamo_use_fullgraph"])
+    if dyn.get("dynamo_use_dynamic") is not None:
+        cfg.dynamo_use_dynamic = _truthy(dyn["dynamo_use_dynamic"])
+
     if dist in ("FSDP",):
         cfg.use_fsdp = True
         cfg.fsdp = 0  # all devices
@@ -58,9 +80,33 @@ def convert_config(src: dict) -> ClusterConfig:
         int_map = {"1": "FULL_SHARD", "2": "SHARD_GRAD_OP", "3": "NO_SHARD", "4": "HYBRID_SHARD"}
         cfg.fsdp_sharding_strategy = int_map.get(strategy, strategy)
         cfg.fsdp_min_num_params = int(fsdp_cfg.get("fsdp_min_num_params", 0))
+        # FSDP2 spelling: reshard_after_forward replaces the strategy enum.
+        if fsdp_cfg.get("fsdp_reshard_after_forward") is not None:
+            raf = fsdp_cfg["fsdp_reshard_after_forward"]
+            if str(raf).upper() in ("TRUE", "FALSE", "1", "0", "YES", "NO"):
+                cfg.fsdp_reshard_after_forward = _truthy(raf)
+                cfg.fsdp_sharding_strategy = (
+                    "FULL_SHARD" if cfg.fsdp_reshard_after_forward else "SHARD_GRAD_OP"
+                )
+        if fsdp_cfg.get("fsdp_version"):
+            cfg.fsdp_version = int(fsdp_cfg["fsdp_version"])
+        if fsdp_cfg.get("fsdp_offload_params") is not None:
+            cfg.fsdp_cpu_offload = _truthy(fsdp_cfg["fsdp_offload_params"])
+        if fsdp_cfg.get("fsdp_auto_wrap_policy"):
+            cfg.fsdp_auto_wrap_policy = str(fsdp_cfg["fsdp_auto_wrap_policy"])
+        if fsdp_cfg.get("fsdp_transformer_layer_cls_to_wrap"):
+            cfg.fsdp_transformer_layer_cls_to_wrap = str(
+                fsdp_cfg["fsdp_transformer_layer_cls_to_wrap"]
+            )
+        if fsdp_cfg.get("fsdp_state_dict_type"):
+            cfg.fsdp_state_dict_type = str(fsdp_cfg["fsdp_state_dict_type"]).upper()
+        if fsdp_cfg.get("fsdp_activation_checkpointing") is not None:
+            cfg.fsdp_activation_checkpointing = _truthy(fsdp_cfg["fsdp_activation_checkpointing"])
     elif dist == "DEEPSPEED":
         ds_cfg = src.get("deepspeed_config", {}) or {}
         stage = int(ds_cfg.get("zero_stage", 2))
+        cfg.use_deepspeed = True
+        cfg.zero_stage = stage
         cfg.use_fsdp = stage >= 1
         cfg.fsdp = 0 if stage >= 1 else 1
         cfg.fsdp_sharding_strategy = "FULL_SHARD" if stage == 3 else "SHARD_GRAD_OP"
@@ -70,11 +116,32 @@ def convert_config(src: dict) -> ClusterConfig:
             # A full ds_config.json keeps flowing through the dialect
             # (utils/deepspeed.py consumes it at prepare time).
             cfg.deepspeed_config_file = str(ds_cfg["deepspeed_config_file"])
+        for key in ("offload_optimizer_device", "offload_param_device"):
+            if ds_cfg.get(key) not in (None, ""):
+                setattr(cfg, key, str(ds_cfg[key]))
+        if ds_cfg.get("gradient_clipping") not in (None, "", "none"):
+            cfg.gradient_clipping = float(ds_cfg["gradient_clipping"])
+        if ds_cfg.get("zero3_init_flag") is not None:
+            cfg.zero3_init_flag = _truthy(ds_cfg["zero3_init_flag"])
+        if ds_cfg.get("zero3_save_16bit_model") is not None:
+            cfg.zero3_save_16bit_model = _truthy(ds_cfg["zero3_save_16bit_model"])
+        if ds_cfg.get("deepspeed_moe_layer_cls_names"):
+            cfg.deepspeed_moe_layer_cls_names = str(ds_cfg["deepspeed_moe_layer_cls_names"])
     elif dist == "MEGATRON_LM":
         mlm = src.get("megatron_lm_config", {}) or {}
-        cfg.tp = int(mlm.get("megatron_lm_tp_degree", 1))
-        cfg.pp = int(mlm.get("megatron_lm_pp_degree", 1))
-        if str(mlm.get("megatron_lm_use_distributed_optimizer", "")).lower() in ("1", "true", "yes"):
+        cfg.use_megatron_lm = True
+        cfg.tp = cfg.megatron_lm_tp_degree = int(mlm.get("megatron_lm_tp_degree", 1))
+        cfg.pp = cfg.megatron_lm_pp_degree = int(mlm.get("megatron_lm_pp_degree", 1))
+        if mlm.get("megatron_lm_num_micro_batches") is not None:
+            cfg.megatron_lm_num_micro_batches = int(mlm["megatron_lm_num_micro_batches"])
+        if mlm.get("megatron_lm_sequence_parallelism") is not None:
+            cfg.megatron_lm_sequence_parallelism = _truthy(mlm["megatron_lm_sequence_parallelism"])
+        if mlm.get("megatron_lm_recompute_activations") is not None:
+            cfg.megatron_lm_recompute_activations = _truthy(mlm["megatron_lm_recompute_activations"])
+        if mlm.get("megatron_lm_gradient_clipping") not in (None, "", "none"):
+            cfg.megatron_lm_gradient_clipping = float(mlm["megatron_lm_gradient_clipping"])
+        if _truthy(mlm.get("megatron_lm_use_distributed_optimizer", "")):
+            cfg.megatron_lm_use_distributed_optimizer = True
             cfg.use_fsdp = True
             cfg.fsdp = 0
             cfg.fsdp_sharding_strategy = "SHARD_GRAD_OP"

@@ -325,6 +325,12 @@ def _merge(args, cfg: ClusterConfig):
         "fp8_interval",
         "fp8_amax_history_len",
         "fp8_amax_compute_algo",
+        "dynamo_backend",
+        "dynamo_mode",
+        "dynamo_use_fullgraph",
+        "dynamo_use_dynamic",
+        "deepspeed_moe_layer_cls_names",
+        "sp_impl",
         "main_training_function",
         "num_cpu_threads_per_process",
         "env",
@@ -370,6 +376,7 @@ def build_env(merged: dict, debug: bool = False, cpu: bool = False) -> dict:
             ("offload_param_device", "ACCELERATE_DEEPSPEED_OFFLOAD_PARAM_DEVICE"),
             ("zero3_init_flag", "ACCELERATE_DEEPSPEED_ZERO3_INIT"),
             ("zero3_save_16bit_model", "ACCELERATE_DEEPSPEED_ZERO3_SAVE_16BIT_MODEL"),
+            ("deepspeed_moe_layer_cls_names", "ACCELERATE_DEEPSPEED_MOE_LAYER_CLS_NAMES"),
         ):
             if merged.get(dest) is not None:
                 env[var] = str(merged[dest])
@@ -388,6 +395,15 @@ def build_env(merged: dict, debug: bool = False, cpu: bool = False) -> dict:
                 env[var] = str(merged[dest])
     if merged.get("gradient_clipping") is not None:
         env["ACCELERATE_GRADIENT_CLIPPING"] = str(merged["gradient_clipping"])
+    for dest, var in (
+        ("dynamo_backend", "ACCELERATE_DYNAMO_BACKEND"),
+        ("dynamo_mode", "ACCELERATE_DYNAMO_MODE"),
+        ("dynamo_use_fullgraph", "ACCELERATE_DYNAMO_USE_FULLGRAPH"),
+        ("dynamo_use_dynamic", "ACCELERATE_DYNAMO_USE_DYNAMIC"),
+        ("sp_impl", "ACCELERATE_SP_IMPL"),
+    ):
+        if merged.get(dest) is not None:
+            env[var] = str(merged[dest])
     for dest, var in (
         ("fp8_backend", "ACCELERATE_FP8_BACKEND"),
         ("fp8_format", "ACCELERATE_FP8_FORMAT"),

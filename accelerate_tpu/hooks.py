@@ -239,6 +239,8 @@ def set_module_tensor_to_device(
             if arr.dtype.name == "bfloat16":  # ml_dtypes bfloat16 -> torch view
                 value = torch.from_numpy(arr.view(np.uint16).copy()).view(torch.bfloat16)
             else:
+                if not arr.flags.writeable:
+                    arr = arr.copy()  # read-only views make torch warn
                 value = torch.as_tensor(arr)
         if (
             old is not None

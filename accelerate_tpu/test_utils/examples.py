@@ -74,7 +74,8 @@ def compare_against_test(
 def uses_base_loader(feature_filename: str) -> bool:
     """True when a by_feature script routes through ``_base`` (our structural
     sync mechanism: the canonical example is imported, not copied)."""
-    tree = ast.parse(open(feature_filename).read())
+    with open(feature_filename) as f:
+        tree = ast.parse(f.read())
     for node in ast.walk(tree):
         if isinstance(node, ast.ImportFrom) and node.module == "_base":
             return True

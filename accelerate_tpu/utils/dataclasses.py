@@ -618,9 +618,18 @@ class SequenceParallelPlugin:
     attention over the ``sp`` mesh axis."""
 
     sp_size: int = 1
-    mode: str = "ring"  # "ring" (blockwise ring attention) | "allgather" (Ulysses-style)
+    # "ring" (blockwise ring attention) | "allgather" (Ulysses-style).  None =
+    # unset: filled from ACCELERATE_SP_IMPL (the launcher env contract), else
+    # "ring" — an explicit code-level mode always wins over the env.
+    mode: Optional[str] = None
 
     def __post_init__(self):
+        if self.mode is None:
+            self.mode = os.environ.get("ACCELERATE_SP_IMPL", "ring")
+        # The questionnaire/launcher say "ulysses"; the engine spelling for the
+        # all-to-all schedule is "allgather".
+        if self.mode == "ulysses":
+            self.mode = "allgather"
         if self.mode not in ("ring", "allgather"):
             raise ValueError(f"Unknown sequence-parallel mode {self.mode!r}")
 

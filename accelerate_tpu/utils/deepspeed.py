@@ -134,6 +134,10 @@ class DeepSpeedPlugin:
             self.zero3_save_16bit_model = (
                 env.get("ACCELERATE_DEEPSPEED_ZERO3_SAVE_16BIT_MODEL", "false") == "true"
             )
+        if self.transformer_moe_cls_names is None:
+            self.transformer_moe_cls_names = env.get(
+                "ACCELERATE_DEEPSPEED_MOE_LAYER_CLS_NAMES"
+            )
 
         if self.hf_ds_config is not None and not isinstance(self.hf_ds_config, HfDeepSpeedConfig):
             self.hf_ds_config = HfDeepSpeedConfig(self.hf_ds_config)

@@ -364,7 +364,10 @@ def _numpy_to_torch(arr: np.ndarray):
 
     if arr.dtype.name == "bfloat16":  # ml_dtypes bf16 -> torch via uint16 view
         return torch.from_numpy(arr.view(np.uint16).copy()).view(torch.bfloat16)
-    return torch.from_numpy(np.ascontiguousarray(arr))
+    arr = np.ascontiguousarray(arr)
+    if not arr.flags.writeable:
+        arr = arr.copy()  # read-only views make torch.from_numpy warn
+    return torch.from_numpy(arr)
 
 
 def gather_object(object: Any):

@@ -39,7 +39,8 @@ def _torch_baseline(num_epochs=3, lr=0.1, batch_size=16):
             loss = F.mse_loss(model(batch["x"]), batch["y"])
             loss.backward()
             opt.step()
-    return float(model.a), float(model.b)
+    with torch.no_grad():
+        return model.a.item(), model.b.item()
 
 
 def _accelerated_run(model_cls, fused: bool, num_epochs=3, lr=0.1, batch_size=16, accum=1):
@@ -201,7 +202,7 @@ def test_save_load_state_roundtrip(tmp_path):
     # Perturb, reload, verify.
     model.params = {k: v * 0 for k, v in model.params.items()}
     accelerator.load_state(str(tmp_path / "ckpt"))
-    assert float(np.asarray(model.params["a"])) == pytest.approx(a_trained)
+    assert np.asarray(model.params["a"]).reshape(()) == pytest.approx(a_trained)
     # Optimizer state restored (adam moments non-zero).
     import jax
 
@@ -222,8 +223,8 @@ def test_unwrap_model_roundtrips_weights():
     model = RegressionModel(a=1.5, b=-0.5)
     prepared = accelerator.prepare(model)
     unwrapped = accelerator.unwrap_model(prepared)
-    assert float(unwrapped.a) == pytest.approx(1.5)
-    assert float(unwrapped.b) == pytest.approx(-0.5)
+    assert unwrapped.a.item() == pytest.approx(1.5)
+    assert unwrapped.b.item() == pytest.approx(-0.5)
 
 
 def test_clip_grad_value():
@@ -489,9 +490,11 @@ def test_can_unwrap_model_and_pickle():
     )
 
 
+@pytest.mark.filterwarnings("ignore:.*torch.jit.script_method.*:DeprecationWarning")
 def test_can_unwrap_distributed_compiled_model():
     """Reference :624/:636 — compile + DataParallel peel in both
-    keep_torch_compile modes."""
+    keep_torch_compile modes (torch.compile itself emits a torch-internal
+    jit.script_method deprecation; not ours to fix)."""
     import torch
 
     from accelerate_tpu import Accelerator
@@ -585,7 +588,7 @@ def test_save_load_model_with_hooks(tmp_path):
     from safetensors.numpy import load_file
 
     saved = load_file(os.path.join(ckpt, "model.safetensors"))
-    assert float(saved["a"]) == 42.0  # the hook's mutation was written
+    assert saved["a"].reshape(()) == 42.0  # the hook's mutation was written
     acc.load_state(ckpt)
     assert loaded["class_name"]
 

@@ -33,7 +33,8 @@ def test_sharded_safetensors_index_roundtrip(tmp_path):
     m = _FakeModel(arrays)
     out = save_model_weights(m, str(tmp_path), max_shard_size=40_000)  # ~16KB/tensor -> multiple shards
     assert out.endswith("index.json")
-    index = json.load(open(out))
+    with open(out) as f:
+        index = json.load(f)
     shard_files = set(index["weight_map"].values())
     assert len(shard_files) >= 2
     assert index["metadata"]["total_size"] == sum(a.nbytes for a in arrays.values())

@@ -332,37 +332,169 @@ def test_full_reference_launch_command_parses():
     assert env["FOO"] == "bar" and env["BAZ"] == "qux"
 
 
-def test_config_questionnaire_covers_cluster_questions(monkeypatch, tmp_path):
-    """The interactive flow asks the native-meaning cluster questions and
-    writes a loadable config."""
+def _drive_config(monkeypatch, tmp_path, answers):
+    """Answer-injection driver for the guided questionnaire: monkeypatched
+    input() feeds both _ask_field prompts and the BulletMenu's numbered
+    fallback (stdin is not a TTY under pytest)."""
     from accelerate_tpu.commands.config import config_command, load_config
 
-    answers = iter([
-        "2",            # machines
-        "0",            # rank
-        "10.0.0.2",     # ip
-        "29501",        # port
-        "bf16",         # precision
-        "4",            # grad accum
-        "yes",          # fsdp
-        "0",            # fsdp size
-        "FULL_SHARD",   # strategy
-        "1000000",      # min params
-        "2",            # tp
-        "1",            # sp
-        "2",            # pp
-        "1",            # ep
-        "no",           # deepspeed
-        "no",           # pod
-    ])
-    monkeypatch.setattr("builtins.input", lambda prompt="": next(answers))
+    it = iter(answers)
+    monkeypatch.setattr("builtins.input", lambda prompt="": next(it))
     path = tmp_path / "cfg.yaml"
     config_command(argparse.Namespace(config_file=str(path), default=False, update=False))
-    cfg = load_config(str(path))
+    leftover = list(it)
+    assert not leftover, f"unconsumed answers: {leftover}"
+    return load_config(str(path))
+
+
+def test_config_guided_fsdp_flow(monkeypatch, tmp_path):
+    """The FSDP guided flow covers the reference's per-strategy question set
+    (cluster.py:383-503) and writes a loadable config."""
+    cfg = _drive_config(monkeypatch, tmp_path, [
+        "2",             # machines
+        "0",             # rank
+        "10.0.0.2",      # ip
+        "29501",         # port
+        "no",            # GCP pod?
+        "no",            # configure dynamo?
+        "1",             # strategy menu -> FSDP
+        "1",             # fsdp version -> 1 (asks the strategy enum)
+        "0",             # sharding strategy menu -> FULL_SHARD
+        "0",             # fsdp axis size (0=all)
+        "no",            # cpu offload
+        "1",             # wrap policy menu -> SIZE_BASED_WRAP
+        "1000000",       # min num params
+        "0",             # state dict menu -> SHARDED_STATE_DICT
+        "yes",           # activation checkpointing
+        "2",             # tp
+        "1",             # sp
+        "2",             # pp
+        "1",             # ep
+        "1",             # precision menu -> bf16
+        "yes",           # downcast_bf16
+        "4",             # grad accum
+    ])
     assert cfg.num_machines == 2 and cfg.main_process_ip == "10.0.0.2"
-    assert cfg.gradient_accumulation_steps == 4
-    assert cfg.use_fsdp and cfg.fsdp_min_num_params == 1000000
+    assert cfg.use_fsdp and cfg.fsdp_version == 1
+    assert cfg.fsdp_sharding_strategy == "FULL_SHARD"
+    # v1 keeps the enum authoritative: no reshard flag for the launcher's
+    # FSDP2-spelling override to rewrite it with.
+    assert cfg.fsdp_reshard_after_forward is None
+    assert cfg.fsdp_auto_wrap_policy == "SIZE_BASED_WRAP"
+    assert cfg.fsdp_min_num_params == 1000000
+    assert cfg.fsdp_state_dict_type == "SHARDED_STATE_DICT"
+    assert cfg.fsdp_activation_checkpointing is True
     assert cfg.tp == 2 and cfg.pp == 2
+    assert cfg.mixed_precision == "bf16" and cfg.downcast_bf16
+    assert cfg.gradient_accumulation_steps == 4
+
+
+def test_config_guided_deepspeed_flow(monkeypatch, tmp_path):
+    """DeepSpeed guided flow: zero stage + offload + clipping + MoE
+    (reference cluster.py:228-380); stage 3 maps onto FULL_SHARD fsdp."""
+    cfg = _drive_config(monkeypatch, tmp_path, [
+        "1",             # machines
+        "no",            # dynamo?
+        "2",             # strategy menu -> DeepSpeed
+        "no",            # json file?
+        "3",             # zero stage menu -> 3
+        "1",             # offload optimizer -> cpu
+        "1",             # offload params -> cpu
+        "yes",           # zero.Init
+        "yes",           # save 16-bit
+        "2",             # grad accum (asked once, in the guided ds flow)
+        "yes",           # grad clipping?
+        "0.5",           # clipping value
+        "yes",           # MoE?
+        "MixtralSparseMoeBlock",  # layer cls names
+        "2",             # ep size
+        "1",             # precision -> bf16
+        "no",            # downcast
+    ])
+    assert cfg.use_deepspeed and cfg.zero_stage == 3
+    assert cfg.gradient_accumulation_steps == 2  # not re-asked at the end
+    assert cfg.offload_optimizer_device == "cpu" and cfg.offload_param_device == "cpu"
+    assert cfg.zero3_init_flag and cfg.zero3_save_16bit_model
+    assert cfg.gradient_clipping == 0.5
+    assert cfg.deepspeed_moe_layer_cls_names == "MixtralSparseMoeBlock" and cfg.ep == 2
+    assert cfg.use_fsdp and cfg.fsdp_sharding_strategy == "FULL_SHARD"
+
+
+def test_config_guided_megatron_flow(monkeypatch, tmp_path):
+    """Megatron guided flow: degrees map onto the tp/pp/sp mesh axes and the
+    distributed optimizer maps onto SHARD_GRAD_OP (cluster.py:505-560)."""
+    cfg = _drive_config(monkeypatch, tmp_path, [
+        "1",             # machines
+        "yes",           # dynamo?
+        "3",             # backend menu -> inductor
+        "yes",           # customize?
+        "1",             # mode menu -> reduce-overhead
+        "no",            # fullgraph
+        "yes",           # dynamic
+        "3",             # strategy menu -> Megatron
+        "2",             # tp degree
+        "yes",           # sequence parallelism
+        "2",             # sp size
+        "1",             # sp impl menu -> ulysses
+        "2",             # pp degree
+        "4",             # micro batches
+        "yes",           # recompute
+        "yes",           # distributed optimizer
+        "1.0",           # grad clipping
+        "1",             # precision -> bf16
+        "no",            # downcast
+        "1",             # grad accum
+    ])
+    assert cfg.use_megatron_lm
+    assert cfg.tp == 2 and cfg.pp == 2 and cfg.sp == 2 and cfg.sp_impl == "ulysses"
+    assert cfg.megatron_lm_num_micro_batches == 4
+    assert cfg.megatron_lm_use_distributed_optimizer is True
+    assert cfg.use_fsdp and cfg.fsdp_sharding_strategy == "SHARD_GRAD_OP"
+    assert cfg.dynamo_backend == "inductor" and cfg.dynamo_mode == "reduce-overhead"
+    assert cfg.dynamo_use_dynamic is True
+
+
+def test_config_yaml_feeds_launch_env(monkeypatch, tmp_path):
+    """A questionnaire-produced yaml flows through _merge/build_env into the
+    worker env contract (FSDP_*/ACCELERATE_DYNAMO_*/MEGATRON_LM_*)."""
+    from accelerate_tpu.commands.config import load_config
+    from accelerate_tpu.commands.launch import _merge, build_env, launch_command_parser
+
+    cfg = _drive_config(monkeypatch, tmp_path, [
+        "1", "no",          # machines, dynamo
+        "1",                # strategy -> FSDP
+        "2", "yes",         # fsdp version 2 -> reshard (replaces the enum)
+        "0", "yes",         # axis size, cpu offload
+        "0", "LlamaDecoderLayer",             # wrap policy TRANSFORMER + cls
+        "1", "no",          # state dict FULL, no act ckpt
+        "1", "2", "0",      # tp, sp -> 2, sp impl ring
+        "1", "1",           # pp, ep
+        "1", "no", "1",     # precision bf16, no downcast, accum
+    ])
+    parser = launch_command_parser()
+    args = parser.parse_args(["script.py"])
+    env = build_env(_merge(args, cfg))
+    assert env["ACCELERATE_USE_FSDP"] == "1"
+    assert env["FSDP_CPU_OFFLOAD"] == "1"
+    assert env["FSDP_TRANSFORMER_CLS_TO_WRAP"] == "LlamaDecoderLayer"
+    assert env["FSDP_STATE_DICT_TYPE"] == "FULL_STATE_DICT"
+    assert env["ACCELERATE_PARALLELISM_SP"] == "2"
+    assert env["ACCELERATE_SP_IMPL"] == "ring"
+
+
+def test_bullet_menu_numbered_fallback(monkeypatch, capsys):
+    """Non-TTY stdin uses the numbered prompt with validation retry."""
+    from accelerate_tpu.commands.menu import BulletMenu
+
+    answers = iter(["9", "x", "2"])
+    monkeypatch.setattr("builtins.input", lambda prompt="": next(answers))
+    assert BulletMenu("pick", ["a", "b", "c"]).run() == 2
+    out = capsys.readouterr().out
+    assert "[0] a" in out and "Out of range" in out and "Please enter a number." in out
+    # Empty input returns the default.
+    answers = iter([""])
+    monkeypatch.setattr("builtins.input", lambda prompt="": next(answers))
+    assert BulletMenu("pick", ["a", "b"]).run(default=1) == 1
 
 
 def test_config_update_migrates_and_drops_unknown(tmp_path):
@@ -373,12 +505,13 @@ def test_config_update_migrates_and_drops_unknown(tmp_path):
         "mixed_precision": "fp16",
         "tp": 4,
         "obsolete_knob": True,          # dropped
-        "dynamo_backend": "inductor",   # dropped
+        "dynamo_backend": "inductor",   # known since the guided-flow schema: kept
     }))
     dropped = update_config_command(argparse.Namespace(config_file=str(path)))
-    assert dropped == ["dynamo_backend", "obsolete_knob"]
+    assert dropped == ["obsolete_knob"]
     cfg = load_config(str(path))
     assert cfg.mixed_precision == "fp16" and cfg.tp == 4
+    assert cfg.dynamo_backend == "inductor"
     assert cfg.num_machines == 1  # defaults filled
 
 

@@ -696,17 +696,16 @@ def test_skip_first_batches_keeps_stateful_flag():
         assert dl2.state_dict()["batches_yielded"] == 0  # epoch completed
 
 
-def test_uneven_device_batch_errors_under_even_batches_false():
-    """even_batches=False means "never fabricate samples": a per-host batch the
-    device shards cannot split evenly must ERROR, not silently repeat the last
-    sample (which mutates training statistics).  even_batches=True keeps the
-    warn-and-pad wraparound analog."""
+def test_uneven_device_batch_pads_and_warns_regardless_of_even_batches():
+    """Decision pinned (r4): the device-level shard-divisibility pad always
+    pads (a global jax.Array must divide across local shards) and warns once —
+    for even_batches=False too, whose semantics live in the host-level index
+    math (the shipped test_distributed_data_loop script asserts that contract).
+    The pad rows are published on GradientState for gather_for_metrics."""
     AcceleratorState()  # 8-device mesh
-    loader = prepare_data_loader(_make_loader(36, 4), even_batches=False)
-    with pytest.raises(RuntimeError, match="even_batches=False forbids padding"):
-        for _ in loader:
-            pass
-
-    with pytest.warns(UserWarning, match="Per-host batch dim"):
-        for _ in prepare_data_loader(_make_loader(36, 4), even_batches=True):
-            pass
+    gs = GradientState()
+    for even in (False, True):
+        with pytest.warns(UserWarning, match="Per-host batch dim"):
+            for _ in prepare_data_loader(_make_loader(36, 4), even_batches=even):
+                pass
+        assert gs.device_pad_rows == 0  # reset after the loader ends
