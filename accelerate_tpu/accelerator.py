@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import collections
 import contextlib
+import functools
 import os
 import warnings
 from typing import Any, Callable, Optional, Union
@@ -865,15 +866,29 @@ class Accelerator:
         return False
 
     def lomo_backward(self, loss, learning_rate: float):
-        """Reference ``accelerator.py:2580``: fused LOMO backward+step.  The
-        torch lomo-optim package is CUDA-oriented and not part of this image;
-        the native path already fuses grad computation and the optimizer
-        update into one jitted step, which is LOMO's purpose."""
-        raise NotImplementedError(
-            "lomo_backward requires the lomo-optim torch package (not available "
-            "on TPU). The native path fuses backward+step already: prepare a "
-            "torch optimizer and call accelerator.backward(loss); optimizer.step()."
-        )
+        """Reference ``accelerator.py:2580`` (lomo-optim's fused
+        backward+step), implemented natively: compute gradients and fold them
+        into the parameters with one jitted, donated SGD update — no optimizer
+        state is ever allocated and the gradient tree dies inside the fused
+        update, which is LOMO's memory-saving contract.  Under
+        ``accumulate()`` the update happens at the sync boundary (gradients
+        accumulate as usual until then)."""
+        # backward() routes the loss to exactly one model; update ONLY that
+        # one — other prepared models may hold accumulated grads for their own
+        # optimizers (multi-model setups must not get a stray SGD step).
+        before = [m._accum_grads for m in self._models]
+        self.backward(loss)
+        if not self.sync_gradients:
+            return
+        for model, prior in zip(self._models, before):
+            if model._accum_grads is prior:
+                continue
+            grads = model._consume_grads()
+            if grads is None:
+                continue
+            model._set_params(
+                _lomo_sgd_update(model.params, grads, jnp.asarray(learning_rate))
+            )
 
     def split_between_processes(self, inputs, apply_padding: bool = False):
         return self.state.split_between_processes(inputs, apply_padding)
@@ -1485,6 +1500,13 @@ class Accelerator:
 
     def __repr__(self):
         return f"Accelerator(state={self.state!r})"
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _lomo_sgd_update(params, grads, lr):
+    """Fused SGD fold-in for lomo_backward: params are donated so the update
+    is in-place in HBM and the grads tree is dead after the call."""
+    return jax.tree_util.tree_map(lambda p, g: p - lr * g.astype(p.dtype), params, grads)
 
 
 def _is_optax_tx(obj) -> bool:

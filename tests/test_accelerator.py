@@ -710,5 +710,47 @@ def test_facade_member_parity(tmp_path):
     acc.gradient_state._set_sync_gradients(False)
     acc.trigger_sync_in_backward(model)
     assert acc.sync_gradients is True
-    with pytest.raises(NotImplementedError, match="lomo"):
+    # lomo_backward is implemented natively (r4); an unattributable loss still
+    # fails loudly through the backward() association contract.
+    with pytest.raises(RuntimeError, match="could not associate|no autograd"):
         acc.lomo_backward(torch.tensor(1.0), 0.1)
+
+
+def test_lomo_backward_fused_sgd_update():
+    """lomo_backward folds grads into params with no optimizer state: the
+    result matches plain SGD on the same data (reference accelerator.py:2580
+    fused-backward contract, native jitted-update design)."""
+    from accelerate_tpu.test_utils.training import RegressionModel
+
+    def run_lomo(lr=0.05, steps=4):
+        AcceleratorState._reset_state()
+        acc = Accelerator()
+        model = acc.prepare(RegressionModel(a=2.0, b=1.0))
+        x = torch.arange(8, dtype=torch.float32).unsqueeze(1)
+        y = 3.0 * x - 0.5
+        for _ in range(steps):
+            loss = F.mse_loss(model(x), y)
+            acc.lomo_backward(loss, learning_rate=lr)
+        assert model._accum_grads is None  # grads died inside the update
+        assert not acc._optimizers  # no optimizer state anywhere
+        return {k: np.asarray(v).copy() for k, v in model.state_dict().items()}
+
+    def run_sgd(lr=0.05, steps=4):
+        AcceleratorState._reset_state()
+        acc = Accelerator()
+        model = RegressionModel(a=2.0, b=1.0)
+        opt = torch.optim.SGD(model.parameters(), lr=lr)
+        pm, popt = acc.prepare(model, opt)
+        x = torch.arange(8, dtype=torch.float32).unsqueeze(1)
+        y = 3.0 * x - 0.5
+        for _ in range(steps):
+            loss = F.mse_loss(pm(x), y)
+            acc.backward(loss)
+            popt.step()
+            popt.zero_grad()
+        return {k: np.asarray(v).copy() for k, v in pm.state_dict().items()}
+
+    lomo, sgd = run_lomo(), run_sgd()
+    AcceleratorState._reset_state()
+    for k in ("a", "b"):
+        np.testing.assert_allclose(lomo[k], sgd[k], atol=1e-5, rtol=1e-5)
