@@ -112,6 +112,7 @@ def test_fp8_autowrap_context():
     assert recipe_dtypes(None) == (jnp.float8_e4m3fn, jnp.float8_e5m2)
 
 
+@pytest.mark.filterwarnings("ignore:mixed_precision='fp8' on")
 def test_accelerator_fp8_trains_torch_linear():
     """mixed_precision='fp8' routes torch Linear layers through scaled_matmul
     (reference capability: TE convert_model + fp8_autocast)."""
@@ -133,7 +134,7 @@ def test_accelerator_fp8_trains_torch_linear():
         accelerator.backward(loss)
         opt.step()
         opt.zero_grad()
-        losses.append(float(loss))
+        losses.append(loss.item())
     assert losses[-1] < losses[0] * 0.8, losses
 
 
@@ -158,7 +159,7 @@ def test_llama_fp8_trains_and_tracks_bf16():
         losses = []
         for _ in range(10):
             params, opt_state, loss = step(params, opt_state, batch)
-            losses.append(float(loss))
+            losses.append(loss.item())
         return losses
 
     l16 = train(cfg16, params0)

@@ -167,7 +167,7 @@ def test_set_module_tensor_sets_dtype_and_moves():
     set_module_tensor_to_device(model, "weight", "meta")
     assert model.weight.device.type == "meta"
     set_module_tensor_to_device(model, "weight", "cpu", value=torch.zeros(3, 3))
-    assert model.weight.device.type == "cpu" and float(model.weight.sum()) == 0.0
+    assert model.weight.device.type == "cpu" and model.weight.sum().item() == 0.0
 
 
 def test_check_device_map_rejects_uncovered():
@@ -309,7 +309,7 @@ def test_load_checkpoint_in_model_basic_and_dtype(tmp_path):
     path = tmp_path / "model.safetensors"
     save_file(sd, str(path))
     load_checkpoint_in_model(model, str(path))
-    assert float(model.block1.linear1.weight[0, 0]) == 0.5
+    assert model.block1.linear1.weight[0, 0].item() == 0.5
 
     model2 = _nested_model()
     load_checkpoint_in_model(model2, str(path), dtype=torch.float16)
@@ -336,7 +336,8 @@ def test_load_checkpoint_in_model_disk_offload(tmp_path):
         device_map={"block1": "cpu", "block2": "disk", "head": "disk"},
         offload_folder=str(off),
     )
-    index = json.load(open(off / "index.json"))
+    with open(off / "index.json") as f:
+        index = json.load(f)
     assert "block2.linear1.weight" in index and "head.weight" in index
     assert (off / "block2.linear1.weight.dat").exists()
 

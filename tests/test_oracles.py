@@ -159,5 +159,5 @@ def test_mid_epoch_resume_skip_first_batches(tmp_path):
         acc.backward(out.loss)
         opt.step()
         opt.zero_grad()
-    a_resumed = float(np.asarray(model.params["a"]))
+    a_resumed = np.asarray(model.params["a"]).reshape(())
     assert a_resumed == pytest.approx(a_full, rel=1e-5)

@@ -137,7 +137,7 @@ def test_load_and_quantize_torch_model():
     model = torch.nn.Sequential(torch.nn.Linear(16, 32), torch.nn.ReLU(), torch.nn.Linear(32, 4))
     x = jnp.asarray(np.random.randn(4, 16).astype(np.float32))
     with torch.no_grad():
-        y_ref = model(torch.from_numpy(np.asarray(x))).numpy()
+        y_ref = model(torch.from_numpy(np.array(x))).numpy()
     cfg = BnbQuantizationConfig(load_in_8bit=True)
     apply_fn, qparams = load_and_quantize_model(model, cfg)
     # Conversion is destructive (reference parity): torch storage released.

@@ -151,6 +151,7 @@ def test_test_sync_script():
     assert "test_sync: success" in out.stdout
 
 
+@pytest.mark.filterwarnings("ignore:Per-host batch dim")
 def test_shipped_distributed_data_loop_script():
     """The launchable test_distributed_data_loop payload passes in-process
     (reference ships test_distributed_data_loop.py the same way, §2.10)."""

@@ -80,9 +80,11 @@ def test_generic_tracker_jsonl_roundtrip(tmp_path):
     t.store_init_configuration({"lr": 0.1, "layers": 2})
     t.log({"loss": 1.5}, step=0)
     t.log({"loss": 0.5, "note": "mid"}, step=1)
-    cfg = json.load(open(tmp_path / "run1" / "config.json"))
+    with open(tmp_path / "run1" / "config.json") as f:
+        cfg = json.load(f)
     assert cfg["lr"] == 0.1
-    lines = [json.loads(l) for l in open(t.path)]
+    with open(t.path) as f:
+        lines = [json.loads(l) for l in f]
     assert lines[0]["loss"] == 1.5 and lines[0]["_step"] == 0
     assert lines[1]["note"] == "mid" and lines[1]["_step"] == 1
 
