@@ -368,8 +368,8 @@ def test_load_checkpoint_in_model_sharded_index(tmp_path):
     }
     (tmp_path / "model.safetensors.index.json").write_text(json.dumps(index))
     load_checkpoint_in_model(model, str(tmp_path / "model.safetensors.index.json"))
-    assert float(model.head.weight[0, 0]) == 2.0
-    assert float(model.block1.linear1.weight[0, 0]) == 2.0
+    assert model.head.weight[0, 0].item() == 2.0
+    assert model.block1.linear1.weight[0, 0].item() == 2.0
 
 
 def test_align_module_device_simple_and_nested(tmp_path):
@@ -456,7 +456,7 @@ def test_set_module_tensor_meta_to_cpu():
     set_module_tensor_to_device(model, "weight", "cpu", value=torch.ones(3, 3))
     set_module_tensor_to_device(model, "bias", "cpu", value=torch.zeros(3))
     assert model.weight.device.type == "cpu"
-    assert float(model.weight.sum()) == 9.0
+    assert model.weight.sum().item() == 9.0
 
 
 def test_compute_module_total_buffer_size():

@@ -138,7 +138,7 @@ def test_mid_epoch_resume_skip_first_batches(tmp_path):
 
     acc, model, opt, dl = make()
     _train_epochs(acc, model, opt, dl, 1)
-    a_full = float(np.asarray(model.params["a"]))
+    a_full = np.asarray(model.params["a"]).item()
     _reset()
 
     acc, model, opt, dl = make()
@@ -159,5 +159,5 @@ def test_mid_epoch_resume_skip_first_batches(tmp_path):
         acc.backward(out.loss)
         opt.step()
         opt.zero_grad()
-    a_resumed = np.asarray(model.params["a"]).reshape(())
+    a_resumed = np.asarray(model.params["a"]).item()
     assert a_resumed == pytest.approx(a_full, rel=1e-5)
