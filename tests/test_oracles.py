@@ -91,7 +91,7 @@ def _train_epochs(acc, model, opt, dl, n_epochs):
                 acc.backward(out.loss)
                 opt.step()
                 opt.zero_grad()
-                losses.append(float(out.loss))
+                losses.append(out.loss.item())
     return losses
 
 
