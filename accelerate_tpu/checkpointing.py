@@ -246,6 +246,118 @@ def load_sharded_model(model, directory: str) -> None:
     model._set_params(restored)
 
 
+# ---------------------------------------------------------------------------
+# LOCAL_STATE_DICT (per-process local shard dump, topology-bound)
+# ---------------------------------------------------------------------------
+
+
+def _shard_index_key(index, shape) -> tuple:
+    """Canonical hashable key for a shard's global slice tuple."""
+    out = []
+    for s, dim in zip(index, shape):
+        out.append((0 if s.start is None else int(s.start), dim if s.stop is None else int(s.stop)))
+    return tuple(out)
+
+
+def save_local_model(model, directory: str) -> None:
+    """FSDP ``LOCAL_STATE_DICT`` equivalent (reference
+    ``utils/fsdp_utils.py:113-155`` with ``StateDictType.LOCAL_STATE_DICT``):
+    every process dumps exactly its locally-addressable shards — no
+    consolidation, no cross-host IO, no resharding metadata.  The checkpoint
+    is topology-bound: it loads ONLY on the same process count and mesh
+    layout, the same contract torch FSDP's LOCAL_STATE_DICT carries."""
+    os.makedirs(directory, exist_ok=True)
+    proc = jax.process_index()
+    leaves = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(model.params)[0]:
+        key = jax.tree_util.keystr(path)
+        if hasattr(leaf, "addressable_shards"):
+            shards = {
+                _shard_index_key(sh.index, leaf.shape): np.asarray(sh.data)
+                for sh in leaf.addressable_shards
+            }
+        else:  # host numpy leaf: one full-coverage shard
+            arr = np.asarray(leaf)
+            shards = {_shard_index_key((slice(None),) * arr.ndim, arr.shape): arr}
+        leaves[key] = {
+            "shape": tuple(np.shape(leaf)),
+            "dtype": str(getattr(leaf, "dtype", None) or np.asarray(leaf).dtype),
+            "shards": shards,
+        }
+    payload = {"num_processes": jax.process_count(), "process_index": proc, "leaves": leaves}
+    with open(os.path.join(directory, f"local_rank{proc}.bin"), "wb") as f:
+        pickle.dump(payload, f)
+
+
+def load_local_model(model, directory: str) -> None:
+    """Restore a :func:`save_local_model` dump onto the SAME topology.  Any
+    mismatch — process count, leaf set, shapes, or per-device shard layout —
+    raises instead of silently resharding (that is what SHARDED_STATE_DICT is
+    for)."""
+    proc = jax.process_index()
+    fp = os.path.join(directory, f"local_rank{proc}.bin")
+    if not os.path.exists(fp):
+        raise FileNotFoundError(
+            f"LOCAL_STATE_DICT checkpoint has no dump for process {proc} under "
+            f"{directory!r} — local checkpoints are topology-bound; use "
+            "SHARDED_STATE_DICT to restore across topologies."
+        )
+    with open(fp, "rb") as f:
+        payload = pickle.load(f)
+    if payload["num_processes"] != jax.process_count():
+        raise RuntimeError(
+            f"LOCAL_STATE_DICT topology mismatch: saved with "
+            f"{payload['num_processes']} processes, loading with {jax.process_count()}."
+        )
+    flat, treedef = jax.tree_util.tree_flatten_with_path(model.params)
+    new_leaves = []
+    for path, leaf in flat:
+        key = jax.tree_util.keystr(path)
+        rec = payload["leaves"].get(key)
+        if rec is None:
+            raise KeyError(f"LOCAL_STATE_DICT dump is missing parameter {key}")
+        if tuple(np.shape(leaf)) != tuple(rec["shape"]):
+            raise ValueError(
+                f"LOCAL_STATE_DICT shape mismatch for {key}: saved {rec['shape']}, "
+                f"live {tuple(np.shape(leaf))}"
+            )
+        live_dtype = str(getattr(leaf, "dtype", None) or np.asarray(leaf).dtype)
+        if rec["dtype"] != live_dtype:
+            raise ValueError(
+                f"LOCAL_STATE_DICT dtype mismatch for {key}: saved {rec['dtype']}, "
+                f"live {live_dtype}"
+            )
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is None:
+            full_key = _shard_index_key(
+                (slice(None),) * len(rec["shape"]), tuple(rec["shape"])
+            )
+            if full_key not in rec["shards"]:
+                raise RuntimeError(
+                    f"LOCAL_STATE_DICT dump for {key} holds partial shards "
+                    f"{sorted(rec['shards'])} but the live leaf is an unsharded host "
+                    "array needing full coverage — the layout changed since save; "
+                    "use SHARDED_STATE_DICT."
+                )
+            new_leaves.append(rec["shards"][full_key])
+            continue
+        idx_map = sharding.addressable_devices_indices_map(tuple(rec["shape"]))
+        singles = []
+        for dev, index in idx_map.items():
+            idx_key = _shard_index_key(index, tuple(rec["shape"]))
+            if idx_key not in rec["shards"]:
+                raise RuntimeError(
+                    f"LOCAL_STATE_DICT shard layout mismatch for {key}: live layout "
+                    f"needs slice {idx_key} on {dev}, dump has {sorted(rec['shards'])} — "
+                    "the mesh layout changed since save; use SHARDED_STATE_DICT."
+                )
+            singles.append(jax.device_put(rec["shards"][idx_key], dev))
+        new_leaves.append(
+            jax.make_array_from_single_device_arrays(tuple(rec["shape"]), sharding, singles)
+        )
+    model._set_params(jax.tree_util.tree_unflatten(treedef, new_leaves))
+
+
 def save_custom_state(obj, path: str, index: int = 0):
     """Reference ``checkpointing.py:302``."""
     location = Path(path) / f"custom_checkpoint_{index}.pkl"
@@ -277,19 +389,29 @@ def _resolve_output_dir(accelerator, output_dir: Optional[str]) -> str:
     return output_dir
 
 
-def _use_sharded_save(accelerator) -> bool:
-    """True when the FSDP plugin asks for SHARDED_STATE_DICT and the prepared
-    models hold jax param pytrees (orbax per-process shard writing applies)."""
+def _plugin_save_mode(accelerator, wanted: str) -> bool:
     from .utils.dataclasses import DistributedType
 
     plugin = getattr(accelerator.state, "fsdp_plugin", None)
     return (
         accelerator.distributed_type == DistributedType.FSDP
         and plugin is not None
-        and getattr(plugin, "state_dict_type", None) == "SHARDED_STATE_DICT"
+        and getattr(plugin, "state_dict_type", None) == wanted
         and all(hasattr(m, "params") for m in accelerator._models)
         and len(accelerator._models) > 0
     )
+
+
+def _use_sharded_save(accelerator) -> bool:
+    """True when the FSDP plugin asks for SHARDED_STATE_DICT and the prepared
+    models hold jax param pytrees (orbax per-process shard writing applies)."""
+    return _plugin_save_mode(accelerator, "SHARDED_STATE_DICT")
+
+
+def _use_local_save(accelerator) -> bool:
+    """True when the FSDP plugin asks for LOCAL_STATE_DICT: every process
+    dumps its addressable shards verbatim (topology-bound)."""
+    return _plugin_save_mode(accelerator, "LOCAL_STATE_DICT")
 
 
 def save_accelerator_state(accelerator, output_dir: Optional[str] = None, **save_model_func_kwargs) -> str:
@@ -300,6 +422,7 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, **save
     state = accelerator.state
 
     sharded = _use_sharded_save(accelerator)
+    local = _use_local_save(accelerator)
 
     # save_state pre-hooks (reference accelerator.py:2992-3005): run before
     # anything is written, with the models and their CURRENT weights.  Hook
@@ -308,7 +431,7 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, **save
     pre_hooks = list(getattr(accelerator, "_save_state_pre_hooks", {}).values())
     hook_weights = None
     if pre_hooks:
-        if sharded:
+        if sharded or local:
             # Reference FSDP behavior (accelerator.py:2992-3005 with
             # fsdp-sharded models): hooks run with an EMPTY weights list —
             # consolidating every model's full state dict just to feed hooks
@@ -347,9 +470,15 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, **save
         # Keep async handles reachable so callers (and the next save) can wait:
         # accelerator.wait_for_checkpoint().
         accelerator._async_checkpointers = checkpointers if async_save else []
+    if local:
+        # LOCAL path also runs on every process — each dumps only its own
+        # addressable shards, with no resharding metadata (topology-bound).
+        for i, model in enumerate(accelerator._models):
+            name = f"{MODEL_NAME}_local" if i == 0 else f"{MODEL_NAME}_{i}_local"
+            save_local_model(model, os.path.join(output_dir, name))
 
     if state.is_main_process or state.num_processes == 1:
-        if not sharded:
+        if not sharded and not local:
             for i, model in enumerate(accelerator._models):
                 name = WEIGHTS_NAME if i == 0 else f"{MODEL_NAME}_{i}.safetensors"
                 save_model_weights(
@@ -418,6 +547,10 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None, **load_
         orbax_dir = os.path.join(input_dir, f"{MODEL_NAME}_orbax" if i == 0 else f"{MODEL_NAME}_{i}_orbax")
         if os.path.isdir(orbax_dir):
             load_sharded_model(model, orbax_dir)
+            continue
+        local_dir = os.path.join(input_dir, f"{MODEL_NAME}_local" if i == 0 else f"{MODEL_NAME}_{i}_local")
+        if os.path.isdir(local_dir):
+            load_local_model(model, local_dir)
             continue
         name = WEIGHTS_NAME if i == 0 else f"{MODEL_NAME}_{i}.safetensors"
         load_model_weights(model, input_dir, weights_name=name)

@@ -17,20 +17,57 @@ __all__ = ["BulletMenu"]
 
 
 def _read_key() -> str:
-    """One keypress from raw stdin; arrows normalize to 'up'/'down'."""
+    """One keypress from raw stdin; arrows normalize to 'up'/'down'.
+
+    ESC handling must not block or leak bytes: a bare Escape press has no
+    tail, and CSI sequences vary in length (arrows send ``[A``, Home/End/
+    PgUp send e.g. ``[1~``) — so the tail is read with a short ``select``
+    timeout and drained to the CSI final byte (0x40-0x7e) instead of a fixed
+    2-byte read, which would hang on bare ESC and leave ``~`` in the stream
+    to be misread as a command."""
+    import os as _os
+    import select
     import termios
     import tty
 
     fd = sys.stdin.fileno()
     old = termios.tcgetattr(fd)
+
+    # All IO happens at the fd level (os.read): sys.stdin is a buffered
+    # TextIOWrapper, so mixing sys.stdin.read with select() on the fd would
+    # see an empty fd while bytes sit in Python's buffer — every arrow key
+    # would misread as 'esc'.
+    def _pending(timeout: float = 0.05) -> bool:
+        return bool(select.select([fd], [], [], timeout)[0])
+
+    def _read1() -> str:
+        return _os.read(fd, 1).decode("latin-1")
+
     try:
         tty.setraw(fd)
-        ch = sys.stdin.read(1)
-        if ch == "\x1b":  # escape sequence
-            seq = sys.stdin.read(2)
-            if seq == "[A":
+        ch = _read1()
+        if ch == "\x1b":  # escape (possibly the start of a CSI sequence)
+            if not _pending():
+                return "esc"  # bare Escape keypress
+            tail = _read1()
+            if tail != "[":
+                # SS3 (ESC O <final>, keypad/application mode) and alt-<key>
+                # sequences: drain any pending tail bytes so they are not
+                # re-read as commands, then treat as esc.
+                while _pending(0.01):
+                    _read1()
+                return "esc"
+            # CSI: parameter bytes 0x30-0x3f and intermediates 0x20-0x2f,
+            # then one final byte 0x40-0x7e terminates the sequence.
+            seq = ""
+            while _pending():
+                b = _read1()
+                seq += b
+                if "\x40" <= b <= "\x7e":
+                    break
+            if seq == "A":
                 return "up"
-            if seq == "[B":
+            if seq == "B":
                 return "down"
             return "esc"
         return ch

@@ -98,15 +98,21 @@ def embed_lookup(table: jax.Array, input_ids: jax.Array, dtype) -> jax.Array:
     overflow, one-hot returns a zero embedding — both are silent garbage, so
     callers must pass valid ids (the reference's nn.Embedding errors instead).
 
-    Outside a model-sharded mesh (single device, pure dp) the gather is
-    cheaper, so it stays.  The gate is mesh-axis sizes, not the table's actual
-    layout, so a config that keeps params replicated on an active ``fsdp``
-    axis (SHARD_GRAD_OP-style) pays an unnecessary one-hot contraction —
-    ~2*B*S*V*D FLOPs, about 1% of a training step at bench shapes; the table's
-    true sharding is not visible on traced values in auto-sharding mode.
-    Decode paths keep the gather: most call it directly, and the trailing-dim-1
-    guard below catches single-token lookups routed through shared embed
-    helpers (a [B, 1, V] one-hot would read the whole table per token).
+    Outside a table-sharding mesh the gather is cheaper, so it stays.  The
+    gate is the ``fsdp``/``tp`` axis sizes — the only axes whose PARTITION
+    rules shard the vocab table.  ``sp``/``ep`` shard activations/experts but
+    leave the table replicated, and a gather from a replicated table
+    partitions cleanly (output inherits the ids' sharding), so those meshes
+    keep the gather: at a 128k vocab the one-hot contraction is ~2*V*D FLOPs
+    per token — ≈10% of the 6N step FLOPs — far too much to pay when the
+    table is not actually sharded.  The gate is mesh-axis sizes, not the
+    table's actual layout, so a config that keeps params replicated on an
+    active ``fsdp`` axis (SHARD_GRAD_OP-style) still pays the contraction;
+    the table's true sharding is not visible on traced values in
+    auto-sharding mode.  Decode paths keep the gather: most call it directly,
+    and the trailing-dim-1 guard below catches single-token lookups routed
+    through shared embed helpers (a [B, 1, V] one-hot would read the whole
+    table per token).
     """
     single_token = input_ids.ndim >= 1 and input_ids.shape[-1] == 1
     m = _abstract_mesh()
@@ -114,7 +120,7 @@ def embed_lookup(table: jax.Array, input_ids: jax.Array, dtype) -> jax.Array:
         not single_token
         and m is not None
         and not m.empty
-        and any(dict(m.shape).get(a, 1) > 1 for a in ("fsdp", "tp", "sp", "ep"))
+        and any(dict(m.shape).get(a, 1) > 1 for a in ("fsdp", "tp"))
     ):
         one_hot = jax.nn.one_hot(input_ids, table.shape[0], dtype=dtype)
         return one_hot @ table.astype(dtype)

@@ -691,10 +691,7 @@ class GradientState:
                 if gradient_accumulation_plugin is not None
                 else {}
             )
-            # None = never explicitly written; the getter then mirrors
-            # sync_gradients.  A written bool (True OR False) is returned
-            # verbatim (reference state.py:1273-1282).
-            self._is_xla_gradients_synced = None
+            self._is_xla_gradients_synced = False
             # Per-process rows the device placer appended to the CURRENT batch
             # to make it shard-divisible, and the resulting padded per-process
             # row count; gather_for_metrics drops the pads — only from tensors
@@ -747,15 +744,14 @@ class GradientState:
 
     @property
     def is_xla_gradients_synced(self) -> bool:
-        """Reference GradientState XLA flag (state.py:1243): whether gradients
-        are synced for the current step.  Writable like the reference's — an
-        explicitly-written value (True OR False) is returned verbatim; only
-        when never written does it mirror the accumulation bookkeeping
-        (``sync_gradients``)."""
-        explicit = self.__dict__.get("_is_xla_gradients_synced")
-        if explicit is not None:
-            return explicit
-        return bool(self.sync_gradients)
+        """Reference GradientState XLA flag (state.py:1273-1277): stored value
+        verbatim, initialized False, with one override — FSDP always
+        synchronizes, so the flag reads True under the ``ACCELERATE_USE_FSDP``
+        env flag (the same gate the reference uses) regardless of the stored
+        value."""
+        if parse_flag_from_env("ACCELERATE_USE_FSDP"):
+            return True
+        return bool(self.__dict__.get("_is_xla_gradients_synced", False))
 
     @is_xla_gradients_synced.setter
     def is_xla_gradients_synced(self, value: bool) -> None:

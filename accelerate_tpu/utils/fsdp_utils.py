@@ -30,18 +30,23 @@ __all__ = [
 ]
 
 
-def _is_sharded(fsdp_plugin) -> bool:
-    return getattr(fsdp_plugin, "state_dict_type", "FULL_STATE_DICT") == "SHARDED_STATE_DICT"
+def _state_dict_type(fsdp_plugin) -> str:
+    return getattr(fsdp_plugin, "state_dict_type", "FULL_STATE_DICT") or "FULL_STATE_DICT"
 
 
 def save_fsdp_model(fsdp_plugin, accelerator, model, output_dir, model_index: int = 0, adapter_only: bool = False) -> None:
     """Reference ``utils/fsdp_utils.py:101``: write model weights according to
     the plugin's ``state_dict_type`` — FULL consolidates to one safetensors
-    file on the main process, SHARDED writes per-process shards."""
-    from ..checkpointing import save_model_weights, save_sharded_model
+    file on the main process, SHARDED writes resharding-capable per-process
+    shards (orbax), LOCAL dumps each process's addressable shards verbatim
+    (topology-bound, like torch FSDP's LOCAL_STATE_DICT)."""
+    from ..checkpointing import save_local_model, save_model_weights, save_sharded_model
 
-    if _is_sharded(fsdp_plugin):
+    sd_type = _state_dict_type(fsdp_plugin)
+    if sd_type == "SHARDED_STATE_DICT":
         save_sharded_model(model, os.path.join(output_dir, f"model_{model_index}"))
+    elif sd_type == "LOCAL_STATE_DICT":
+        save_local_model(model, os.path.join(output_dir, f"model_{model_index}_local"))
     else:
         weights_name = "model.safetensors" if model_index == 0 else f"model_{model_index}.safetensors"
         save_model_weights(model, output_dir, weights_name=weights_name)
@@ -49,12 +54,17 @@ def save_fsdp_model(fsdp_plugin, accelerator, model, output_dir, model_index: in
 
 def load_fsdp_model(fsdp_plugin, accelerator, model, input_dir, model_index: int = 0, adapter_only: bool = False) -> None:
     """Reference ``utils/fsdp_utils.py:162``: restore weights saved by
-    :func:`save_fsdp_model`, resharding onto the live mesh layout."""
-    from ..checkpointing import load_model_weights, load_sharded_model
+    :func:`save_fsdp_model` — SHARDED reshards onto the live mesh layout,
+    LOCAL requires the identical topology and raises otherwise."""
+    from ..checkpointing import load_local_model, load_model_weights, load_sharded_model
 
+    sd_type = _state_dict_type(fsdp_plugin)
     sharded_dir = os.path.join(input_dir, f"model_{model_index}")
-    if _is_sharded(fsdp_plugin) and os.path.isdir(sharded_dir):
+    local_dir = os.path.join(input_dir, f"model_{model_index}_local")
+    if sd_type == "SHARDED_STATE_DICT" and os.path.isdir(sharded_dir):
         load_sharded_model(model, sharded_dir)
+    elif sd_type == "LOCAL_STATE_DICT" and os.path.isdir(local_dir):
+        load_local_model(model, local_dir)
     else:
         weights_name = "model.safetensors" if model_index == 0 else f"model_{model_index}.safetensors"
         load_model_weights(model, input_dir, weights_name=weights_name)

@@ -620,13 +620,11 @@ def _resolve_unknown_function(target, function_table):
     return simple.get(name)
 
 
-def lower_module(module) -> LoweredModule:
-    """Trace + lower a torch module.  Uses transformers' tracer for PreTrainedModel
-    (it understands HF signatures), plain ``torch.fx`` otherwise."""
+def _trace_for_lowering(module):
+    """Symbolically trace a torch module: transformers' tracer for
+    PreTrainedModel (it understands HF signatures), plain ``torch.fx``
+    otherwise.  Returns the GraphModule without touching parameter data."""
     import torch
-
-    params = {k: _t2j(v) for k, v in module.named_parameters()}
-    buffers = {k: _t2j(v) for k, v in module.named_buffers()}
 
     graph_module = None
     errors = []
@@ -649,7 +647,14 @@ def lower_module(module) -> LoweredModule:
             "Could not symbolically trace the torch module for JAX lowering: "
             + "; ".join(errors)
         )
-    return LoweredModule(module, graph_module, params, buffers)
+    return graph_module
+
+
+def lower_module(module) -> LoweredModule:
+    """Trace + lower a torch module (params converted to JAX arrays)."""
+    params = {k: _t2j(v) for k, v in module.named_parameters()}
+    buffers = {k: _t2j(v) for k, v in module.named_buffers()}
+    return LoweredModule(module, _trace_for_lowering(module), params, buffers)
 
 
 # ---------------------------------------------------------------------------
@@ -874,6 +879,63 @@ def lower_module_pipelined(
     )
 
 
+def _block_graph_signature(module, graph_module=None):
+    """Canonical (structure, constants) signature of a block's traced graph.
+
+    Everything that shapes execution is included — op sequence, targets,
+    literal args, submodule configuration (``repr`` carries ``extra_repr``
+    fields like ``Dropout(p=...)``), and the VALUES of constant ``get_attr``
+    nodes — while parameter/buffer values are excluded (those are stacked per
+    block by design; only their NAMES matter).  Two blocks with equal
+    signatures execute identically under block 0's graph; unequal signatures
+    mean stacking would be wrong.  Works from the trace alone — no parameter
+    data is converted.
+    """
+    import torch
+    import torch.fx
+
+    if graph_module is None:
+        graph_module = _trace_for_lowering(module)
+    param_names = {k for k, _ in module.named_parameters()}
+    buffer_names = {k for k, _ in module.named_buffers()}
+    idx: dict[str, int] = {}
+    sig = []
+
+    def canon(a):
+        if isinstance(a, torch.fx.Node):
+            return ("node", idx[a.name])
+        if isinstance(a, (list, tuple)):
+            return (type(a).__name__,) + tuple(canon(x) for x in a)
+        if isinstance(a, dict):
+            return ("dict",) + tuple((k, canon(v)) for k, v in sorted(a.items()))
+        if isinstance(a, torch.Tensor):
+            t = a.detach().cpu().numpy()
+            return ("tensor", t.shape, str(t.dtype), t.tobytes())
+        if isinstance(a, (torch.dtype, torch.device)):
+            return str(a)
+        return repr(a)
+
+    for i, node in enumerate(graph_module.graph.nodes):
+        idx[node.name] = i
+        if node.op == "call_module":
+            submod = graph_module.get_submodule(node.target)
+            target, extra = node.target, repr(submod)
+        elif node.op == "get_attr":
+            target = node.target
+            if node.target in param_names or node.target in buffer_names:
+                extra = "param_or_buffer"
+            else:
+                obj = module
+                for part in node.target.split("."):
+                    obj = getattr(obj, part)
+                extra = canon(obj)
+        else:
+            target = getattr(node.target, "__name__", None) or str(node.target)
+            extra = None
+        sig.append((node.op, target, canon(node.args), canon(node.kwargs), extra))
+    return tuple(sig)
+
+
 def _pipeline_container(
     module, container: str, n_blocks: int, num_stages: int, num_micro_batches: int
 ) -> "PipelinedLoweredModule":
@@ -918,9 +980,15 @@ def _pipeline_container(
                 "a non-final block's output is consumed outside the chain"
             )
 
-    # Lower ONE block; verify all blocks stack (identical param trees/shapes).
+    # Lower EVERY block; verify all blocks stack.  Identical param/buffer
+    # shapes are necessary but not sufficient: the pipeline runs block 0's
+    # graph (and its baked-in constants) for every layer, so blocks that
+    # differ by non-parameter attributes — per-layer drop-path rates, scale
+    # constants, layer_idx-dependent branches — must be rejected here, loudly,
+    # or they would silently execute block 0's constants at every stage.
     blocks = list(module.get_submodule(container).children())
     block_lowered = lower_module(blocks[0])
+    ref_sig = _block_graph_signature(blocks[0], block_lowered.graph_module)
     ref_p = {k: v.shape for k, v in blocks[0].named_parameters()}
     ref_b = {k: v.shape for k, v in blocks[0].named_buffers()}
     for i, b in enumerate(blocks[1:], 1):
@@ -929,6 +997,19 @@ def _pipeline_container(
         } != ref_b:
             raise TorchLoweringError(
                 f"block {i} of {container!r} has different parameters than block 0 — not stackable"
+            )
+        try:
+            sig = _block_graph_signature(b)
+        except TorchLoweringError as e:
+            raise TorchLoweringError(
+                f"block {i} of {container!r} failed to lower for stackability check: {e}"
+            ) from e
+        if sig != ref_sig:
+            raise TorchLoweringError(
+                f"block {i} of {container!r} traces to a different graph or different "
+                "constants than block 0 (per-layer rates, scales, or index-dependent "
+                "branches) — stacked pipelining would run block 0's constants for every "
+                "layer, so this chain cannot pipeline"
             )
 
     # Parent params: per-block entries collapse into stacked leaves.

@@ -497,6 +497,60 @@ def test_bullet_menu_numbered_fallback(monkeypatch, capsys):
     assert BulletMenu("pick", ["a", "b"]).run(default=1) == 1
 
 
+def test_bullet_menu_interactive_pty():
+    """Raw-mode key handling on a real pty: arrow keys navigate (fd-level
+    reads must agree with select), bare/SS3/long-CSI escape sequences are
+    swallowed without aborting or leaking bytes into the command stream."""
+    import os as _os
+    import pty
+    import sys as _sys
+    import threading
+    import time
+
+    pid, master = pty.fork()
+    if pid == 0:  # child: drive a menu on the pty
+        try:
+            # pytest's capture rebinds sys.stdin/stdout to non-fd objects;
+            # point them back at the pty so the menu sees a real TTY.
+            _sys.stdin = open(0, closefd=False)
+            _sys.stdout = open(1, "w", closefd=False)
+            from accelerate_tpu.commands.menu import BulletMenu
+
+            idx = BulletMenu("pick:", ["alpha", "beta", "gamma"]).run(0)
+            _os.write(1, f"\nRESULT={idx}\n".encode())
+        finally:
+            _os._exit(0)
+
+    chunks = []
+
+    def reader():
+        while True:
+            try:
+                d = _os.read(master, 1024)
+            except OSError:
+                return
+            if not d:
+                return
+            chunks.append(d)
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    time.sleep(1.0)
+    for seq, wait in [
+        (b"\x1b[B", 0.3),  # down (single packet: CSI buffered with ESC)
+        (b"\x1b[B", 0.3),  # down -> gamma
+        (b"\x1bOq", 0.3),  # SS3 keypad seq: swallowed, 'q' must NOT abort
+        (b"\x1b[1~", 0.3),  # Home, long CSI: swallowed, '~' must not leak
+        (b"\r", 0.0),  # enter
+    ]:
+        _os.write(master, seq)
+        time.sleep(wait)
+    t.join(timeout=10)
+    _os.waitpid(pid, 0)
+    text = b"".join(chunks).decode("latin-1", "replace")
+    assert "RESULT=2" in text, text[-400:]
+
+
 def test_config_update_migrates_and_drops_unknown(tmp_path):
     from accelerate_tpu.commands.config import load_config, update_config_command
 

@@ -308,7 +308,7 @@ def test_prepare_pipelines_bridged_module_under_pp():
             acc.backward(loss)
             popt.step()
             popt.zero_grad()
-            losses.append(float(loss))
+            losses.append(loss.detach().item())
         return losses
 
     base = run(ParallelismConfig(dp=8))
@@ -425,4 +425,80 @@ def test_pipelined_bridge_skips_shadowing_inner_container():
         atol=2e-5,
         rtol=1e-5,
     )
+    AcceleratorState._reset_state()
+
+
+def test_pipelined_bridge_rejects_heterogeneous_block_constants():
+    """Same-class blocks that differ by NON-parameter attributes (per-layer
+    scale / drop-path rate / layer_idx branch) have identical param shapes but
+    different traced constants — stacking would silently run block 0's
+    constants for every layer, so lowering must refuse loudly instead."""
+    import pytest
+    import torch
+
+    from accelerate_tpu.utils.torch_bridge import TorchLoweringError, lower_module_pipelined
+
+    d = 8
+
+    class ScaledBlock(torch.nn.Module):
+        def __init__(self, scale):
+            super().__init__()
+            self.fc = torch.nn.Linear(d, d)
+            self.scale = scale
+
+        def forward(self, x):
+            return x + self.scale * self.fc(x)
+
+    class Net(torch.nn.Module):
+        def __init__(self, scales):
+            super().__init__()
+            self.blocks = torch.nn.ModuleList(ScaledBlock(s) for s in scales)
+
+        def forward(self, x):
+            for b in self.blocks:
+                x = b(x)
+            return x
+
+    torch.manual_seed(0)
+    AcceleratorState._reset_state()
+    AcceleratorState(parallelism_config=ParallelismConfig(pp=2, dp=4))
+    # Increasing per-layer scales (the ViT stochastic-depth pattern): refuse.
+    with pytest.raises(TorchLoweringError, match="different graph or different constants"):
+        lower_module_pipelined(Net([0.1, 0.2, 0.3, 0.4]), num_stages=2, num_micro_batches=2)
+    # Uniform scales lower fine and match plain lowering.
+    net = Net([0.5, 0.5, 0.5, 0.5])
+    piped = lower_module_pipelined(net, num_stages=2, num_micro_batches=2)
+    from accelerate_tpu.utils.torch_bridge import lower_module
+
+    plain = lower_module(net)
+    x = torch.randn(4, d)
+    np.testing.assert_allclose(
+        np.asarray(piped.apply(piped.params, piped.buffers, x.numpy())),
+        np.asarray(plain.apply(plain.params, plain.buffers, x.numpy())),
+        atol=2e-5,
+        rtol=1e-5,
+    )
+    # Submodule-configuration differences (Dropout p) must also be caught —
+    # they live in the module repr, not the traced constants.
+    class DropBlock(torch.nn.Module):
+        def __init__(self, p):
+            super().__init__()
+            self.fc = torch.nn.Linear(d, d)
+            self.drop = torch.nn.Dropout(p)
+
+        def forward(self, x):
+            return x + self.drop(self.fc(x))
+
+    class DropNet(torch.nn.Module):
+        def __init__(self, ps):
+            super().__init__()
+            self.blocks = torch.nn.ModuleList(DropBlock(p) for p in ps)
+
+        def forward(self, x):
+            for b in self.blocks:
+                x = b(x)
+            return x
+
+    with pytest.raises(TorchLoweringError):
+        lower_module_pipelined(DropNet([0.0, 0.1, 0.2, 0.3]), num_stages=2, num_micro_batches=2)
     AcceleratorState._reset_state()

@@ -126,19 +126,28 @@ def test_deepspeed_plugin_registry_get_and_select():
     GradientState._reset_state()
 
 
-def test_gradient_state_xla_sync_flag_mirrors_sync():
+def test_gradient_state_xla_sync_flag_reference_parity():
+    """Reference state.py:1224,1273-1282: the flag initializes False, is
+    returned verbatim once written, and is forced True under the FSDP env
+    flag regardless of the stored value."""
+    import os
+
     gs = GradientState()
-    gs._set_sync_gradients(True)
-    assert gs.is_xla_gradients_synced is True
-    gs._set_sync_gradients(False)
+    # Never written -> False, independent of sync_gradients (which is True).
+    assert gs.sync_gradients is True
     assert gs.is_xla_gradients_synced is False
-    gs._set_sync_gradients(True)
-    # An explicitly-written value is returned verbatim — including False —
-    # even while sync_gradients says otherwise (reference state.py:1273-1282).
-    gs.is_xla_gradients_synced = False
-    gs._set_sync_gradients(True)
-    assert gs.is_xla_gradients_synced is False
+    # Written values come back verbatim, regardless of sync_gradients.
     gs.is_xla_gradients_synced = True
     gs._set_sync_gradients(False)
     assert gs.is_xla_gradients_synced is True
+    gs.is_xla_gradients_synced = False
+    gs._set_sync_gradients(True)
+    assert gs.is_xla_gradients_synced is False
+    # FSDP always syncs: env flag overrides the stored False.
+    os.environ["ACCELERATE_USE_FSDP"] = "true"
+    try:
+        assert gs.is_xla_gradients_synced is True
+    finally:
+        del os.environ["ACCELERATE_USE_FSDP"]
+    assert gs.is_xla_gradients_synced is False
     GradientState._reset_state()
