@@ -199,27 +199,39 @@ if os.environ.get("BENCH_PROOF_LADDER_JSON"):
 
 
 def _run_rung_subprocess(rung_index: int, timeout_s: int, flag: str = "--rung"):
-    """Run one ladder rung in a KILLABLE subprocess.
+    """Run one ladder rung in a bounded subprocess.
 
     A half-up device tunnel can hang a compile inside a C call, where neither
-    SIGALRM nor Python-level timeouts fire — only killing the process works.
+    SIGALRM nor Python-level timeouts fire — the subprocess boundary is the
+    only real timeout.  BUT a SIGKILL delivered mid-compile wedges the tunnel
+    for >15 min (observed r4), so the escalation is cooperative: SIGTERM
+    first (lets Python unwind and the XLA client shut down when it is not
+    stuck in C), a grace period, and SIGKILL only as the last resort.
     Returns (result_dict | None, error_str | None)."""
     import subprocess
 
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), flag, str(rung_index)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
     try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), flag, str(rung_index)],
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-        )
+        stdout, stderr = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        return None, f"timeout after {timeout_s}s"
+        proc.terminate()  # cooperative: compile clients get to shut down
+        try:
+            stdout, stderr = proc.communicate(timeout=60)
+            return None, f"timeout after {timeout_s}s (exited on SIGTERM)"
+        except subprocess.TimeoutExpired:
+            proc.kill()  # stuck inside a C call; nothing else works
+            proc.communicate()
+            return None, f"timeout after {timeout_s}s (SIGKILL after 60s grace)"
     if proc.returncode != 0:
-        return None, (proc.stderr or "")[-200:].replace("\n", " ")
+        return None, (stderr or "")[-200:].replace("\n", " ")
     # Scan from the end for the LAST parseable JSON line — spurious
     # brace-prefixed library output (before or after the result) is skipped.
-    for line in reversed(proc.stdout.splitlines()):
+    for line in reversed(stdout.splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
@@ -240,8 +252,12 @@ def _acquire_device(deadline_s: float, attempt_timeout_s: float, wait_s: float):
     """Bounded device acquisition: killable-subprocess probes until the backend
     answers or the wall-clock window closes.  Each attempt is a fresh
     interpreter — the only real "backend reset" for a wedged tunnel (an
-    in-process clear_backends cannot unwedge a blocked C call).  Returns
-    (ok, detail, attempts)."""
+    in-process clear_backends cannot unwedge a blocked C call).
+
+    The wait between attempts backs off exponentially (capped): an observed
+    wedge (r4) lasted >15 min, so the window must ride it out instead of
+    burning all attempts in the first minutes.  Returns (ok, detail,
+    attempts)."""
     from accelerate_tpu.utils.device_probe import probe_device_backend
 
     t0 = time.monotonic()
@@ -250,16 +266,24 @@ def _acquire_device(deadline_s: float, attempt_timeout_s: float, wait_s: float):
     # First attempt with a SHORT timeout: a healthy tunnel answers in a few
     # seconds, so a wedge is detected fast instead of after 180s.
     timeout = min(60.0, attempt_timeout_s)
+    wait = wait_s
     while True:
         attempts += 1
         ok, detail = probe_device_backend(timeout_s=timeout, retries=1)
         if ok:
             return True, detail, attempts
-        print(f"# probe attempt {attempts} failed: {detail}", file=sys.stderr, flush=True)
+        elapsed = time.monotonic() - t0
+        print(
+            f"# probe attempt {attempts} failed after {elapsed:.0f}s: {detail} "
+            f"(next wait {wait:.0f}s)",
+            file=sys.stderr,
+            flush=True,
+        )
         timeout = attempt_timeout_s
-        if time.monotonic() - t0 + wait_s + timeout > deadline_s:
+        if elapsed + wait + timeout > deadline_s:
             return False, detail, attempts
-        time.sleep(wait_s)
+        time.sleep(wait)
+        wait = min(wait * 2, 300.0)
 
 
 def main():
@@ -293,10 +317,15 @@ def main():
     # Fast-fail (then retry, bounded) when the device backend is unreachable
     # (e.g. wedged TPU tunnel).  Probes MUST be subprocesses: backend init
     # blocks inside a C call, which a SIGALRM-based timeout cannot interrupt.
+    # The window defaults PAST the longest observed wedge (>15 min, r4):
+    # spending 40 min waiting out a wedge beats recording 0.0.
+    probe_window = float(os.environ.get("BENCH_PROBE_WINDOW_S", "2400"))
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "120"))
+    probe_wait = float(os.environ.get("BENCH_PROBE_WAIT_S", "30"))
     ok, detail, attempts = _acquire_device(
-        deadline_s=float(os.environ.get("BENCH_PROBE_WINDOW_S", "900")),
-        attempt_timeout_s=float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "120")),
-        wait_s=float(os.environ.get("BENCH_PROBE_WAIT_S", "60")),
+        deadline_s=probe_window,
+        attempt_timeout_s=probe_timeout,
+        wait_s=probe_wait,
     )
     if not ok:
         print(
@@ -319,11 +348,28 @@ def main():
             policy = f"{policy}/{extra}"
         return f"{name}/b{batch}/s{seq}/{impl}/{policy}"
 
+    def _device_trouble(err: str) -> bool:
+        """Rung failures that mean the TUNNEL died (vs. the config OOMing):
+        burning the next rung would waste 480s per attempt against a wedge —
+        reacquire first.  RESOURCE_EXHAUSTED / compile errors are NOT device
+        trouble; the ladder's next rung is the right response to those."""
+        if not err:
+            return False
+        e = err.lower()
+        if "resource_exhausted" in e or "out of memory" in e:
+            return False
+        return any(
+            s in e
+            for s in ("timeout", "unreachable", "unavailable", "deadline", "no parseable")
+        )
+
+    rung_timeout = int(float(os.environ.get("BENCH_RUNG_TIMEOUT_S", "480")))
     result = None
     rung_log = []
     rung_cfg = None
+    tunnel_lost = False
     for i, rung in enumerate(LADDER):
-        result, err = _run_rung_subprocess(i, timeout_s=480)
+        result, err = _run_rung_subprocess(i, timeout_s=rung_timeout)
         # Per-rung emission: a later crash can no longer zero the round — the
         # outcome of every attempted rung is in the final JSON and on stderr.
         status = "ok" if result is not None else err
@@ -332,6 +378,28 @@ def main():
         if result is not None:
             rung_cfg = rung_log[-1]["config"]
             break
+        if _device_trouble(err):
+            ok2, d2, n2 = _acquire_device(probe_window, probe_timeout, probe_wait)
+            rung_log.append(
+                {"rung": f"reacquire-after-{i}", "status": "ok" if ok2 else d2, "probes": n2}
+            )
+            print(
+                f"# reacquire after rung {i}: {'ok' if ok2 else d2} ({n2} probes)",
+                file=sys.stderr,
+                flush=True,
+            )
+            if not ok2:
+                tunnel_lost = True
+                break
+            # Tunnel answered again: retry the SAME rung once before moving
+            # on — its failure may have been the wedge, not the config.
+            result, err = _run_rung_subprocess(i, timeout_s=rung_timeout)
+            status = "ok" if result is not None else err
+            rung_log.append({"rung": f"{i}-retry", "config": _cfg_str(rung), "status": status})
+            print(f"# rung {i} retry: {status}", file=sys.stderr, flush=True)
+            if result is not None:
+                rung_cfg = _cfg_str(rung)
+                break
     if result is None:
         print(
             json.dumps(
@@ -340,7 +408,7 @@ def main():
                     "value": 0.0,
                     "unit": "mfu_fraction",
                     "vs_baseline": 0.0,
-                    "error": "all rungs failed",
+                    "error": "tunnel lost mid-run" if tunnel_lost else "all rungs failed",
                     "detail": {"rungs": rung_log},
                 }
             )
@@ -353,7 +421,18 @@ def main():
     proof = None
     proof_cfg = None
     for i, rung in enumerate(PROOF_RUNGS):
-        proof, err = _run_rung_subprocess(i, timeout_s=480, flag="--proof-rung")
+        proof, err = _run_rung_subprocess(i, timeout_s=rung_timeout, flag="--proof-rung")
+        if proof is None and _device_trouble(err):
+            # The headline is already landed; still worth one bounded
+            # reacquire so the HBM-bound proof rides out a transient wedge.
+            ok2, d2, n2 = _acquire_device(min(probe_window, 1200.0), probe_timeout, probe_wait)
+            rung_log.append(
+                {"rung": f"proof-reacquire-{i}", "status": "ok" if ok2 else d2, "probes": n2}
+            )
+            if not ok2:
+                rung_log.append({"rung": f"proof-{i}", "config": _cfg_str(rung), "status": err})
+                break
+            proof, err = _run_rung_subprocess(i, timeout_s=rung_timeout, flag="--proof-rung")
         # A parseable-but-foreign JSON line (library noise) must not crash the
         # already-measured headline below — require the result keys.
         if proof is not None and not all(

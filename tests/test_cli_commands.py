@@ -184,6 +184,46 @@ def test_bench_ladder_subprocess_machinery():
     assert result["detail"]["tokens_per_sec"] > 0
 
 
+def test_bench_reacquires_after_rung_timeout():
+    """A rung timeout (the device-trouble signature of a wedged tunnel) must
+    trigger a bounded reacquire probe, ONE retry of the same rung, then fall
+    through to the next rung — instead of burning every rung against a dead
+    device or zeroing the round."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_LADDER_JSON"] = json.dumps(
+        [
+            # Big enough that compile+43 steps cannot finish in 120s on a
+            # 1-core CPU; the tiny rung fits comfortably.
+            ["slow", 1024, 8, 4096, 4, 1024, "einsum", "nothing"],
+            ["tiny", 64, 2, 128, 2, 64, "einsum", "nothing"],
+        ]
+    )
+    env["BENCH_RUNG_TIMEOUT_S"] = "120"
+    env["BENCH_PROBE_WINDOW_S"] = "120"
+    env["BENCH_PROBE_TIMEOUT_S"] = "60"
+    env["BENCH_PROBE_WAIT_S"] = "1"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py")],
+        capture_output=True, text=True, timeout=900, env=env, cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip().startswith("{")]
+    result = json.loads(lines[-1])
+    assert result["metric"] == "train_mfu" and "error" not in result
+    statuses = {str(r["rung"]): r["status"] for r in result["detail"]["rungs"]}
+    assert "timeout" in statuses["0"], statuses
+    assert statuses["reacquire-after-0"] == "ok", statuses  # CPU probe answers
+    assert "0-retry" in statuses, statuses  # same rung retried once
+    assert statuses["1"] == "ok", statuses  # ladder advanced and landed
+
+
 def _ref_yaml_variants():
     """Reference-shaped `accelerate config` YAMLs (one per engine family)."""
     return {
