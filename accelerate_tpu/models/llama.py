@@ -241,6 +241,26 @@ def _sp_active() -> bool:
     return bool(m is not None and not m.empty and "sp" in m.axis_names and m.shape["sp"] > 1)
 
 
+def _sp_use_pallas(c, s: int, head_dim: int) -> bool:
+    """Pallas selection for the sequence-parallel paths: explicit opt-in
+    always (the kernel auto-interprets off-TPU); "auto" on TPU when the
+    per-device sequence chunk still tiles into VMEM blocks."""
+    if c.attention_impl == "pallas":
+        return True
+    if c.attention_impl != "auto":
+        return False
+    try:
+        from ..ops.flash_attention import pick_block_pallas
+        from ..ops.pallas_attention import pallas_available
+    except ImportError:  # pragma: no cover
+        return False
+    if not pallas_available() or jax.default_backend() != "tpu":
+        return False
+    m = _abstract_mesh()
+    sp = m.shape["sp"] if m is not None and "sp" in m.axis_names else 1
+    return s % sp == 0 and pick_block_pallas(s // sp, head_dim=head_dim) is not None
+
+
 def _rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
     # fp32 statistics regardless of compute dtype.
     x32 = x.astype(jnp.float32)
@@ -356,12 +376,22 @@ def attention_block(x, p, c, mask, positions, kv_valid=None) -> jax.Array:
         # Sequence-parallel path over the sp axis; kv_valid (sequence-sharded)
         # rides the ring / all-gathers in the ulysses body.  mixtral shares
         # this block — getattr default covers configs without the knob.
+        # The fused Pallas kernel composes with both sp variants (per-block
+        # inside the ppermute ring; per-device local attention in ulysses) —
+        # selected by the same policy as the dense path, minus the padded-
+        # batch case the kernel does not mask.
+        sp_pallas = kv_valid is None and _sp_use_pallas(c, s, q.shape[-1])
         if getattr(c, "sp_impl", "ring") == "ulysses":
             from ..ops.ulysses_attention import ulysses_attention
 
             attn = ulysses_attention(
-                q, k, v, mesh=None, axis_name="sp", causal=True, kv_valid=kv_valid
+                q, k, v, mesh=None, axis_name="sp", causal=True, kv_valid=kv_valid,
+                impl="pallas" if sp_pallas else None,
             )
+        elif sp_pallas:
+            from ..ops.pallas_attention import ring_attention_pallas
+
+            attn = ring_attention_pallas(q, k, v, mesh=None, axis_name="sp", causal=True)
         else:
             from ..ops.ring_attention import ring_attention
 

@@ -19,9 +19,16 @@ Q heads and the K/V BlockSpec index maps divide by the group size; backward
 produces per-Q-head dK/dV which are group-summed outside the kernel.
 
 Partitioning note: ``pallas_call`` does not participate in GSPMD automatic
-partitioning, so this path is selected (``attention_impl="auto"``) only when
-the computation is single-device; the ``lax.scan`` flash path remains the
-spmd-friendly fallback XLA can slice freely on a multi-chip mesh.
+partitioning, so on a mesh the kernel always runs under ``shard_map``:
+
+- non-sp meshes: :func:`pallas_attention_spmd` — batch over the data axes,
+  heads over ``tp``, each device runs the fused kernel on its own shard;
+- sp meshes: :func:`ring_attention_pallas` — the Pallas kernel is the
+  per-block compute inside the ``ppermute`` ring (online-softmax combine of
+  per-block (out, lse) pairs; backward ring rotates dK/dV accumulators home
+  with their chunks), composing sequence parallelism with the fused kernel;
+- ulysses: ``ulysses_attention(..., impl="pallas")`` runs this kernel as the
+  per-device full-sequence attention between the two all-to-alls.
 """
 
 from __future__ import annotations
@@ -40,7 +47,12 @@ try:  # pallas TPU backend is absent on some CPU-only installs
 except Exception:  # pragma: no cover
     pltpu = None
 
-__all__ = ["pallas_attention", "pallas_attention_spmd", "pallas_available"]
+__all__ = [
+    "pallas_attention",
+    "pallas_attention_spmd",
+    "ring_attention_pallas",
+    "pallas_available",
+]
 
 _NEG_INF = -1e30  # finite: avoids inf-inf NaNs inside the exp bookkeeping
 
@@ -449,5 +461,176 @@ def pallas_attention_spmd(
 
     def body(q, k, v):
         return pallas_attention(q, k, v, causal=causal, block_size=block_size, interpret=interpret)
+
+    return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas-in-ring: sequence parallelism with the fused kernel per block
+# ---------------------------------------------------------------------------
+#
+# The ring loop is unrolled in Python (the axis size n is static), which keeps
+# the Pallas kernels exactly as compiled for the single-device path:
+#
+# - step r == 0: the local K/V chunk sits at the same global offset as the
+#   local queries, so the standard *causal* kernel applies;
+# - step r >  0: after r upward rotations the held chunk is (idx - r) % n.
+#   For equal chunks that is either entirely BEFORE the local queries
+#   (idx >= r: full non-causal attention) or entirely after (idx < r: no
+#   contribution) — so the *non-causal* kernel runs and a per-device gate
+#   (idx >= r) decides whether its (out, lse) pair enters the combine.  The
+#   gated-off devices still compute (same cost profile as the einsum ring,
+#   and what keeps every hop a pure neighbor exchange).
+#
+# Forward combine is the associative flash merge of normalized outputs:
+#   lse' = logaddexp(lse_a, lse_b);  out' = out_a·e^{lse_a-lse'} + out_b·e^{lse_b-lse'}.
+#
+# Backward is its own ring with the GLOBAL lse (saved from forward): per-block
+# flash backward with the true softmax normalizer is exact, dQ accumulates
+# locally, and the dK/dV accumulators ride the ring WITH their chunks so each
+# chunk arrives home carrying its full gradient after n rotations.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_mha(q, k, v, axis_name, n, scale, causal, blk, interpret):
+    out, _ = _ring_mha_fwd(q, k, v, axis_name, n, scale, causal, blk, interpret)
+    return out
+
+
+def _ring_perm(n):
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def _ring_mha_fwd(q, k, v, axis_name, n, scale, causal, blk, interpret):
+    """q: [B, H, Sq, d]; k, v: [B, K, Sq, d] — local chunks under shard_map."""
+    idx = jax.lax.axis_index(axis_name)
+    o_blk, lse_acc = _flash_fwd(
+        q, k, v, scale=scale, causal=causal, blk_q=blk, blk_k=blk, interpret=interpret
+    )
+    out_acc = o_blk.astype(jnp.float32)
+    k_r, v_r = k, v
+    perm = _ring_perm(n)
+    for r in range(1, n):
+        k_r = jax.lax.ppermute(k_r, axis_name, perm)
+        v_r = jax.lax.ppermute(v_r, axis_name, perm)
+        o_blk, lse_blk = _flash_fwd(
+            q, k_r, v_r, scale=scale, causal=False, blk_q=blk, blk_k=blk, interpret=interpret
+        )
+        if causal:
+            # Contribution gate; lse starts finite (every row of the causal
+            # step attends at least its own position), so the merge below
+            # never sees a -inf minus -inf.
+            lse_b = jnp.where(idx >= r, lse_blk, -jnp.inf)
+        else:
+            lse_b = lse_blk
+        m = jnp.maximum(lse_acc, lse_b)
+        lse_new = m + jnp.log(jnp.exp(lse_acc - m) + jnp.exp(lse_b - m))
+        out_acc = (
+            out_acc * jnp.exp(lse_acc - lse_new)[..., None]
+            + o_blk.astype(jnp.float32) * jnp.exp(lse_b - lse_new)[..., None]
+        )
+        lse_acc = lse_new
+    out = out_acc.astype(q.dtype)
+    return out, (q, k, v, out, lse_acc)
+
+
+def _ring_mha_bwd(axis_name, n, scale, causal, blk, interpret, res, do):
+    q, k, v, out, lse = res
+    idx = jax.lax.axis_index(axis_name)
+    perm = _ring_perm(n)
+    dq = jnp.zeros(q.shape, jnp.float32)
+    dk = jnp.zeros(k.shape, jnp.float32)
+    dv = jnp.zeros(v.shape, jnp.float32)
+    k_r, v_r = k, v
+    for r in range(n):
+        if r:
+            k_r = jax.lax.ppermute(k_r, axis_name, perm)
+            v_r = jax.lax.ppermute(v_r, axis_name, perm)
+            dk = jax.lax.ppermute(dk, axis_name, perm)
+            dv = jax.lax.ppermute(dv, axis_name, perm)
+        dq_b, dk_b, dv_b = _flash_bwd(
+            q, k_r, v_r, out, lse, do,
+            scale=scale, causal=(causal and r == 0), blk_q=blk, blk_k=blk,
+            interpret=interpret,
+        )
+        if causal and r:
+            gate = idx >= r
+            dq_b = jnp.where(gate, dq_b.astype(jnp.float32), 0.0)
+            dk_b = jnp.where(gate, dk_b.astype(jnp.float32), 0.0)
+            dv_b = jnp.where(gate, dv_b.astype(jnp.float32), 0.0)
+        dq = dq + dq_b.astype(jnp.float32)
+        dk = dk + dk_b.astype(jnp.float32)
+        dv = dv + dv_b.astype(jnp.float32)
+    # n-1 rotations happened in the loop, so the accumulator at device idx
+    # belongs to chunk (idx+1) % n — one final hop brings every chunk home.
+    dk = jax.lax.ppermute(dk, axis_name, perm)
+    dv = jax.lax.ppermute(dv, axis_name, perm)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_mha.defvjp(_ring_mha_fwd, _ring_mha_bwd)
+
+
+def ring_attention_pallas(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh=None,
+    axis_name: str = "sp",
+    *,
+    causal: bool = True,
+    block_size: int = 512,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Sequence-parallel flash attention with the Pallas kernel per ring block.
+
+    Same contract as ``ring_attention``: q ``[B, S, H, d]``, k/v
+    ``[B, S, K, d]`` with S sharded over ``axis_name``; no padding-mask
+    support (``kv_valid`` batches take the einsum ring).  Falls back to the
+    plain fused kernel when the axis is absent/trivial.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import data_axes
+    from .flash_attention import pick_block_pallas
+    from .ring_attention import resolve_sp_mesh, shard_map, tp_head_axis
+
+    if pltpu is None:
+        raise RuntimeError("jax.experimental.pallas.tpu unavailable")
+    mesh = resolve_sp_mesh(mesh, axis_name)
+    if mesh is None:
+        return pallas_attention(q, k, v, causal=causal, block_size=block_size, interpret=interpret)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    n = mesh.shape[axis_name]
+    b, s, h, d = q.shape
+    sq = s // n
+    blk = pick_block_pallas(sq, head_dim=d)
+    if blk is None:
+        raise ValueError(
+            f"ring_attention_pallas needs the per-device sequence chunk ({sq}) "
+            "divisible by 64/128/256/512 (VMEM tiling)"
+        )
+    blk = min(blk, block_size)
+    if sq % blk:
+        # A caller-supplied block_size that does not divide the chunk would
+        # silently truncate the kernel grid (nq = sq // blk) — refuse instead.
+        raise ValueError(
+            f"block_size {block_size} does not divide the per-device sequence "
+            f"chunk {sq}"
+        )
+    scale = float(1.0 / np.sqrt(d))
+
+    batch_axes = tuple(a for a in data_axes(mesh) if a != axis_name)
+    head_axis = tp_head_axis(mesh, h, k.shape[2])
+    spec = P(batch_axes if batch_axes else None, axis_name, head_axis, None)
+
+    def body(q, k, v):
+        qh = q.transpose(0, 2, 1, 3)
+        kh = k.transpose(0, 2, 1, 3)
+        vh = v.transpose(0, 2, 1, 3)
+        out = _ring_mha(qh, kh, vh, axis_name, n, scale, causal, blk, interpret)
+        return out.transpose(0, 2, 1, 3)
 
     return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)

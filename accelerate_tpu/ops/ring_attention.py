@@ -108,15 +108,26 @@ def _block_attention(q, k, v, mask, m_prev, l_prev, o_prev, scale):
     return m_new, l_new, o_new
 
 
-def full_sequence_attention(q, k, v, causal: bool = True, kv_valid=None) -> jax.Array:
+def full_sequence_attention(q, k, v, causal: bool = True, kv_valid=None, impl=None) -> jax.Array:
     """Full-sequence attention on local data — the shared non-ring path: flash
     (blockwise) when an MXU-friendly block divides S, otherwise one dense block
     through the same online-softmax math.  Used as the sp=1 fallback here and
     as the per-device local attention inside ulysses_attention.
 
-    ``kv_valid`` [B, S] (bool) marks valid keys for padded batches."""
+    ``kv_valid`` [B, S] (bool) marks valid keys for padded batches.
+    ``impl="pallas"`` runs the fused Pallas kernel instead (legal here even
+    under shard_map — the call is per-device); padded batches and non-tileable
+    sequence lengths fall back to the flash/dense path below."""
     b, s, h, d = q.shape
     from .flash_attention import flash_attention, pick_block
+
+    if impl == "pallas" and kv_valid is None:
+        from .flash_attention import pick_block_pallas
+        from .pallas_attention import pallas_attention, pallas_available
+
+        blk = pick_block_pallas(s, head_dim=d)
+        if pallas_available() and blk is not None:
+            return pallas_attention(q, k, v, causal=causal, block_size=blk)
 
     blk = pick_block(s)
     if blk is not None and s > blk:

@@ -45,7 +45,7 @@ def _kv_expansion(num_q_heads: int, num_kv_heads: int, n: int) -> int:
     return target // num_kv_heads
 
 
-def _ulysses_body(q, k, v, kv_valid, *, axis_name: str, causal: bool, has_valid: bool):
+def _ulysses_body(q, k, v, kv_valid, *, axis_name: str, causal: bool, has_valid: bool, impl=None):
     """Per-device body under shard_map.
 
     In:  q [B, S/n, H, d]; k, v [B, S/n, K, d] (sequence-sharded);
@@ -74,7 +74,7 @@ def _ulysses_body(q, k, v, kv_valid, *, axis_name: str, causal: bool, has_valid:
         # Local attention spans the FULL sequence here, so each device needs the
         # whole [B, S] validity vector (cheap: bools, no quadratic blowup).
         valid_full = jax.lax.all_gather(kv_valid, axis_name, axis=1, tiled=True)
-    out = full_sequence_attention(qh, kh_, vh, causal=causal, kv_valid=valid_full)
+    out = full_sequence_attention(qh, kh_, vh, causal=causal, kv_valid=valid_full, impl=impl)
     # head-sharded -> seq-sharded.
     return jax.lax.all_to_all(out, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True)
 
@@ -87,15 +87,17 @@ def ulysses_attention(
     axis_name: str = "sp",
     causal: bool = True,
     kv_valid: Optional[jax.Array] = None,
+    impl=None,
 ) -> jax.Array:
     """Sequence-parallel attention, all-to-all variant.  Same contract as
     ``ring_attention``: [B, S, H, d] x [B, S, K, d] -> [B, S, H, d] with S
     sharded over ``axis_name``; ``kv_valid`` [B, S] (bool, sequence-sharded)
     marks valid keys for padded batches; dense fallback when the axis is
-    trivial."""
+    trivial.  ``impl="pallas"`` runs the fused Pallas kernel as the per-device
+    local attention between the two all-to-alls."""
     mesh = resolve_sp_mesh(mesh, axis_name)
     if mesh is None:
-        return full_sequence_attention(q, k, v, causal=causal, kv_valid=kv_valid)
+        return full_sequence_attention(q, k, v, causal=causal, kv_valid=kv_valid, impl=impl)
 
     n = mesh.shape[axis_name]
     # Shard heads over tp too when both divisions work out (shared policy with
@@ -123,7 +125,7 @@ def ulysses_attention(
         kv_valid = jnp.ones(q.shape[:2], bool)
     valid_spec = P(batch_axes if batch_axes else None, axis_name)
     body = functools.partial(
-        _ulysses_body, axis_name=axis_name, causal=causal, has_valid=has_valid
+        _ulysses_body, axis_name=axis_name, causal=causal, has_valid=has_valid, impl=impl
     )
     return shard_map(
         body,

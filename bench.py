@@ -222,12 +222,15 @@ def _run_rung_subprocess(rung_index: int, timeout_s: int, flag: str = "--rung"):
         proc.terminate()  # cooperative: compile clients get to shut down
         try:
             stdout, stderr = proc.communicate(timeout=60)
-            return None, f"timeout after {timeout_s}s (exited on SIGTERM)"
         except subprocess.TimeoutExpired:
             proc.kill()  # stuck inside a C call; nothing else works
             proc.communicate()
             return None, f"timeout after {timeout_s}s (SIGKILL after 60s grace)"
-    if proc.returncode != 0:
+        if proc.returncode != 0:
+            return None, f"timeout after {timeout_s}s (exited on SIGTERM)"
+        # The child finished right at the deadline (exit 0 with a result on
+        # stdout): fall through and parse it rather than discard a valid
+        # measurement and burn a reacquire + retry.
         return None, (stderr or "")[-200:].replace("\n", " ")
     # Scan from the end for the LAST parseable JSON line — spurious
     # brace-prefixed library output (before or after the result) is skipped.
