@@ -978,6 +978,24 @@ def prepare_data_loader(
 
     if dispatch_batches:
         base = dataloader
+        if is_torch_loader and use_seedable_sampler:
+            # The seedable-sampler contract holds on the dispatcher path too
+            # (reference data_loader.py:1038-1048 swaps the sampler before
+            # choosing a wrapper): replace a RandomSampler inside the LIVE
+            # loader so process 0 reads the epoch-seeded permutation.
+            import torch.utils.data
+
+            samp = get_sampler(dataloader)
+            if isinstance(samp, torch.utils.data.RandomSampler):
+                seedable = SeedableRandomSampler(
+                    samp.data_source,
+                    initial_seed=data_seed if data_seed is not None else 42,
+                    generator=getattr(samp, "generator", None),
+                )
+                if getattr(dataloader, "batch_sampler", None) is not None:
+                    dataloader.batch_sampler.sampler = seedable
+                else:  # pragma: no cover - batch_sampler=None loaders
+                    dataloader.sampler = seedable
         return DataLoaderDispatcher(
             base,
             split_batches=split_batches,

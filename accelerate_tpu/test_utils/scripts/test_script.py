@@ -45,54 +45,134 @@ def dl_preparation_check():
         print("Non-shuffled dataloader passing.")
 
 
-def training_check():
+def training_check(use_seedable_sampler: bool = False):
+    """Reference ``training_check`` (test_script.py:454-818) as a full matrix:
+    a single-process torch-SGD baseline's final weights must be reproduced by
+    EVERY dataloader configuration — {no-split, split_batches} x
+    {dispatch_batches off, on} in fp32 (tight tolerance), then the
+    mixed-precision rungs (bf16, fp8) within loose tolerance — and the whole
+    sweep runs for both the sequential loader and the seedable-sampler
+    shuffle (the caller invokes it twice, like the reference's main)."""
     import torch
     import torch.nn.functional as F
     from torch.utils.data import DataLoader
 
     from accelerate_tpu.accelerator import Accelerator
-    from accelerate_tpu.state import AcceleratorState, GradientState
+    from accelerate_tpu.data_loader import SeedableRandomSampler
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
     from accelerate_tpu.test_utils import RegressionDataset, RegressionModel
+    from accelerate_tpu.utils.dataclasses import DataLoaderConfiguration
 
-    def collate(samples):
+    batch_size = 16
+    # Reference geometry: the baseline consumes the GLOBAL batch and the
+    # dataset scales with the parallel degree (test_script.py:457-459).  In
+    # the reference that degree is the process count; here the mesh's data
+    # shards play that role — a non-split prepared loader feeds batch_size
+    # PER SHARD, so the global batch is batch_size x shards.
+    from accelerate_tpu.parallel.mesh import data_axes
+
+    state0 = AcceleratorState()
+    data_shards = 1
+    for axis in data_axes(state0.mesh):
+        data_shards *= state0.mesh.shape[axis]
+    AcceleratorState._reset_state()
+    length = batch_size * 4 * data_shards
+    ds = RegressionDataset(length=length)
+    samples = list(ds)
+
+    def collate(items):
         return {
-            "x": torch.tensor([s["x"] for s in samples]),
-            "y": torch.tensor([s["y"] for s in samples]),
+            "x": torch.tensor([s["x"] for s in items]),
+            "y": torch.tensor([s["y"] for s in items]),
         }
 
-    # Single-process torch baseline.
+    def epoch_orders(n_epochs):
+        """Baseline iteration order per epoch: sequential, or the exact
+        permutations the prepared loader's SeedableRandomSampler will draw
+        (numpy rng seeded data_seed + epoch)."""
+        if not use_seedable_sampler:
+            return [list(range(length)) for _ in range(n_epochs)]
+        sampler = SeedableRandomSampler(samples, initial_seed=42)
+        return [list(iter(sampler)) for _ in range(n_epochs)]
+
+    # Single-process torch baseline on the global batch.
     torch.manual_seed(0)
-    ds = RegressionDataset(length=64)
-    dl = DataLoader(list(ds), batch_size=16, collate_fn=collate)
     model = RegressionModel()
     opt = torch.optim.SGD(model.parameters(), lr=0.1)
-    for _ in range(3):
-        for batch in dl:
+    global_bs = batch_size * data_shards
+    for order in epoch_orders(3):
+        for i in range(0, length, global_bs):
+            batch = collate([samples[j] for j in order[i : i + global_bs]])
             opt.zero_grad()
             loss = F.mse_loss(model(batch["x"]), batch["y"])
             loss.backward()
             opt.step()
-    base_a, base_b = float(model.a), float(model.b)
+    base_a, base_b = model.a.detach().item(), model.b.detach().item()
 
-    accelerator = Accelerator(split_batches=True)
-    dl = DataLoader(list(ds), batch_size=16, collate_fn=collate)
-    model = RegressionModel()
-    opt = torch.optim.SGD(model.parameters(), lr=0.1)
-    model, opt, dl = accelerator.prepare(model, opt, dl)
-    for _ in range(3):
-        for batch in dl:
-            with accelerator.accumulate(model):
+    def make_dl(bs):
+        if use_seedable_sampler:
+            return DataLoader(samples, batch_size=bs, shuffle=True, collate_fn=collate)
+        return DataLoader(samples, batch_size=bs, collate_fn=collate)
+
+    def run_prepared(accelerator, bs, tol, label):
+        model = RegressionModel()
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        model, opt, dl = accelerator.prepare(model, opt, make_dl(bs))
+        for _ in range(3):
+            for batch in dl:
                 pred = model(batch["x"])
                 loss = F.mse_loss(pred, batch["y"])
                 accelerator.backward(loss)
                 opt.step()
                 opt.zero_grad()
-    sd = model.state_dict()
-    a, b = float(np.asarray(sd["a"])), float(np.asarray(sd["b"]))
-    assert abs(a - base_a) < 1e-3, f"a mismatch: {a} vs {base_a}"
-    assert abs(b - base_b) < 1e-3, f"b mismatch: {b} vs {base_b}"
-    if accelerator.is_main_process:
-        print("Training yielded the same results on one process and the mesh.")
+        sd = model.state_dict()
+        a, b = float(np.asarray(sd["a"])), float(np.asarray(sd["b"]))
+        assert abs(a - base_a) < tol and abs(b - base_b) < tol, (
+            f"{label}: final weights ({a:.6f}, {b:.6f}) diverge from the "
+            f"baseline ({base_a:.6f}, {base_b:.6f})"
+        )
+        if accelerator.is_main_process:
+            print(f"Training matched the baseline: {label}.")
+
+    def fresh():
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+
+    sampler_tag = "seedable" if use_seedable_sampler else "sequential"
+    # fp32 matrix: split_batches x dispatch_batches, identical weights.
+    for split in (False, True):
+        for dispatch in (False, True):
+            fresh()
+            acc = Accelerator(
+                dataloader_config=DataLoaderConfiguration(
+                    split_batches=split,
+                    dispatch_batches=dispatch,
+                    use_seedable_sampler=use_seedable_sampler,
+                    data_seed=42,
+                )
+            )
+            # split mode consumes the loader at the global batch size
+            # (reference test_script.py:498-501).
+            run_prepared(
+                acc,
+                global_bs if split else batch_size,
+                1e-3,
+                f"{sampler_tag}/split={split}/dispatch={dispatch}",
+            )
+
+    # Precision rungs: bf16 compute and the native fp8 path must converge to
+    # the same weights within mixed-precision rounding (reference's BF16/FP16
+    # training checks; fp8 replaces the CUDA-only TE/MSAMP engines).
+    for mp in ("bf16", "fp8"):
+        fresh()
+        acc = Accelerator(
+            mixed_precision=mp,
+            dataloader_config=DataLoaderConfiguration(
+                use_seedable_sampler=use_seedable_sampler, data_seed=42
+            ),
+        )
+        run_prepared(acc, batch_size, 5e-2, f"{sampler_tag}/{mp}")
+    fresh()
 
 
 def split_between_processes_check():
@@ -137,7 +217,8 @@ def main():
 
     AcceleratorState._reset_state()
     GradientState._reset_state()
-    training_check()
+    training_check(use_seedable_sampler=False)
+    training_check(use_seedable_sampler=True)
     split_between_processes_check()
     AcceleratorState._reset_state()
     GradientState._reset_state()
