@@ -43,6 +43,7 @@ def _run(
     loss_impl: str = "dense",
     param_dtype: str = "f32",
     vocab_size: int = 32000,
+    host_opt: bool = False,
 ):
     import jax
     import jax.numpy as jnp
@@ -75,19 +76,45 @@ def _run(
     )
     params = llama.init_params(cfg, jax.random.key(0))
     tx = optax.adamw(1e-4)
+    if host_opt:
+        # ZeRO-offload rung: AdamW moments live in pinned host memory and ride
+        # explicit H2D/D2H transfers inside the step — frees ~4N bytes of HBM
+        # (the moments) at the cost of per-step host-link traffic.
+        from accelerate_tpu.parallel.host_offload import host_offload
+
+        tx = host_offload(tx)
     opt_state = tx.init(params)
     tokens = np.random.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
     batch_tree = {"input_ids": jnp.asarray(tokens)}
 
     import functools
 
-    # Donation matters: without it every step copies params+opt state (~45 ms
-    # and 2x transient HBM at this size).
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def train_step(params, opt_state, batch_tree):
+    def _step(params, opt_state, batch_tree):
         loss, grads = jax.value_and_grad(llama.loss_fn)(params, batch_tree, cfg)
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
+
+    # Donation matters: without it every step copies params+opt state (~45 ms
+    # and 2x transient HBM at this size).
+    if host_opt and jax.default_backend() == "tpu":
+        # The carried opt state must come back in host memory — pin the out
+        # shardings so the donated pinned_host buffers are reused instead of
+        # clashing with a default device-placed output.
+        opt_sh = jax.tree_util.tree_map(
+            lambda x: x.sharding if isinstance(x, jax.Array) else None, opt_state
+        )
+        train_step = jax.jit(
+            _step, donate_argnums=(0, 1), out_shardings=(None, opt_sh, None)
+        )
+    elif host_opt:
+        # CPU smoke path: the backend cannot execute D2H placement inside jit,
+        # so the state silently returns in device memory — numerics identical,
+        # placement untested here (the TPU rung is the real measurement).
+        # Donating the pinned_host input against a device output would crash;
+        # donate params only.
+        train_step = jax.jit(_step, donate_argnums=(0,))
+    else:
+        train_step = jax.jit(_step, donate_argnums=(0, 1))
 
     # Warmup / compile.  NOTE: sync via device_get — block_until_ready does not
     # reliably block on tunneled platforms.
@@ -188,6 +215,18 @@ PROOF_RUNGS = [
     ("llama-1.4b", 2048, 20, 8192, 2, 2048, "pallas", "dots", "chunked", "bf16"),
     ("llama-1.4b", 2048, 20, 8192, 4, 2048, "pallas", "nothing", "dense", "bf16"),
 ]
+
+# Opt-in (unmeasured): host-offloaded AdamW moments free ~5.6G of HBM at 1.39B
+# — enough for batch 3-4 where batch 2 was the dense frontier — IF the ~11GB
+# per-step host-link round-trip hides behind the longer step.  Never shadows
+# the proven rungs without the flag.
+if os.environ.get("BENCH_TRY_HOSTOPT"):
+    PROOF_RUNGS.insert(
+        0, ("llama-1.4b-hostopt", 2048, 20, 8192, 4, 2048, "pallas", "dots", "dense", "bf16", 32000, True)
+    )
+    PROOF_RUNGS.insert(
+        1, ("llama-1.4b-hostopt", 2048, 20, 8192, 3, 2048, "pallas", "dots", "dense", "bf16", 32000, True)
+    )
 
 # Test hook: lets the smoke tests exercise the rung-subprocess machinery with
 # CPU-sized configs (a real rung takes minutes on CPU).
@@ -310,9 +349,13 @@ def main():
         loss_impl = rung[8] if len(rung) > 8 else "dense"
         param_dtype = rung[9] if len(rung) > 9 else "f32"
         vocab = rung[10] if len(rung) > 10 else 32000
+        host_opt = bool(rung[11]) if len(rung) > 11 else False
         print(
             json.dumps(
-                _run(name, d, layers, f, b, s, impl, policy, loss_impl, param_dtype, vocab)
+                _run(
+                    name, d, layers, f, b, s, impl, policy, loss_impl, param_dtype,
+                    vocab, host_opt,
+                )
             )
         )
         return
