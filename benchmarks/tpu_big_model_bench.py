@@ -38,21 +38,23 @@ def _sync(x):
     return jax.device_get([leaf.ravel()[:1] for leaf in jax.tree_util.tree_leaves(x)])
 
 
-def resident_rung(prompt_len: int = 128, new_tokens: int = 32, batch: int = 1):
+def resident_rung(prompt_len: int = 128, new_tokens: int = 32, batch: int = 1, tiny: bool = False):
     import jax
     import jax.numpy as jnp
 
     from accelerate_tpu.models import llama
 
+    geom = (
+        dict(hidden_size=256, intermediate_size=512, num_layers=4,
+             num_heads=4, num_kv_heads=4, vocab_size=512)
+        if tiny
+        else dict(hidden_size=4096, intermediate_size=11008, num_layers=32,
+                  num_heads=32, num_kv_heads=32, vocab_size=32000)  # llama2-7b MHA
+    )
     cfg = llama.LlamaConfig(
-        vocab_size=32000,
-        hidden_size=4096,
-        intermediate_size=11008,
-        num_layers=32,
-        num_heads=32,
-        num_kv_heads=32,  # llama2-7b is MHA
         max_seq_len=prompt_len + new_tokens,
         param_dtype=jnp.bfloat16,
+        **geom,
     )
     t0 = time.perf_counter()
     params = llama.init_params(cfg, jax.random.key(0))
@@ -81,7 +83,7 @@ def resident_rung(prompt_len: int = 128, new_tokens: int = 32, batch: int = 1):
     }
 
 
-def streamed_rung(new_tokens: int = 8, batch: int = 8, max_len: int = 64):
+def streamed_rung(new_tokens: int = 8, batch: int = 8, max_len: int = 64, tiny: bool = False):
     """8.36B params streamed from host RAM through double device buffers."""
     import jax
     import jax.numpy as jnp
@@ -89,20 +91,19 @@ def streamed_rung(new_tokens: int = 8, batch: int = 8, max_len: int = 64):
 
     from accelerate_tpu.models import llama
 
-    cfg = llama.LlamaConfig(
-        vocab_size=32000,
-        hidden_size=4096,
-        intermediate_size=11008,
-        num_layers=40,
-        num_heads=32,
-        num_kv_heads=32,
-        max_seq_len=max_len,
-        param_dtype=jnp.bfloat16,
+    geom = (
+        dict(hidden_size=256, intermediate_size=512, num_layers=6,
+             num_heads=4, num_kv_heads=4, vocab_size=512)
+        if tiny
+        else dict(hidden_size=4096, intermediate_size=11008, num_layers=40,
+                  num_heads=32, num_kv_heads=32, vocab_size=32000)
     )
+    cfg = llama.LlamaConfig(max_seq_len=max_len, param_dtype=jnp.bfloat16, **geom)
     L, d, f, hd = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size, cfg.head_dim_
     K = cfg.num_kv_heads
     n_params = cfg.num_params()
-    assert n_params * 2 > 15.75e9, "streamed rung must NOT fit HBM"
+    if not tiny:
+        assert n_params * 2 > 15.75e9, "streamed rung must NOT fit HBM"
 
     # Host-resident per-layer params.  Values are irrelevant to throughput;
     # zeros avoid NaN propagation and calloc makes 16 GB instant.
@@ -227,6 +228,8 @@ def main():
     parser.add_argument("--rung", choices=("resident", "streamed", "both"), default="both")
     parser.add_argument("--batch", type=int, default=None)
     parser.add_argument("--new", type=int, default=None)
+    parser.add_argument("--tiny", action="store_true",
+                        help="CPU shakedown geometry (validates the code path only)")
     args = parser.parse_args()
     if args.rung in ("resident", "both"):
         kw = {}
@@ -234,14 +237,14 @@ def main():
             kw["batch"] = args.batch
         if args.new:
             kw["new_tokens"] = args.new
-        print(json.dumps(resident_rung(**kw)), flush=True)
+        print(json.dumps(resident_rung(tiny=args.tiny, **kw)), flush=True)
     if args.rung in ("streamed", "both"):
         kw = {}
         if args.batch:
             kw["batch"] = args.batch
         if args.new:
             kw["new_tokens"] = args.new
-        print(json.dumps(streamed_rung(**kw)), flush=True)
+        print(json.dumps(streamed_rung(tiny=args.tiny, **kw)), flush=True)
 
 
 if __name__ == "__main__":
