@@ -540,55 +540,71 @@ def test_bullet_menu_numbered_fallback(monkeypatch, capsys):
 def test_bullet_menu_interactive_pty():
     """Raw-mode key handling on a real pty: arrow keys navigate (fd-level
     reads must agree with select), bare/SS3/long-CSI escape sequences are
-    swallowed without aborting or leaking bytes into the command stream."""
-    import os as _os
-    import pty
-    import sys as _sys
-    import threading
-    import time
+    swallowed without aborting or leaking bytes into the command stream.
+    The whole pty dance runs in a fresh interpreter: pty.fork() inside the
+    multithreaded (JAX) pytest process would warn and risk deadlock."""
+    import os
+    import subprocess
+    import sys
 
-    pid, master = pty.fork()
-    if pid == 0:  # child: drive a menu on the pty
+    driver = r"""
+import os, pty, sys, threading, time
+
+pid, master = pty.fork()
+if pid == 0:
+    try:
+        from accelerate_tpu.commands.menu import BulletMenu
+        idx = BulletMenu("pick:", ["alpha", "beta", "gamma"]).run(0)
+        os.write(1, f"\nRESULT={idx}\n".encode())
+    finally:
+        os._exit(0)
+
+chunks = []
+def reader():
+    while True:
         try:
-            # pytest's capture rebinds sys.stdin/stdout to non-fd objects;
-            # point them back at the pty so the menu sees a real TTY.
-            _sys.stdin = open(0, closefd=False)
-            _sys.stdout = open(1, "w", closefd=False)
-            from accelerate_tpu.commands.menu import BulletMenu
+            d = os.read(master, 1024)
+        except OSError:
+            return
+        if not d:
+            return
+        chunks.append(d)
 
-            idx = BulletMenu("pick:", ["alpha", "beta", "gamma"]).run(0)
-            _os.write(1, f"\nRESULT={idx}\n".encode())
-        finally:
-            _os._exit(0)
-
-    chunks = []
-
-    def reader():
-        while True:
-            try:
-                d = _os.read(master, 1024)
-            except OSError:
-                return
-            if not d:
-                return
-            chunks.append(d)
-
-    t = threading.Thread(target=reader, daemon=True)
-    t.start()
-    time.sleep(1.0)
-    for seq, wait in [
-        (b"\x1b[B", 0.3),  # down (single packet: CSI buffered with ESC)
-        (b"\x1b[B", 0.3),  # down -> gamma
-        (b"\x1bOq", 0.3),  # SS3 keypad seq: swallowed, 'q' must NOT abort
-        (b"\x1b[1~", 0.3),  # Home, long CSI: swallowed, '~' must not leak
-        (b"\r", 0.0),  # enter
-    ]:
-        _os.write(master, seq)
-        time.sleep(wait)
-    t.join(timeout=10)
-    _os.waitpid(pid, 0)
-    text = b"".join(chunks).decode("latin-1", "replace")
-    assert "RESULT=2" in text, text[-400:]
+t = threading.Thread(target=reader, daemon=True)
+t.start()
+# Wait until the menu has rendered (raw mode active) before sending keys —
+# bytes sent earlier are eaten by the canonical-mode line discipline.
+deadline = time.time() + 60
+while time.time() < deadline:
+    if b"gamma" in b"".join(chunks):
+        break
+    time.sleep(0.1)
+else:
+    raise SystemExit("menu never rendered: " + repr(b"".join(chunks)[-300:]))
+for seq, wait in [
+    (b"\x1b[B", 0.3),   # down (single packet: CSI buffered with ESC)
+    (b"\x1b[B", 0.3),   # down -> gamma
+    (b"\x1bOq", 0.3),   # SS3 keypad seq: swallowed, 'q' must NOT abort
+    (b"\x1b[1~", 0.3),  # Home, long CSI: swallowed, '~' must not leak
+    (b"\r", 0.0),       # enter
+]:
+    os.write(master, seq)
+    time.sleep(wait)
+t.join(timeout=10)
+os.waitpid(pid, 0)
+text = b"".join(chunks).decode("latin-1", "replace")
+assert "RESULT=2" in text, text[-400:]
+print("PTY_OK")
+"""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    proc = subprocess.run(
+        [sys.executable, "-c", driver],
+        capture_output=True, text=True, timeout=120, env=env, cwd=repo,
+    )
+    assert proc.returncode == 0 and "PTY_OK" in proc.stdout, (
+        proc.stdout[-300:] + proc.stderr[-500:]
+    )
 
 
 def test_config_update_migrates_and_drops_unknown(tmp_path):
