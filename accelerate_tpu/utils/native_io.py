@@ -85,6 +85,10 @@ def _load():
         lib.ts_pool_destroy.argtypes = [ctypes.c_void_p]
         lib.ts_pool_prefetch.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.ts_pool_prefetch.restype = ctypes.c_int
+        # Older prebuilt .so may predate the batched entry point.
+        if hasattr(lib, "ts_pool_prefetch_many"):
+            lib.ts_pool_prefetch_many.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.ts_pool_prefetch_many.restype = ctypes.c_int
         lib.ts_pool_fetch.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_uint64,
         ]
@@ -157,6 +161,20 @@ class PrefetchPool:
         with self._flock:
             if path not in self._futures:
                 self._futures[path] = self._executor.submit(self._read_all, path)
+
+    def prefetch_many(self, paths) -> None:
+        """Queue a batch in ONE native call (one lock, one worker wake).
+        Per-path enqueues each pay a scheduler round-trip — on single-core
+        hosts the notify preempts the caller — so a ~10-tensor block batches
+        into a single call."""
+        paths = [p for p in paths]
+        if not paths:
+            return
+        if self._lib is not None and hasattr(self._lib, "ts_pool_prefetch_many"):
+            self._lib.ts_pool_prefetch_many(self._pool, "\n".join(paths).encode())
+            return
+        for p in paths:
+            self.prefetch(p)
 
     @staticmethod
     def _read_all(path: str) -> bytes:

@@ -117,19 +117,24 @@ class OffloadedWeightsLoader(Mapping):
         return os.path.join(self.save_folder, f"{key}.dat")
 
     def prefetch(self, keys) -> None:
-        """Queue async loads of offloaded ``.dat`` weights."""
+        """Queue async loads of offloaded ``.dat`` weights — the whole batch
+        in one pool call (a block's ~10 tensors would otherwise pay a
+        scheduler round-trip per enqueue)."""
         if self.save_folder is None:
             return
         from .native_io import PrefetchPool
 
         if self._pool is None:
             self._pool = PrefetchPool(self._prefetch_threads)
+        paths = []
         for key in keys:
             info = self.index.get(key)
             if info is None or key in self.state_dict or info.get("safetensors_file"):
                 continue
-            self._pool.prefetch(self._weight_file(key))
+            paths.append(self._weight_file(key))
             self._prefetched.add(key)
+        if paths:
+            self._pool.prefetch_many(paths)
 
     def __getitem__(self, key: str):
         if key in self.state_dict:

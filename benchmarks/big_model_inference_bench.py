@@ -91,6 +91,8 @@ def run(tier: str, args, ckpt: str) -> dict:
                 ids = torch.cat([ids, nxt], dim=1)
         per_token = (time.perf_counter() - t0) / args.new
         remove_hook_from_submodules(model)
+    import os
+
     return {
         "metric": "big_model_inference",
         "tier": tier,
@@ -98,6 +100,13 @@ def run(tier: str, args, ckpt: str) -> dict:
         "s_per_token": round(per_token, 4),
         # numel works on meta/offloaded tensors too — no extra init.
         "params": sum(p.numel() for p in model.parameters()),
+        # Interpretation guard: this toy bench computes on the HOST (torch
+        # CPU), so on a single-core machine the prefetch pool cannot overlap
+        # reads with compute at all — the disk tier necessarily pays
+        # read-time + compute-time.  Overlap is only measurable when compute
+        # runs on the device (benchmarks/tpu_big_model_bench.py streamed
+        # rung), which frees the host core for IO.
+        "host_cpus": os.cpu_count(),
     }
 
 
