@@ -53,6 +53,28 @@ def test_prefetch_pool_roundtrip(tmp_path):
     pool.close()
 
 
+def test_prefetch_pool_batched_enqueue(tmp_path):
+    """prefetch_many (one native call per block) must behave exactly like
+    per-path prefetch: every fetch returns exact contents, re-enqueues of
+    pending paths are idempotent, and unknown paths still fetch sync."""
+    pool = PrefetchPool(num_threads=2)
+    files = {}
+    for i in range(6):
+        arr = np.random.randn(128, 32).astype(np.float32)
+        path = str(tmp_path / f"b{i}.dat")
+        write_bytes(path, arr)
+        files[path] = arr
+    paths = list(files)
+    pool.prefetch_many(paths[:4])
+    pool.prefetch_many(paths)  # overlap with already-queued: idempotent
+    for path, arr in files.items():
+        got = pool.fetch(path, arr.nbytes)
+        np.testing.assert_array_equal(got.view(np.float32).reshape(arr.shape), arr)
+    # Nothing left pending once every path is consumed.
+    assert pool.pending() == 0
+    pool.close()
+
+
 def test_prefetch_pool_fetch_without_prefetch(tmp_path):
     pool = PrefetchPool()
     arr = np.ones(32, np.float64)
