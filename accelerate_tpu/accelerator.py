@@ -122,12 +122,40 @@ class _LazyOutputs:
         return f"_LazyOutputs({keys})"
 
 
+def _local_numpy(x: jax.Array) -> np.ndarray:
+    """Host copy of the PROCESS-LOCAL portion of a jax.Array.
+
+    Fully-addressable arrays fetch whole.  Multi-process global arrays
+    cannot be fetched (jax raises); each process instead assembles its own
+    addressable shards — DDP semantics: rank-local batch rows in, rank-local
+    outputs back.  Replicated copies dedup by slice; a single varying axis
+    (the batch/data dim) concatenates in index order, which is also the
+    layout ``jax.make_array_from_process_local_data`` expects when the
+    backward rebuilds the global cotangent."""
+    if x.is_fully_addressable:
+        return np.asarray(jax.device_get(x))
+    seen: dict = {}
+    for sh in x.addressable_shards:
+        key = tuple((sl.start or 0, sl.stop) for sl in sh.index)
+        seen.setdefault(key, np.asarray(sh.data))
+    if len(seen) == 1:
+        return next(iter(seen.values()))
+    keys = sorted(seen)
+    varying = [i for i in range(len(keys[0])) if len({k[i] for k in keys}) > 1]
+    if len(varying) != 1:
+        raise NotImplementedError(
+            "process-local assembly of an array sharded on multiple axes "
+            f"({varying}) is not supported on the torch-bridge boundary"
+        )
+    return np.concatenate([seen[k] for k in keys], axis=varying[0])
+
+
 def _jax_to_torch(x):
     if not isinstance(x, jax.Array):
         return x
     import torch
 
-    arr = np.asarray(jax.device_get(x))
+    arr = _local_numpy(x)
     if not arr.flags.writeable:
         # torch.from_numpy on a read-only view warns (and writing through the
         # tensor would be UB); jax.device_get returns read-only arrays.
@@ -363,14 +391,53 @@ class PreparedModel:
                 out = model._jit_fwd(model.params, args, kwargs)
                 flat, treedef = jax.tree_util.tree_flatten(out)
                 out_struct["treedef"] = treedef
-                out_struct["avals"] = [(f.shape, f.dtype) for f in flat]
-                return tuple(_jax_to_torch(f) for f in flat)
+                torch_out = tuple(_jax_to_torch(f) for f in flat)
+                # Keep each output's sharding: on multi-process clusters the
+                # torch side sees only the LOCAL rows, and the backward must
+                # rebuild the GLOBAL cotangent from each process's local grad.
+                # ``scaled``: True only when the torch side actually received
+                # a local SLICE (data-sharded output) — those cotangents sum
+                # across ranks inside the spmd vjp and carry the DDP 1/P.
+                # Replicated global outputs (full copy on every rank) have no
+                # cross-rank summation to cancel and must NOT be shrunk.
+                out_struct["avals"] = [
+                    (
+                        f.shape,
+                        f.dtype,
+                        None if f.is_fully_addressable else f.sharding,
+                        (not f.is_fully_addressable) and tuple(t.shape) != tuple(f.shape),
+                    )
+                    for f, t in zip(flat, torch_out)
+                ]
+                return torch_out
 
             @staticmethod
             def backward(ctx, *grad_outputs):
+                def as_global(g, shape, dtype, sharding, scaled):
+                    if g is None:
+                        cot = jnp.zeros(shape, dtype)
+                        if sharding is not None:
+                            cot = jax.device_put(cot, sharding)
+                        return cot
+                    arr = to_numpy(g).astype(dtype)
+                    if sharding is None:
+                        return jnp.asarray(arr)
+                    if scaled:
+                        # Local rows -> global array (inverse of _local_numpy).
+                        # DDP semantics: each rank computed a MEAN loss over
+                        # its local rows, and ranks' gradients are AVERAGED —
+                        # the spmd vjp sums contributions across the data
+                        # axis, so the per-rank cotangent carries the 1/P.
+                        # (Divide-then-recast: numpy promotes bf16/fp16 under
+                        # true division, and the vjp needs the exact dtype.)
+                        from .state import PartialState
+
+                        arr = (arr / PartialState().num_processes).astype(dtype, copy=False)
+                    return jax.make_array_from_process_local_data(sharding, arr)
+
                 cotangents = [
-                    jnp.asarray(to_numpy(g)).astype(d) if g is not None else jnp.zeros(s, d)
-                    for g, (s, d) in zip(grad_outputs, out_struct["avals"])
+                    as_global(g, s, d, sh, sc)
+                    for g, (s, d, sh, sc) in zip(grad_outputs, out_struct["avals"])
                 ]
                 cot_tree = jax.tree_util.tree_unflatten(out_struct["treedef"], cotangents)
                 grads = model._jit_vjp(model.params, args, kwargs, cot_tree)

@@ -119,3 +119,21 @@ def check_split_between_processes(expected: int):
     state = PartialState()
     with state.split_between_processes(list(range(7)), apply_padding=True) as chunk:
         assert len(chunk) == 4 if expected == 2 else True
+
+
+def run_training_matrix(expected: int):
+    """The test_script training_check matrix across a real multi-process
+    cluster (reference: torchrun test_script.py) — quick combos via
+    ACCELERATE_TEST_QUICK so each process's recompiles stay bounded."""
+    import os
+
+    from accelerate_tpu.state import PartialState
+
+    os.environ["ACCELERATE_TEST_QUICK"] = "1"
+    state = PartialState()
+    assert state.num_processes == expected, (state.num_processes, expected)
+    from accelerate_tpu.test_utils.scripts.test_script import training_check
+
+    training_check(use_seedable_sampler=False)
+    training_check(use_seedable_sampler=True)
+    state.wait_for_everyone()

@@ -83,41 +83,35 @@ def test_merge_weights_roundtrip(tmp_path):
     assert merged["w"].shape == (4, 2)
 
 
-@pytest.mark.slow
-def test_debug_launcher_forms_real_cluster():
-    """Two OS processes join a jax.distributed cluster and run collectives."""
+def _run_cluster_worker(worker: str, token: str, timeout: int = 300, nproc: int = 2):
+    """Run a debug_workers payload across a real N-process cluster and assert
+    it printed ``token`` — shared boilerplate for the cluster smoke tests."""
     code = (
         "from accelerate_tpu.launchers import debug_launcher;"
-        "from accelerate_tpu.test_utils.scripts.debug_workers import check_cluster_formed;"
-        "debug_launcher(check_cluster_formed, args=(2,), num_processes=2);"
-        "print('CLUSTER_OK')"
+        f"from accelerate_tpu.test_utils.scripts.debug_workers import {worker};"
+        f"debug_launcher({worker}, args=({nproc},), num_processes={nproc});"
+        f"print('{token}')"
     )
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env["JAX_PLATFORMS"] = "cpu"
     res = subprocess.run(
-        [sys.executable, "-c", code], capture_output=True, text=True, timeout=180, cwd="/root/repo", env=env
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=timeout, cwd="/root/repo", env=env,
     )
-    assert res.returncode == 0, res.stderr[-2000:]
-    assert "CLUSTER_OK" in res.stdout
+    assert res.returncode == 0, res.stderr[-3000:]
+    assert token in res.stdout
+
+
+@pytest.mark.slow
+def test_debug_launcher_forms_real_cluster():
+    """Two OS processes join a jax.distributed cluster and run collectives."""
+    _run_cluster_worker("check_cluster_formed", "CLUSTER_OK", timeout=180)
 
 
 @pytest.mark.slow
 def test_debug_launcher_object_collectives():
-    code = (
-        "from accelerate_tpu.launchers import debug_launcher;"
-        "from accelerate_tpu.test_utils.scripts.debug_workers import check_object_collectives;"
-        "debug_launcher(check_object_collectives, args=(2,), num_processes=2);"
-        "print('OBJECTS_OK')"
-    )
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    res = subprocess.run(
-        [sys.executable, "-c", code], capture_output=True, text=True, timeout=180, cwd="/root/repo", env=env
-    )
-    assert res.returncode == 0, res.stderr[-2000:]
-    assert "OBJECTS_OK" in res.stdout
+    _run_cluster_worker("check_object_collectives", "OBJECTS_OK", timeout=180)
 
 
 @pytest.mark.slow
@@ -127,20 +121,16 @@ def test_data_loop_payload_on_two_process_cluster():
     stateful mid-epoch resume) across TWO OS processes on a real
     jax.distributed cluster — reference runs the same payload under torchrun
     (test_utils/scripts/test_distributed_data_loop.py)."""
-    code = (
-        "from accelerate_tpu.launchers import debug_launcher;"
-        "from accelerate_tpu.test_utils.scripts.debug_workers import run_data_loop_suite;"
-        "debug_launcher(run_data_loop_suite, args=(2,), num_processes=2);"
-        "print('DATA_LOOP_OK')"
-    )
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    res = subprocess.run(
-        [sys.executable, "-c", code], capture_output=True, text=True, timeout=300, cwd="/root/repo", env=env
-    )
-    assert res.returncode == 0, res.stderr[-3000:]
-    assert "DATA_LOOP_OK" in res.stdout
+    _run_cluster_worker("run_data_loop_suite", "DATA_LOOP_OK", timeout=300)
+
+
+@pytest.mark.slow
+def test_training_matrix_on_two_process_cluster():
+    """The training_check identical-weights matrix across TWO OS processes on
+    a real jax.distributed cluster (reference runs test_script.py under
+    torchrun) — quick combos: {no-split, split+dispatch} x {sequential,
+    seedable}."""
+    _run_cluster_worker("run_training_matrix", "TRAIN_MATRIX_OK", timeout=600)
 
 
 def test_launch_module_flag(tmp_path):
