@@ -133,6 +133,13 @@ def test_training_matrix_on_two_process_cluster():
     _run_cluster_worker("run_training_matrix", "TRAIN_MATRIX_OK", timeout=600)
 
 
+@pytest.mark.slow
+def test_local_state_dict_on_two_process_cluster():
+    """LOCAL_STATE_DICT across two OS processes: each rank dumps only its
+    own shards and restores them exactly (the topology-bound contract)."""
+    _run_cluster_worker("run_local_state_dict_roundtrip", "LOCAL_SD_OK", timeout=300)
+
+
 def test_launch_module_flag(tmp_path):
     """accelerate-tpu launch -m pkg.module parity (reference launch --module)."""
     pkg = tmp_path / "fakepkg"
