@@ -1135,6 +1135,20 @@ class Accelerator:
                 "same model+optimizer pairing for FSDP, accelerator.py:1384-1398)."
             )
         model = self._models[-1]
+        # Honor the offload knobs: fsdp_plugin.cpu_offload and the DeepSpeed
+        # dialect's offload_optimizer both mean "optimizer state in host
+        # memory" — wired through parallel/host_offload (pinned_host placement
+        # + in-step transfers).
+        host_off = bool(
+            getattr(getattr(self.state, "fsdp_plugin", None), "cpu_offload", False)
+        ) or (
+            getattr(
+                getattr(self.state, "deepspeed_plugin", None),
+                "offload_optimizer_device",
+                None,
+            )
+            in ("cpu", "nvme")
+        )
         if isinstance(optimizer, torch.optim.Optimizer):
             # Pair by PARAMETER IDENTITY, not recency: with several models under
             # one Accelerator (reference test_ds_multiple_model.py), each torch
@@ -1152,9 +1166,12 @@ class Accelerator:
             from .utils.torch_bridge import convert_optimizer
 
             tx, lr = convert_optimizer(optimizer)
-            prepared = AcceleratedOptimizer(tx, model=model, torch_optimizer=optimizer, initial_lr=lr)
+            prepared = AcceleratedOptimizer(
+                tx, model=model, torch_optimizer=optimizer, initial_lr=lr,
+                host_offload_state=host_off,
+            )
         else:
-            prepared = AcceleratedOptimizer(optimizer, model=model)
+            prepared = AcceleratedOptimizer(optimizer, model=model, host_offload_state=host_off)
         if self._dialect_grad_clip is not None and float(self._dialect_grad_clip) > 0:
             # DS/Megatron configs carry gradient_clipping; the engines applied it
             # automatically, so the dialect must too (reference utils/deepspeed.py

@@ -26,9 +26,8 @@ from .state import AcceleratorState, GradientState
 __all__ = ["AcceleratedOptimizer"]
 
 
-@partial(jax.jit, donate_argnums=(1, 2), static_argnums=(0,))
-def _update_step(tx_update, params, opt_state, grads, clip_norm, clip_value):
-    """One optimizer update, jitted once per (tx, clip) structure.
+def _update_body(tx_update, params, opt_state, grads, clip_norm, clip_value):
+    """One optimizer update (traced body shared by the jit variants).
 
     ``clip_norm`` / ``clip_value`` < 0 disable the respective clip (static
     python floats would retrigger compilation; pass as arrays); 0 is a real
@@ -49,6 +48,9 @@ def _update_step(tx_update, params, opt_state, grads, clip_norm, clip_value):
     return new_params, new_opt_state, gnorm
 
 
+_update_step = partial(jax.jit, donate_argnums=(1, 2), static_argnums=(0,))(_update_body)
+
+
 class AcceleratedOptimizer:
     """Wraps an optax transformation (or a converted torch optimizer) so the
     training loop keeps its imperative ``optimizer.step()`` shape.
@@ -64,8 +66,11 @@ class AcceleratedOptimizer:
         model=None,
         torch_optimizer=None,
         initial_lr: Optional[float] = None,
+        host_offload_state: bool = False,
     ):
         self.tx = tx
+        self._host_offload_requested = host_offload_state
+        self._update_fn = None
         self.model = model  # PreparedModel owning the params
         self.torch_optimizer = torch_optimizer  # shadow for scheduler compat
         self.initial_lr = initial_lr
@@ -87,7 +92,43 @@ class AcceleratedOptimizer:
             self._init_state()
 
     def _init_state(self):
+        if self._host_offload_requested:
+            # fsdp_plugin.cpu_offload / DeepSpeed offload_optimizer: optimizer
+            # state lives in pinned host memory between steps and rides
+            # explicit transfers inside the update program.
+            from .parallel.host_offload import host_memory_kind, host_offload
+
+            if host_memory_kind() is None:
+                import warnings
+
+                warnings.warn(
+                    "cpu_offload requested but this backend exposes no host "
+                    "memory space; optimizer state stays in device memory."
+                )
+                self._host_offload_requested = False
+            else:
+                self.tx = host_offload(self.tx)
         self.opt_state = self.tx.init(self.model.params)
+        if self._host_offload_requested:
+            if jax.default_backend() == "tpu":
+                # The carried state must come back in host memory: pin the out
+                # shardings so the donated pinned_host buffers are reused
+                # instead of clashing with default device-placed outputs.
+                opt_sh = jax.tree_util.tree_map(
+                    lambda x: x.sharding if isinstance(x, jax.Array) else None,
+                    self.opt_state,
+                )
+                self._update_fn = jax.jit(
+                    partial(_update_body, self.tx.update),
+                    donate_argnums=(0, 1),
+                    out_shardings=(None, opt_sh, None),
+                )
+            else:
+                # CPU smoke path: the backend cannot execute D2H placement
+                # inside jit (the state silently returns in device memory —
+                # numerics identical); donating the pinned_host input against
+                # a device-kind output would crash, so no donation here.
+                self._update_fn = jax.jit(partial(_update_body, self.tx.update))
 
     # -- torch-optimizer-shaped surface -------------------------------------
 
@@ -138,14 +179,23 @@ class AcceleratedOptimizer:
         clip_value = self._clip_value if self._clip_value_once is None else self._clip_value_once
         self._clip_norm_once = None
         self._clip_value_once = None
-        new_params, self.opt_state, gnorm = _update_step(
-            self.tx.update,
-            self.model.params,
-            self.opt_state,
-            grads,
-            jnp.asarray(clip_norm, jnp.float32),
-            jnp.asarray(clip_value, jnp.float32),
-        )
+        if self._update_fn is not None:
+            new_params, self.opt_state, gnorm = self._update_fn(
+                self.model.params,
+                self.opt_state,
+                grads,
+                jnp.asarray(clip_norm, jnp.float32),
+                jnp.asarray(clip_value, jnp.float32),
+            )
+        else:
+            new_params, self.opt_state, gnorm = _update_step(
+                self.tx.update,
+                self.model.params,
+                self.opt_state,
+                grads,
+                jnp.asarray(clip_norm, jnp.float32),
+                jnp.asarray(clip_value, jnp.float32),
+            )
         self.model._set_params(new_params)
         self._last_grad_norm = gnorm
         self._step_was_skipped = False

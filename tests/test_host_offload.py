@@ -81,3 +81,85 @@ def test_update_before_init_raises():
     tx = host_offload(optax.sgd(0.1))
     with pytest.raises(RuntimeError, match="before init"):
         tx.update({"w": jnp.zeros(2)}, {"w": jnp.zeros(2)})
+
+
+def test_fsdp_cpu_offload_places_opt_state_and_trains():
+    """fsdp_plugin.cpu_offload=True must actually move the prepared
+    optimizer's state to host memory (it was a silently-ignored knob) and
+    train to the same weights as the on-device optimizer."""
+    import torch
+
+    from accelerate_tpu import Accelerator, AcceleratorState, ParallelismConfig
+    from accelerate_tpu.state import GradientState, PartialState
+    from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModel
+    from accelerate_tpu.utils import FullyShardedDataParallelPlugin
+
+    samples = list(RegressionDataset(length=32))
+
+    def train(cpu_offload):
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        acc = Accelerator(
+            parallelism_config=ParallelismConfig(fsdp=8),
+            fsdp_plugin=FullyShardedDataParallelPlugin(cpu_offload=cpu_offload),
+        )
+        model = RegressionModel()
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        model, opt = acc.prepare(model, opt)
+        for _ in range(2):
+            for i in range(0, 32, 8):
+                batch = {
+                    "x": torch.tensor([s["x"] for s in samples[i : i + 8]]),
+                    "y": torch.tensor([s["y"] for s in samples[i : i + 8]]),
+                }
+                loss = torch.nn.functional.mse_loss(model(batch["x"]), batch["y"])
+                acc.backward(loss)
+                opt.step()
+                opt.zero_grad()
+        kinds = {
+            leaf.sharding.memory_kind
+            for leaf in jax.tree_util.tree_leaves(opt.opt_state)
+            if isinstance(leaf, jax.Array)
+        }
+        sd = model.state_dict()
+        AcceleratorState._reset_state()
+        return kinds, float(np.asarray(sd["a"])), float(np.asarray(sd["b"]))
+
+    kinds_off, a_off, b_off = train(cpu_offload=True)
+    kinds_on, a_on, b_on = train(cpu_offload=False)
+    # Initial placement is pinned host; on CPU backends the in-jit D2H
+    # annotation is a no-op, so after steps the carried state may be device-
+    # kind — the INIT placement proves the wiring, numerics prove parity.
+    assert a_off == pytest.approx(a_on, abs=1e-6)
+    assert b_off == pytest.approx(b_on, abs=1e-6)
+    assert kinds_on == {"device"}
+
+
+def test_prepared_opt_state_initially_pinned_host():
+    """The freshly initialized opt state under cpu_offload sits in host
+    memory before any step."""
+    import torch
+
+    from accelerate_tpu import Accelerator, AcceleratorState, ParallelismConfig
+    from accelerate_tpu.state import GradientState, PartialState
+    from accelerate_tpu.test_utils.training import RegressionModel
+    from accelerate_tpu.utils import FullyShardedDataParallelPlugin
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(fsdp=8),
+        fsdp_plugin=FullyShardedDataParallelPlugin(cpu_offload=True),
+    )
+    model = RegressionModel()
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    model, opt = acc.prepare(model, opt)
+    kinds = {
+        leaf.sharding.memory_kind
+        for leaf in jax.tree_util.tree_leaves(opt.opt_state)
+        if isinstance(leaf, jax.Array)
+    }
+    AcceleratorState._reset_state()
+    assert kinds <= {host_memory_kind()}, kinds
