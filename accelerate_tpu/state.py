@@ -505,6 +505,12 @@ class AcceleratorState:
 
             fsdp_plugin = FullyShardedDataParallelPlugin()
         self.fsdp_plugin = fsdp_plugin
+        # An explicit per-plugin policy (FSDP2-style MixedPrecision) overrides
+        # the blanket mode — reference utils/fsdp_utils.py applies the
+        # plugin's MixedPrecision to the wrapped modules the same way.
+        plugin_policy = getattr(fsdp_plugin, "mixed_precision_policy", None)
+        if plugin_policy is not None:
+            self.dtype_policy = plugin_policy
         self.tp_plugin = tp_plugin
         self.sp_plugin = sp_plugin
         self.pp_plugin = pp_plugin

@@ -527,10 +527,19 @@ class FullyShardedDataParallelPlugin:
       NO_SHARD → plain DP; HYBRID_SHARD → shard within slice, replicate across DCN.
     - ``min_num_params`` / auto-wrap policy: parameter arrays smaller than the
       threshold stay replicated (sharding tiny arrays wastes collective latency).
-    - ``cpu_offload``: opt-state (and optionally params between steps) live in
-      pinned host memory, streamed in per step.
-    - ``state_dict_type``: FULL_STATE_DICT consolidates on save; SHARDED_STATE_DICT
-      writes one shard per process (orbax-style) + offline merge.
+    - ``cpu_offload``: optimizer state lives in pinned host memory, riding
+      explicit transfers inside the update program (``parallel/host_offload``).
+    - ``state_dict_type``: FULL_STATE_DICT consolidates on save;
+      SHARDED_STATE_DICT writes one shard per process (orbax, reshardable);
+      LOCAL_STATE_DICT dumps each process's raw shards (topology-bound).
+    - ``mixed_precision_policy``: an explicit policy overrides the blanket
+      ``mixed_precision`` mode (FSDP2 MixedPrecision semantics).
+    - ``reshard_after_forward`` / ``use_orig_params`` / ``sync_module_states``:
+      accepted, inherently handled — GSPMD decides gather/reshard scheduling
+      at compile time, params are one pytree (no flat-param views to sync).
+    - ``auto_wrap_policy`` / ``transformer_cls_names_to_wrap``: subsumed by
+      the per-model partition rules + ``min_num_params`` threshold (wrapping
+      is a spec table here, not a module tree surgery).
 
     Env contract preserved: ``FSDP_*`` variables (reference
     ``utils/dataclasses.py:1665-1844``) are read in ``__post_init__``.

@@ -807,6 +807,18 @@ class PipelinedLoweredModule(LoweredModule):
         stage_p = stack_pipeline_stages(stacked_p, S)  # [S, L/S, ...]
         stage_b = stack_pipeline_stages(stacked_b, S) if stacked_b else {}
         block_apply = self.block_lowered.apply
+        # fsdp_plugin.activation_checkpointing: remat each block inside the
+        # scan — per-layer activation memory instead of per-model (the same
+        # knob the reference applies via apply_activation_checkpointing).
+        from ..state import AcceleratorState
+
+        plugin = (
+            getattr(AcceleratorState(), "fsdp_plugin", None)
+            if AcceleratorState._shared_state
+            else None
+        )
+        if plugin is not None and getattr(plugin, "activation_checkpointing", False):
+            block_apply = jax.checkpoint(block_apply)
 
         def stage_fn(lp, h):
             # lp: one stage's params {name: [L/S, ...]} (+ buffers alongside).

@@ -112,6 +112,30 @@ def test_fsdp_versions_map_to_same_design():
     assert s1 == s2
 
 
+def test_plugin_mixed_precision_policy_overrides_mode():
+    """An explicit FSDP2-style MixedPrecisionPolicy on the plugin becomes the
+    active dtype policy (reference applies the plugin's MixedPrecision to the
+    wrapped modules)."""
+    from accelerate_tpu.utils.dataclasses import MixedPrecisionPolicy
+
+    AcceleratorState._reset_state()
+    pol = MixedPrecisionPolicy(param_dtype="bfloat16", compute_dtype="bfloat16")
+    state = AcceleratorState(
+        parallelism_config=ParallelismConfig(fsdp=8),
+        fsdp_plugin=FullyShardedDataParallelPlugin(mixed_precision_policy=pol),
+    )
+    assert state.dtype_policy is pol
+    AcceleratorState._reset_state()
+    # Without a plugin policy the blanket mode rules.
+    state = AcceleratorState(
+        parallelism_config=ParallelismConfig(fsdp=8),
+        mixed_precision="bf16",
+        fsdp_plugin=FullyShardedDataParallelPlugin(),
+    )
+    assert state.dtype_policy.compute_dtype == "bfloat16"
+    AcceleratorState._reset_state()
+
+
 def test_cpu_offload_flows_into_host_sharding():
     """cpu_offload=True marks the plugin for host-memory placement of sharded
     state (the dryrun/mesh tests exercise the actual placement); here the

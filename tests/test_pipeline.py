@@ -428,6 +428,47 @@ def test_pipelined_bridge_skips_shadowing_inner_container():
     AcceleratorState._reset_state()
 
 
+def test_pipelined_bridge_activation_checkpointing_parity():
+    """fsdp_plugin.activation_checkpointing remats each block in the
+    pipelined bridge — a pure memory/schedule change: losses must match the
+    non-remat run exactly."""
+    import torch
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils import FullyShardedDataParallelPlugin
+
+    def run(ckpt):
+        from accelerate_tpu.state import GradientState, PartialState
+
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        acc = Accelerator(
+            parallelism_config=ParallelismConfig(dp=4, pp=2),
+            fsdp_plugin=FullyShardedDataParallelPlugin(activation_checkpointing=ckpt),
+        )
+        model = _toy_torch_decoder(seed=5)
+        opt = torch.optim.AdamW(model.parameters(), lr=1e-3)
+        pm, popt = acc.prepare(model, opt)
+        ids = torch.arange(64, dtype=torch.long).reshape(8, 8) % 32
+        losses = []
+        for _ in range(2):
+            logits = pm(ids)
+            loss = torch.nn.functional.cross_entropy(
+                logits.reshape(-1, 32), ids.reshape(-1)
+            )
+            acc.backward(loss)
+            popt.step()
+            popt.zero_grad()
+            losses.append(loss.detach().item())
+        return losses
+
+    base = run(ckpt=False)
+    remat = run(ckpt=True)
+    np.testing.assert_allclose(base, remat, atol=1e-6, rtol=1e-6)
+    AcceleratorState._reset_state()
+
+
 def test_pipelined_bridge_rejects_heterogeneous_block_constants():
     """Same-class blocks that differ by NON-parameter attributes (per-layer
     scale / drop-path rate / layer_idx branch) have identical param shapes but
