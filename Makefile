@@ -35,3 +35,10 @@ test_kernels:
 
 bench:
 	python bench.py
+
+# C++ offload streamer (auto-built on first use by utils/native_io.py; this
+# target is the explicit form the docs reference).
+.PHONY: native
+native:
+	g++ -O3 -march=native -shared -fPIC -pthread \
+	  accelerate_tpu/_native/tensorstore.cpp -o accelerate_tpu/_native/libtensorstore.so
