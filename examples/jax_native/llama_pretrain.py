@@ -8,6 +8,7 @@ single chip, a virtual CPU mesh (set ``JAX_PLATFORMS=cpu`` and
 same script.
 
 Run:  python examples/jax_native/llama_pretrain.py --fsdp 4 --tp 2 --steps 10
+Long context:  --dp 2 --sp 4 --seq_len 4096 --sp_impl ring --attention pallas
 """
 
 import argparse
@@ -35,6 +36,15 @@ def main():
     parser.add_argument("--seq_len", type=int, default=128)
     parser.add_argument("--hidden", type=int, default=128)
     parser.add_argument("--layers", type=int, default=4)
+    parser.add_argument(
+        "--sp_impl", choices=("ring", "ulysses"), default="ring",
+        help="sequence-parallel attention backend when --sp > 1",
+    )
+    parser.add_argument(
+        "--attention", choices=("auto", "einsum", "flash", "pallas"), default="auto",
+        help="attention implementation (pallas = fused MXU kernel; composes "
+             "with --sp via the pallas-in-ring / pallas-ulysses paths)",
+    )
     args = parser.parse_args()
 
     state = AcceleratorState(
@@ -50,6 +60,8 @@ def main():
         intermediate_size=2 * args.hidden,
         max_seq_len=args.seq_len,
         vocab_size=4096,
+        sp_impl=args.sp_impl,
+        attention_impl=args.attention,
     )
     params = llama.init_params(cfg, jax.random.key(0))
     specs = make_param_specs(params, mesh, state.fsdp_plugin, rules=llama.PARTITION_RULES)
