@@ -22,6 +22,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.sharding import constrain as _constrain, embed_lookup as _embed_lookup
+from .llama import _sp_active
+from .llama import sp_attention as _sp_attention
 from .gpt2 import _layer_norm
 
 __all__ = [
@@ -47,6 +49,13 @@ class BertConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = False
+    # Sequence parallelism backend with an sp>1 mesh axis (bidirectional
+    # ring / ulysses; same knob as LlamaConfig.sp_impl).
+    sp_impl: str = "ring"
+
+    def __post_init__(self):
+        if self.sp_impl not in ("ring", "ulysses"):
+            raise ValueError(f"sp_impl must be 'ring' or 'ulysses', got {self.sp_impl!r}")
 
     @property
     def head_dim(self) -> int:
@@ -129,17 +138,25 @@ def init_params(config: BertConfig, key: jax.Array) -> dict:
     return out
 
 
-def _layer(carry, p, *, c: BertConfig, mask, act_spec):
+def _layer(carry, p, *, c: BertConfig, mask, kv_valid, act_spec):
     x = carry
     d, h, hd = c.hidden_size, c.num_heads, c.head_dim
     b, s, _ = x.shape
 
     qkv = x @ p["w_qkv"].astype(c.dtype) + p["b_qkv"].astype(c.dtype)
     q, k, v = (t[:, :, 0] for t in jnp.split(qkv.reshape(b, s, 3, h, hd), 3, axis=2))
-    scores = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) / np.sqrt(hd)
-    scores = jnp.where(mask[:, None], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
-    attn = jnp.einsum("bhst,bthd->bshd", probs, v).reshape(b, s, d)
+    if _sp_active():
+        # Sequence-parallel path: the shared dispatch (bidirectional ring /
+        # ulysses + pallas fast paths); kv_valid masks KEYS only, so padded
+        # QUERY rows attend normally over the valid keys — they differ from
+        # the dense path (which masks query rows too) but nothing downstream
+        # reads them (pooler uses [CLS]; losses weight pads to zero).
+        attn = _sp_attention(q, k, v, c, causal=False, kv_valid=kv_valid).reshape(b, s, d)
+    else:
+        scores = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) / np.sqrt(hd)
+        scores = jnp.where(mask[:, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+        attn = jnp.einsum("bhst,bthd->bshd", probs, v).reshape(b, s, d)
     # Post-LN (original BERT): residual then LayerNorm.
     x = _layer_norm(
         x + attn @ p["w_proj"].astype(c.dtype) + p["b_proj"].astype(c.dtype),
@@ -165,11 +182,13 @@ def apply(
     """Returns (sequence_output [B, S, d] in compute dtype, pooled [B, d] fp32)."""
     c = config
     b, s = input_ids.shape
-    if attention_mask is None:
+    kv_valid = attention_mask.astype(bool) if attention_mask is not None else None
+    if _sp_active():
+        mask = None  # the sp path masks per block; no [S, S] tensor
+    elif kv_valid is None:
         mask = jnp.ones((b, s, s), bool)
     else:
-        valid = attention_mask.astype(bool)
-        mask = valid[:, None, :] & valid[:, :, None]
+        mask = kv_valid[:, None, :] & kv_valid[:, :, None]
     if token_type_ids is None:
         token_type_ids = jnp.zeros_like(input_ids)
 
@@ -184,7 +203,7 @@ def apply(
     x = _constrain(x, act_spec)
 
     def body(carry, lp):
-        return _layer(carry, lp, c=c, mask=mask, act_spec=act_spec)
+        return _layer(carry, lp, c=c, mask=mask, kv_valid=kv_valid, act_spec=act_spec)
 
     if c.remat:
         body = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)

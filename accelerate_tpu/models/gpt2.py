@@ -22,7 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from .llama import cross_entropy, labels_and_weights
+from .llama import _sp_active, cross_entropy, labels_and_weights
+from .llama import sp_attention as _sp_attention
 from ..parallel.sharding import constrain as _constrain, embed_lookup as _embed_lookup
 
 __all__ = ["GPT2Config", "init_params", "apply", "loss_fn", "PARTITION_RULES", "param_specs"]
@@ -44,10 +45,17 @@ class GPT2Config:
     # the single largest activation; same knob as LlamaConfig.loss_impl.
     loss_impl: str = "dense"
     loss_chunk_size: int = 4096
+    # Sequence parallelism: with an sp>1 mesh axis, attention runs the shared
+    # ring/ulysses machinery (same knob as LlamaConfig.sp_impl) instead of
+    # materializing the [B, S, S] mask — which is what makes long context
+    # feasible on this family too.
+    sp_impl: str = "ring"
 
     def __post_init__(self):
         if self.loss_impl not in ("dense", "chunked"):
             raise ValueError(f"loss_impl must be 'dense' or 'chunked', got {self.loss_impl!r}")
+        if self.sp_impl not in ("ring", "ulysses"):
+            raise ValueError(f"sp_impl must be 'ring' or 'ulysses', got {self.sp_impl!r}")
 
     @property
     def head_dim(self) -> int:
@@ -177,10 +185,18 @@ def _mlp_block(x, p, c: GPT2Config):
     return x + u @ p["w_down"].astype(c.dtype) + p["b_down"].astype(c.dtype)
 
 
-def _layer(carry, p, *, c: GPT2Config, mask, act_spec):
+def _layer(carry, p, *, c: GPT2Config, mask, kv_valid, act_spec):
     x = carry
+    b, s, _ = x.shape
     q, k, v = _qkv(x, p, c)
-    attn = _attend(q, k, v, mask[:, None], c)
+    if _sp_active():
+        # Sequence-parallel path: the shared dispatch (ring / ulysses, with
+        # the fused-Pallas fast paths) — causal at block granularity, the
+        # [B, S] validity vector rides the ring; never a global [S, S] mask.
+        attn = _sp_attention(q, k, v, c, causal=True, kv_valid=kv_valid)
+        attn = attn.reshape(b, s, c.hidden_size)
+    else:
+        attn = _attend(q, k, v, mask[:, None], c)
     x = x + attn @ p["w_proj"].astype(c.dtype) + p["b_proj"].astype(c.dtype)
     x = _mlp_block(x, p, c)
     if act_spec is not None:
@@ -214,16 +230,20 @@ def apply_hidden(
     """Trunk forward -> final-LN hidden [B, S, d] (compute dtype)."""
     c = config
     b, s = input_ids.shape
-    mask = jnp.broadcast_to(jnp.tril(jnp.ones((s, s), bool)), (b, s, s))
-    if attention_mask is not None:
-        mask = mask & attention_mask[:, None, :].astype(bool)
+    kv_valid = attention_mask.astype(bool) if attention_mask is not None else None
+    if _sp_active():
+        mask = None  # the sp path masks causally per block; no [S, S] tensor
+    else:
+        mask = jnp.broadcast_to(jnp.tril(jnp.ones((s, s), bool)), (b, s, s))
+        if kv_valid is not None:
+            mask = mask & kv_valid[:, None, :]
 
     x = _embed_lookup(params["wte"], input_ids, c.dtype) + params["wpe"].astype(c.dtype)[:s][None]
     act_spec = P(("dcn_dp", "dp", "fsdp"), "sp", None)
     x = _constrain(x, act_spec)
 
     def body(carry, lp):
-        return _layer(carry, lp, c=c, mask=mask, act_spec=act_spec)
+        return _layer(carry, lp, c=c, mask=mask, kv_valid=kv_valid, act_spec=act_spec)
 
     if c.remat:
         body = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)

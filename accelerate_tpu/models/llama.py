@@ -356,6 +356,34 @@ def _mm(h: jax.Array, w: jax.Array, c: LlamaConfig) -> jax.Array:
     return h @ w.astype(c.dtype)
 
 
+def sp_attention(q, k, v, c, *, causal: bool = True, kv_valid=None) -> jax.Array:
+    """Shared sequence-parallel attention dispatch over the ``sp`` axis —
+    q ``[B, S, H, hd]``, k/v ``[B, S, K, hd]`` sequence-sharded; the
+    key-validity vector rides the ring / all-gathers in the ulysses body.
+    One implementation for every family (llama/mixtral/gpt2/bert), including
+    the fused-Pallas fast paths (per-block inside the ppermute ring;
+    per-device local attention in ulysses), selected by the same policy as
+    the dense path minus the padded-batch case the kernel does not mask.
+    ``c`` needs ``sp_impl``/``attention_impl`` (getattr defaults cover
+    configs without the knobs)."""
+    s = q.shape[1]
+    sp_pallas = kv_valid is None and _sp_use_pallas(c, s, q.shape[-1])
+    if getattr(c, "sp_impl", "ring") == "ulysses":
+        from ..ops.ulysses_attention import ulysses_attention
+
+        return ulysses_attention(
+            q, k, v, mesh=None, axis_name="sp", causal=causal, kv_valid=kv_valid,
+            impl="pallas" if sp_pallas else None,
+        )
+    if sp_pallas:
+        from ..ops.pallas_attention import ring_attention_pallas
+
+        return ring_attention_pallas(q, k, v, mesh=None, axis_name="sp", causal=causal)
+    from ..ops.ring_attention import ring_attention
+
+    return ring_attention(q, k, v, mesh=None, axis_name="sp", causal=causal, kv_valid=kv_valid)
+
+
 def attention_block(x, p, c, mask, positions, kv_valid=None) -> jax.Array:
     """Pre-norm attention sub-block with residual: shared by llama and the MoE
     models (mixtral) — both get the ring-attention (sp) and fp8 paths from one
@@ -373,31 +401,7 @@ def attention_block(x, p, c, mask, positions, kv_valid=None) -> jax.Array:
     v = _mm(h, p["wv"], c).reshape(b, s, c.num_kv_heads, hd)
     q, k = _rope(q, k, positions, c.rope_theta)
     if _sp_active():
-        # Sequence-parallel path over the sp axis; kv_valid (sequence-sharded)
-        # rides the ring / all-gathers in the ulysses body.  mixtral shares
-        # this block — getattr default covers configs without the knob.
-        # The fused Pallas kernel composes with both sp variants (per-block
-        # inside the ppermute ring; per-device local attention in ulysses) —
-        # selected by the same policy as the dense path, minus the padded-
-        # batch case the kernel does not mask.
-        sp_pallas = kv_valid is None and _sp_use_pallas(c, s, q.shape[-1])
-        if getattr(c, "sp_impl", "ring") == "ulysses":
-            from ..ops.ulysses_attention import ulysses_attention
-
-            attn = ulysses_attention(
-                q, k, v, mesh=None, axis_name="sp", causal=True, kv_valid=kv_valid,
-                impl="pallas" if sp_pallas else None,
-            )
-        elif sp_pallas:
-            from ..ops.pallas_attention import ring_attention_pallas
-
-            attn = ring_attention_pallas(q, k, v, mesh=None, axis_name="sp", causal=True)
-        else:
-            from ..ops.ring_attention import ring_attention
-
-            attn = ring_attention(
-                q, k, v, mesh=None, axis_name="sp", causal=True, kv_valid=kv_valid
-            )
+        attn = sp_attention(q, k, v, c, causal=True, kv_valid=kv_valid)
     elif mask is None and kv_valid is None and _use_pallas(c, s, b, c.num_heads, c.num_kv_heads):
         from ..ops.pallas_attention import pallas_attention_spmd
 
