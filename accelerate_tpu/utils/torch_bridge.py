@@ -1098,6 +1098,30 @@ def convert_optimizer(torch_optimizer):
         tx = optax.inject_hyperparams(sgd_factory)(learning_rate=lr)
     elif isinstance(torch_optimizer, torch.optim.Adagrad):
         tx = optax.inject_hyperparams(optax.adagrad)(learning_rate=lr, eps=group.get("eps", 1e-10))
+    elif isinstance(torch_optimizer, torch.optim.RMSprop):
+
+        def rmsprop_factory(learning_rate):
+            return optax.rmsprop(
+                learning_rate,
+                decay=group.get("alpha", 0.99),
+                eps=group.get("eps", 1e-8),
+                centered=group.get("centered", False),
+                momentum=group.get("momentum", 0.0) or None,
+            )
+
+        tx = optax.inject_hyperparams(rmsprop_factory)(learning_rate=lr)
+    elif isinstance(torch_optimizer, torch.optim.Adamax):
+        tx = optax.inject_hyperparams(optax.adamax)(
+            learning_rate=lr, b1=group["betas"][0], b2=group["betas"][1], eps=group["eps"]
+        )
+    elif isinstance(torch_optimizer, torch.optim.NAdam):
+        tx = optax.inject_hyperparams(optax.nadam)(
+            learning_rate=lr, b1=group["betas"][0], b2=group["betas"][1], eps=group["eps"]
+        )
+    elif isinstance(torch_optimizer, torch.optim.Adadelta):
+        tx = optax.inject_hyperparams(optax.adadelta)(
+            learning_rate=lr, rho=group.get("rho", 0.9), eps=group.get("eps", 1e-6)
+        )
     else:
         raise TorchLoweringError(
             f"Unsupported torch optimizer {type(torch_optimizer).__name__}; pass an "
