@@ -13,7 +13,11 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["generate_loop", "select_token", "make_kv_cache", "check_cache_room", "quantize_kv", "dequantize_kv"]
+__all__ = [
+    "generate_loop", "select_token", "make_kv_cache", "check_cache_room",
+    "quantize_kv", "dequantize_kv", "pack_cache_for_scan",
+    "unpack_cache_from_scan", "cache_write",
+]
 
 
 def make_kv_cache(num_layers: int, batch_size: int, max_len: int,
@@ -55,6 +59,43 @@ def dequantize_kv(codes: jax.Array, scale: jax.Array, dtype) -> jax.Array:
     """Inverse of :func:`quantize_kv`; the elementwise multiply fuses into
     the consuming attention matmul (no materialized fp cache)."""
     return codes.astype(dtype) * scale[..., None].astype(dtype)
+
+
+def pack_cache_for_scan(cache: dict):
+    """K/V leaves in the form a family's decode ``lax.scan`` threads: plain
+    arrays, or (codes, scale) tuples for the int8 cache."""
+    quant = "k_scale" in cache
+    ck = (cache["k"], cache["k_scale"]) if quant else cache["k"]
+    cv = (cache["v"], cache["v_scale"]) if quant else cache["v"]
+    return ck, cv, quant
+
+
+def unpack_cache_from_scan(new_k, new_v, index, quant: bool) -> dict:
+    """Inverse of :func:`pack_cache_for_scan` for the scanned-out leaves."""
+    if quant:
+        return {
+            "k": new_k[0], "k_scale": new_k[1],
+            "v": new_v[0], "v_scale": new_v[1],
+            "index": index,
+        }
+    return {"k": new_k, "v": new_v, "index": index}
+
+
+def cache_write(cache_leaf, new_rows: jax.Array, index, dtype):
+    """Write ``new_rows`` ``[B, S, K, hd]`` at ``index``; returns
+    (updated leaf(s), full-precision view for attention).  Handles both the
+    plain and int8 (codes, scale) layouts — shared by every family's cached
+    attention."""
+    if isinstance(cache_leaf, tuple):
+        codes, scale = cache_leaf
+        n_codes, n_scale = quantize_kv(new_rows)
+        codes = jax.lax.dynamic_update_slice(codes, n_codes, (0, index, 0, 0))
+        scale = jax.lax.dynamic_update_slice(scale, n_scale, (0, index, 0))
+        return (codes, scale), dequantize_kv(codes, scale, dtype)
+    updated = jax.lax.dynamic_update_slice(
+        cache_leaf, new_rows.astype(cache_leaf.dtype), (0, index, 0, 0)
+    )
+    return updated, updated
 
 
 def check_cache_room(index, new_tokens: int, max_len: int) -> None:

@@ -50,6 +50,8 @@ class GPT2Config:
     # materializing the [B, S, S] mask — which is what makes long context
     # feasible on this family too.
     sp_impl: str = "ring"
+    # int8 KV cache for generation (shared machinery; see LlamaConfig).
+    kv_cache_quant: bool = False
 
     def __post_init__(self):
         if self.loss_impl not in ("dense", "chunked"):
@@ -276,7 +278,10 @@ def init_cache(config: GPT2Config, batch_size: int, max_len: int) -> dict:
     from .generation import make_kv_cache
 
     c = config
-    return make_kv_cache(c.num_layers, batch_size, max_len, c.num_heads, c.head_dim, c.dtype)
+    return make_kv_cache(
+        c.num_layers, batch_size, max_len, c.num_heads, c.head_dim, c.dtype,
+        quantized=c.kv_cache_quant,
+    )
 
 
 def apply_cached(
@@ -308,21 +313,24 @@ def apply_cached(
     k_pos = jnp.arange(max_len)
     mask = positions[:, None] >= k_pos[None, :]  # [S, max_len]
 
+    from .generation import cache_write, pack_cache_for_scan, unpack_cache_from_scan
+
     def body(carry, xs):
         lp, ck, cv = xs
         x = carry
         q, k, v = _qkv(x, lp, c)
-        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, index, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, index, 0, 0))
-        attn = _attend(q, ck, cv, mask[None, None], c)
+        ck, k_full = cache_write(ck, k, index, c.dtype)
+        cv, v_full = cache_write(cv, v, index, c.dtype)
+        attn = _attend(q, k_full, v_full, mask[None, None], c)
         x = x + attn @ lp["w_proj"].astype(c.dtype) + lp["b_proj"].astype(c.dtype)
         x = _mlp_block(x, lp, c)
         return x, (ck, cv)
 
-    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    ck_in, cv_in, quant = pack_cache_for_scan(cache)
+    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], ck_in, cv_in))
     x = _layer_norm(x, params["final_ln_scale"], params["final_ln_bias"], c.layer_norm_eps)
     logits = (x @ params["wte"].astype(c.dtype).T).astype(jnp.float32)
-    return logits, {"k": new_k, "v": new_v, "index": index + s}
+    return logits, unpack_cache_from_scan(new_k, new_v, index + s, quant)
 
 
 def generate(

@@ -621,24 +621,12 @@ def _attention_block_cached(x, p, c, ck, cv, index, positions):
     v = _mm(h, p["wv"], c).reshape(b, s, c.num_kv_heads, hd)
     q, k = _rope(q, k, positions, c.rope_theta)
 
-    if isinstance(ck, tuple):
-        # int8 cache: (codes, per-slot scale).  New rows quantize on write;
-        # the dequant multiply fuses into the attention matmuls on read.
-        from .generation import dequantize_kv, quantize_kv
+    from .generation import cache_write
 
-        def write(cache_pair, new):
-            codes, scale = cache_pair
-            n_codes, n_scale = quantize_kv(new)
-            codes = jax.lax.dynamic_update_slice(codes, n_codes, (0, index, 0, 0))
-            scale = jax.lax.dynamic_update_slice(scale, n_scale, (0, index, 0))
-            return (codes, scale), dequantize_kv(codes, scale, c.dtype)
-
-        ck, k_full = write(ck, k)
-        cv, v_full = write(cv, v)
-    else:
-        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, index, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, index, 0, 0))
-        k_full, v_full = ck, cv
+    # Plain and int8 (codes, scale) cache layouts share one write/read
+    # helper; the dequant multiply fuses into the attention matmuls.
+    ck, k_full = cache_write(ck, k, index, c.dtype)
+    cv, v_full = cache_write(cv, v, index, c.dtype)
 
     # q position i (global index + i) attends cache slots <= its position.
     q_pos = index + jnp.arange(s)
@@ -667,7 +655,7 @@ def apply_cached(
     positions = jnp.broadcast_to(index + jnp.arange(s), (b, s))
     x = embed_tokens(params, input_ids, c)
 
-    quant = "k_scale" in cache
+    from .generation import pack_cache_for_scan, unpack_cache_from_scan
 
     def body(carry, xs):
         lp, ck, cv = xs
@@ -677,17 +665,10 @@ def apply_cached(
         up = _mm(h, lp["w_up"], c)
         return y + _mm(gate * up, lp["w_down"], c), (ck, cv)
 
-    ck_in = (cache["k"], cache["k_scale"]) if quant else cache["k"]
-    cv_in = (cache["v"], cache["v_scale"]) if quant else cache["v"]
+    ck_in, cv_in, quant = pack_cache_for_scan(cache)
     x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], ck_in, cv_in))
     logits = unembed(params, x, c)
-    if quant:
-        return logits, {
-            "k": new_k[0], "k_scale": new_k[1],
-            "v": new_v[0], "v_scale": new_v[1],
-            "index": index + s,
-        }
-    return logits, {"k": new_k, "v": new_v, "index": index + s}
+    return logits, unpack_cache_from_scan(new_k, new_v, index + s, quant)
 
 
 def generate(

@@ -62,6 +62,8 @@ class MixtralConfig:
     sp_impl: str = "ring"
     # "chunked" streams the LM-head loss over vocab tiles (ops/chunked_ce.py)
     # — no [B, S, V] logits tensor; same knob as LlamaConfig.loss_impl.
+    # int8 KV cache for generation (shared machinery; see LlamaConfig).
+    kv_cache_quant: bool = False
     loss_impl: str = "dense"
     loss_chunk_size: int = 4096
 
@@ -328,7 +330,10 @@ def init_cache(config: MixtralConfig, batch_size: int, max_len: int) -> dict:
     from .generation import make_kv_cache
 
     c = config
-    return make_kv_cache(c.num_layers, batch_size, max_len, c.num_kv_heads, c.head_dim_, c.dtype)
+    return make_kv_cache(
+        c.num_layers, batch_size, max_len, c.num_kv_heads, c.head_dim_, c.dtype,
+        quantized=getattr(c, "kv_cache_quant", False),
+    )
 
 
 def apply_cached(
@@ -365,10 +370,13 @@ def apply_cached(
         )
         return y + ffn, (ck, cv)
 
-    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    from .generation import pack_cache_for_scan, unpack_cache_from_scan
+
+    ck_in, cv_in, quant = pack_cache_for_scan(cache)
+    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], ck_in, cv_in))
     x = _llama._rms_norm(x, params["final_norm"], c.rms_eps)
     logits = (x @ params["lm_head"].astype(c.dtype)).astype(jnp.float32)
-    return logits, {"k": new_k, "v": new_v, "index": index + s}
+    return logits, unpack_cache_from_scan(new_k, new_v, index + s, quant)
 
 
 def generate(

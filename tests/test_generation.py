@@ -405,3 +405,21 @@ def test_int8_kv_cache_parity_and_size():
         params, jnp.asarray(ids), cfg_q, max_new_tokens=4, num_beams=2, max_len=96
     )
     assert np.asarray(beam).shape == (2, 20)
+
+
+def test_int8_kv_cache_gpt2_and_mixtral():
+    """The quantized cache machinery is shared: gpt2 and mixtral greedy
+    decode match their fp caches."""
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models import gpt2, mixtral
+
+    for mod, Config in ((gpt2, gpt2.GPT2Config), (mixtral, mixtral.MixtralConfig)):
+        cfg = Config.tiny(dtype=jnp.float32)
+        cfg_q = Config.tiny(dtype=jnp.float32, kv_cache_quant=True)
+        params = mod.init_params(cfg, jax.random.key(0))
+        ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 12)).astype(np.int32)
+        out_f = mod.generate(params, jnp.asarray(ids), cfg, max_new_tokens=6, max_len=48)
+        out_q = mod.generate(params, jnp.asarray(ids), cfg_q, max_new_tokens=6, max_len=48)
+        np.testing.assert_array_equal(np.asarray(out_f), np.asarray(out_q))
