@@ -25,6 +25,9 @@ def main():
     parser.add_argument("--prompt", type=int, default=128)
     parser.add_argument("--new", type=int, default=128)
     parser.add_argument("--temperature", type=float, default=0.0)
+    parser.add_argument("--kv_quant", action="store_true",
+                        help="int8 KV cache (half the cache HBM; measures the "
+                             "dequant-fused decode rate)")
     args = parser.parse_args()
 
     import jax
@@ -42,6 +45,7 @@ def main():
         max_seq_len=args.prompt + args.new,
         remat=False,
         attention_impl="einsum",  # decode q-len is 1; flash buys nothing
+        kv_cache_quant=args.kv_quant,
     )
     params = llama.init_params(cfg, jax.random.key(0))
     prompt = np.random.default_rng(0).integers(0, cfg.vocab_size, (args.batch, args.prompt))
