@@ -42,6 +42,9 @@ class T5Config:
     remat: bool = False
     # "chunked" streams the (tied, 1/sqrt(d)-scaled) LM-head loss over vocab
     # tiles (ops/chunked_ce.py) — same knob as LlamaConfig.loss_impl.
+    # int8 self-attn KV cache for decoding (shared machinery; see
+    # LlamaConfig).  Cross K/V stay full precision.
+    kv_cache_quant: bool = False
     loss_impl: str = "dense"
     loss_chunk_size: int = 4096
 
@@ -349,7 +352,13 @@ def init_decoder_cache(params: dict, enc_out: jax.Array, config: "T5Config", max
     cross_k, cross_v = jax.lax.map(cross_kv, params["decoder"])
     from .generation import make_kv_cache
 
-    cache = make_kv_cache(c.num_layers, b, max_len, nh, hd, c.dtype)
+    cache = make_kv_cache(
+        c.num_layers, b, max_len, nh, hd, c.dtype,
+        quantized=getattr(c, "kv_cache_quant", False),
+    )
+    # Cross K/V stay full precision: computed once per call, read every
+    # token — quantizing them trades accuracy for memory only while the
+    # (short-lived) cache exists; the growing self-attn cache is the win.
     cache["cross_k"] = cross_k  # [L, B, S, H, hd]
     cache["cross_v"] = cross_v
     return cache
@@ -384,20 +393,22 @@ def decode_cached(
 
     y = _embed_lookup(params["shared_embed"], decoder_input_ids, c.dtype)
 
+    from .generation import cache_write
+
     def body(carry, xs):
         lp, ck, cv, xk, xv = xs
         x = carry
-        # Self-attention against the cache.
+        # Self-attention against the cache (plain or int8 via cache_write).
         h = _rms_norm(x, lp["ln_attn"], c.rms_eps)
         q = (h @ lp["wq"].astype(c.dtype)).reshape(b, t, nh, hd)
         k = (h @ lp["wk"].astype(c.dtype)).reshape(b, t, nh, hd)
         v = (h @ lp["wv"].astype(c.dtype)).reshape(b, t, nh, hd)
-        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, index, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, index, 0, 0))
-        scores = jnp.einsum("bshd,bthd->bhst", q, ck).astype(jnp.float32) + bias[None]
+        ck, k_full = cache_write(ck, k, index, c.dtype)
+        cv, v_full = cache_write(cv, v, index, c.dtype)
+        scores = jnp.einsum("bshd,bthd->bhst", q, k_full).astype(jnp.float32) + bias[None]
         scores = jnp.where(self_mask[:, None], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
-        attn = jnp.einsum("bhst,bthd->bshd", probs, cv).reshape(b, t, nh * hd)
+        probs = jax.nn.softmax(scores, axis=-1).astype(v_full.dtype)
+        attn = jnp.einsum("bhst,bthd->bshd", probs, v_full).reshape(b, t, nh * hd)
         x = x + attn @ lp["wo"].astype(c.dtype)
         # Cross-attention against precomputed encoder K/V.
         h = _rms_norm(x, lp["ln_cross"], c.rms_eps)
@@ -413,13 +424,16 @@ def decode_cached(
         x = x + jax.nn.relu(h @ lp["w_up"].astype(c.dtype)) @ lp["w_down"].astype(c.dtype)
         return x, (ck, cv)
 
+    from .generation import pack_cache_for_scan, unpack_cache_from_scan
+
+    ck_in, cv_in, quant = pack_cache_for_scan(cache)
     y, (new_k, new_v) = jax.lax.scan(
-        body, y, (params["decoder"], cache["k"], cache["v"], cache["cross_k"], cache["cross_v"])
+        body, y, (params["decoder"], ck_in, cv_in, cache["cross_k"], cache["cross_v"])
     )
     y = _rms_norm(y, params["dec_final_ln"], c.rms_eps)
     logits = (y @ lm_head(params, c)).astype(jnp.float32)
     new_cache = dict(cache)
-    new_cache.update({"k": new_k, "v": new_v, "index": index + t})
+    new_cache.update(unpack_cache_from_scan(new_k, new_v, index + t, quant))
     return logits, new_cache
 
 

@@ -423,3 +423,15 @@ def test_int8_kv_cache_gpt2_and_mixtral():
         out_f = mod.generate(params, jnp.asarray(ids), cfg, max_new_tokens=6, max_len=48)
         out_q = mod.generate(params, jnp.asarray(ids), cfg_q, max_new_tokens=6, max_len=48)
         np.testing.assert_array_equal(np.asarray(out_f), np.asarray(out_q))
+
+    # T5: encoder-decoder — the int8 knob covers the growing self-attn
+    # cache (cross K/V stay full precision).
+    from accelerate_tpu.models import t5
+
+    cfg = t5.T5Config.tiny(dtype=jnp.float32)
+    cfg_q = t5.T5Config.tiny(dtype=jnp.float32, kv_cache_quant=True)
+    params = t5.init_params(cfg, jax.random.key(0))
+    ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 12)).astype(np.int32)
+    out_f = t5.generate(params, jnp.asarray(ids), cfg, max_new_tokens=6)
+    out_q = t5.generate(params, jnp.asarray(ids), cfg_q, max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(out_f), np.asarray(out_q))
