@@ -138,7 +138,7 @@ def init_params(config: BertConfig, key: jax.Array) -> dict:
     return out
 
 
-def _layer(carry, p, *, c: BertConfig, mask, kv_valid, act_spec):
+def _layer(carry, p, *, c: BertConfig, mask, kv_valid=None, act_spec):
     x = carry
     d, h, hd = c.hidden_size, c.num_heads, c.head_dim
     b, s, _ = x.shape

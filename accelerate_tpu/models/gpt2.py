@@ -187,7 +187,7 @@ def _mlp_block(x, p, c: GPT2Config):
     return x + u @ p["w_down"].astype(c.dtype) + p["b_down"].astype(c.dtype)
 
 
-def _layer(carry, p, *, c: GPT2Config, mask, kv_valid, act_spec):
+def _layer(carry, p, *, c: GPT2Config, mask, kv_valid=None, act_spec):
     x = carry
     b, s, _ = x.shape
     q, k, v = _qkv(x, p, c)
