@@ -703,3 +703,53 @@ def test_downcast_bf16_maps_to_mixed_precision():
     # An explicit --mixed_precision wins over the mapped knob.
     args = parser.parse_args(["--downcast_bf16", "--mixed_precision", "fp8", "train.py"])
     assert _merge(args, ClusterConfig())["mixed_precision"] == "fp8"
+
+
+def test_bench_ladder_configs_construct():
+    """Every rung in the REAL ladders (headline, proof, frontier, and the
+    env-gated extras) must parse into a valid LlamaConfig — a typo'd tuple
+    would otherwise only surface on TPU at driver time."""
+    import importlib.util
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location("bench_mod", os.path.join(repo, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    saved = {k: os.environ.pop(k, None) for k in
+             ("BENCH_LADDER_JSON", "BENCH_PROOF_LADDER_JSON", "BENCH_FRONTIER_JSON",
+              "BENCH_TRY_CHUNKED", "BENCH_TRY_BIG", "BENCH_TRY_HOSTOPT")}
+    os.environ["BENCH_TRY_HOSTOPT"] = "1"  # include the env-gated rungs
+    os.environ["BENCH_TRY_BIG"] = "1"
+    os.environ["BENCH_TRY_CHUNKED"] = "1"
+    try:
+        spec.loader.exec_module(bench)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models import llama
+
+    all_rungs = list(bench.LADDER) + list(bench.PROOF_RUNGS) + list(bench.FRONTIER_RUNGS)
+    assert len(all_rungs) >= 14
+    for rung in all_rungs:
+        name, d, layers, f, b, s, impl, policy = rung[:8]
+        loss_impl = rung[8] if len(rung) > 8 else "dense"
+        param_dtype = rung[9] if len(rung) > 9 else "f32"
+        vocab = rung[10] if len(rung) > 10 else 32000
+        host_opt = bool(rung[11]) if len(rung) > 11 else False
+        cfg = llama.LlamaConfig(
+            vocab_size=vocab, hidden_size=d, intermediate_size=f, num_layers=layers,
+            num_heads=max(d // 128, 1), num_kv_heads=max(d // 256, 1),
+            max_seq_len=s, remat=True, attention_impl=impl, remat_policy=policy,
+            loss_impl=loss_impl,
+            param_dtype=jnp.bfloat16 if param_dtype == "bf16" else jnp.float32,
+        )
+        assert cfg.num_params() > 0, name
+        assert s % 128 == 0, (name, s)  # VMEM tiling contract
+        assert isinstance(host_opt, bool)
