@@ -38,7 +38,7 @@ def pick_block(
     pickers.  ``ACCELERATE_ATTN_BLOCK`` overrides when it is a positive
     integer dividing ``s`` — an EXPERT knob applied verbatim on every
     attention path (pallas/flash/ring), bypassing the ladder and the VMEM
-    head_dim guard; see docs/performance.md for the measured ladder (1024
+    head_dim guard; see docs/concept_guides/performance.md for the measured ladder (1024
     wins on the fused pallas path where VMEM allows, 512 elsewhere)."""
     import os
 
@@ -75,7 +75,7 @@ def pick_block(
 def pick_block_pallas(s: int, head_dim: int) -> Optional[int]:
     """Block ladder for the fused Pallas kernel: prefers 1024 where the
     larger K/V tile fits VMEM (head_dim <= 128) — measured 0.6353 vs 0.6041
-    MFU at 512 on v5e b8/s2048 (docs/performance.md).  Short sequences
+    MFU at 512 on v5e b8/s2048 (docs/concept_guides/performance.md).  Short sequences
     (s <= 1024) that no ladder entry divides run as ONE block at any
     head_dim — a single <=1024 block is within the tile budget the ladder
     guard protects (the guard is about GRID blocks of 1024 at large

@@ -179,7 +179,7 @@ LADDER = [
     # reaches 0.6152 at b4/blk1024 (was worse at blk512) and flash loses.
     # Chunked-vocab CE measured r3: b8 0.5863 / b10 0.5790 at blk512, 0.6161
     # at b8/blk1024; b12/s4096 OOM, and b16/chunked/bf16 also OOMs — loses at
-    # every feasible shape here (see docs/performance.md #5), so dense stays
+    # every feasible shape here (see docs/concept_guides/performance.md #5), so dense stays
     # the winning loss impl.  remat "nothing" at b8
     # also measured r3: 0.5711 — saving every activation costs more HBM
     # traffic than "dots" recomputes.
