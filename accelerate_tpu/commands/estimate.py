@@ -59,7 +59,7 @@ def _native_presets() -> dict:
 
 
 def _native_estimate(name: str):
-    """(total_f32_bytes, largest_layer_f32_bytes) from a preset config —
+    """(total_f32_bytes, largest_layer_f32_bytes, config) from a preset —
     closed-form, no arrays."""
     factory = _native_presets().get(name.lower())
     if factory is None:
@@ -70,7 +70,22 @@ def _native_estimate(name: str):
     embed = cfg.vocab_size * cfg.hidden_size * 4
     layers = getattr(cfg, "num_layers", 1) or 1
     per_layer = max((total - embed) // layers, 0)
-    return total, max(embed, per_layer)
+    return total, max(embed, per_layer), cfg
+
+
+def _kv_cache_row(cfg, context: int, batch: int = 1) -> dict:
+    """Decode KV-cache bytes at a context length: bf16 vs the int8 cache
+    (codes + per-slot bf16 scales; ``kv_cache_quant=True``)."""
+    kv_heads = getattr(cfg, "num_kv_heads", None) or getattr(cfg, "num_heads", 1)
+    hd = getattr(cfg, "head_dim_", None) or getattr(cfg, "head_dim", 0)
+    layers = getattr(cfg, "num_layers", 1) or 1
+    slots = 2 * layers * batch * context * kv_heads  # k and v
+    return {
+        "context": context,
+        "batch": batch,
+        "bf16": slots * hd * 2,
+        "int8": slots * hd + slots * 2,  # codes + bf16 scale per slot
+    }
 
 
 def _skeleton_estimate(model_name: str, trust_remote_code: bool):
@@ -113,8 +128,9 @@ def build_rows(total_f32: float, largest_f32: float, dtypes, hbm_gb=None) -> lis
 
 def estimate_command(args):
     native = _native_estimate(args.model_name)
+    native_cfg = None
     if native is not None:
-        total_f32, largest_f32 = native
+        total_f32, largest_f32, native_cfg = native
         source = "native preset"
     else:
         try:
@@ -151,6 +167,16 @@ def estimate_command(args):
             ways = r["min_fsdp_ways"]
             fits = "fits on 1 chip" if ways == 1 else f"needs fsdp>={ways} to train"
             print(f"  {r['dtype']}: {fits} at {args.hbm_gb} GB HBM/chip")
+    if native_cfg is not None and getattr(native_cfg, "head_dim_", None) is not None:
+        # Decode-cache advisory: where generation memory goes at long context
+        # (and what kv_cache_quant=True buys).
+        print("KV cache at decode (batch 1):")
+        for context in (8192, 32768, 131072):
+            row = _kv_cache_row(native_cfg, context)
+            print(
+                f"  context {context:>6}: bf16 {_format_bytes(row['bf16']):>10}"
+                f"  |  int8 (kv_cache_quant) {_format_bytes(row['int8']):>10}"
+            )
     return rows
 
 
