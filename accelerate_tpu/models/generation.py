@@ -166,8 +166,15 @@ def generate_loop(
     max_len: Optional[int] = None,
     top_k: int = 0,
     top_p: float = 1.0,
+    prefill_chunk: Optional[int] = None,
 ) -> jax.Array:
-    """Dense prompt ``[B, S]`` -> ``[B, S + max_new_tokens]``."""
+    """Dense prompt ``[B, S]`` -> ``[B, S + max_new_tokens]``.
+
+    ``prefill_chunk`` processes the prompt in slices of that many tokens:
+    prefill attention scores are ``[B, chunk, max_len]`` instead of
+    ``[B, S, max_len]``, which bounds prefill activation memory at long
+    context (the decode loop is unaffected).  Identical outputs — the cache
+    after chunked prefill equals the one-shot cache."""
     if not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if top_k < 0:
@@ -192,7 +199,17 @@ def generate_loop(
         return input_ids
 
     cache = init_cache(config, b, max_len)
-    logits, cache = apply_cached(params, input_ids, config, cache)
+    if prefill_chunk is not None and prefill_chunk < 1:
+        raise ValueError(f"prefill_chunk must be >= 1, got {prefill_chunk}")
+    if prefill_chunk is None or prefill_chunk >= s:
+        logits, cache = apply_cached(params, input_ids, config, cache)
+    else:
+        # Static chunk count: equal slices of prefill_chunk plus one tail
+        # slice — at most two program shapes, no per-chunk retrace churn.
+        for start in range(0, s, prefill_chunk):
+            logits, cache = apply_cached(
+                params, input_ids[:, start : start + prefill_chunk], config, cache
+            )
     next_tok = select_token(logits[:, -1], temperature, key, 0, top_k=top_k, top_p=top_p)
 
     def step(carry, i):

@@ -389,6 +389,7 @@ def generate(
     max_len=None,
     top_k: int = 0,
     top_p: float = 1.0,
+    prefill_chunk=None,
 ) -> jax.Array:
     """Autoregressive generation (one compiled XLA program; see
     models/generation.py)."""
@@ -397,7 +398,7 @@ def generate(
     return generate_loop(
         apply_cached, init_cache, params, input_ids, config,
         max_new_tokens, temperature=temperature, key=key, max_len=max_len,
-        top_k=top_k, top_p=top_p,
+        top_k=top_k, top_p=top_p, prefill_chunk=prefill_chunk,
     )
 
 
