@@ -131,3 +131,54 @@ def test_bert_sp_outputs_match_dense():
     AcceleratorState._reset_state()
     GradientState._reset_state()
     PartialState._reset_state()
+
+
+def test_sp_pallas_selection_policy(monkeypatch):
+    """Pin the dispatch rules: explicit attention_impl='pallas' always takes
+    the fused path; 'auto' requires a TPU backend; padded (kv_valid) batches
+    always fall back to the einsum ring (the kernel does not mask)."""
+    import jax
+
+    from accelerate_tpu.models import llama
+
+    AcceleratorState._reset_state()
+    AcceleratorState(parallelism_config=ParallelismConfig(dp=2, sp=4))
+
+    calls = []
+    import importlib
+
+    # `import a.b as x` can bind the package ATTRIBUTE (the re-exported
+    # function) instead of the submodule; import_module is unambiguous.
+    pa = importlib.import_module("accelerate_tpu.ops.pallas_attention")
+    ra = importlib.import_module("accelerate_tpu.ops.ring_attention")
+
+    real_ring_pallas = pa.ring_attention_pallas
+    real_ring = ra.ring_attention
+    monkeypatch.setattr(
+        pa, "ring_attention_pallas",
+        lambda *a, **k: calls.append("pallas") or real_ring_pallas(*a, **k),
+    )
+    monkeypatch.setattr(
+        ra, "ring_attention",
+        lambda *a, **k: calls.append("einsum") or real_ring(*a, **k),
+    )
+
+    cfg_p = llama.LlamaConfig.tiny(max_seq_len=512)
+    q = jax.random.normal(jax.random.key(0), (2, 512, 4, 64), jax.numpy.float32)
+    kv = jax.random.normal(jax.random.key(1), (2, 512, 2, 64), jax.numpy.float32)
+
+    # Explicit pallas, no padding -> fused ring.
+    llama.sp_attention(q, kv, kv, llama.LlamaConfig.tiny(
+        max_seq_len=512, attention_impl="pallas"), causal=True)
+    assert calls[-1] == "pallas", calls
+    # Padded batch -> einsum ring even with explicit pallas.
+    valid = jax.numpy.ones((2, 512), bool)
+    llama.sp_attention(q, kv, kv, llama.LlamaConfig.tiny(
+        max_seq_len=512, attention_impl="pallas"), causal=True, kv_valid=valid)
+    assert calls[-1] == "einsum", calls
+    # auto off-TPU (this CPU mesh) -> einsum ring.
+    llama.sp_attention(q, kv, kv, cfg_p, causal=True)
+    assert calls[-1] == "einsum", calls
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
