@@ -248,10 +248,12 @@ def _sp_active() -> bool:
 def _sp_use_pallas(c, s: int, head_dim: int) -> bool:
     """Pallas selection for the sequence-parallel paths: explicit opt-in
     always (the kernel auto-interprets off-TPU); "auto" on TPU when the
-    per-device sequence chunk still tiles into VMEM blocks."""
-    if c.attention_impl == "pallas":
+    per-device sequence chunk still tiles into VMEM blocks.  Configs without
+    the knob (bert/gpt2) default to "auto"."""
+    impl = getattr(c, "attention_impl", "auto")
+    if impl == "pallas":
         return True
-    if c.attention_impl != "auto":
+    if impl != "auto":
         return False
     try:
         from ..ops.flash_attention import pick_block_pallas
@@ -371,7 +373,7 @@ def sp_attention(q, k, v, c, *, causal: bool = True, kv_valid=None) -> jax.Array
     ``c`` needs ``sp_impl``/``attention_impl`` (getattr defaults cover
     configs without the knobs)."""
     s = q.shape[1]
-    sp_pallas = kv_valid is None and _sp_use_pallas(c, s, q.shape[-1])
+    sp_pallas = _sp_use_pallas(c, s, q.shape[-1])
     if getattr(c, "sp_impl", "ring") == "ulysses":
         from ..ops.ulysses_attention import ulysses_attention
 
@@ -379,7 +381,9 @@ def sp_attention(q, k, v, c, *, causal: bool = True, kv_valid=None) -> jax.Array
             q, k, v, mesh=None, axis_name="sp", causal=causal, kv_valid=kv_valid,
             impl="pallas" if sp_pallas else None,
         )
-    if sp_pallas:
+    if sp_pallas and kv_valid is None:
+        # The pallas RING variant has no validity plumbing (the chunks would
+        # have to ride the ring); padded ring batches take the einsum path.
         from ..ops.pallas_attention import ring_attention_pallas
 
         return ring_attention_pallas(q, k, v, mesh=None, axis_name="sp", causal=causal)
@@ -406,7 +410,7 @@ def attention_block(x, p, c, mask, positions, kv_valid=None) -> jax.Array:
     q, k = _rope(q, k, positions, c.rope_theta)
     if _sp_active():
         attn = sp_attention(q, k, v, c, causal=True, kv_valid=kv_valid)
-    elif mask is None and kv_valid is None and _use_pallas(c, s, b, c.num_heads, c.num_kv_heads):
+    elif mask is None and _use_pallas(c, s, b, c.num_heads, c.num_kv_heads):
         from ..ops.pallas_attention import pallas_attention_spmd
 
         from ..ops.flash_attention import pick_block_pallas
@@ -419,7 +423,8 @@ def attention_block(x, p, c, mask, positions, kv_valid=None) -> jax.Array:
             )
         # On a sharded (non-sp) mesh the spmd wrapper runs the kernel
         # per-device under shard_map; trivial meshes take the plain call.
-        attn = pallas_attention_spmd(q, k, v, causal=True, block_size=blk)
+        # Padded batches mask keys inside the kernel (round 5).
+        attn = pallas_attention_spmd(q, k, v, causal=True, block_size=blk, kv_valid=kv_valid)
     elif mask is None and (
         c.attention_impl == "flash" or (c.attention_impl == "auto" and s >= 1024)
     ) and _flash_block(s) is not None:

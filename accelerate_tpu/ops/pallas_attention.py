@@ -91,8 +91,12 @@ def _causal_mask(s, iq, ik, blk_q, blk_k, rows_are_k=False):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
-                scale, blk_q, blk_k, causal, nk):
+def _fwd_kernel(*refs,
+                scale, blk_q, blk_k, causal, nk, has_valid=False):
+    if has_valid:
+        q_ref, k_ref, v_ref, valid_ref, o_ref, lse_ref, acc, m_scr, l_scr = refs
+    else:
+        (q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr), valid_ref = refs, None
     ik = pl.program_id(3)
     iq = pl.program_id(2)
 
@@ -111,12 +115,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
         ) * scale  # [blk_q, blk_k]
         if causal:
             s = _causal_mask(s, iq, ik, blk_q, blk_k)
+        if valid_ref is not None:
+            vmask = valid_ref[0] != 0  # [blk_k] key validity
+            s = jnp.where(vmask[None, :], s, _NEG_INF)
 
         m_prev = m_scr[:, :1]  # [blk_q, 1]
         l_prev = l_scr[:, :1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)  # [blk_q, blk_k] f32
+        if valid_ref is not None:
+            # A fully-masked row (every key invalid OR causally excluded —
+            # left padding creates them) has m_new = -1e30, so every masked
+            # entry sees exp(-1e30 - -1e30) = 1.  Gate on the masked score
+            # itself: it covers validity AND causal exclusion jointly, so
+            # empty rows keep l = 0 and output zeros like the einsum paths.
+            p = jnp.where(s > _NEG_INF * 0.5, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)  # [blk_q, 1]
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         pv = jax.lax.dot_general(
@@ -140,17 +154,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
         lse_ref[0, 0] = m_scr[:, :1] + jnp.log(l)
 
 
-def _flash_fwd(q, k, v, *, scale, causal, blk_q, blk_k, interpret):
-    """q: [B, H, S, d]; k, v: [B, K, S, d].  Returns (out [B,H,S,d], lse [B,H,S])."""
+def _flash_fwd(q, k, v, *, scale, causal, blk_q, blk_k, interpret, kv_valid=None):
+    """q: [B, H, S, d]; k, v: [B, K, S, d]; optional kv_valid [B, S] (int8
+    key validity).  Returns (out [B,H,S,d], lse [B,H,S])."""
     b, h, s, d = q.shape
     kh = k.shape[1]
     g = h // kh
     nq = s // blk_q
     nk = s // blk_k
 
+    has_valid = kv_valid is not None
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, blk_q=blk_q, blk_k=blk_k, causal=causal, nk=nk
+        _fwd_kernel, scale=scale, blk_q=blk_q, blk_k=blk_k, causal=causal, nk=nk,
+        has_valid=has_valid,
     )
+    operands = [q, k, v] + ([kv_valid] if has_valid else [])
     out, lse = pl.pallas_call(
         kernel,
         grid=(b, h, nq, nk),
@@ -158,7 +176,7 @@ def _flash_fwd(q, k, v, *, scale, causal, blk_q, blk_k, interpret):
             _vmem_spec((1, 1, blk_q, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
             _vmem_spec((1, 1, blk_k, d), lambda ib, ih, iq, ik: (ib, ih // g, ik, 0)),
             _vmem_spec((1, 1, blk_k, d), lambda ib, ih, iq, ik: (ib, ih // g, ik, 0)),
-        ],
+        ] + ([_vmem_spec((1, blk_k), lambda ib, ih, iq, ik: (ib, ik))] if has_valid else []),
         out_specs=[
             _vmem_spec((1, 1, blk_q, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
             _vmem_spec((1, 1, blk_q, 1), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
@@ -174,7 +192,7 @@ def _flash_fwd(q, k, v, *, scale, causal, blk_q, blk_k, interpret):
         ],
         compiler_params=_compiler_params(),
         interpret=interpret,
-    )(q, k, v)
+    )(*operands)
     return out, lse.reshape(b, h, s)
 
 
@@ -183,8 +201,11 @@ def _flash_fwd(q, k, v, *, scale, causal, blk_q, blk_k, interpret):
 # ---------------------------------------------------------------------------
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
-                   *, scale, blk_q, blk_k, causal, nk):
+def _bwd_dq_kernel(*refs, scale, blk_q, blk_k, causal, nk, has_valid=False):
+    if has_valid:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, valid_ref, dq_ref, dq_acc = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc), valid_ref = refs, None
     ik = pl.program_id(3)
     iq = pl.program_id(2)
 
@@ -205,7 +226,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_a
         ) * scale
         if causal:
             s = _causal_mask(s, iq, ik, blk_q, blk_k)
+        if valid_ref is not None:
+            s = jnp.where((valid_ref[0] != 0)[None, :], s, _NEG_INF)
         p = jnp.exp(s - lse)  # [blk_q, blk_k]
+        if valid_ref is not None:
+            # Empty (fully-masked) rows carry lse ~ -1e30, so exp(s - lse)
+            # explodes at their masked entries — gate on the masked score.
+            p = jnp.where(s > _NEG_INF * 0.5, p, 0.0)
         dp = jax.lax.dot_general(
             do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -226,9 +253,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_a
         dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc,
-                    *, scale, blk_q, blk_k, causal, nq):
+def _bwd_dkv_kernel(*refs, scale, blk_q, blk_k, causal, nq, has_valid=False):
+    if has_valid:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, valid_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        valid_ref = None
     iq = pl.program_id(3)
     ik = pl.program_id(2)
 
@@ -250,7 +282,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         ) * scale  # [blk_k, blk_q]
         if causal:
             st = _causal_mask(st, iq, ik, blk_q, blk_k, rows_are_k=True)
+        if valid_ref is not None:
+            # rows are K here: mask invalid KEY rows (their dk/dv stay 0).
+            st = jnp.where((valid_ref[0] != 0)[:, None], st, _NEG_INF)
         pt = jnp.exp(st - lse)  # [blk_k, blk_q]
+        if valid_ref is not None:
+            # Same empty-row lse guard as the dq kernel, transposed.
+            pt = jnp.where(st > _NEG_INF * 0.5, pt, 0.0)
         dv_acc[:] += jax.lax.dot_general(
             pt.astype(do.dtype), do, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -276,12 +314,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, out, lse, do, *, scale, causal, blk_q, blk_k, interpret):
+def _flash_bwd(q, k, v, out, lse, do, *, scale, causal, blk_q, blk_k, interpret,
+               kv_valid=None):
     b, h, s, d = q.shape
     kh = k.shape[1]
     g = h // kh
     nq = s // blk_q
     nk = s // blk_k
+    has_valid = kv_valid is not None
 
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     lse_col = lse.reshape(b, h, s, 1)
@@ -290,7 +330,8 @@ def _flash_bwd(q, k, v, out, lse, do, *, scale, causal, blk_q, blk_k, interpret)
     delta_row = delta.reshape(b, h, 1, s)
 
     dq_kernel = functools.partial(
-        _bwd_dq_kernel, scale=scale, blk_q=blk_q, blk_k=blk_k, causal=causal, nk=nk
+        _bwd_dq_kernel, scale=scale, blk_q=blk_q, blk_k=blk_k, causal=causal, nk=nk,
+        has_valid=has_valid,
     )
     dq = pl.pallas_call(
         dq_kernel,
@@ -302,16 +343,17 @@ def _flash_bwd(q, k, v, out, lse, do, *, scale, causal, blk_q, blk_k, interpret)
             _vmem_spec((1, 1, blk_q, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
             _vmem_spec((1, 1, blk_q, 1), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
             _vmem_spec((1, 1, blk_q, 1), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
-        ],
+        ] + ([_vmem_spec((1, blk_k), lambda ib, ih, iq, ik: (ib, ik))] if has_valid else []),
         out_specs=_vmem_spec((1, 1, blk_q, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
         compiler_params=_compiler_params(),
         interpret=interpret,
-    )(q, k, v, do, lse_col, delta_col)
+    )(*([q, k, v, do, lse_col, delta_col] + ([kv_valid] if has_valid else [])))
 
     dkv_kernel = functools.partial(
-        _bwd_dkv_kernel, scale=scale, blk_q=blk_q, blk_k=blk_k, causal=causal, nq=nq
+        _bwd_dkv_kernel, scale=scale, blk_q=blk_q, blk_k=blk_k, causal=causal, nq=nq,
+        has_valid=has_valid,
     )
     # dK/dV computed per Q-head ([B, H, S, d]) then group-summed to K heads.
     dk_h, dv_h = pl.pallas_call(
@@ -324,7 +366,7 @@ def _flash_bwd(q, k, v, out, lse, do, *, scale, causal, blk_q, blk_k, interpret)
             _vmem_spec((1, 1, blk_q, d), lambda ib, ih, ik, iq: (ib, ih, iq, 0)),
             _vmem_spec((1, 1, 1, blk_q), lambda ib, ih, ik, iq: (ib, ih, 0, iq)),
             _vmem_spec((1, 1, 1, blk_q), lambda ib, ih, ik, iq: (ib, ih, 0, iq)),
-        ],
+        ] + ([_vmem_spec((1, blk_k), lambda ib, ih, ik, iq: (ib, ik))] if has_valid else []),
         out_specs=[
             _vmem_spec((1, 1, blk_k, d), lambda ib, ih, ik, iq: (ib, ih, ik, 0)),
             _vmem_spec((1, 1, blk_k, d), lambda ib, ih, ik, iq: (ib, ih, ik, 0)),
@@ -339,7 +381,7 @@ def _flash_bwd(q, k, v, out, lse, do, *, scale, causal, blk_q, blk_k, interpret)
         ],
         compiler_params=_compiler_params(),
         interpret=interpret,
-    )(q, k, v, do, lse_row, delta_row)
+    )(*([q, k, v, do, lse_row, delta_row] + ([kv_valid] if has_valid else [])))
 
     if g > 1:
         dk = dk_h.reshape(b, kh, g, s, d).sum(axis=2)
@@ -354,23 +396,30 @@ def _flash_bwd(q, k, v, out, lse, do, *, scale, causal, blk_q, blk_k, interpret)
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _mha(q, k, v, scale, causal, blk_q, blk_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _mha(q, k, v, kv_valid, scale, causal, blk_q, blk_k, interpret):
     out, _ = _flash_fwd(q, k, v, scale=scale, causal=causal, blk_q=blk_q,
-                        blk_k=blk_k, interpret=interpret)
+                        blk_k=blk_k, interpret=interpret, kv_valid=kv_valid)
     return out
 
 
-def _mha_fwd(q, k, v, scale, causal, blk_q, blk_k, interpret):
+def _mha_fwd(q, k, v, kv_valid, scale, causal, blk_q, blk_k, interpret):
     out, lse = _flash_fwd(q, k, v, scale=scale, causal=causal, blk_q=blk_q,
-                          blk_k=blk_k, interpret=interpret)
-    return out, (q, k, v, out, lse)
+                          blk_k=blk_k, interpret=interpret, kv_valid=kv_valid)
+    return out, (q, k, v, kv_valid, out, lse)
 
 
 def _mha_bwd(scale, causal, blk_q, blk_k, interpret, res, do):
-    q, k, v, out, lse = res
-    return _flash_bwd(q, k, v, out, lse, do, scale=scale, causal=causal,
-                      blk_q=blk_q, blk_k=blk_k, interpret=interpret)
+    q, k, v, kv_valid, out, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, do, scale=scale, causal=causal,
+                            blk_q=blk_q, blk_k=blk_k, interpret=interpret,
+                            kv_valid=kv_valid)
+    # kv_valid is integer-dtype: its cotangent is the symbolic float0 zero.
+    d_valid = (
+        None if kv_valid is None
+        else np.zeros(kv_valid.shape, jax.dtypes.float0)
+    )
+    return dq, dk, dv, d_valid
 
 
 _mha.defvjp(_mha_fwd, _mha_bwd)
@@ -384,13 +433,17 @@ def pallas_attention(
     causal: bool = True,
     block_size: int = 512,
     interpret: Optional[bool] = None,
+    kv_valid: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Fused flash attention on TPU via Pallas.
 
     Same contract as ``ops.flash_attention.flash_attention``: q ``[B, S, H, d]``,
-    k/v ``[B, S, K, d]`` with ``H = K * groups``; causal GQA over densely packed
-    batches (no padding mask).  ``interpret=None`` auto-enables the Pallas
-    interpreter off-TPU so the same tests run on the CPU mesh.
+    k/v ``[B, S, K, d]`` with ``H = K * groups``; causal GQA.  ``kv_valid``
+    ``[B, S]`` (bool/int) masks padded KEYS per tile (round 5 — padded
+    batches no longer need the scan fallback); fully-masked query rows
+    output zeros, matching the einsum/ring paths.  ``interpret=None``
+    auto-enables the Pallas interpreter off-TPU so the same tests run on the
+    CPU mesh.
     """
     if pltpu is None:
         raise RuntimeError("jax.experimental.pallas.tpu unavailable")
@@ -408,7 +461,8 @@ def pallas_attention(
     kk = k.transpose(0, 2, 1, 3)  # [B, K, S, d]
     vv = v.transpose(0, 2, 1, 3)
     scale = float(1.0 / np.sqrt(d))
-    out = _mha(qh, kk, vv, scale, causal, blk, blk, interpret)
+    valid = None if kv_valid is None else kv_valid.astype(jnp.int8)
+    out = _mha(qh, kk, vv, valid, scale, causal, blk, blk, interpret)
     return out.transpose(0, 2, 1, 3)
 
 
@@ -421,6 +475,7 @@ def pallas_attention_spmd(
     causal: bool = True,
     block_size: int = 512,
     interpret: Optional[bool] = None,
+    kv_valid: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Pallas attention on a multi-device mesh.
 
@@ -451,18 +506,36 @@ def pallas_attention_spmd(
         if am is not None and not am.empty and am.axis_names:
             mesh = am
     if mesh is None or mesh.size == 1:
-        return pallas_attention(q, k, v, causal=causal, block_size=block_size, interpret=interpret)
+        return pallas_attention(
+            q, k, v, causal=causal, block_size=block_size, interpret=interpret,
+            kv_valid=kv_valid,
+        )
     if "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
         raise ValueError("pallas_attention_spmd does not shard the sequence axis; use ring/ulysses for sp>1")
 
     batch_axes = data_axes(mesh)
     head_axis = tp_head_axis(mesh, q.shape[2], k.shape[2])
     spec = P(batch_axes if batch_axes else None, None, head_axis, None)
+    if kv_valid is None:  # hot path: no dummy operand threaded through
 
-    def body(q, k, v):
-        return pallas_attention(q, k, v, causal=causal, block_size=block_size, interpret=interpret)
+        def body(q, k, v):
+            return pallas_attention(
+                q, k, v, causal=causal, block_size=block_size, interpret=interpret
+            )
 
-    return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
+        return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
+
+    valid_spec = P(batch_axes if batch_axes else None, None)
+
+    def body(q, k, v, valid):
+        return pallas_attention(
+            q, k, v, causal=causal, block_size=block_size, interpret=interpret,
+            kv_valid=valid,
+        )
+
+    return shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec, valid_spec), out_specs=spec
+    )(q, k, v, kv_valid.astype(jnp.int8))
 
 
 # ---------------------------------------------------------------------------

@@ -116,18 +116,21 @@ def full_sequence_attention(q, k, v, causal: bool = True, kv_valid=None, impl=No
 
     ``kv_valid`` [B, S] (bool) marks valid keys for padded batches.
     ``impl="pallas"`` runs the fused Pallas kernel instead (legal here even
-    under shard_map — the call is per-device); padded batches and non-tileable
-    sequence lengths fall back to the flash/dense path below."""
+    under shard_map — the call is per-device), including padded batches
+    (the kernel masks keys per tile, round 5); non-tileable sequence
+    lengths fall back to the flash/dense path below."""
     b, s, h, d = q.shape
     from .flash_attention import flash_attention, pick_block
 
-    if impl == "pallas" and kv_valid is None:
+    if impl == "pallas":
         from .flash_attention import pick_block_pallas
         from .pallas_attention import pallas_attention, pallas_available
 
         blk = pick_block_pallas(s, head_dim=d)
         if pallas_available() and blk is not None:
-            return pallas_attention(q, k, v, causal=causal, block_size=blk)
+            return pallas_attention(
+                q, k, v, causal=causal, block_size=blk, kv_valid=kv_valid
+            )
 
     blk = pick_block(s)
     if blk is not None and s > blk:
