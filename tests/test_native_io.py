@@ -70,7 +70,13 @@ def test_prefetch_pool_batched_enqueue(tmp_path):
     for path, arr in files.items():
         got = pool.fetch(path, arr.nbytes)
         np.testing.assert_array_equal(got.view(np.float32).reshape(arr.shape), arr)
-    # Nothing left pending once every path is consumed.
+    # Workers drain queue entries whose cache slots fetch() already
+    # consumed asynchronously — wait for the counter, don't race it.
+    import time as _time
+
+    deadline = _time.time() + 10
+    while pool.pending() and _time.time() < deadline:
+        _time.sleep(0.05)
     assert pool.pending() == 0
     pool.close()
 
