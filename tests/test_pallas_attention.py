@@ -410,3 +410,29 @@ def test_llama_sp_pallas_matches_dense_model():
     np.testing.assert_allclose(
         np.asarray(out_ref, np.float32), np.asarray(out_sp, np.float32), atol=2e-2, rtol=2e-2
     )
+
+
+def test_llama_padded_batch_pallas_matches_einsum():
+    """attention_impl='pallas' with an attention_mask (the padded-batch path
+    that round 5 moved INTO the kernel) must match the einsum model: loss
+    and gradients."""
+    from accelerate_tpu.models import llama
+
+    cfg_kw = dict(num_layers=2, hidden_size=64, intermediate_size=128,
+                  dtype=jnp.float32, max_seq_len=128)
+    cfg_e = llama.LlamaConfig.tiny(**cfg_kw, attention_impl="einsum")
+    cfg_p = llama.LlamaConfig.tiny(**cfg_kw, attention_impl="pallas")
+    params = llama.init_params(cfg_e, jax.random.key(0))
+    ids = np.random.default_rng(5).integers(0, cfg_e.vocab_size, (2, 128)).astype(np.int32)
+    am = np.ones((2, 128), np.int32)
+    am[0, 100:] = 0   # right padding
+    am[1, :40] = 0    # left padding (empty query rows)
+    batch = {"input_ids": jnp.asarray(ids), "attention_mask": jnp.asarray(am)}
+
+    le, ge = jax.value_and_grad(lambda p: llama.loss_fn(p, batch, cfg_e))(params)
+    lp, gp = jax.value_and_grad(lambda p: llama.loss_fn(p, batch, cfg_p))(params)
+    assert abs(float(le) - float(lp)) < 2e-4, (float(le), float(lp))
+    err = jax.tree.reduce(
+        max, jax.tree.map(lambda a, b: float(jnp.abs(a - b).max()), ge, gp)
+    )
+    assert err < 5e-4, f"max grad delta {err}"
