@@ -31,7 +31,8 @@ test_examples:
 
 test_kernels:
 	$(PYTEST) tests/test_flash_attention.py tests/test_pallas_attention.py \
-	  tests/test_ring_attention.py tests/test_moe.py tests/test_fp8.py
+	  tests/test_ring_attention.py tests/test_ulysses.py tests/test_chunked_ce.py \
+	  tests/test_moe.py tests/test_fp8.py
 
 bench:
 	python bench.py
