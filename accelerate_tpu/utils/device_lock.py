@@ -1,0 +1,86 @@
+"""Inter-process serialization for the single-client TPU tunnel.
+
+The axon tunnel admits ONE backend client at a time: a second process
+initializing a client while another holds the device fails with
+``UNAVAILABLE: TPU backend setup/compile error`` — and the losing
+half-initialized client can wedge the tunnel for >15 minutes (observed
+round 5: ``tpu_big_model_bench.py`` racing a ``bench.py`` frontier rung).
+The reference never needs this because CUDA multiplexes clients natively;
+on the tunnel, an advisory ``flock`` is the multiplexer.
+
+Every repo benchmark takes the lock before its first backend touch
+(``benchmarks/_bootstrap.py``) and ``bench.py``'s orchestrator holds it
+across the whole ladder (its rung subprocesses run under the parent's
+lock and must NOT re-acquire).  Opt out with ``ACCELERATE_DEVICE_LOCK=0``
+(e.g. for a manually-serialized run).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+DEFAULT_LOCK_PATH = os.environ.get(
+    "ACCELERATE_DEVICE_LOCK_PATH", "/tmp/accelerate_tpu.device.lock"
+)
+
+_held = {}  # path -> open fd (kept for process lifetime)
+
+
+def acquire_device_lock(
+    timeout_s: float | None = None,
+    path: str = DEFAULT_LOCK_PATH,
+    poll_s: float = 2.0,
+) -> bool:
+    """Block until this process holds the exclusive device lock.
+
+    Returns True when held (or already held by this process, or disabled
+    via ``ACCELERATE_DEVICE_LOCK=0``); False when ``timeout_s`` elapsed
+    first.  The lock is advisory (``flock``), auto-released on process
+    exit — a crashed holder never strands it.
+    """
+    if os.environ.get("ACCELERATE_DEVICE_LOCK", "1") == "0":
+        return True
+    if path in _held:
+        return True
+    import fcntl
+
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("ACCELERATE_DEVICE_LOCK_TIMEOUT_S", "3600"))
+    fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o666)
+    deadline = time.monotonic() + timeout_s
+    announced = False
+    while True:
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            _held[path] = fd
+            try:
+                os.ftruncate(fd, 0)
+                os.write(fd, f"pid={os.getpid()}\n".encode())
+            except OSError:
+                pass
+            return True
+        except OSError:
+            if not announced:
+                print(
+                    f"# device lock busy ({path}); waiting up to {timeout_s:.0f}s "
+                    "for the other bench to finish",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                announced = True
+            if time.monotonic() >= deadline:
+                os.close(fd)
+                return False
+            time.sleep(poll_s)
+
+
+def release_device_lock(path: str = DEFAULT_LOCK_PATH) -> None:
+    """Release early (tests; long-lived processes done with the device)."""
+    fd = _held.pop(path, None)
+    if fd is not None:
+        import fcntl
+
+        fcntl.flock(fd, fcntl.LOCK_UN)
+        os.close(fd)

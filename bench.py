@@ -233,15 +233,15 @@ if os.environ.get("BENCH_TRY_HOSTOPT"):
 # Every outcome is attached to detail.frontier and appended incrementally to
 # BENCH_frontier_live.json (survives a mid-run kill).  Wall-clock bounded by
 # BENCH_FRONTIER_BUDGET_S.
-# - 128k-vocab b7: between the proven b6 (0.8462) and the measured b8 OOM —
-#   the next headline candidate if it fits.
-# - 1.39B + host-offloaded moments at b4/b3: the VERDICT r5 item-8
-#   measurement (proven frontier without offload: b2 = 0.6092, b3 OOM).
-FRONTIER_RUNGS = [
-    ("llama3-903m-v128k", 2048, 6, 8192, 7, 2048, "pallas", "dots", "dense", "bf16", 128256),
-    ("llama-1.4b-hostopt", 2048, 20, 8192, 4, 2048, "pallas", "dots", "dense", "bf16", 32000, True),
-    ("llama-1.4b-hostopt", 2048, 20, 8192, 3, 2048, "pallas", "dots", "dense", "bf16", 32000, True),
-]
+#
+# The round-5 candidates were all MEASURED when the tunnel revived
+# (BENCH_frontier_live.json): 128k-vocab b7 = 0.8207 MFU (b6 = 0.8454 stays
+# champion), 1.39B host-offloaded-moments b4 = 0.297 MFU (transfer-bound — see
+# docs/concept_guides/performance.md), b3 hit its 480 s rung budget.  The list
+# is empty until there is a new unmeasured candidate; re-running known numbers
+# at driver time costs ~20 min and a rung-timeout wedge risk for no
+# information.  BENCH_FRONTIER_JSON still injects ad-hoc rungs.
+FRONTIER_RUNGS = []
 
 # Test hook: lets the smoke tests exercise the rung-subprocess machinery with
 # CPU-sized configs (a real rung takes minutes on CPU).
@@ -351,8 +351,18 @@ def main():
     if "--probe" in sys.argv:
         # Probe through the killable-subprocess machinery: an in-process
         # jax.devices() on a wedged tunnel blocks inside a C call forever.
+        # A probe IS a backend client — racing one against a running bench
+        # is the single-client-tunnel hazard — so it try-acquires the device
+        # lock first and reports "busy" (exit 2) without touching the device
+        # when another bench holds it.
         from accelerate_tpu.utils.device_probe import probe_device_backend
 
+        if os.environ.get("JAX_PLATFORMS", "").lower() != "cpu":
+            from accelerate_tpu.utils.device_lock import acquire_device_lock
+
+            if not acquire_device_lock(timeout_s=0):
+                print("device busy: another bench process holds the device lock")
+                sys.exit(2)
         ok, detail = probe_device_backend(
             timeout_s=float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "90")), retries=1
         )
@@ -379,6 +389,26 @@ def main():
             )
         )
         return
+
+    # The tunnel admits one backend client at a time; serialize with any
+    # other repo bench (rung subprocesses run UNDER this lock and do not
+    # re-acquire — the --rung paths above return before reaching here).
+    if os.environ.get("JAX_PLATFORMS", "").lower() != "cpu":
+        from accelerate_tpu.utils.device_lock import acquire_device_lock
+
+        if not acquire_device_lock():
+            print(
+                json.dumps(
+                    {
+                        "metric": "train_mfu",
+                        "value": 0.0,
+                        "unit": "mfu_fraction",
+                        "vs_baseline": 0.0,
+                        "error": "device lock: timed out waiting for another bench process",
+                    }
+                )
+            )
+            sys.exit(1)
 
     # Fast-fail (then retry, bounded) when the device backend is unreachable
     # (e.g. wedged TPU tunnel).  Probes MUST be subprocesses: backend init

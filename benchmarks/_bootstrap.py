@@ -11,3 +11,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from accelerate_tpu.state import honor_cpu_platform_env
 
 honor_cpu_platform_env()
+
+# The axon tunnel admits one backend client at a time; serialize every
+# benchmark process on the advisory device lock (no-op on CPU runs).
+if os.environ.get("JAX_PLATFORMS", "").lower() != "cpu":
+    from accelerate_tpu.utils.device_lock import acquire_device_lock
+
+    if not acquire_device_lock():
+        raise SystemExit("device lock: timed out waiting for the other bench")

@@ -123,13 +123,22 @@ def main() -> None:
     ap.add_argument("--log", default=os.path.join(REPO, "benchmarks", "probe_log_r03.jsonl"))
     args = ap.parse_args()
 
+    from accelerate_tpu.utils.device_lock import acquire_device_lock, release_device_lock
     from accelerate_tpu.utils.device_probe import probe_device_backend
 
     deadline = time.monotonic() + args.hours * 3600
     attempt = 0
     while time.monotonic() < deadline:
         attempt += 1
+        # A probe is a backend client; never race one against a bench that
+        # holds the single-client tunnel.  Try-acquire, probe, release —
+        # the child benches below re-acquire for themselves.
+        if not acquire_device_lock(timeout_s=0):
+            _log(args.log, {"attempt": attempt, "ok": False, "detail": "device lock busy"})
+            time.sleep(args.interval)
+            continue
         ok, detail = probe_device_backend(timeout_s=args.probe_timeout, retries=1)
+        release_device_lock()
         _log(args.log, {"attempt": attempt, "ok": ok, "detail": detail})
         if ok:
             results = {}

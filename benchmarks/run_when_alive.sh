@@ -1,0 +1,30 @@
+#!/bin/bash
+# Wait for the TPU tunnel to answer a probe, then run the queued hardware
+# benches serially (one client at a time — the tunnel admits one).
+# Usage: bash benchmarks/run_when_alive.sh [max_wait_minutes]
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+MAX_MIN=${1:-240}
+DEADLINE=$(( $(date +%s) + MAX_MIN * 60 ))
+while true; do
+  # The probe's EXIT CODE is the aliveness signal (its output can contain
+  # "TPU" inside failure text like "UNAVAILABLE: TPU backend setup error").
+  if out=$(timeout 180 python bench.py --probe 2>&1); then
+    echo "[watcher] tunnel alive: $(echo "$out" | tail -1) ($(date -u +%H:%M:%S))"
+    break
+  fi
+  out=$(echo "$out" | tail -1)
+  echo "[watcher] still down: $out ($(date -u +%H:%M:%S))"
+  if [ "$(date +%s)" -gt "$DEADLINE" ]; then
+    echo "[watcher] gave up after ${MAX_MIN}m"
+    exit 1
+  fi
+  sleep 150
+done
+echo "[watcher] running big-model bench"
+python benchmarks/tpu_big_model_bench.py 2>&1 | tee /tmp/bigmodel_r05.jsonl
+echo "[watcher] big-model rc=${PIPESTATUS[0]}"
+echo "[watcher] running inference bench --kv_quant"
+python benchmarks/inference_bench.py --kv_quant 2>&1 | tee /tmp/infer_kvq_r05.jsonl
+echo "[watcher] inference rc=${PIPESTATUS[0]}"
+echo "[watcher] done"
