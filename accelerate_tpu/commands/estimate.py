@@ -46,7 +46,7 @@ def _format_bytes(n: float) -> str:
 
 def _native_presets() -> dict:
     """name -> zero-cost config factory for the bundled model families."""
-    from ..models import gpt2, llama, mixtral
+    from ..models import gpt2, llama, mixtral, vit
 
     return {
         "llama3-8b": llama.LlamaConfig.llama3_8b,
@@ -55,6 +55,8 @@ def _native_presets() -> dict:
         "mixtral-tiny": mixtral.MixtralConfig.tiny,
         "gpt2": gpt2.GPT2Config.gpt2_small,
         "gpt2-tiny": gpt2.GPT2Config.tiny,
+        "vit-b-16": vit.ViTConfig.vit_base_16,
+        "vit-l-16": vit.ViTConfig.vit_large_16,
     }
 
 
@@ -66,8 +68,9 @@ def _native_estimate(name: str):
         return None
     cfg = factory()
     total = cfg.num_params() * 4
-    # Largest single block: token embedding vs one decoder layer.
-    embed = cfg.vocab_size * cfg.hidden_size * 4
+    # Largest single block: token embedding vs one decoder layer.  Vision
+    # configs have no vocab; their biggest block is always a layer.
+    embed = getattr(cfg, "vocab_size", 0) * cfg.hidden_size * 4
     layers = getattr(cfg, "num_layers", 1) or 1
     per_layer = max((total - embed) // layers, 0)
     return total, max(embed, per_layer), cfg

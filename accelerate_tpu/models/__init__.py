@@ -1,1 +1,1 @@
-from . import bert, gpt2, llama, mixtral, t5
+from . import bert, gpt2, llama, mixtral, t5, vit

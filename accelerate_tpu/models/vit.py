@@ -1,0 +1,269 @@
+"""ViT — native vision-encoder model family.
+
+Parity rationale: the reference's CV story runs torchvision/timm models
+through its model-agnostic loop (``examples/cv_example.py``,
+``examples/complete_cv_example.py``); its own test fixtures are
+regression MLPs.  This family covers the vision-encoder architecture
+class natively so image training does not require the torch bridge:
+patchify-as-matmul embedding (a strided conv is exactly a reshape +
+``[p*p*C, d]`` matmul — one MXU-shaped contraction, no conv lowering),
+pre-LN transformer blocks, learned position embeddings, CLS-token or
+mean pooling, classification head.
+
+Same TPU-first layout as the other families: stacked per-layer params
+under ``lax.scan``, bf16 compute / fp32 params, partition rules over the
+named mesh, optional per-block remat.  Sequence parallelism composes via
+the shared ``sp_attention`` dispatch (bidirectional, like BERT) with
+``pool="mean"`` — the CLS token would make the token count ``N + 1``,
+indivisible by the ``sp`` axis, so ``pool="cls"`` raises under an active
+sp mesh instead of silently falling back.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.sharding import constrain as _constrain
+from .llama import _sp_active
+from .llama import sp_attention as _sp_attention
+from .gpt2 import _layer_norm
+
+__all__ = [
+    "ViTConfig",
+    "init_params",
+    "apply",
+    "classification_loss_fn",
+    "PARTITION_RULES",
+    "param_specs",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_channels: int = 3
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_ratio: int = 4
+    num_labels: int = 1000
+    pool: str = "cls"  # "cls" | "mean" ("mean" required under an sp mesh)
+    layer_norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    sp_impl: str = "ring"
+
+    def __post_init__(self):
+        if self.image_size % self.patch_size:
+            raise ValueError(
+                f"image_size {self.image_size} must be divisible by patch_size {self.patch_size}"
+            )
+        if self.hidden_size % self.num_heads:
+            raise ValueError("hidden_size must be divisible by num_heads")
+        if self.pool not in ("cls", "mean"):
+            raise ValueError(f"pool must be 'cls' or 'mean', got {self.pool!r}")
+        if self.sp_impl not in ("ring", "ulysses"):
+            raise ValueError(f"sp_impl must be 'ring' or 'ulysses', got {self.sp_impl!r}")
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def seq_len(self) -> int:
+        return self.num_patches + (1 if self.pool == "cls" else 0)
+
+    def num_params(self) -> int:
+        leaves = jax.tree_util.tree_leaves(
+            _param_shapes(self), is_leaf=lambda x: isinstance(x, tuple)
+        )
+        return sum(int(np.prod(s)) for s in leaves)
+
+    @classmethod
+    def tiny(cls, **kw) -> "ViTConfig":
+        defaults = dict(
+            image_size=32, patch_size=8, hidden_size=64, num_layers=2,
+            num_heads=4, num_labels=10,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def vit_base_16(cls, **kw) -> "ViTConfig":
+        return cls(**kw)  # the defaults are ViT-B/16
+
+    @classmethod
+    def vit_large_16(cls, **kw) -> "ViTConfig":
+        defaults = dict(hidden_size=1024, num_layers=24, num_heads=16)
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+PARTITION_RULES: list[tuple[str, P]] = [
+    (r"embeddings/patch_w", P(None, "fsdp")),
+    (r"embeddings/position", P(None, "fsdp")),
+    (r"layers/w_qkv", P(None, "fsdp", "tp")),
+    (r"layers/w_proj", P(None, "tp", "fsdp")),
+    (r"layers/w_up", P(None, "fsdp", "tp")),
+    (r"layers/w_down", P(None, "tp", "fsdp")),
+    (r"classifier/w", P("tp", None)),
+]
+
+
+def _param_shapes(c: ViTConfig) -> dict:
+    d, L, m = c.hidden_size, c.num_layers, c.mlp_ratio
+    emb = {
+        "patch_w": (c.patch_size * c.patch_size * c.num_channels, d),
+        "patch_b": (d,),
+        "position": (c.seq_len, d),
+    }
+    if c.pool == "cls":
+        emb["cls"] = (1, 1, d)
+    return {
+        "embeddings": emb,
+        "layers": {
+            "w_qkv": (L, d, 3 * d),
+            "b_qkv": (L, 3 * d),
+            "w_proj": (L, d, d),
+            "b_proj": (L, d),
+            "w_up": (L, d, m * d),
+            "b_up": (L, m * d),
+            "w_down": (L, m * d, d),
+            "b_down": (L, d),
+            "ln_attn_scale": (L, d),
+            "ln_attn_bias": (L, d),
+            "ln_mlp_scale": (L, d),
+            "ln_mlp_bias": (L, d),
+        },
+        "final_ln": {"scale": (d,), "bias": (d,)},
+        "classifier": {"w": (d, c.num_labels), "b": (c.num_labels,)},
+    }
+
+
+def param_specs(config: ViTConfig) -> dict:
+    from ..parallel.sharding import spec_from_rules
+
+    shapes = _param_shapes(config)
+
+    def one(kp, shape):
+        path = "/".join(str(getattr(k, "key", k)) for k in kp)
+        spec = spec_from_rules(path, len(shape), PARTITION_RULES)
+        return spec if spec is not None else P(*([None] * len(shape)))
+
+    return jax.tree_util.tree_map_with_path(one, shapes, is_leaf=lambda x: isinstance(x, tuple))
+
+
+def init_params(config: ViTConfig, key: jax.Array) -> dict:
+    shapes = _param_shapes(config)
+    leaves, treedef = jax.tree_util.tree_flatten(shapes, is_leaf=lambda x: isinstance(x, tuple))
+    keys = jax.random.split(key, len(leaves))
+    keys = jax.tree_util.tree_unflatten(treedef, list(keys))
+
+    def init_one(kp, shape, k):
+        # Dispatch on the param NAME, not shape (a shape test would zero the
+        # (seq_len, d) position embedding whenever seq_len == num_layers):
+        # biases, LN params and the CLS token start at zero; LN scales at one;
+        # position embeddings and weight matrices normal(0.02) as in ViT.
+        name = str(getattr(kp[-1], "key", kp[-1]))
+        if name.endswith("_scale") or name == "scale":
+            return jnp.ones(shape, config.param_dtype)
+        if name.startswith("b_") or name.endswith("_bias") or name in ("bias", "b", "patch_b", "cls"):
+            return jnp.zeros(shape, config.param_dtype)
+        return (jax.random.normal(k, shape, jnp.float32) * 0.02).astype(config.param_dtype)
+
+    return jax.tree_util.tree_map_with_path(
+        init_one, shapes, keys, is_leaf=lambda x: isinstance(x, tuple)
+    )
+
+
+def _patchify(pixels: jax.Array, c: ViTConfig) -> jax.Array:
+    """[B, H, W, C] -> [B, N, p*p*C]; the strided-conv patch embedding as a
+    reshape + matmul (the matmul lives in ``apply``)."""
+    b, hgt, wid, ch = pixels.shape
+    p = c.patch_size
+    x = pixels.reshape(b, hgt // p, p, wid // p, p, ch)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, (hgt // p) * (wid // p), p * p * ch)
+
+
+def _layer(carry, p, *, c: ViTConfig, act_spec):
+    x = carry
+    d, h, hd = c.hidden_size, c.num_heads, c.head_dim
+    b, s, _ = x.shape
+
+    # Pre-LN attention sub-block.
+    n = _layer_norm(x, p["ln_attn_scale"], p["ln_attn_bias"], c.layer_norm_eps)
+    qkv = n @ p["w_qkv"].astype(c.dtype) + p["b_qkv"].astype(c.dtype)
+    q, k, v = (t[:, :, 0] for t in jnp.split(qkv.reshape(b, s, 3, h, hd), 3, axis=2))
+    if _sp_active():
+        attn = _sp_attention(q, k, v, c, causal=False).reshape(b, s, d)
+    else:
+        scores = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) / np.sqrt(hd)
+        probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+        attn = jnp.einsum("bhst,bthd->bshd", probs, v).reshape(b, s, d)
+    x = x + attn @ p["w_proj"].astype(c.dtype) + p["b_proj"].astype(c.dtype)
+
+    # Pre-LN MLP sub-block.
+    n = _layer_norm(x, p["ln_mlp_scale"], p["ln_mlp_bias"], c.layer_norm_eps)
+    u = jax.nn.gelu(n @ p["w_up"].astype(c.dtype) + p["b_up"].astype(c.dtype))
+    x = x + u @ p["w_down"].astype(c.dtype) + p["b_down"].astype(c.dtype)
+    if act_spec is not None:
+        x = _constrain(x, act_spec)
+    return x, None
+
+
+def apply(params: dict, pixels: jax.Array, config: ViTConfig) -> tuple[jax.Array, jax.Array]:
+    """Returns (token features [B, S, d] in compute dtype, pooled [B, d] fp32).
+
+    ``pixels`` is channels-last ``[B, H, W, C]`` (NHWC is the TPU-native
+    layout; transpose NCHW inputs before calling).
+    """
+    c = config
+    if _sp_active() and c.pool == "cls":
+        raise ValueError(
+            "ViT with pool='cls' cannot run sequence-parallel: the CLS token "
+            "makes the token count num_patches+1, indivisible by the sp axis. "
+            "Use ViTConfig(pool='mean')."
+        )
+    e = params["embeddings"]
+    x = _patchify(pixels.astype(c.dtype), c) @ e["patch_w"].astype(c.dtype)
+    x = x + e["patch_b"].astype(c.dtype)
+    if c.pool == "cls":
+        cls = jnp.broadcast_to(e["cls"].astype(c.dtype), (x.shape[0], 1, c.hidden_size))
+        x = jnp.concatenate([cls, x], axis=1)
+    x = x + e["position"].astype(c.dtype)[None]
+    act_spec = P(("dcn_dp", "dp", "fsdp"), "sp", None)
+    x = _constrain(x, act_spec)
+
+    def body(carry, lp):
+        return _layer(carry, lp, c=c, act_spec=act_spec)
+
+    if c.remat:
+        body = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = _layer_norm(x, params["final_ln"]["scale"], params["final_ln"]["bias"], c.layer_norm_eps)
+    xf = x.astype(jnp.float32)
+    pooled = xf[:, 0] if c.pool == "cls" else xf.mean(axis=1)
+    return x, pooled
+
+
+def classification_loss_fn(params: dict, batch: dict, config: ViTConfig) -> jax.Array:
+    """Image-classification cross-entropy over ``batch["pixel_values"]``
+    [B, H, W, C] and ``batch["labels"]`` [B]."""
+    _, pooled = apply(params, batch["pixel_values"], config)
+    logits = pooled @ params["classifier"]["w"].astype(jnp.float32) + params["classifier"]["b"]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, batch["labels"][:, None], axis=-1))

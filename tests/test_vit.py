@@ -1,0 +1,114 @@
+"""ViT family tests (shapes, pooling, training, sharding and sp parity) on
+the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import AcceleratorState, ParallelismConfig
+from accelerate_tpu.models import vit
+from accelerate_tpu.parallel.sharding import data_sharding, shard_params
+
+
+def _batch(cfg, b=4, seed=1):
+    kp, kl = jax.random.split(jax.random.key(seed))
+    return {
+        "pixel_values": jax.random.normal(
+            kp, (b, cfg.image_size, cfg.image_size, cfg.num_channels), jnp.float32
+        ),
+        "labels": jax.random.randint(kl, (b,), 0, cfg.num_labels),
+    }
+
+
+def test_forward_shapes_and_pooling():
+    cfg = vit.ViTConfig.tiny()
+    params = vit.init_params(cfg, jax.random.key(0))
+    x = _batch(cfg)["pixel_values"]
+    tokens, pooled = vit.apply(params, x, cfg)
+    assert tokens.shape == (4, cfg.seq_len, cfg.hidden_size)
+    assert pooled.shape == (4, cfg.hidden_size) and pooled.dtype == jnp.float32
+    # CLS pooling reads token 0; mean pooling averages — they must differ.
+    cfg_m = vit.ViTConfig.tiny(pool="mean")
+    params_m = vit.init_params(cfg_m, jax.random.key(0))
+    tokens_m, pooled_m = vit.apply(params_m, x, cfg_m)
+    assert tokens_m.shape[1] == cfg_m.num_patches == cfg.seq_len - 1
+    np.testing.assert_allclose(
+        np.asarray(pooled_m), np.asarray(tokens_m.astype(jnp.float32).mean(axis=1)),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_init_by_name_not_shape():
+    # 32/8 -> 16 patches + cls = 17... use mean pool: 16 tokens == num_layers=16;
+    # a shape-based init dispatch would zero the (16, d) position embedding.
+    cfg = vit.ViTConfig.tiny(pool="mean", num_layers=16)
+    assert cfg.seq_len == cfg.num_layers
+    params = vit.init_params(cfg, jax.random.key(0))
+    e = params["embeddings"]
+    assert float(jnp.abs(e["position"]).sum()) > 0
+    assert float(jnp.abs(e["patch_b"]).sum()) == 0
+    assert float(jnp.abs(params["layers"]["b_qkv"]).sum()) == 0
+    np.testing.assert_array_equal(np.asarray(params["final_ln"]["scale"]), 1.0)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="divisible by patch_size"):
+        vit.ViTConfig(image_size=30, patch_size=16)
+    with pytest.raises(ValueError, match="pool"):
+        vit.ViTConfig.tiny(pool="max")
+
+
+def test_trains():
+    cfg = vit.ViTConfig.tiny()
+    params = vit.init_params(cfg, jax.random.key(0))
+    batch = _batch(cfg)
+    tx = optax.adam(1e-2)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(p, o, b):
+        l, g = jax.value_and_grad(vit.classification_loss_fn)(p, b, cfg)
+        u, o = tx.update(g, o, p)
+        return optax.apply_updates(p, u), o, l
+
+    losses = []
+    for _ in range(10):
+        params, opt, loss = step(params, opt, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_sharded_matches_dense():
+    cfg = vit.ViTConfig.tiny(dtype=jnp.float32)
+    params = vit.init_params(cfg, jax.random.key(0))
+    batch = _batch(cfg)
+    dense = float(jax.jit(lambda p, b: vit.classification_loss_fn(p, b, cfg))(params, batch))
+    state = AcceleratorState(parallelism_config=ParallelismConfig(fsdp=4, tp=2))
+    sharded = shard_params(params, state.mesh, vit.param_specs(cfg))
+    sb = {k: jax.device_put(v, data_sharding(state.mesh)) for k, v in batch.items()}
+    sl = float(jax.jit(lambda p, b: vit.classification_loss_fn(p, b, cfg))(sharded, sb))
+    assert abs(dense - sl) < 1e-4, (dense, sl)
+
+
+@pytest.mark.parametrize("sp_impl", ["ring", "ulysses"])
+def test_sp_matches_dense(sp_impl):
+    # 32/8 -> 16 patches, divisible by sp=4; mean pooling (no CLS token).
+    cfg = vit.ViTConfig.tiny(dtype=jnp.float32, pool="mean", sp_impl=sp_impl)
+    params = vit.init_params(cfg, jax.random.key(0))
+    batch = _batch(cfg)
+    dense = float(jax.jit(lambda p, b: vit.classification_loss_fn(p, b, cfg))(params, batch))
+    state = AcceleratorState(parallelism_config=ParallelismConfig(dp=2, sp=4))
+    sharded = shard_params(params, state.mesh, vit.param_specs(cfg))
+    sb = {k: jax.device_put(v, data_sharding(state.mesh)) for k, v in batch.items()}
+    sl = float(jax.jit(lambda p, b: vit.classification_loss_fn(p, b, cfg))(sharded, sb))
+    assert abs(dense - sl) < 2e-3, (dense, sl, sp_impl)
+
+
+def test_cls_pool_rejected_under_sp():
+    cfg = vit.ViTConfig.tiny(dtype=jnp.float32)  # pool="cls"
+    params = vit.init_params(cfg, jax.random.key(0))
+    AcceleratorState(parallelism_config=ParallelismConfig(dp=2, sp=4))
+    with pytest.raises(ValueError, match="pool='cls'"):
+        vit.apply(params, _batch(cfg)["pixel_values"], cfg)
