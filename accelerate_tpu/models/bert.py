@@ -124,18 +124,22 @@ def param_specs(config: BertConfig) -> dict:
 def init_params(config: BertConfig, key: jax.Array) -> dict:
     shapes = _param_shapes(config)
     leaves, treedef = jax.tree_util.tree_flatten(shapes, is_leaf=lambda x: isinstance(x, tuple))
-    keys = jax.random.split(key, len(leaves))
+    keys = jax.tree_util.tree_unflatten(treedef, list(jax.random.split(key, len(leaves))))
 
-    def init_one(shape, k):
-        if len(shape) == 1 or (len(shape) == 2 and shape[0] == config.num_layers):
+    def init_one(kp, shape, k):
+        # Name-based dispatch (see llama.init_params): the old shape test
+        # zeroed the (type_vocab_size, d) token-type table whenever
+        # type_vocab_size == num_layers — true for the 2-layer tiny config.
+        name = str(getattr(kp[-1], "key", kp[-1]))
+        if name.endswith("scale"):
+            return jnp.ones(shape, config.param_dtype)
+        if name.startswith("b_") or name.endswith("bias") or name == "b":
             return jnp.zeros(shape, config.param_dtype)
         return (jax.random.normal(k, shape, jnp.float32) * 0.02).astype(config.param_dtype)
 
-    out = jax.tree_util.tree_unflatten(treedef, [init_one(s, k) for s, k in zip(leaves, keys)])
-    for scale_key in ("ln_attn_scale", "ln_mlp_scale"):
-        out["layers"][scale_key] = jnp.ones_like(out["layers"][scale_key])
-    out["embeddings"]["ln_scale"] = jnp.ones_like(out["embeddings"]["ln_scale"])
-    return out
+    return jax.tree_util.tree_map_with_path(
+        init_one, shapes, keys, is_leaf=lambda x: isinstance(x, tuple)
+    )
 
 
 def _layer(carry, p, *, c: BertConfig, mask, kv_valid=None, act_spec):

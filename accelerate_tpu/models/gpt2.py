@@ -138,21 +138,22 @@ def param_specs(config: GPT2Config) -> dict:
 def init_params(config: GPT2Config, key: jax.Array) -> dict:
     shapes = _param_shapes(config)
     leaves, treedef = jax.tree_util.tree_flatten(shapes, is_leaf=lambda x: isinstance(x, tuple))
-    keys = jax.random.split(key, len(leaves))
+    keys = jax.tree_util.tree_unflatten(treedef, list(jax.random.split(key, len(leaves))))
 
-    def init_one(kp_shape, k):
-        shape = kp_shape
+    def init_one(kp, shape, k):
+        # Name-based dispatch (see llama.init_params): a shape test would zero
+        # the (max_seq_len, d) position table whenever max_seq_len == num_layers.
         # Scales to 1, biases to 0, weights normal(0.02) (GPT-2 init).
-        if len(shape) == 1 or (len(shape) == 2 and shape[0] == config.num_layers):
+        name = str(getattr(kp[-1], "key", kp[-1]))
+        if name.endswith("_scale"):
+            return jnp.ones(shape, config.param_dtype)
+        if name.startswith("b_") or name.endswith("_bias"):
             return jnp.zeros(shape, config.param_dtype)
         return (jax.random.normal(k, shape, jnp.float32) * 0.02).astype(config.param_dtype)
 
-    out = jax.tree_util.tree_unflatten(treedef, [init_one(s, k) for s, k in zip(leaves, keys)])
-    # LayerNorm scales start at 1.
-    out["layers"]["ln_attn_scale"] = jnp.ones_like(out["layers"]["ln_attn_scale"])
-    out["layers"]["ln_mlp_scale"] = jnp.ones_like(out["layers"]["ln_mlp_scale"])
-    out["final_ln_scale"] = jnp.ones_like(out["final_ln_scale"])
-    return out
+    return jax.tree_util.tree_map_with_path(
+        init_one, shapes, keys, is_leaf=lambda x: isinstance(x, tuple)
+    )
 
 
 def _layer_norm(x, scale, bias, eps):

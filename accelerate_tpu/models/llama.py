@@ -213,23 +213,25 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
     """Initialize parameters (truncated-normal fan-in scaling)."""
     shapes = _param_shapes(config)
     leaves, treedef = jax.tree_util.tree_flatten(shapes, is_leaf=lambda x: isinstance(x, tuple))
-    keys = jax.random.split(key, len(leaves))
+    keys = jax.tree_util.tree_unflatten(treedef, list(jax.random.split(key, len(leaves))))
 
-    def init_one(shape, k):
-        if len(shape) == 1 or (len(shape) == 2 and shape[0] == config.num_layers):
+    def init_one(kp, shape, k):
+        # Dispatch on the param NAME, not shape — a shape test would turn the
+        # (vocab, d) embedding into ones whenever vocab == num_layers.
+        name = str(getattr(kp[-1], "key", kp[-1]))
+        if name in ("ln_attn", "ln_mlp", "final_norm"):
             return jnp.ones(shape, config.param_dtype)  # norm scales
-        if len(shape) == 2 and shape[0] == config.vocab_size:
-            # Embedding table: lookup is one-hot (effective fan-in 1), so scale by
-            # hidden size, not vocab size.
-            fan_in = config.hidden_size
-        else:
-            fan_in = shape[-2]
+        # Embedding table: lookup is one-hot (effective fan-in 1), so scale by
+        # hidden size, not vocab size.
+        fan_in = config.hidden_size if name == "embed" else shape[-2]
         scale = 1.0 / np.sqrt(fan_in)
         return (jax.random.truncated_normal(k, -2.0, 2.0, shape, jnp.float32) * scale).astype(
             config.param_dtype
         )
 
-    return jax.tree_util.tree_unflatten(treedef, [init_one(s, k) for s, k in zip(leaves, keys)])
+    return jax.tree_util.tree_map_with_path(
+        init_one, shapes, keys, is_leaf=lambda x: isinstance(x, tuple)
+    )
 
 
 from ..parallel.sharding import (  # noqa: E402

@@ -185,21 +185,23 @@ def param_specs(config: MixtralConfig) -> dict:
 def init_params(config: MixtralConfig, key: jax.Array) -> dict:
     shapes = _param_shapes(config)
     leaves, treedef = jax.tree_util.tree_flatten(shapes, is_leaf=lambda x: isinstance(x, tuple))
-    keys = jax.random.split(key, len(leaves))
+    keys = jax.tree_util.tree_unflatten(treedef, list(jax.random.split(key, len(leaves))))
 
-    def init_one(shape, k):
-        if len(shape) == 1 or (len(shape) == 2 and shape[0] == config.num_layers):
+    def init_one(kp, shape, k):
+        # Name-based dispatch (see llama.init_params): shape tests misfire
+        # when e.g. vocab_size == num_layers.
+        name = str(getattr(kp[-1], "key", kp[-1]))
+        if name in ("ln_attn", "ln_mlp", "final_norm"):
             return jnp.ones(shape, config.param_dtype)  # norm scales
-        if len(shape) == 2 and shape[0] == config.vocab_size:
-            fan_in = config.hidden_size
-        else:
-            fan_in = shape[-2]
+        fan_in = config.hidden_size if name == "embed" else shape[-2]
         scale = 1.0 / np.sqrt(fan_in)
         return (jax.random.truncated_normal(k, -2.0, 2.0, shape, jnp.float32) * scale).astype(
             config.param_dtype
         )
 
-    return jax.tree_util.tree_unflatten(treedef, [init_one(s, k) for s, k in zip(leaves, keys)])
+    return jax.tree_util.tree_map_with_path(
+        init_one, shapes, keys, is_leaf=lambda x: isinstance(x, tuple)
+    )
 
 
 def _layer(

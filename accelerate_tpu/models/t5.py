@@ -126,19 +126,24 @@ def param_specs(config: T5Config) -> dict:
 def init_params(config: T5Config, key: jax.Array) -> dict:
     shapes = _param_shapes(config)
     leaves, treedef = jax.tree_util.tree_flatten(shapes, is_leaf=lambda x: isinstance(x, tuple))
-    keys = jax.random.split(key, len(leaves))
+    keys = jax.tree_util.tree_unflatten(treedef, list(jax.random.split(key, len(leaves))))
 
-    def init_one(shape, k):
-        if len(shape) == 1 or (len(shape) == 2 and shape[0] == config.num_layers):
+    def init_one(kp, shape, k):
+        # Name-based dispatch (see llama.init_params): shape tests misfire
+        # when e.g. num_buckets == num_layers or vocab_size == num_layers.
+        name = str(getattr(kp[-1], "key", kp[-1]))
+        if name.startswith("ln_") or name.endswith("_final_ln"):
             return jnp.ones(shape, config.param_dtype)  # RMSNorm scales
-        fan_in = shape[-2] if len(shape) >= 2 else shape[0]
-        if len(shape) == 2 and shape[0] == config.num_buckets:
+        if name.endswith("_rel_bias"):
             return jnp.zeros(shape, config.param_dtype)  # relative bias starts flat
+        fan_in = shape[-2] if len(shape) >= 2 else shape[0]
         return (jax.random.normal(k, shape, jnp.float32) / np.sqrt(fan_in)).astype(
             config.param_dtype
         )
 
-    return jax.tree_util.tree_unflatten(treedef, [init_one(s, k) for s, k in zip(leaves, keys)])
+    return jax.tree_util.tree_map_with_path(
+        init_one, shapes, keys, is_leaf=lambda x: isinstance(x, tuple)
+    )
 
 
 def _relative_buckets(rel_pos: jax.Array, num_buckets: int, max_distance: int, bidirectional: bool):
