@@ -52,6 +52,19 @@ def test_jax_native_llama_example():
     assert loss is not None and loss < 10.0
 
 
+def test_jax_native_vit_example():
+    mod = _load(os.path.join(EXAMPLES, "jax_native", "vit_train.py"), "vit_train")
+    argv = sys.argv
+    sys.argv = ["vit_train.py", "--dp", "2", "--sp", "4", "--pool", "mean",
+                "--steps", "4", "--batch_size", "8", "--image_size", "32",
+                "--patch_size", "8", "--hidden", "64", "--layers", "2"]
+    try:
+        loss = mod.main()
+    finally:
+        sys.argv = argv
+    assert loss is not None and loss < 10.0
+
+
 def test_complete_nlp_example_checkpoint_and_resume(tmp_path):
     mod = _load(os.path.join(EXAMPLES, "complete_nlp_example.py"), "complete_nlp_example")
     args = argparse.Namespace(
