@@ -16,7 +16,7 @@ import jax.numpy as jnp
 __all__ = [
     "generate_loop", "select_token", "make_kv_cache", "check_cache_room",
     "quantize_kv", "dequantize_kv", "pack_cache_for_scan",
-    "unpack_cache_from_scan", "cache_write",
+    "unpack_cache_from_scan", "cache_write", "speculative_generate_loop",
 ]
 
 
@@ -225,6 +225,129 @@ def generate_loop(
         jnp.concatenate([toks.T, last[:, None]], axis=1) if max_new_tokens > 1 else last[:, None]
     )
     return jnp.concatenate([input_ids, generated], axis=1)
+
+
+def speculative_generate_loop(
+    apply_cached: Callable,
+    init_cache: Callable,
+    params,
+    config,
+    draft_apply_cached: Callable,
+    draft_init_cache: Callable,
+    draft_params,
+    draft_config,
+    input_ids: jax.Array,
+    max_new_tokens: int,
+    num_draft_tokens: int = 4,
+    max_len: Optional[int] = None,
+) -> jax.Array:
+    """Greedy speculative decoding: a small draft model proposes ``γ =
+    num_draft_tokens`` tokens autoregressively, the target verifies all of
+    them (plus a bonus position) in ONE cached forward, and the longest
+    agreeing prefix is accepted — ``1..γ+1`` tokens per target forward
+    instead of exactly 1.  The output is **token-identical to greedy
+    decoding with the target alone** (every emitted token is either a
+    verified draft token or the target's own argmax), so the speedup is
+    free of quality risk.  Net-new vs the reference (no generation engine
+    upstream); the TPU angle is that the whole propose→verify→accept round
+    — including the variable-length accept — is one ``lax.while_loop``
+    with static shapes, compiled once.
+
+    Cache bookkeeping: both caches keep the invariant "``index`` counts the
+    tokens strictly before ``last`` (the newest emitted, not-yet-fed
+    token)".  Each round writes ``γ+1`` rows into both caches and then
+    *rewinds* ``index`` to the accepted length; the next round's writes
+    cover every stale row before any query can attend it (write extent
+    ``[index', index'+γ]`` ⊇ stale ``[index', index+γ]`` since the accept
+    count is ≥ 1), and the families' position-based causal mask hides
+    anything beyond ``index``.
+
+    Batch 1 only (speculative decoding is a latency optimization; rows with
+    different accept counts would need per-row cache indices).  Greedy only
+    — sampled acceptance (the Leviathan et al. rejection scheme) needs the
+    draft's full distribution, not just its argmax.
+    """
+    b, s = input_ids.shape
+    if b != 1:
+        raise ValueError(
+            f"speculative decoding is batch-1 only (got batch {b}): rows with "
+            "different accept counts would need per-row cache indices"
+        )
+    gamma = int(num_draft_tokens)
+    if gamma < 1:
+        raise ValueError(f"num_draft_tokens must be >= 1, got {num_draft_tokens}")
+    tv = getattr(config, "vocab_size", None)
+    dv = getattr(draft_config, "vocab_size", None)
+    if tv != dv:
+        raise ValueError(f"target and draft vocab sizes differ: {tv} vs {dv}")
+    if max_new_tokens < 0:
+        raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
+    if max_new_tokens == 0:
+        return input_ids
+    # The last round can start at generated-count max_new-1 and still write
+    # γ+1 rows — the caches need that much slack past the final token.
+    need = s + max_new_tokens + gamma
+    if max_len is None:
+        max_len = need
+    elif max_len < need:
+        raise ValueError(
+            f"max_len ({max_len}) < prompt + max_new_tokens + num_draft_tokens "
+            f"({need}): the verify writes need overshoot room"
+        )
+
+    t_cache = init_cache(config, b, max_len)
+    d_cache = draft_init_cache(draft_config, b, max_len)
+    t_logits, t_cache = apply_cached(params, input_ids, config, t_cache)
+    _, d_cache = draft_apply_cached(draft_params, input_ids, draft_config, d_cache)
+    first = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)  # [B]
+
+    buf = jnp.zeros((b, max_new_tokens + gamma + 1), jnp.int32)
+    buf = jax.lax.dynamic_update_slice(buf, first[:, None], (0, 0))
+
+    def cond(carry):
+        return carry[0] < max_new_tokens
+
+    def body(carry):
+        n, last, t_cache, d_cache, buf = carry
+
+        # Draft proposes γ tokens — a one-token cached step under lax.scan
+        # (cache in the carry), so the draft forward compiles ONCE however
+        # large γ is.  One extra feed (logits discarded) keeps the draft
+        # cache covering d_γ so a full accept stays aligned.
+        def d_step(dcarry, _):
+            dc, tok = dcarry
+            dl, dc = draft_apply_cached(draft_params, tok[:, None], draft_config, dc)
+            nxt = jnp.argmax(dl[:, -1], axis=-1).astype(jnp.int32)
+            return (dc, nxt), nxt
+
+        (dc, tok), d_steps = jax.lax.scan(d_step, (d_cache, last), None, length=gamma)
+        _, dc = draft_apply_cached(draft_params, tok[:, None], draft_config, dc)
+        d = jnp.moveaxis(d_steps, 0, 1)  # [γ, B] -> [B, γ]
+
+        # Target verifies [last, d_1..d_γ] in one forward: row j's argmax is
+        # the target's choice AFTER consuming seq[:, j].
+        seq = jnp.concatenate([last[:, None], d], axis=1)  # [B, γ+1]
+        t_logits, tc = apply_cached(params, seq, config, t_cache)
+        t = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [B, γ+1]
+
+        # m = longest prefix where the target agrees with the draft; the
+        # accepted chunk is [d_1..d_m, t_{m+1}] (correction on mismatch,
+        # bonus token on full accept) — count = m+1 tokens, uniformly.
+        match = (t[:, :gamma] == d).astype(jnp.int32)
+        m = jnp.sum(jnp.cumprod(match, axis=1), axis=1)[0]  # scalar; b == 1
+        count = m + 1
+        d_pad = jnp.concatenate([d, jnp.zeros((b, 1), jnp.int32)], axis=1)
+        chunk = jnp.where(jnp.arange(gamma + 1)[None, :] < m, d_pad, t)  # [B, γ+1]
+        buf = jax.lax.dynamic_update_slice(buf, chunk, (0, n))
+        last = jax.lax.dynamic_index_in_dim(chunk, m, axis=1, keepdims=False)
+        # Rewind both caches to the accepted length (both wrote γ+1 rows).
+        tc = {**tc, "index": tc["index"] - (gamma + 1) + count}
+        dc = {**dc, "index": dc["index"] - (gamma + 1) + count}
+        return n + count, last, tc, dc, buf
+
+    carry = (jnp.asarray(1, jnp.int32), first, t_cache, d_cache, buf)
+    _, _, _, _, buf = jax.lax.while_loop(cond, body, carry)
+    return jnp.concatenate([input_ids, buf[:, :max_new_tokens]], axis=1)
 
 
 def beam_search(

@@ -705,6 +705,30 @@ def generate(
     )
 
 
+def speculative_generate(
+    params: dict,
+    draft_params: dict,
+    input_ids: jax.Array,
+    config: LlamaConfig,
+    draft_config: LlamaConfig,
+    max_new_tokens: int,
+    num_draft_tokens: int = 4,
+    max_len: Optional[int] = None,
+) -> jax.Array:
+    """Greedy speculative decoding with a small draft llama — output is
+    token-identical to ``generate(params, ..., temperature=0)`` but accepts
+    up to ``num_draft_tokens + 1`` tokens per target forward (see
+    ``models/generation.py speculative_generate_loop``).  Batch 1 only."""
+    from .generation import speculative_generate_loop
+
+    return speculative_generate_loop(
+        apply_cached, init_cache, params, config,
+        apply_cached, init_cache, draft_params, draft_config,
+        input_ids, max_new_tokens,
+        num_draft_tokens=num_draft_tokens, max_len=max_len,
+    )
+
+
 def generate_beam(
     params: dict,
     input_ids: jax.Array,

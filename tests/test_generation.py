@@ -458,3 +458,70 @@ def test_chunked_prefill_matches_one_shot():
                     prefill_chunk=chunk,
                 )
                 np.testing.assert_array_equal(np.asarray(one), np.asarray(chunked))
+
+
+# ---- speculative decoding -------------------------------------------------
+
+
+def test_speculative_matches_greedy_same_model():
+    """Draft == target: every proposal verifies, output must equal greedy."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(3), (1, 8), 0, cfg.vocab_size)
+    greedy = llama.generate(params, ids, cfg, max_new_tokens=12)
+    spec = llama.speculative_generate(params, params, ids, cfg, cfg, 12)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(spec))
+
+
+def test_speculative_matches_greedy_weak_draft():
+    """A differently-seeded (mostly disagreeing) draft: accepts are rare, the
+    correction path dominates — output must STILL equal target-only greedy."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    draft_params = llama.init_params(cfg, jax.random.key(99))
+    ids = jax.random.randint(jax.random.key(4), (1, 8), 0, cfg.vocab_size)
+    greedy = llama.generate(params, ids, cfg, max_new_tokens=15)
+    for gamma in (1, 3, 6):
+        spec = llama.speculative_generate(
+            params, draft_params, ids, cfg, cfg, 15, num_draft_tokens=gamma
+        )
+        np.testing.assert_array_equal(np.asarray(greedy), np.asarray(spec))
+
+
+def test_speculative_small_draft_geometry():
+    """The real use case: a shallower/narrower draft with the same vocab."""
+    cfg = _cfg()
+    draft_cfg = llama.LlamaConfig.tiny(dtype=jnp.float32, num_layers=1, hidden_size=32,
+                                       intermediate_size=64, num_heads=2, num_kv_heads=2)
+    assert draft_cfg.vocab_size == cfg.vocab_size
+    params = llama.init_params(cfg, jax.random.key(0))
+    draft_params = llama.init_params(draft_cfg, jax.random.key(1))
+    ids = jax.random.randint(jax.random.key(5), (1, 8), 0, cfg.vocab_size)
+    greedy = llama.generate(params, ids, cfg, max_new_tokens=12)
+    spec = llama.speculative_generate(params, draft_params, ids, cfg, draft_cfg, 12)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(spec))
+
+
+def test_speculative_jits_and_validates():
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(6), (1, 8), 0, cfg.vocab_size)
+    # The whole propose/verify/accept loop compiles into one program.
+    jitted = jax.jit(
+        lambda p, dp, i: llama.speculative_generate(p, dp, i, cfg, cfg, 6)
+    )
+    out = jitted(params, params, ids)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.asarray(llama.generate(params, ids, cfg, max_new_tokens=6))
+    )
+    with pytest.raises(ValueError, match="batch-1"):
+        llama.speculative_generate(
+            params, params, jnp.zeros((2, 4), jnp.int32), cfg, cfg, 4
+        )
+    with pytest.raises(ValueError, match="num_draft_tokens"):
+        llama.speculative_generate(params, params, ids, cfg, cfg, 4, num_draft_tokens=0)
+    with pytest.raises(ValueError, match="vocab"):
+        bad = llama.LlamaConfig.tiny(dtype=jnp.float32, vocab_size=128)
+        llama.speculative_generate(params, params, ids, cfg, bad, 4)
+    with pytest.raises(ValueError, match="max_len"):
+        llama.speculative_generate(params, params, ids, cfg, cfg, 8, max_len=16)
