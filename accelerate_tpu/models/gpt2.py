@@ -357,6 +357,30 @@ def generate(
     )
 
 
+def speculative_generate(
+    params: dict,
+    draft_params: dict,
+    input_ids: jax.Array,
+    config: GPT2Config,
+    draft_config: GPT2Config,
+    max_new_tokens: int,
+    num_draft_tokens: int = 4,
+    max_len=None,
+) -> jax.Array:
+    """Greedy speculative decoding (see ``models/generation.py``); output is
+    token-identical to ``generate(..., temperature=0)``.  Batch 1 only.
+    The cache slack (prompt + new + num_draft_tokens) must fit the position
+    table (``config.max_seq_len``)."""
+    from .generation import speculative_generate_loop
+
+    return speculative_generate_loop(
+        apply_cached, init_cache, params, config,
+        apply_cached, init_cache, draft_params, draft_config,
+        input_ids, max_new_tokens,
+        num_draft_tokens=num_draft_tokens, max_len=max_len,
+    )
+
+
 def generate_beam(
     params: dict,
     input_ids: jax.Array,

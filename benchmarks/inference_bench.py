@@ -28,7 +28,17 @@ def main():
     parser.add_argument("--kv_quant", action="store_true",
                         help="int8 KV cache (half the cache HBM; measures the "
                              "dequant-fused decode rate)")
+    parser.add_argument("--speculative", type=int, default=0, metavar="GAMMA",
+                        help="speculative decoding with a 2-layer draft of the "
+                             "same width proposing GAMMA tokens per round "
+                             "(batch forced to 1; output identical to greedy). "
+                             "NOTE: random weights never agree, so this measures "
+                             "the WORST-CASE overhead vs plain greedy — the "
+                             "all-reject floor; trained draft/target pairs sit "
+                             "between this and the (gamma+1)x ceiling")
     args = parser.parse_args()
+    if args.speculative and args.temperature > 0:
+        raise SystemExit("--speculative is greedy-only")
 
     import jax
     import numpy as np
@@ -52,11 +62,29 @@ def main():
     prompt = jax.numpy.asarray(prompt.astype(np.int32))
 
     key = jax.random.key(1) if args.temperature > 0 else None
-    gen = jax.jit(
-        lambda p, ids: llama.generate(
-            p, ids, cfg, max_new_tokens=args.new, temperature=args.temperature, key=key
+    if args.speculative:
+        # Latency mode: batch 1, small same-width draft, exact greedy output.
+        prompt = prompt[:1]
+        args.batch = 1
+        draft_cfg = llama.LlamaConfig(
+            vocab_size=cfg.vocab_size, hidden_size=args.hidden,
+            intermediate_size=4 * args.hidden, num_layers=2,
+            num_heads=cfg.num_heads, num_kv_heads=cfg.num_kv_heads,
+            max_seq_len=cfg.max_seq_len, remat=False, attention_impl="einsum",
         )
-    )
+        draft_params = llama.init_params(draft_cfg, jax.random.key(7))
+        gen = jax.jit(
+            lambda p, ids: llama.speculative_generate(
+                p, draft_params, ids, cfg, draft_cfg, args.new,
+                num_draft_tokens=args.speculative,
+            )
+        )
+    else:
+        gen = jax.jit(
+            lambda p, ids: llama.generate(
+                p, ids, cfg, max_new_tokens=args.new, temperature=args.temperature, key=key
+            )
+        )
 
     t0 = time.perf_counter()
     out = jax.device_get(gen(params, prompt))

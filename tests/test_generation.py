@@ -525,3 +525,15 @@ def test_speculative_jits_and_validates():
         llama.speculative_generate(params, params, ids, cfg, bad, 4)
     with pytest.raises(ValueError, match="max_len"):
         llama.speculative_generate(params, params, ids, cfg, cfg, 8, max_len=16)
+
+
+def test_speculative_gpt2_matches_greedy():
+    from accelerate_tpu.models import gpt2
+
+    cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32)
+    params = gpt2.init_params(cfg, jax.random.key(0))
+    draft_params = gpt2.init_params(cfg, jax.random.key(42))
+    ids = jax.random.randint(jax.random.key(8), (1, 8), 0, cfg.vocab_size)
+    greedy = gpt2.generate(params, ids, cfg, max_new_tokens=10)
+    spec = gpt2.speculative_generate(params, draft_params, ids, cfg, cfg, 10)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(spec))
