@@ -240,6 +240,7 @@ def speculative_generate_loop(
     max_new_tokens: int,
     num_draft_tokens: int = 4,
     max_len: Optional[int] = None,
+    return_stats: bool = False,
 ) -> jax.Array:
     """Greedy speculative decoding: a small draft model proposes ``γ =
     num_draft_tokens`` tokens autoregressively, the target verifies all of
@@ -266,6 +267,11 @@ def speculative_generate_loop(
     different accept counts would need per-row cache indices).  Greedy only
     — sampled acceptance (the Leviathan et al. rejection scheme) needs the
     draft's full distribution, not just its argmax.
+
+    ``return_stats=True`` additionally returns ``{"rounds", "proposed",
+    "accepted"}`` (int32 scalars): ``accepted / proposed`` is the draft
+    acceptance rate — the quantity that decides the real-world speedup
+    (``rounds`` target forwards produced ``accepted + rounds`` tokens).
     """
     b, s = input_ids.shape
     if b != 1:
@@ -308,7 +314,7 @@ def speculative_generate_loop(
         return carry[0] < max_new_tokens
 
     def body(carry):
-        n, last, t_cache, d_cache, buf = carry
+        n, last, t_cache, d_cache, buf, rounds, accepted = carry
 
         # Draft proposes γ tokens — a one-token cached step under lax.scan
         # (cache in the carry), so the draft forward compiles ONCE however
@@ -343,11 +349,15 @@ def speculative_generate_loop(
         # Rewind both caches to the accepted length (both wrote γ+1 rows).
         tc = {**tc, "index": tc["index"] - (gamma + 1) + count}
         dc = {**dc, "index": dc["index"] - (gamma + 1) + count}
-        return n + count, last, tc, dc, buf
+        return n + count, last, tc, dc, buf, rounds + 1, accepted + m
 
-    carry = (jnp.asarray(1, jnp.int32), first, t_cache, d_cache, buf)
-    _, _, _, _, buf = jax.lax.while_loop(cond, body, carry)
-    return jnp.concatenate([input_ids, buf[:, :max_new_tokens]], axis=1)
+    zero = jnp.asarray(0, jnp.int32)
+    carry = (jnp.asarray(1, jnp.int32), first, t_cache, d_cache, buf, zero, zero)
+    _, _, _, _, buf, rounds, accepted = jax.lax.while_loop(cond, body, carry)
+    out = jnp.concatenate([input_ids, buf[:, :max_new_tokens]], axis=1)
+    if return_stats:
+        return out, {"rounds": rounds, "proposed": rounds * gamma, "accepted": accepted}
+    return out
 
 
 def beam_search(

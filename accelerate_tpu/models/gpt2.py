@@ -366,6 +366,7 @@ def speculative_generate(
     max_new_tokens: int,
     num_draft_tokens: int = 4,
     max_len=None,
+    return_stats: bool = False,
 ) -> jax.Array:
     """Greedy speculative decoding (see ``models/generation.py``); output is
     token-identical to ``generate(..., temperature=0)``.  Batch 1 only.
@@ -378,6 +379,7 @@ def speculative_generate(
         apply_cached, init_cache, draft_params, draft_config,
         input_ids, max_new_tokens,
         num_draft_tokens=num_draft_tokens, max_len=max_len,
+        return_stats=return_stats,
     )
 
 

@@ -714,6 +714,7 @@ def speculative_generate(
     max_new_tokens: int,
     num_draft_tokens: int = 4,
     max_len: Optional[int] = None,
+    return_stats: bool = False,
 ) -> jax.Array:
     """Greedy speculative decoding with a small draft llama — output is
     token-identical to ``generate(params, ..., temperature=0)`` but accepts
@@ -726,6 +727,7 @@ def speculative_generate(
         apply_cached, init_cache, draft_params, draft_config,
         input_ids, max_new_tokens,
         num_draft_tokens=num_draft_tokens, max_len=max_len,
+        return_stats=return_stats,
     )
 
 

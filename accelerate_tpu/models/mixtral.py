@@ -404,6 +404,34 @@ def generate(
     )
 
 
+def speculative_generate(
+    params: dict,
+    draft_params: dict,
+    input_ids: jax.Array,
+    config: MixtralConfig,
+    draft_config,
+    max_new_tokens: int,
+    num_draft_tokens: int = 4,
+    max_len=None,
+    return_stats: bool = False,
+) -> jax.Array:
+    """Greedy speculative decoding (see ``models/generation.py``).  The
+    draft can be any family module with the same vocab — a dense llama
+    drafting for a Mixtral target is the classic cheap-draft pairing —
+    pass that family's ``apply_cached``/``init_cache`` via
+    ``speculative_generate_loop`` directly; this wrapper uses a (smaller)
+    Mixtral draft.  Batch 1 only."""
+    from .generation import speculative_generate_loop
+
+    return speculative_generate_loop(
+        apply_cached, init_cache, params, config,
+        apply_cached, init_cache, draft_params, draft_config,
+        input_ids, max_new_tokens,
+        num_draft_tokens=num_draft_tokens, max_len=max_len,
+        return_stats=return_stats,
+    )
+
+
 def generate_beam(
     params: dict,
     input_ids: jax.Array,

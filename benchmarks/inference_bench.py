@@ -76,7 +76,7 @@ def main():
         gen = jax.jit(
             lambda p, ids: llama.speculative_generate(
                 p, draft_params, ids, cfg, draft_cfg, args.new,
-                num_draft_tokens=args.speculative,
+                num_draft_tokens=args.speculative, return_stats=True,
             )
         )
     else:
@@ -86,30 +86,44 @@ def main():
             )
         )
 
+    stats = None
+
+    def _run():
+        nonlocal stats
+        res = gen(params, prompt)
+        if args.speculative:
+            res, stats = res
+            stats = jax.device_get(stats)
+        return jax.device_get(res)
+
     t0 = time.perf_counter()
-    out = jax.device_get(gen(params, prompt))
+    out = _run()
     compile_and_first = time.perf_counter() - t0
 
     runs = []
     for _ in range(3):
         t0 = time.perf_counter()
-        out = jax.device_get(gen(params, prompt))
+        out = _run()
         runs.append(time.perf_counter() - t0)
     dt = min(runs)
     new_tokens = args.batch * args.new
-    print(
-        json.dumps(
-            {
-                "metric": "generation_throughput",
-                "value": round(new_tokens / dt, 1),
-                "unit": "tokens/sec",
-                "s_per_token_per_seq": round(dt / args.new, 5),
-                "params": cfg.num_params(),
-                "first_call_s": round(compile_and_first, 2),
-                "out_shape": list(out.shape),
-            }
-        )
-    )
+    row = {
+        "metric": "generation_throughput",
+        "value": round(new_tokens / dt, 1),
+        "unit": "tokens/sec",
+        "s_per_token_per_seq": round(dt / args.new, 5),
+        "params": cfg.num_params(),
+        "first_call_s": round(compile_and_first, 2),
+        "out_shape": list(out.shape),
+    }
+    if stats is not None:
+        proposed = max(int(stats["proposed"]), 1)
+        row["speculative"] = {
+            "gamma": args.speculative,
+            "rounds": int(stats["rounds"]),
+            "accept_rate": round(int(stats["accepted"]) / proposed, 3),
+        }
+    print(json.dumps(row))
 
 
 if __name__ == "__main__":

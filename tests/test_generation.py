@@ -537,3 +537,38 @@ def test_speculative_gpt2_matches_greedy():
     greedy = gpt2.generate(params, ids, cfg, max_new_tokens=10)
     spec = gpt2.speculative_generate(params, draft_params, ids, cfg, cfg, 10)
     np.testing.assert_array_equal(np.asarray(greedy), np.asarray(spec))
+
+
+def test_speculative_stats():
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(9), (1, 8), 0, cfg.vocab_size)
+    # Same-model draft: every proposal verifies -> gamma accepted per round,
+    # gamma+1 tokens per round after the prefill token.
+    out, stats = llama.speculative_generate(
+        params, params, ids, cfg, cfg, 12, num_draft_tokens=4, return_stats=True
+    )
+    rounds, proposed, accepted = (int(stats[k]) for k in ("rounds", "proposed", "accepted"))
+    assert rounds == -(-11 // 5), stats  # ceil((12-1)/(gamma+1)) rounds
+    assert proposed == rounds * 4 and accepted == proposed, stats
+    assert accepted + rounds >= 11, stats  # tokens produced covers max_new-1
+    # Disagreeing draft: acceptance is rare, every round still nets >= 1.
+    draft = llama.init_params(cfg, jax.random.key(77))
+    _, stats = llama.speculative_generate(
+        params, draft, ids, cfg, cfg, 12, num_draft_tokens=4, return_stats=True
+    )
+    rounds, proposed, accepted = (int(stats[k]) for k in ("rounds", "proposed", "accepted"))
+    assert accepted < proposed and rounds <= 11, stats
+    assert accepted + rounds >= 11, stats
+
+
+def test_speculative_mixtral_matches_greedy():
+    from accelerate_tpu.models import mixtral
+
+    cfg = mixtral.MixtralConfig.tiny(dtype=jnp.float32)
+    params = mixtral.init_params(cfg, jax.random.key(0))
+    draft_params = mixtral.init_params(cfg, jax.random.key(5))
+    ids = jax.random.randint(jax.random.key(10), (1, 8), 0, cfg.vocab_size)
+    greedy = mixtral.generate(params, ids, cfg, max_new_tokens=8)
+    spec = mixtral.speculative_generate(params, draft_params, ids, cfg, cfg, 8)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(spec))
