@@ -477,3 +477,44 @@ def generate(
         max_new_tokens, temperature=temperature, key=key,
         top_k=top_k, top_p=top_p,
     )
+
+
+def speculative_generate(
+    params: dict,
+    draft_params: dict,
+    input_ids: jax.Array,
+    config: "T5Config",
+    draft_config: "T5Config",
+    max_new_tokens: int,
+    num_draft_tokens: int = 4,
+    decoder_start_token_id: int = 0,
+    attention_mask: Optional[jax.Array] = None,
+    return_stats: bool = False,
+) -> jax.Array:
+    """Greedy speculative seq2seq decoding: both models encode the source
+    once, then the draft decoder proposes and the target decoder verifies
+    (see ``models/generation.py speculative_generate_loop``).  Output is
+    token-identical to ``generate(..., temperature=0)``.  Batch 1 only."""
+    from .generation import speculative_generate_loop
+
+    c = config
+    b = input_ids.shape[0]
+    enc_out = encode(params, input_ids, c, attention_mask)
+    d_enc_out = encode(draft_params, input_ids, draft_config, attention_mask)
+
+    def _init_cache(cfg, batch_size, max_len):
+        return init_decoder_cache(params, enc_out, cfg, max_len)
+
+    def _apply_cached(p, ids, cfg, cache):
+        return decode_cached(p, ids, cfg, cache, attention_mask)
+
+    def _d_init_cache(cfg, batch_size, max_len):
+        return init_decoder_cache(draft_params, d_enc_out, cfg, max_len)
+
+    start = jnp.full((b, 1), decoder_start_token_id, jnp.int32)
+    return speculative_generate_loop(
+        _apply_cached, _init_cache, params, c,
+        _apply_cached, _d_init_cache, draft_params, draft_config,
+        start, max_new_tokens,
+        num_draft_tokens=num_draft_tokens, return_stats=return_stats,
+    )

@@ -572,3 +572,15 @@ def test_speculative_mixtral_matches_greedy():
     greedy = mixtral.generate(params, ids, cfg, max_new_tokens=8)
     spec = mixtral.speculative_generate(params, draft_params, ids, cfg, cfg, 8)
     np.testing.assert_array_equal(np.asarray(greedy), np.asarray(spec))
+
+
+def test_speculative_t5_matches_greedy():
+    from accelerate_tpu.models import t5
+
+    cfg = t5.T5Config.tiny(dtype=jnp.float32)
+    params = t5.init_params(cfg, jax.random.key(0))
+    draft_params = t5.init_params(cfg, jax.random.key(11))
+    src = jax.random.randint(jax.random.key(12), (1, 10), 0, cfg.vocab_size)
+    greedy = t5.generate(params, src, cfg, max_new_tokens=8)
+    spec = t5.speculative_generate(params, draft_params, src, cfg, cfg, 8)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(spec))
