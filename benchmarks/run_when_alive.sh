@@ -21,10 +21,18 @@ while true; do
   fi
   sleep 150
 done
+# Results land as repo artifacts directly: even if nobody is watching,
+# the round-end commit of uncommitted files preserves them.
 echo "[watcher] running big-model bench"
-python benchmarks/tpu_big_model_bench.py 2>&1 | tee /tmp/bigmodel_r05.jsonl
-echo "[watcher] big-model rc=${PIPESTATUS[0]}"
+python benchmarks/tpu_big_model_bench.py 2>&1 | tee /tmp/bigmodel_r05_raw.log |
+  grep '^{' > BENCH_big_model_tpu.json
+rc=${PIPESTATUS[0]}
+echo "[watcher] big-model rc=$rc"
+[ -s BENCH_big_model_tpu.json ] || rm -f BENCH_big_model_tpu.json
 echo "[watcher] running inference bench --kv_quant"
-python benchmarks/inference_bench.py --kv_quant 2>&1 | tee /tmp/infer_kvq_r05.jsonl
-echo "[watcher] inference rc=${PIPESTATUS[0]}"
+python benchmarks/inference_bench.py --kv_quant 2>&1 | tee /tmp/infer_kvq_r05_raw.log |
+  grep '^{' > BENCH_generation_kvq.json
+rc=${PIPESTATUS[0]}
+echo "[watcher] inference rc=$rc"
+[ -s BENCH_generation_kvq.json ] || rm -f BENCH_generation_kvq.json
 echo "[watcher] done"
