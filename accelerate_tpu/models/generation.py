@@ -241,18 +241,33 @@ def speculative_generate_loop(
     num_draft_tokens: int = 4,
     max_len: Optional[int] = None,
     return_stats: bool = False,
+    temperature: float = 0.0,
+    key: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Greedy speculative decoding: a small draft model proposes ``γ =
+    """Speculative decoding: a small draft model proposes ``γ =
     num_draft_tokens`` tokens autoregressively, the target verifies all of
     them (plus a bonus position) in ONE cached forward, and the longest
-    agreeing prefix is accepted — ``1..γ+1`` tokens per target forward
-    instead of exactly 1.  The output is **token-identical to greedy
-    decoding with the target alone** (every emitted token is either a
-    verified draft token or the target's own argmax), so the speedup is
-    free of quality risk.  Net-new vs the reference (no generation engine
+    accepted prefix lands — ``1..γ+1`` tokens per target forward instead
+    of exactly 1.  Net-new vs the reference (no generation engine
     upstream); the TPU angle is that the whole propose→verify→accept round
     — including the variable-length accept — is one ``lax.while_loop``
     with static shapes, compiled once.
+
+    Two modes, both distribution-exact w.r.t. the target alone:
+
+    - ``temperature <= 0`` (default) — greedy: a draft token is accepted
+      iff it equals the target's argmax; on mismatch the target's argmax
+      is emitted.  Output **token-identical to greedy decoding with the
+      target alone**.
+    - ``temperature > 0`` (needs ``key``) — the Leviathan/Chen rejection
+      scheme: draft token ``x`` (sampled from the draft's softmax ``q``)
+      is accepted with probability ``min(1, p(x)/q(x))`` against the
+      target's softmax ``p``; on rejection the replacement is sampled
+      from the residual ``normalize(max(p - q, 0))``, and a full accept
+      earns a bonus token sampled from ``p``.  Each emitted token is
+      **exactly distributed as target-only sampling** at this
+      temperature (the classic telescoping identity), so the speedup is
+      again free of quality risk.
 
     Cache bookkeeping: both caches keep the invariant "``index`` counts the
     tokens strictly before ``last`` (the newest emitted, not-yet-fed
@@ -264,9 +279,9 @@ def speculative_generate_loop(
     anything beyond ``index``.
 
     Batch 1 only (speculative decoding is a latency optimization; rows with
-    different accept counts would need per-row cache indices).  Greedy only
-    — sampled acceptance (the Leviathan et al. rejection scheme) needs the
-    draft's full distribution, not just its argmax.
+    different accept counts would need per-row cache indices).  ``top_k`` /
+    ``top_p`` are not supported here — filtering changes both distributions
+    and the residual algebra; use ``generate_loop`` for filtered sampling.
 
     ``return_stats=True`` additionally returns ``{"rounds", "proposed",
     "accepted"}`` (int32 scalars): ``accepted / proposed`` is the draft
@@ -279,6 +294,9 @@ def speculative_generate_loop(
             f"speculative decoding is batch-1 only (got batch {b}): rows with "
             "different accept counts would need per-row cache indices"
         )
+    sampled = temperature > 0.0
+    if sampled and key is None:
+        raise ValueError("sampled speculative decoding (temperature > 0) needs a PRNG key")
     gamma = int(num_draft_tokens)
     if gamma < 1:
         raise ValueError(f"num_draft_tokens must be >= 1, got {num_draft_tokens}")
@@ -305,7 +323,18 @@ def speculative_generate_loop(
     d_cache = draft_init_cache(draft_config, b, max_len)
     t_logits, t_cache = apply_cached(params, input_ids, config, t_cache)
     _, d_cache = draft_apply_cached(draft_params, input_ids, draft_config, d_cache)
-    first = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)  # [B]
+    if sampled:
+        # fp32 before the divide: the PROPOSAL distribution and the p/q used
+        # in acceptance must be computed from identical logits, or the
+        # rejection identity (and the exactness claim) silently breaks on
+        # bf16 models.
+        first = jax.random.categorical(
+            jax.random.fold_in(key, 0),
+            t_logits[:, -1].astype(jnp.float32) / temperature,
+            axis=-1,
+        ).astype(jnp.int32)
+    else:
+        first = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)  # [B]
 
     buf = jnp.zeros((b, max_new_tokens + gamma + 1), jnp.int32)
     buf = jax.lax.dynamic_update_slice(buf, first[:, None], (0, 0))
@@ -315,35 +344,75 @@ def speculative_generate_loop(
 
     def body(carry):
         n, last, t_cache, d_cache, buf, rounds, accepted = carry
+        # Per-round key stream, derived from the static base key and the
+        # round counter — deterministic, no key in the carry.
+        rkey = jax.random.fold_in(key, 1 + rounds) if sampled else None
 
         # Draft proposes γ tokens — a one-token cached step under lax.scan
         # (cache in the carry), so the draft forward compiles ONCE however
         # large γ is.  One extra feed (logits discarded) keeps the draft
         # cache covering d_γ so a full accept stays aligned.
-        def d_step(dcarry, _):
+        def d_step(dcarry, j):
             dc, tok = dcarry
             dl, dc = draft_apply_cached(draft_params, tok[:, None], draft_config, dc)
-            nxt = jnp.argmax(dl[:, -1], axis=-1).astype(jnp.int32)
-            return (dc, nxt), nxt
+            logits = dl[:, -1].astype(jnp.float32)  # [B, V]; fp32 so q == the
+            # distribution actually sampled (see the `first` comment)
+            if sampled:
+                nxt = jax.random.categorical(
+                    jax.random.fold_in(rkey, j), logits / temperature, axis=-1
+                ).astype(jnp.int32)
+            else:
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (dc, nxt), (nxt, logits)
 
-        (dc, tok), d_steps = jax.lax.scan(d_step, (d_cache, last), None, length=gamma)
+        (dc, tok), (d_steps, d_logits) = jax.lax.scan(
+            d_step, (d_cache, last), jnp.arange(gamma)
+        )
         _, dc = draft_apply_cached(draft_params, tok[:, None], draft_config, dc)
         d = jnp.moveaxis(d_steps, 0, 1)  # [γ, B] -> [B, γ]
 
-        # Target verifies [last, d_1..d_γ] in one forward: row j's argmax is
-        # the target's choice AFTER consuming seq[:, j].
+        # Target verifies [last, d_1..d_γ] in one forward: row j carries the
+        # target's distribution AFTER consuming seq[:, j].
         seq = jnp.concatenate([last[:, None], d], axis=1)  # [B, γ+1]
         t_logits, tc = apply_cached(params, seq, config, t_cache)
-        t = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [B, γ+1]
 
-        # m = longest prefix where the target agrees with the draft; the
-        # accepted chunk is [d_1..d_m, t_{m+1}] (correction on mismatch,
-        # bonus token on full accept) — count = m+1 tokens, uniformly.
-        match = (t[:, :gamma] == d).astype(jnp.int32)
-        m = jnp.sum(jnp.cumprod(match, axis=1), axis=1)[0]  # scalar; b == 1
+        if sampled:
+            # Rejection acceptance: keep d_j with prob min(1, p(d_j)/q(d_j)).
+            p = jax.nn.softmax(t_logits.astype(jnp.float32) / temperature, axis=-1)
+            q = jax.nn.softmax(
+                jnp.moveaxis(d_logits, 0, 1).astype(jnp.float32) / temperature, axis=-1
+            )  # [B, γ, V]
+            p_head = p[:, :gamma]
+            p_at_d = jnp.take_along_axis(p_head, d[..., None], axis=-1)[..., 0]
+            q_at_d = jnp.take_along_axis(q, d[..., None], axis=-1)[..., 0]
+            u = jax.random.uniform(jax.random.fold_in(rkey, gamma), (b, gamma))
+            accept = (u * jnp.maximum(q_at_d, 1e-30) < p_at_d).astype(jnp.int32)
+            m = jnp.sum(jnp.cumprod(accept, axis=1), axis=1)[0]  # scalar; b == 1
+            # Replacement at the stop position: residual normalize(max(p-q, 0))
+            # on a rejection, plain p on a full accept (bonus token).  A ~zero
+            # residual (p == q numerically) falls back to p — acceptance was
+            # then certain, so the branch is all but unreachable anyway.
+            resid = jnp.maximum(p_head - q, 0.0)
+            mass = jnp.sum(resid, axis=-1, keepdims=True)
+            resid = jnp.where(mass > 1e-9, resid, p_head)
+            dist = jnp.concatenate([resid, p[:, gamma:]], axis=1)  # [B, γ+1, V]
+            dist_m = jax.lax.dynamic_index_in_dim(dist, m, axis=1, keepdims=False)
+            fill = jax.random.categorical(
+                jax.random.fold_in(rkey, gamma + 1), jnp.log(dist_m + 1e-38), axis=-1
+            ).astype(jnp.int32)  # [B]
+            fill_col = jnp.broadcast_to(fill[:, None], (b, gamma + 1))
+        else:
+            # Greedy acceptance: d_j must equal the target argmax; the fill
+            # column is the target argmax itself (correction or bonus).
+            t = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [B, γ+1]
+            accept = (t[:, :gamma] == d).astype(jnp.int32)
+            m = jnp.sum(jnp.cumprod(accept, axis=1), axis=1)[0]  # scalar; b == 1
+            fill_col = t
+
+        # The accepted chunk is [d_1..d_m, fill] — count = m+1, uniformly.
         count = m + 1
         d_pad = jnp.concatenate([d, jnp.zeros((b, 1), jnp.int32)], axis=1)
-        chunk = jnp.where(jnp.arange(gamma + 1)[None, :] < m, d_pad, t)  # [B, γ+1]
+        chunk = jnp.where(jnp.arange(gamma + 1)[None, :] < m, d_pad, fill_col)
         buf = jax.lax.dynamic_update_slice(buf, chunk, (0, n))
         last = jax.lax.dynamic_index_in_dim(chunk, m, axis=1, keepdims=False)
         # Rewind both caches to the accepted length (both wrote γ+1 rows).

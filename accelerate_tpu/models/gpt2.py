@@ -367,11 +367,14 @@ def speculative_generate(
     num_draft_tokens: int = 4,
     max_len=None,
     return_stats: bool = False,
+    temperature: float = 0.0,
+    key=None,
 ) -> jax.Array:
-    """Greedy speculative decoding (see ``models/generation.py``); output is
-    token-identical to ``generate(..., temperature=0)``.  Batch 1 only.
-    The cache slack (prompt + new + num_draft_tokens) must fit the position
-    table (``config.max_seq_len``)."""
+    """Speculative decoding (see ``models/generation.py``): greedy by
+    default (token-identical to ``generate(..., temperature=0)``), or the
+    distribution-exact rejection-sampling mode with ``temperature>0`` +
+    ``key``.  Batch 1 only.  The cache slack (prompt + new +
+    num_draft_tokens) must fit the position table (``config.max_seq_len``)."""
     from .generation import speculative_generate_loop
 
     return speculative_generate_loop(
@@ -379,7 +382,7 @@ def speculative_generate(
         apply_cached, init_cache, draft_params, draft_config,
         input_ids, max_new_tokens,
         num_draft_tokens=num_draft_tokens, max_len=max_len,
-        return_stats=return_stats,
+        return_stats=return_stats, temperature=temperature, key=key,
     )
 
 

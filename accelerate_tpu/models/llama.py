@@ -715,10 +715,14 @@ def speculative_generate(
     num_draft_tokens: int = 4,
     max_len: Optional[int] = None,
     return_stats: bool = False,
+    temperature: float = 0.0,
+    key=None,
 ) -> jax.Array:
-    """Greedy speculative decoding with a small draft llama — output is
-    token-identical to ``generate(params, ..., temperature=0)`` but accepts
-    up to ``num_draft_tokens + 1`` tokens per target forward (see
+    """Speculative decoding with a small draft llama — up to
+    ``num_draft_tokens + 1`` tokens per target forward.  ``temperature<=0``
+    (default): output token-identical to ``generate(..., temperature=0)``;
+    ``temperature>0`` (pass ``key``): rejection-sampling mode,
+    distribution-exact w.r.t. target-only sampling (see
     ``models/generation.py speculative_generate_loop``).  Batch 1 only."""
     from .generation import speculative_generate_loop
 
@@ -727,7 +731,7 @@ def speculative_generate(
         apply_cached, init_cache, draft_params, draft_config,
         input_ids, max_new_tokens,
         num_draft_tokens=num_draft_tokens, max_len=max_len,
-        return_stats=return_stats,
+        return_stats=return_stats, temperature=temperature, key=key,
     )
 
 

@@ -414,9 +414,12 @@ def speculative_generate(
     num_draft_tokens: int = 4,
     max_len=None,
     return_stats: bool = False,
+    temperature: float = 0.0,
+    key=None,
 ) -> jax.Array:
-    """Greedy speculative decoding (see ``models/generation.py``).  The
-    draft can be any family module with the same vocab — a dense llama
+    """Speculative decoding (see ``models/generation.py``): greedy by
+    default, distribution-exact sampling with ``temperature>0`` + ``key``.
+    The draft can be any family module with the same vocab — a dense llama
     drafting for a Mixtral target is the classic cheap-draft pairing —
     pass that family's ``apply_cached``/``init_cache`` via
     ``speculative_generate_loop`` directly; this wrapper uses a (smaller)
@@ -428,7 +431,7 @@ def speculative_generate(
         apply_cached, init_cache, draft_params, draft_config,
         input_ids, max_new_tokens,
         num_draft_tokens=num_draft_tokens, max_len=max_len,
-        return_stats=return_stats,
+        return_stats=return_stats, temperature=temperature, key=key,
     )
 
 

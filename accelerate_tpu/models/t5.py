@@ -490,11 +490,14 @@ def speculative_generate(
     decoder_start_token_id: int = 0,
     attention_mask: Optional[jax.Array] = None,
     return_stats: bool = False,
+    temperature: float = 0.0,
+    key=None,
 ) -> jax.Array:
-    """Greedy speculative seq2seq decoding: both models encode the source
-    once, then the draft decoder proposes and the target decoder verifies
-    (see ``models/generation.py speculative_generate_loop``).  Output is
-    token-identical to ``generate(..., temperature=0)``.  Batch 1 only."""
+    """Speculative seq2seq decoding: both models encode the source once,
+    then the draft decoder proposes and the target decoder verifies (see
+    ``models/generation.py speculative_generate_loop``).  Greedy by default
+    (token-identical to ``generate(..., temperature=0)``); ``temperature>0``
+    + ``key`` runs the distribution-exact sampling mode.  Batch 1 only."""
     from .generation import speculative_generate_loop
 
     c = config
@@ -517,4 +520,5 @@ def speculative_generate(
         _apply_cached, _d_init_cache, draft_params, draft_config,
         start, max_new_tokens,
         num_draft_tokens=num_draft_tokens, return_stats=return_stats,
+        temperature=temperature, key=key,
     )

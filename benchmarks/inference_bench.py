@@ -37,8 +37,6 @@ def main():
                              "all-reject floor; trained draft/target pairs sit "
                              "between this and the (gamma+1)x ceiling")
     args = parser.parse_args()
-    if args.speculative and args.temperature > 0:
-        raise SystemExit("--speculative is greedy-only")
 
     import jax
     import numpy as np
@@ -77,6 +75,7 @@ def main():
             lambda p, ids: llama.speculative_generate(
                 p, draft_params, ids, cfg, draft_cfg, args.new,
                 num_draft_tokens=args.speculative, return_stats=True,
+                temperature=args.temperature, key=key,
             )
         )
     else:

@@ -584,3 +584,71 @@ def test_speculative_t5_matches_greedy():
     greedy = t5.generate(params, src, cfg, max_new_tokens=8)
     spec = t5.speculative_generate(params, draft_params, src, cfg, cfg, 8)
     np.testing.assert_array_equal(np.asarray(greedy), np.asarray(spec))
+
+
+def test_speculative_sampled_all_accept_same_model():
+    """Draft == target: p/q == 1, every proposal accepted; bonus every round."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(13), (1, 8), 0, cfg.vocab_size)
+    out, stats = llama.speculative_generate(
+        params, params, ids, cfg, cfg, 12, num_draft_tokens=4,
+        temperature=0.8, key=jax.random.key(3), return_stats=True,
+    )
+    assert out.shape == (1, 20)
+    assert np.all((np.asarray(out) >= 0) & (np.asarray(out) < cfg.vocab_size))
+    assert int(stats["accepted"]) == int(stats["proposed"]), stats
+    assert int(stats["rounds"]) == -(-11 // 5), stats
+
+
+def test_speculative_sampled_matches_target_distribution():
+    """The rejection scheme must sample EXACTLY the target's distribution:
+    empirical 2-token sequence frequencies (4096 keys, vmapped) vs the
+    directly computed P(t1) * P(t2 | t1) on an 8-vocab model."""
+    temp = 1.0
+    cfg = llama.LlamaConfig.tiny(
+        dtype=jnp.float32, vocab_size=8, hidden_size=16, intermediate_size=32,
+        num_layers=1, num_heads=2, num_kv_heads=2,
+    )
+    params = llama.init_params(cfg, jax.random.key(0))
+    draft = llama.init_params(cfg, jax.random.key(123))
+    ids = jax.random.randint(jax.random.key(14), (1, 4), 0, 8)
+
+    n_samples = 4096
+    keys = jax.random.split(jax.random.key(15), n_samples)
+    spec = jax.jit(jax.vmap(lambda k: llama.speculative_generate(
+        params, draft, ids, cfg, cfg, 2, num_draft_tokens=2,
+        temperature=temp, key=k,
+    )[0, 4:]))
+    pairs = np.asarray(spec(keys))  # [N, 2]
+    counts = np.zeros((8, 8))
+    np.add.at(counts, (pairs[:, 0], pairs[:, 1]), 1)
+    empirical = counts / n_samples
+
+    # Exact target distribution: P(t1) from the prompt, P(t2 | t1) per t1.
+    p1 = jax.nn.softmax(llama.apply(params, ids, cfg)[0, -1] / temp)
+    expected = np.zeros((8, 8))
+    for t1 in range(8):
+        ext = jnp.concatenate([ids, jnp.full((1, 1), t1, ids.dtype)], axis=1)
+        p2 = jax.nn.softmax(llama.apply(params, ext, cfg)[0, -1] / temp)
+        expected[t1] = float(p1[t1]) * np.asarray(p2)
+
+    tv = 0.5 * np.abs(empirical - expected).sum()
+    assert tv < 0.08, f"total variation vs target distribution: {tv:.3f}"
+    # Sanity: the DRAFT's distribution must be distinguishably different,
+    # and the sampler must NOT be following it.
+    q1 = jax.nn.softmax(llama.apply(draft, ids, cfg)[0, -1] / temp)
+    tv_models = 0.5 * float(jnp.abs(p1 - q1).sum())
+    assert tv_models > 0.15, "draft and target too similar for the check to bite"
+    emp1 = empirical.sum(axis=1)
+    tv_vs_draft = 0.5 * float(np.abs(emp1 - np.asarray(q1)).sum())
+    tv_vs_target = 0.5 * float(np.abs(emp1 - np.asarray(p1)).sum())
+    assert tv_vs_target < tv_vs_draft, (tv_vs_target, tv_vs_draft)
+
+
+def test_speculative_sampled_needs_key():
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(16), (1, 8), 0, cfg.vocab_size)
+    with pytest.raises(ValueError, match="PRNG key"):
+        llama.speculative_generate(params, params, ids, cfg, cfg, 4, temperature=0.7)
