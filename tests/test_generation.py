@@ -652,3 +652,15 @@ def test_speculative_sampled_needs_key():
     ids = jax.random.randint(jax.random.key(16), (1, 8), 0, cfg.vocab_size)
     with pytest.raises(ValueError, match="PRNG key"):
         llama.speculative_generate(params, params, ids, cfg, cfg, 4, temperature=0.7)
+
+
+def test_speculative_composes_with_int8_cache():
+    """Same per-row quantization in chunked and one-token writes -> the
+    greedy equivalence holds bit-for-bit under the int8 KV cache too."""
+    cfg = llama.LlamaConfig.tiny(dtype=jnp.float32, kv_cache_quant=True)
+    params = llama.init_params(cfg, jax.random.key(0))
+    draft = llama.init_params(cfg, jax.random.key(21))
+    ids = jax.random.randint(jax.random.key(20), (1, 8), 0, cfg.vocab_size)
+    greedy = llama.generate(params, ids, cfg, max_new_tokens=10)
+    spec = llama.speculative_generate(params, draft, ids, cfg, cfg, 10)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(spec))
