@@ -46,7 +46,7 @@ def _format_bytes(n: float) -> str:
 
 def _native_presets() -> dict:
     """name -> zero-cost config factory for the bundled model families."""
-    from ..models import gpt2, llama, mixtral, vit
+    from ..models import gpt2, llama, mixtral, resnet, vit
 
     return {
         "llama3-8b": llama.LlamaConfig.llama3_8b,
@@ -57,6 +57,9 @@ def _native_presets() -> dict:
         "gpt2-tiny": gpt2.GPT2Config.tiny,
         "vit-b-16": vit.ViTConfig.vit_base_16,
         "vit-l-16": vit.ViTConfig.vit_large_16,
+        "resnet50": resnet.ResNetConfig.resnet50,
+        "resnet101": resnet.ResNetConfig.resnet101,
+        "resnet18": resnet.ResNetConfig.resnet18,
     }
 
 
@@ -68,6 +71,10 @@ def _native_estimate(name: str):
         return None
     cfg = factory()
     total = cfg.num_params() * 4
+    if hasattr(cfg, "largest_block_f32_bytes"):
+        # Families with non-uniform blocks (conv stages) expose the exact
+        # number as a config-level hook, like num_params.
+        return total, cfg.largest_block_f32_bytes(), cfg
     # Largest single block: token embedding vs one decoder layer.  Vision
     # configs have no vocab; their biggest block is always a layer.
     embed = getattr(cfg, "vocab_size", 0) * cfg.hidden_size * 4

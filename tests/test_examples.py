@@ -65,6 +65,18 @@ def test_jax_native_vit_example():
     assert loss is not None and loss < 10.0
 
 
+def test_jax_native_resnet_example():
+    mod = _load(os.path.join(EXAMPLES, "jax_native", "resnet_train.py"), "resnet_train")
+    argv = sys.argv
+    sys.argv = ["resnet_train.py", "--dp", "4", "--fsdp", "2", "--steps", "4",
+                "--batch_size", "8", "--image_size", "32", "--width", "8"]
+    try:
+        loss = mod.main()
+    finally:
+        sys.argv = argv
+    assert loss is not None and loss < 10.0
+
+
 def test_complete_nlp_example_checkpoint_and_resume(tmp_path):
     mod = _load(os.path.join(EXAMPLES, "complete_nlp_example.py"), "complete_nlp_example")
     args = argparse.Namespace(
