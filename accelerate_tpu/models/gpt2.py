@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from .llama import _sp_active, cross_entropy, labels_and_weights
+from .llama import _dequant_layer, _sp_active, cross_entropy, labels_and_weights
 from .llama import sp_attention as _sp_attention
 from ..parallel.sharding import constrain as _constrain, embed_lookup as _embed_lookup
 
@@ -246,7 +246,8 @@ def apply_hidden(
     x = _constrain(x, act_spec)
 
     def body(carry, lp):
-        return _layer(carry, lp, c=c, mask=mask, kv_valid=kv_valid, act_spec=act_spec)
+        return _layer(carry, _dequant_layer(lp), c=c, mask=mask, kv_valid=kv_valid,
+                      act_spec=act_spec)
 
     if c.remat:
         body = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)
@@ -272,6 +273,17 @@ def loss_fn(params: dict, batch: dict, config: GPT2Config) -> jax.Array:
 # ---------------------------------------------------------------------------
 # KV-cache inference (shared driver: models/generation.py)
 # ---------------------------------------------------------------------------
+
+
+def quantize_weights(params: dict, block_size: int = 64) -> dict:
+    """int8-weight-resident storage for the stacked blocks (wte/wpe and
+    per-layer norms/biases stay full precision); see
+    ``llama.quantize_weights``."""
+    from ..utils.quantization import quantize_layer_stack
+
+    out = dict(params)
+    out["layers"] = quantize_layer_stack(params["layers"], block_size)
+    return out
 
 
 def init_cache(config: GPT2Config, batch_size: int, max_len: int) -> dict:
@@ -318,6 +330,7 @@ def apply_cached(
 
     def body(carry, xs):
         lp, ck, cv = xs
+        lp = _dequant_layer(lp)
         x = carry
         q, k, v = _qkv(x, lp, c)
         ck, k_full = cache_write(ck, k, index, c.dtype)

@@ -458,6 +458,30 @@ def _layer(carry, layer_params, *, config: LlamaConfig, mask, positions, act_spe
     return x, None
 
 
+def _dequant_layer(lp):
+    """Per-layer int8-weight hook: dequantize QuantizedArray leaves of a
+    scanned layer slice (see ``quantize_weights``); no-op on plain params."""
+    from ..utils.quantization import dequantize_layer_slice
+
+    return dequantize_layer_slice(lp)
+
+
+def quantize_weights(params: dict, block_size: int = 64) -> dict:
+    """int8-weight-resident storage: blockwise-quantize the stacked decoder
+    layers (embed / final_norm / lm_head and the per-layer norm scales stay
+    full precision).  The result drops HBM weight bytes ~2x and feeds every
+    ``apply*``/``generate*`` path unchanged — the scan bodies dequantize each
+    layer slice as it is consumed, which XLA fuses into the consuming
+    matmuls.  This is the single-chip answer for models whose bf16 weights
+    exceed HBM (reference frame: disk/cpu-offloaded big-model inference,
+    ``benchmarks/big_model_inference``)."""
+    from ..utils.quantization import quantize_layer_stack
+
+    out = dict(params)
+    out["layers"] = quantize_layer_stack(params["layers"], block_size)
+    return out
+
+
 def apply(
     params: dict,
     input_ids: jax.Array,
@@ -500,8 +524,8 @@ def apply_hidden(
 
     def body(carry, lp):
         return _layer(
-            carry, lp, config=c, mask=None, positions=positions, act_spec=act_spec,
-            kv_valid=kv_valid,
+            carry, _dequant_layer(lp), config=c, mask=None, positions=positions,
+            act_spec=act_spec, kv_valid=kv_valid,
         )
 
     if c.remat:
@@ -666,6 +690,7 @@ def apply_cached(
 
     def body(carry, xs):
         lp, ck, cv = xs
+        lp = _dequant_layer(lp)
         y, ck, cv = _attention_block_cached(carry, lp, c, ck, cv, index, positions)
         h = _rms_norm(y, lp["ln_mlp"], c.rms_eps)
         gate = jax.nn.silu(_mm(h, lp["w_gate"], c))

@@ -284,7 +284,8 @@ def apply_hidden(
 
     def body(carry, lp):
         return _layer(
-            carry, lp, config=c, mask=None, positions=positions, act_spec=act_spec,
+            carry, _llama._dequant_layer(lp), config=c, mask=None,
+            positions=positions, act_spec=act_spec,
             capacity=capacity, kv_valid=kv_valid,
         )
 
@@ -327,6 +328,20 @@ def loss_fn(params: dict, batch: dict, config: MixtralConfig) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
+def quantize_weights(params: dict, block_size: int = 64) -> dict:
+    """int8-weight-resident storage for the stacked MoE blocks — the expert
+    tensors ([L, E, d, f]) are the dominant bytes, making this the classic
+    MoE memory win.  The router stays full precision (its logits pick the
+    top-k experts; a near-tie flip from quantization error would change
+    outputs for ~1/f of the byte win), as do embed/lm_head/norms.  See
+    ``llama.quantize_weights``."""
+    from ..utils.quantization import quantize_layer_stack
+
+    out = dict(params)
+    out["layers"] = quantize_layer_stack(params["layers"], block_size, skip=("router",))
+    return out
+
+
 def init_cache(config: MixtralConfig, batch_size: int, max_len: int) -> dict:
     """Zeroed KV cache (same layout as llama: attention is shared code)."""
     from .generation import make_kv_cache
@@ -358,6 +373,7 @@ def apply_cached(
 
     def body(carry, xs):
         lp, ck, cv = xs
+        lp = _llama._dequant_layer(lp)
         y, ck, cv = _llama._attention_block_cached(carry, lp, c, ck, cv, index, positions)
         h = _llama._rms_norm(y, lp["ln_mlp"], c.rms_eps)
         ffn, _ = moe_ffn(

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.sharding import constrain as _constrain, embed_lookup as _embed_lookup
-from .llama import _rms_norm
+from .llama import _dequant_layer, _rms_norm
 
 __all__ = ["T5Config", "init_params", "apply", "loss_fn", "PARTITION_RULES", "param_specs"]
 
@@ -259,7 +259,7 @@ def apply_hidden(
 
     def dec_body(carry, lp):
         return _dec_layer(
-            carry, lp, c=c, bias=dec_bias, self_mask=self_mask,
+            carry, _dequant_layer(lp), c=c, bias=dec_bias, self_mask=self_mask,
             enc_out=enc_out, cross_mask=cross_mask, act_spec=act_spec,
         )
 
@@ -335,12 +335,25 @@ def encode(params: dict, input_ids: jax.Array, config: "T5Config",
         x = _constrain(x, act_spec)
 
     def enc_body(carry, lp):
-        return _enc_layer(carry, lp, c=c, bias=enc_bias, mask=enc_mask, act_spec=act_spec)
+        return _enc_layer(carry, _dequant_layer(lp), c=c, bias=enc_bias, mask=enc_mask,
+                          act_spec=act_spec)
 
     if c.remat:
         enc_body = jax.checkpoint(enc_body, policy=jax.checkpoint_policies.nothing_saveable)
     x, _ = jax.lax.scan(enc_body, x, params["encoder"])
     return _rms_norm(x, params["enc_final_ln"], c.rms_eps)
+
+
+def quantize_weights(params: dict, block_size: int = 64) -> dict:
+    """int8-weight-resident storage for both stacks (encoder + decoder);
+    shared embedding, rel-bias tables and norms stay full precision.  See
+    ``llama.quantize_weights``."""
+    from ..utils.quantization import quantize_layer_stack
+
+    out = dict(params)
+    out["encoder"] = quantize_layer_stack(params["encoder"], block_size)
+    out["decoder"] = quantize_layer_stack(params["decoder"], block_size)
+    return out
 
 
 def init_decoder_cache(params: dict, enc_out: jax.Array, config: "T5Config", max_len: int) -> dict:
@@ -350,6 +363,7 @@ def init_decoder_cache(params: dict, enc_out: jax.Array, config: "T5Config", max
     hd, nh = c.head_dim, c.num_heads
 
     def cross_kv(lp):
+        lp = _dequant_layer(lp)
         k = (enc_out @ lp["cross_wk"].astype(c.dtype)).reshape(b, s, nh, hd)
         v = (enc_out @ lp["cross_wv"].astype(c.dtype)).reshape(b, s, nh, hd)
         return k, v
@@ -402,6 +416,7 @@ def decode_cached(
 
     def body(carry, xs):
         lp, ck, cv, xk, xv = xs
+        lp = _dequant_layer(lp)
         x = carry
         # Self-attention against the cache (plain or int8 via cache_write).
         h = _rms_norm(x, lp["ln_attn"], c.rms_eps)

@@ -16,6 +16,7 @@ flow through ``jax.jit``/``device_put``/checkpointing unchanged.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import re
 from typing import Any, Optional
 
@@ -165,6 +166,17 @@ def quantize_blockwise_4bit(
 
 def dequantize(q: QuantizedArray) -> jax.Array:
     n = int(np.prod(q.shape))
+    if q.qtype == "int8" and getattr(q.data, "ndim", 1) == 3:
+        # Stacked layer store from quantize_layer_stack: data [L, n_blocks,
+        # block], scales [L, n_blocks], shape = per-layer shape.  Dequantize
+        # the whole stack to [L, *shape] (per-layer slices arrive 2-D via
+        # lax.scan and take the branch below).
+        L = q.data.shape[0]
+        flat = q.data.astype(jnp.float32)
+        vals = flat * (q.scales[:, :, None] / 127.0)
+        return (
+            vals.reshape(L, -1)[:, :n].reshape((L, *q.shape)).astype(q.out_dtype)
+        )
     if q.qtype == "int8":
         flat = q.data.astype(jnp.float32).reshape(-1, q.block_size)
         vals = flat * (q.scales[:, None] / 127.0)
@@ -175,6 +187,62 @@ def dequantize(q: QuantizedArray) -> jax.Array:
         idx = jnp.stack([hi, lo], axis=1).reshape(-1)
         vals = code[idx].reshape(-1, q.block_size) * q.scales[:, None]
     return vals.reshape(-1)[:n].reshape(q.shape).astype(q.out_dtype)
+
+
+def quantize_layer_stack(
+    stacked: Any,
+    block_size: int = 64,
+    out_dtype=jnp.bfloat16,
+    skip: tuple = (),
+) -> Any:
+    """Quantize a stacked per-layer parameter tree (leaves ``[L, ...]``) so a
+    decode ``lax.scan`` can slice it.
+
+    Codes keep the leading layer dim (``[L, n_blocks, block]`` int8, scales
+    ``[L, n_blocks]``) — both are QuantizedArray *children*, so ``lax.scan``
+    over the tree slices layer ``l`` and tree_unflatten reconstructs a
+    per-layer QuantizedArray whose ``dequantize()`` yields the ``[...rest]``
+    weight; ``dequantize`` on the whole stack returns ``[L, ...rest]``.
+    Leaves whose per-layer rank is < 2 — stacked norm scales and biases —
+    stay full precision, as do leaves named in ``skip`` (quality-critical
+    small tensors, e.g. an MoE router).  The per-leaf quantization is
+    jitted so XLA writes int8 codes directly instead of materializing fp32
+    transients next to device-resident params."""
+
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def quant_one(leaf, pad):
+        L = leaf.shape[0]
+        flat = leaf.astype(jnp.float32).reshape(L, -1)
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((L, pad), jnp.float32)], axis=1)
+        blocks = flat.reshape(L, -1, block_size)
+        absmax = jnp.maximum(jnp.max(jnp.abs(blocks), axis=2), 1e-12)  # [L, n_blocks]
+        codes = jnp.clip(
+            jnp.round(blocks / absmax[:, :, None] * 127.0), -127, 127
+        ).astype(jnp.int8)
+        return codes, absmax
+
+    def one(kp, leaf):
+        name = str(getattr(kp[-1], "key", kp[-1]))
+        if name in skip or not hasattr(leaf, "ndim") or leaf.ndim < 3:
+            return leaf
+        rest = tuple(leaf.shape[1:])
+        n = int(np.prod(rest))
+        codes, absmax = quant_one(leaf, (-n) % block_size)
+        return QuantizedArray(codes, absmax, rest, "int8", block_size, out_dtype)
+
+    return jax.tree_util.tree_map_with_path(one, stacked)
+
+
+def dequantize_layer_slice(layer_tree: Any) -> Any:
+    """Dequantize the QuantizedArray leaves of one scanned layer slice,
+    passing everything else through — the hook a family's scan body calls
+    first when running int8-weight-resident."""
+    return jax.tree_util.tree_map(
+        lambda v: v.dequantize() if isinstance(v, QuantizedArray) else v,
+        layer_tree,
+        is_leaf=lambda v: isinstance(v, QuantizedArray),
+    )
 
 
 def quantize_array(x, config: BnbQuantizationConfig, out_dtype=jnp.bfloat16) -> QuantizedArray:

@@ -235,3 +235,133 @@ def test_generate_quality_quantized():
     # nf4 is lossy; on a confident model greedy tokens still agree.
     agreement = (full == quant).mean()
     assert agreement >= 0.9, (agreement, full, quant)
+
+
+def test_int8_layer_stack_decode_parity():
+    """int8-weight-resident decode (``llama.quantize_weights``): the scanned
+    per-layer dequant path is bit-identical to explicitly dequantizing every
+    layer slice and running dense, and ``generate`` is token-identical.
+    Norm scales (per-layer rank < 2) stay full precision."""
+    from accelerate_tpu.utils.quantization import quantize_layer_stack
+
+    cfg = llama.LlamaConfig.tiny(param_dtype=jnp.float32, dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.key(0))
+    qparams = llama.quantize_weights(params, block_size=64)
+
+    assert isinstance(qparams["layers"]["wq"], QuantizedArray)
+    assert qparams["layers"]["ln_attn"] is params["layers"]["ln_attn"]
+    # Codes keep the leading layer dim so lax.scan slices them.
+    L = cfg.num_layers
+    assert qparams["layers"]["wq"].data.shape[0] == L
+    assert qparams["layers"]["wq"].scales.shape[0] == L
+
+    pd = dict(params)
+    pd["layers"] = _dense_from_q(qparams["layers"])
+    # Whole-stack dequantize agrees with the explicit per-slice loop
+    # (dequantize_params round-trip contract on quantize_weights outputs).
+    np.testing.assert_array_equal(
+        np.asarray(qparams["layers"]["wq"].dequantize()),
+        np.asarray(pd["layers"]["wq"]),
+    )
+    ids = np.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 12)), np.int32
+    )
+    lq = llama.apply(qparams, jnp.asarray(ids), cfg)
+    ld = llama.apply(pd, jnp.asarray(ids), cfg)
+    assert float(jnp.abs(lq - ld).max()) == 0.0
+
+    outq = np.asarray(llama.generate(qparams, ids, cfg, max_new_tokens=6))
+    outd = np.asarray(llama.generate(pd, ids, cfg, max_new_tokens=6))
+    assert (outq == outd).all()
+
+    # Quantization error vs the original weights stays small.
+    l0 = llama.apply(params, jnp.asarray(ids), cfg)
+    assert float(jnp.abs(lq - l0).max()) < 0.25
+
+    # Storage: int8 codes ~halve the bf16 stack (fp32 here, so ~4x).
+    q = qparams["layers"]["w_gate"]
+    assert q.data.dtype == jnp.int8
+    stored = q.data.nbytes + q.scales.nbytes
+    assert stored < params["layers"]["w_gate"].nbytes / 2
+
+
+def test_int8_layer_stack_composes_with_quantized_kv_cache():
+    """int8 weights x int8 KV cache: both decode-side quantizations at once,
+    greedy-token-identical to the explicit-dequant dense model under the
+    same int8 cache (the weight path must be exactly equivalent whatever
+    the cache does)."""
+    cfg = llama.LlamaConfig.tiny(param_dtype=jnp.float32, dtype=jnp.float32,
+                                 kv_cache_quant=True)
+    params = llama.init_params(cfg, jax.random.key(0))
+    qparams = llama.quantize_weights(params, block_size=64)
+    pd = dict(params)
+    pd["layers"] = _dense_from_q(qparams["layers"])
+    ids = np.asarray(
+        np.random.default_rng(1).integers(0, cfg.vocab_size, (2, 8)), np.int32
+    )
+    out_q = np.asarray(llama.generate(qparams, ids, cfg, max_new_tokens=5))
+    out_d = np.asarray(llama.generate(pd, ids, cfg, max_new_tokens=5))
+    assert out_q.shape == out_d.shape == (2, 13)
+    assert (out_q == out_d).all()
+
+
+def _dense_from_q(qstack):
+    """Explicitly dequantize every layer slice of a quantized stack."""
+    out = {}
+    for k, v in qstack.items():
+        if isinstance(v, QuantizedArray):
+            out[k] = jnp.stack([
+                QuantizedArray(v.data[l], v.scales[l], v.shape, v.qtype,
+                               v.block_size, v.out_dtype).dequantize()
+                for l in range(v.data.shape[0])
+            ])
+        else:
+            out[k] = v
+    return out
+
+
+@pytest.mark.parametrize("family", ["gpt2", "mixtral", "t5"])
+def test_int8_layer_stack_all_families(family):
+    """Every decoder family runs int8-weight-resident bit-identically to the
+    explicit-dequant dense model (forward logits and greedy generate)."""
+    from accelerate_tpu.models import gpt2, mixtral, t5
+
+    rng = np.random.default_rng(7)
+    if family == "gpt2":
+        cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
+        params = gpt2.init_params(cfg, jax.random.key(0))
+        qp = gpt2.quantize_weights(params)
+        pd = dict(params); pd["layers"] = _dense_from_q(qp["layers"])
+        ids = np.asarray(rng.integers(0, cfg.vocab_size, (2, 10)), np.int32)
+        lq = gpt2.apply(qp, jnp.asarray(ids), cfg)
+        ld = gpt2.apply(pd, jnp.asarray(ids), cfg)
+        outq = np.asarray(gpt2.generate(qp, ids, cfg, max_new_tokens=4))
+        outd = np.asarray(gpt2.generate(pd, ids, cfg, max_new_tokens=4))
+    elif family == "mixtral":
+        cfg = mixtral.MixtralConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
+        params = mixtral.init_params(cfg, jax.random.key(0))
+        qp = mixtral.quantize_weights(params)
+        # The router must stay full precision (expert selection is
+        # quantization-sensitive for ~1/f of the byte win).
+        assert not isinstance(qp["layers"]["router"], QuantizedArray)
+        pd = dict(params); pd["layers"] = _dense_from_q(qp["layers"])
+        ids = np.asarray(rng.integers(0, cfg.vocab_size, (2, 10)), np.int32)
+        lq, _ = mixtral.apply(qp, jnp.asarray(ids), cfg)
+        ld, _ = mixtral.apply(pd, jnp.asarray(ids), cfg)
+        outq = np.asarray(mixtral.generate(qp, ids, cfg, max_new_tokens=4))
+        outd = np.asarray(mixtral.generate(pd, ids, cfg, max_new_tokens=4))
+    else:
+        cfg = t5.T5Config.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
+        params = t5.init_params(cfg, jax.random.key(0))
+        qp = t5.quantize_weights(params)
+        pd = dict(params)
+        pd["encoder"] = _dense_from_q(qp["encoder"])
+        pd["decoder"] = _dense_from_q(qp["decoder"])
+        ids = np.asarray(rng.integers(0, cfg.vocab_size, (2, 10)), np.int32)
+        dec = np.asarray(rng.integers(0, cfg.vocab_size, (2, 6)), np.int32)
+        lq = t5.apply(qp, jnp.asarray(ids), jnp.asarray(dec), cfg)
+        ld = t5.apply(pd, jnp.asarray(ids), jnp.asarray(dec), cfg)
+        outq = np.asarray(t5.generate(qp, ids, cfg, max_new_tokens=4))
+        outd = np.asarray(t5.generate(pd, ids, cfg, max_new_tokens=4))
+    assert float(jnp.abs(lq - ld).max()) == 0.0
+    assert (outq == outd).all()

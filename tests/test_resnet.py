@@ -101,12 +101,17 @@ def test_sharded_matches_dense():
     )(params, stats, batch)
     state = AcceleratorState(parallelism_config=ParallelismConfig(fsdp=4, tp=2))
     sp = shard_params(params, state.mesh, resnet.param_specs(cfg))
+    # stats too — a single-device-committed tree would collide with the
+    # mesh-context jit depending on test order.
+    sr = jax.device_put(
+        stats, jax.sharding.NamedSharding(state.mesh, jax.sharding.PartitionSpec())
+    )
     sb = {
         "pixel_values": jax.device_put(batch["pixel_values"], data_sharding(state.mesh)),
         "labels": jax.device_put(batch["labels"], data_sharding(state.mesh)),
     }
     sl, _ = jax.jit(lambda p, s, b: resnet.classification_loss_fn(p, s, b, cfg))(
-        sp, stats, sb
+        sp, sr, sb
     )
     assert abs(float(dense) - float(sl)) < 1e-4, (float(dense), float(sl))
 
