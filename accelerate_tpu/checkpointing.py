@@ -171,7 +171,10 @@ def save_model_weights(
     return index_path
 
 
-def load_model_weights(model, input_dir, weights_name: str = WEIGHTS_NAME):
+def read_safetensors_state_dict(input_dir, weights_name: str = WEIGHTS_NAME):
+    """Resolve ``{weights_name}.index.json`` shards or the single file into
+    one numpy state dict; ``None`` if neither exists.  Shared by the
+    checkpoint loader and ``models/hf_import.load_hf_checkpoint``."""
     path = os.path.join(input_dir, weights_name)
     index_path = f"{path}.index.json"
     if os.path.exists(index_path):
@@ -182,11 +185,17 @@ def load_model_weights(model, input_dir, weights_name: str = WEIGHTS_NAME):
         state_dict = {}
         for fname in sorted(set(weight_map.values())):
             state_dict.update(load_file(os.path.join(input_dir, fname)))
-    elif os.path.exists(path):
+        return state_dict
+    if os.path.exists(path):
         from safetensors.numpy import load_file
 
-        state_dict = load_file(path)
-    else:
+        return load_file(path)
+    return None
+
+
+def load_model_weights(model, input_dir, weights_name: str = WEIGHTS_NAME):
+    state_dict = read_safetensors_state_dict(input_dir, weights_name)
+    if state_dict is None:
         stem = weights_name.rsplit(".", 1)[0]
         with open(os.path.join(input_dir, f"{stem}.pkl"), "rb") as f:
             state_dict = pickle.load(f)

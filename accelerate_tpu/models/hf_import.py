@@ -40,7 +40,7 @@ import numpy as np
 
 import jax.numpy as jnp
 
-__all__ = ["config_from_hf", "import_state_dict", "from_hf"]
+__all__ = ["config_from_hf", "import_state_dict", "from_hf", "load_hf_checkpoint"]
 
 
 def _np(t) -> np.ndarray:
@@ -486,7 +486,10 @@ _PREFIXES = {
 class _RecordingDict(dict):
     """Tracks which checkpoint keys an importer actually read, so silently
     dropped tensors (attention biases, extra heads, gated-MLP halves…)
-    become a loud error instead of a wrong model."""
+    become a loud error instead of a wrong model.  Reads also *release* the
+    source tensor (each weight is read exactly once), so the checkpoint dict
+    shrinks as the staging pytree grows — peak host memory stays ~one model
+    copy plus the tensor in flight, not checkpoint + full staging tree."""
 
     def __init__(self, *a, **kw):
         super().__init__(*a, **kw)
@@ -494,12 +497,17 @@ class _RecordingDict(dict):
 
     def __getitem__(self, k):
         self.consumed.add(k)
-        return super().__getitem__(k)
+        v = super().__getitem__(k)
+        super().__delitem__(k)
+        return v
 
     def get(self, k, default=None):
         if super().__contains__(k):
             self.consumed.add(k)
-        return super().get(k, default)
+            v = super().__getitem__(k)
+            super().__delitem__(k)
+            return v
+        return default
 
 
 # Buffers transformers serializes that carry no weights.
@@ -549,6 +557,54 @@ def import_state_dict(family: str, state_dict: dict, config, strict: bool = True
 
     cast_inplace(params)
     return params
+
+
+def load_hf_checkpoint(path: str, strict: bool = True, **config_overrides):
+    """Load an HF checkpoint directory directly from disk ->
+    ``(family, native_config, native_params)``.
+
+    Reads ``config.json`` plus ``model.safetensors`` (or the
+    ``model.safetensors.index.json`` shard index / legacy
+    ``pytorch_model.bin``) without instantiating a torch module — at 7B+
+    the torch model would double host memory for nothing.  Mirrors the
+    reference's shard-streaming loader
+    (``utils/modeling.py load_checkpoint_in_model``) for the native
+    families."""
+    import json
+    import os
+
+    with open(os.path.join(path, "config.json")) as f:
+        raw = json.load(f)
+    # config.json serializes id2label, not num_labels — derive it, or the
+    # bert/vit classifier silently defaults to 2 labels.
+    if "num_labels" not in raw and isinstance(raw.get("id2label"), dict):
+        raw["num_labels"] = len(raw["id2label"])
+
+    class _Cfg:
+        def __init__(self, d):
+            self.__dict__.update(d)
+
+        def __getattr__(self, name):  # missing keys -> AttributeError
+            raise AttributeError(name)
+
+    hf_config = _Cfg(raw)
+    family = _detect_family(hf_config)
+    cfg = config_from_hf(hf_config, **config_overrides)
+
+    from ..checkpointing import read_safetensors_state_dict
+
+    sd = read_safetensors_state_dict(path, "model.safetensors")
+    if sd is None:
+        legacy = os.path.join(path, "pytorch_model.bin")
+        if os.path.exists(legacy):
+            import torch
+
+            sd = torch.load(legacy, map_location="cpu", weights_only=True)
+        else:
+            raise FileNotFoundError(
+                f"No model.safetensors(.index.json) or pytorch_model.bin in {path}"
+            )
+    return family, cfg, import_state_dict(family, sd, cfg, strict=strict)
 
 
 def from_hf(model, **config_overrides):

@@ -230,3 +230,53 @@ def test_llama_explicit_head_dim_passthrough():
         ref = hf(torch.from_numpy(ids).long()).logits.numpy()
     ours = np.asarray(llama.apply(params, jnp.asarray(ids), cfg))
     np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_load_hf_checkpoint_from_disk(tmp_path):
+    """Disk path: save_pretrained -> load_hf_checkpoint without a torch
+    module round-trip, single-file and sharded safetensors."""
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=96, n_embd=48, n_layer=2, n_head=4, n_positions=64,
+    )
+    torch.manual_seed(9)
+    hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    ids = _ids(96, (2, 7))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids).long()).logits.numpy()
+
+    single = tmp_path / "single"
+    hf.save_pretrained(single)
+    family, cfg, params = hf_import.load_hf_checkpoint(
+        str(single), dtype=jnp.float32, param_dtype=jnp.float32
+    )
+    assert family == "gpt2"
+    ours = np.asarray(gpt2.apply(params, jnp.asarray(ids), cfg))
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+    sharded = tmp_path / "sharded"
+    hf.save_pretrained(sharded, max_shard_size="100KB")
+    import os
+    assert os.path.exists(sharded / "model.safetensors.index.json")
+    family, cfg, params = hf_import.load_hf_checkpoint(
+        str(sharded), dtype=jnp.float32, param_dtype=jnp.float32
+    )
+    ours = np.asarray(gpt2.apply(params, jnp.asarray(ids), cfg))
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_load_hf_checkpoint_num_labels_from_id2label(tmp_path):
+    """config.json serializes id2label, not num_labels — the disk loader
+    must derive the label count (silently defaulting to 2 was a bug)."""
+    hf_cfg = transformers.BertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=32, type_vocab_size=2, num_labels=3,
+    )
+    torch.manual_seed(10)
+    hf = transformers.BertForSequenceClassification(hf_cfg).eval()
+    hf.save_pretrained(tmp_path / "m")
+    family, cfg, params = hf_import.load_hf_checkpoint(
+        str(tmp_path / "m"), dtype=jnp.float32, param_dtype=jnp.float32
+    )
+    assert cfg.num_labels == 3
+    assert params["classifier"]["w"].shape == (32, 3)
