@@ -1,1 +1,1 @@
-from . import bert, gpt2, hf_import, llama, mixtral, resnet, t5, vit
+from . import bert, gpt2, hf_export, hf_import, llama, mixtral, resnet, t5, vit

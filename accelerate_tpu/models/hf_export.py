@@ -1,0 +1,373 @@
+"""HF-checkpoint export: native param trees -> transformers-loadable
+checkpoints.
+
+The inverse of ``hf_import``: after training or quant-aware work on the
+native families, write a ``config.json`` + ``model.safetensors`` directory
+that ``transformers.AutoModel*.from_pretrained`` loads directly — the
+interop contract that lets work leave this framework as easily as it
+enters (reference frame: every reference workflow ends in
+``save_pretrained``; ``accelerator.save_model`` keeps torch modules in the
+HF layout, and this does the same for native pytrees).
+
+Oracles (``tests/test_hf_export.py``): transformers loads the exported
+directory and its forward matches the native logits; import(export(x))
+round-trips bit-exactly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+import jax
+
+__all__ = ["export_state_dict", "export_hf_checkpoint"]
+
+
+def _np32(a) -> np.ndarray:
+    return np.asarray(jax.device_get(a), np.float32)
+
+
+def _unstack(tree_leaf, fmt: str, out: dict, transpose: bool = False):
+    a = _np32(tree_leaf)
+    for i in range(a.shape[0]):
+        out[fmt.format(i)] = a[i].T.copy() if transpose else a[i].copy()
+
+
+def _export_llama(params: dict, cfg) -> dict:
+    sd: dict = {"model.embed_tokens.weight": _np32(params["embed"])}
+    lay = params["layers"]
+    pre = "model.layers.{}."
+    _unstack(lay["wq"], pre + "self_attn.q_proj.weight", sd, transpose=True)
+    _unstack(lay["wk"], pre + "self_attn.k_proj.weight", sd, transpose=True)
+    _unstack(lay["wv"], pre + "self_attn.v_proj.weight", sd, transpose=True)
+    _unstack(lay["wo"], pre + "self_attn.o_proj.weight", sd, transpose=True)
+    _unstack(lay["w_gate"], pre + "mlp.gate_proj.weight", sd, transpose=True)
+    _unstack(lay["w_up"], pre + "mlp.up_proj.weight", sd, transpose=True)
+    _unstack(lay["w_down"], pre + "mlp.down_proj.weight", sd, transpose=True)
+    _unstack(lay["ln_attn"], pre + "input_layernorm.weight", sd)
+    _unstack(lay["ln_mlp"], pre + "post_attention_layernorm.weight", sd)
+    sd["model.norm.weight"] = _np32(params["final_norm"])
+    if "lm_head" in params:
+        sd["lm_head.weight"] = _np32(params["lm_head"]).T.copy()
+    return sd
+
+
+def _export_gpt2(params: dict, cfg) -> dict:
+    sd: dict = {
+        "transformer.wte.weight": _np32(params["wte"]),
+        "transformer.wpe.weight": _np32(params["wpe"]),
+        "transformer.ln_f.weight": _np32(params["final_ln_scale"]),
+        "transformer.ln_f.bias": _np32(params["final_ln_bias"]),
+    }
+    lay = params["layers"]
+    pre = "transformer.h.{}."
+    # Conv1D layout ([in, out]): no transpose on export either.
+    _unstack(lay["w_qkv"], pre + "attn.c_attn.weight", sd)
+    _unstack(lay["b_qkv"], pre + "attn.c_attn.bias", sd)
+    _unstack(lay["w_proj"], pre + "attn.c_proj.weight", sd)
+    _unstack(lay["b_proj"], pre + "attn.c_proj.bias", sd)
+    _unstack(lay["w_up"], pre + "mlp.c_fc.weight", sd)
+    _unstack(lay["b_up"], pre + "mlp.c_fc.bias", sd)
+    _unstack(lay["w_down"], pre + "mlp.c_proj.weight", sd)
+    _unstack(lay["b_down"], pre + "mlp.c_proj.bias", sd)
+    _unstack(lay["ln_attn_scale"], pre + "ln_1.weight", sd)
+    _unstack(lay["ln_attn_bias"], pre + "ln_1.bias", sd)
+    _unstack(lay["ln_mlp_scale"], pre + "ln_2.weight", sd)
+    _unstack(lay["ln_mlp_bias"], pre + "ln_2.bias", sd)
+    return sd
+
+
+def _split3(a: np.ndarray) -> tuple:
+    return np.split(a, 3, axis=-1)
+
+
+def _export_bert(params: dict, cfg) -> dict:
+    e = params["embeddings"]
+    sd: dict = {
+        "bert.embeddings.word_embeddings.weight": _np32(e["word"]),
+        "bert.embeddings.position_embeddings.weight": _np32(e["position"]),
+        "bert.embeddings.token_type_embeddings.weight": _np32(e["token_type"]),
+        "bert.embeddings.LayerNorm.weight": _np32(e["ln_scale"]),
+        "bert.embeddings.LayerNorm.bias": _np32(e["ln_bias"]),
+        "bert.pooler.dense.weight": _np32(params["pooler"]["w"]).T.copy(),
+        "bert.pooler.dense.bias": _np32(params["pooler"]["b"]),
+        "classifier.weight": _np32(params["classifier"]["w"]).T.copy(),
+        "classifier.bias": _np32(params["classifier"]["b"]),
+    }
+    lay = params["layers"]
+    pre = "bert.encoder.layer.{}."
+    wq = _np32(lay["w_qkv"])
+    bq = _np32(lay["b_qkv"])
+    for i in range(wq.shape[0]):
+        qw, kw, vw = _split3(wq[i])
+        qb, kb, vb = _split3(bq[i])
+        for n, w, b in (("query", qw, qb), ("key", kw, kb), ("value", vw, vb)):
+            sd[pre.format(i) + f"attention.self.{n}.weight"] = w.T.copy()
+            sd[pre.format(i) + f"attention.self.{n}.bias"] = b.copy()
+    _unstack(lay["w_proj"], pre + "attention.output.dense.weight", sd, transpose=True)
+    _unstack(lay["b_proj"], pre + "attention.output.dense.bias", sd)
+    _unstack(lay["w_up"], pre + "intermediate.dense.weight", sd, transpose=True)
+    _unstack(lay["b_up"], pre + "intermediate.dense.bias", sd)
+    _unstack(lay["w_down"], pre + "output.dense.weight", sd, transpose=True)
+    _unstack(lay["b_down"], pre + "output.dense.bias", sd)
+    _unstack(lay["ln_attn_scale"], pre + "attention.output.LayerNorm.weight", sd)
+    _unstack(lay["ln_attn_bias"], pre + "attention.output.LayerNorm.bias", sd)
+    _unstack(lay["ln_mlp_scale"], pre + "output.LayerNorm.weight", sd)
+    _unstack(lay["ln_mlp_bias"], pre + "output.LayerNorm.bias", sd)
+    return sd
+
+
+def _export_t5_stack(stack: dict, prefix: str, decoder: bool, out: dict):
+    pre = prefix + ".block.{}."
+    _unstack(stack["wq"], pre + "layer.0.SelfAttention.q.weight", out, transpose=True)
+    _unstack(stack["wk"], pre + "layer.0.SelfAttention.k.weight", out, transpose=True)
+    _unstack(stack["wv"], pre + "layer.0.SelfAttention.v.weight", out, transpose=True)
+    _unstack(stack["wo"], pre + "layer.0.SelfAttention.o.weight", out, transpose=True)
+    _unstack(stack["ln_attn"], pre + "layer.0.layer_norm.weight", out)
+    mlp = 2 if decoder else 1
+    if decoder:
+        _unstack(stack["cross_wq"], pre + "layer.1.EncDecAttention.q.weight", out, transpose=True)
+        _unstack(stack["cross_wk"], pre + "layer.1.EncDecAttention.k.weight", out, transpose=True)
+        _unstack(stack["cross_wv"], pre + "layer.1.EncDecAttention.v.weight", out, transpose=True)
+        _unstack(stack["cross_wo"], pre + "layer.1.EncDecAttention.o.weight", out, transpose=True)
+        _unstack(stack["ln_cross"], pre + "layer.1.layer_norm.weight", out)
+    _unstack(stack["w_up"], pre + f"layer.{mlp}.DenseReluDense.wi.weight", out, transpose=True)
+    _unstack(stack["w_down"], pre + f"layer.{mlp}.DenseReluDense.wo.weight", out, transpose=True)
+    _unstack(stack["ln_mlp"], pre + f"layer.{mlp}.layer_norm.weight", out)
+
+
+def _export_t5(params: dict, cfg) -> dict:
+    sd: dict = {
+        "shared.weight": _np32(params["shared_embed"]),
+        "encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight":
+            _np32(params["enc_rel_bias"]),
+        "decoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight":
+            _np32(params["dec_rel_bias"]),
+        "encoder.final_layer_norm.weight": _np32(params["enc_final_ln"]),
+        "decoder.final_layer_norm.weight": _np32(params["dec_final_ln"]),
+    }
+    _export_t5_stack(params["encoder"], "encoder", False, sd)
+    _export_t5_stack(params["decoder"], "decoder", True, sd)
+    return sd
+
+
+def _export_mixtral(params: dict, cfg) -> dict:
+    sd: dict = {"model.embed_tokens.weight": _np32(params["embed"])}
+    lay = params["layers"]
+    pre = "model.layers.{}."
+    _unstack(lay["wq"], pre + "self_attn.q_proj.weight", sd, transpose=True)
+    _unstack(lay["wk"], pre + "self_attn.k_proj.weight", sd, transpose=True)
+    _unstack(lay["wv"], pre + "self_attn.v_proj.weight", sd, transpose=True)
+    _unstack(lay["wo"], pre + "self_attn.o_proj.weight", sd, transpose=True)
+    _unstack(lay["router"], pre + "block_sparse_moe.gate.weight", sd, transpose=True)
+    for which, key in (("w1", "w_gate"), ("w3", "w_up"), ("w2", "w_down")):
+        a = _np32(lay[key])  # [L, E, in, out]
+        for i in range(a.shape[0]):
+            for j in range(a.shape[1]):
+                sd[
+                    f"model.layers.{i}.block_sparse_moe.experts.{j}.{which}.weight"
+                ] = a[i, j].T.copy()
+    _unstack(lay["ln_attn"], pre + "input_layernorm.weight", sd)
+    _unstack(lay["ln_mlp"], pre + "post_attention_layernorm.weight", sd)
+    sd["model.norm.weight"] = _np32(params["final_norm"])
+    sd["lm_head.weight"] = _np32(params["lm_head"]).T.copy()
+    return sd
+
+
+def _export_vit(params: dict, cfg) -> dict:
+    if cfg.pool != "cls":
+        raise ValueError(
+            "ViT export requires pool='cls': HF ViT always prepends a CLS "
+            "token, so a pool='mean' model (no cls token, num_patches "
+            "position slots) cannot be represented as a loadable HF "
+            "checkpoint."
+        )
+    e = params["embeddings"]
+    p, C = cfg.patch_size, cfg.num_channels
+    d = cfg.hidden_size
+    # Inverse of the import permutation: [p*p*C, d] -> conv [d, C, p, p].
+    conv = _np32(e["patch_w"]).reshape(p, p, C, d).transpose(3, 2, 0, 1).copy()
+    sd: dict = {
+        "vit.embeddings.patch_embeddings.projection.weight": conv,
+        "vit.embeddings.patch_embeddings.projection.bias": _np32(e["patch_b"]),
+        "vit.embeddings.position_embeddings": _np32(e["position"])[None],
+        "vit.layernorm.weight": _np32(params["final_ln"]["scale"]),
+        "vit.layernorm.bias": _np32(params["final_ln"]["bias"]),
+        "classifier.weight": _np32(params["classifier"]["w"]).T.copy(),
+        "classifier.bias": _np32(params["classifier"]["b"]),
+    }
+    if cfg.pool == "cls":
+        sd["vit.embeddings.cls_token"] = _np32(e["cls"])
+    lay = params["layers"]
+    pre = "vit.encoder.layer.{}."
+    wq = _np32(lay["w_qkv"])
+    bq = _np32(lay["b_qkv"])
+    for i in range(wq.shape[0]):
+        qw, kw, vw = _split3(wq[i])
+        qb, kb, vb = _split3(bq[i])
+        for n, w, b in (("query", qw, qb), ("key", kw, kb), ("value", vw, vb)):
+            sd[pre.format(i) + f"attention.attention.{n}.weight"] = w.T.copy()
+            sd[pre.format(i) + f"attention.attention.{n}.bias"] = b.copy()
+    _unstack(lay["w_proj"], pre + "attention.output.dense.weight", sd, transpose=True)
+    _unstack(lay["b_proj"], pre + "attention.output.dense.bias", sd)
+    _unstack(lay["w_up"], pre + "intermediate.dense.weight", sd, transpose=True)
+    _unstack(lay["b_up"], pre + "intermediate.dense.bias", sd)
+    _unstack(lay["w_down"], pre + "output.dense.weight", sd, transpose=True)
+    _unstack(lay["b_down"], pre + "output.dense.bias", sd)
+    _unstack(lay["ln_attn_scale"], pre + "layernorm_before.weight", sd)
+    _unstack(lay["ln_attn_bias"], pre + "layernorm_before.bias", sd)
+    _unstack(lay["ln_mlp_scale"], pre + "layernorm_after.weight", sd)
+    _unstack(lay["ln_mlp_bias"], pre + "layernorm_after.bias", sd)
+    return sd
+
+
+_EXPORTERS = {
+    "llama": _export_llama,
+    "gpt2": _export_gpt2,
+    "bert": _export_bert,
+    "t5": _export_t5,
+    "mixtral": _export_mixtral,
+    "vit": _export_vit,
+}
+
+
+def _hf_config_dict(family: str, cfg, params: dict) -> dict:
+    """The MLP width is read from the WEIGHTS, not reconstructed from the
+    native config: bert/gpt2/vit configs don't carry it (the forward derives
+    it from shapes), so a 4*hidden guess would write config.json claims that
+    contradict the tensors for non-standard widths."""
+    if family == "llama":
+        return {
+            "model_type": "llama",
+            "architectures": ["LlamaForCausalLM"],
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.hidden_size,
+            "intermediate_size": cfg.intermediate_size,
+            "num_hidden_layers": cfg.num_layers,
+            "num_attention_heads": cfg.num_heads,
+            "num_key_value_heads": cfg.num_kv_heads,
+            "head_dim": cfg.head_dim_,
+            "max_position_embeddings": cfg.max_seq_len,
+            "rms_norm_eps": cfg.rms_eps,
+            "rope_theta": cfg.rope_theta,
+            "tie_word_embeddings": cfg.tie_embeddings,
+            "hidden_act": "silu",
+            "attention_bias": False,
+            "mlp_bias": False,
+            "torch_dtype": "float32",
+        }
+    if family == "gpt2":
+        return {
+            "model_type": "gpt2",
+            "architectures": ["GPT2LMHeadModel"],
+            "vocab_size": cfg.vocab_size,
+            "n_embd": cfg.hidden_size,
+            "n_layer": cfg.num_layers,
+            "n_head": cfg.num_heads,
+            "n_positions": cfg.max_seq_len,
+            "n_ctx": cfg.max_seq_len,
+            "n_inner": int(params["layers"]["w_up"].shape[-1]),
+            "layer_norm_epsilon": cfg.layer_norm_eps,
+            "activation_function": "gelu_new",
+            "torch_dtype": "float32",
+        }
+    if family == "bert":
+        return {
+            "model_type": "bert",
+            "architectures": ["BertForSequenceClassification"],
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.hidden_size,
+            "num_hidden_layers": cfg.num_layers,
+            "num_attention_heads": cfg.num_heads,
+            "intermediate_size": int(params["layers"]["w_up"].shape[-1]),
+            "max_position_embeddings": cfg.max_seq_len,
+            "type_vocab_size": cfg.type_vocab_size,
+            "layer_norm_eps": cfg.layer_norm_eps,
+            "num_labels": cfg.num_labels,
+            "id2label": {str(i): f"LABEL_{i}" for i in range(cfg.num_labels)},
+            "label2id": {f"LABEL_{i}": i for i in range(cfg.num_labels)},
+            "hidden_act": "gelu",
+            "torch_dtype": "float32",
+        }
+    if family == "t5":
+        return {
+            "model_type": "t5",
+            "architectures": ["T5ForConditionalGeneration"],
+            "vocab_size": cfg.vocab_size,
+            "d_model": cfg.hidden_size,
+            "d_kv": cfg.head_dim,
+            "d_ff": cfg.intermediate_size,
+            "num_layers": cfg.num_layers,
+            "num_decoder_layers": cfg.num_layers,
+            "num_heads": cfg.num_heads,
+            "relative_attention_num_buckets": cfg.num_buckets,
+            "relative_attention_max_distance": cfg.max_distance,
+            "layer_norm_epsilon": cfg.rms_eps,
+            "feed_forward_proj": "relu",
+            "tie_word_embeddings": True,
+            "is_encoder_decoder": True,
+            "torch_dtype": "float32",
+        }
+    if family == "mixtral":
+        return {
+            "model_type": "mixtral",
+            "architectures": ["MixtralForCausalLM"],
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.hidden_size,
+            "intermediate_size": cfg.intermediate_size,
+            "num_hidden_layers": cfg.num_layers,
+            "num_attention_heads": cfg.num_heads,
+            "num_key_value_heads": cfg.num_kv_heads,
+            "num_local_experts": cfg.num_experts,
+            "num_experts_per_tok": cfg.top_k,
+            "max_position_embeddings": cfg.max_seq_len,
+            "rms_norm_eps": cfg.rms_eps,
+            "rope_theta": cfg.rope_theta,
+            "tie_word_embeddings": False,
+            "torch_dtype": "float32",
+        }
+    # vit
+    return {
+        "model_type": "vit",
+        "architectures": ["ViTForImageClassification"],
+        "image_size": cfg.image_size,
+        "patch_size": cfg.patch_size,
+        "num_channels": cfg.num_channels,
+        "hidden_size": cfg.hidden_size,
+        "num_hidden_layers": cfg.num_layers,
+        "num_attention_heads": cfg.num_heads,
+        "intermediate_size": int(params["layers"]["w_up"].shape[-1]),
+        "layer_norm_eps": cfg.layer_norm_eps,
+        "num_labels": cfg.num_labels,
+        "id2label": {str(i): f"LABEL_{i}" for i in range(cfg.num_labels)},
+        "label2id": {f"LABEL_{i}": i for i in range(cfg.num_labels)},
+        "hidden_act": "gelu",
+        "torch_dtype": "float32",
+    }
+
+
+def export_state_dict(family: str, params: dict, config) -> dict:
+    """Native param tree -> transformers-style numpy state dict."""
+    if family not in _EXPORTERS:
+        raise ValueError(
+            f"Export supports {sorted(_EXPORTERS)}; got {family!r}"
+        )
+    return _EXPORTERS[family](params, config)
+
+
+def export_hf_checkpoint(family: str, params: dict, config, path: str) -> str:
+    """Write ``config.json`` + ``model.safetensors`` that transformers
+    ``from_pretrained(path)`` loads.  Returns ``path``."""
+    from safetensors.numpy import save_file
+
+    os.makedirs(path, exist_ok=True)
+    sd = export_state_dict(family, params, config)
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(_hf_config_dict(family, config, params), f, indent=2)
+    # metadata format key: older transformers releases reject safetensors
+    # files without it.
+    save_file(sd, os.path.join(path, "model.safetensors"), metadata={"format": "pt"})
+    return path

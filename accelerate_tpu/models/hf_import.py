@@ -520,16 +520,32 @@ _IGNORABLE = (
 )
 
 
-def import_state_dict(family: str, state_dict: dict, config, strict: bool = True) -> dict:
+def import_state_dict(
+    family: str,
+    state_dict: dict,
+    config,
+    strict: bool = True,
+    consume_source: bool = False,
+) -> dict:
     """Map a transformers state dict onto the native param tree for
     ``family``, cast to ``config.param_dtype``.
 
     ``strict`` (default): raise if any checkpoint tensor was not consumed by
     the mapping — a dropped tensor means the converted model computes
-    something different from the checkpoint."""
+    something different from the checkpoint.
+
+    ``consume_source``: empty the caller's ``state_dict`` after copying the
+    references in, so the read-releases in ``_RecordingDict`` actually free
+    each source tensor as it is staged — peak host memory then stays ~one
+    model copy.  Without it (e.g. ``from_hf``, where the torch module owns
+    the tensors anyway) the deletions only shrink this function's view."""
     if family not in _IMPORTERS:
         raise ValueError(f"Unknown family {family!r}; supported: {sorted(_IMPORTERS)}")
-    sd = _RecordingDict(_strip_prefix(dict(state_dict), _PREFIXES[family]))
+    stripped = _strip_prefix(dict(state_dict), _PREFIXES[family])
+    if consume_source:
+        state_dict.clear()
+    sd = _RecordingDict(stripped)
+    del stripped
     params = _IMPORTERS[family](sd, config)
     if strict:
         leftover = [
@@ -604,7 +620,8 @@ def load_hf_checkpoint(path: str, strict: bool = True, **config_overrides):
             raise FileNotFoundError(
                 f"No model.safetensors(.index.json) or pytorch_model.bin in {path}"
             )
-    return family, cfg, import_state_dict(family, sd, cfg, strict=strict)
+    params = import_state_dict(family, sd, cfg, strict=strict, consume_source=True)
+    return family, cfg, params
 
 
 def from_hf(model, **config_overrides):

@@ -1,0 +1,170 @@
+"""HF-checkpoint export oracles: train-or-init a native model, export with
+``models.hf_export``, load the directory with transformers, and compare the
+transformers forward against the native logits — plus bit-exact
+import(export(x)) round-trips."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from accelerate_tpu.models import bert, gpt2, hf_export, hf_import, llama
+
+
+def _ids(vocab, shape, seed=0):
+    return np.asarray(
+        np.random.default_rng(seed).integers(0, vocab, shape), np.int32
+    )
+
+
+def test_llama_export_loads_in_transformers(tmp_path):
+    cfg = llama.LlamaConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.key(0))
+    out = hf_export.export_hf_checkpoint("llama", params, cfg, str(tmp_path / "m"))
+    hf = transformers.AutoModelForCausalLM.from_pretrained(out).eval()
+    ids = _ids(cfg.vocab_size, (2, 10))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids).long()).logits.numpy()
+    ours = np.asarray(llama.apply(params, jnp.asarray(ids), cfg))
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_gpt2_export_loads_in_transformers(tmp_path):
+    cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
+    params = gpt2.init_params(cfg, jax.random.key(1))
+    out = hf_export.export_hf_checkpoint("gpt2", params, cfg, str(tmp_path / "m"))
+    hf = transformers.AutoModelForCausalLM.from_pretrained(out).eval()
+    ids = _ids(cfg.vocab_size, (2, 8))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids).long()).logits.numpy()
+    ours = np.asarray(gpt2.apply(params, jnp.asarray(ids), cfg))
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_bert_export_loads_in_transformers(tmp_path):
+    cfg = bert.BertConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
+    params = bert.init_params(cfg, jax.random.key(2))
+    out = hf_export.export_hf_checkpoint("bert", params, cfg, str(tmp_path / "m"))
+    hf = transformers.AutoModelForSequenceClassification.from_pretrained(out).eval()
+    assert hf.config.num_labels == cfg.num_labels
+    ids = _ids(cfg.vocab_size, (2, 9))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids).long()).logits.numpy()
+    _, pooled = bert.apply(params, jnp.asarray(ids), cfg)
+    ours = np.asarray(
+        pooled @ np.asarray(params["classifier"]["w"])
+        + np.asarray(params["classifier"]["b"])
+    )
+    # tanh-approx vs erf GeLU (as in the import oracle).
+    np.testing.assert_allclose(ours, ref, atol=5e-3, rtol=5e-3)
+
+
+@pytest.mark.parametrize("family", ["llama", "gpt2", "bert"])
+def test_import_export_round_trip(family):
+    """import(export(params)) is bit-exact on every leaf."""
+    if family == "llama":
+        cfg = llama.LlamaConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
+        params = llama.init_params(cfg, jax.random.key(3))
+    elif family == "gpt2":
+        cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
+        params = gpt2.init_params(cfg, jax.random.key(4))
+    else:
+        cfg = bert.BertConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
+        params = bert.init_params(cfg, jax.random.key(5))
+    sd = hf_export.export_state_dict(family, params, cfg)
+    back = hf_import.import_state_dict(family, sd, cfg)
+    ta = jax.tree_util.tree_structure(params)
+    tb = jax.tree_util.tree_structure(back)
+    assert ta == tb, (ta, tb)
+    jax.tree_util.tree_map_with_path(
+        lambda kp, a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=jax.tree_util.keystr(kp)
+        ),
+        params, back,
+    )
+
+
+def test_export_unsupported_family_raises():
+    with pytest.raises(ValueError, match="Export supports"):
+        hf_export.export_state_dict("resnet", {}, None)
+
+
+def test_t5_export_loads_in_transformers(tmp_path):
+    from accelerate_tpu.models import t5
+
+    cfg = t5.T5Config.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
+    params = t5.init_params(cfg, jax.random.key(6))
+    out = hf_export.export_hf_checkpoint("t5", params, cfg, str(tmp_path / "m"))
+    hf = transformers.AutoModelForSeq2SeqLM.from_pretrained(out).eval()
+    enc = _ids(cfg.vocab_size, (2, 8))
+    dec = _ids(cfg.vocab_size, (2, 5), seed=1)
+    with torch.no_grad():
+        ref = hf(
+            input_ids=torch.from_numpy(enc).long(),
+            decoder_input_ids=torch.from_numpy(dec).long(),
+        ).logits.numpy()
+    ours = np.asarray(t5.apply(params, jnp.asarray(enc), jnp.asarray(dec), cfg))
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_mixtral_export_loads_in_transformers(tmp_path):
+    from accelerate_tpu.models import mixtral
+
+    cfg = mixtral.MixtralConfig.tiny(
+        dtype=jnp.float32, param_dtype=jnp.float32, capacity_factor=8.0
+    )
+    params = mixtral.init_params(cfg, jax.random.key(7))
+    out = hf_export.export_hf_checkpoint("mixtral", params, cfg, str(tmp_path / "m"))
+    hf = transformers.AutoModelForCausalLM.from_pretrained(out).eval()
+    ids = _ids(cfg.vocab_size, (2, 8))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids).long()).logits.numpy()
+    ours, _ = mixtral.apply(params, jnp.asarray(ids), cfg)
+    np.testing.assert_allclose(np.asarray(ours), ref, atol=5e-4, rtol=5e-4)
+
+
+def test_vit_export_loads_in_transformers(tmp_path):
+    from accelerate_tpu.models import vit
+
+    cfg = vit.ViTConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
+    params = vit.init_params(cfg, jax.random.key(8))
+    out = hf_export.export_hf_checkpoint("vit", params, cfg, str(tmp_path / "m"))
+    hf = transformers.AutoModelForImageClassification.from_pretrained(out).eval()
+    rng = np.random.default_rng(9)
+    pixels = rng.normal(size=(2, cfg.image_size, cfg.image_size, 3)).astype(np.float32)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(pixels.transpose(0, 3, 1, 2))).logits.numpy()
+    _, pooled = vit.apply(params, jnp.asarray(pixels), cfg)
+    ours = np.asarray(
+        pooled @ np.asarray(params["classifier"]["w"])
+        + np.asarray(params["classifier"]["b"])
+    )
+    np.testing.assert_allclose(ours, ref, atol=5e-3, rtol=5e-3)
+
+
+@pytest.mark.parametrize("family", ["t5", "mixtral", "vit"])
+def test_import_export_round_trip_rest(family):
+    from accelerate_tpu.models import mixtral, t5, vit
+
+    if family == "t5":
+        cfg = t5.T5Config.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
+        params = t5.init_params(cfg, jax.random.key(10))
+    elif family == "mixtral":
+        cfg = mixtral.MixtralConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
+        params = mixtral.init_params(cfg, jax.random.key(11))
+    else:
+        cfg = vit.ViTConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
+        params = vit.init_params(cfg, jax.random.key(12))
+    sd = hf_export.export_state_dict(family, params, cfg)
+    back = hf_import.import_state_dict(family, sd, cfg)
+    assert jax.tree_util.tree_structure(params) == jax.tree_util.tree_structure(back)
+    jax.tree_util.tree_map_with_path(
+        lambda kp, a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=jax.tree_util.keystr(kp)
+        ),
+        params, back,
+    )
