@@ -199,8 +199,7 @@ def _export_vit(params: dict, cfg) -> dict:
         "classifier.weight": _np32(params["classifier"]["w"]).T.copy(),
         "classifier.bias": _np32(params["classifier"]["b"]),
     }
-    if cfg.pool == "cls":
-        sd["vit.embeddings.cls_token"] = _np32(e["cls"])
+    sd["vit.embeddings.cls_token"] = _np32(e["cls"])  # pool=='cls' guaranteed above
     lay = params["layers"]
     pre = "vit.encoder.layer.{}."
     wq = _np32(lay["w_qkv"])

@@ -50,6 +50,12 @@ class MixtralConfig:
     num_experts: int = 8
     top_k: int = 2
     capacity_factor: float = 1.25
+    # "dense" = Switch-style dispatch/combine einsums (the GSPMD ep-sharded
+    # path; capacity_factor applies); "ragged" = exact grouped matmul via
+    # lax.ragged_dot (no capacity padding, zero drops — per-device: raises
+    # under an active ep>1 mesh where group sizes would be data-dependent
+    # across shards).
+    moe_impl: str = "dense"
     router_aux_coef: float = 0.01
     router_z_coef: float = 0.001
     dtype: Any = jnp.bfloat16
@@ -77,6 +83,8 @@ class MixtralConfig:
             raise ValueError(f"sp_impl must be 'ring' or 'ulysses', got {self.sp_impl!r}")
         if self.loss_impl not in ("dense", "chunked"):
             raise ValueError(f"loss_impl must be 'dense' or 'chunked', got {self.loss_impl!r}")
+        if self.moe_impl not in ("dense", "ragged"):
+            raise ValueError(f"moe_impl must be 'dense' or 'ragged', got {self.moe_impl!r}")
 
     @property
     def head_dim_(self) -> int:
@@ -204,6 +212,41 @@ def init_params(config: MixtralConfig, key: jax.Array) -> dict:
     )
 
 
+def _ep_active() -> bool:
+    from ..parallel.sharding import _abstract_mesh
+
+    m = _abstract_mesh()
+    return bool(m is not None and not m.empty and "ep" in m.axis_names and m.shape["ep"] > 1)
+
+
+def _check_moe_impl(c: MixtralConfig) -> None:
+    """Fail fast (before any computation touches the mesh) when the ragged
+    impl meets an expert-parallel mesh."""
+    if c.moe_impl == "ragged" and _ep_active():
+        raise ValueError(
+            "moe_impl='ragged' cannot run under an ep>1 mesh: ragged "
+            "group sizes are data-dependent per shard.  Use "
+            "moe_impl='dense' for expert-parallel meshes."
+        )
+
+
+def _moe(h, p, c: MixtralConfig, capacity):
+    """Dispatch on ``moe_impl``: Switch dense dispatch (GSPMD ep path) or the
+    exact ragged grouped matmul (per-device; see MixtralConfig)."""
+    if c.moe_impl == "ragged":
+        _check_moe_impl(c)
+        from ..ops.moe import moe_ffn_ragged
+
+        return moe_ffn_ragged(
+            h, p["router"], p["w_gate"], p["w_up"], p["w_down"],
+            top_k=c.top_k, compute_dtype=c.dtype,
+        )
+    return moe_ffn(
+        h, p["router"], p["w_gate"], p["w_up"], p["w_down"],
+        top_k=c.top_k, capacity=capacity, compute_dtype=c.dtype,
+    )
+
+
 def _layer(
     carry, layer_params, *, config: MixtralConfig, mask, positions, act_spec, capacity,
     kv_valid=None,
@@ -214,16 +257,7 @@ def _layer(
     x = _llama.attention_block(x, p, c, mask, positions, kv_valid=kv_valid)
 
     h = _llama._rms_norm(x, p["ln_mlp"], c.rms_eps)
-    y, aux = moe_ffn(
-        h,
-        p["router"],
-        p["w_gate"],
-        p["w_up"],
-        p["w_down"],
-        top_k=c.top_k,
-        capacity=capacity,
-        compute_dtype=c.dtype,
-    )
+    y, aux = _moe(h, p, c, capacity)
     x = x + y
     if act_spec is not None:
         x = _llama._maybe_constrain(x, act_spec)
@@ -263,6 +297,7 @@ def apply_hidden(
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
     """Trunk forward -> (final-normed hidden [B, S, d], mean aux losses) —
     the chunked loss consumes the hidden directly (no logits tensor)."""
+    _check_moe_impl(config)
     c = config
     b, s = input_ids.shape
     if positions is None:
@@ -361,6 +396,7 @@ def apply_cached(
 ) -> tuple[jax.Array, dict]:
     """Forward over new tokens with cache read/write; router aux losses are
     not accumulated (inference)."""
+    _check_moe_impl(config)
     from .generation import check_cache_room
 
     c = config
@@ -376,16 +412,7 @@ def apply_cached(
         lp = _llama._dequant_layer(lp)
         y, ck, cv = _llama._attention_block_cached(carry, lp, c, ck, cv, index, positions)
         h = _llama._rms_norm(y, lp["ln_mlp"], c.rms_eps)
-        ffn, _ = moe_ffn(
-            h,
-            lp["router"],
-            lp["w_gate"],
-            lp["w_up"],
-            lp["w_down"],
-            top_k=c.top_k,
-            capacity=capacity,
-            compute_dtype=c.dtype,
-        )
+        ffn, _ = _moe(h, lp, c, capacity)
         return y + ffn, (ck, cv)
 
     from .generation import pack_cache_for_scan, unpack_cache_from_scan

@@ -1,3 +1,3 @@
 from .fp8 import dequantize, quantize, scaled_matmul
-from .moe import dispatch_combine, expert_capacity, moe_ffn, router
+from .moe import dispatch_combine, expert_capacity, moe_ffn, moe_ffn_ragged, router
 from .ring_attention import ring_attention, ring_self_attention

@@ -35,7 +35,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..parallel.sharding import constrain
 
-__all__ = ["router", "dispatch_combine", "moe_ffn", "expert_capacity"]
+__all__ = ["router", "dispatch_combine", "moe_ffn", "moe_ffn_ragged", "expert_capacity"]
 
 
 def expert_capacity(seq_len: int, num_experts: int, top_k: int, capacity_factor: float) -> int:
@@ -147,3 +147,68 @@ def moe_ffn(
     aux["load_balancing_loss"] = load_balancing_loss(probs, dispatch)
     aux["router_z_loss"] = router_z_loss(logits)
     return y.astype(x.dtype), aux
+
+
+def moe_ffn_ragged(
+    x: jax.Array,
+    w_router: jax.Array,
+    w_gate: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    *,
+    top_k: int = 2,
+    compute_dtype: Any = jnp.bfloat16,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Megablocks-style exact MoE FFN via ``lax.ragged_dot`` — the grouped
+    matmul the dense dispatch approximates.
+
+    Tokens are sorted by their routed expert and each expert's rows run as
+    one group of a ragged matmul: compute is exactly ``S*top_k`` rows (no
+    capacity padding — the dense path does ``E*C >= S*top_k*cf`` rows) and
+    no token is ever dropped.  Group sizes are data-dependent, so this path
+    is per-device (use it for single-chip decode / fsdp-replicated experts);
+    the dense dispatch remains the GSPMD `ep`-sharded path where static
+    shapes let XLA place the all-to-all.
+
+    Same signature/return contract as ``moe_ffn`` minus the capacity knobs;
+    ``fraction_dropped`` is identically zero.
+    """
+    b, s, d = x.shape
+    e = w_gate.shape[0]
+    probs, logits = router(x, w_router)
+    gates, idx = jax.lax.top_k(probs, top_k)  # [B, S, k] fp32
+    gates = gates / jnp.maximum(jnp.sum(gates, axis=-1, keepdims=True), 1e-9)
+
+    n = b * s * top_k
+    expert_of = idx.reshape(n)
+    token_of = jnp.repeat(jnp.arange(b * s), top_k)
+    order = jnp.argsort(expert_of, stable=True)
+
+    tokens = x.reshape(b * s, d).astype(compute_dtype)
+    rows = tokens[token_of[order]]  # [N, d] grouped by expert
+    group_sizes = jnp.bincount(expert_of, length=e).astype(jnp.int32)
+
+    gate = jax.nn.silu(
+        jax.lax.ragged_dot(rows, w_gate.astype(compute_dtype), group_sizes)
+    )
+    up = jax.lax.ragged_dot(rows, w_up.astype(compute_dtype), group_sizes)
+    y_rows = jax.lax.ragged_dot(gate * up, w_down.astype(compute_dtype), group_sizes)
+
+    weighted = y_rows.astype(jnp.float32) * gates.reshape(n)[order][:, None]
+    y = jnp.zeros((b * s, d), jnp.float32).at[token_of[order]].add(weighted)
+
+    # Aux losses use the same Switch formula as the dense path; every routed
+    # token is kept, so the dispatch mass is the one-hot top-k assignment
+    # itself (per batch row, like load_balancing_loss).
+    onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32).sum(axis=2)  # [B, S, E]
+    tokens_per_expert = jnp.sum(onehot, axis=1)  # [B, E]
+    f = tokens_per_expert / jnp.maximum(
+        jnp.sum(tokens_per_expert, axis=-1, keepdims=True), 1.0
+    )
+    p = jnp.mean(probs, axis=1)
+    aux = {
+        "load_balancing_loss": e * jnp.mean(jnp.sum(f * p, axis=-1)),
+        "router_z_loss": router_z_loss(logits),
+        "fraction_dropped": jnp.zeros((), jnp.float32),
+    }
+    return y.reshape(b, s, d).astype(x.dtype), aux

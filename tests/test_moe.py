@@ -159,3 +159,55 @@ def test_mixtral_ep_sharded_matches_unsharded():
     sb = {"input_ids": jax.device_put(batch["input_ids"], data_sharding(state.mesh))}
     ep_loss = float(jax.jit(lambda p, b: mixtral.loss_fn(p, b, cfg))(sharded, sb))
     assert abs(dense_loss - ep_loss) < 1e-4, (dense_loss, ep_loss)
+
+
+def test_ragged_matches_dense_when_nothing_drops():
+    """moe_ffn_ragged is the exact computation the dense dispatch approximates:
+    with capacity high enough that no token drops, outputs are identical."""
+    from accelerate_tpu.ops.moe import moe_ffn, moe_ffn_ragged
+
+    rng = np.random.default_rng(0)
+    b, s, d, e, f = 2, 16, 8, 4, 16
+    x = jnp.asarray(rng.normal(size=(b, s, d)), jnp.float32)
+    wr = jnp.asarray(rng.normal(size=(d, e)) * 0.1, jnp.float32)
+    wg = jnp.asarray(rng.normal(size=(e, d, f)) * 0.1, jnp.float32)
+    wu = jnp.asarray(rng.normal(size=(e, d, f)) * 0.1, jnp.float32)
+    wd = jnp.asarray(rng.normal(size=(e, f, d)) * 0.1, jnp.float32)
+    yd, auxd = moe_ffn(x, wr, wg, wu, wd, top_k=2, capacity=1000,
+                       compute_dtype=jnp.float32)
+    yr, auxr = moe_ffn_ragged(x, wr, wg, wu, wd, top_k=2,
+                              compute_dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(yd), np.asarray(yr), atol=1e-6)
+    assert abs(float(auxd["load_balancing_loss"]) - float(auxr["load_balancing_loss"])) < 1e-6
+    assert float(auxr["fraction_dropped"]) == 0.0
+    # Gradients flow through the ragged path (training-usable).
+    g = jax.grad(
+        lambda w: moe_ffn_ragged(x, wr, w, wu, wd, top_k=2,
+                                 compute_dtype=jnp.float32)[0].sum()
+    )(wg)
+    assert bool(jnp.isfinite(g).all())
+
+
+def test_mixtral_ragged_impl_end_to_end():
+    """moe_impl='ragged' trains and generates; under an ep>1 mesh it refuses."""
+    from accelerate_tpu import AcceleratorState, ParallelismConfig
+    from accelerate_tpu.models import mixtral
+
+    cfg = mixtral.MixtralConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32,
+                                     moe_impl="ragged", capacity_factor=8.0)
+    params = mixtral.init_params(cfg, jax.random.key(0))
+    ids = np.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 12)), np.int32
+    )
+    # Forward parity vs the dense impl at non-dropping capacity.
+    cfg_dense = mixtral.MixtralConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32,
+                                           capacity_factor=8.0)
+    lr, _ = mixtral.apply(params, jnp.asarray(ids), cfg)
+    ld, _ = mixtral.apply(params, jnp.asarray(ids), cfg_dense)
+    np.testing.assert_allclose(np.asarray(lr), np.asarray(ld), atol=1e-5)
+    out = mixtral.generate(params, ids, cfg, max_new_tokens=4)
+    assert np.asarray(out).shape == (2, 16)
+
+    state = AcceleratorState(parallelism_config=ParallelismConfig(ep=4, dp=2))
+    with pytest.raises(ValueError, match="ragged"):
+        mixtral.apply(params, jnp.asarray(ids), cfg)
