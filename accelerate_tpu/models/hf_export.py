@@ -223,6 +223,63 @@ def _export_vit(params: dict, cfg) -> dict:
     return sd
 
 
+def _export_resnet(tree: dict, cfg) -> dict:
+    """Expects the ``{"params", "batch_stats"}`` pair the resnet import
+    produces (BN running statistics are state, exported alongside)."""
+    if not (isinstance(tree, dict) and "params" in tree and "batch_stats" in tree):
+        raise ValueError(
+            "resnet export takes {'params': ..., 'batch_stats': ...} — the "
+            "pair resnet training threads (and hf_import returns)."
+        )
+    if cfg.stem != "imagenet":
+        raise ValueError(
+            "resnet export requires stem='imagenet' (HF ResNet has no "
+            "CIFAR-stem variant)."
+        )
+    params, stats = tree["params"], tree["batch_stats"]
+
+    def conv(a):  # HWIO -> OIHW
+        return _np32(a).transpose(3, 2, 0, 1).copy()
+
+    def bn(prefix, site, p, s, out):
+        out[prefix + ".weight"] = _np32(p[f"{site}_scale"])
+        out[prefix + ".bias"] = _np32(p[f"{site}_bias"])
+        out[prefix + ".running_mean"] = _np32(s[f"{site}_mean"])
+        out[prefix + ".running_var"] = _np32(s[f"{site}_var"])
+        out[prefix + ".num_batches_tracked"] = np.zeros((), np.int64)
+
+    n_convs = 3 if cfg.block == "bottleneck" else 2
+    sd: dict = {
+        "resnet.embedder.embedder.convolution.weight": conv(params["stem"]["conv_w"]),
+        "classifier.1.weight": _np32(params["classifier"]["w"]).T.copy(),
+        "classifier.1.bias": _np32(params["classifier"]["b"]),
+    }
+    bn("resnet.embedder.embedder.normalization", "bn",
+       params["stem"], stats["stem"], sd)
+
+    for s_i, depth in enumerate(cfg.stage_sizes):
+        sp, ss = params[f"stage{s_i}"], stats[f"stage{s_i}"]
+
+        def one_layer(i, p, st):
+            lp = f"resnet.encoder.stages.{s_i}.layers.{i}."
+            for j in range(n_convs):
+                sd[lp + f"layer.{j}.convolution.weight"] = conv(p[f"conv{j + 1}_w"])
+                bn(lp + f"layer.{j}.normalization", f"bn{j + 1}", p, st, sd)
+            if "proj_w" in p:
+                sd[lp + "shortcut.convolution.weight"] = conv(p["proj_w"])
+                bn(lp + "shortcut.normalization", "proj_bn", p, st, sd)
+
+        one_layer(0, sp["head"], ss["head"])
+        if depth > 1:
+            for i in range(1, depth):
+                one_layer(
+                    i,
+                    {k: v[i - 1] for k, v in sp["tail"].items()},
+                    {k: v[i - 1] for k, v in ss["tail"].items()},
+                )
+    return sd
+
+
 _EXPORTERS = {
     "llama": _export_llama,
     "gpt2": _export_gpt2,
@@ -230,6 +287,7 @@ _EXPORTERS = {
     "t5": _export_t5,
     "mixtral": _export_mixtral,
     "vit": _export_vit,
+    "resnet": _export_resnet,
 }
 
 
@@ -326,6 +384,25 @@ def _hf_config_dict(family: str, cfg, params: dict) -> dict:
             "rms_norm_eps": cfg.rms_eps,
             "rope_theta": cfg.rope_theta,
             "tie_word_embeddings": False,
+            "torch_dtype": "float32",
+        }
+    if family == "resnet":
+        e = 4 if cfg.block == "bottleneck" else 1
+        return {
+            "model_type": "resnet",
+            "architectures": ["ResNetForImageClassification"],
+            "num_channels": cfg.num_channels,
+            "embedding_size": cfg.width,
+            "hidden_sizes": [
+                cfg.width * (2**s) * e for s in range(len(cfg.stage_sizes))
+            ],
+            "depths": list(cfg.stage_sizes),
+            "layer_type": cfg.block,
+            "downsample_in_first_stage": False,
+            "num_labels": cfg.num_labels,
+            "id2label": {str(i): f"LABEL_{i}" for i in range(cfg.num_labels)},
+            "label2id": {f"LABEL_{i}": i for i in range(cfg.num_labels)},
+            "hidden_act": "relu",
             "torch_dtype": "float32",
         }
     # vit

@@ -26,6 +26,11 @@ Supported families and their HF architectures:
                 stacked [L, E, ...]; the router gate maps transposed)
 - ``vit``     — ViTForImageClassification / ViTModel (patch-conv kernel
                 [d, C, p, p] -> the patchify matmul's [p*p*C, d])
+- ``resnet``  — ResNetForImageClassification / ResNetModel (HF's v1.5
+                blocks = the native layout; conv kernels OIHW -> HWIO; BN
+                running statistics import as a ``batch_stats`` tree next to
+                ``params`` — this family's import returns
+                ``{"params": ..., "batch_stats": ...}``)
 
 Every tensor is copied through numpy (no torch object survives into the
 pytree).  Tested by logits-parity oracles against the actual transformers
@@ -72,7 +77,7 @@ def _stack_cat(sd: dict, fmts: list, n: int, transpose: bool = False) -> np.ndar
 
 def _detect_family(hf_config) -> str:
     mt = getattr(hf_config, "model_type", "")
-    known = {"llama", "gpt2", "bert", "t5", "mixtral", "vit"}
+    known = {"llama", "gpt2", "bert", "t5", "mixtral", "vit", "resnet"}
     if mt in known:
         return mt
     raise ValueError(
@@ -187,6 +192,45 @@ def config_from_hf(hf_config, **overrides):
         )
         kw.update(overrides)
         return MixtralConfig(**kw)
+    if family == "resnet":
+        from .resnet import ResNetConfig
+
+        block = {"bottleneck": "bottleneck", "basic": "basic"}.get(
+            getattr(c, "layer_type", "bottleneck")
+        )
+        if block is None:
+            raise ValueError(f"Unsupported resnet layer_type {c.layer_type!r}")
+        if getattr(c, "downsample_in_first_stage", False):
+            raise ValueError(
+                "resnet import requires downsample_in_first_stage=False "
+                "(the native family strides stage 0 at 1, torchvision-style)."
+            )
+        if getattr(c, "downsample_in_bottleneck", False):
+            raise ValueError(
+                "resnet import requires downsample_in_bottleneck=False: the "
+                "native block strides the 3x3 conv (v1.5); a v1-style "
+                "checkpoint (stride on the first 1x1) has identical shapes "
+                "but different numerics, so it must be refused, not silently "
+                "mis-run."
+            )
+        width = c.embedding_size
+        e = 4 if block == "bottleneck" else 1
+        expect = [width * (2**s) * e for s in range(len(c.depths))]
+        if list(c.hidden_sizes) != expect:
+            raise ValueError(
+                f"resnet import supports the standard doubling geometry "
+                f"(hidden_sizes {expect} for embedding_size {width}); got "
+                f"{list(c.hidden_sizes)}."
+            )
+        kw = dict(
+            block=block,
+            stage_sizes=tuple(c.depths),
+            width=width,
+            num_labels=getattr(c, "num_labels", 2),
+            stem="imagenet",
+        )
+        kw.update(overrides)
+        return ResNetConfig(**kw)
     # vit
     from .vit import ViTConfig
 
@@ -462,6 +506,73 @@ def _import_vit(sd: dict, cfg) -> dict:
     return params
 
 
+def _import_resnet(sd: dict, cfg) -> dict:
+    """HF ResNet (v1.5: stride on the 3x3 — the native block layout) ->
+    ``{"params": ..., "batch_stats": ...}``: BN running statistics are real
+    state here, imported alongside the weights."""
+
+    def conv(key):  # [O, I, kh, kw] -> HWIO
+        return _np(sd[key]).transpose(2, 3, 1, 0).copy()
+
+    def bn(prefix, site, params_out, stats_out):
+        params_out[f"{site}_scale"] = _np(sd[prefix + ".weight"])
+        params_out[f"{site}_bias"] = _np(sd[prefix + ".bias"])
+        stats_out[f"{site}_mean"] = _np(sd[prefix + ".running_mean"])
+        stats_out[f"{site}_var"] = _np(sd[prefix + ".running_var"])
+
+    n_convs = 3 if cfg.block == "bottleneck" else 2
+    params: dict = {"stem": {}}
+    stats: dict = {"stem": {}}
+    params["stem"]["conv_w"] = conv("embedder.embedder.convolution.weight")
+    bn("embedder.embedder.normalization", "bn", params["stem"], stats["stem"])
+
+    for s, depth in enumerate(cfg.stage_sizes):
+        head_p: dict = {}
+        head_s: dict = {}
+        lp = f"encoder.stages.{s}.layers.0."
+        for j in range(n_convs):
+            head_p[f"conv{j + 1}_w"] = conv(lp + f"layer.{j}.convolution.weight")
+            bn(lp + f"layer.{j}.normalization", f"bn{j + 1}", head_p, head_s)
+        if lp + "shortcut.convolution.weight" in sd:
+            head_p["proj_w"] = conv(lp + "shortcut.convolution.weight")
+            bn(lp + "shortcut.normalization", "proj_bn", head_p, head_s)
+        stage_p: dict = {"head": head_p}
+        stage_s: dict = {"head": head_s}
+        if depth > 1:
+            tails_p = []
+            tails_s = []
+            for i in range(1, depth):
+                tp: dict = {}
+                ts: dict = {}
+                lp = f"encoder.stages.{s}.layers.{i}."
+                for j in range(n_convs):
+                    tp[f"conv{j + 1}_w"] = conv(lp + f"layer.{j}.convolution.weight")
+                    bn(lp + f"layer.{j}.normalization", f"bn{j + 1}", tp, ts)
+                tails_p.append(tp)
+                tails_s.append(ts)
+            stage_p["tail"] = {
+                k: np.stack([t[k] for t in tails_p]) for k in tails_p[0]
+            }
+            stage_s["tail"] = {
+                k: np.stack([t[k] for t in tails_s]) for k in tails_s[0]
+            }
+        params[f"stage{s}"] = stage_p
+        stats[f"stage{s}"] = stage_s
+
+    d_out = cfg.stage_channels(len(cfg.stage_sizes) - 1) * cfg.expansion
+    if "classifier.1.weight" in sd:
+        params["classifier"] = {
+            "w": _np(sd["classifier.1.weight"]).T.copy(),
+            "b": _np(sd["classifier.1.bias"]),
+        }
+    else:
+        params["classifier"] = {
+            "w": np.zeros((d_out, cfg.num_labels), np.float32),
+            "b": np.zeros((cfg.num_labels,), np.float32),
+        }
+    return {"params": params, "batch_stats": stats}
+
+
 _IMPORTERS = {
     "llama": _import_llama,
     "gpt2": _import_gpt2,
@@ -469,6 +580,7 @@ _IMPORTERS = {
     "t5": _import_t5,
     "mixtral": _import_mixtral,
     "vit": _import_vit,
+    "resnet": _import_resnet,
 }
 
 # Architecture-wrapper prefixes stripped before mapping, so ForCausalLM /
@@ -480,6 +592,7 @@ _PREFIXES = {
     "t5": (),
     "mixtral": ("model.",),
     "vit": ("vit.",),
+    "resnet": ("resnet.",),
 }
 
 
@@ -517,6 +630,7 @@ _IGNORABLE = (
     "attention.self.distance_embedding",
     "masked_bias",
     ".attn.bias",  # gpt2's causal-mask buffer
+    "num_batches_tracked",  # BN bookkeeping (momentum here is a constant)
 )
 
 
@@ -563,15 +677,18 @@ def import_state_dict(
 
     # Cast leaf-by-leaf IN PLACE so the fp32 staging tree and the target-dtype
     # tree never coexist in full (a 7B import would otherwise hold ~28 GB
-    # fp32 next to the cast copy).
-    def cast_inplace(tree):
+    # fp32 next to the cast copy).  BN batch statistics (resnet) stay fp32 —
+    # they are normalization state, not parameters.
+    def cast_inplace(tree, leaf_dtype):
         for k, v in tree.items():
-            if isinstance(v, dict):
-                cast_inplace(v)
+            if k == "batch_stats":
+                cast_inplace(v, jnp.float32)
+            elif isinstance(v, dict):
+                cast_inplace(v, leaf_dtype)
             else:
-                tree[k] = jnp.asarray(v, dtype)
+                tree[k] = jnp.asarray(v, leaf_dtype)
 
-    cast_inplace(params)
+    cast_inplace(params, dtype)
     return params
 
 

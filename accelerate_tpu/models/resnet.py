@@ -298,11 +298,17 @@ def init_batch_stats(config: ResNetConfig) -> dict:
 
 
 def _conv(x: jax.Array, w: jax.Array, stride: int, c: ResNetConfig) -> jax.Array:
+    # Explicit symmetric padding (torch Conv2d padding=k//2), NOT "SAME":
+    # XLA's SAME pads asymmetrically for stride 2 ((0,1) vs torch's (1,1)),
+    # which would misalign every strided conv by one pixel vs a torch/HF
+    # checkpoint.
+    k = w.shape[0]
+    pad = (k - 1) // 2
     return jax.lax.conv_general_dilated(
         x,
         w.astype(c.dtype),
         window_strides=(stride, stride),
-        padding="SAME",
+        padding=((pad, pad), (pad, pad)),
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
     )
 
@@ -389,8 +395,10 @@ def apply(params: dict, batch_stats: dict, pixels: jax.Array, config: ResNetConf
                     batch_stats["stem"]["bn_var"], new_stats["stem"], "bn", c, train)
     )
     if c.stem == "imagenet":
+        # torch MaxPool2d(3, stride=2, padding=1): symmetric explicit pad.
         x = jax.lax.reduce_window(
-            x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+            x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+            ((0, 0), (1, 1), (1, 1), (0, 0)),
         )
 
     for si, n in enumerate(c.stage_sizes):

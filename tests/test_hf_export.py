@@ -90,7 +90,7 @@ def test_import_export_round_trip(family):
 
 def test_export_unsupported_family_raises():
     with pytest.raises(ValueError, match="Export supports"):
-        hf_export.export_state_dict("resnet", {}, None)
+        hf_export.export_state_dict("mamba", {}, None)
 
 
 def test_t5_export_loads_in_transformers(tmp_path):
@@ -168,3 +168,84 @@ def test_import_export_round_trip_rest(family):
         ),
         params, back,
     )
+
+
+def test_resnet_export_loads_in_transformers(tmp_path):
+    from accelerate_tpu.models import resnet
+
+    cfg = resnet.ResNetConfig(
+        block="bottleneck", stage_sizes=(2, 2), width=8, num_labels=4,
+        stem="imagenet", dtype=jnp.float32,
+    )
+    params = resnet.init_params(cfg, jax.random.key(13))
+    stats = resnet.init_batch_stats(cfg)
+    tree = {"params": params, "batch_stats": stats}
+    out = hf_export.export_hf_checkpoint("resnet", tree, cfg, str(tmp_path / "m"))
+    hf = transformers.AutoModelForImageClassification.from_pretrained(out).eval()
+    rng = np.random.default_rng(2)
+    px = rng.normal(size=(2, 64, 64, 3)).astype(np.float32)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(px.transpose(0, 3, 1, 2))).logits.numpy()
+    pooled, _ = resnet.apply(params, stats, px, cfg, train=False)
+    ours = np.asarray(
+        pooled @ np.asarray(params["classifier"]["w"])
+        + np.asarray(params["classifier"]["b"])
+    )
+    np.testing.assert_allclose(ours, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_resnet_import_export_round_trip():
+    from accelerate_tpu.models import resnet
+
+    cfg = resnet.ResNetConfig(
+        block="bottleneck", stage_sizes=(2, 2), width=8, num_labels=4,
+        stem="imagenet", dtype=jnp.float32,
+    )
+    params = resnet.init_params(cfg, jax.random.key(14))
+    stats = resnet.init_batch_stats(cfg)
+    tree = {"params": params, "batch_stats": stats}
+    sd = hf_export.export_state_dict("resnet", tree, cfg)
+    back = hf_import.import_state_dict("resnet", sd, cfg)
+    assert jax.tree_util.tree_structure(tree) == jax.tree_util.tree_structure(back)
+    jax.tree_util.tree_map_with_path(
+        lambda kp, a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=jax.tree_util.keystr(kp)
+        ),
+        tree, back,
+    )
+
+
+def test_resnet_basic_export_round_trip_and_loads(tmp_path):
+    """Basic-block export path (2 convs, identity stage-0 shortcut)."""
+    from accelerate_tpu.models import resnet
+
+    cfg = resnet.ResNetConfig(
+        block="basic", stage_sizes=(2, 2), width=8, num_labels=3,
+        stem="imagenet", dtype=jnp.float32,
+    )
+    params = resnet.init_params(cfg, jax.random.key(15))
+    stats = resnet.init_batch_stats(cfg)
+    tree = {"params": params, "batch_stats": stats}
+    sd = hf_export.export_state_dict("resnet", tree, cfg)
+    # stage 0 keeps the identity shortcut: no shortcut keys for layers.0.
+    assert "resnet.encoder.stages.0.layers.0.shortcut.convolution.weight" not in sd
+    assert "resnet.encoder.stages.1.layers.0.shortcut.convolution.weight" in sd
+    back = hf_import.import_state_dict("resnet", sd, cfg)
+    jax.tree_util.tree_map_with_path(
+        lambda kp, a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=jax.tree_util.keystr(kp)
+        ),
+        tree, back,
+    )
+    out = hf_export.export_hf_checkpoint("resnet", tree, cfg, str(tmp_path / "m"))
+    hf = transformers.AutoModelForImageClassification.from_pretrained(out).eval()
+    rng = np.random.default_rng(3)
+    px = rng.normal(size=(2, 32, 32, 3)).astype(np.float32)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(px.transpose(0, 3, 1, 2))).logits.numpy()
+    pooled, _ = resnet.apply(params, stats, px, cfg, train=False)
+    ours = np.asarray(
+        pooled @ np.asarray(params["classifier"]["w"])
+        + np.asarray(params["classifier"]["b"])
+    )
+    np.testing.assert_allclose(ours, ref, atol=2e-5, rtol=2e-5)

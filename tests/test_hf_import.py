@@ -280,3 +280,57 @@ def test_load_hf_checkpoint_num_labels_from_id2label(tmp_path):
     )
     assert cfg.num_labels == 3
     assert params["classifier"]["w"].shape == (32, 3)
+
+
+def test_resnet_logits_match_transformers():
+    """HF ResNet (v1.5 blocks) -> native resnet with imported BN running
+    stats; eval-mode logits match the transformers forward."""
+    from accelerate_tpu.models import resnet
+
+    hf_cfg = transformers.ResNetConfig(
+        num_channels=3, embedding_size=8, hidden_sizes=[32, 64], depths=[2, 2],
+        layer_type="bottleneck", num_labels=4, downsample_in_first_stage=False,
+    )
+    torch.manual_seed(11)
+    hf = transformers.ResNetForImageClassification(hf_cfg).eval()
+    family, cfg, tree = hf_import.from_hf(
+        hf, dtype=jnp.float32, param_dtype=jnp.float32
+    )
+    assert family == "resnet"
+    params, stats = tree["params"], tree["batch_stats"]
+    rng = np.random.default_rng(0)
+    px = rng.normal(size=(2, 64, 64, 3)).astype(np.float32)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(px.transpose(0, 3, 1, 2))).logits.numpy()
+    pooled, _ = resnet.apply(params, stats, px, cfg, train=False)
+    ours = np.asarray(
+        pooled @ np.asarray(params["classifier"]["w"])
+        + np.asarray(params["classifier"]["b"])
+    )
+    np.testing.assert_allclose(ours, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_resnet_basic_block_import_parity():
+    from accelerate_tpu.models import resnet
+
+    hf_cfg = transformers.ResNetConfig(
+        num_channels=3, embedding_size=8, hidden_sizes=[8, 16], depths=[2, 2],
+        layer_type="basic", num_labels=3, downsample_in_first_stage=False,
+    )
+    torch.manual_seed(12)
+    hf = transformers.ResNetForImageClassification(hf_cfg).eval()
+    family, cfg, tree = hf_import.from_hf(
+        hf, dtype=jnp.float32, param_dtype=jnp.float32
+    )
+    assert cfg.block == "basic"
+    params, stats = tree["params"], tree["batch_stats"]
+    rng = np.random.default_rng(1)
+    px = rng.normal(size=(2, 32, 32, 3)).astype(np.float32)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(px.transpose(0, 3, 1, 2))).logits.numpy()
+    pooled, _ = resnet.apply(params, stats, px, cfg, train=False)
+    ours = np.asarray(
+        pooled @ np.asarray(params["classifier"]["w"])
+        + np.asarray(params["classifier"]["b"])
+    )
+    np.testing.assert_allclose(ours, ref, atol=2e-5, rtol=2e-5)
