@@ -692,9 +692,15 @@ def import_state_dict(
     return params
 
 
-def load_hf_checkpoint(path: str, strict: bool = True, **config_overrides):
+def load_hf_checkpoint(
+    path: str, strict: bool = True, quantize: Optional[str] = None, **config_overrides
+):
     """Load an HF checkpoint directory directly from disk ->
     ``(family, native_config, native_params)``.
+
+    ``quantize="int8"`` applies the family's ``quantize_weights`` before
+    returning (decoder families only) — one call from an HF directory to a
+    >HBM-in-bf16 model decoding int8-weight-resident on a single chip.
 
     Reads ``config.json`` plus ``model.safetensors`` (or the
     ``model.safetensors.index.json`` shard index / legacy
@@ -724,6 +730,22 @@ def load_hf_checkpoint(path: str, strict: bool = True, **config_overrides):
     family = _detect_family(hf_config)
     cfg = config_from_hf(hf_config, **config_overrides)
 
+    # Validate the quantize request from config.json alone, BEFORE reading
+    # shards — a typo'd mode or a family without the weight-resident path
+    # must fail in milliseconds, not after tens of GB of IO.
+    qw = None
+    if quantize is not None:
+        if quantize != "int8":
+            raise ValueError(f"quantize must be 'int8' or None, got {quantize!r}")
+        import importlib
+
+        mod = importlib.import_module(f".{family}", __package__)
+        qw = getattr(mod, "quantize_weights", None)
+        if qw is None:
+            raise ValueError(
+                f"{family} has no int8-weight-resident path (quantize_weights)."
+            )
+
     from ..checkpointing import read_safetensors_state_dict
 
     sd = read_safetensors_state_dict(path, "model.safetensors")
@@ -738,6 +760,8 @@ def load_hf_checkpoint(path: str, strict: bool = True, **config_overrides):
                 f"No model.safetensors(.index.json) or pytorch_model.bin in {path}"
             )
     params = import_state_dict(family, sd, cfg, strict=strict, consume_source=True)
+    if qw is not None:
+        params = qw(params)
     return family, cfg, params
 
 

@@ -334,3 +334,31 @@ def test_resnet_basic_block_import_parity():
         + np.asarray(params["classifier"]["b"])
     )
     np.testing.assert_allclose(ours, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_load_hf_checkpoint_quantize_int8(tmp_path):
+    """quantize='int8': one call from an HF directory to int8-weight-resident
+    decode, greedy-identical to quantizing after a plain load."""
+    from accelerate_tpu.utils.quantization import QuantizedArray
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=32,
+    )
+    torch.manual_seed(13)
+    transformers.LlamaForCausalLM(hf_cfg).save_pretrained(tmp_path / "m")
+    family, cfg, qparams = hf_import.load_hf_checkpoint(
+        str(tmp_path / "m"), quantize="int8",
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    assert isinstance(qparams["layers"]["wq"], QuantizedArray)
+    _, _, plain = hf_import.load_hf_checkpoint(
+        str(tmp_path / "m"), dtype=jnp.float32, param_dtype=jnp.float32
+    )
+    ids = _ids(64, (1, 6))
+    a = np.asarray(llama.generate(qparams, ids, cfg, max_new_tokens=4))
+    b = np.asarray(llama.generate(llama.quantize_weights(plain), ids, cfg, max_new_tokens=4))
+    np.testing.assert_array_equal(a, b)
+    with pytest.raises(ValueError, match="quantize"):
+        hf_import.load_hf_checkpoint(str(tmp_path / "m"), quantize="int4")
