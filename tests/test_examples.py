@@ -176,3 +176,23 @@ def test_by_feature_scripts_stay_in_sync():
         nlp, os.path.join(EXAMPLES, "complete_nlp_example.py"), parser_only=False
     )
     assert "save_state" in "".join(diff)
+
+
+def test_jax_native_hf_finetune_example(tmp_path):
+    """The full interop loop: HF in -> mesh fine-tune -> HF out, and the
+    exported directory loads in transformers."""
+    pytest.importorskip("transformers")
+    mod = _load(os.path.join(EXAMPLES, "jax_native", "hf_finetune.py"), "hf_finetune")
+    out = str(tmp_path / "exported")
+    argv = sys.argv
+    sys.argv = ["hf_finetune.py", "--fsdp", "4", "--dp", "2", "--steps", "4",
+                "--batch_size", "8", "--seq_len", "16", "--out", out]
+    try:
+        loss = mod.main()
+    finally:
+        sys.argv = argv
+    assert loss is not None and loss < 10.0
+    import transformers
+
+    hf = transformers.AutoModelForCausalLM.from_pretrained(out)
+    assert hf.config.n_layer == 2
