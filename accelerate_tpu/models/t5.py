@@ -389,9 +389,15 @@ def decode_cached(
     config: "T5Config",
     cache: dict,
     attention_mask: Optional[jax.Array] = None,
+    num_beams: int = 1,
 ) -> tuple[jax.Array, dict]:
     """Decoder forward over new tokens at positions index..index+T with
-    self-attn cache read/write and precomputed cross K/V."""
+    self-attn cache read/write and precomputed cross K/V.
+
+    ``num_beams > 1``: the decoder batch is ``B*num_beams`` (tiled self
+    cache) while the cross K/V and ``attention_mask`` stay at batch ``B`` —
+    beams fold into the cross attention as a grouped einsum instead of
+    tiling the encode output K-fold in HBM."""
     from .generation import check_cache_room
 
     c = config
@@ -401,6 +407,9 @@ def decode_cached(
     max_len = cache["k"].shape[2]
     check_cache_room(index, t, max_len)
     s = cache["cross_k"].shape[2]  # encoder length lives in the cross cache
+    if b % num_beams:
+        raise ValueError(f"decoder batch {b} not divisible by num_beams {num_beams}")
+    b0 = b // num_beams
 
     positions = index + jnp.arange(t)
     bias = _rel_bias_at(params["dec_rel_bias"].astype(jnp.float32), positions, max_len, c)
@@ -408,7 +417,7 @@ def decode_cached(
     self_mask = jnp.broadcast_to(positions[:, None] >= k_pos[None, :], (b, t, max_len))
     cross_mask = None
     if attention_mask is not None:
-        cross_mask = jnp.broadcast_to(attention_mask.astype(bool)[:, None, :], (b, t, s))
+        cross_mask = jnp.broadcast_to(attention_mask.astype(bool)[:, None, :], (b0, t, s))
 
     y = _embed_lookup(params["shared_embed"], decoder_input_ids, c.dtype)
 
@@ -430,14 +439,15 @@ def decode_cached(
         probs = jax.nn.softmax(scores, axis=-1).astype(v_full.dtype)
         attn = jnp.einsum("bhst,bthd->bshd", probs, v_full).reshape(b, t, nh * hd)
         x = x + attn @ lp["wo"].astype(c.dtype)
-        # Cross-attention against precomputed encoder K/V.
+        # Cross-attention against precomputed encoder K/V (batch b0; beams
+        # fold via the grouped einsum — no K-fold tile of the encode output).
         h = _rms_norm(x, lp["ln_cross"], c.rms_eps)
-        q = (h @ lp["cross_wq"].astype(c.dtype)).reshape(b, t, nh, hd)
-        scores = jnp.einsum("bshd,bthd->bhst", q, xk).astype(jnp.float32)
+        q = (h @ lp["cross_wq"].astype(c.dtype)).reshape(b0, num_beams, t, nh, hd)
+        scores = jnp.einsum("bkthd,bshd->bkhts", q, xk).astype(jnp.float32)
         if cross_mask is not None:
-            scores = jnp.where(cross_mask[:, None], scores, -1e30)
+            scores = jnp.where(cross_mask[:, None, None], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(xv.dtype)
-        attn = jnp.einsum("bhst,bthd->bshd", probs, xv).reshape(b, t, nh * hd)
+        attn = jnp.einsum("bkhts,bshd->bkthd", probs, xv).reshape(b, t, nh * hd)
         x = x + attn @ lp["cross_wo"].astype(c.dtype)
         # MLP.
         h = _rms_norm(x, lp["ln_mlp"], c.rms_eps)
@@ -491,6 +501,60 @@ def generate(
         _apply_cached, _init_cache, params, start, c,
         max_new_tokens, temperature=temperature, key=key,
         top_k=top_k, top_p=top_p,
+    )
+
+
+def generate_beam(
+    params: dict,
+    input_ids: jax.Array,
+    config: "T5Config",
+    max_new_tokens: int,
+    num_beams: int = 4,
+    length_penalty: float = 1.0,
+    eos_token_id: Optional[int] = None,
+    decoder_start_token_id: int = 0,
+    attention_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Seq2seq beam search: encode once, beam-decode with the shared
+    machinery (``models/generation.py beam_search``).  The per-layer cross
+    K/V tile per beam like the self cache (batch on axis 1); the source
+    attention mask tiles to ``B*num_beams`` for the decode steps.  Returns
+    decoder ids ``[B, 1 + max_new_tokens]``."""
+    from .generation import beam_search
+
+    c = config
+    b = input_ids.shape[0]
+    enc_out = encode(params, input_ids, c, attention_mask)
+    cross: dict = {}
+
+    def _init_cache(cfg, batch_size, max_len):
+        cache = init_decoder_cache(params, enc_out, cfg, max_len)
+        # Keep the cross K/V OUT of the cache beam_search tiles/reorders:
+        # all K beams of a batch row share the same encode output, so tiling
+        # would K-fold its HBM and gather-copy it every decode step for
+        # nothing — decode_cached folds beams via a grouped einsum instead.
+        cross["cross_k"] = cache.pop("cross_k")
+        cross["cross_v"] = cache.pop("cross_v")
+        return cache
+
+    def _apply_cached(p, ids, cfg, cache):
+        # Prefill runs at batch B (shared prompt); decode steps at B*K.
+        beams = 1 if ids.shape[0] == b else num_beams
+        full = dict(cache)
+        full.update(cross)
+        logits, new_cache = decode_cached(
+            p, ids, cfg, full, attention_mask, num_beams=beams
+        )
+        new_cache = dict(new_cache)
+        new_cache.pop("cross_k")
+        new_cache.pop("cross_v")
+        return logits, new_cache
+
+    start = jnp.full((b, 1), decoder_start_token_id, jnp.int32)
+    return beam_search(
+        _apply_cached, _init_cache, params, start, c, max_new_tokens,
+        num_beams=num_beams, length_penalty=length_penalty,
+        eos_token_id=eos_token_id,
     )
 
 
