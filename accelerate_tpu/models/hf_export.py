@@ -47,6 +47,11 @@ def _export_llama(params: dict, cfg) -> dict:
     _unstack(lay["w_gate"], pre + "mlp.gate_proj.weight", sd, transpose=True)
     _unstack(lay["w_up"], pre + "mlp.up_proj.weight", sd, transpose=True)
     _unstack(lay["w_down"], pre + "mlp.down_proj.weight", sd, transpose=True)
+    if "bq" in lay:
+        _unstack(lay["bq"], pre + "self_attn.q_proj.bias", sd)
+        _unstack(lay["bk"], pre + "self_attn.k_proj.bias", sd)
+        _unstack(lay["bv"], pre + "self_attn.v_proj.bias", sd)
+        _unstack(lay["bo"], pre + "self_attn.o_proj.bias", sd)
     _unstack(lay["ln_attn"], pre + "input_layernorm.weight", sd)
     _unstack(lay["ln_mlp"], pre + "post_attention_layernorm.weight", sd)
     sd["model.norm.weight"] = _np32(params["final_norm"])
@@ -312,7 +317,7 @@ def _hf_config_dict(family: str, cfg, params: dict) -> dict:
             "rope_theta": cfg.rope_theta,
             "tie_word_embeddings": cfg.tie_embeddings,
             "hidden_act": "silu",
-            "attention_bias": False,
+            "attention_bias": cfg.attention_bias,
             "mlp_bias": False,
             "torch_dtype": "float32",
         }

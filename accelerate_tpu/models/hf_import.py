@@ -13,7 +13,10 @@ Supported families and their HF architectures:
 
 - ``llama``   — LlamaForCausalLM / LlamaModel (HF rotate-half RoPE matches
                 the native `_rope`; torch Linear weights are [out, in] and
-                transpose to the native [in, out] matmul layout)
+                transpose to the native [in, out] matmul layout) — and
+                Qwen2ForCausalLM, the same architecture with Q/K/V biases
+                (``LlamaConfig(attention_bias=True)``; sliding-window
+                configs are refused)
 - ``gpt2``    — GPT2LMHeadModel / GPT2Model (Conv1D stores [in, out]:
                 no transpose; wte is tied as the unembedding)
 - ``bert``    — BertForSequenceClassification / BertModel (post-LN; note
@@ -78,10 +81,16 @@ def _stack_cat(sd: dict, fmts: list, n: int, transpose: bool = False) -> np.ndar
 def _detect_family(hf_config) -> str:
     mt = getattr(hf_config, "model_type", "")
     known = {"llama", "gpt2", "bert", "t5", "mixtral", "vit", "resnet"}
+    if mt == "qwen2":
+        # Qwen2 is the llama architecture with Q/K/V biases; it maps onto
+        # the llama family with attention_bias=True (sliding-window configs
+        # are refused in config_from_hf).
+        return "llama"
     if mt in known:
         return mt
     raise ValueError(
-        f"Unsupported HF model_type {mt!r}; supported: {sorted(known)}"
+        f"Unsupported HF model_type {mt!r}; supported: {sorted(known)} "
+        "(qwen2 maps onto llama)"
     )
 
 
@@ -92,6 +101,20 @@ def config_from_hf(hf_config, **overrides):
     if family == "llama":
         from .llama import LlamaConfig
 
+        if getattr(c, "model_type", "llama") == "qwen2" and getattr(
+            c, "use_sliding_window", False
+        ):
+            raise ValueError(
+                "qwen2 import requires use_sliding_window=False: the native "
+                "attention paths are full-causal."
+            )
+        # llama checkpoints default attention_bias False; qwen2's bias is
+        # architectural (always on — transformers hardcodes it, so a stray
+        # "attention_bias": false in a qwen2 config.json must not win).
+        if getattr(c, "model_type", "llama") == "qwen2":
+            bias = True
+        else:
+            bias = bool(getattr(c, "attention_bias", False))
         kw = dict(
             vocab_size=c.vocab_size,
             hidden_size=c.hidden_size,
@@ -104,6 +127,7 @@ def config_from_hf(hf_config, **overrides):
             rope_theta=float(getattr(c, "rope_theta", 10000.0)),
             rms_eps=float(c.rms_norm_eps),
             tie_embeddings=bool(getattr(c, "tie_word_embeddings", False)),
+            attention_bias=bias,
         )
         kw.update(overrides)
         return LlamaConfig(**kw)
@@ -278,6 +302,18 @@ def _import_llama(sd: dict, cfg) -> dict:
         },
         "final_norm": _np(sd["norm.weight"]),
     }
+    if cfg.attention_bias:
+        params["layers"]["bq"] = _stack(sd, pre + "self_attn.q_proj.bias", L)
+        params["layers"]["bk"] = _stack(sd, pre + "self_attn.k_proj.bias", L)
+        params["layers"]["bv"] = _stack(sd, pre + "self_attn.v_proj.bias", L)
+        # HF llama with attention_bias also biases o_proj; qwen2 does not —
+        # zeros are numerically identical to "no bias".
+        if "layers.0.self_attn.o_proj.bias" in sd:
+            params["layers"]["bo"] = _stack(sd, pre + "self_attn.o_proj.bias", L)
+        else:
+            params["layers"]["bo"] = np.zeros(
+                (L, cfg.hidden_size), np.float32
+            )
     head = sd.get("lm_head.weight")  # consumed even when tied (alias)
     if not cfg.tie_embeddings:
         params["lm_head"] = (

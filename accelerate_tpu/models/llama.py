@@ -51,6 +51,9 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     rms_eps: float = 1e-5
     tie_embeddings: bool = False
+    # Q/K/V projection biases (the Qwen2-class variant of the llama
+    # architecture; plain llama keeps False).
+    attention_bias: bool = False
     dtype: Any = jnp.bfloat16  # compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = True
@@ -163,6 +166,8 @@ PARTITION_RULES: list[tuple[str, P]] = [
     (r"layers/w_gate", P(None, "fsdp", "tp")),
     (r"layers/w_up", P(None, "fsdp", "tp")),
     (r"layers/w_down", P(None, "tp", "fsdp")),
+    (r"layers/b[qkv]$", P(None, "tp")),
+    (r"layers/bo$", P(None, "fsdp")),
     (r"layers/ln_", P(None, None)),
     (r"final_norm", P(None)),
     (r"lm_head", P("fsdp", "tp")),
@@ -204,6 +209,11 @@ def _param_shapes(config: LlamaConfig) -> dict:
         },
         "final_norm": (d,),
     }
+    if c.attention_bias:
+        shapes["layers"]["bq"] = (L, c.num_heads * hd)
+        shapes["layers"]["bk"] = (L, c.num_kv_heads * hd)
+        shapes["layers"]["bv"] = (L, c.num_kv_heads * hd)
+        shapes["layers"]["bo"] = (L, d)  # zero in qwen2 (no o_proj bias)
     if not c.tie_embeddings:
         shapes["lm_head"] = (d, c.vocab_size)
     return shapes
@@ -221,6 +231,8 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
         name = str(getattr(kp[-1], "key", kp[-1]))
         if name in ("ln_attn", "ln_mlp", "final_norm"):
             return jnp.ones(shape, config.param_dtype)  # norm scales
+        if name in ("bq", "bk", "bv", "bo"):
+            return jnp.zeros(shape, config.param_dtype)  # attention biases
         # Embedding table: lookup is one-hot (effective fan-in 1), so scale by
         # hidden size, not vocab size.
         fan_in = config.hidden_size if name == "embed" else shape[-2]
@@ -394,6 +406,25 @@ def sp_attention(q, k, v, c, *, causal: bool = True, kv_valid=None) -> jax.Array
     return ring_attention(q, k, v, mesh=None, axis_name="sp", causal=causal, kv_valid=kv_valid)
 
 
+def _qkv_proj(h, p, c, b: int, s: int):
+    """Q/K/V projections with the optional Qwen2-style biases (present in
+    ``p`` iff ``attention_bias`` — key presence is static at trace time, so
+    the plain-llama path compiles without the adds)."""
+    hd = c.head_dim_
+    q = _mm(h, p["wq"], c)
+    k = _mm(h, p["wk"], c)
+    v = _mm(h, p["wv"], c)
+    if "bq" in p:
+        q = q + p["bq"].astype(q.dtype)
+        k = k + p["bk"].astype(k.dtype)
+        v = v + p["bv"].astype(v.dtype)
+    return (
+        q.reshape(b, s, c.num_heads, hd),
+        k.reshape(b, s, c.num_kv_heads, hd),
+        v.reshape(b, s, c.num_kv_heads, hd),
+    )
+
+
 def attention_block(x, p, c, mask, positions, kv_valid=None) -> jax.Array:
     """Pre-norm attention sub-block with residual: shared by llama and the MoE
     models (mixtral) — both get the ring-attention (sp) and fp8 paths from one
@@ -406,9 +437,7 @@ def attention_block(x, p, c, mask, positions, kv_valid=None) -> jax.Array:
     hd = c.head_dim_
     h = _rms_norm(x, p["ln_attn"], c.rms_eps)
     b, s, _ = h.shape
-    q = _mm(h, p["wq"], c).reshape(b, s, c.num_heads, hd)
-    k = _mm(h, p["wk"], c).reshape(b, s, c.num_kv_heads, hd)
-    v = _mm(h, p["wv"], c).reshape(b, s, c.num_kv_heads, hd)
+    q, k, v = _qkv_proj(h, p, c, b, s)
     q, k = _rope(q, k, positions, c.rope_theta)
     if _sp_active():
         attn = sp_attention(q, k, v, c, causal=True, kv_valid=kv_valid)
@@ -441,7 +470,10 @@ def attention_block(x, p, c, mask, positions, kv_valid=None) -> jax.Array:
             if kv_valid is not None:
                 mask = mask & kv_valid.astype(bool)[:, None, :]
         attn = _attention(q, k, v, mask, c.num_heads // c.num_kv_heads)
-    return x + _mm(attn.reshape(b, s, c.num_heads * hd), p["wo"], c)
+    out = _mm(attn.reshape(b, s, c.num_heads * hd), p["wo"], c)
+    if "bo" in p:
+        out = out + p["bo"].astype(out.dtype)
+    return x + out
 
 
 def _layer(carry, layer_params, *, config: LlamaConfig, mask, positions, act_spec, kv_valid=None):
@@ -647,9 +679,7 @@ def _attention_block_cached(x, p, c, ck, cv, index, positions):
     h = _rms_norm(x, p["ln_attn"], c.rms_eps)
     b, s, _ = h.shape
     max_len = (ck[0] if isinstance(ck, tuple) else ck).shape[1]
-    q = _mm(h, p["wq"], c).reshape(b, s, c.num_heads, hd)
-    k = _mm(h, p["wk"], c).reshape(b, s, c.num_kv_heads, hd)
-    v = _mm(h, p["wv"], c).reshape(b, s, c.num_kv_heads, hd)
+    q, k, v = _qkv_proj(h, p, c, b, s)
     q, k = _rope(q, k, positions, c.rope_theta)
 
     from .generation import cache_write
@@ -664,7 +694,10 @@ def _attention_block_cached(x, p, c, ck, cv, index, positions):
     k_pos = jnp.arange(max_len)
     mask = jnp.broadcast_to(q_pos[:, None] >= k_pos[None, :], (b, s, max_len))
     attn = _attention(q, k_full, v_full, mask, c.num_heads // c.num_kv_heads)
-    return x + _mm(attn.reshape(b, s, c.num_heads * hd), p["wo"], c), ck, cv
+    out = _mm(attn.reshape(b, s, c.num_heads * hd), p["wo"], c)
+    if "bo" in p:
+        out = out + p["bo"].astype(out.dtype)
+    return x + out, ck, cv
 
 
 def apply_cached(

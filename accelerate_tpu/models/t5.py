@@ -516,10 +516,12 @@ def generate_beam(
     attention_mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Seq2seq beam search: encode once, beam-decode with the shared
-    machinery (``models/generation.py beam_search``).  The per-layer cross
-    K/V tile per beam like the self cache (batch on axis 1); the source
-    attention mask tiles to ``B*num_beams`` for the decode steps.  Returns
-    decoder ids ``[B, 1 + max_new_tokens]``."""
+    machinery (``models/generation.py beam_search``).  Only the self-attn
+    cache tiles per beam; the per-layer cross K/V and the source
+    ``attention_mask`` stay at batch ``B`` — beams fold into the cross
+    attention as a grouped einsum (``decode_cached(num_beams=K)``), so the
+    encode output is never duplicated K-fold in HBM.  Returns decoder ids
+    ``[B, 1 + max_new_tokens]``."""
     from .generation import beam_search
 
     c = config

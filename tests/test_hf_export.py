@@ -249,3 +249,30 @@ def test_resnet_basic_export_round_trip_and_loads(tmp_path):
         + np.asarray(params["classifier"]["b"])
     )
     np.testing.assert_allclose(ours, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_biased_llama_export_round_trip(tmp_path):
+    """attention_bias=True (the Qwen2-class variant): export loads in
+    transformers with logits parity and round-trips bit-exactly."""
+    cfg = llama.LlamaConfig.tiny(
+        dtype=jnp.float32, param_dtype=jnp.float32, attention_bias=True
+    )
+    params = llama.init_params(cfg, jax.random.key(16))
+    # Non-zero biases so the parity actually exercises them.
+    params["layers"]["bq"] = params["layers"]["bq"] + 0.1
+    params["layers"]["bo"] = params["layers"]["bo"] - 0.05
+    out = hf_export.export_hf_checkpoint("llama", params, cfg, str(tmp_path / "m"))
+    hf = transformers.AutoModelForCausalLM.from_pretrained(out).eval()
+    ids = _ids(cfg.vocab_size, (2, 8))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids).long()).logits.numpy()
+    ours = np.asarray(llama.apply(params, jnp.asarray(ids), cfg))
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+    sd = hf_export.export_state_dict("llama", params, cfg)
+    back = hf_import.import_state_dict("llama", sd, cfg)
+    jax.tree_util.tree_map_with_path(
+        lambda kp, a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=jax.tree_util.keystr(kp)
+        ),
+        params, back,
+    )

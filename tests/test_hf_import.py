@@ -194,23 +194,69 @@ def test_gated_t5_refused():
 
 
 def test_unconsumed_tensors_raise():
-    """A checkpoint with weights the mapping does not model (llama attention
-    biases) must fail loudly, not convert to a silently different model."""
+    """A checkpoint with weights the mapping does not model must fail loudly,
+    not convert to a silently different model."""
     hf_cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=32,
+    )
+    torch.manual_seed(7)
+    hf = transformers.LlamaForCausalLM(hf_cfg)
+    sd = dict(hf.state_dict())
+    sd["model.layers.0.mystery_adapter.weight"] = torch.zeros(4, 4)
+    cfg = hf_import.config_from_hf(hf_cfg, dtype=jnp.float32, param_dtype=jnp.float32)
+    with pytest.raises(ValueError, match="unmapped"):
+        hf_import.import_state_dict("llama", sd, cfg)
+    # strict=False discards them knowingly.
+    params = hf_import.import_state_dict("llama", sd, cfg, strict=False)
+    assert "layers" in params
+
+
+def test_qwen2_and_biased_llama_logits_match_transformers():
+    """Qwen2 (llama + Q/K/V biases) maps onto the llama family; logits match
+    the transformers forward and greedy generation is token-identical."""
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-6, rope_theta=10000.0,
+        use_sliding_window=False, tie_word_embeddings=False,
+    )
+    torch.manual_seed(14)
+    hf = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+    family, cfg, params = hf_import.from_hf(
+        hf, dtype=jnp.float32, param_dtype=jnp.float32
+    )
+    assert family == "llama" and cfg.attention_bias
+    assert "bq" in params["layers"]
+    ids = _ids(128, (2, 10))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids).long()).logits.numpy()
+    ours = np.asarray(llama.apply(params, jnp.asarray(ids), cfg))
+    np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-4)
+    with torch.no_grad():
+        hf_out = hf.generate(
+            torch.from_numpy(ids).long(), max_new_tokens=5, do_sample=False
+        ).numpy()
+    ours_out = np.asarray(llama.generate(params, ids, cfg, max_new_tokens=5))
+    np.testing.assert_array_equal(ours_out, hf_out)
+
+    # LlamaForCausalLM with attention_bias=True takes the same path.
+    lcfg = transformers.LlamaConfig(
         vocab_size=64, hidden_size=32, intermediate_size=64,
         num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=4,
         max_position_embeddings=32, attention_bias=True,
     )
-    torch.manual_seed(7)
-    hf = transformers.LlamaForCausalLM(hf_cfg)
-    with pytest.raises(ValueError, match="unmapped"):
-        hf_import.from_hf(hf, dtype=jnp.float32, param_dtype=jnp.float32)
-    # strict=False discards them knowingly.
-    cfg = hf_import.config_from_hf(hf_cfg, dtype=jnp.float32, param_dtype=jnp.float32)
-    params = hf_import.import_state_dict(
-        "llama", hf.state_dict(), cfg, strict=False
+    torch.manual_seed(15)
+    lhf = transformers.LlamaForCausalLM(lcfg).eval()
+    _, lc, lp = hf_import.from_hf(lhf, dtype=jnp.float32, param_dtype=jnp.float32)
+    lids = _ids(64, (1, 6))
+    with torch.no_grad():
+        lref = lhf(torch.from_numpy(lids).long()).logits.numpy()
+    np.testing.assert_allclose(
+        np.asarray(llama.apply(lp, jnp.asarray(lids), lc)), lref,
+        atol=3e-4, rtol=3e-4,
     )
-    assert "layers" in params
 
 
 def test_llama_explicit_head_dim_passthrough():
