@@ -37,6 +37,15 @@ def _unstack(tree_leaf, fmt: str, out: dict, transpose: bool = False):
 
 
 def _export_llama(params: dict, cfg) -> dict:
+    # One source of truth: config.json's attention_bias must match whether
+    # bias tensors exist, or from_pretrained silently drops/initializes them.
+    if ("bq" in params["layers"]) != bool(cfg.attention_bias):
+        raise ValueError(
+            "attention_bias mismatch: params "
+            f"{'contain' if 'bq' in params['layers'] else 'lack'} bias "
+            f"tensors but cfg.attention_bias={cfg.attention_bias}; rebuild "
+            "the config with the flag matching the params."
+        )
     sd: dict = {"model.embed_tokens.weight": _np32(params["embed"])}
     lay = params["layers"]
     pre = "model.layers.{}."

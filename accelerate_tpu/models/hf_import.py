@@ -13,10 +13,11 @@ Supported families and their HF architectures:
 
 - ``llama``   — LlamaForCausalLM / LlamaModel (HF rotate-half RoPE matches
                 the native `_rope`; torch Linear weights are [out, in] and
-                transpose to the native [in, out] matmul layout) — and
-                Qwen2ForCausalLM, the same architecture with Q/K/V biases
-                (``LlamaConfig(attention_bias=True)``; sliding-window
-                configs are refused)
+                transpose to the native [in, out] matmul layout) — plus
+                Qwen2ForCausalLM (the same architecture with Q/K/V biases,
+                ``LlamaConfig(attention_bias=True)``) and MistralForCausalLM
+                (llama-shaped GQA, v0.2+); sliding-window configs are
+                refused for both
 - ``gpt2``    — GPT2LMHeadModel / GPT2Model (Conv1D stores [in, out]:
                 no transpose; wte is tied as the unembedding)
 - ``bert``    — BertForSequenceClassification / BertModel (post-LN; note
@@ -81,16 +82,16 @@ def _stack_cat(sd: dict, fmts: list, n: int, transpose: bool = False) -> np.ndar
 def _detect_family(hf_config) -> str:
     mt = getattr(hf_config, "model_type", "")
     known = {"llama", "gpt2", "bert", "t5", "mixtral", "vit", "resnet"}
-    if mt == "qwen2":
-        # Qwen2 is the llama architecture with Q/K/V biases; it maps onto
-        # the llama family with attention_bias=True (sliding-window configs
-        # are refused in config_from_hf).
+    if mt in ("qwen2", "mistral"):
+        # llama-architecture variants: qwen2 adds Q/K/V biases, mistral is
+        # llama-shaped GQA (both map onto the llama family; sliding-window
+        # configs are refused in config_from_hf).
         return "llama"
     if mt in known:
         return mt
     raise ValueError(
         f"Unsupported HF model_type {mt!r}; supported: {sorted(known)} "
-        "(qwen2 maps onto llama)"
+        "(qwen2 and mistral map onto llama)"
     )
 
 
@@ -101,20 +102,22 @@ def config_from_hf(hf_config, **overrides):
     if family == "llama":
         from .llama import LlamaConfig
 
-        if getattr(c, "model_type", "llama") == "qwen2" and getattr(
-            c, "use_sliding_window", False
-        ):
+        mt = getattr(c, "model_type", "llama")
+        if mt == "qwen2" and getattr(c, "use_sliding_window", False):
             raise ValueError(
                 "qwen2 import requires use_sliding_window=False: the native "
                 "attention paths are full-causal."
             )
+        if mt == "mistral" and getattr(c, "sliding_window", None) is not None:
+            raise ValueError(
+                "mistral import requires sliding_window=null (v0.2+ configs): "
+                "the native attention paths are full-causal, so a windowed "
+                "checkpoint would silently attend differently."
+            )
         # llama checkpoints default attention_bias False; qwen2's bias is
         # architectural (always on — transformers hardcodes it, so a stray
         # "attention_bias": false in a qwen2 config.json must not win).
-        if getattr(c, "model_type", "llama") == "qwen2":
-            bias = True
-        else:
-            bias = bool(getattr(c, "attention_bias", False))
+        bias = True if mt == "qwen2" else bool(getattr(c, "attention_bias", False))
         kw = dict(
             vocab_size=c.vocab_size,
             hidden_size=c.hidden_size,

@@ -408,3 +408,38 @@ def test_load_hf_checkpoint_quantize_int8(tmp_path):
     np.testing.assert_array_equal(a, b)
     with pytest.raises(ValueError, match="quantize"):
         hf_import.load_hf_checkpoint(str(tmp_path / "m"), quantize="int4")
+
+
+def test_mistral_maps_onto_llama():
+    """Mistral (llama-shaped GQA, no biases) maps onto the llama family;
+    windowed configs are refused."""
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=96, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=48, sliding_window=None,
+    )
+    torch.manual_seed(16)
+    hf = transformers.MistralForCausalLM(hf_cfg).eval()
+    family, cfg, params = hf_import.from_hf(
+        hf, dtype=jnp.float32, param_dtype=jnp.float32
+    )
+    assert family == "llama" and not cfg.attention_bias
+    ids = _ids(96, (2, 9))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids).long()).logits.numpy()
+    ours = np.asarray(llama.apply(params, jnp.asarray(ids), cfg))
+    np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-4)
+    with torch.no_grad():
+        hf_out = hf.generate(
+            torch.from_numpy(ids).long(), max_new_tokens=4, do_sample=False
+        ).numpy()
+    ours_out = np.asarray(llama.generate(params, ids, cfg, max_new_tokens=4))
+    np.testing.assert_array_equal(ours_out, hf_out)
+
+    windowed = transformers.MistralConfig(
+        vocab_size=96, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        sliding_window=8,
+    )
+    with pytest.raises(ValueError, match="sliding_window"):
+        hf_import.config_from_hf(windowed)
