@@ -311,9 +311,7 @@ def _hf_config_dict(family: str, cfg, params: dict) -> dict:
     it from shapes), so a 4*hidden guess would write config.json claims that
     contradict the tensors for non-standard widths."""
     if family == "llama":
-        return {
-            "model_type": "llama",
-            "architectures": ["LlamaForCausalLM"],
+        common = {
             "vocab_size": cfg.vocab_size,
             "hidden_size": cfg.hidden_size,
             "intermediate_size": cfg.intermediate_size,
@@ -325,11 +323,39 @@ def _hf_config_dict(family: str, cfg, params: dict) -> dict:
             "rms_norm_eps": cfg.rms_eps,
             "rope_theta": cfg.rope_theta,
             "tie_word_embeddings": cfg.tie_embeddings,
-            "hidden_act": "silu",
             "attention_bias": cfg.attention_bias,
-            "mlp_bias": False,
             "torch_dtype": "float32",
         }
+        if cfg.rms_offset:
+            # Gemma-convention configs share the llama tensor names but
+            # carry different semantics — emit a gemma config so
+            # from_pretrained builds the right module.
+            if cfg.hidden_act != "gelu_tanh" or not cfg.embed_scale or not cfg.tie_embeddings:
+                raise ValueError(
+                    "rms_offset configs export as gemma and need the full "
+                    "gemma convention: hidden_act='gelu_tanh', "
+                    "embed_scale=True, tie_embeddings=True."
+                )
+            common.update({
+                "model_type": "gemma",
+                "architectures": ["GemmaForCausalLM"],
+                "hidden_act": "gelu_pytorch_tanh",
+                "hidden_activation": "gelu_pytorch_tanh",
+            })
+            return common
+        if cfg.hidden_act != "silu" or cfg.embed_scale:
+            raise ValueError(
+                "llama export supports the silu/no-embed-scale convention or "
+                "the full gemma convention (rms_offset=True); this mix is "
+                "not representable as an HF architecture."
+            )
+        common.update({
+            "model_type": "llama",
+            "architectures": ["LlamaForCausalLM"],
+            "hidden_act": "silu",
+            "mlp_bias": False,
+        })
+        return common
     if family == "gpt2":
         return {
             "model_type": "gpt2",

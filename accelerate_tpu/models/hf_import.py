@@ -15,9 +15,11 @@ Supported families and their HF architectures:
                 the native `_rope`; torch Linear weights are [out, in] and
                 transpose to the native [in, out] matmul layout) — plus
                 Qwen2ForCausalLM (the same architecture with Q/K/V biases,
-                ``LlamaConfig(attention_bias=True)``) and MistralForCausalLM
-                (llama-shaped GQA, v0.2+); sliding-window configs are
-                refused for both
+                ``LlamaConfig(attention_bias=True)``), MistralForCausalLM
+                (llama-shaped GQA, v0.2+; sliding-window configs refused),
+                and GemmaForCausalLM (GeGLU + (1+w) RMSNorm + sqrt(d)
+                embeddings via the ``hidden_act``/``rms_offset``/
+                ``embed_scale`` knobs)
 - ``gpt2``    — GPT2LMHeadModel / GPT2Model (Conv1D stores [in, out]:
                 no transpose; wte is tied as the unembedding)
 - ``bert``    — BertForSequenceClassification / BertModel (post-LN; note
@@ -82,16 +84,17 @@ def _stack_cat(sd: dict, fmts: list, n: int, transpose: bool = False) -> np.ndar
 def _detect_family(hf_config) -> str:
     mt = getattr(hf_config, "model_type", "")
     known = {"llama", "gpt2", "bert", "t5", "mixtral", "vit", "resnet"}
-    if mt in ("qwen2", "mistral"):
+    if mt in ("qwen2", "mistral", "gemma"):
         # llama-architecture variants: qwen2 adds Q/K/V biases, mistral is
-        # llama-shaped GQA (both map onto the llama family; sliding-window
-        # configs are refused in config_from_hf).
+        # llama-shaped GQA, gemma swaps in GeGLU + (1+w) RMSNorm + sqrt(d)
+        # embeddings (all map onto the llama family; sliding-window and
+        # gemma2 configs are refused in config_from_hf).
         return "llama"
     if mt in known:
         return mt
     raise ValueError(
         f"Unsupported HF model_type {mt!r}; supported: {sorted(known)} "
-        "(qwen2 and mistral map onto llama)"
+        "(qwen2, mistral and gemma map onto llama)"
     )
 
 
@@ -118,6 +121,18 @@ def config_from_hf(hf_config, **overrides):
         # architectural (always on — transformers hardcodes it, so a stray
         # "attention_bias": false in a qwen2 config.json must not win).
         bias = True if mt == "qwen2" else bool(getattr(c, "attention_bias", False))
+        gemma = mt == "gemma"
+        if gemma:
+            # transformers overrides legacy configs (hidden_activation=None)
+            # to gelu_pytorch_tanh; an EXPLICIT hidden_activation that is not
+            # the tanh variant (e.g. exact-erf 'gelu') would silently diverge
+            # from the native tanh-approximate path — refuse it.
+            act_explicit = getattr(c, "hidden_activation", None)
+            if act_explicit is not None and act_explicit != "gelu_pytorch_tanh":
+                raise ValueError(
+                    "gemma import supports hidden_activation="
+                    f"'gelu_pytorch_tanh' (or unset), got {act_explicit!r}"
+                )
         kw = dict(
             vocab_size=c.vocab_size,
             hidden_size=c.hidden_size,
@@ -129,8 +144,11 @@ def config_from_hf(hf_config, **overrides):
             max_seq_len=c.max_position_embeddings,
             rope_theta=float(getattr(c, "rope_theta", 10000.0)),
             rms_eps=float(c.rms_norm_eps),
-            tie_embeddings=bool(getattr(c, "tie_word_embeddings", False)),
+            tie_embeddings=bool(getattr(c, "tie_word_embeddings", gemma)),
             attention_bias=bias,
+            hidden_act="gelu_tanh" if gemma else "silu",
+            rms_offset=gemma,
+            embed_scale=gemma,
         )
         kw.update(overrides)
         return LlamaConfig(**kw)

@@ -54,6 +54,11 @@ class LlamaConfig:
     # Q/K/V projection biases (the Qwen2-class variant of the llama
     # architecture; plain llama keeps False).
     attention_bias: bool = False
+    # Gemma-class conventions: GeGLU MLP ("gelu_tanh"), (1 + w) RMSNorm
+    # scales (stored weights start at zero), sqrt(d)-scaled embeddings.
+    hidden_act: str = "silu"  # "silu" | "gelu_tanh"
+    rms_offset: bool = False
+    embed_scale: bool = False
     dtype: Any = jnp.bfloat16  # compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = True
@@ -93,6 +98,10 @@ class LlamaConfig:
     loss_chunk_size: int = 4096
 
     def __post_init__(self):
+        if self.hidden_act not in ("silu", "gelu_tanh"):
+            raise ValueError(
+                f"hidden_act must be 'silu' or 'gelu_tanh', got {self.hidden_act!r}"
+            )
         if self.attention_impl not in ("auto", "einsum", "flash", "pallas"):
             raise ValueError(
                 "attention_impl must be 'auto', 'einsum', 'flash' or 'pallas', "
@@ -230,7 +239,9 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
         # (vocab, d) embedding into ones whenever vocab == num_layers.
         name = str(getattr(kp[-1], "key", kp[-1]))
         if name in ("ln_attn", "ln_mlp", "final_norm"):
-            return jnp.ones(shape, config.param_dtype)  # norm scales
+            # Offset convention stores scales as (w - 1): start at zero.
+            fill = jnp.zeros if config.rms_offset else jnp.ones
+            return fill(shape, config.param_dtype)  # norm scales
         if name in ("bq", "bk", "bv", "bo"):
             return jnp.zeros(shape, config.param_dtype)  # attention biases
         # Embedding table: lookup is one-hot (effective fan-in 1), so scale by
@@ -286,6 +297,25 @@ def _rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
     x32 = x.astype(jnp.float32)
     rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
     return (x32 * rms).astype(x.dtype) * scale.astype(x.dtype)
+
+
+def _norm(x: jax.Array, scale: jax.Array, c) -> jax.Array:
+    """Config-dispatched RMSNorm: gemma's (1 + w) scale convention when
+    ``rms_offset`` (weights stored as offsets from one, multiplied in fp32
+    before the downcast — matching transformers' GemmaRMSNorm); the plain
+    llama/mixtral scale otherwise."""
+    if getattr(c, "rms_offset", False):
+        x32 = x.astype(jnp.float32)
+        rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + c.rms_eps)
+        return (x32 * rms * (1.0 + scale.astype(jnp.float32))).astype(x.dtype)
+    return _rms_norm(x, scale, c.rms_eps)
+
+
+def _act(x: jax.Array, c) -> jax.Array:
+    """Gate activation: SwiGLU's silu, or gemma's tanh-approximate GeLU."""
+    if getattr(c, "hidden_act", "silu") == "gelu_tanh":
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.silu(x)
 
 
 def _rope(q: jax.Array, k: jax.Array, positions: jax.Array, theta: float) -> tuple[jax.Array, jax.Array]:
@@ -435,7 +465,7 @@ def attention_block(x, p, c, mask, positions, kv_valid=None) -> jax.Array:
     so the flash/ring/ulysses paths never materialize an [S, S] mask.
     """
     hd = c.head_dim_
-    h = _rms_norm(x, p["ln_attn"], c.rms_eps)
+    h = _norm(x, p["ln_attn"], c)
     b, s, _ = h.shape
     q, k, v = _qkv_proj(h, p, c, b, s)
     q, k = _rope(q, k, positions, c.rope_theta)
@@ -481,8 +511,8 @@ def _layer(carry, layer_params, *, config: LlamaConfig, mask, positions, act_spe
     p = layer_params
     x = attention_block(carry, p, c, mask, positions, kv_valid=kv_valid)
 
-    h = _rms_norm(x, p["ln_mlp"], c.rms_eps)
-    gate = jax.nn.silu(_mm(h, p["w_gate"], c))
+    h = _norm(x, p["ln_mlp"], c)
+    gate = _act(_mm(h, p["w_gate"], c), c)
     up = _mm(h, p["w_up"], c)
     x = x + _mm(gate * up, p["w_down"], c)
     if act_spec is not None:
@@ -576,13 +606,18 @@ def _remat_policy(name: str):
 
 def embed_tokens(params: dict, input_ids: jax.Array, config: LlamaConfig) -> jax.Array:
     """Token embedding lookup in compute dtype — shared by the dense and
-    pipeline-parallel paths."""
-    return _embed_lookup(params["embed"], input_ids, config.dtype)
+    pipeline-parallel paths.  ``embed_scale`` multiplies by sqrt(d) in the
+    compute dtype (gemma convention: the normalizer is cast to the hidden
+    dtype before the multiply)."""
+    x = _embed_lookup(params["embed"], input_ids, config.dtype)
+    if config.embed_scale:
+        x = x * jnp.asarray(config.hidden_size**0.5, config.dtype)
+    return x
 
 
 def final_norm(params: dict, x: jax.Array, config: LlamaConfig) -> jax.Array:
     """The pre-head RMS norm (shared by the dense and chunked loss paths)."""
-    return _rms_norm(x, params["final_norm"], config.rms_eps)
+    return _norm(x, params["final_norm"], config)
 
 
 def lm_head(params: dict, config: LlamaConfig) -> jax.Array:
@@ -676,7 +711,7 @@ def _attention_block_cached(x, p, c, ck, cv, index, positions):
     """Attention sub-block against the cache.  x: [B, S, D] (S = new tokens);
     ck/cv: [B, max_len, K, hd].  Returns (out, new_ck, new_cv)."""
     hd = c.head_dim_
-    h = _rms_norm(x, p["ln_attn"], c.rms_eps)
+    h = _norm(x, p["ln_attn"], c)
     b, s, _ = h.shape
     max_len = (ck[0] if isinstance(ck, tuple) else ck).shape[1]
     q, k, v = _qkv_proj(h, p, c, b, s)
@@ -725,8 +760,8 @@ def apply_cached(
         lp, ck, cv = xs
         lp = _dequant_layer(lp)
         y, ck, cv = _attention_block_cached(carry, lp, c, ck, cv, index, positions)
-        h = _rms_norm(y, lp["ln_mlp"], c.rms_eps)
-        gate = jax.nn.silu(_mm(h, lp["w_gate"], c))
+        h = _norm(y, lp["ln_mlp"], c)
+        gate = _act(_mm(h, lp["w_gate"], c), c)
         up = _mm(h, lp["w_up"], c)
         return y + _mm(gate * up, lp["w_down"], c), (ck, cv)
 

@@ -276,3 +276,22 @@ def test_biased_llama_export_round_trip(tmp_path):
         ),
         params, back,
     )
+
+
+def test_gemma_export_round_trip(tmp_path):
+    """A gemma-convention config exports as a GemmaForCausalLM checkpoint
+    that transformers loads with logits parity."""
+    cfg = llama.LlamaConfig.tiny(
+        dtype=jnp.float32, param_dtype=jnp.float32,
+        hidden_act="gelu_tanh", rms_offset=True, embed_scale=True,
+        tie_embeddings=True, head_dim=16,
+    )
+    params = llama.init_params(cfg, jax.random.key(17))
+    out = hf_export.export_hf_checkpoint("llama", params, cfg, str(tmp_path / "m"))
+    hf = transformers.AutoModelForCausalLM.from_pretrained(out).eval()
+    assert hf.config.model_type == "gemma"
+    ids = _ids(cfg.vocab_size, (2, 8))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids).long()).logits.numpy()
+    ours = np.asarray(llama.apply(params, jnp.asarray(ids), cfg))
+    np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-4)

@@ -443,3 +443,33 @@ def test_mistral_maps_onto_llama():
     )
     with pytest.raises(ValueError, match="sliding_window"):
         hf_import.config_from_hf(windowed)
+
+
+def test_gemma_maps_onto_llama():
+    """Gemma (GeGLU, (1+w) RMSNorm, sqrt(d)-scaled embeddings, tied head)
+    maps onto the llama family with the three convention knobs; logits match
+    transformers and greedy generation is token-identical."""
+    hf_cfg = transformers.GemmaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, rms_norm_eps=1e-6,
+    )
+    torch.manual_seed(17)
+    hf = transformers.GemmaForCausalLM(hf_cfg).eval()
+    family, cfg, params = hf_import.from_hf(
+        hf, dtype=jnp.float32, param_dtype=jnp.float32
+    )
+    assert family == "llama"
+    assert cfg.hidden_act == "gelu_tanh" and cfg.rms_offset and cfg.embed_scale
+    assert cfg.tie_embeddings
+    ids = _ids(128, (2, 10))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids).long()).logits.numpy()
+    ours = np.asarray(llama.apply(params, jnp.asarray(ids), cfg))
+    np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-4)
+    with torch.no_grad():
+        hf_out = hf.generate(
+            torch.from_numpy(ids).long(), max_new_tokens=5, do_sample=False
+        ).numpy()
+    ours_out = np.asarray(llama.generate(params, ids, cfg, max_new_tokens=5))
+    np.testing.assert_array_equal(ours_out, hf_out)
