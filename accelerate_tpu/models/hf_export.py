@@ -326,6 +326,15 @@ def _hf_config_dict(family: str, cfg, params: dict) -> dict:
             "attention_bias": cfg.attention_bias,
             "torch_dtype": "float32",
         }
+        if cfg.rope_scaling is not None:
+            _, factor, low_f, high_f, orig = cfg.rope_scaling
+            common["rope_scaling"] = {
+                "rope_type": "llama3",
+                "factor": factor,
+                "low_freq_factor": low_f,
+                "high_freq_factor": high_f,
+                "original_max_position_embeddings": orig,
+            }
         if cfg.rms_offset:
             # Gemma-convention configs share the llama tensor names but
             # carry different semantics — emit a gemma config so

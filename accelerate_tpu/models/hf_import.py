@@ -121,7 +121,35 @@ def config_from_hf(hf_config, **overrides):
         # architectural (always on — transformers hardcodes it, so a stray
         # "attention_bias": false in a qwen2 config.json must not win).
         bias = True if mt == "qwen2" else bool(getattr(c, "attention_bias", False))
+        rs = getattr(c, "rope_scaling", None)
+        rope_scaling = None
+        if rs:
+            rs = dict(rs)
+            kind = rs.get("rope_type", rs.get("type"))
+            if kind == "default":  # transformers: plain unscaled RoPE
+                kind = None
+                rs = None
+            elif kind != "llama3":
+                raise ValueError(
+                    f"rope_scaling type {kind!r} is not supported (llama3 "
+                    "long-context rescaling only); importing would silently "
+                    "rotate positions differently from the checkpoint."
+                )
+        if rs:
+            rope_scaling = (
+                "llama3",
+                float(rs["factor"]),
+                float(rs["low_freq_factor"]),
+                float(rs["high_freq_factor"]),
+                int(rs["original_max_position_embeddings"]),
+            )
         gemma = mt == "gemma"
+        if not gemma and getattr(c, "hidden_act", "silu") != "silu":
+            raise ValueError(
+                f"{mt} import supports hidden_act='silu', got "
+                f"{c.hidden_act!r}; the native MLP would silently compute a "
+                "different activation."
+            )
         if gemma:
             # transformers overrides legacy configs (hidden_activation=None)
             # to gelu_pytorch_tanh; an EXPLICIT hidden_activation that is not
@@ -149,6 +177,7 @@ def config_from_hf(hf_config, **overrides):
             hidden_act="gelu_tanh" if gemma else "silu",
             rms_offset=gemma,
             embed_scale=gemma,
+            rope_scaling=rope_scaling,
         )
         kw.update(overrides)
         return LlamaConfig(**kw)

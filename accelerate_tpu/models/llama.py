@@ -59,6 +59,10 @@ class LlamaConfig:
     hidden_act: str = "silu"  # "silu" | "gelu_tanh"
     rms_offset: bool = False
     embed_scale: bool = False
+    # Llama-3.1 long-context RoPE rescaling: ("llama3", factor,
+    # low_freq_factor, high_freq_factor, original_max_position_embeddings)
+    # as a hashable tuple (None = plain RoPE).
+    rope_scaling: Optional[tuple] = None
     dtype: Any = jnp.bfloat16  # compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = True
@@ -98,6 +102,16 @@ class LlamaConfig:
     loss_chunk_size: int = 4096
 
     def __post_init__(self):
+        if self.rope_scaling is not None and (
+            not isinstance(self.rope_scaling, tuple)
+            or len(self.rope_scaling) != 5
+            or self.rope_scaling[0] != "llama3"
+        ):
+            raise ValueError(
+                "rope_scaling must be None or ('llama3', factor, "
+                f"low_freq_factor, high_freq_factor, original_max), got "
+                f"{self.rope_scaling!r}"
+            )
         if self.hidden_act not in ("silu", "gelu_tanh"):
             raise ValueError(
                 f"hidden_act must be 'silu' or 'gelu_tanh', got {self.hidden_act!r}"
@@ -318,10 +332,35 @@ def _act(x: jax.Array, c) -> jax.Array:
     return jax.nn.silu(x)
 
 
-def _rope(q: jax.Array, k: jax.Array, positions: jax.Array, theta: float) -> tuple[jax.Array, jax.Array]:
+def _rope_freqs(hd: int, theta: float, scaling) -> jax.Array:
+    """Inverse frequencies, with the llama-3.1 long-context rescaling when
+    ``scaling`` is ``("llama3", factor, low_freq_factor, high_freq_factor,
+    original_max_position_embeddings)``: wavelengths longer than
+    original/low_freq are divided by ``factor``, shorter than
+    original/high_freq are kept, and the band between interpolates smoothly
+    (the transformers ``_compute_llama3_parameters`` rule)."""
+    freqs = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    if scaling is None:
+        return freqs
+    kind, factor, low_f, high_f, orig = scaling
+    if kind != "llama3":  # validated at config build; defensive here
+        raise ValueError(f"unsupported rope_scaling type {kind!r}")
+    wavelen = 2.0 * np.pi / freqs
+    low_wavelen = orig / low_f
+    high_wavelen = orig / high_f
+    scaled = freqs / factor
+    smooth = (orig / wavelen - low_f) / (high_f - low_f)
+    smoothed = (1.0 - smooth) * scaled + smooth * freqs
+    out = jnp.where(wavelen > low_wavelen, scaled, freqs)
+    mid = (wavelen <= low_wavelen) & (wavelen >= high_wavelen)
+    return jnp.where(mid, smoothed, out)
+
+
+def _rope(q: jax.Array, k: jax.Array, positions: jax.Array, theta: float,
+          scaling=None) -> tuple[jax.Array, jax.Array]:
     """Rotary embeddings applied to [B, S, H, hd] queries/keys."""
     hd = q.shape[-1]
-    freqs = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    freqs = _rope_freqs(hd, theta, scaling)
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, hd/2]
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
@@ -468,7 +507,7 @@ def attention_block(x, p, c, mask, positions, kv_valid=None) -> jax.Array:
     h = _norm(x, p["ln_attn"], c)
     b, s, _ = h.shape
     q, k, v = _qkv_proj(h, p, c, b, s)
-    q, k = _rope(q, k, positions, c.rope_theta)
+    q, k = _rope(q, k, positions, c.rope_theta, getattr(c, 'rope_scaling', None))
     if _sp_active():
         attn = sp_attention(q, k, v, c, causal=True, kv_valid=kv_valid)
     elif mask is None and _use_pallas(c, s, b, c.num_heads, c.num_kv_heads):
@@ -715,7 +754,7 @@ def _attention_block_cached(x, p, c, ck, cv, index, positions):
     b, s, _ = h.shape
     max_len = (ck[0] if isinstance(ck, tuple) else ck).shape[1]
     q, k, v = _qkv_proj(h, p, c, b, s)
-    q, k = _rope(q, k, positions, c.rope_theta)
+    q, k = _rope(q, k, positions, c.rope_theta, getattr(c, 'rope_scaling', None))
 
     from .generation import cache_write
 

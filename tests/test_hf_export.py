@@ -295,3 +295,24 @@ def test_gemma_export_round_trip(tmp_path):
         ref = hf(torch.from_numpy(ids).long()).logits.numpy()
     ours = np.asarray(llama.apply(params, jnp.asarray(ids), cfg))
     np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-4)
+
+
+def test_rope_scaling_export_round_trip(tmp_path):
+    """Llama-3.1 rope_scaling survives export: transformers loads the
+    directory and its (rescaled) forward matches the native model at
+    positions beyond the original window."""
+    cfg = llama.LlamaConfig.tiny(
+        dtype=jnp.float32, param_dtype=jnp.float32, max_seq_len=128,
+        rope_theta=10000.0,
+        rope_scaling=("llama3", 8.0, 1.0, 4.0, 32),
+    )
+    params = llama.init_params(cfg, jax.random.key(18))
+    out = hf_export.export_hf_checkpoint("llama", params, cfg, str(tmp_path / "m"))
+    hf = transformers.AutoModelForCausalLM.from_pretrained(out).eval()
+    assert hf.config.rope_scaling["rope_type"] == "llama3"
+    assert hf.config.rope_scaling["original_max_position_embeddings"] == 32
+    ids = _ids(cfg.vocab_size, (2, 64))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids).long()).logits.numpy()
+    ours = np.asarray(llama.apply(params, jnp.asarray(ids), cfg))
+    np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-4)

@@ -473,3 +473,42 @@ def test_gemma_maps_onto_llama():
         ).numpy()
     ours_out = np.asarray(llama.generate(params, ids, cfg, max_new_tokens=5))
     np.testing.assert_array_equal(ours_out, hf_out)
+
+
+def test_llama31_rope_scaling():
+    """Llama-3.1-style rope_scaling (llama3 rule) imports and matches the
+    transformers forward exactly; other scaling types are refused."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=96, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rope_theta=10000.0,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 32},
+    )
+    torch.manual_seed(18)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+    family, cfg, params = hf_import.from_hf(
+        hf, dtype=jnp.float32, param_dtype=jnp.float32
+    )
+    assert cfg.rope_scaling == ("llama3", 8.0, 1.0, 4.0, 32)
+    # Long prompt so positions beyond original_max exercise the rescale.
+    ids = _ids(96, (2, 64))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids).long()).logits.numpy()
+    ours = np.asarray(llama.apply(params, jnp.asarray(ids), cfg))
+    np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-4)
+    with torch.no_grad():
+        hf_out = hf.generate(
+            torch.from_numpy(ids).long(), max_new_tokens=4, do_sample=False
+        ).numpy()
+    ours_out = np.asarray(llama.generate(params, ids, cfg, max_new_tokens=4))
+    np.testing.assert_array_equal(ours_out, hf_out)
+
+    yarn = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=1, num_attention_heads=4,
+        rope_scaling={"rope_type": "yarn", "factor": 4.0},
+    )
+    with pytest.raises(ValueError, match="rope_scaling"):
+        hf_import.config_from_hf(yarn)
