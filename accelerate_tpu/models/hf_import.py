@@ -17,9 +17,11 @@ Supported families and their HF architectures:
                 Qwen2ForCausalLM (the same architecture with Q/K/V biases,
                 ``LlamaConfig(attention_bias=True)``), MistralForCausalLM
                 (llama-shaped GQA, v0.2+; sliding-window configs refused),
-                and GemmaForCausalLM (GeGLU + (1+w) RMSNorm + sqrt(d)
+                GemmaForCausalLM (GeGLU + (1+w) RMSNorm + sqrt(d)
                 embeddings via the ``hidden_act``/``rms_offset``/
-                ``embed_scale`` knobs)
+                ``embed_scale`` knobs), Phi3ForCausalLM (fused
+                qkv_proj/gate_up_proj split on import), and Llama-3.1
+                ``rope_scaling`` (the llama3 long-context rule)
 - ``gpt2``    — GPT2LMHeadModel / GPT2Model (Conv1D stores [in, out]:
                 no transpose; wte is tied as the unembedding)
 - ``bert``    — BertForSequenceClassification / BertModel (post-LN; note
@@ -84,17 +86,18 @@ def _stack_cat(sd: dict, fmts: list, n: int, transpose: bool = False) -> np.ndar
 def _detect_family(hf_config) -> str:
     mt = getattr(hf_config, "model_type", "")
     known = {"llama", "gpt2", "bert", "t5", "mixtral", "vit", "resnet"}
-    if mt in ("qwen2", "mistral", "gemma"):
+    if mt in ("qwen2", "mistral", "gemma", "phi3"):
         # llama-architecture variants: qwen2 adds Q/K/V biases, mistral is
         # llama-shaped GQA, gemma swaps in GeGLU + (1+w) RMSNorm + sqrt(d)
-        # embeddings (all map onto the llama family; sliding-window and
-        # gemma2 configs are refused in config_from_hf).
+        # embeddings, phi3 fuses qkv_proj/gate_up_proj (split on import) —
+        # all map onto the llama family; sliding-window, gemma2 and
+        # longrope configs are refused in config_from_hf.
         return "llama"
     if mt in known:
         return mt
     raise ValueError(
         f"Unsupported HF model_type {mt!r}; supported: {sorted(known)} "
-        "(qwen2, mistral and gemma map onto llama)"
+        "(qwen2, mistral, gemma and phi3 map onto llama)"
     )
 
 
@@ -117,6 +120,17 @@ def config_from_hf(hf_config, **overrides):
                 "the native attention paths are full-causal, so a windowed "
                 "checkpoint would silently attend differently."
             )
+        if mt == "phi3":
+            if getattr(c, "sliding_window", None) is not None:
+                raise ValueError(
+                    "phi3 import requires sliding_window=null: the native "
+                    "attention paths are full-causal."
+                )
+            if float(getattr(c, "partial_rotary_factor", 1.0)) != 1.0:
+                raise ValueError(
+                    "phi3 import requires partial_rotary_factor=1.0 (the "
+                    "native RoPE rotates the full head dim)."
+                )
         # llama checkpoints default attention_bias False; qwen2's bias is
         # architectural (always on — transformers hardcodes it, so a stray
         # "attention_bias": false in a qwen2 config.json must not win).
@@ -337,15 +351,38 @@ def _strip_prefix(sd: dict, prefixes: tuple) -> dict:
 def _import_llama(sd: dict, cfg) -> dict:
     L = cfg.num_layers
     pre = "layers.{}."
-    params = {
-        "embed": _np(sd["embed_tokens.weight"]),
-        "layers": {
+    if "layers.0.self_attn.qkv_proj.weight" in sd:
+        # phi3 fuses the projections ([q|k|v] rows, [gate|up] rows): split
+        # per layer back into the separate native tensors.
+        nq = cfg.num_heads * cfg.head_dim_
+        nk = cfg.num_kv_heads * cfg.head_dim_
+        f = cfg.intermediate_size
+        wq, wk, wv, wg, wu = [], [], [], [], []
+        for i in range(L):
+            qkv = _np(sd[f"layers.{i}.self_attn.qkv_proj.weight"])
+            wq.append(qkv[:nq].T.copy())
+            wk.append(qkv[nq:nq + nk].T.copy())
+            wv.append(qkv[nq + nk:].T.copy())
+            gu = _np(sd[f"layers.{i}.mlp.gate_up_proj.weight"])
+            wg.append(gu[:f].T.copy())
+            wu.append(gu[f:].T.copy())
+        attn = {
+            "wq": np.stack(wq), "wk": np.stack(wk), "wv": np.stack(wv),
+            "w_gate": np.stack(wg), "w_up": np.stack(wu),
+        }
+    else:
+        attn = {
             "wq": _stack(sd, pre + "self_attn.q_proj.weight", L, transpose=True),
             "wk": _stack(sd, pre + "self_attn.k_proj.weight", L, transpose=True),
             "wv": _stack(sd, pre + "self_attn.v_proj.weight", L, transpose=True),
-            "wo": _stack(sd, pre + "self_attn.o_proj.weight", L, transpose=True),
             "w_gate": _stack(sd, pre + "mlp.gate_proj.weight", L, transpose=True),
             "w_up": _stack(sd, pre + "mlp.up_proj.weight", L, transpose=True),
+        }
+    params = {
+        "embed": _np(sd["embed_tokens.weight"]),
+        "layers": {
+            **attn,
+            "wo": _stack(sd, pre + "self_attn.o_proj.weight", L, transpose=True),
             "w_down": _stack(sd, pre + "mlp.down_proj.weight", L, transpose=True),
             "ln_attn": _stack(sd, pre + "input_layernorm.weight", L),
             "ln_mlp": _stack(sd, pre + "post_attention_layernorm.weight", L),

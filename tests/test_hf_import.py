@@ -512,3 +512,51 @@ def test_llama31_rope_scaling():
     )
     with pytest.raises(ValueError, match="rope_scaling"):
         hf_import.config_from_hf(yarn)
+
+
+def test_phi3_maps_onto_llama():
+    """Phi-3 (llama math with fused qkv_proj / gate_up_proj) maps onto the
+    llama family by splitting the fused tensors; logits match transformers
+    and greedy generation is token-identical."""
+    hf_cfg = transformers.Phi3Config(
+        vocab_size=96, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, pad_token_id=0, sliding_window=None,
+    )
+    torch.manual_seed(19)
+    hf = transformers.Phi3ForCausalLM(hf_cfg).eval()
+    family, cfg, params = hf_import.from_hf(
+        hf, dtype=jnp.float32, param_dtype=jnp.float32
+    )
+    assert family == "llama" and not cfg.attention_bias
+    ids = _ids(96, (2, 10))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids).long()).logits.numpy()
+    ours = np.asarray(llama.apply(params, jnp.asarray(ids), cfg))
+    np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-4)
+    with torch.no_grad():
+        hf_out = hf.generate(
+            torch.from_numpy(ids).long(), max_new_tokens=4, do_sample=False
+        ).numpy()
+    ours_out = np.asarray(llama.generate(params, ids, cfg, max_new_tokens=4))
+    np.testing.assert_array_equal(ours_out, hf_out)
+
+
+def test_phi3_windowed_and_partial_rotary_refused():
+    """Real Phi-3-mini configs ship sliding_window set — the refusal branch
+    is the common path and must stay loud; partial rotary likewise."""
+    windowed = transformers.Phi3Config(
+        vocab_size=64, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        pad_token_id=0, sliding_window=2047,
+    )
+    with pytest.raises(ValueError, match="sliding_window"):
+        hf_import.config_from_hf(windowed)
+
+    partial = transformers.Phi3Config(
+        vocab_size=64, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        pad_token_id=0, sliding_window=None, partial_rotary_factor=0.5,
+    )
+    with pytest.raises(ValueError, match="partial_rotary"):
+        hf_import.config_from_hf(partial)
