@@ -50,6 +50,7 @@ def _native_presets() -> dict:
 
     return {
         "llama3-8b": llama.LlamaConfig.llama3_8b,
+        "llama3-70b": llama.LlamaConfig.llama3_70b,
         "llama-tiny": llama.LlamaConfig.tiny,
         "mixtral-8x7b": mixtral.MixtralConfig.mixtral_8x7b,
         "mixtral-tiny": mixtral.MixtralConfig.tiny,

@@ -161,6 +161,19 @@ class LlamaConfig:
         defaults.update(kw)
         return cls(**defaults)
 
+    @classmethod
+    def llama3_70b(cls, **kw) -> "LlamaConfig":
+        defaults = dict(
+            vocab_size=128256,
+            hidden_size=8192,
+            intermediate_size=28672,
+            num_layers=80,
+            num_heads=64,
+            num_kv_heads=8,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
     def flops_per_token(self) -> float:
         """Approximate training FLOPs per token (6 * params for matmuls + attention
         quadratic term is handled by callers with seq length)."""
