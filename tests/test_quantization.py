@@ -365,3 +365,24 @@ def test_int8_layer_stack_all_families(family):
         outd = np.asarray(t5.generate(pd, ids, cfg, max_new_tokens=4))
     assert float(jnp.abs(lq - ld).max()) == 0.0
     assert (outq == outd).all()
+
+
+def test_int8_weights_compose_with_speculative_decoding():
+    """Quantized target + quantized draft in speculative mode: greedy output
+    must equal the quantized target decoding alone (the speculative contract
+    is target-equivalence, whatever the weights' storage format)."""
+    cfg = llama.LlamaConfig.tiny(param_dtype=jnp.float32, dtype=jnp.float32)
+    dcfg = llama.LlamaConfig.tiny(param_dtype=jnp.float32, dtype=jnp.float32,
+                                  num_layers=1)
+    params = llama.quantize_weights(llama.init_params(cfg, jax.random.key(0)))
+    draft = llama.quantize_weights(llama.init_params(dcfg, jax.random.key(1)))
+    ids = np.asarray(
+        np.random.default_rng(2).integers(0, cfg.vocab_size, (1, 8)), np.int32
+    )
+    target_only = np.asarray(llama.generate(params, ids, cfg, max_new_tokens=6))
+    spec = np.asarray(
+        llama.speculative_generate(
+            params, draft, ids, cfg, dcfg, max_new_tokens=6, num_draft_tokens=3
+        )
+    )
+    np.testing.assert_array_equal(spec, target_only)
