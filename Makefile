@@ -1,11 +1,16 @@
 # Test-suite splits mirroring the reference Makefile:25-77.
 
-.PHONY: test test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels bench
+.PHONY: test test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels bench telemetry-smoke
 
 PYTEST = python -m pytest -q
 
-test:
+test: telemetry-smoke
 	$(PYTEST) tests/
+
+# 3-step CPU training loop with telemetry ON; asserts the JSONL trace is
+# non-empty and parseable (docs/usage_guides/telemetry.md).
+telemetry-smoke:
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.telemetry.smoke
 
 # Everything except big-modeling / engine dialects / CLI / examples.
 test_core:

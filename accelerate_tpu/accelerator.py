@@ -40,6 +40,8 @@ from .data_loader import DataLoaderDispatcher, DataLoaderShard, prepare_data_loa
 from .optimizer import AcceleratedOptimizer
 from .scheduler import AcceleratedScheduler
 from .state import AcceleratorState, GradientState
+from .telemetry import maybe_enable_from_env as _telemetry_from_env
+from .telemetry import span as _span
 from .utils.dataclasses import (
     DataLoaderConfiguration,
     DistributedType,
@@ -715,6 +717,9 @@ class Accelerator:
         if self.fp8_recipe_handler is not None and hasattr(self.state, "dtype_policy"):
             # Recipe kwargs override the policy default (reference fp8 plumbing).
             self.state.dtype_policy.fp8_recipe = self.fp8_recipe_handler
+        # Observability is env-opt-in (ACCELERATE_TPU_TELEMETRY=1): enabled
+        # here so env-only runs get spans/metrics/watchdog with no code change.
+        _telemetry_from_env()
 
     # -- state passthroughs (reference properties) ---------------------------
 
@@ -962,6 +967,7 @@ class Accelerator:
 
     # -- prepare -------------------------------------------------------------
 
+    @_span("accelerator.prepare")
     def prepare(self, *args, device_placement=None):
         """Prepare model/optimizer/dataloader/scheduler objects for the mesh.
 
@@ -1036,6 +1042,7 @@ class Accelerator:
         prepared = [staged[i] for i in range(len(args))]
         return prepared[0] if len(prepared) == 1 else tuple(prepared)
 
+    @_span("accelerator.prepare_model")
     def prepare_model(self, model, device_placement=None, evaluation_mode: bool = False):
         """Lower + shard a model (reference ``prepare_model`` ``accelerator.py:1468``)."""
         from .parallel.sharding import make_param_specs, shard_params
@@ -1200,6 +1207,7 @@ class Accelerator:
 
     # -- training loop surface ------------------------------------------------
 
+    @_span("accelerator.backward")
     def backward(self, loss, **kwargs):
         """Accumulate gradients for ``loss`` (reference ``accelerator.py:2437``)."""
         scale = 1.0 / self.gradient_accumulation_steps
@@ -1464,14 +1472,15 @@ class Accelerator:
         Parity: reference ``accelerator.py:3705-3762`` (torch.profiler → Chrome
         trace per rank).  Here: ``jax.profiler`` → perfetto/xplane dump under
         ``<output_trace_dir>/profile_<rank>`` when a `ProfileKwargs` with
-        ``output_trace_dir`` is given; otherwise the trace is collected and
+        ``output_trace_dir`` is given (the ``ACCELERATE_TPU_TRACE_DIR`` env
+        var is the argument-free form); otherwise the trace is collected and
         dropped (useful for warm-up parity with the reference's schedule).
         """
         import shutil
         import tempfile
 
         handler = profile_handler or self.profile_handler or ProfileKwargs()
-        out_dir = handler.output_trace_dir
+        out_dir = handler.output_trace_dir or os.environ.get("ACCELERATE_TPU_TRACE_DIR")
         keep = out_dir is not None
         if not keep:
             out_dir = tempfile.mkdtemp(prefix="atpu_profile_")
@@ -1569,6 +1578,13 @@ class Accelerator:
         self.trackers = init_trackers(self.log_with, project_name, config, init_kwargs, self)
 
     def log(self, values: dict, step: Optional[int] = None, log_kwargs=None):
+        from .tracking import telemetry_rows
+
+        rows = telemetry_rows()
+        if rows:
+            # Telemetry rides along under its own prefix; the user's keys win
+            # on collision.
+            values = {**rows, **values}
         for tracker in self.trackers:
             tracker.log(values, step=step)
 

@@ -29,6 +29,7 @@ import numpy as np
 import jax
 
 from .logging import get_logger
+from .telemetry import span as _span
 from .utils.imports import is_torch_available
 from .utils.random import rng_registry
 
@@ -423,6 +424,7 @@ def _use_local_save(accelerator) -> bool:
     return _plugin_save_mode(accelerator, "LOCAL_STATE_DICT")
 
 
+@_span("checkpoint.save_state")
 def save_accelerator_state(accelerator, output_dir: Optional[str] = None, **save_model_func_kwargs) -> str:
     """Reference ``save_accelerator_state`` ``checkpointing.py:56`` +
     ``Accelerator.save_state`` orchestration."""
@@ -533,6 +535,7 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, **save
     return output_dir
 
 
+@_span("checkpoint.load_state")
 def load_accelerator_state(accelerator, input_dir: Optional[str] = None, **load_model_func_kwargs) -> None:
     """Reference ``load_accelerator_state`` ``checkpointing.py:174``."""
     if input_dir is None and accelerator.project_configuration.automatic_checkpoint_naming:

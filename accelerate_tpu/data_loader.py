@@ -45,6 +45,8 @@ from .utils.operations import (
     to_numpy,
 )
 from .utils.random import synchronize_rng_states
+from .telemetry import get_telemetry as _get_telemetry
+from .telemetry import span as _span
 
 __all__ = [
     "SeedableRandomSampler",
@@ -685,7 +687,12 @@ class DataLoaderShard(DataLoaderStateMixin):
         current_pad = (0, 0)
 
         def _convert_tracked(b):
-            out = self._convert(b)
+            with _span("dataloader.next_batch"):
+                out = self._convert(b)
+            tel = _get_telemetry()
+            if tel.enabled:
+                tel.registry.counter("dataloader.batches").inc()
+                tel.heartbeat()  # host-side data stalls must not trip the watchdog
             if self._placer is None:
                 return out, (0, 0)
             return out, (self._placer.last_pad_rows, self._placer.last_batch_rows)
@@ -865,11 +872,16 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
         self._consume_skip_once()
         self.end()
 
+    @_span("dataloader.next_batch")
     def _emit(self, global_batch):
         # Every host received the full global batch via broadcast; cut THIS host's
         # slice before placement (the reference sliced per-rank here,
         # data_loader.py:844-916) — the placer's multi-host path expects exactly
         # the process-local shard.
+        tel = _get_telemetry()
+        if tel.enabled:
+            tel.registry.counter("dataloader.batches").inc()
+            tel.heartbeat()
         if self.state.num_processes > 1:
             bs = ignorant_find_batch_size(global_batch)
             if bs is not None:

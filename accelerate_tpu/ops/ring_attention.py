@@ -65,7 +65,10 @@ def tp_head_axis(mesh: Mesh, num_heads: int, num_kv_heads: int, extra_div: int =
         return "tp"
     return None
 
-from jax import shard_map as _shard_map
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # jax < 0.6 ships it under experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
 
 
 def shard_map(f, mesh, in_specs, out_specs):
@@ -165,10 +168,11 @@ def _ring_body(
     # type stays consistent (shard_map VMA rules).
     axes = tuple(vary_axes) or (axis_name,)
     # (pvary was deprecated in jax 0.9 in favor of pcast(..., to="varying");
-    # keep the old spelling as a fallback for older jax.)
+    # keep the old spelling as a fallback, and on jax < 0.5 — which has no
+    # varying-axes type system at all — the marking is unnecessary, so skip.)
     if hasattr(jax.lax, "pcast"):
         m0, l0, o0 = (jax.lax.pcast(x, axes, to="varying") for x in (m0, l0, o0))
-    else:
+    elif hasattr(jax.lax, "pvary"):
         m0, l0, o0 = (jax.lax.pvary(x, axes) for x in (m0, l0, o0))
 
     local_pos = jnp.arange(sq)

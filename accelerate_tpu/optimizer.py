@@ -22,6 +22,8 @@ import jax.numpy as jnp
 import optax
 
 from .state import AcceleratorState, GradientState
+from .telemetry import get_telemetry as _get_telemetry
+from .telemetry import span as _span
 
 __all__ = ["AcceleratedOptimizer"]
 
@@ -174,6 +176,13 @@ class AcceleratedOptimizer:
         if self.model is None or self.model._accum_grads is None:
             self._step_was_skipped = True
             return
+        with _span("optimizer.step"):
+            self._apply_update()
+        # A completed step is the telemetry heartbeat: step-time histogram,
+        # tokens/sec + MFU gauges, HBM gauges, stall-watchdog beat.
+        _get_telemetry().record_step()
+
+    def _apply_update(self):
         grads = self.model._consume_grads()
         clip_norm = self._clip_norm if self._clip_norm_once is None else self._clip_norm_once
         clip_value = self._clip_value if self._clip_value_once is None else self._clip_value_once

@@ -22,6 +22,7 @@ import numpy as np
 import jax
 from jax.sharding import Mesh
 
+from ..telemetry import span as _span
 from ..utils.dataclasses import ParallelismConfig
 
 __all__ = ["build_mesh", "mesh_axis_names", "data_axes", "model_axes", "local_mesh_shape"]
@@ -30,6 +31,26 @@ __all__ = ["build_mesh", "mesh_axis_names", "data_axes", "model_axes", "local_me
 DATA_AXES = ("dcn_dp", "dp", "fsdp")
 # Axes over which *weights* may be sharded.
 MODEL_AXES = ("fsdp", "pp", "ep", "tp")
+
+# jax < 0.5 has no AxisType (every axis is implicitly Auto there, which is
+# exactly the GSPMD-hint semantics we want); newer jax needs it spelled out.
+_HAS_AXIS_TYPES = hasattr(jax.sharding, "AxisType")
+
+
+def _auto_axis_types(n: int):
+    return (jax.sharding.AxisType.Auto,) * n if _HAS_AXIS_TYPES else None
+
+
+def _make_mesh(shape, axis_names):
+    if _HAS_AXIS_TYPES:
+        return jax.make_mesh(shape, axis_names, axis_types=_auto_axis_types(len(axis_names)))
+    return jax.make_mesh(shape, axis_names)
+
+
+def _mesh_from_devices(dev_array, axis_names):
+    if _HAS_AXIS_TYPES:
+        return Mesh(dev_array, axis_names, axis_types=_auto_axis_types(len(axis_names)))
+    return Mesh(dev_array, axis_names)
 
 
 def mesh_axis_names() -> tuple[str, ...]:
@@ -45,6 +66,7 @@ def model_axes(mesh: Mesh) -> tuple[str, ...]:
     return tuple(a for a in MODEL_AXES if a in mesh.axis_names and mesh.shape[a] > 1)
 
 
+@_span("mesh.build")
 def build_mesh(
     cfg: ParallelismConfig,
     devices: Optional[Sequence[jax.Device]] = None,
@@ -59,17 +81,16 @@ def build_mesh(
     shape = tuple(getattr(cfg, a) for a in axis_names)
     # Auto axis types: shardings are GSPMD *hints* (with_sharding_constraint
     # propagates), not the assert semantics of Explicit mode.
-    axis_types = (jax.sharding.AxisType.Auto,) * len(axis_names)
     if devices is None:
         try:
-            return jax.make_mesh(shape, axis_names, axis_types=axis_types)
+            return _make_mesh(shape, axis_names)
         except (ValueError, RuntimeError):
             devices = jax.devices()
     n = int(np.prod(shape))
     if len(devices) < n:
         raise ValueError(f"Need {n} devices for mesh {dict(zip(axis_names, shape))}, have {len(devices)}")
     dev_array = np.asarray(devices[:n]).reshape(shape)
-    return Mesh(dev_array, axis_names, axis_types=axis_types)
+    return _mesh_from_devices(dev_array, axis_names)
 
 
 def local_mesh_shape(mesh: Mesh) -> dict[str, int]:
@@ -81,8 +102,27 @@ def trivial_mesh() -> Mesh:
     mesh context so sharding constraints become no-ops."""
     names = mesh_axis_names()
     dev = np.asarray(jax.devices()[:1]).reshape((1,) * len(names))
-    return Mesh(dev, names, axis_types=(jax.sharding.AxisType.Auto,) * len(names))
+    return _mesh_from_devices(dev, names)
+
+
+# jax < 0.5 has no jax.set_mesh; the Mesh object itself is the (thread-local,
+# stack-based) global-mesh context manager.  Keep the entered mesh here and
+# swap strictly exit-then-enter so the stack never grows past one extra frame.
+_ACTIVE_LEGACY_MESH: Optional[Mesh] = None
+
+
+def install_global_mesh(mesh: Mesh) -> None:
+    """Install ``mesh`` as the global mesh context so bare-``PartitionSpec``
+    sharding constraints inside model code resolve against it."""
+    global _ACTIVE_LEGACY_MESH
+    if hasattr(jax, "set_mesh"):
+        jax.set_mesh(mesh)
+        return
+    if _ACTIVE_LEGACY_MESH is not None:
+        _ACTIVE_LEGACY_MESH.__exit__(None, None, None)
+    mesh.__enter__()
+    _ACTIVE_LEGACY_MESH = mesh
 
 
 def reset_global_mesh() -> None:
-    jax.set_mesh(trivial_mesh())
+    install_global_mesh(trivial_mesh())

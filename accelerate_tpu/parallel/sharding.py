@@ -54,7 +54,24 @@ def _abstract_mesh():
     except AttributeError:  # older jax
         from jax._src import mesh as _mesh_lib
 
-        return _mesh_lib.get_abstract_mesh()
+        try:
+            ctx = _mesh_lib.get_abstract_mesh()
+        except Exception:
+            ctx = None
+        if isinstance(ctx, tuple):
+            # jax < 0.5: get_abstract_mesh returns a context STACK tuple
+            # (usually empty — Mesh.__enter__ does not feed it).
+            ctx = ctx[-1] if ctx else None
+        if ctx is not None:
+            return ctx
+        # jax < 0.5 keeps the entered global mesh on the thread-resources env;
+        # a concrete Mesh duck-types the AbstractMesh surface we read
+        # (.empty / .shape / .axis_names).
+        try:
+            physical = _mesh_lib.thread_resources.env.physical_mesh
+        except Exception:
+            return None
+        return None if physical.empty else physical
 
 
 def constrain(x: jax.Array, spec: P) -> jax.Array:

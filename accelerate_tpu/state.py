@@ -468,6 +468,14 @@ class AcceleratorState:
             return
 
         self._partial = PartialState(cpu, **kwargs)
+        # Env-opt-in observability goes live before the mesh builds (so the
+        # mesh.build span is captured even without the Accelerator facade) but
+        # AFTER PartialState: enabling writes a record whose process index is
+        # a backend-initializing call, which must not precede
+        # jax.distributed.initialize on multi-host.
+        from .telemetry import maybe_enable_from_env
+
+        maybe_enable_from_env()
         mixed_precision = (
             parse_choice_from_env("ACCELERATE_MIXED_PRECISION", "no")
             if mixed_precision is None
@@ -520,7 +528,9 @@ class AcceleratorState:
         self.mesh = self._build_mesh(self.parallelism_config)
         # Install as the global mesh context so bare-PartitionSpec sharding
         # constraints inside model code resolve against it.
-        jax.set_mesh(self.mesh)
+        from .parallel.mesh import install_global_mesh
+
+        install_global_mesh(self.mesh)
 
         # distributed_type rewrite, mirroring reference state.py:952-976.
         if self.fsdp_plugin is not None and self.parallelism_config.fsdp > 1:
@@ -665,7 +675,9 @@ class AcceleratorState:
             # context (device counts may differ across hosts; the axis layout
             # is what the pickle preserves).
             self.mesh = self._build_mesh(self.parallelism_config)
-            jax.set_mesh(self.mesh)
+            from .parallel.mesh import install_global_mesh
+
+            install_global_mesh(self.mesh)
 
     def __repr__(self) -> str:
         return (

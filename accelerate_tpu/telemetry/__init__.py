@@ -1,0 +1,64 @@
+"""Dependency-free observability for the training hot path.
+
+Three pillars (see ``docs/usage_guides/telemetry.md``):
+
+- **trace spans** — ``span("name")`` context-manager/decorator: wall-time,
+  process index and nesting to a per-process JSONL file, mirrored into
+  ``jax.profiler.TraceAnnotation`` for Perfetto/XPlane dumps;
+- **metrics registry** — counters/gauges/histograms with built-in collectors
+  for step time, jit compile count/time (cache-miss detection via
+  ``jax.monitoring``), tokens/sec, achieved-MFU, and device HBM bytes;
+- **stall watchdog** — warns with a full thread dump when no step completes
+  within a configurable deadline.
+
+Default-off: enable with ``ACCELERATE_TPU_TELEMETRY=1`` or
+``telemetry.enable()``.  Summarize a run with
+``python -m accelerate_tpu.telemetry.report <dir>``.
+"""
+
+from .core import (
+    ENV_DIR,
+    ENV_ENABLE,
+    ENV_STALL_TIMEOUT,
+    Telemetry,
+    disable,
+    enable,
+    enabled,
+    get_telemetry,
+    maybe_enable_from_env,
+)
+from .metrics import (
+    CompileWatcher,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    StepTimer,
+    collect_hbm,
+    peak_flops_per_chip,
+)
+from .spans import span
+from .watchdog import StallWatchdog, thread_dump
+
+__all__ = [
+    "Telemetry",
+    "get_telemetry",
+    "enabled",
+    "enable",
+    "disable",
+    "maybe_enable_from_env",
+    "span",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "StepTimer",
+    "CompileWatcher",
+    "collect_hbm",
+    "peak_flops_per_chip",
+    "StallWatchdog",
+    "thread_dump",
+    "ENV_ENABLE",
+    "ENV_DIR",
+    "ENV_STALL_TIMEOUT",
+]

@@ -1,0 +1,218 @@
+"""Telemetry runtime: enablement, per-process JSONL sink, singleton wiring.
+
+Default-OFF.  Enable with ``ACCELERATE_TPU_TELEMETRY=1`` (honored by
+``Accelerator.__init__``) or programmatically via ``telemetry.enable()``.
+When disabled, the instrumented hot paths reduce to one attribute check — no
+file handles, no listeners firing, no records.
+
+JSONL schema (one record per line, ``telemetry_p<process>.jsonl``):
+
+- ``{"kind": "span", "name", "path", "depth", "dur_ms", "t", "proc", ...}``
+- ``{"kind": "compile", "dur_ms", ...}`` — one per XLA backend compile (cache miss)
+- ``{"kind": "stall", "elapsed_s", "deadline_s", "threads", ...}``
+- ``{"kind": "event", "name", ...}`` — ad-hoc markers
+- ``{"kind": "metrics", "snapshot": {...}}`` — final registry dump on disable/exit
+- ``{"kind": "meta", ...}`` — run bookkeeping (enable time, pid)
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from .metrics import COMPILE_EVENT, MetricsRegistry, StepTimer, collect_hbm
+
+__all__ = [
+    "Telemetry",
+    "get_telemetry",
+    "enabled",
+    "enable",
+    "disable",
+    "maybe_enable_from_env",
+    "ENV_ENABLE",
+    "ENV_DIR",
+    "ENV_STALL_TIMEOUT",
+]
+
+ENV_ENABLE = "ACCELERATE_TPU_TELEMETRY"
+ENV_DIR = "ACCELERATE_TPU_TELEMETRY_DIR"
+ENV_STALL_TIMEOUT = "ACCELERATE_TPU_STALL_TIMEOUT_S"
+DEFAULT_DIR = "telemetry"
+
+_TRUTHY = {"1", "true", "yes", "on"}
+
+
+def _env_flag(key: str) -> bool:
+    return os.environ.get(key, "").strip().lower() in _TRUTHY
+
+
+class Telemetry:
+    """Process-wide telemetry hub: owns the metrics registry, the JSONL sink,
+    the step timer, and (optionally) the stall watchdog."""
+
+    def __init__(self):
+        self.enabled = False
+        self.dir: Optional[str] = None
+        self.registry = MetricsRegistry()
+        self.step_timer = StepTimer(self.registry)
+        self.watchdog = None
+        self._file = None
+        self._lock = threading.Lock()
+        self._proc: Optional[int] = None
+        self._atexit_registered = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def enable(self, dir: Optional[str] = None, stall_timeout_s: Optional[float] = None):
+        """Turn telemetry on (idempotent).  ``dir`` defaults to
+        ``$ACCELERATE_TPU_TELEMETRY_DIR`` then ``./telemetry``; a positive
+        ``stall_timeout_s`` (or ``$ACCELERATE_TPU_STALL_TIMEOUT_S``) arms the
+        stall watchdog."""
+        if self.enabled:
+            return self
+        self.dir = dir or os.environ.get(ENV_DIR) or DEFAULT_DIR
+        os.makedirs(self.dir, exist_ok=True)
+        # Fresh-run semantics: a re-enable starts a new measurement window.
+        self.registry.reset()
+        self.step_timer.reset()
+        self._file = None
+        self.enabled = True
+        _install_compile_listener()
+        if stall_timeout_s is None:
+            try:
+                stall_timeout_s = float(os.environ.get(ENV_STALL_TIMEOUT, "0") or 0)
+            except ValueError:
+                stall_timeout_s = 0.0
+        if stall_timeout_s and stall_timeout_s > 0:
+            from .watchdog import StallWatchdog
+
+            self.watchdog = StallWatchdog(stall_timeout_s, telemetry=self)
+            self.watchdog.start()
+        if not self._atexit_registered:
+            self._atexit_registered = True
+            atexit.register(self.disable)
+        self.write({"kind": "meta", "event": "enabled", "pid": os.getpid()})
+        return self
+
+    def disable(self):
+        """Flush the final metrics snapshot and turn everything off."""
+        if not self.enabled:
+            return
+        self.write({"kind": "metrics", "snapshot": self.registry.snapshot()})
+        self.enabled = False
+        if self.watchdog is not None:
+            self.watchdog.stop()
+            self.watchdog = None
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+    # -- sink ----------------------------------------------------------------
+
+    def _process_index(self) -> int:
+        if self._proc is None:
+            try:
+                import jax
+
+                self._proc = int(jax.process_index())
+            except Exception:
+                self._proc = 0
+        return self._proc
+
+    @property
+    def jsonl_path(self) -> Optional[str]:
+        if self.dir is None:
+            return None
+        return os.path.join(self.dir, f"telemetry_p{self._process_index()}.jsonl")
+
+    def write(self, record: dict):
+        if not self.enabled:
+            return
+        record.setdefault("t", time.time())
+        record.setdefault("proc", self._process_index())
+        line = json.dumps(record, default=str)
+        with self._lock:
+            if self._file is None:
+                # Line-buffered append: records are durable per line, so a
+                # crashed run still leaves a parseable file.
+                self._file = open(self.jsonl_path, "a", buffering=1)
+            self._file.write(line + "\n")
+
+    def event(self, name: str, **fields):
+        self.write({"kind": "event", "name": name, **fields})
+
+    # -- hot-path hooks ------------------------------------------------------
+
+    def heartbeat(self):
+        """Liveness signal for the stall watchdog (batch fetched, step done)."""
+        if self.watchdog is not None:
+            self.watchdog.beat()
+
+    def record_step(self):
+        """Mark one COMPLETED optimizer step: step-time histogram, derived
+        tokens/sec + MFU gauges, HBM gauges, watchdog heartbeat."""
+        if not self.enabled:
+            return
+        self.step_timer.step()
+        collect_hbm(self.registry)
+        self.heartbeat()
+
+
+_TELEMETRY = Telemetry()
+
+
+def get_telemetry() -> Telemetry:
+    return _TELEMETRY
+
+
+def enabled() -> bool:
+    return _TELEMETRY.enabled
+
+
+def enable(dir: Optional[str] = None, stall_timeout_s: Optional[float] = None) -> Telemetry:
+    return _TELEMETRY.enable(dir=dir, stall_timeout_s=stall_timeout_s)
+
+
+def disable():
+    _TELEMETRY.disable()
+
+
+def maybe_enable_from_env() -> bool:
+    """Enable iff ``$ACCELERATE_TPU_TELEMETRY`` is truthy (the Accelerator
+    constructor calls this so env-only runs need no code changes)."""
+    if not _TELEMETRY.enabled and _env_flag(ENV_ENABLE):
+        _TELEMETRY.enable()
+    return _TELEMETRY.enabled
+
+
+# ---------------------------------------------------------------------------
+# Compile-event listener (module-level: jax.monitoring has no per-listener
+# unregister, so exactly ONE is ever installed and it forwards to the
+# singleton only while telemetry is enabled).
+# ---------------------------------------------------------------------------
+
+_compile_listener_installed = False
+
+
+def _install_compile_listener():
+    global _compile_listener_installed
+    if _compile_listener_installed:
+        return
+    _compile_listener_installed = True
+    from jax import monitoring
+
+    def _on_duration(event, duration, **kwargs):
+        tel = _TELEMETRY
+        if not tel.enabled or event != COMPILE_EVENT:
+            return
+        dur_ms = duration * 1e3
+        tel.registry.counter("jit.compiles").inc()
+        tel.registry.histogram("jit.compile_ms").observe(dur_ms)
+        tel.write({"kind": "compile", "dur_ms": round(dur_ms, 3)})
+
+    monitoring.register_event_duration_secs_listener(_on_duration)

@@ -1,0 +1,278 @@
+"""Metrics registry: counters, gauges, histograms + built-in collectors.
+
+Dependency-free by design (stdlib + jax only, and jax is touched lazily): the
+registry must be constructible before any backend client exists, and a snapshot
+must serialize straight into the JSONL sink or a tracker ``log()`` call.
+
+Built-in collectors cover the signals the ROADMAP's perf work needs to prove
+wins on ``bench.py``'s MFU metric:
+
+- ``StepTimer`` — wall-time between completed optimizer steps, tokens/sec and
+  an achieved-MFU estimate against the per-chip peak-FLOPs table (the same
+  table ``bench.py`` uses).
+- ``CompileWatcher`` — counts XLA backend compiles via ``jax.monitoring``
+  duration events; every backend compile is a jit cache miss, so a moving
+  count mid-training is the recompile signal GSPMD runs must not have.
+- ``collect_hbm`` — live/peak device HBM bytes via ``device.memory_stats()``.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Optional
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "StepTimer",
+    "CompileWatcher",
+    "collect_hbm",
+    "peak_flops_per_chip",
+]
+
+# jax.monitoring key emitted once per XLA backend compile (cache hits skip it).
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class Counter:
+    """Monotonic counter."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def inc(self, n: int = 1):
+        self.value += n
+
+
+class Gauge:
+    """Last-value-wins scalar."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = None
+
+    def set(self, value):
+        self.value = float(value)
+
+
+class Histogram:
+    """Streaming distribution: exact count/sum/min/max plus a bounded window of
+    recent observations for percentile estimates."""
+
+    __slots__ = ("name", "count", "total", "min", "max", "last", "_recent")
+
+    WINDOW = 1024
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = None
+        self.last = None
+        self._recent = collections.deque(maxlen=self.WINDOW)
+
+    def observe(self, value):
+        value = float(value)
+        self.count += 1
+        self.total += value
+        self.last = value
+        self.min = value if self.min is None else min(self.min, value)
+        self.max = value if self.max is None else max(self.max, value)
+        self._recent.append(value)
+
+    def summary(self) -> dict:
+        if self.count == 0:
+            return {"count": 0}
+        data = sorted(self._recent)
+
+        def pct(q):
+            return data[min(int(q * len(data)), len(data) - 1)]
+
+        return {
+            "count": self.count,
+            "mean": self.total / self.count,
+            "min": self.min,
+            "max": self.max,
+            "last": self.last,
+            "p50": pct(0.50),
+            "p95": pct(0.95),
+        }
+
+
+class MetricsRegistry:
+    """Name → metric store with get-or-create accessors and a flat snapshot."""
+
+    def __init__(self):
+        self._metrics: dict = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, name: str, cls):
+        with self._lock:
+            metric = self._metrics.get(name)
+            if metric is None:
+                metric = self._metrics[name] = cls(name)
+            elif not isinstance(metric, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {type(metric).__name__}"
+                )
+            return metric
+
+    def counter(self, name: str) -> Counter:
+        return self._get_or_create(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get_or_create(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get_or_create(name, Histogram)
+
+    def reset(self):
+        with self._lock:
+            self._metrics.clear()
+
+    def snapshot(self) -> dict:
+        """Flat ``{name: scalar}`` view: counters/gauges as-is, histograms
+        exploded into ``name.count/.mean/.p50/.p95/.max/.last``."""
+        out: dict = {}
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for metric in metrics:
+            if isinstance(metric, Histogram):
+                for k, v in metric.summary().items():
+                    if v is not None:
+                        out[f"{metric.name}.{k}"] = v
+            elif metric.value is not None:
+                out[metric.name] = metric.value
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Built-in collectors
+# ---------------------------------------------------------------------------
+
+# Per-chip bf16 peak FLOP/s by device kind, checked in order (the table
+# bench.py's MFU math uses — kept here so the live MFU gauge and the benchmark
+# can never disagree).  "v5 lite"/"v5e" before "v5" so the lite chip does not
+# match the v5p row.
+_PEAK_FLOPS_TABLE = (
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v6", 918e12),
+    ("trillium", 918e12),
+)
+_DEFAULT_PEAK_FLOPS = 197e12  # conservative default
+
+
+def peak_flops_per_chip(device=None) -> float:
+    """bf16 peak FLOP/s for one chip of ``device``'s kind (default: device 0)."""
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    kind = device.device_kind.lower()
+    for key, flops in _PEAK_FLOPS_TABLE:
+        if key in kind:
+            return flops
+    return _DEFAULT_PEAK_FLOPS
+
+
+def collect_hbm(registry: MetricsRegistry, device=None) -> dict:
+    """Record live/peak device memory gauges where the backend exposes them
+    (``device.memory_stats()`` — absent on some CPU builds and tunnels)."""
+    try:
+        if device is None:
+            import jax
+
+            device = jax.local_devices()[0]
+        stats = device.memory_stats() or {}
+    except Exception:
+        return {}
+    out = {}
+    if "bytes_in_use" in stats:
+        registry.gauge("hbm.bytes_in_use").set(stats["bytes_in_use"])
+        out["hbm.bytes_in_use"] = stats["bytes_in_use"]
+    if "peak_bytes_in_use" in stats:
+        registry.gauge("hbm.peak_bytes").set(stats["peak_bytes_in_use"])
+        out["hbm.peak_bytes"] = stats["peak_bytes_in_use"]
+    return out
+
+
+class StepTimer:
+    """Wall-time between completed optimizer steps → step-time histogram,
+    tokens/sec and achieved-MFU gauges (when configured with the workload's
+    per-step token/FLOP counts)."""
+
+    def __init__(self, registry: MetricsRegistry):
+        self.registry = registry
+        self.tokens_per_step: Optional[float] = None
+        self.flops_per_step: Optional[float] = None
+        self._last: Optional[float] = None
+
+    def configure(self, tokens_per_step=None, flops_per_step=None):
+        if tokens_per_step is not None:
+            self.tokens_per_step = float(tokens_per_step)
+        if flops_per_step is not None:
+            self.flops_per_step = float(flops_per_step)
+
+    def reset(self):
+        self._last = None
+
+    def step(self) -> Optional[float]:
+        """Mark one completed step; returns the step duration in seconds (None
+        for the first step — there is no prior boundary to measure from)."""
+        now = time.perf_counter()
+        self.registry.counter("step.count").inc()
+        dt = None
+        if self._last is not None:
+            dt = now - self._last
+            self.registry.histogram("step.time_ms").observe(dt * 1e3)
+            if self.tokens_per_step:
+                self.registry.gauge("step.tokens_per_sec").set(self.tokens_per_step / dt)
+            if self.flops_per_step:
+                try:
+                    import jax
+
+                    peak = peak_flops_per_chip() * jax.device_count()
+                    self.registry.gauge("step.mfu").set(self.flops_per_step / dt / peak)
+                except Exception:
+                    pass
+        self._last = now
+        return dt
+
+
+class CompileWatcher:
+    """Standalone compile counter: registers a ``jax.monitoring`` duration
+    listener and tallies backend compiles between construction and ``stop()``.
+
+    jax has no per-listener unregister, so the listener stays installed but
+    goes inert after ``stop()`` — construct sparingly (one per process is the
+    intended shape; the telemetry singleton uses its own listener)."""
+
+    def __init__(self):
+        self.count = 0
+        self.total_ms = 0.0
+        self._active = True
+        from jax import monitoring
+
+        def _on_duration(event, duration, **kwargs):
+            if self._active and event == COMPILE_EVENT:
+                self.count += 1
+                self.total_ms += duration * 1e3
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+
+    def stop(self):
+        self._active = False

@@ -1,0 +1,143 @@
+"""Telemetry run summarizer: ``python -m accelerate_tpu.telemetry.report <path>``.
+
+``<path>`` is a telemetry JSONL file or a directory holding
+``telemetry_p*.jsonl`` files (one per process).  Prints a per-span time
+breakdown, compile statistics, stall events, and the final metrics snapshot.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+__all__ = ["load_records", "summarize", "format_report", "main"]
+
+
+def load_records(path: str) -> list[dict]:
+    """Parse every record from a JSONL file or a run directory.  Unparseable
+    lines (a crashed writer's torn tail) are skipped, not fatal."""
+    if os.path.isdir(path):
+        files = sorted(glob.glob(os.path.join(path, "telemetry_p*.jsonl")))
+        if not files:
+            files = sorted(glob.glob(os.path.join(path, "*.jsonl")))
+    else:
+        files = [path]
+    records = []
+    for file in files:
+        with open(file) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except ValueError:
+                    continue
+    return records
+
+
+def summarize(records: list[dict]) -> dict:
+    """Aggregate records into the report's sections."""
+    spans: dict = {}
+    toplevel_ms = 0.0
+    compiles = 0
+    compile_ms = 0.0
+    stalls = []
+    snapshot = None
+    for rec in records:
+        kind = rec.get("kind")
+        if kind == "span":
+            name = rec.get("name", "?")
+            agg = spans.setdefault(
+                name, {"count": 0, "total_ms": 0.0, "max_ms": 0.0, "depth": rec.get("depth", 0)}
+            )
+            dur = float(rec.get("dur_ms", 0.0))
+            agg["count"] += 1
+            agg["total_ms"] += dur
+            agg["max_ms"] = max(agg["max_ms"], dur)
+            agg["depth"] = min(agg["depth"], rec.get("depth", 0))
+            if rec.get("depth", 0) == 0:
+                toplevel_ms += dur
+        elif kind == "compile":
+            compiles += 1
+            compile_ms += float(rec.get("dur_ms", 0.0))
+        elif kind == "stall":
+            stalls.append(
+                {"elapsed_s": rec.get("elapsed_s"), "deadline_s": rec.get("deadline_s")}
+            )
+        elif kind == "metrics":
+            snapshot = rec.get("snapshot")  # last one wins (written on disable)
+    return {
+        "spans": spans,
+        "toplevel_ms": toplevel_ms,
+        "compiles": compiles,
+        "compile_ms": compile_ms,
+        "stalls": stalls,
+        "snapshot": snapshot,
+        "n_records": len(records),
+    }
+
+
+def format_report(summary: dict) -> str:
+    lines = []
+    spans = summary["spans"]
+    lines.append(f"telemetry report — {summary['n_records']} records")
+    lines.append("")
+    if spans:
+        lines.append(
+            f"{'span':<36} {'count':>7} {'total_ms':>12} {'mean_ms':>10} {'max_ms':>10} {'%top':>6}"
+        )
+        top = summary["toplevel_ms"] or 1.0
+        for name, agg in sorted(spans.items(), key=lambda kv: -kv[1]["total_ms"]):
+            mean = agg["total_ms"] / agg["count"]
+            pct = 100.0 * agg["total_ms"] / top if agg["depth"] == 0 else float("nan")
+            pct_str = f"{pct:6.1f}" if pct == pct else "     -"
+            lines.append(
+                f"{name:<36} {agg['count']:>7} {agg['total_ms']:>12.1f} "
+                f"{mean:>10.2f} {agg['max_ms']:>10.1f} {pct_str}"
+            )
+    else:
+        lines.append("no spans recorded")
+    lines.append("")
+    lines.append(
+        f"compiles: {summary['compiles']} ({summary['compile_ms']:.1f} ms total)"
+    )
+    if summary["stalls"]:
+        lines.append(f"stalls: {len(summary['stalls'])}")
+        for s in summary["stalls"]:
+            lines.append(f"  - stalled {s['elapsed_s']}s (deadline {s['deadline_s']}s)")
+    snapshot = summary["snapshot"]
+    if snapshot:
+        lines.append("")
+        lines.append("final metrics snapshot:")
+        for key in sorted(snapshot):
+            value = snapshot[key]
+            if isinstance(value, float):
+                value = round(value, 4)
+            lines.append(f"  {key} = {value}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m accelerate_tpu.telemetry.report",
+        description="Summarize a telemetry JSONL run into a per-span time breakdown.",
+    )
+    parser.add_argument("path", help="telemetry JSONL file or run directory")
+    args = parser.parse_args(argv)
+    if not os.path.exists(args.path):
+        print(f"no such file or directory: {args.path}", file=sys.stderr)
+        return 1
+    records = load_records(args.path)
+    if not records:
+        print(f"no telemetry records found under {args.path}", file=sys.stderr)
+        return 1
+    print(format_report(summarize(records)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

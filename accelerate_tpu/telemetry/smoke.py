@@ -1,0 +1,78 @@
+"""Telemetry smoke: a 3-step CPU training loop with telemetry ON.
+
+Run via ``make telemetry-smoke`` (or ``python -m accelerate_tpu.telemetry.smoke``).
+Drives the instrumented hot paths end-to-end — Accelerator.prepare, data-loader
+placement, backward, optimizer.step — then asserts the per-process JSONL file is
+non-empty and fully parseable and prints the report summary.  Exit code 0 only
+when every assertion holds.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    out_dir = tempfile.mkdtemp(prefix="atpu_telemetry_smoke_")
+
+    from accelerate_tpu import telemetry
+
+    tel = telemetry.enable(dir=out_dir, stall_timeout_s=300)
+
+    import torch
+    from torch.utils.data import DataLoader
+
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.test_utils import RegressionDataset, RegressionModelWithLoss
+
+    def _collate(samples):
+        return {
+            "x": torch.tensor([s["x"] for s in samples]),
+            "y": torch.tensor([s["y"] for s in samples]),
+        }
+
+    accelerator = Accelerator()
+    ds = RegressionDataset(length=12)
+    dl = DataLoader(list(ds), batch_size=4, collate_fn=_collate)
+    model = RegressionModelWithLoss()
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    model, opt, dl = accelerator.prepare(model, opt, dl)
+
+    steps = 0
+    for batch in dl:  # 12 samples / batch 4 = exactly 3 steps
+        out = model(x=batch["x"], y=batch["y"])
+        accelerator.backward(out.loss)
+        opt.step()
+        opt.zero_grad()
+        steps += 1
+    assert steps == 3, f"expected 3 steps, ran {steps}"
+
+    path = tel.jsonl_path
+    telemetry.disable()  # flush the final metrics snapshot
+
+    assert path is not None and os.path.exists(path), f"telemetry JSONL missing: {path}"
+    with open(path) as f:
+        lines = [line for line in f if line.strip()]
+    assert lines, f"telemetry JSONL is empty: {path}"
+    records = [json.loads(line) for line in lines]  # every line must parse
+
+    kinds = {rec.get("kind") for rec in records}
+    assert "span" in kinds, f"no span records in {path} (kinds: {kinds})"
+    assert "metrics" in kinds, f"no final metrics snapshot in {path} (kinds: {kinds})"
+    snapshot = next(r["snapshot"] for r in reversed(records) if r.get("kind") == "metrics")
+    assert snapshot.get("step.count") == 3, f"step.count != 3 in snapshot: {snapshot}"
+    assert snapshot.get("jit.compiles", 0) >= 1, f"no compiles recorded: {snapshot}"
+
+    from .report import format_report, summarize
+
+    print(format_report(summarize(records)))
+    print(f"\ntelemetry-smoke OK — {len(records)} records in {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

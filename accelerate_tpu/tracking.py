@@ -45,6 +45,7 @@ __all__ = [
     "filter_trackers",
     "init_trackers",
     "on_main_process",
+    "telemetry_rows",
 ]
 
 
@@ -533,6 +534,23 @@ def filter_trackers(log_with: list, logging_dir: Optional[str] = None) -> list:
             seen.add(key)
             deduped.append(item)
     return deduped
+
+
+def telemetry_rows(prefix: str = "telemetry/") -> dict:
+    """Scalar snapshot of the telemetry metrics registry, prefixed for tracker
+    namespaces.  Empty when telemetry is disabled — ``Accelerator.log`` merges
+    this into every ``log()`` call, so any ``GeneralTracker`` backend receives
+    step-time / compile / HBM / MFU rows for free once telemetry is on."""
+    from .telemetry import get_telemetry
+
+    tel = get_telemetry()
+    if not tel.enabled:
+        return {}
+    return {
+        f"{prefix}{k}": v
+        for k, v in tel.registry.snapshot().items()
+        if isinstance(v, (int, float))
+    }
 
 
 def init_trackers(log_with, project_name, config, init_kwargs, accelerator) -> list[GeneralTracker]:

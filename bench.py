@@ -16,19 +16,11 @@ import numpy as np
 
 
 def _peak_flops_per_chip() -> float:
-    """bf16 peak per chip.  v5e: 197 TFLOP/s bf16."""
-    import jax
+    """bf16 peak per chip (v5e: 197 TFLOP/s) — the table lives in the telemetry
+    subsystem so the live MFU gauge and this benchmark can never disagree."""
+    from accelerate_tpu.telemetry import peak_flops_per_chip
 
-    kind = jax.devices()[0].device_kind.lower()
-    if "v5 lite" in kind or "v5e" in kind:
-        return 197e12
-    if "v5p" in kind or "v5" in kind:
-        return 459e12
-    if "v4" in kind:
-        return 275e12
-    if "v6" in kind or "trillium" in kind:
-        return 918e12
-    return 197e12  # conservative default
+    return peak_flops_per_chip()
 
 
 def _run(
@@ -50,6 +42,12 @@ def _run(
     import optax
 
     from accelerate_tpu.models import llama
+    from accelerate_tpu.telemetry import CompileWatcher
+
+    # Counts XLA backend compiles (jit cache misses) for the telemetry block
+    # of the result line; warmup compiles are expected, steady-state ones are
+    # the recompile bug the count exists to expose.
+    compile_watcher = CompileWatcher()
 
     cfg = llama.LlamaConfig(
         vocab_size=vocab_size,
@@ -121,6 +119,7 @@ def _run(
     for _ in range(3):
         params, opt_state, loss = train_step(params, opt_state, batch_tree)
     jax.device_get(loss)
+    warmup_compiles = compile_watcher.count
 
     n_steps = 20
     best = float("inf")
@@ -152,6 +151,17 @@ def _run(
             out["peak_hbm_gb"] = round(stats["peak_bytes_in_use"] / 1e9, 2)
     except Exception:
         pass
+    compile_watcher.stop()
+    # Telemetry snapshot for the result line: total/steady-state compile
+    # counts (steady-state > 0 means the timed loop itself recompiled — a
+    # perf bug), mean step time, and peak HBM where available.
+    out["telemetry"] = {
+        "compile_count": compile_watcher.count,
+        "steady_state_compiles": compile_watcher.count - warmup_compiles,
+        "compile_ms": round(compile_watcher.total_ms, 1),
+        "mean_step_ms": round(dt * 1e3, 3),
+        "peak_hbm_gb": out.get("peak_hbm_gb"),
+    }
     return out
 
 
@@ -587,6 +597,8 @@ def main():
         "loss": round(result["loss"], 4),
         "rungs": rung_log,
     }
+    if "telemetry" in result:
+        detail["telemetry"] = result["telemetry"]
     if frontier:
         detail["frontier"] = frontier
     if proof is not None:
@@ -598,6 +610,8 @@ def main():
             "tokens_per_sec": round(proof["tokens_per_sec"], 1),
             "step_ms": round(proof["step_ms"], 2),
         }
+        if "telemetry" in proof:
+            detail["hbm_bound_proof"]["telemetry"] = proof["telemetry"]
     print(
         json.dumps(
             {

@@ -8,6 +8,11 @@ import sys
 
 import pytest
 
+# Tier-2 end-to-end suite: spawns real training subprocesses (minutes of
+# compile+train on CPU) — excluded from the tier-1 `-m 'not slow'` budget.
+pytestmark = pytest.mark.slow
+
+
 BY_FEATURE = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples", "by_feature"
 )

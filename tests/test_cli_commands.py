@@ -10,6 +10,11 @@ import argparse
 import pytest
 import yaml
 
+# Tier-2 end-to-end suite: spawns real training subprocesses (minutes of
+# compile+train on CPU) — excluded from the tier-1 `-m 'not slow'` budget.
+pytestmark = pytest.mark.slow
+
+
 from accelerate_tpu.commands.from_accelerate import convert_config, from_accelerate_command
 from accelerate_tpu.commands.tpu import tpu_command
 

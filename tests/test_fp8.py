@@ -10,6 +10,11 @@ import numpy as np
 import optax
 import pytest
 
+# Tier-2 compile-heavy e2e suite (minutes of XLA CPU compile per run) —
+# excluded from the tier-1 `-m 'not slow'` budget; runs under `make test_core`.
+pytestmark = pytest.mark.slow
+
+
 from accelerate_tpu.models import llama
 from accelerate_tpu.ops import fp8
 from accelerate_tpu.utils import FP8RecipeKwargs, MixedPrecisionPolicy

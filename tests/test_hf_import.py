@@ -9,6 +9,11 @@ the reference ecosystem's own compute, not a reimplementation.
 import numpy as np
 import pytest
 
+# Tier-2 compile-heavy e2e suite (minutes of XLA CPU compile per run) —
+# excluded from the tier-1 `-m 'not slow'` budget; runs under `make test_core`.
+pytestmark = pytest.mark.slow
+
+
 import jax
 import jax.numpy as jnp
 

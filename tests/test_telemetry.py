@@ -1,0 +1,409 @@
+"""Telemetry subsystem: spans, metrics registry, collectors, stall watchdog,
+hot-path instrumentation, report CLI, and the profile() trace-dir env var.
+
+Everything runs default-OFF: the first test class asserts the disabled fast
+path writes nothing; the rest enable telemetry into tmp dirs and verify the
+JSONL stream and registry contents.
+"""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+import torch
+from torch.utils.data import DataLoader
+
+from accelerate_tpu import telemetry
+from accelerate_tpu.telemetry import (
+    CompileWatcher,
+    MetricsRegistry,
+    StallWatchdog,
+    get_telemetry,
+    peak_flops_per_chip,
+    span,
+)
+from accelerate_tpu.telemetry import report as telemetry_report
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_off():
+    """Telemetry state is process-global; every test leaves it disabled."""
+    yield
+    telemetry.disable()
+
+
+def _read_jsonl(tel):
+    with open(tel.jsonl_path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# ---------------------------------------------------------------------------
+# Disabled fast path
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_by_default_and_writes_nothing(tmp_path):
+    assert not telemetry.enabled()
+    with span("should_not_record"):
+        pass
+    tel = get_telemetry()
+    assert tel._file is None
+    assert tel.registry.snapshot() == {}
+
+
+def test_record_step_noop_when_disabled():
+    tel = get_telemetry()
+    tel.record_step()
+    assert tel.registry.snapshot() == {}
+
+
+# ---------------------------------------------------------------------------
+# Spans
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_depth_and_path(tmp_path):
+    tel = telemetry.enable(dir=str(tmp_path))
+    with span("outer"):
+        time.sleep(0.01)
+        with span("inner", detail="x"):
+            pass
+    records = [r for r in _read_jsonl(tel) if r["kind"] == "span"]
+    inner, outer = records[0], records[1]  # inner exits (and writes) first
+    assert inner["name"] == "inner" and inner["depth"] == 1
+    assert inner["path"] == "outer/inner"
+    assert inner["attrs"] == {"detail": "x"}
+    assert outer["name"] == "outer" and outer["depth"] == 0
+    assert outer["dur_ms"] >= 10
+    assert "proc" in outer and "t" in outer
+
+
+def test_span_decorator_and_exception_flag(tmp_path):
+    tel = telemetry.enable(dir=str(tmp_path))
+
+    @span("decorated")
+    def work(x):
+        return x + 1
+
+    assert work(1) == 2
+    assert work(2) == 3
+
+    with pytest.raises(ValueError):
+        with span("failing"):
+            raise ValueError("boom")
+
+    records = [r for r in _read_jsonl(tel) if r["kind"] == "span"]
+    names = [r["name"] for r in records]
+    assert names.count("decorated") == 2
+    failing = next(r for r in records if r["name"] == "failing")
+    assert failing["error"] == "ValueError"
+    # Registry mirrors every span into a histogram.
+    assert tel.registry.snapshot()["span.decorated_ms.count"] == 2
+
+
+def test_span_enabled_mid_flight_records_nothing_for_open_context(tmp_path):
+    """A span entered while disabled must not write on exit, even if telemetry
+    turned on mid-context (enablement is checked at __enter__)."""
+    s = span("early")
+    s.__enter__()
+    tel = telemetry.enable(dir=str(tmp_path))
+    s.__exit__(None, None, None)
+    assert all(r["kind"] != "span" for r in _read_jsonl(tel))
+
+
+# ---------------------------------------------------------------------------
+# Metrics registry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_counter_gauge_histogram():
+    reg = MetricsRegistry()
+    reg.counter("c").inc()
+    reg.counter("c").inc(4)
+    reg.gauge("g").set(2.5)
+    for v in (1.0, 2.0, 3.0, 4.0):
+        reg.histogram("h").observe(v)
+    snap = reg.snapshot()
+    assert snap["c"] == 5
+    assert snap["g"] == 2.5
+    assert snap["h.count"] == 4
+    assert snap["h.mean"] == 2.5
+    assert snap["h.min"] == 1.0 and snap["h.max"] == 4.0
+    assert snap["h.last"] == 4.0
+    assert 2.0 <= snap["h.p50"] <= 3.0
+    with pytest.raises(TypeError, match="already registered"):
+        reg.gauge("c")
+
+
+def test_peak_flops_table_matches_bench_defaults():
+    # On the CPU test mesh the device kind is unknown → conservative default.
+    assert peak_flops_per_chip() == 197e12
+
+
+def test_step_timer_tokens_and_mfu(tmp_path):
+    tel = telemetry.enable(dir=str(tmp_path))
+    tel.step_timer.configure(tokens_per_step=1000, flops_per_step=1e9)
+    tel.record_step()
+    time.sleep(0.02)
+    tel.record_step()
+    snap = tel.registry.snapshot()
+    assert snap["step.count"] == 2
+    assert snap["step.time_ms.count"] == 1  # first step has no prior boundary
+    assert snap["step.time_ms.last"] >= 20
+    assert snap["step.tokens_per_sec"] > 0
+    assert 0 < snap["step.mfu"] < 1
+
+
+# ---------------------------------------------------------------------------
+# Compile (jit cache-miss) detection
+# ---------------------------------------------------------------------------
+
+
+def test_forced_recompile_detection(tmp_path):
+    tel = telemetry.enable(dir=str(tmp_path))
+    counter = tel.registry.counter("jit.compiles")
+
+    @jax.jit
+    def f(x):
+        return x * 2 + 1
+
+    f(jnp.ones((3,))).block_until_ready()
+    after_first = counter.value
+    assert after_first >= 1  # first call compiles
+
+    f(jnp.ones((3,))).block_until_ready()
+    assert counter.value == after_first  # cache hit: no compile event
+
+    f(jnp.ones((5,))).block_until_ready()  # new shape forces a recompile
+    assert counter.value > after_first
+
+    records = _read_jsonl(tel)
+    compile_recs = [r for r in records if r["kind"] == "compile"]
+    assert len(compile_recs) == counter.value
+    assert all(r["dur_ms"] > 0 for r in compile_recs)
+    assert tel.registry.snapshot()["jit.compile_ms.count"] == counter.value
+
+
+def test_compile_watcher_standalone():
+    watcher = CompileWatcher()
+
+    @jax.jit
+    def g(x):
+        return x - 1
+
+    g(jnp.ones((7,))).block_until_ready()
+    assert watcher.count >= 1
+    assert watcher.total_ms > 0
+    n = watcher.count
+    watcher.stop()
+    g(jnp.ones((9,))).block_until_ready()
+    assert watcher.count == n  # inert after stop()
+
+
+# ---------------------------------------------------------------------------
+# Stall watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_fires_once_per_stall_with_thread_dump(tmp_path):
+    tel = telemetry.enable(dir=str(tmp_path))
+    dog = StallWatchdog(0.05, telemetry=tel, poll_s=0.01)
+    dog.start()
+    try:
+        time.sleep(0.25)
+        assert dog.stall_count == 1  # one warning per episode, not per poll
+        dog.beat()  # progress re-arms it
+        time.sleep(0.02)
+        assert dog.stall_count == 1
+        time.sleep(0.25)
+        assert dog.stall_count == 2
+    finally:
+        dog.stop()
+    stalls = [r for r in _read_jsonl(tel) if r["kind"] == "stall"]
+    assert len(stalls) == 2
+    assert stalls[0]["deadline_s"] == 0.05
+    # The dump carries the stalled (main) thread's actual stack.
+    assert "test_telemetry" in stalls[0]["threads"]
+    assert tel.registry.snapshot()["stall.count"] == 2
+
+
+def test_watchdog_rejects_nonpositive_deadline():
+    with pytest.raises(ValueError):
+        StallWatchdog(0)
+
+
+def test_watchdog_armed_via_enable(tmp_path):
+    tel = telemetry.enable(dir=str(tmp_path), stall_timeout_s=120)
+    assert tel.watchdog is not None
+    assert tel.watchdog.deadline_s == 120
+    telemetry.disable()
+    assert tel.watchdog is None
+
+
+# ---------------------------------------------------------------------------
+# Hot-path instrumentation through the Accelerator facade
+# ---------------------------------------------------------------------------
+
+
+def _collate(samples):
+    return {
+        "x": torch.tensor([s["x"] for s in samples]),
+        "y": torch.tensor([s["y"] for s in samples]),
+    }
+
+
+def _train_two_steps(tmp_path):
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.test_utils import RegressionDataset, RegressionModelWithLoss
+
+    # split_batches: the global batch IS batch_size (16 samples / 8 = 2 steps
+    # regardless of the 8-device test mesh's shard count).
+    accelerator = Accelerator(split_batches=True)
+    ds = RegressionDataset(length=16)
+    dl = DataLoader(list(ds), batch_size=8, collate_fn=_collate)
+    model = RegressionModelWithLoss()
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    model, opt, dl = accelerator.prepare(model, opt, dl)
+    for batch in dl:
+        out = model(x=batch["x"], y=batch["y"])
+        accelerator.backward(out.loss)
+        opt.step()
+        opt.zero_grad()
+    return accelerator
+
+
+def test_training_hot_paths_emit_spans_and_step_metrics(tmp_path):
+    tel = telemetry.enable(dir=str(tmp_path / "runs"))
+    acc = _train_two_steps(tmp_path)
+    records = _read_jsonl(tel)
+    names = {r["name"] for r in records if r["kind"] == "span"}
+    assert {"mesh.build", "accelerator.prepare", "accelerator.prepare_model",
+            "accelerator.backward", "optimizer.step", "dataloader.next_batch"} <= names
+    # prepare_model nests under prepare.
+    pm = next(r for r in records if r.get("name") == "accelerator.prepare_model")
+    assert pm["path"] == "accelerator.prepare/accelerator.prepare_model"
+    snap = tel.registry.snapshot()
+    assert snap["step.count"] == 2
+    assert snap["dataloader.batches"] == 2
+    assert snap["jit.compiles"] >= 1  # the fused train step compiled
+
+    ckpt = str(tmp_path / "ckpt")
+    acc.save_state(ckpt)
+    acc.load_state(ckpt)
+    names = {r["name"] for r in _read_jsonl(tel) if r["kind"] == "span"}
+    assert {"checkpoint.save_state", "checkpoint.load_state"} <= names
+
+
+def test_env_flag_enables_via_accelerator(tmp_path, monkeypatch):
+    from accelerate_tpu.accelerator import Accelerator
+
+    monkeypatch.setenv("ACCELERATE_TPU_TELEMETRY", "1")
+    monkeypatch.setenv("ACCELERATE_TPU_TELEMETRY_DIR", str(tmp_path / "env_dir"))
+    assert not telemetry.enabled()
+    Accelerator()
+    assert telemetry.enabled()
+    assert get_telemetry().dir == str(tmp_path / "env_dir")
+
+
+def test_disable_flushes_final_metrics_snapshot(tmp_path):
+    tel = telemetry.enable(dir=str(tmp_path))
+    tel.registry.counter("demo").inc(7)
+    path = tel.jsonl_path
+    telemetry.disable()
+    with open(path) as f:
+        records = [json.loads(line) for line in f if line.strip()]
+    snap = next(r for r in records if r["kind"] == "metrics")["snapshot"]
+    assert snap["demo"] == 7
+
+
+def test_tracker_bridge_telemetry_rows(tmp_path):
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.tracking import GeneralTracker, telemetry_rows
+
+    assert telemetry_rows() == {}  # disabled → trackers see nothing extra
+
+    tel = telemetry.enable(dir=str(tmp_path))
+    tel.registry.counter("step.count").inc(3)
+    tel.registry.gauge("hbm.demo").set(5)
+
+    class Recorder(GeneralTracker):
+        name = "recorder"
+        requires_logging_directory = False
+
+        def __init__(self):
+            self.records = []
+
+        def store_init_configuration(self, values):
+            pass
+
+        def log(self, values, step=None, **kwargs):
+            self.records.append((step, dict(values)))
+
+    rec = Recorder()
+    acc = Accelerator(log_with=[rec])
+    acc.init_trackers("proj")
+    acc.log({"loss": 1.0, "telemetry/step.count": -1}, step=0)
+    step, values = rec.records[0]
+    assert values["loss"] == 1.0
+    assert values["telemetry/step.count"] == -1  # user keys win on collision
+    assert values["telemetry/hbm.demo"] == 5  # registry rows ride along
+
+
+# ---------------------------------------------------------------------------
+# Report CLI
+# ---------------------------------------------------------------------------
+
+
+def test_report_summarizes_run_dir(tmp_path, capsys):
+    run_dir = str(tmp_path / "run")
+    tel = telemetry.enable(dir=run_dir)
+    with span("train_step"):
+        with span("forward"):
+            pass
+    with span("train_step"):
+        pass
+    tel.write({"kind": "compile", "dur_ms": 12.5})
+    telemetry.disable()
+
+    assert telemetry_report.main([run_dir]) == 0
+    out = capsys.readouterr().out
+    assert "train_step" in out and "forward" in out
+    assert "compiles: 1 (12.5 ms total)" in out
+    assert "final metrics snapshot" in out
+
+    summary = telemetry_report.summarize(telemetry_report.load_records(run_dir))
+    assert summary["spans"]["train_step"]["count"] == 2
+    assert summary["spans"]["forward"]["depth"] == 1
+    assert summary["compiles"] == 1
+
+
+def test_report_missing_path_errors():
+    assert telemetry_report.main(["/nonexistent/telemetry"]) == 1
+
+
+def test_report_skips_torn_lines(tmp_path):
+    f = tmp_path / "telemetry_p0.jsonl"
+    f.write_text('{"kind": "span", "name": "a", "dur_ms": 1.0, "depth": 0}\n{"kind": "sp')
+    records = telemetry_report.load_records(str(tmp_path))
+    assert len(records) == 1
+
+
+# ---------------------------------------------------------------------------
+# profile() trace-dir env var (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_profile_honors_trace_dir_env(tmp_path, monkeypatch):
+    from accelerate_tpu.accelerator import Accelerator
+
+    out_dir = str(tmp_path / "traces")
+    monkeypatch.setenv("ACCELERATE_TPU_TRACE_DIR", out_dir)
+    acc = Accelerator()
+    with acc.profile():
+        jnp.ones((4,)).block_until_ready()
+    trace_dir = os.path.join(out_dir, "profile_0")
+    assert os.path.isdir(trace_dir)
+    assert any(files for _, _, files in os.walk(trace_dir)), "no trace artifacts written"

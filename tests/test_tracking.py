@@ -89,6 +89,30 @@ def test_generic_tracker_jsonl_roundtrip(tmp_path):
     assert lines[1]["note"] == "mid" and lines[1]["_step"] == 1
 
 
+def test_init_trackers_generic_jsonl_roundtrip(tmp_path):
+    """Regression: the dependency-free JSONL tracker through the full facade
+    path — ``init_trackers`` → ``log`` → on-disk contents."""
+    acc = Accelerator(log_with="generic", project_dir=str(tmp_path))
+    acc.init_trackers("run_rt", config={"lr": 0.5, "note": "cfg"})
+    acc.log({"loss": 1.25, "tag": "warmup"}, step=0)
+    acc.log({"loss": 0.75}, step=7)
+    acc.end_training()
+
+    run_dir = tmp_path / "run_rt"
+    with open(run_dir / "config.json") as f:
+        cfg = json.load(f)
+    assert cfg == {"lr": 0.5, "note": "cfg"}
+
+    path = acc.get_tracker("generic", unwrap=True)
+    assert path == str(run_dir / "metrics.jsonl")
+    with open(path) as f:
+        lines = [json.loads(l) for l in f]
+    assert [l["_step"] for l in lines] == [0, 7]
+    assert [l["loss"] for l in lines] == [1.25, 0.75]
+    assert lines[0]["tag"] == "warmup"
+    assert all("_time" in l for l in lines)
+
+
 def test_accelerator_tracker_glue(tmp_path):
     dummy = DummyTracker()
     acc = Accelerator(log_with=[dummy, "generic"], project_dir=str(tmp_path))
