@@ -1,16 +1,22 @@
 # Test-suite splits mirroring the reference Makefile:25-77.
 
-.PHONY: test test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels bench telemetry-smoke
+.PHONY: test test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels bench telemetry-smoke introspect-smoke
 
 PYTEST = python -m pytest -q
 
-test: telemetry-smoke
+test: telemetry-smoke introspect-smoke
 	$(PYTEST) tests/
 
 # 3-step CPU training loop with telemetry ON; asserts the JSONL trace is
 # non-empty and parseable (docs/usage_guides/telemetry.md).
 telemetry-smoke:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.telemetry.smoke
+
+# 2-step CPU loop on a forced dp=2 mesh with ACCELERATE_TPU_INTROSPECT=1;
+# asserts the comms-ledger JSON parses and reports >= 1 collective
+# (docs/package_reference/introspect.md).
+introspect-smoke:
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.telemetry.introspect_smoke
 
 # Everything except big-modeling / engine dialects / CLI / examples.
 test_core:

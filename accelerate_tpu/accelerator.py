@@ -205,6 +205,15 @@ class PreparedModel:
         self._jit_fused = None
         self._jit_fwd = None
         self._jit_vjp = None
+        # PartitionSpec tree prepare_model declared for self.params — the
+        # "what was intended" side of the resharding lint.
+        self._param_specs = None
+        self._introspect_pending = True
+        self._introspect_modes = None  # captured-program keys once enabled
+        # Telemetry program label; prepare_model makes it unique per model so
+        # two prepared models don't overwrite each other's introspection
+        # report or measured-FLOPs entry (both are keyed by name).
+        self._program_label = "model"
 
     # -- torch-like mode switches -------------------------------------------
 
@@ -278,12 +287,47 @@ class PreparedModel:
             return "fused"
         return "bridge"
 
+    def _maybe_introspect(self, args, kwargs):
+        """Once-per-program AOT inspection of the compiled step this call
+        will run (``ACCELERATE_TPU_INTROSPECT=1``): cost/memory analysis,
+        comms ledger, resharding lint against the specs prepare_model
+        declared.  Captures the fused training step and the eval forward
+        independently (an eval-first warmup must not swallow the training
+        step's capture).  Costs one extra AOT compile per captured program;
+        with the flag unset the first call resolves the env once and every
+        later call is a single attribute check — nothing is lowered."""
+        if not self._introspect_pending:
+            return
+        from .telemetry import introspect as _introspect
+
+        if self._introspect_modes is None:
+            if not _introspect.enabled_from_env():
+                self._introspect_pending = False
+                return
+            self._introspect_modes = set()
+        fused = self.training and self._mode == "fused"
+        key = "fused_step" if fused else "forward"
+        if key in self._introspect_modes:
+            return
+        self._introspect_modes.add(key)
+        _introspect.capture(
+            self._jit_fused if fused else self._jit_fwd,
+            (self.params, args, kwargs),
+            name=f"{self._program_label}.{key}",
+            mesh=self.accelerator.mesh,
+            declared_specs=self._param_specs,
+            # Only the fused train step runs once per optimizer step; an eval
+            # forward (or bridge-mode partial) must not skew measured MFU.
+            count_in_step=fused,
+        )
+
     def __call__(self, *args, **kwargs):
         args = _torch_to_jax_tree(args)
         kwargs = _torch_to_jax_tree(kwargs)
         self._build_jits()
         if self.training and self._mode is None:
             self._mode = self._pick_mode(args, kwargs)
+        self._maybe_introspect(args, kwargs)
         if self.training and self._mode == "fused":
             loss, out, grads = self._jit_fused(self.params, args, kwargs)
             self._pending = (loss, grads)
@@ -1092,6 +1136,10 @@ class Accelerator:
         params = shard_params(params, self.mesh, specs)
         buffers = jax.tree_util.tree_map(lambda b: jax.device_put(jnp.asarray(b)), buffers)
         prepared = PreparedModel(apply_fn, params, buffers, self, original_module=original)
+        # The declared shardings are the lint's ground truth: the inspector
+        # compares what enters the compiled step against these.
+        prepared._param_specs = specs
+        prepared._program_label = f"model{len(self._models)}"
         if original is not None:
             # Keep the lowering handle: a pipelined lowering stores stacked
             # block params, and state_dict/unwrap must translate back to torch

@@ -47,6 +47,7 @@ forward on randomly initialized tiny models (``tests/test_hf_import.py``).
 
 from __future__ import annotations
 
+import re
 from typing import Any, Optional
 
 import numpy as np
@@ -746,14 +747,21 @@ class _RecordingDict(dict):
         return default
 
 
-# Buffers transformers serializes that carry no weights.
-_IGNORABLE = (
-    "position_ids",
-    "rotary_emb.inv_freq",
-    "attention.self.distance_embedding",
-    "masked_bias",
-    ".attn.bias",  # gpt2's causal-mask buffer
-    "num_batches_tracked",  # BN bookkeeping (momentum here is a constant)
+# Buffers transformers serializes that carry no weights.  ANCHORED regexes
+# (suffix / dotted-boundary), not bare substrings: strict mode's loud-failure
+# guarantee depends on these never over-matching a real weight key (a
+# substring like ".attn.bias" would also swallow e.g. "cross_attn.bias_proj"
+# from an unmapped architecture variant).
+_IGNORABLE = tuple(
+    re.compile(p)
+    for p in (
+        r"(^|\.)position_ids$",
+        r"(^|\.)rotary_emb\.inv_freq$",
+        r"(^|\.)attention\.self\.distance_embedding\.weight$",
+        r"(^|\.)masked_bias$",
+        r"(^|\.)attn\.bias$",  # gpt2's causal-mask buffer
+        r"(^|\.)num_batches_tracked$",  # BN bookkeeping (momentum here is a constant)
+    )
 )
 
 
@@ -787,7 +795,7 @@ def import_state_dict(
     if strict:
         leftover = [
             k for k in sd
-            if k not in sd.consumed and not any(p in k for p in _IGNORABLE)
+            if k not in sd.consumed and not any(p.search(k) for p in _IGNORABLE)
         ]
         if leftover:
             raise ValueError(

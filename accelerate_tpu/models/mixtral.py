@@ -13,6 +13,7 @@ PartitionSpec — expert weights additionally sharded on ``ep``.
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from typing import Any, Optional
 
 import numpy as np
@@ -219,14 +220,42 @@ def _ep_active() -> bool:
     return bool(m is not None and not m.empty and "ep" in m.axis_names and m.shape["ep"] > 1)
 
 
+def _sharded_batch_axes() -> tuple:
+    """Data-consuming mesh axes with size > 1 on the ambient mesh (the axes
+    the batch dimension is sharded over)."""
+    from ..parallel.sharding import _abstract_mesh
+
+    m = _abstract_mesh()
+    if m is None or m.empty:
+        return ()
+    return tuple(
+        a for a in ("dcn_dp", "dp", "fsdp") if a in m.axis_names and m.shape[a] > 1
+    )
+
+
 def _check_moe_impl(c: MixtralConfig) -> None:
     """Fail fast (before any computation touches the mesh) when the ragged
-    impl meets an expert-parallel mesh."""
-    if c.moe_impl == "ragged" and _ep_active():
+    impl meets an expert-parallel mesh, and warn when it meets a sharded
+    batch: the global-token argsort/bincount in the ragged path gathers the
+    FULL token set onto every device, silently discarding the data
+    parallelism the mesh was built for."""
+    if c.moe_impl != "ragged":
+        return
+    if _ep_active():
         raise ValueError(
             "moe_impl='ragged' cannot run under an ep>1 mesh: ragged "
             "group sizes are data-dependent per shard.  Use "
             "moe_impl='dense' for expert-parallel meshes."
+        )
+    batch_axes = _sharded_batch_axes()
+    if batch_axes:
+        warnings.warn(
+            f"moe_impl='ragged' under a mesh with sharded batch axes "
+            f"{batch_axes}: the ragged grouped-matmul sorts and bins the "
+            "GLOBAL token set, so XLA all-gathers the full batch onto every "
+            "device before routing — the per-device work does not shrink "
+            "with the mesh.  Use moe_impl='dense' for dp/fsdp meshes (its "
+            "dispatch einsum partitions over the batch axes)."
         )
 
 

@@ -9,7 +9,11 @@ Three pillars (see ``docs/usage_guides/telemetry.md``):
   for step time, jit compile count/time (cache-miss detection via
   ``jax.monitoring``), tokens/sec, achieved-MFU, and device HBM bytes;
 - **stall watchdog** — warns with a full thread dump when no step completes
-  within a configurable deadline.
+  within a configurable deadline;
+- **compiled-program introspection** — XLA cost/memory analysis, the
+  per-program collective-communication ledger, and the resharding lint
+  (``ACCELERATE_TPU_INTROSPECT=1``; see ``introspect.py`` /
+  ``docs/package_reference/introspect.md``).
 
 Default-off: enable with ``ACCELERATE_TPU_TELEMETRY=1`` or
 ``telemetry.enable()``.  Summarize a run with
@@ -37,6 +41,15 @@ from .metrics import (
     collect_hbm,
     peak_flops_per_chip,
 )
+from .hlo_scan import CollectiveOp, CommsLedger, parse_collectives, scan_hlo
+from .introspect import (
+    ENV_INTROSPECT,
+    LintFinding,
+    ProgramReport,
+    capture,
+    inspect_compiled,
+    lint_reshardings,
+)
 from .spans import span
 from .watchdog import StallWatchdog, thread_dump
 
@@ -61,4 +74,15 @@ __all__ = [
     "ENV_ENABLE",
     "ENV_DIR",
     "ENV_STALL_TIMEOUT",
+    # compiled-program introspection
+    "ENV_INTROSPECT",
+    "ProgramReport",
+    "LintFinding",
+    "CollectiveOp",
+    "CommsLedger",
+    "inspect_compiled",
+    "capture",
+    "lint_reshardings",
+    "parse_collectives",
+    "scan_hlo",
 ]

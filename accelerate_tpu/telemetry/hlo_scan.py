@@ -1,0 +1,310 @@
+"""Optimized-HLO collective scanner: the text half of the compiled-program
+inspector (``introspect.py`` drives it).
+
+Under GSPMD sharding propagation (arXiv:2105.04663) XLA inserts
+``all-gather``/``all-reduce``/``reduce-scatter``/``all-to-all``/
+``collective-permute`` ops wherever the sharding annotations under-constrain
+the program — none of them appear in user code, so the only place they can be
+*counted* is the optimized HLO module of the compiled executable.  This module
+parses that text (``compiled.as_text()``) into a structured **comms ledger**:
+
+- one :class:`CollectiveOp` per HLO collective, with the result byte volume
+  (per participating device) and the mesh axis/axes the op communicates over,
+  recovered from ``replica_groups`` / ``source_target_pairs`` against the
+  mesh's device coordinates;
+- a :class:`CommsLedger` aggregate: op counts and byte volumes per collective
+  kind and per mesh axis.
+
+Pure text + numpy — no XLA bindings beyond the HLO string, so the scan works
+identically on CPU test meshes and real TPU slices.
+
+Known limitation: the scan is *static* — each HLO instruction counts once.  A
+collective inside a ``while`` body (e.g. the per-layer gradient all-reduce of
+a ``lax.scan`` over layers) executes once per iteration but appears once in
+the text, so scanned-layer programs under-report executed bytes by roughly the
+layer count for the in-loop portion.  Invariant tests pin ``num_layers=1``
+(static == executed there); ranking programs by comms pressure is unaffected
+as long as they scan the same depth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional
+
+import numpy as np
+
+__all__ = [
+    "CollectiveOp",
+    "CommsLedger",
+    "COLLECTIVE_KINDS",
+    "parse_shape_bytes",
+    "parse_collectives",
+    "classify_groups",
+    "scan_hlo",
+]
+
+COLLECTIVE_KINDS = (
+    "all-gather",
+    "all-reduce",
+    "reduce-scatter",
+    "all-to-all",
+    "collective-permute",
+)
+
+# f32[4,8]{1,0} / bf16[2,4,8] / s8[16] / pred[] / u32[3]{0} / f8e4m3fn[...]
+_SHAPE_RE = re.compile(r"(?P<dtype>[a-z][a-z0-9]*)\[(?P<dims>[0-9,]*)\](?:\{[^}]*\})?")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s2": 1, "u2": 1, "s4": 1, "u4": 1,
+    "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1,
+    "f8e4m3fnuz": 1, "f8e5m2fnuz": 1, "f8e3m4": 1, "f8e4m3": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "c64": 8,
+    "s64": 8, "u64": 8, "f64": 8, "c128": 16,
+}
+
+# One HLO instruction line whose opcode is a collective.  The result shape is
+# either a single array shape or a tuple "(f32[...], f32[...])" when XLA fused
+# several tensors (e.g. many gradient leaves) into one collective.  Async pairs
+# lower as <op>-start/<op>-done; counting only -start (plus the sync form)
+# avoids double counting.
+_COLLECTIVE_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*"
+    r"(?P<shape>\([^)]*\)|[a-z][a-z0-9]*\[[0-9,]*\](?:\{[^}]*\})?)\s+"
+    r"(?P<kind>" + "|".join(COLLECTIVE_KINDS) + r")(?P<start>-start)?\(",
+    re.M,
+)
+
+# Nested one level: {{0,1},{2,3}} — the inner-group alternation keeps the
+# match from stopping at the first inner "},".
+_REPLICA_GROUPS_RE = re.compile(r"replica_groups=\{(?P<groups>(?:\{[^{}]*\}\s*,?\s*)*)\}")
+# Iota form (newer XLA): replica_groups=[4,2]<=[8] — 4 groups of 2 over 8 ids.
+_IOTA_GROUPS_RE = re.compile(r"replica_groups=\[(?P<dims>[0-9,]+)\]<=\[")
+_SOURCE_TARGET_RE = re.compile(r"source_target_pairs=\{(?P<pairs>(?:\{[^{}]*\}\s*,?\s*)*)\}")
+_OP_NAME_RE = re.compile(r'op_name="(?P<name>[^"]*)"')
+
+
+@dataclasses.dataclass
+class CollectiveOp:
+    """One collective instruction from the optimized HLO."""
+
+    kind: str  # one of COLLECTIVE_KINDS
+    bytes: int  # result byte volume per participating device
+    axes: Optional[tuple[str, ...]]  # mesh axes communicated over (None: unknown)
+    group_size: int  # devices per replica group (0 = unknown, 1 = degenerate)
+    op_name: str = ""  # jax op_name metadata (trace provenance), may be ""
+
+    @property
+    def is_degenerate(self) -> bool:
+        """True when every replica group has exactly one member — the
+        partitioner kept the op but it moves no data (e.g. a psum over a
+        size-1 mesh axis).  Unknown group size (0 — no replica_groups
+        attribute and no mesh to resolve against) is NOT degenerate: an
+        absent/empty group list means ALL devices, the maximum traffic."""
+        return self.group_size == 1
+
+
+@dataclasses.dataclass
+class CommsLedger:
+    """Aggregate comms view of one compiled program."""
+
+    ops: list  # list[CollectiveOp], degenerate ops excluded
+    by_kind: dict  # kind -> {"count": int, "bytes": int}
+    by_axis: dict  # "dp" / "fsdp" / "dp+fsdp" / "?" -> bytes
+    total_bytes: int
+    degenerate_ops: int  # collectives present in HLO but moving no data
+
+    def to_dict(self) -> dict:
+        return {
+            "by_kind": self.by_kind,
+            "by_axis": self.by_axis,
+            "total_bytes": self.total_bytes,
+            "n_ops": len(self.ops),
+            "degenerate_ops": self.degenerate_ops,
+        }
+
+
+def parse_shape_bytes(shape: str) -> int:
+    """Total byte volume of an HLO result shape (array or tuple of arrays)."""
+    total = 0
+    for m in _SHAPE_RE.finditer(shape):
+        dims = m.group("dims")
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES.get(m.group("dtype"), 4)
+    return total
+
+
+def _element_bytes(shape: str) -> list[tuple[str, str, int]]:
+    """Per-element (dtype, dims, bytes) list of a (possibly tuple) HLO shape."""
+    out = []
+    for m in _SHAPE_RE.finditer(shape):
+        n = 1
+        for d in m.group("dims").split(","):
+            if d:
+                n *= int(d)
+        out.append(
+            (m.group("dtype"), m.group("dims"), n * _DTYPE_BYTES.get(m.group("dtype"), 4))
+        )
+    return out
+
+
+def _async_start_bytes(shape: str) -> int:
+    """Result bytes of an async ``<op>-start`` instruction.
+
+    Async collectives lower with tuple shapes carrying the OPERAND buffer(s)
+    alongside the result(s) (e.g. ``all-gather-start = (f32[S/N], f32[S])``,
+    ``collective-permute-start = (f32[S], f32[S], u32[], u32[])``) — a plain
+    tuple sum double-counts.  Context state is always SCALAR integer elements
+    (``u32[]``); integer payloads (int8 weight shards, routing indices) keep
+    their dims and stay counted.  Among the payload elements: equal
+    front/back halves means (operands..., results...) of a combined
+    same-shape collective — count the back half; otherwise the result is the
+    final element (all-gather: the gathered buffer; reduce-scatter: the
+    scattered shard)."""
+    elems = [
+        b
+        for dtype, dims, b in _element_bytes(shape)
+        if not (dims == "" and dtype.startswith(("u", "s")))
+    ]
+    if not elems:
+        return parse_shape_bytes(shape)
+    if len(elems) >= 2 and len(elems) % 2 == 0:
+        half = len(elems) // 2
+        if elems[:half] == elems[half:]:
+            return sum(elems[half:])
+    return elems[-1]
+
+
+def _parse_groups(line: str) -> Optional[list[list[int]]]:
+    """Extract replica groups as id lists: ``{{0,4},{1,5}}`` -> [[0,4],[1,5]].
+    ``source_target_pairs`` (collective-permute) parse into 2-member groups so
+    axis classification treats each hop as one communicating pair."""
+    m = _REPLICA_GROUPS_RE.search(line)
+    if m is not None:
+        body = m.group("groups")
+        groups = [
+            [int(x) for x in g.split(",") if x.strip()]
+            for g in re.findall(r"\{([^{}]*)\}", body)
+        ]
+        return [g for g in groups if g] or None
+    m = _SOURCE_TARGET_RE.search(line)
+    if m is not None:
+        pairs = re.findall(r"\{(\d+),(\d+)\}", m.group("pairs"))
+        return [[int(a), int(b)] for a, b in pairs] or None
+    m = _IOTA_GROUPS_RE.search(line)
+    if m is not None:
+        dims = [int(d) for d in m.group("dims").split(",")]
+        # Iota groups: the trailing dim is the per-group member count; expand
+        # to consecutive-id groups (iota order, no transpose support — a
+        # transposed iota loses axis attribution but keeps sizes right).
+        n_groups, group_size = int(np.prod(dims[:-1], dtype=int)), dims[-1]
+        return [
+            list(range(g * group_size, (g + 1) * group_size)) for g in range(n_groups)
+        ]
+    return None
+
+
+def _mesh_coords(mesh) -> dict:
+    """Device id -> mesh coordinates, from the mesh's own device array
+    (replica groups use global device ids when use_global_device_ids=true,
+    which is how jax emits SPMD collectives)."""
+    coords = {}
+    for i, dev in enumerate(mesh.devices.reshape(-1)):
+        coords[int(dev.id)] = np.unravel_index(i, mesh.devices.shape)
+    return coords
+
+
+def classify_groups(
+    groups: Optional[list[list[int]]], mesh=None, coords: Optional[dict] = None
+) -> tuple[Optional[tuple[str, ...]], int]:
+    """Map replica groups onto mesh axis names.
+
+    Returns ``(axes, group_size)`` where ``axes`` is the tuple of mesh axes
+    whose coordinates vary within a group (mesh axis order), or ``None`` when
+    no mesh was given / the ids don't match it.  ``group_size`` is the largest
+    group's member count — 1 means degenerate (no traffic), 0 unknown.
+    ``coords`` lets a scan over many collectives reuse one
+    :func:`_mesh_coords` map instead of rebuilding it per instruction.
+    """
+    if not groups:
+        # No replica_groups attribute: the collective spans every device.
+        if mesh is None:
+            return None, 0
+        active = tuple(a for a in mesh.axis_names if mesh.shape[a] > 1)
+        return active, int(np.prod([mesh.shape[a] for a in active], dtype=int)) if active else 1
+    size = max(len(g) for g in groups)
+    if mesh is None or size <= 1:
+        return None, size
+    if coords is None:
+        coords = _mesh_coords(mesh)
+    varying: set[int] = set()
+    for g in groups:
+        cs = [coords.get(d) for d in g]
+        if any(c is None for c in cs):
+            return None, size  # ids outside this mesh (e.g. a sub-mesh program)
+        for dim in range(len(mesh.axis_names)):
+            if len({c[dim] for c in cs}) > 1:
+                varying.add(dim)
+    if not varying:
+        return None, size
+    return tuple(mesh.axis_names[d] for d in sorted(varying)), size
+
+
+def parse_collectives(hlo_text: str, mesh=None) -> list[CollectiveOp]:
+    """Scan optimized HLO text for collective instructions."""
+    ops = []
+    coords = _mesh_coords(mesh) if mesh is not None else None
+    for line in hlo_text.splitlines():
+        m = _COLLECTIVE_RE.match(line)
+        if m is None:
+            continue
+        groups = _parse_groups(line)
+        axes, group_size = classify_groups(groups, mesh, coords)
+        name_m = _OP_NAME_RE.search(line)
+        shape = m.group("shape")
+        ops.append(
+            CollectiveOp(
+                kind=m.group("kind"),
+                bytes=_async_start_bytes(shape) if m.group("start") else parse_shape_bytes(shape),
+                axes=axes,
+                group_size=group_size,
+                op_name=name_m.group("name") if name_m else "",
+            )
+        )
+    return ops
+
+
+def scan_hlo(hlo_text: str, mesh=None) -> CommsLedger:
+    """Build the comms ledger for one compiled program's optimized HLO.
+
+    Byte volumes are the collective's **result bytes on one participating
+    device** — for an all-reduce of a replicated gradient this equals the
+    gradient's full byte size, which is what makes the dp-grad-sync invariant
+    (`all-reduce bytes ≈ param bytes`) checkable.  Degenerate collectives
+    (single-member groups — no traffic) are counted separately, not in the
+    totals.
+    """
+    all_ops = parse_collectives(hlo_text, mesh)
+    ops = [op for op in all_ops if not op.is_degenerate]
+    by_kind: dict = {}
+    by_axis: dict = {}
+    total = 0
+    for op in ops:
+        agg = by_kind.setdefault(op.kind, {"count": 0, "bytes": 0})
+        agg["count"] += 1
+        agg["bytes"] += op.bytes
+        axis_key = "+".join(op.axes) if op.axes else "?"
+        by_axis[axis_key] = by_axis.get(axis_key, 0) + op.bytes
+        total += op.bytes
+    return CommsLedger(
+        ops=ops,
+        by_kind=by_kind,
+        by_axis=by_axis,
+        total_bytes=total,
+        degenerate_ops=len(all_ops) - len(ops),
+    )

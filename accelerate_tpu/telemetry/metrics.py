@@ -219,6 +219,10 @@ class StepTimer:
         self.registry = registry
         self.tokens_per_step: Optional[float] = None
         self.flops_per_step: Optional[float] = None
+        # Per-program analyzed FLOPs from the compiled-program inspector
+        # (introspect.py).  When the user never configured a static estimate,
+        # their sum IS the per-step FLOP count — measured-cost MFU.
+        self.measured_flops: dict = {}
         self._last: Optional[float] = None
 
     def configure(self, tokens_per_step=None, flops_per_step=None):
@@ -227,8 +231,27 @@ class StepTimer:
         if flops_per_step is not None:
             self.flops_per_step = float(flops_per_step)
 
+    def record_measured_flops(self, program: str, flops: float):
+        """Register the XLA-analyzed FLOPs of one compiled program in the step
+        (called by the inspector; latest capture per program name wins).
+        NOTE: ``cost_analysis`` FLOPs are PER DEVICE (the SPMD-partitioned
+        module), unlike ``configure(flops_per_step=)``'s global estimate —
+        the MFU math normalizes the two differently."""
+        self.measured_flops[program] = float(flops)
+
+    @property
+    def effective_flops_per_step(self) -> Optional[float]:
+        """Explicit static estimate if configured, else the summed analyzed
+        cost of every inspected step program — measured beats assumed."""
+        if self.flops_per_step:
+            return self.flops_per_step
+        if self.measured_flops:
+            return sum(self.measured_flops.values())
+        return None
+
     def reset(self):
         self._last = None
+        self.measured_flops.clear()
 
     def step(self) -> Optional[float]:
         """Mark one completed step; returns the step duration in seconds (None
@@ -241,14 +264,22 @@ class StepTimer:
             self.registry.histogram("step.time_ms").observe(dt * 1e3)
             if self.tokens_per_step:
                 self.registry.gauge("step.tokens_per_sec").set(self.tokens_per_step / dt)
-            if self.flops_per_step:
-                try:
+            try:
+                if self.flops_per_step:
+                    # Global static estimate: normalize by the whole fleet.
                     import jax
 
                     peak = peak_flops_per_chip() * jax.device_count()
                     self.registry.gauge("step.mfu").set(self.flops_per_step / dt / peak)
-                except Exception:
-                    pass
+                elif self.measured_flops:
+                    # Analyzed cost is per device (SPMD module): per-chip peak
+                    # only — the same value as global MFU under symmetric SPMD.
+                    flops = sum(self.measured_flops.values())
+                    self.registry.gauge("step.mfu").set(
+                        flops / dt / peak_flops_per_chip()
+                    )
+            except Exception:
+                pass
         self._last = now
         return dt
 

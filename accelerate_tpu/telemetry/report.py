@@ -47,6 +47,7 @@ def summarize(records: list[dict]) -> dict:
     compile_ms = 0.0
     stalls = []
     snapshot = None
+    introspect = {}
     for rec in records:
         kind = rec.get("kind")
         if kind == "span":
@@ -70,6 +71,9 @@ def summarize(records: list[dict]) -> dict:
             )
         elif kind == "metrics":
             snapshot = rec.get("snapshot")  # last one wins (written on disable)
+        elif kind == "introspect":
+            # Latest capture per program name wins (a recompile re-captures).
+            introspect[rec.get("name", "?")] = rec
     return {
         "spans": spans,
         "toplevel_ms": toplevel_ms,
@@ -77,8 +81,20 @@ def summarize(records: list[dict]) -> dict:
         "compile_ms": compile_ms,
         "stalls": stalls,
         "snapshot": snapshot,
+        "introspect": introspect,
         "n_records": len(records),
     }
+
+
+def _human(n) -> str:
+    """1234567 -> '1.2M' (unitless SI prefix; caller appends the unit)."""
+    if n is None:
+        return "?"
+    n = float(n)
+    for mag, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "K")):
+        if abs(n) >= mag:
+            return f"{n / mag:.1f}{suffix} "
+    return f"{n:.0f} "
 
 
 def format_report(summary: dict) -> str:
@@ -109,6 +125,45 @@ def format_report(summary: dict) -> str:
         lines.append(f"stalls: {len(summary['stalls'])}")
         for s in summary["stalls"]:
             lines.append(f"  - stalled {s['elapsed_s']}s (deadline {s['deadline_s']}s)")
+    for name, rec in sorted(summary.get("introspect", {}).items()):
+        lines.append("")
+        lines.append(f"compiled program {name!r} (introspection):")
+        lines.append(
+            f"  cost: {_human(rec.get('flops'))}FLOPs, "
+            f"{_human(rec.get('bytes_accessed'))}B accessed"
+        )
+        mem = rec.get("memory") or {}
+        if mem:
+            lines.append(
+                "  memory: "
+                + ", ".join(f"{k.replace('_bytes', '')} {_human(v)}B" for k, v in mem.items())
+            )
+        comms = rec.get("comms") or {}
+        by_kind = comms.get("by_kind") or {}
+        if by_kind:
+            lines.append(
+                f"  comms: {_human(comms.get('total_bytes'))}B total"
+                + (
+                    f" (est. comms/compute ratio {rec['comms_compute_ratio']:.3f})"
+                    if rec.get("comms_compute_ratio") is not None
+                    else ""
+                )
+            )
+            for op_kind in sorted(by_kind):
+                agg = by_kind[op_kind]
+                lines.append(
+                    f"    {op_kind:<20} x{agg['count']:<4} {_human(agg['bytes'])}B"
+                )
+            by_axis = comms.get("by_axis") or {}
+            if by_axis:
+                lines.append(
+                    "    per mesh axis: "
+                    + ", ".join(f"{ax}={_human(b)}B" for ax, b in sorted(by_axis.items()))
+                )
+        else:
+            lines.append("  comms: no collectives (single-device program)")
+        for finding in rec.get("lint") or []:
+            lines.append(f"  LINT[{finding.get('kind')}]: {finding.get('message')}")
     snapshot = summary["snapshot"]
     if snapshot:
         lines.append("")

@@ -189,6 +189,23 @@ def dequantize(q: QuantizedArray) -> jax.Array:
     return vals.reshape(-1)[:n].reshape(q.shape).astype(q.out_dtype)
 
 
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _quant_stack_leaf(leaf, pad: int, block_size: int):
+    """Blockwise int8 quantization of one stacked ``[L, ...]`` leaf.  Module
+    level (static ``pad``/``block_size``) so repeated ``quantize_layer_stack``
+    calls hit one persistent jit cache instead of rebuilding it per call."""
+    L = leaf.shape[0]
+    flat = leaf.astype(jnp.float32).reshape(L, -1)
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((L, pad), jnp.float32)], axis=1)
+    blocks = flat.reshape(L, -1, block_size)
+    absmax = jnp.maximum(jnp.max(jnp.abs(blocks), axis=2), 1e-12)  # [L, n_blocks]
+    codes = jnp.clip(
+        jnp.round(blocks / absmax[:, :, None] * 127.0), -127, 127
+    ).astype(jnp.int8)
+    return codes, absmax
+
+
 def quantize_layer_stack(
     stacked: Any,
     block_size: int = 64,
@@ -206,21 +223,8 @@ def quantize_layer_stack(
     Leaves whose per-layer rank is < 2 — stacked norm scales and biases —
     stay full precision, as do leaves named in ``skip`` (quality-critical
     small tensors, e.g. an MoE router).  The per-leaf quantization is
-    jitted so XLA writes int8 codes directly instead of materializing fp32
-    transients next to device-resident params."""
-
-    @functools.partial(jax.jit, static_argnums=(1,))
-    def quant_one(leaf, pad):
-        L = leaf.shape[0]
-        flat = leaf.astype(jnp.float32).reshape(L, -1)
-        if pad:
-            flat = jnp.concatenate([flat, jnp.zeros((L, pad), jnp.float32)], axis=1)
-        blocks = flat.reshape(L, -1, block_size)
-        absmax = jnp.maximum(jnp.max(jnp.abs(blocks), axis=2), 1e-12)  # [L, n_blocks]
-        codes = jnp.clip(
-            jnp.round(blocks / absmax[:, :, None] * 127.0), -127, 127
-        ).astype(jnp.int8)
-        return codes, absmax
+    jitted (``_quant_stack_leaf``) so XLA writes int8 codes directly instead
+    of materializing fp32 transients next to device-resident params."""
 
     def one(kp, leaf):
         name = str(getattr(kp[-1], "key", kp[-1]))
@@ -228,7 +232,7 @@ def quantize_layer_stack(
             return leaf
         rest = tuple(leaf.shape[1:])
         n = int(np.prod(rest))
-        codes, absmax = quant_one(leaf, (-n) % block_size)
+        codes, absmax = _quant_stack_leaf(leaf, (-n) % block_size, block_size)
         return QuantizedArray(codes, absmax, rest, "int8", block_size, out_dtype)
 
     return jax.tree_util.tree_map_with_path(one, stacked)

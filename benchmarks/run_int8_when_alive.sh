@@ -24,8 +24,14 @@ done
 echo "[int8-watcher] running int8-resident 8.36B (synthetic weights)"
 python benchmarks/tpu_big_model_bench.py --rung int8 --layers 40 2>&1 |
   tee /tmp/int8_84b_watch.log | grep '^{' >> BENCH_big_model_tpu.json
-echo "[int8-watcher] rc=${PIPESTATUS[0]}"
+rc1=${PIPESTATUS[0]}
+echo "[int8-watcher] rc=$rc1"
 echo "[int8-watcher] running int8-resident 6.7B (real weights, vs bf16 0.1167)"
 python benchmarks/tpu_big_model_bench.py --rung int8 --layers 32 --real_weights 2>&1 |
   tee /tmp/int8_67b_watch.log | grep '^{' >> BENCH_big_model_tpu.json
-echo "[int8-watcher] rc=${PIPESTATUS[0]}; done"
+rc2=${PIPESTATUS[0]}
+echo "[int8-watcher] rc=$rc2; done"
+# A failed rung must fail the script — `grep >> artifact` otherwise eats the
+# python exit code and a dead rung silently appends nothing.
+[ "$rc1" -eq 0 ] || exit "$rc1"
+exit "$rc2"

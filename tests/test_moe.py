@@ -211,3 +211,22 @@ def test_mixtral_ragged_impl_end_to_end():
     state = AcceleratorState(parallelism_config=ParallelismConfig(ep=4, dp=2))
     with pytest.raises(ValueError, match="ragged"):
         mixtral.apply(params, jnp.asarray(ids), cfg)
+
+
+def test_mixtral_ragged_warns_on_sharded_batch_mesh():
+    """Under a dp/fsdp mesh the ragged impl gathers the GLOBAL token set per
+    device (argsort/bincount over all tokens) — allowed, but it must warn that
+    the mesh's data parallelism buys nothing."""
+    import warnings
+
+    from accelerate_tpu import AcceleratorState, ParallelismConfig
+    from accelerate_tpu.models import mixtral
+
+    cfg = mixtral.MixtralConfig.tiny(moe_impl="ragged")
+    AcceleratorState(parallelism_config=ParallelismConfig(dp=4, fsdp=2))
+    with pytest.warns(UserWarning, match="sharded batch axes"):
+        mixtral._check_moe_impl(cfg)
+    # Dense impl on the same mesh: silent.
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        mixtral._check_moe_impl(mixtral.MixtralConfig.tiny())
