@@ -1,10 +1,10 @@
 # Test-suite splits mirroring the reference Makefile:25-77.
 
-.PHONY: test test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels bench telemetry-smoke introspect-smoke
+.PHONY: test test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels bench telemetry-smoke introspect-smoke resilience-smoke
 
 PYTEST = python -m pytest -q
 
-test: telemetry-smoke introspect-smoke
+test: telemetry-smoke introspect-smoke resilience-smoke
 	$(PYTEST) tests/
 
 # 3-step CPU training loop with telemetry ON; asserts the JSONL trace is
@@ -17,6 +17,13 @@ telemetry-smoke:
 # (docs/package_reference/introspect.md).
 introspect-smoke:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.telemetry.introspect_smoke
+
+# Kill-and-resume proof: SIGTERMs a CPU training run mid-step (fault
+# injection), asserts the PreemptionGuard wrote a manifest-complete verified
+# checkpoint, and a fresh process resumes to bit-exact loss continuation
+# (docs/usage_guides/resilience.md).
+resilience-smoke:
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.resilience.smoke
 
 # Everything except big-modeling / engine dialects / CLI / examples.
 test_core:

@@ -79,4 +79,9 @@ def __getattr__(name):
         from .logging import get_logger
 
         return get_logger
+    if name in ("PreemptionGuard", "RetryPolicy", "retrying", "verify_checkpoint",
+                "find_latest_complete", "CheckpointVerificationError"):
+        from . import resilience
+
+        return getattr(resilience, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -722,6 +722,10 @@ class Accelerator:
         self._save_state_pre_hooks: "OrderedDict" = collections.OrderedDict()
         self._load_state_pre_hooks: "OrderedDict" = collections.OrderedDict()
         self.flag_tensor = None
+        # Resilience: no guard (and no signal handlers, no per-step cost)
+        # unless enable_preemption_handling() opts in.
+        self._preemption_guard = None
+        self._pending_checkpoint_finalize = None
         self.trackers: list = []
         self.log_with = log_with if isinstance(log_with, (list, tuple)) else ([log_with] if log_with else [])
 
@@ -1595,10 +1599,103 @@ class Accelerator:
 
     def wait_for_checkpoint(self):
         """Block until any in-flight async checkpoint writes
-        (``save_state(async_save=True)``) are durable on disk."""
-        for ck in getattr(self, "_async_checkpointers", []):
-            ck.wait_until_finished()
-        self._async_checkpointers = []
+        (``save_state(async_save=True)``) are durable on disk.  The join runs
+        under the resilience retry policy and a failed async save re-raises
+        here with a clear error (instead of dying silently with its thread);
+        for verified saves this also runs the deferred manifest + atomic
+        rename that publishes the checkpoint."""
+        from .checkpointing import finalize_async_checkpoint
+
+        finalize_async_checkpoint(self)
+
+    # -- resilience (full impl in resilience/) --------------------------------
+
+    def enable_preemption_handling(self, save_dir: Optional[str] = None, signals=None, coordinated=None):
+        """Install a :class:`~accelerate_tpu.resilience.PreemptionGuard` for
+        this process (idempotent).  ``save_dir`` is where
+        :meth:`check_preemption` writes the final verified checkpoint (default:
+        the project's automatic checkpoint naming).  Returns the guard."""
+        from .resilience import PreemptionGuard
+
+        if self._preemption_guard is None and save_dir is None and not (
+            self.project_configuration.automatic_checkpoint_naming
+        ):
+            # Fail at INSTALL time, not at signal delivery — discovering the
+            # missing save target inside the preemption path would kill the
+            # run with a traceback exactly when the final checkpoint matters.
+            # (A re-enable of an already-installed guard keeps its target, so
+            # the idempotent second call never trips this.)
+            raise ValueError(
+                "enable_preemption_handling needs a checkpoint target: pass "
+                "save_dir=, or enable ProjectConfiguration("
+                "automatic_checkpoint_naming=True)."
+            )
+        if self._preemption_guard is None:
+            kwargs = {}
+            if signals is not None:
+                kwargs["signals"] = signals
+            self._preemption_guard = PreemptionGuard(coordinated=coordinated, **kwargs)
+            self._preemption_guard.install()
+        if save_dir is not None:
+            self._preemption_guard.save_dir = save_dir
+        return self._preemption_guard
+
+    def check_preemption(self, save_dir: Optional[str] = None, step: Optional[int] = None) -> bool:
+        """Call once per step at the step boundary.  Returns True when the
+        fleet agreed a preemption signal arrived — after writing ONE final
+        verified checkpoint (to ``save_dir``, the guard's configured dir, or
+        automatic naming) so the caller can break out of the loop and exit
+        cleanly.  ``step`` is recorded in the checkpoint manifest for
+        :meth:`resume_from_latest`.  Without an installed guard this is a
+        single attribute check (plus the env-armed fault-injection tick)."""
+        from .resilience import faultinject
+
+        if faultinject.armed():
+            faultinject.tick(step if step is not None else self.step)
+        guard = self._preemption_guard
+        if guard is None or not guard.should_stop():
+            return False
+        if not guard.final_checkpoint_saved:
+            target = save_dir or guard.save_dir
+            from .telemetry import get_telemetry, span as _tspan
+
+            with _tspan("resilience.final_checkpoint"):
+                self.save_state(target, step=step)
+            guard.final_checkpoint_saved = True
+            tel = get_telemetry()
+            if tel.enabled:
+                tel.registry.counter("resilience.preempt_checkpoints").inc()
+                tel.event("resilience.preempt_checkpoint", step=step)
+            from .logging import get_logger
+
+            get_logger(__name__).warning(
+                f"preemption checkpoint written (step={step}); exiting cleanly"
+            )
+        return True
+
+    def resume_from_latest(self, checkpoint_dir: Optional[str] = None, verify: bool = True):
+        """Auto-resume: restore the newest *manifest-complete* checkpoint
+        under ``checkpoint_dir`` (default: ``<project_dir>/checkpoints``),
+        skipping torn partials from crashed saves.  Restores model/optimizer/
+        scheduler/RNG/dataloader position via ``load_state`` and returns the
+        step recorded at save time (``save_state(..., step=N)`` /
+        ``check_preemption(step=N)``), 0 when the checkpoint carries no step,
+        or None when no complete checkpoint exists."""
+        from .resilience.manifest import find_latest_complete, read_manifest
+
+        root = checkpoint_dir or os.path.join(self.project_dir or ".", "checkpoints")
+        ckpt = find_latest_complete(root)
+        if ckpt is None:
+            return None
+        self.load_state(ckpt, verify=verify)
+        # Automatic naming must not overwrite the checkpoint we just resumed
+        # from on the next save.
+        tail = os.path.basename(ckpt).rsplit("_", 1)[-1]
+        if os.path.basename(ckpt).startswith("checkpoint_") and tail.isdigit():
+            self.project_configuration.iteration = int(tail) + 1
+        manifest = read_manifest(ckpt) or {}
+        step = manifest.get("step")
+        return int(step) if step is not None else 0
 
     def free_memory(self, *objects):
         """Reference ``accelerator.py:3497``: drop references + clear caches.
@@ -1643,6 +1740,13 @@ class Accelerator:
         raise ValueError(f"Tracker {name} not found")
 
     def end_training(self):
+        # A deferred verified async save must publish before the run ends —
+        # exiting with the manifest+rename pending would strand the final
+        # checkpoint in `.tmp` for the next run's rotation to sweep.
+        if getattr(self, "_pending_checkpoint_finalize", None) is not None or getattr(
+            self, "_async_checkpointers", []
+        ):
+            self.wait_for_checkpoint()
         for tracker in self.trackers:
             tracker.finish()
 

@@ -386,14 +386,9 @@ def _resolve_output_dir(accelerator, output_dir: Optional[str]) -> str:
     if cfg.automatic_checkpoint_naming:
         base = os.path.join(accelerator.project_dir or ".", "checkpoints")
         output_dir = os.path.join(base, f"checkpoint_{cfg.iteration}")
-        if cfg.total_limit is not None and os.path.isdir(base):
-            existing = sorted(
-                (d for d in os.listdir(base) if d.startswith("checkpoint_")),
-                key=lambda d: int(d.split("_")[-1]),
-            )
-            while len(existing) >= cfg.total_limit:
-                victim = existing.pop(0)
-                shutil.rmtree(os.path.join(base, victim), ignore_errors=True)
+        # NOTE: keep-last-N rotation runs AFTER the new save publishes (see
+        # save_accelerator_state) — pruning before the write could destroy the
+        # last good checkpoint and then fail the save, leaving nothing.
     if output_dir is None:
         raise ValueError("output_dir required (or enable automatic_checkpoint_naming)")
     return output_dir
@@ -424,13 +419,151 @@ def _use_local_save(accelerator) -> bool:
     return _plugin_save_mode(accelerator, "LOCAL_STATE_DICT")
 
 
+def _io_policy(label: str):
+    """Retry policy for checkpoint I/O.  Env-tunable so tests can shrink the
+    backoff: ``ACCELERATE_TPU_IO_RETRIES`` (default 4),
+    ``ACCELERATE_TPU_IO_RETRY_BASE_S`` (0.2), ``…_DEADLINE_S`` (120)."""
+    from .resilience.retry import RetryPolicy
+
+    def _env(key, default, cast):
+        try:
+            return cast(os.environ.get(key, "") or default)
+        except ValueError:
+            return cast(default)
+
+    return RetryPolicy(
+        # 0 (the natural "disable retries") means one attempt, not a crash.
+        tries=max(1, _env("ACCELERATE_TPU_IO_RETRIES", 4, int)),
+        base_delay_s=_env("ACCELERATE_TPU_IO_RETRY_BASE_S", 0.2, float),
+        deadline_s=_env("ACCELERATE_TPU_IO_RETRY_DEADLINE_S", 120.0, float),
+        label=label,
+    )
+
+
+# Safety net for `save_state(async_save=True)` followed by plain process
+# exit: a verified async save's manifest+rename is DEFERRED, and without a
+# finalize the run's last checkpoint would sit unpublished in `.tmp` (and be
+# swept as stale by the next run's rotation).  One atexit hook finalizes
+# every accelerator with a pending publish — single-process only, because a
+# multi-host publish barriers on wait_for_everyone and an atexit collective
+# against already-dead peers would hang interpreter shutdown (multi-host
+# relies on the documented wait_for_checkpoint()/end_training() lifecycle).
+_pending_finalize_accelerators: "weakref.WeakSet" = None  # type: ignore[assignment]
+
+
+def _register_finalize_atexit(accelerator) -> None:
+    import atexit
+    import weakref
+
+    global _pending_finalize_accelerators
+    if _pending_finalize_accelerators is None:
+        _pending_finalize_accelerators = weakref.WeakSet()
+        atexit.register(_finalize_pending_at_exit)
+    _pending_finalize_accelerators.add(accelerator)
+
+
+def _finalize_pending_at_exit() -> None:
+    for accelerator in list(_pending_finalize_accelerators or ()):
+        try:
+            if (
+                getattr(accelerator, "_pending_checkpoint_finalize", None) is not None
+                and accelerator.state.num_processes == 1
+            ):
+                logger.warning(
+                    "finalizing a pending async checkpoint at interpreter exit — "
+                    "call wait_for_checkpoint() or end_training() to publish it "
+                    "deterministically."
+                )
+                finalize_async_checkpoint(accelerator)
+        except Exception:
+            logger.exception("atexit checkpoint finalize failed")
+
+
+def finalize_async_checkpoint(accelerator) -> None:
+    """Join any in-flight async (orbax) checkpoint writes under the retry
+    policy and run the deferred atomic publish.  A failed async save used to
+    die silently with its thread; here it re-raises on the save path with a
+    clear error, and the torn checkpoint is never published."""
+    checkpointers = getattr(accelerator, "_async_checkpointers", [])
+    errors: list = []
+    if checkpointers:
+        policy = _io_policy("checkpoint.async_join")
+        # Join EVERY checkpointer even after one fails: abandoning the rest
+        # would leave orbax threads still streaming into a staging dir the
+        # next save is about to delete.
+        for ck in checkpointers:
+            try:
+                policy.call(ck.wait_until_finished)
+            except Exception as e:
+                errors.append(e)
+        accelerator._async_checkpointers = []
+    fleet_failed = bool(errors)
+    if checkpointers and accelerator.state.num_processes > 1:
+        # Every process must take the SAME branch below: a process that
+        # raises pre-barrier while the others enter _publish's
+        # wait_for_everyone turns one host's I/O failure into a fleet-wide
+        # hang.  Agree on (any host failed?) first.
+        from .utils.operations import gather_object
+
+        try:
+            fleet_failed = any(gather_object([bool(errors)]))
+        except Exception:
+            fleet_failed = True  # coordination itself broken: nobody publishes
+    if fleet_failed:
+        accelerator._pending_checkpoint_finalize = None
+        # Every checkpointer is joined (no in-flight writers remain), so the
+        # torn staging dir is reclaimable garbage — without this a failed
+        # async save strands a full checkpoint's worth of disk.  One process
+        # deletes (shared-FS semantics); ignore_errors covers local-FS races.
+        staging = getattr(accelerator, "_pending_checkpoint_staging", None)
+        accelerator._pending_checkpoint_staging = None
+        state = accelerator.state
+        if staging and os.path.isdir(staging) and (state.is_main_process or state.num_processes == 1):
+            shutil.rmtree(staging, ignore_errors=True)
+        detail = "; ".join(str(e) for e in errors) if errors else "another process reported failure"
+        raise RuntimeError(
+            "async (orbax) checkpoint save failed while finalizing — the "
+            "checkpoint is incomplete and was NOT published; the previous "
+            f"complete checkpoint is still the resume target: {detail}"
+        ) from (errors[0] if errors else None)
+    finalize = getattr(accelerator, "_pending_checkpoint_finalize", None)
+    if finalize is not None:
+        accelerator._pending_checkpoint_finalize = None
+        accelerator._pending_checkpoint_staging = None
+        finalize()
+
+
 @_span("checkpoint.save_state")
 def save_accelerator_state(accelerator, output_dir: Optional[str] = None, **save_model_func_kwargs) -> str:
     """Reference ``save_accelerator_state`` ``checkpointing.py:56`` +
-    ``Accelerator.save_state`` orchestration."""
-    output_dir = _resolve_output_dir(accelerator, output_dir)
-    os.makedirs(output_dir, exist_ok=True)
+    ``Accelerator.save_state`` orchestration.
+
+    Atomic verified save (default, ``verified=False`` opts out): every file is
+    written into ``<output_dir>.tmp``, a ``manifest.json`` (per-file size +
+    SHA-256, ``step``, world size, library version) is written LAST, files are
+    fsynced, and the staging dir atomically renames onto ``output_dir`` — a
+    crash mid-save can never leave a manifest-complete final directory.  Pass
+    ``step=<int>`` to record the training step for ``resume_from_latest``.
+    """
+    step = save_model_func_kwargs.pop("step", None)
+    verified = save_model_func_kwargs.pop("verified", True)
+    # A still-running async save from the previous save_state must be joined
+    # (and its deferred publish run) before its directory can be replaced.
+    finalize_async_checkpoint(accelerator)
+
+    final_dir = _resolve_output_dir(accelerator, output_dir)
     state = accelerator.state
+    is_writer = state.is_main_process or state.num_processes == 1
+    if verified:
+        output_dir = f"{final_dir.rstrip(os.sep)}.tmp"
+        if is_writer and os.path.isdir(output_dir):
+            # Leftover staging from a crashed save: never loadable, safe to drop.
+            shutil.rmtree(output_dir, ignore_errors=True)
+        if state.num_processes > 1:
+            accelerator.wait_for_everyone()
+    else:
+        output_dir = final_dir
+    os.makedirs(output_dir, exist_ok=True)
 
     sharded = _use_sharded_save(accelerator)
     local = _use_local_save(accelerator)
@@ -465,10 +598,6 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, **save
         for hook in pre_hooks:
             hook(accelerator._models, hook_weights, output_dir)
     if sharded:
-        # A still-running async save from the previous save_state must finish
-        # before its directory can be replaced.
-        for ck in getattr(accelerator, "_async_checkpointers", []):
-            ck.wait_until_finished()
         async_save = bool(save_model_func_kwargs.get("async_save", False))
         checkpointers = []
         # Orbax path runs on EVERY process — each writes only its own shards
@@ -530,25 +659,126 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, **save
     with open(os.path.join(output_dir, f"random_states_{state.process_index}.pkl"), "wb") as f:
         pickle.dump(_rng_state_bundle(), f)
 
+    if verified:
+        staging_dir = output_dir
+
+        def _publish_io():
+            from .resilience.manifest import fsync_dir, fsync_enabled, write_manifest
+
+            write_manifest(staging_dir, step=step)
+            # Overwriting an existing final dir: move it aside FIRST (one
+            # metadata op), swing staging in, then delete the old tree.  The
+            # previous checkpoint is destroyed only AFTER the new one is
+            # published — an rmtree-before-rename would leave a crash window
+            # with no published checkpoint at all.
+            trash_dir = f"{final_dir.rstrip(os.sep)}.old"
+            if os.path.isdir(trash_dir):
+                if not os.path.isdir(final_dir):
+                    # A previous attempt (or crashed publish) displaced the
+                    # last good checkpoint and died before the swap: put it
+                    # BACK — it is the only published state, not garbage.
+                    os.rename(trash_dir, final_dir)
+                else:
+                    shutil.rmtree(trash_dir)
+            displaced = False
+            if os.path.isdir(final_dir):
+                os.rename(final_dir, trash_dir)
+                displaced = True
+            try:
+                os.rename(staging_dir, final_dir)
+            except BaseException:
+                if displaced:
+                    # Undo the displacement so a retry (or a crash-landing
+                    # reader) still finds the previous checkpoint published.
+                    os.rename(trash_dir, final_dir)
+                raise
+            if fsync_enabled():
+                fsync_dir(os.path.dirname(final_dir) or ".")
+            if displaced:
+                shutil.rmtree(trash_dir, ignore_errors=True)
+
+        def _publish():
+            with _span("checkpoint.publish"):
+                if state.num_processes > 1:
+                    # Every process's files must be in staging before the swap.
+                    accelerator.wait_for_everyone()
+                if is_writer:
+                    _io_policy("checkpoint.publish").call(_publish_io)
+                    cfg = accelerator.project_configuration
+                    if cfg.automatic_checkpoint_naming and cfg.total_limit is not None:
+                        from .resilience.manifest import prune_checkpoints
+
+                        prune_checkpoints(os.path.dirname(final_dir), keep=cfg.total_limit)
+                if state.num_processes > 1:
+                    accelerator.wait_for_everyone()
+
+        if getattr(accelerator, "_async_checkpointers", []):
+            # Async orbax writes are still streaming into staging: defer the
+            # manifest + rename until wait_for_checkpoint(), end_training(),
+            # or the next save_state joins them (single-process runs also get
+            # an atexit net).  The staging path rides along so a failed join
+            # can reclaim the torn dir instead of leaking it.
+            accelerator._pending_checkpoint_finalize = _publish
+            accelerator._pending_checkpoint_staging = staging_dir
+            _register_finalize_atexit(accelerator)
+        else:
+            _publish()
+    elif accelerator.project_configuration.automatic_checkpoint_naming:
+        cfg = accelerator.project_configuration
+        if cfg.total_limit is not None and is_writer:
+            # Legacy (unverified) rotation: oldest-first by index, no
+            # completeness bookkeeping to consult.  The isdigit guard keeps
+            # verified saves' checkpoint_N.tmp/.old siblings out of int().
+            base = os.path.dirname(final_dir)
+            existing = sorted(
+                (
+                    d for d in os.listdir(base)
+                    if d.startswith("checkpoint_") and d.split("_")[-1].isdigit()
+                ),
+                key=lambda d: int(d.split("_")[-1]),
+            )
+            while len(existing) > cfg.total_limit:
+                shutil.rmtree(os.path.join(base, existing.pop(0)), ignore_errors=True)
+
     accelerator.project_configuration.iteration += 1
-    logger.info(f"Saved accelerator state to {output_dir}")
-    return output_dir
+    logger.info(f"Saved accelerator state to {final_dir}")
+    return final_dir
 
 
 @_span("checkpoint.load_state")
 def load_accelerator_state(accelerator, input_dir: Optional[str] = None, **load_model_func_kwargs) -> None:
-    """Reference ``load_accelerator_state`` ``checkpointing.py:174``."""
+    """Reference ``load_accelerator_state`` ``checkpointing.py:174``.
+
+    When the checkpoint carries a ``manifest.json`` it is verified (file
+    sizes, and SHA-256 unless ``ACCELERATE_TPU_MANIFEST_HASH=0``) before
+    anything is restored; pass ``verify=False`` to skip.  Manifest-less
+    (legacy) checkpoints load as before."""
+    verify = load_model_func_kwargs.pop("verify", True)
     if input_dir is None and accelerator.project_configuration.automatic_checkpoint_naming:
+        from .resilience.manifest import find_latest_complete
+
         base = os.path.join(accelerator.project_dir or ".", "checkpoints")
-        existing = sorted(
-            (d for d in os.listdir(base) if d.startswith("checkpoint_")),
-            key=lambda d: int(d.split("_")[-1]),
-        )
-        if not existing:
-            raise FileNotFoundError(f"No checkpoints in {base}")
-        input_dir = os.path.join(base, existing[-1])
+        # Prefer the newest manifest-COMPLETE checkpoint; a torn partial from
+        # a crashed save must not shadow the last good one.
+        input_dir = find_latest_complete(base)
+        if input_dir is None:
+            existing = sorted(
+                (
+                    d for d in os.listdir(base)
+                    if d.startswith("checkpoint_") and d.split("_")[-1].isdigit()
+                ),
+                key=lambda d: int(d.split("_")[-1]),
+            ) if os.path.isdir(base) else []
+            if not existing:
+                raise FileNotFoundError(f"No checkpoints in {base}")
+            input_dir = os.path.join(base, existing[-1])
     if input_dir is None:
         raise ValueError("input_dir required")
+    if verify:
+        from .resilience.manifest import read_manifest, verify_checkpoint
+
+        if read_manifest(input_dir) is not None:
+            verify_checkpoint(input_dir)
 
     # load_state pre-hooks (reference accelerator.py:3106-3112): run before
     # any state is restored.

@@ -251,13 +251,29 @@ class AcceleratedOptimizer:
     def load_state_dict(self, state_dict: dict):
         target = self.opt_state
         loaded = state_dict["opt_state"]
-        # Restore with the live opt-state's shardings.
+        # Restore with the live opt-state's shardings — but ONLY where the
+        # live leaf is meaningfully placed (spans >1 device, or lives in a
+        # non-default memory space like pinned_host).  A fresh ``tx.init``
+        # leaves scalar leaves (optax's ``count``) as UNCOMMITTED
+        # single-device arrays whose placement the next update's jit resolves
+        # against the params; ``device_put``-committing them to the init
+        # device pins them to device 0 and a resumed run on a multi-device
+        # mesh then fails jit placement ("incompatible devices") on its very
+        # first step.
         flat_t, treedef = jax.tree_util.tree_flatten(target)
         flat_l = jax.tree_util.tree_leaves(loaded)
         placed = []
         for t, l in zip(flat_t, flat_l):
-            if isinstance(t, jax.Array) and hasattr(t, "sharding"):
-                placed.append(jax.device_put(jnp.asarray(l), t.sharding))
+            sharding = getattr(t, "sharding", None) if isinstance(t, jax.Array) else None
+            pinned = False
+            if sharding is not None and getattr(sharding, "memory_kind", None) is not None:
+                try:
+                    default_kind = next(iter(sharding.device_set)).default_memory().kind
+                except Exception:
+                    default_kind = None
+                pinned = default_kind is not None and sharding.memory_kind != default_kind
+            if sharding is not None and (len(sharding.device_set) > 1 or pinned):
+                placed.append(jax.device_put(jnp.asarray(l), sharding))
             else:
                 placed.append(l)
         self.opt_state = jax.tree_util.tree_unflatten(treedef, placed)

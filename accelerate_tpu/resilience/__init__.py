@@ -1,0 +1,66 @@
+"""Fault-tolerance layer: atomic verified checkpoints, retry policies,
+preemption-safe stepping, auto-resume, and a fault-injection harness.
+
+On real TPU pods the dominant failure mode is the environment killing the
+job — preemptions, flaky filesystem writes, OOMs — and under SPMD execution a
+single host writing a torn checkpoint corrupts the whole multi-host run.  The
+pieces here make the training loop survive those (see
+``docs/usage_guides/resilience.md``):
+
+- **atomic verified checkpoints** (``manifest.py``) — ``save_state`` stages
+  into ``<dir>.tmp``, writes a ``manifest.json`` (per-file size + SHA-256,
+  step, world size, library version) LAST, fsyncs, then atomically renames.
+  A crash mid-save can never leave a manifest-complete directory, so
+  ``verify_checkpoint`` / ``find_latest_complete`` can tell torn partials
+  from real checkpoints.
+- **retry/timeout/backoff** (``retry.py``) — ``retrying()`` wraps checkpoint
+  I/O so transient FS/GCS errors back off (exponential + jitter, deadline)
+  instead of killing a run; counted in telemetry as ``resilience.retries`` /
+  ``resilience.gave_up``.
+- **preemption-safe stepping** (``preemption.py``) — ``PreemptionGuard``
+  installs SIGTERM/SIGINT handlers (multi-host coordinated so every process
+  agrees) and ``Accelerator.check_preemption()`` turns the signal into one
+  final verified checkpoint at the next step boundary.
+- **auto-resume** — ``Accelerator.resume_from_latest(dir)`` restores the
+  newest *manifest-complete* checkpoint (skipping torn partials) and returns
+  the resumed step.
+- **fault injection** (``faultinject.py``) — env-driven failure modes (fail
+  the Nth checkpoint write, SIGTERM at step K, one synthetic
+  RESOURCE_EXHAUSTED) that ``make resilience-smoke`` uses to prove
+  kill-and-resume gives bit-exact loss continuation.
+
+Zero overhead when unused: no signal handlers are installed and no manifest
+hashing runs unless a guard is installed / a checkpoint is saved; hashing is
+skippable for huge checkpoints via ``ACCELERATE_TPU_MANIFEST_HASH=0``.
+"""
+
+from .manifest import (
+    ENV_MANIFEST_HASH,
+    MANIFEST_NAME,
+    CheckpointVerificationError,
+    find_latest_complete,
+    is_complete,
+    list_checkpoints,
+    prune_checkpoints,
+    read_manifest,
+    verify_checkpoint,
+    write_manifest,
+)
+from .preemption import PreemptionGuard
+from .retry import RetryPolicy, retrying
+
+__all__ = [
+    "MANIFEST_NAME",
+    "ENV_MANIFEST_HASH",
+    "CheckpointVerificationError",
+    "write_manifest",
+    "read_manifest",
+    "verify_checkpoint",
+    "is_complete",
+    "list_checkpoints",
+    "find_latest_complete",
+    "prune_checkpoints",
+    "RetryPolicy",
+    "retrying",
+    "PreemptionGuard",
+]

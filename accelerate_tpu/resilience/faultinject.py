@@ -1,0 +1,157 @@
+"""Env-driven fault injection for resilience testing.
+
+Three failure modes, each armed by an environment variable so a *subprocess*
+under test can be broken without code changes (``make resilience-smoke`` and
+``tests/test_resilience.py`` drive these):
+
+- ``ACCELERATE_TPU_FAULT_WRITE_N=<n>`` — the Nth checkpoint write (1-based,
+  counted process-wide across the manifest/staging path) raises
+  :class:`InjectedWriteError` (an ``OSError``, so it looks transient to the
+  retry policy).  With ``ACCELERATE_TPU_FAULT_WRITE_STICKY=1`` every write
+  from the Nth on fails — a dead filesystem rather than a transient blip —
+  which exhausts ``retrying()`` and produces a torn (manifest-less) save.
+- ``ACCELERATE_TPU_FAULT_SIGTERM_STEP=<k>`` — :func:`tick` delivers a real
+  SIGTERM to this process the first time it sees ``step >= k`` (exercising
+  the actual signal path through ``PreemptionGuard``).
+- ``ACCELERATE_TPU_FAULT_OOM_ONCE=1`` — :func:`maybe_oom` raises one
+  synthetic ``RESOURCE_EXHAUSTED`` RuntimeError, then goes quiet (drives
+  ``find_executable_batch_size``'s halving path).
+
+Zero overhead when unarmed: the env is read once, and every hook is a single
+``if`` on a cached None.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Optional
+
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "InjectedWriteError",
+    "armed",
+    "maybe_fail_write",
+    "tick",
+    "maybe_oom",
+    "reload",
+]
+
+ENV_WRITE_N = "ACCELERATE_TPU_FAULT_WRITE_N"
+ENV_WRITE_STICKY = "ACCELERATE_TPU_FAULT_WRITE_STICKY"
+ENV_SIGTERM_STEP = "ACCELERATE_TPU_FAULT_SIGTERM_STEP"
+ENV_OOM_ONCE = "ACCELERATE_TPU_FAULT_OOM_ONCE"
+
+
+class InjectedWriteError(OSError):
+    """A fault-injected checkpoint-write failure."""
+
+
+class _Config:
+    __slots__ = ("write_n", "write_sticky", "sigterm_step", "oom_once")
+
+    def __init__(self):
+        def _int(key) -> Optional[int]:
+            raw = os.environ.get(key, "").strip()
+            return int(raw) if raw else None
+
+        self.write_n = _int(ENV_WRITE_N)
+        self.write_sticky = os.environ.get(ENV_WRITE_STICKY, "").strip().lower() in (
+            "1", "true", "yes", "on",
+        )
+        self.sigterm_step = _int(ENV_SIGTERM_STEP)
+        self.oom_once = os.environ.get(ENV_OOM_ONCE, "").strip().lower() in (
+            "1", "true", "yes", "on",
+        )
+
+    @property
+    def any_armed(self) -> bool:
+        return self.write_n is not None or self.sigterm_step is not None or self.oom_once
+
+
+_cfg: Optional[_Config] = None
+_lock = threading.Lock()
+_write_count = 0
+_sigterm_fired = False
+_oom_fired = False
+
+
+def _config() -> _Config:
+    global _cfg
+    if _cfg is None:
+        _cfg = _Config()
+        if _cfg.any_armed:
+            logger.warning(
+                "fault injection ARMED: "
+                f"write_n={_cfg.write_n} sticky={_cfg.write_sticky} "
+                f"sigterm_step={_cfg.sigterm_step} oom_once={_cfg.oom_once}"
+            )
+    return _cfg
+
+
+def reload() -> None:
+    """Re-read the env and reset counters (tests flip env vars in-process)."""
+    global _cfg, _write_count, _sigterm_fired, _oom_fired
+    with _lock:
+        _cfg = None
+        _write_count = 0
+        _sigterm_fired = False
+        _oom_fired = False
+
+
+def armed() -> bool:
+    return _config().any_armed
+
+
+def maybe_fail_write(path: str) -> None:
+    """Called once per file on the checkpoint save path; raises on the
+    configured Nth write (and, when sticky, every one after it)."""
+    cfg = _config()
+    if cfg.write_n is None:
+        return
+    global _write_count
+    with _lock:
+        _write_count += 1
+        count = _write_count
+    if count == cfg.write_n or (cfg.write_sticky and count >= cfg.write_n):
+        raise InjectedWriteError(
+            f"injected write failure #{count} (threshold {cfg.write_n}, "
+            f"sticky={cfg.write_sticky}) at {path!r}"
+        )
+
+
+def tick(step: Optional[int]) -> None:
+    """Step-boundary hook (``Accelerator.check_preemption`` calls this):
+    delivers SIGTERM to this process once when ``step`` reaches the armed
+    threshold."""
+    cfg = _config()
+    if cfg.sigterm_step is None or step is None:
+        return
+    global _sigterm_fired
+    if _sigterm_fired or step < cfg.sigterm_step:
+        return
+    _sigterm_fired = True
+    logger.warning(f"fault injection: delivering SIGTERM at step {step}")
+    os.kill(os.getpid(), signal.SIGTERM)
+
+
+def maybe_oom() -> None:
+    """Raises one synthetic RESOURCE_EXHAUSTED, then goes quiet.  Place this
+    inside the function under ``find_executable_batch_size`` to exercise the
+    OOM-halving path without a real allocator failure."""
+    cfg = _config()
+    if not cfg.oom_once:
+        return
+    global _oom_fired
+    with _lock:
+        if _oom_fired:
+            return
+        _oom_fired = True
+    raise RuntimeError(
+        "RESOURCE_EXHAUSTED: injected out-of-memory (fault injection "
+        f"{ENV_OOM_ONCE}=1; fires once)"
+    )

@@ -1,0 +1,201 @@
+"""Preemption-safe stepping: coordinated SIGTERM/SIGINT handling.
+
+``PreemptionGuard`` turns an asynchronous kill signal into a synchronous,
+step-boundary decision: the handler only sets a flag; the training loop asks
+``accelerator.check_preemption()`` once per step, which coordinates the flag
+across hosts (all processes must agree before anyone acts — a single host
+checkpointing alone while the others keep training corrupts a multi-host run)
+and triggers one final verified checkpoint before a clean exit.
+
+Nothing is installed unless :meth:`PreemptionGuard.install` runs — the
+zero-overhead-when-disabled contract: a process that never opts in keeps the
+default signal disposition and pays no per-step cost.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Callable, Optional, Sequence
+
+from ..logging import get_logger
+from ..telemetry import get_telemetry
+
+logger = get_logger(__name__)
+
+__all__ = ["PreemptionGuard"]
+
+
+class PreemptionGuard:
+    """Install SIGTERM/SIGINT handlers that request a graceful stop.
+
+    >>> guard = accelerator.enable_preemption_handling(save_dir="ckpts")
+    >>> for batch in dl:
+    ...     train_step(batch)
+    ...     if accelerator.check_preemption(step=global_step):
+    ...         break  # final verified checkpoint already written
+
+    The handler is async-signal-minimal: it records the signal, notes it in
+    telemetry, and invokes any registered raw callbacks (bench uses this to
+    share the guard with its emergency-JSON path).  A SECOND delivery of the
+    same signal restores the default disposition and re-raises it, so an
+    operator can still hard-kill a run stuck in its final checkpoint.
+    """
+
+    def __init__(
+        self,
+        signals: Sequence[int] = (signal.SIGTERM, signal.SIGINT),
+        coordinated: Optional[bool] = None,
+        coordinate_every: int = 10,
+    ):
+        self.signals = tuple(signals)
+        # Multi-host coordination defaults to on only when >1 process exists;
+        # resolved lazily so constructing a guard never touches the backend.
+        self._coordinated = coordinated
+        # Cross-host agreement costs a collective; amortize it over every Nth
+        # should_stop() call.  MUST be call-count based, not wall-clock: every
+        # process has to enter the gather on the same step or the collective
+        # deadlocks.
+        self.coordinate_every = max(1, int(coordinate_every))
+        self._should_stop_calls = 0
+        self._agreed = False
+        self._installed = False
+        self._prev_handlers: dict[int, object] = {}
+        self._flag = False
+        self._signum: Optional[int] = None
+        self._callbacks: list[Callable[[int], None]] = []
+        self._lock = threading.Lock()
+        self._signal_noted = False
+        self.final_checkpoint_saved = False
+        self.save_dir: Optional[str] = None
+
+    # -- signal plumbing -----------------------------------------------------
+
+    def _handler(self, signum, frame):
+        if self._flag and self._signum == signum:
+            # Second delivery: get out of the way of a determined kill.
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+            return
+        # Async-signal-minimal: set flags ONLY.  Telemetry here would acquire
+        # non-reentrant locks (Telemetry._lock / MetricsRegistry._lock) that
+        # the interrupted main thread may already hold — a deadlock inside the
+        # handler at exactly the moment the guard exists for.  The signal is
+        # recorded into telemetry at the next should_stop() call instead.
+        self._flag = True
+        self._signum = signum
+        for cb in self._callbacks:
+            try:
+                cb(signum)
+            except Exception:
+                logger.exception("PreemptionGuard callback failed")
+
+    def _note_signal_in_telemetry(self) -> None:
+        """Deferred signal bookkeeping, run from the training thread (a safe,
+        non-handler context) the first time the flag is observed."""
+        if self._signal_noted or not self._flag:
+            return
+        self._signal_noted = True
+        tel = get_telemetry()
+        if tel.enabled:
+            tel.registry.counter("resilience.preempt_signals").inc()
+            tel.event("resilience.preempt_signal", signum=int(self._signum or 0))
+
+    def install(self) -> "PreemptionGuard":
+        """Install handlers (idempotent).  Must run on the main thread —
+        CPython only delivers signals there."""
+        if self._installed:
+            return self
+        for signum in self.signals:
+            self._prev_handlers[signum] = signal.signal(signum, self._handler)
+        self._installed = True
+        logger.info(
+            "PreemptionGuard installed for "
+            + ", ".join(signal.Signals(s).name for s in self.signals)
+        )
+        return self
+
+    def uninstall(self) -> None:
+        """Restore the previous handlers (idempotent)."""
+        if not self._installed:
+            return
+        for signum, prev in self._prev_handlers.items():
+            try:
+                signal.signal(signum, prev)
+            except (ValueError, TypeError, OSError):
+                pass
+        self._prev_handlers.clear()
+        self._installed = False
+
+    def __enter__(self) -> "PreemptionGuard":
+        return self.install()
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.uninstall()
+        return False
+
+    def add_callback(self, fn: Callable[[int], None]) -> None:
+        """Register ``fn(signum)`` to run inside the signal handler.  Keep it
+        async-signal-minimal (set flags, write a line, ``os._exit``)."""
+        self._callbacks.append(fn)
+
+    # -- queries -------------------------------------------------------------
+
+    @property
+    def installed(self) -> bool:
+        return self._installed
+
+    def preempted_locally(self) -> bool:
+        """THIS process received a signal (uncoordinated view)."""
+        return self._flag
+
+    def _coordination_on(self) -> bool:
+        if self._coordinated is not None:
+            return self._coordinated
+        try:
+            import jax
+
+            return jax.process_count() > 1
+        except Exception:
+            return False
+
+    def should_stop(self) -> bool:
+        """Whether the fleet agreed to stop: the local flag all-reduced (max)
+        across processes, so EVERY process returns the same answer on the same
+        step and the final checkpoint is written by everyone together.  On a
+        single process this is just the local flag.
+
+        The cross-host gather only runs on every ``coordinate_every``-th call
+        (call-count gated, so all processes enter the collective in lockstep)
+        — a per-step collective on every step of a multi-host run is real
+        overhead, and preemption grace periods tolerate a few steps of
+        detection latency."""
+        self._note_signal_in_telemetry()
+        if not self._coordination_on():
+            return self._flag
+        if self._agreed:
+            return True
+        self._should_stop_calls += 1
+        if (self._should_stop_calls - 1) % self.coordinate_every != 0:
+            return False
+        from ..utils.operations import gather_object
+
+        try:
+            flags = gather_object([bool(self._flag)])
+        except Exception:
+            # Coordination path itself failing (a host already died) must not
+            # mask the local signal.
+            logger.exception("preemption flag all-reduce failed; using local flag")
+            return self._flag
+        self._agreed = any(flags)
+        return self._agreed
+
+    def reset(self) -> None:
+        """Clear the flag (tests / multi-preemption loops)."""
+        self._flag = False
+        self._signum = None
+        self._agreed = False
+        self._should_stop_calls = 0
+        self._signal_noted = False
+        self.final_checkpoint_saved = False

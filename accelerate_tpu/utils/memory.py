@@ -61,10 +61,12 @@ def find_executable_batch_size(
     if function is None:
         return functools.partial(find_executable_batch_size, starting_batch_size=starting_batch_size)
 
-    batch_size = starting_batch_size
-
     def decorator(*args, **kwargs):
-        nonlocal batch_size
+        # Reset PER OUTER CALL: the reference kept the halved size in a
+        # closure, so a second invocation of the decorated function started
+        # from the previous run's shrunken size instead of
+        # ``starting_batch_size``.
+        batch_size = starting_batch_size
         clear_device_cache(garbage_collection=True)
         params = list(inspect.signature(function).parameters.keys())
         if len(params) < (len(args) + 1):
@@ -74,6 +76,10 @@ def find_executable_batch_size(
                 f"when called. Remove this as the decorator already does so: "
                 f"`{function.__name__}({arg_str})`"
             )
+        from ..logging import get_logger
+        from ..telemetry import get_telemetry
+
+        logger = get_logger(__name__)
         while True:
             if batch_size == 0:
                 raise RuntimeError("No executable batch size found, reached zero.")
@@ -82,7 +88,23 @@ def find_executable_batch_size(
             except Exception as e:
                 if should_reduce_batch_size(e):
                     clear_device_cache(garbage_collection=True)
-                    batch_size //= 2
+                    new_size = batch_size // 2
+                    # OOM retries must be VISIBLE: a silently halved batch
+                    # size changes throughput and optimization dynamics.
+                    logger.warning(
+                        f"OOM at batch_size={batch_size} in `{function.__name__}`; "
+                        f"retrying with batch_size={new_size}"
+                    )
+                    tel = get_telemetry()
+                    if tel.enabled:
+                        tel.registry.counter("memory.oom_halvings").inc()
+                        tel.event(
+                            "memory.oom_halving",
+                            function=function.__name__,
+                            batch_size=batch_size,
+                            new_batch_size=new_size,
+                        )
+                    batch_size = new_size
                 else:
                     raise
 

@@ -133,6 +133,16 @@ def read_bytes(path: str, nbytes: int, offset: int = 0) -> np.ndarray:
     return out
 
 
+def _consume_future_exception(fut) -> None:
+    """Retrieve (and drop) a future's exception so a reader that failed after
+    ``close()`` doesn't emit 'exception was never retrieved' noise or kill the
+    worker thread's teardown."""
+    try:
+        fut.exception()
+    except BaseException:  # CancelledError is a BaseException on 3.8+
+        pass
+
+
 class PrefetchPool:
     """Background file prefetcher.
 
@@ -209,14 +219,40 @@ class PrefetchPool:
             return sum(1 for f in self._futures.values() if not f.done())
 
     def close(self) -> None:
-        if self._lib is not None:
+        """Idempotent shutdown.  In-flight reader exceptions are swallowed
+        HERE only — a failed prefetch still surfaces on ``fetch()`` (the
+        future's exception re-raises there); at close time nobody is left to
+        consume it and an unretrieved-exception warning at interpreter exit
+        helps no one."""
+        if getattr(self, "_lib", None) is not None:
             if getattr(self, "_pool", None):
                 self._lib.ts_pool_destroy(self._pool)
                 self._pool = None
-        else:
-            self._executor.shutdown(wait=False)
+            return
+        executor = getattr(self, "_executor", None)
+        if executor is None:
+            return
+        self._executor = None
+        with self._flock:
+            futures = list(self._futures.values())
+            self._futures.clear()
+        for fut in futures:
+            fut.cancel()
+            # Mark any in-flight failure as retrieved (done_callback runs
+            # immediately when already done, later otherwise).
+            fut.add_done_callback(_consume_future_exception)
+        try:
+            executor.shutdown(wait=False, cancel_futures=True)
+        except TypeError:  # cancel_futures needs Python >= 3.9
+            executor.shutdown(wait=False)
+        except RuntimeError:
+            # Interpreter teardown: new-thread creation is forbidden and the
+            # executor may already be dead — nothing left to release.
+            pass
 
     def __del__(self):
+        # Must never raise at interpreter exit: modules (even builtins) may
+        # already be torn down under us.
         try:
             self.close()
         except Exception:

@@ -354,6 +354,85 @@ def _emit_error_json(error: str, detail: dict = None):
     print(json.dumps(rec), flush=True)
 
 
+def _checkpoint_probe() -> dict:
+    """Measure verified-checkpoint save/verify/restore latency on a ~4M-param
+    model (host-side I/O: safetensors write + manifest hash + fsync + atomic
+    rename, manifest verification, full restore).  Runs on CPU — checkpoint
+    I/O never touches the accelerator, and the probe must not race the tunnel."""
+    import shutil
+    import tempfile
+
+    import torch
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.resilience import verify_checkpoint
+
+    model = torch.nn.Sequential(*[torch.nn.Linear(1024, 1024) for _ in range(4)])
+    n_params = sum(p.numel() for p in model.parameters())
+    acc = Accelerator()
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    model, opt = acc.prepare(model, opt)
+
+    tmp = tempfile.mkdtemp(prefix="atpu_bench_ckpt_")
+    try:
+        path = os.path.join(tmp, "ckpt")
+        t0 = time.perf_counter()
+        saved = acc.save_state(path, step=1)
+        save_ms = (time.perf_counter() - t0) * 1e3
+        t0 = time.perf_counter()
+        verify_checkpoint(saved)
+        verify_ms = (time.perf_counter() - t0) * 1e3
+        t0 = time.perf_counter()
+        acc.load_state(saved)
+        load_ms = (time.perf_counter() - t0) * 1e3
+        nbytes = sum(
+            os.path.getsize(os.path.join(dp, f))
+            for dp, _, fs in os.walk(saved)
+            for f in fs
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "checkpoint": {
+            "params": n_params,
+            "bytes": nbytes,
+            "save_ms": round(save_ms, 2),
+            "verify_ms": round(verify_ms, 2),
+            "restore_ms": round(load_ms, 2),
+        }
+    }
+
+
+def _run_checkpoint_probe_subprocess(timeout_s: float = 180.0):
+    """Checkpoint-latency probe in a bounded CPU subprocess (same contract as
+    the rung children: last JSON line on stdout is the result, silence is
+    failure)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--checkpoint-probe"],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"checkpoint probe timeout after {timeout_s:.0f}s"
+    if proc.returncode != 0:
+        return None, (proc.stderr or "")[-200:].replace("\n", " ")
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except ValueError:
+                continue
+    return None, "no parseable checkpoint-probe line"
+
+
 def _honor_cpu_env():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from accelerate_tpu.state import honor_cpu_platform_env
@@ -421,6 +500,9 @@ def main():
         )
         print(detail)
         sys.exit(0 if ok else 1)
+    if "--checkpoint-probe" in sys.argv:
+        print(json.dumps(_checkpoint_probe()))
+        return
     if "--rung" in sys.argv or "--proof-rung" in sys.argv or "--frontier-rung" in sys.argv:
         if "--rung" in sys.argv:
             rung = LADDER[int(sys.argv[sys.argv.index("--rung") + 1])]
@@ -484,10 +566,14 @@ def main():
         _watchdog.start()
     import signal
 
-    signal.signal(
-        signal.SIGTERM,
-        lambda signum, frame: _emergency_exit("SIGTERM received (driver budget?)"),
-    )
+    # The driver's cooperative kill routes through the library's
+    # PreemptionGuard (one signal code path for bench AND training loops);
+    # the callback still emits the emergency JSON line before exiting.
+    from accelerate_tpu.resilience import PreemptionGuard
+
+    _guard = PreemptionGuard(signals=(signal.SIGTERM,), coordinated=False)
+    _guard.add_callback(lambda signum: _emergency_exit("SIGTERM received (driver budget?)"))
+    _guard.install()
 
     # Fast-fail (then retry, bounded) when the device backend is unreachable
     # (e.g. wedged TPU tunnel).  Probes MUST be subprocesses: backend init
@@ -660,6 +746,14 @@ def main():
         if fres is None and _device_trouble(err):
             break  # tunnel gone; headline is safe, stop burning rung slots
 
+    # Checkpoint save/restore latency (resilience subsystem): CPU subprocess,
+    # cheap, never zeroes the headline — a failure is recorded as a status.
+    ckpt_block = None
+    if os.environ.get("BENCH_CHECKPOINT_PROBE", "1") != "0":
+        ckpt_probe, ckpt_err = _run_checkpoint_probe_subprocess()
+        ckpt_block = ckpt_probe["checkpoint"] if ckpt_probe else {"status": ckpt_err}
+        print(f"# checkpoint probe: {ckpt_block}", file=sys.stderr, flush=True)
+
     detail = {
         "config": result["config"],
         "rung": rung_cfg,
@@ -675,6 +769,8 @@ def main():
         detail["introspect"] = result["introspect"]
     if frontier:
         detail["frontier"] = frontier
+    if ckpt_block is not None:
+        detail["checkpoint"] = ckpt_block
     if proof is not None:
         detail["hbm_bound_proof"] = {
             "config": proof_cfg,
@@ -704,7 +800,8 @@ if __name__ == "__main__":
     # parent scans their stdout for the last JSON line and would mistake it
     # for a measurement; their silence IS the failure signal.
     _is_child = any(
-        flag in sys.argv for flag in ("--rung", "--proof-rung", "--frontier-rung", "--probe")
+        flag in sys.argv
+        for flag in ("--rung", "--proof-rung", "--frontier-rung", "--probe", "--checkpoint-probe")
     )
     try:
         main()

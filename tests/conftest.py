@@ -10,6 +10,10 @@ import os
 
 # Must be set before the CPU backend client is created.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Checkpoint tests assert write ORDERING (manifest-last atomic publish), not
+# power-loss durability; per-file fsync on the CI filesystem costs real
+# wall-clock across the suite's many save_state calls.
+os.environ.setdefault("ACCELERATE_TPU_CHECKPOINT_FSYNC", "0")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,707 @@
+"""Resilience subsystem: atomic verified checkpoints, retry policy, preemption
+guard, auto-resume, fault injection (``accelerate_tpu/resilience/``)."""
+
+import os
+import pickle
+import signal
+
+import numpy as np
+import pytest
+import torch
+from torch.utils.data import DataLoader
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.resilience import (
+    CheckpointVerificationError,
+    PreemptionGuard,
+    RetryPolicy,
+    faultinject,
+    find_latest_complete,
+    is_complete,
+    prune_checkpoints,
+    read_manifest,
+    retrying,
+    verify_checkpoint,
+    write_manifest,
+)
+from accelerate_tpu.test_utils.training import (
+    RegressionDataset,
+    RegressionModel,
+    regression_collate,
+)
+from accelerate_tpu.utils import ProjectConfiguration
+
+
+@pytest.fixture(autouse=True)
+def _fast_io_retries(monkeypatch):
+    """Keep the retry backoff test-speed and the fault injector disarmed, and
+    leave the process-global telemetry singleton pristine (disable() alone
+    keeps the registry's counters — test_telemetry asserts an empty one)."""
+    monkeypatch.setenv("ACCELERATE_TPU_IO_RETRY_BASE_S", "0.01")
+    faultinject.reload()
+    yield
+    faultinject.reload()
+    from accelerate_tpu import telemetry
+
+    telemetry.disable()
+    telemetry.get_telemetry().registry.reset()
+
+
+def _make_accelerator(tmp_path, **proj_kwargs):
+    acc = Accelerator(
+        project_config=ProjectConfiguration(project_dir=str(tmp_path), **proj_kwargs)
+    )
+    model = RegressionModel()
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    dl = DataLoader(list(RegressionDataset(length=16)), batch_size=8, collate_fn=regression_collate)
+    model, opt, dl = acc.prepare(model, opt, dl)
+    return acc, model, opt, dl
+
+
+# -- manifest / atomic save ---------------------------------------------------
+
+
+def test_verified_save_writes_manifest_and_verifies(tmp_path):
+    acc, *_ = _make_accelerator(tmp_path)
+    path = acc.save_state(str(tmp_path / "ckpt"), step=7)
+    manifest = read_manifest(path)
+    assert manifest is not None and manifest["step"] == 7
+    assert manifest["world_size"] == 1 and manifest["hashed"]
+    assert "model.safetensors" in manifest["files"]
+    assert manifest["files"]["model.safetensors"]["sha256"]
+    # No staging leftovers after a successful publish.
+    assert not os.path.exists(str(tmp_path / "ckpt.tmp"))
+    verify_checkpoint(path)  # must not raise
+
+
+def test_unverified_save_opt_out_writes_no_manifest(tmp_path):
+    acc, *_ = _make_accelerator(tmp_path)
+    path = acc.save_state(str(tmp_path / "ckpt"), verified=False)
+    assert read_manifest(path) is None
+    acc.load_state(path)  # legacy (manifest-less) checkpoints still load
+
+
+def test_manifest_rejects_truncated_safetensors(tmp_path):
+    """Acceptance: a truncated model.safetensors fails verification and load."""
+    acc, *_ = _make_accelerator(tmp_path)
+    path = acc.save_state(str(tmp_path / "ckpt"), step=1)
+    weights = os.path.join(path, "model.safetensors")
+    with open(weights, "rb") as f:
+        blob = f.read()
+    with open(weights, "wb") as f:
+        f.write(blob[: len(blob) // 2])
+    with pytest.raises(CheckpointVerificationError, match="size"):
+        verify_checkpoint(path)
+    with pytest.raises(CheckpointVerificationError):
+        acc.load_state(path)
+    # Same-size corruption is caught by the hash.
+    with open(weights, "wb") as f:
+        f.write(blob[:-4] + b"\x00\x00\x00\x01")
+    with pytest.raises(CheckpointVerificationError, match="sha256"):
+        verify_checkpoint(path)
+
+
+def test_manifest_hashing_env_opt_out(tmp_path, monkeypatch):
+    monkeypatch.setenv("ACCELERATE_TPU_MANIFEST_HASH", "0")
+    acc, *_ = _make_accelerator(tmp_path)
+    path = acc.save_state(str(tmp_path / "ckpt"), step=1)
+    manifest = read_manifest(path)
+    assert manifest["hashed"] is False
+    assert "sha256" not in manifest["files"]["model.safetensors"]
+    verify_checkpoint(path)  # size-only verification still runs
+
+
+def test_injected_failure_leaves_no_manifest_and_resume_skips_it(tmp_path, monkeypatch):
+    """Acceptance: a save killed by injected I/O failure publishes nothing;
+    resume_from_latest lands on the previous complete checkpoint."""
+    acc, model, *_ = _make_accelerator(tmp_path, automatic_checkpoint_naming=True)
+    acc.save_state(step=11)
+
+    monkeypatch.setenv("ACCELERATE_TPU_FAULT_WRITE_N", "1")
+    monkeypatch.setenv("ACCELERATE_TPU_FAULT_WRITE_STICKY", "1")
+    faultinject.reload()
+    with pytest.raises(OSError, match="injected"):
+        acc.save_state(step=12)
+    monkeypatch.delenv("ACCELERATE_TPU_FAULT_WRITE_N")
+    monkeypatch.delenv("ACCELERATE_TPU_FAULT_WRITE_STICKY")
+    faultinject.reload()
+
+    base = str(tmp_path / "checkpoints")
+    assert not os.path.isdir(os.path.join(base, "checkpoint_1"))  # never published
+    assert os.path.isdir(os.path.join(base, "checkpoint_1.tmp"))  # torn staging
+    assert not os.path.exists(os.path.join(base, "checkpoint_1.tmp", "manifest.json"))
+    assert find_latest_complete(base) == os.path.join(base, "checkpoint_0")
+    assert acc.resume_from_latest(base) == 11
+
+
+def test_transient_injected_failure_healed_by_retry(tmp_path, monkeypatch):
+    """A non-sticky (transient) injected failure is absorbed by retrying()."""
+    acc, *_ = _make_accelerator(tmp_path)
+    monkeypatch.setenv("ACCELERATE_TPU_FAULT_WRITE_N", "1")
+    faultinject.reload()
+    path = acc.save_state(str(tmp_path / "ckpt"), step=2)
+    verify_checkpoint(path)
+
+
+def test_rotation_never_deletes_only_complete_checkpoint(tmp_path):
+    base = tmp_path / "ckpts"
+    complete = base / "checkpoint_0"
+    complete.mkdir(parents=True)
+    (complete / "weights.bin").write_bytes(b"x" * 32)
+    write_manifest(str(complete), step=1)
+    for i in (1, 2):
+        torn = base / f"checkpoint_{i}"
+        torn.mkdir()
+        (torn / "weights.bin").write_bytes(b"y" * 32)
+
+    removed = prune_checkpoints(str(base), keep=1)
+    # The only complete checkpoint survived even though it is the oldest;
+    # the manifest-less (torn/legacy) dirs aged out instead.
+    assert sorted(os.path.basename(p) for p in removed) == ["checkpoint_1", "checkpoint_2"]
+    assert is_complete(str(complete))
+
+    # Even keep=0 refuses to delete the last complete checkpoint.
+    assert prune_checkpoints(str(base), keep=0) == []
+    assert is_complete(str(complete))
+
+
+def test_save_limit_rotation_end_state(tmp_path):
+    """total_limit still holds with verified saves (rotation now runs AFTER
+    the new checkpoint publishes, so the limit can never empty the dir)."""
+    acc, *_ = _make_accelerator(tmp_path, automatic_checkpoint_naming=True, total_limit=1)
+    for step in (1, 2, 3):
+        acc.save_state(step=step)
+    base = str(tmp_path / "checkpoints")
+    assert sorted(os.listdir(base)) == ["checkpoint_2"]
+    assert acc.resume_from_latest(base) == 3
+
+
+def test_latest_prefers_newest_index_over_stale_stepped(tmp_path):
+    """A stale preemption checkpoint carrying step=N must not outrank newer
+    plain saves whose manifests have step=None: ordering is by save
+    iteration, never by recorded step."""
+    base = tmp_path / "ckpts"
+    for name, step in (("checkpoint_3", 100), ("checkpoint_6", None)):
+        d = base / name
+        d.mkdir(parents=True)
+        (d / "weights.bin").write_bytes(b"w" * 16)
+        write_manifest(str(d), step=step)
+    assert find_latest_complete(str(base)) == str(base / "checkpoint_6")
+    # ...and rotation protects the newest complete one, not the stale stepped one.
+    assert prune_checkpoints(str(base), keep=1) == [str(base / "checkpoint_3")]
+
+
+def test_prune_ignores_non_checkpoint_dirs(tmp_path):
+    """Rotation must never touch directories it does not own (logs/, user
+    artifacts) even when they sit under the checkpoints root."""
+    base = tmp_path / "ckpts"
+    logs = base / "logs"
+    logs.mkdir(parents=True)
+    (logs / "events.txt").write_text("precious")
+    for i in (0, 1):
+        d = base / f"checkpoint_{i}"
+        d.mkdir()
+        (d / "w.bin").write_bytes(b"x")
+        write_manifest(str(d), step=i)
+    removed = prune_checkpoints(str(base), keep=1)
+    assert removed == [str(base / "checkpoint_0")]
+    assert (logs / "events.txt").read_text() == "precious"
+
+
+def test_overwrite_same_path_swaps_safely(tmp_path):
+    """Re-saving onto an existing checkpoint path publishes the new state and
+    leaves no .tmp/.old residue (the old tree is displaced, not rmtree'd,
+    before the new one lands)."""
+    acc, *_ = _make_accelerator(tmp_path)
+    path = str(tmp_path / "ckpt")
+    acc.save_state(path, step=1)
+    acc.save_state(path, step=2)
+    assert read_manifest(path)["step"] == 2
+    verify_checkpoint(path)
+    assert not os.path.exists(path + ".tmp")
+    assert not os.path.exists(path + ".old")
+
+
+def test_manifest_ignores_stale_manifest_tmp(tmp_path):
+    """A leftover manifest.json.tmp from a failed earlier manifest write must
+    not be covered by a retried write_manifest — os.replace consumes that very
+    file, which would publish a manifest listing a file that no longer exists
+    (permanently failing verification on the newest checkpoint)."""
+    d = tmp_path / "ckpt"
+    d.mkdir()
+    (d / "weights.bin").write_bytes(b"w" * 8)
+    (d / "manifest.json.tmp").write_text("{torn")
+    manifest = write_manifest(str(d), step=1)
+    assert "manifest.json.tmp" not in manifest["files"]
+    assert list(manifest["files"]) == ["weights.bin"]
+    verify_checkpoint(str(d))  # must not complain about the consumed tmp
+
+
+def test_latest_prefers_newer_preempt_dir_over_indexed_saves(tmp_path):
+    """The docs pattern: periodic step_<N> saves plus a 'preempt' final
+    checkpoint written LAST.  Ordering is mtime-first, so the newest
+    (preemption) checkpoint wins even though its name carries no index."""
+    base = tmp_path / "ckpts"
+    now = os.path.getmtime(str(tmp_path))
+    for i, name in enumerate(("step_1000", "step_2000", "preempt")):
+        d = base / name
+        d.mkdir(parents=True)
+        (d / "w.bin").write_bytes(b"x" * 8)
+        write_manifest(str(d), step=1000 * (i + 1))
+        os.utime(d, (now + i * 10, now + i * 10))  # force distinct mtimes
+    assert find_latest_complete(str(base)) == str(base / "preempt")
+
+
+def test_publish_recovers_displaced_checkpoint(tmp_path):
+    """A crash between the two publish renames leaves only `<dir>.old`; the
+    next save must treat that as the last good checkpoint (restore it before
+    displacing again), never as garbage."""
+    acc, *_ = _make_accelerator(tmp_path)
+    path = str(tmp_path / "ckpt")
+    acc.save_state(path, step=1)
+    os.rename(path, path + ".old")  # simulate crash mid-swap of save #2
+    acc.save_state(path, step=2)
+    assert read_manifest(path)["step"] == 2
+    assert not os.path.exists(path + ".old")
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_rotation_sweeps_stale_staging(tmp_path):
+    """checkpoint_*.tmp leftovers from crashed/failed saves of other
+    iterations are reclaimed by rotation (they can hold a full checkpoint's
+    worth of disk and no other path ever deletes them)."""
+    acc, *_ = _make_accelerator(tmp_path, automatic_checkpoint_naming=True, total_limit=2)
+    stale = tmp_path / "checkpoints" / "checkpoint_99.tmp"
+    stale.mkdir(parents=True)
+    (stale / "model.safetensors").write_bytes(b"x" * 64)
+    acc.save_state(step=1)
+    assert not stale.exists()
+
+
+def test_enable_preemption_handling_requires_target(tmp_path):
+    """No save_dir and no automatic naming must fail at INSTALL time, not at
+    signal delivery (where it would kill the run instead of checkpointing)."""
+    acc, *_ = _make_accelerator(tmp_path)  # automatic_checkpoint_naming=False
+    with pytest.raises(ValueError, match="checkpoint target"):
+        acc.enable_preemption_handling()
+    assert acc._preemption_guard is None  # nothing half-installed
+    guard = acc.enable_preemption_handling(save_dir=str(tmp_path / "p"))
+    try:
+        # Documented idempotency: a second enable without save_dir keeps the
+        # configured guard instead of re-tripping the validation.
+        assert acc.enable_preemption_handling() is guard
+        assert guard.save_dir == str(tmp_path / "p")
+    finally:
+        guard.uninstall()
+
+
+def test_load_state_auto_naming_skips_torn_partial(tmp_path):
+    acc, model, *_ = _make_accelerator(tmp_path, automatic_checkpoint_naming=True)
+    acc.save_state(step=5)
+    # Fake a torn checkpoint_1: files but no manifest (crash before publish
+    # completed on a filesystem without atomic rename).
+    torn = tmp_path / "checkpoints" / "checkpoint_1"
+    torn.mkdir()
+    (torn / "model.safetensors").write_bytes(b"garbage")
+    acc.load_state()  # auto naming must pick checkpoint_0, not the torn dir
+    assert acc.resume_from_latest(str(tmp_path / "checkpoints")) == 5
+
+
+# -- retry policy -------------------------------------------------------------
+
+
+def test_retrying_retries_transient_then_succeeds():
+    calls = {"n": 0}
+
+    @retrying(tries=5, base_delay_s=0.001, label="test")
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("flaky disk")
+        return "ok"
+
+    assert flaky() == "ok"
+    assert calls["n"] == 3
+
+
+def test_retrying_nonretryable_raises_immediately():
+    calls = {"n": 0}
+
+    @retrying(tries=5, base_delay_s=0.001)
+    def broken():
+        calls["n"] += 1
+        raise KeyError("bug")
+
+    with pytest.raises(KeyError):
+        broken()
+    assert calls["n"] == 1
+
+
+def test_retrying_oom_is_not_transient():
+    calls = {"n": 0}
+
+    @retrying(tries=5, base_delay_s=0.001)
+    def oom():
+        calls["n"] += 1
+        raise RuntimeError("RESOURCE_EXHAUSTED: out of memory allocating 1GB")
+
+    with pytest.raises(RuntimeError):
+        oom()
+    assert calls["n"] == 1
+
+
+def test_retrying_exhausts_and_counts(monkeypatch):
+    from accelerate_tpu import telemetry
+
+    tel = telemetry.enable(dir=os.path.join("/tmp", f"atpu_retry_tel_{os.getpid()}"))
+    try:
+        before_retries = tel.registry.counter("resilience.retries").value
+        before_gave_up = tel.registry.counter("resilience.gave_up").value
+        policy = RetryPolicy(tries=3, base_delay_s=0.001, label="test")
+        with pytest.raises(OSError):
+            policy.call(lambda: (_ for _ in ()).throw(OSError("dead disk")))
+        assert tel.registry.counter("resilience.retries").value - before_retries == 2
+        assert tel.registry.counter("resilience.gave_up").value - before_gave_up == 1
+    finally:
+        telemetry.disable()
+
+
+def test_retrying_deadline_cuts_off():
+    policy = RetryPolicy(tries=50, base_delay_s=0.2, max_delay_s=0.2, deadline_s=0.05)
+    calls = {"n": 0}
+
+    def always_fails():
+        calls["n"] += 1
+        raise OSError("slow disk")
+
+    with pytest.raises(OSError):
+        policy.call(always_fails)
+    assert calls["n"] < 5  # deadline stopped it long before 50 tries
+
+
+# -- preemption guard ---------------------------------------------------------
+
+
+def test_no_handlers_installed_by_default(tmp_path):
+    before = signal.getsignal(signal.SIGTERM)
+    acc, *_ = _make_accelerator(tmp_path)
+    assert signal.getsignal(signal.SIGTERM) is before  # zero-overhead contract
+    assert acc.check_preemption() is False
+
+
+def test_preemption_guard_install_uninstall_restores():
+    before_term = signal.getsignal(signal.SIGTERM)
+    before_int = signal.getsignal(signal.SIGINT)
+    guard = PreemptionGuard(coordinated=False)
+    guard.install()
+    assert signal.getsignal(signal.SIGTERM) is not before_term
+    guard.uninstall()
+    assert signal.getsignal(signal.SIGTERM) is before_term
+    assert signal.getsignal(signal.SIGINT) is before_int
+
+
+def test_preemption_signal_sets_flag_and_checkpoint_written_once(tmp_path):
+    acc, model, opt, dl = _make_accelerator(tmp_path)
+    guard = acc.enable_preemption_handling(save_dir=str(tmp_path / "preempt"))
+    try:
+        assert acc.check_preemption(step=1) is False
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert guard.preempted_locally()
+        assert acc.check_preemption(step=3) is True
+        ckpt = str(tmp_path / "preempt")
+        assert read_manifest(ckpt)["step"] == 3
+        verify_checkpoint(ckpt)
+        # Subsequent calls keep returning True without re-saving.
+        mtime = os.path.getmtime(os.path.join(ckpt, "manifest.json"))
+        assert acc.check_preemption(step=4) is True
+        assert os.path.getmtime(os.path.join(ckpt, "manifest.json")) == mtime
+        assert acc.resume_from_latest(ckpt) == 3
+    finally:
+        guard.uninstall()
+
+
+def test_fault_sigterm_tick_fires_through_guard(tmp_path, monkeypatch):
+    monkeypatch.setenv("ACCELERATE_TPU_FAULT_SIGTERM_STEP", "2")
+    faultinject.reload()
+    acc, *_ = _make_accelerator(tmp_path)
+    guard = acc.enable_preemption_handling(save_dir=str(tmp_path / "preempt"))
+    try:
+        assert acc.check_preemption(step=1) is False
+        assert acc.check_preemption(step=2) is True  # tick delivered SIGTERM
+        assert is_complete(str(tmp_path / "preempt"))
+    finally:
+        guard.uninstall()
+
+
+# -- auto-resume --------------------------------------------------------------
+
+
+def test_resume_from_latest_empty_dir_returns_none(tmp_path):
+    acc, *_ = _make_accelerator(tmp_path)
+    assert acc.resume_from_latest(str(tmp_path / "nothing")) is None
+
+
+def test_resume_restores_weights_and_rng_determinism(tmp_path):
+    """Resumed-RNG determinism: the random streams after load_state replay the
+    post-save streams exactly."""
+    acc, model, opt, dl = _make_accelerator(tmp_path)
+    saved_weights = {k: np.asarray(v).copy() for k, v in model.state_dict().items()}
+    path = acc.save_state(str(tmp_path / "ckpt"), step=1)
+    post_save_torch = torch.rand(4)
+    post_save_np = np.random.rand(4)
+
+    # Scramble everything the checkpoint should restore.
+    torch.manual_seed(999)
+    np.random.seed(999)
+    model.load_state_dict({k: np.zeros_like(v) for k, v in saved_weights.items()})
+
+    assert acc.resume_from_latest(str(tmp_path / "ckpt")) == 1
+    for k, v in model.state_dict().items():
+        np.testing.assert_array_equal(np.asarray(v), saved_weights[k])
+    torch.testing.assert_close(torch.rand(4), post_save_torch)
+    np.testing.assert_array_equal(np.random.rand(4), post_save_np)
+
+
+def test_resume_then_step_on_multidevice_mesh(tmp_path):
+    """Regression: a resumed optimizer must keep STEPPING on a multi-device
+    mesh.  load_state_dict used to device_put-commit optax's scalar ``count``
+    to device 0, and the first post-resume update then failed jit placement
+    against the mesh-replicated params ('Received incompatible devices')."""
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    def _build():
+        acc = Accelerator()
+        model = RegressionModel()
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        dl = DataLoader(
+            list(RegressionDataset(length=16)), batch_size=8, collate_fn=regression_collate
+        )
+        return acc, *acc.prepare(model, opt, dl)
+
+    def _steps(acc, model, opt, dl, n):
+        losses = []
+        it = iter(dl)
+        for _ in range(n):
+            try:
+                batch = next(it)
+            except StopIteration:
+                it = iter(dl)
+                batch = next(it)
+            loss = torch.nn.functional.mse_loss(model(batch["x"]), batch["y"])
+            acc.backward(loss)
+            opt.step()
+            opt.zero_grad()
+            losses.append(float(np.asarray(loss.detach())))
+        return losses
+
+    acc, model, opt, dl = _build()
+    _steps(acc, model, opt, dl, 2)
+    acc.save_state(str(tmp_path / "ckpt"), step=2)
+    expected = _steps(acc, model, opt, dl, 2)  # the unkilled continuation
+
+    # Fresh-process simulation: reset singletons, rebuild everything, resume.
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    torch.manual_seed(0)
+    acc2, model2, opt2, dl2 = _build()
+    assert acc2.resume_from_latest(str(tmp_path / "ckpt")) == 2
+    resumed = _steps(acc2, model2, opt2, dl2, 2)  # must not raise, must match
+    np.testing.assert_allclose(resumed, expected, rtol=0, atol=0)
+
+
+def test_resume_sets_iteration_past_loaded_checkpoint(tmp_path):
+    acc, *_ = _make_accelerator(tmp_path, automatic_checkpoint_naming=True)
+    acc.save_state(step=1)
+    acc.save_state(step=2)
+    base = str(tmp_path / "checkpoints")
+    acc.project_configuration.iteration = 0  # fresh-process default
+    assert acc.resume_from_latest(base) == 2
+    # The next automatic save must not overwrite the checkpoint just resumed.
+    path = acc.save_state(step=3)
+    assert os.path.basename(path) == "checkpoint_2"
+    assert is_complete(os.path.join(base, "checkpoint_1"))
+
+
+# -- async-save finalize surface ----------------------------------------------
+
+
+def test_wait_for_checkpoint_reraises_async_failure(tmp_path):
+    acc, *_ = _make_accelerator(tmp_path)
+
+    class _DeadCheckpointer:
+        def wait_until_finished(self):
+            raise ValueError("orbax commit failed: replica 3 wrote 0 bytes")
+
+    acc._async_checkpointers = [_DeadCheckpointer()]
+    with pytest.raises(RuntimeError, match="NOT published"):
+        acc.wait_for_checkpoint()
+    assert acc._async_checkpointers == []
+
+    # The next save path surfaces it the same way.
+    acc._async_checkpointers = [_DeadCheckpointer()]
+    with pytest.raises(RuntimeError, match="async .*checkpoint save failed"):
+        acc.save_state(str(tmp_path / "ckpt2"))
+
+
+def test_async_sharded_save_state_publishes_on_wait(tmp_path):
+    """A verified async (orbax) save defers the manifest + atomic rename to
+    wait_for_checkpoint(): nothing is published while shards may still be
+    streaming, and afterwards the checkpoint is manifest-complete."""
+    import jax
+
+    from accelerate_tpu import ParallelismConfig
+    from accelerate_tpu.utils import FullyShardedDataParallelPlugin
+
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(fsdp=8),
+        fsdp_plugin=FullyShardedDataParallelPlugin(state_dict_type="SHARDED_STATE_DICT"),
+    )
+    model = acc.prepare(RegressionModel(a=1.5, b=-0.5))
+    a_val = float(np.asarray(model.params["a"]))
+
+    path = acc.save_state(str(tmp_path / "ck"), async_save=True, step=9)
+    assert not os.path.isdir(path)  # not published yet
+    acc.wait_for_checkpoint()
+    assert read_manifest(path)["step"] == 9
+    verify_checkpoint(path)
+
+    model._set_params(jax.tree_util.tree_map(lambda x: x * 0.0, model.params))
+    assert acc.resume_from_latest(path) == 9
+    assert float(np.asarray(model.params["a"])) == pytest.approx(a_val)
+
+
+def test_end_training_publishes_pending_async_save(tmp_path):
+    """A script that ends with save_state(async_save=True) + end_training()
+    must still get its final checkpoint published (the deferred manifest +
+    rename runs in end_training, not only in wait_for_checkpoint)."""
+    import jax
+
+    from accelerate_tpu import ParallelismConfig
+    from accelerate_tpu.utils import FullyShardedDataParallelPlugin
+
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(fsdp=8),
+        fsdp_plugin=FullyShardedDataParallelPlugin(state_dict_type="SHARDED_STATE_DICT"),
+    )
+    acc.prepare(RegressionModel(a=2.0, b=1.0))
+    path = acc.save_state(str(tmp_path / "final"), async_save=True, step=4)
+    acc.end_training()
+    assert read_manifest(path)["step"] == 4
+    verify_checkpoint(path)
+
+
+def test_io_retries_zero_env_disables_instead_of_crashing(tmp_path, monkeypatch):
+    monkeypatch.setenv("ACCELERATE_TPU_IO_RETRIES", "0")
+    acc, *_ = _make_accelerator(tmp_path)
+    path = acc.save_state(str(tmp_path / "ckpt"), step=1)  # must not raise
+    verify_checkpoint(path)
+    # ...and an injected failure now fails on the FIRST attempt (no retries).
+    monkeypatch.setenv("ACCELERATE_TPU_FAULT_WRITE_N", "1")
+    faultinject.reload()
+    with pytest.raises(OSError, match="injected"):
+        acc.save_state(str(tmp_path / "ckpt2"), step=2)
+
+
+# -- fault injection: OOM + find_executable_batch_size ------------------------
+
+
+def test_find_executable_batch_size_resets_per_outer_call(monkeypatch):
+    from accelerate_tpu.utils.memory import find_executable_batch_size
+
+    sizes = []
+
+    @find_executable_batch_size(starting_batch_size=64)
+    def run(batch_size):
+        sizes.append(batch_size)
+        if batch_size > 16:
+            raise RuntimeError("RESOURCE_EXHAUSTED: fake OOM")
+        return batch_size
+
+    assert run() == 16
+    assert sizes == [64, 32, 16]
+    # Second outer call must start from starting_batch_size again, not 16.
+    sizes.clear()
+    assert run() == 16
+    assert sizes == [64, 32, 16]
+
+
+def test_find_executable_batch_size_with_injected_oom(monkeypatch):
+    from accelerate_tpu.utils.memory import find_executable_batch_size
+
+    monkeypatch.setenv("ACCELERATE_TPU_FAULT_OOM_ONCE", "1")
+    faultinject.reload()
+    sizes = []
+
+    @find_executable_batch_size(starting_batch_size=8)
+    def run(batch_size):
+        sizes.append(batch_size)
+        faultinject.maybe_oom()
+        return batch_size
+
+    assert run() == 4  # one injected OOM, one halving
+    assert sizes == [8, 4]
+
+
+def test_find_executable_batch_size_halving_counted(monkeypatch, tmp_path):
+    from accelerate_tpu import telemetry
+    from accelerate_tpu.utils.memory import find_executable_batch_size
+
+    tel = telemetry.enable(dir=str(tmp_path / "tel"))
+    try:
+        before = tel.registry.counter("memory.oom_halvings").value
+
+        @find_executable_batch_size(starting_batch_size=32)
+        def run(batch_size):
+            if batch_size > 8:
+                raise RuntimeError("RESOURCE_EXHAUSTED: fake OOM")
+            return batch_size
+
+        assert run() == 8
+        assert tel.registry.counter("memory.oom_halvings").value - before == 2
+    finally:
+        telemetry.disable()
+
+
+# -- PrefetchPool shutdown hardening ------------------------------------------
+
+
+def test_prefetch_pool_failed_prefetch_surfaces_on_fetch(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_TPU_DISABLE_NATIVE", "1")
+    from accelerate_tpu.utils import native_io
+
+    monkeypatch.setattr(native_io, "_lib", None)
+    monkeypatch.setattr(native_io, "_build_failed", True)
+    pool = native_io.PrefetchPool(num_threads=1)
+    pool.prefetch("/nonexistent/path/weights.bin")
+    with pytest.raises(OSError):
+        pool.fetch("/nonexistent/path/weights.bin", 16)
+    pool.close()
+
+
+def test_prefetch_pool_close_swallows_inflight_failures(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_TPU_DISABLE_NATIVE", "1")
+    from accelerate_tpu.utils import native_io
+
+    monkeypatch.setattr(native_io, "_lib", None)
+    monkeypatch.setattr(native_io, "_build_failed", True)
+    pool = native_io.PrefetchPool(num_threads=1)
+    for i in range(8):
+        pool.prefetch(f"/nonexistent/path/{i}.bin")
+    pool.close()  # must not raise despite queued/in-flight failures
+    pool.close()  # idempotent
+    pool.__del__()  # safe after close (interpreter-exit path)
+
+
+def test_prefetch_pool_fetch_after_close_reads_synchronously(tmp_path, monkeypatch):
+    monkeypatch.setenv("ACCELERATE_TPU_DISABLE_NATIVE", "1")
+    from accelerate_tpu.utils import native_io
+
+    monkeypatch.setattr(native_io, "_lib", None)
+    monkeypatch.setattr(native_io, "_build_failed", True)
+    blob = tmp_path / "x.bin"
+    blob.write_bytes(bytes(range(16)))
+    pool = native_io.PrefetchPool(num_threads=1)
+    pool.close()
+    out = pool.fetch(str(blob), 16)
+    assert bytes(out) == bytes(range(16))
