@@ -1,10 +1,10 @@
 # Test-suite splits mirroring the reference Makefile:25-77.
 
-.PHONY: test test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels bench telemetry-smoke introspect-smoke resilience-smoke
+.PHONY: test test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels bench telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke
 
 PYTEST = python -m pytest -q
 
-test: telemetry-smoke introspect-smoke resilience-smoke
+test: telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke
 	$(PYTEST) tests/
 
 # 3-step CPU training loop with telemetry ON; asserts the JSONL trace is
@@ -24,6 +24,13 @@ introspect-smoke:
 # (docs/usage_guides/resilience.md).
 resilience-smoke:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.resilience.smoke
+
+# Eager vs fused train step on CPU: asserts the dispatch-count gauge shows
+# exactly 1 dispatch per accumulation window on the fused path (3 x accum on
+# eager), bit-exact losses/params between the two, and prefetch ordering
+# (docs/usage_guides/performance.md).
+pipeline-smoke:
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.pipeline.smoke
 
 # Everything except big-modeling / engine dialects / CLI / examples.
 test_core:

@@ -49,6 +49,10 @@ def __getattr__(name):
         from .utils.memory import find_executable_batch_size
 
         return find_executable_batch_size
+    if name in ("make_train_step", "TrainStep", "DevicePrefetcher"):
+        from . import pipeline
+
+        return getattr(pipeline, name)
     if name == "is_rich_available":
         from .utils.imports import is_rich_available
 

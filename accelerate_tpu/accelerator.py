@@ -40,6 +40,7 @@ from .data_loader import DataLoaderDispatcher, DataLoaderShard, prepare_data_loa
 from .optimizer import AcceleratedOptimizer
 from .scheduler import AcceleratedScheduler
 from .state import AcceleratorState, GradientState
+from .telemetry import get_telemetry as _get_telemetry
 from .telemetry import maybe_enable_from_env as _telemetry_from_env
 from .telemetry import span as _span
 from .utils.dataclasses import (
@@ -329,6 +330,7 @@ class PreparedModel:
             self._mode = self._pick_mode(args, kwargs)
         self._maybe_introspect(args, kwargs)
         if self.training and self._mode == "fused":
+            _get_telemetry().count_dispatch()  # eager fused fwd+bwd program
             loss, out, grads = self._jit_fused(self.params, args, kwargs)
             self._pending = (loss, grads)
             return _LazyOutputs(out if isinstance(out, (dict, tuple, list)) else {"loss": loss}, self)
@@ -399,6 +401,7 @@ class PreparedModel:
         return pending
 
     def _accumulate(self, grads, scale: float):
+        _get_telemetry().count_dispatch()  # host-side gradient scale
         scaled = jax.tree_util.tree_map(lambda g: g * scale, grads)
         if self._grad_sync_dtype is not None:
             scaled = jax.tree_util.tree_map(
@@ -408,6 +411,7 @@ class PreparedModel:
         if self._accum_grads is None:
             self._accum_grads = scaled
         else:
+            _get_telemetry().count_dispatch()  # host-side gradient merge
             self._accum_grads = jax.tree_util.tree_map(jnp.add, self._accum_grads, scaled)
 
     def _consume_grads(self):
@@ -768,6 +772,13 @@ class Accelerator:
         # Observability is env-opt-in (ACCELERATE_TPU_TELEMETRY=1): enabled
         # here so env-only runs get spans/metrics/watchdog with no code change.
         _telemetry_from_env()
+        # Persistent XLA compilation cache is default-ON (pipeline/
+        # compile_cache.py): repeated runs load compiled executables instead
+        # of recompiling.  ACCELERATE_TPU_COMPILE_CACHE= (empty) disables,
+        # =/path redirects; hits surface as the jit.cache_hits counter.
+        from .pipeline.compile_cache import maybe_enable_compile_cache_from_env
+
+        maybe_enable_compile_cache_from_env()
 
     # -- state passthroughs (reference properties) ---------------------------
 
@@ -1177,6 +1188,7 @@ class Accelerator:
             output_type="torch",  # user-land torch ops (criteria/metrics) work
             # unchanged; the jitted model picks up `._atpu_jax` with no re-transfer
             static_shape_tail=getattr(cfg, "static_shape_tail", False),
+            prefetch_to_device=getattr(cfg, "prefetch_to_device", 0),
         )
         prepared._is_accelerate_prepared = True
         self._dataloaders.append(prepared)
@@ -1258,6 +1270,43 @@ class Accelerator:
         return prepared
 
     # -- training loop surface ------------------------------------------------
+
+    def make_train_step(
+        self,
+        model,
+        optimizer,
+        accum_steps: Optional[int] = None,
+        clip_norm: Optional[float] = None,
+        clip_value: Optional[float] = None,
+    ):
+        """Build the fused train step: ONE jitted, buffer-donated callable
+        running forward+backward, gradient accumulation over the micro-batch
+        window (``lax.scan`` when ``accum_steps > 1``), optional clipping and
+        the optax update — one Python→XLA dispatch per optimizer step instead
+        of ``3 × accum_steps`` on the eager ``backward()``/``step()`` path,
+        with bit-exact numerics (see ``docs/usage_guides/performance.md``).
+
+        ``model``/``optimizer`` are the prepared pair from :meth:`prepare`;
+        they remain the source of truth (params/opt-state written back every
+        call), so ``save_state``/``resume_from_latest``, LR schedulers and
+        :meth:`check_preemption` step boundaries keep working unchanged::
+
+            step_fn = accelerator.make_train_step(model, optimizer)
+            for batch in loader:          # accum_steps == 1
+                loss = step_fn(batch)
+            for window in windows:        # accum_steps == N: list of N batches
+                losses = step_fn(window)
+        """
+        from .pipeline.train_step import make_train_step as _make
+
+        return _make(
+            self,
+            model,
+            optimizer,
+            accum_steps=accum_steps,
+            clip_norm=clip_norm,
+            clip_value=clip_value,
+        )
 
     @_span("accelerator.backward")
     def backward(self, loss, **kwargs):

@@ -183,6 +183,7 @@ class AcceleratedOptimizer:
         _get_telemetry().record_step()
 
     def _apply_update(self):
+        _get_telemetry().count_dispatch()  # jitted optax update program
         grads = self.model._consume_grads()
         clip_norm = self._clip_norm if self._clip_norm_once is None else self._clip_norm_once
         clip_value = self._clip_value if self._clip_value_once is None else self._clip_value_once

@@ -1,0 +1,130 @@
+"""Persistent XLA compilation cache — default-on.
+
+Warmup compiles are the dominant startup cost of a large GSPMD program
+(minutes at scale); XLA can serialize compiled executables and re-load them
+keyed by (HLO, flags, topology).  This module turns that cache on by default
+for every :class:`Accelerator` run:
+
+- ``ACCELERATE_TPU_COMPILE_CACHE`` unset → cache at
+  ``~/.cache/accelerate_tpu/xla_cache`` (created on demand);
+- ``ACCELERATE_TPU_COMPILE_CACHE=/path`` → cache there;
+- ``ACCELERATE_TPU_COMPILE_CACHE=`` (set but empty) → cache OFF.
+
+Because the cache is default-on (and caches every program, however small),
+the directory is bounded: jax's LRU eviction is configured to
+``ACCELERATE_TPU_COMPILE_CACHE_MAX_BYTES`` (default 1 GiB; ``0`` or negative
+→ unbounded) so long-lived dev machines and shared ``$HOME`` filesystems
+never grow it without limit.
+
+Cache *hits* are surfaced through the telemetry compile counters: jax emits a
+``/jax/compilation_cache/cache_hits`` monitoring event per hit, which
+telemetry's listener tallies as ``jit.cache_hits`` next to the existing
+``jit.compiles`` miss counter (every backend compile is, by definition, a
+persistent-cache miss).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = [
+    "ENV_COMPILE_CACHE",
+    "ENV_COMPILE_CACHE_MAX_BYTES",
+    "DEFAULT_COMPILE_CACHE_DIR",
+    "DEFAULT_COMPILE_CACHE_MAX_BYTES",
+    "compile_cache_dir_from_env",
+    "compile_cache_max_bytes_from_env",
+    "enable_compile_cache",
+    "maybe_enable_compile_cache_from_env",
+]
+
+ENV_COMPILE_CACHE = "ACCELERATE_TPU_COMPILE_CACHE"
+ENV_COMPILE_CACHE_MAX_BYTES = "ACCELERATE_TPU_COMPILE_CACHE_MAX_BYTES"
+DEFAULT_COMPILE_CACHE_DIR = os.path.join(
+    os.path.expanduser("~"), ".cache", "accelerate_tpu", "xla_cache"
+)
+DEFAULT_COMPILE_CACHE_MAX_BYTES = 1 << 30  # 1 GiB LRU bound
+
+_applied_dir: Optional[str] = None
+
+
+def compile_cache_dir_from_env() -> Optional[str]:
+    """Resolve the cache directory from the environment: ``None`` means
+    explicitly disabled (env set to empty), otherwise the directory to use."""
+    raw = os.environ.get(ENV_COMPILE_CACHE)
+    if raw is None:
+        return DEFAULT_COMPILE_CACHE_DIR
+    raw = raw.strip()
+    if not raw:
+        return None
+    return os.path.expanduser(raw)
+
+
+def compile_cache_max_bytes_from_env() -> int:
+    """Size bound for the cache directory: default 1 GiB; ``0`` or negative
+    (or unparseable) opts out of eviction (jax's ``-1`` = unbounded)."""
+    raw = os.environ.get(ENV_COMPILE_CACHE_MAX_BYTES)
+    if raw is None or not raw.strip():
+        return DEFAULT_COMPILE_CACHE_MAX_BYTES
+    try:
+        max_bytes = int(raw.strip())
+    except ValueError:
+        import warnings
+
+        warnings.warn(
+            f"{ENV_COMPILE_CACHE_MAX_BYTES}={raw!r} is not an integer; "
+            "leaving the compilation cache unbounded"
+        )
+        return -1
+    return max_bytes if max_bytes > 0 else -1
+
+
+def enable_compile_cache(cache_dir: Optional[str] = None) -> Optional[str]:
+    """Point jax's persistent compilation cache at ``cache_dir`` (default:
+    the env-resolved directory).  Returns the active directory, or ``None``
+    when the cache is disabled.  Idempotent; never raises — a read-only
+    filesystem must not take down training, it just forfeits the cache."""
+    global _applied_dir
+    if cache_dir is None:
+        cache_dir = compile_cache_dir_from_env()
+    if cache_dir is None:
+        return None
+    if _applied_dir == cache_dir:
+        return _applied_dir
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # Cache every program: the default 1s floor skips exactly the small
+        # programs a CPU-smoke run compiles, and at TPU scale everything
+        # worth running clears 1s anyway.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        # ...but a default-on cache-everything policy needs a bound, or the
+        # directory grows forever on long-lived machines: LRU-evict past
+        # the configured size (default 1 GiB).
+        jax.config.update(
+            "jax_compilation_cache_max_size", compile_cache_max_bytes_from_env()
+        )
+        # jax latches "cache unused/initialized" on the FIRST compile; a
+        # process that already compiled something (warmup, an earlier
+        # Accelerator with the cache off) must reset that latch or the new
+        # dir is silently ignored.
+        from jax.experimental.compilation_cache import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception as e:  # pragma: no cover - fs/backend specific
+        import warnings
+
+        warnings.warn(f"persistent compilation cache unavailable ({e}); continuing without it")
+        return None
+    _applied_dir = cache_dir
+    return _applied_dir
+
+
+def maybe_enable_compile_cache_from_env() -> Optional[str]:
+    """Default-on hook called by ``Accelerator.__init__``: enable the cache
+    unless ``$ACCELERATE_TPU_COMPILE_CACHE`` is set to the empty string."""
+    return enable_compile_cache()

@@ -1,0 +1,194 @@
+"""Async device prefetch: overlap H2D transfer with the running step.
+
+The prepared dataloaders already double-buffer one batch synchronously (issue
+the transfer for batch n+1 before yielding batch n).  That still pays the
+host-side conversion cost (numpy assembly, sharding construction,
+``device_put`` dispatch) inside the training loop's thread.  The
+:class:`DevicePrefetcher` moves that work to a background thread with a
+bounded queue of already-on-device batches, so the loop's only host cost per
+step is a queue pop — the device never idles waiting on host-side batch prep.
+
+Depth semantics: ``depth`` is the number of CONVERTED batches the background
+thread may hold ahead of the consumer (1-2 is plenty; each slot pins one
+global batch in device memory).  Ordering is preserved (single worker, FIFO
+queue), the final batch is flagged so end-of-epoch bookkeeping still happens
+BEFORE user code sees it, and worker exceptions surface on the consuming
+thread at the matching position in the stream.
+
+Also home to the process-wide ``NamedSharding`` cache: building
+``NamedSharding(mesh, spec)`` per tensor per batch shows up in the hot loop
+(it hashes the mesh every call), so placement code asks :func:`cached_sharding`
+instead and reuses one object per ``(mesh, spec)``.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import queue
+import threading
+import time
+from typing import Callable, Iterable, Iterator, Optional
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..telemetry import get_telemetry as _get_telemetry
+
+__all__ = [
+    "DevicePrefetcher",
+    "cached_sharding",
+    "sharding_cache_info",
+    "prefetch_depth_from_env",
+    "ENV_PREFETCH",
+]
+
+ENV_PREFETCH = "ACCELERATE_TPU_PREFETCH"
+
+
+def prefetch_depth_from_env(default: int = 0) -> int:
+    """Prefetch depth from ``$ACCELERATE_TPU_PREFETCH`` (0 / unset / junk =
+    ``default``)."""
+    raw = os.environ.get(ENV_PREFETCH, "").strip()
+    if not raw:
+        return default
+    try:
+        return max(int(raw), 0)
+    except ValueError:
+        return default
+
+
+@functools.lru_cache(maxsize=256)
+def cached_sharding(mesh: Mesh, spec: PartitionSpec) -> NamedSharding:
+    """One ``NamedSharding`` per ``(mesh, spec)`` — the hot-loop placement
+    path must not rebuild (and re-hash the mesh for) an identical sharding
+    per tensor per batch.  Meshes are few and long-lived per process, so the
+    cache's strong references are not a leak in practice."""
+    return NamedSharding(mesh, spec)
+
+
+def sharding_cache_info():
+    """lru_cache stats for :func:`cached_sharding` (hits/misses/currsize)."""
+    return cached_sharding.cache_info()
+
+
+class _WorkerError:
+    """Exception container pushed through the queue in-position, so the
+    consumer re-raises exactly where the stream broke."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+_DONE = object()  # worker sentinel: stream exhausted cleanly
+
+
+class DevicePrefetcher:
+    """Background converter: pulls raw batches from ``iterator``, runs
+    ``convert`` (the sharded ``device_put``) up to ``depth`` batches ahead,
+    and yields ``(converted, meta, is_last)`` in order.
+
+    ``convert(raw) -> (converted, meta)`` runs ONLY on the worker thread;
+    ``meta`` travels with its batch so per-batch bookkeeping (pad rows) is
+    published by the consumer at yield time, exactly like the synchronous
+    path.  ``is_last`` is computed with a one-item lookahead in the worker so
+    the consumer can flip ``end_of_dataloader`` before yielding the final
+    batch (the contract ``accumulate()`` relies on).
+
+    The consumer-side blocking time (queue empty — i.e. the host out-ran the
+    prefetcher) is recorded to the ``pipeline.host_blocked_ms`` histogram
+    when telemetry is on; near-zero means transfers left the critical path.
+    """
+
+    def __init__(
+        self,
+        iterator: Iterable,
+        convert: Callable,
+        depth: int = 1,
+    ):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self.depth = depth
+        self._iterator = iter(iterator)
+        self._convert = convert
+        self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._worker, name="atpu-prefetch", daemon=True
+        )
+        self._thread.start()
+        self._closed = False
+
+    # -- worker ---------------------------------------------------------------
+
+    def _put(self, item) -> bool:
+        """Blocking put that stays responsive to close(); False = aborted."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _worker(self):
+        try:
+            try:
+                current = next(self._iterator)
+            except StopIteration:
+                self._put(_DONE)
+                return
+            while not self._stop.is_set():
+                converted, meta = self._convert(current)
+                try:
+                    upcoming = next(self._iterator)
+                except StopIteration:
+                    self._put((converted, meta, True))
+                    self._put(_DONE)
+                    return
+                if not self._put((converted, meta, False)):
+                    return
+                current = upcoming
+        except BaseException as exc:  # surfaces on the consumer, in-position
+            self._put(_WorkerError(exc))
+
+    # -- consumer -------------------------------------------------------------
+
+    def __iter__(self) -> Iterator:
+        tel = _get_telemetry()
+        while True:
+            t0 = time.perf_counter() if tel.enabled else 0.0
+            item = self._queue.get()
+            if tel.enabled:
+                tel.registry.histogram("pipeline.host_blocked_ms").observe(
+                    (time.perf_counter() - t0) * 1e3
+                )
+                tel.heartbeat()
+            if item is _DONE:
+                return
+            if isinstance(item, _WorkerError):
+                raise item.exc
+            yield item
+
+    def close(self):
+        """Stop the worker and drop queued batches (idempotent).  Called by
+        the owning loader when its epoch generator is closed or abandoned —
+        a half-consumed epoch must not leave a thread converting batches."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        # Drain so a worker blocked on put() observes the stop quickly.
+        while True:
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5.0)
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,163 @@
+"""Pipeline smoke: eager vs fused train step on CPU, dispatch-count proof.
+
+Run via ``make pipeline-smoke`` (or ``python -m accelerate_tpu.pipeline.smoke``).
+One process trains the same recipe twice over ``gradient_accumulation_steps=4``
+windows:
+
+1. **eager** — ``model(...)`` / ``backward()`` / ``optimizer.step()`` per
+   micro-batch, with the prefetching dataloader (``prefetch_to_device=2``);
+2. **fused** — ``accelerator.make_train_step(model, optimizer)``: the whole
+   accumulation window in ONE jitted dispatch.
+
+Asserts, from the telemetry ``pipeline.dispatches`` counter and the
+``pipeline.dispatches_per_step`` gauge:
+
+- the eager path costs ``3 × accum_steps`` dispatch sites per optimizer step,
+- the fused path costs exactly **1** dispatch per accumulation window,
+- per-micro-batch losses and final parameters are BIT-EXACT equal between the
+  two paths, and
+- the prefetcher preserved batch order (losses again bit-exact vs eager with
+  prefetch off).
+
+Exit code 0 only when every assertion holds.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+ACCUM = 4
+WINDOWS = 4
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2"
+        ).strip()
+    # Hermetic: the smoke proves dispatch counts, not the persistent cache.
+    os.environ.setdefault("ACCELERATE_TPU_COMPILE_CACHE", "")
+
+    import numpy as np
+
+    from accelerate_tpu import telemetry
+
+    tel = telemetry.enable(dir=tempfile.mkdtemp(prefix="atpu_pipeline_smoke_"))
+
+    import torch
+    from torch.utils.data import DataLoader
+
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.test_utils import RegressionDataset, RegressionModelWithLoss
+    from accelerate_tpu.test_utils.training import regression_collate
+    from accelerate_tpu.utils import DataLoaderConfiguration, set_seed
+
+    # 2 virtual devices x batch_size 2 = global batch 4; ACCUM x WINDOWS
+    # global batches per epoch.
+    n_samples = 4 * ACCUM * WINDOWS
+
+    def build(prefetch: int):
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        set_seed(1234)
+        accelerator = Accelerator(
+            gradient_accumulation_steps=ACCUM,
+            dataloader_config=DataLoaderConfiguration(prefetch_to_device=prefetch),
+        )
+        model = RegressionModelWithLoss()
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        dl = DataLoader(
+            list(RegressionDataset(length=n_samples)),
+            batch_size=2,
+            collate_fn=regression_collate,
+        )
+        model, opt, dl = accelerator.prepare(model, opt, dl)
+        return accelerator, model, opt, dl
+
+    dispatches = tel.registry.counter("pipeline.dispatches")
+
+    # -- eager path (prefetch ON: also proves ordering under the prefetcher) --
+    accelerator, model, opt, dl = build(prefetch=2)
+    eager_losses = []
+    mark = dispatches.value
+    windows = 0
+    for batch in dl:
+        with accelerator.accumulate(model):
+            out = model(x=batch["x"], y=batch["y"])
+            accelerator.backward(out.loss)
+            opt.step()
+            opt.zero_grad()
+            eager_losses.append(float(out.loss.detach()))
+        if accelerator.sync_gradients:
+            windows += 1
+    eager_dispatches = dispatches.value - mark
+    eager_params = model.state_dict()
+    eager_gauge = tel.registry.gauge("pipeline.dispatches_per_step").value
+    assert windows == WINDOWS, f"expected {WINDOWS} windows, got {windows}"
+    assert eager_dispatches == 3 * ACCUM * windows, (
+        f"eager path: expected 3 x accum x windows = {3 * ACCUM * windows} "
+        f"dispatches, counted {eager_dispatches}"
+    )
+    assert eager_gauge == 3 * ACCUM, f"eager dispatches/step gauge: {eager_gauge}"
+
+    # -- eager path, prefetch OFF: the prefetcher must not reorder batches ----
+    accelerator, model, opt, dl = build(prefetch=0)
+    sync_losses = []
+    for batch in dl:
+        with accelerator.accumulate(model):
+            out = model(x=batch["x"], y=batch["y"])
+            accelerator.backward(out.loss)
+            opt.step()
+            opt.zero_grad()
+            sync_losses.append(float(out.loss.detach()))
+    assert sync_losses == eager_losses, "prefetch-on losses diverged from prefetch-off"
+
+    # -- fused path -----------------------------------------------------------
+    accelerator, model, opt, dl = build(prefetch=2)
+    step_fn = accelerator.make_train_step(model, opt)
+    fused_losses = []
+    mark = dispatches.value
+    window = []
+    for batch in dl:
+        window.append(batch)
+        if len(window) == ACCUM:
+            losses = step_fn(window)
+            fused_losses.extend(float(x) for x in np.asarray(losses))
+            window = []
+    fused_dispatches = dispatches.value - mark
+    fused_params = model.state_dict()
+    fused_gauge = tel.registry.gauge("pipeline.dispatches_per_step").value
+    assert fused_dispatches == WINDOWS, (
+        f"fused path: expected 1 dispatch per window ({WINDOWS}), "
+        f"counted {fused_dispatches}"
+    )
+    assert fused_gauge == 1, f"fused dispatches/step gauge: {fused_gauge}"
+
+    # -- numerics: bit-exact equivalence --------------------------------------
+    assert fused_losses == eager_losses, (
+        f"fused losses diverged: {fused_losses[:4]} vs {eager_losses[:4]}"
+    )
+    for key in eager_params:
+        assert np.array_equal(eager_params[key], fused_params[key]), (
+            f"param {key} diverged: {eager_params[key]} vs {fused_params[key]}"
+        )
+
+    host_blocked = tel.registry.histogram("pipeline.host_blocked_ms").summary()
+    print(
+        "pipeline-smoke OK — "
+        f"eager {eager_dispatches} dispatches ({3 * ACCUM}/window), "
+        f"fused {fused_dispatches} ({WINDOWS} windows, 1/window), "
+        f"{len(eager_losses)} micro-losses bit-exact, "
+        f"prefetch host-blocked p50 {host_blocked.get('p50', 0):.2f} ms"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

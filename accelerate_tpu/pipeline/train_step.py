@@ -1,0 +1,332 @@
+"""Fused train step: ONE jitted, buffer-donated dispatch per optimizer step.
+
+The eager hot loop pays three Python→XLA dispatch sites per micro-batch
+(the fused forward+backward jit, the host-side gradient scale/accumulate,
+and the jitted optax update at the window boundary) — ``3 × accum_steps``
+dispatches per optimizer step, with the device idling on host work between
+each.  ``accelerator.make_train_step(model, optimizer)`` collapses the whole
+window into one compiled program:
+
+- forward + backward for every micro-batch (``lax.scan`` over the stacked
+  micro-batch window when ``gradient_accumulation_steps > 1``),
+- gradient accumulation (same ``g * (1/accum)`` scaling and addition order
+  as the eager ``backward()`` path, so numerics are bit-exact),
+- optional value/global-norm clipping and the optax update — literally the
+  eager path's ``_update_body``, traced into the same program.
+
+Params and optimizer state are donated, so the update is in-place in device
+memory and the gradient window never materializes on the host.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..telemetry import get_telemetry as _get_telemetry
+from ..telemetry import span as _span
+
+__all__ = ["TrainStep", "make_train_step"]
+
+
+def _as_args_kwargs(batch):
+    """One micro-batch → the (args, kwargs) the prepared model is called with:
+    mappings become keyword arguments (the ``model(**batch)`` shape), tuples
+    positional, anything else a single positional argument.  (An accumulation
+    WINDOW is a ``list`` — only lists are unpacked by ``__call__``, so a
+    tuple micro-batch is never mistaken for a window.)"""
+    if isinstance(batch, Mapping):
+        return (), dict(batch)
+    if isinstance(batch, tuple):
+        return batch, {}
+    return (batch,), {}
+
+
+class TrainStep:
+    """Callable returned by :meth:`Accelerator.make_train_step`.
+
+    ``step_fn(batch)`` runs one full optimizer step from one micro-batch
+    (``accum_steps == 1``); ``step_fn([b1, ..., bN])`` (or ``step_fn(b1, ...,
+    bN)``) runs the whole N-micro-batch accumulation window in the same single
+    dispatch.  Returns the micro-batch loss (scalar when ``accum_steps == 1``,
+    else the per-micro-batch loss vector) — bit-exact with the eager
+    ``model(...)`` / ``backward()`` / ``optimizer.step()`` sequence.
+
+    The wrapped model/optimizer stay the source of truth: parameters and
+    optimizer state are read from them at every call and written back after,
+    so checkpointing (``save_state``/``load_state``/``resume_from_latest``),
+    LR scheduling and ``check_preemption()`` step boundaries keep working
+    unchanged around the fused loop.
+    """
+
+    def __init__(
+        self,
+        accelerator,
+        model,
+        optimizer,
+        accum_steps: Optional[int] = None,
+        clip_norm: Optional[float] = None,
+        clip_value: Optional[float] = None,
+    ):
+        from ..accelerator import PreparedModel
+        from ..optimizer import AcceleratedOptimizer
+
+        if not isinstance(model, PreparedModel):
+            raise TypeError(
+                "make_train_step needs the PreparedModel returned by prepare(); "
+                f"got {type(model).__name__}"
+            )
+        if not isinstance(optimizer, AcceleratedOptimizer):
+            raise TypeError(
+                "make_train_step needs the AcceleratedOptimizer returned by "
+                f"prepare(); got {type(optimizer).__name__}"
+            )
+        if optimizer.model is not model:
+            raise ValueError(
+                "optimizer is not paired with this model — prepare them together "
+                "(the optax state is built from the model's sharded params)"
+            )
+        self.accelerator = accelerator
+        self.model = model
+        self.optimizer = optimizer
+        self.accum_steps = int(
+            accum_steps
+            if accum_steps is not None
+            else accelerator.gradient_accumulation_steps
+        )
+        if self.accum_steps < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {self.accum_steps}")
+        # Persistent clips for this step fn; None defers to the optimizer's
+        # (dialect-configured) persistent clips.  One-shot arms from
+        # ``accelerator.clip_grad_{norm,value}_`` still win for one call.
+        self.clip_norm = clip_norm
+        self.clip_value = clip_value
+        self.last_grad_norm = None
+        self.step_count = 0
+        # Python-side dispatch tally (telemetry-independent; the
+        # ``pipeline.dispatches`` counter is the observable twin).
+        self.dispatch_count = 0
+        self._jit = None
+        self._introspect_pending = True
+
+    # -- program construction -------------------------------------------------
+
+    def _build_jit(self):
+        if self._jit is not None:
+            return
+        from ..optimizer import _update_body
+
+        model = self.model
+        tx_update = self.optimizer.tx.update
+        accum = self.accum_steps
+        scale = 1.0 / accum
+        # DDP comm-hook parity: the eager path casts each scaled micro-grad
+        # to the sync dtype (bf16 under fp16/bf16 hooks) before accumulating
+        # (PreparedModel._accumulate); the fused window must do the same or
+        # switching to make_train_step silently changes numerics.
+        sync_dtype = model._grad_sync_dtype
+
+        def _scaled(g):
+            s = g * scale
+            if sync_dtype is not None and jnp.issubdtype(s.dtype, jnp.floating):
+                s = s.astype(sync_dtype)
+            return s
+
+        def _loss_and_grads(params, batch):
+            args, kwargs = batch
+
+            def lossf(p):
+                out = model._forward(p, args, kwargs)
+                loss = out["loss"] if isinstance(out, dict) else out[0]
+                return jnp.asarray(loss, jnp.float32).mean()
+
+            return jax.value_and_grad(lossf)(params)
+
+        def step(params, opt_state, batches, clip_norm, clip_value):
+            if accum == 1:
+                loss, grads = _loss_and_grads(params, batches[0])
+                # Eager parity: backward() accumulates grads * (1/accum) —
+                # at accum == 1 the scale is exactly 1.0 (a no-op multiply).
+                grads = jax.tree_util.tree_map(_scaled, grads)
+                losses = loss
+            else:
+                stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *batches)
+
+                def body(acc, micro):
+                    loss, grads = _loss_and_grads(params, micro)
+                    # Same op order as the eager accumulation buffer:
+                    # scale (and sync-dtype-cast) each micro-grad, then add
+                    # (0 + g*s == g*s bitwise, so the zeros init matches
+                    # "first assign").
+                    acc = jax.tree_util.tree_map(
+                        lambda a, g: a + _scaled(g), acc, grads
+                    )
+                    return acc, loss
+
+                def _zeros_like_accum(p):
+                    dtype = p.dtype
+                    if sync_dtype is not None and jnp.issubdtype(dtype, jnp.floating):
+                        dtype = sync_dtype
+                    return jnp.zeros(jnp.shape(p), dtype)
+
+                zeros = jax.tree_util.tree_map(_zeros_like_accum, params)
+                grads, losses = jax.lax.scan(body, zeros, stacked)
+            new_params, new_opt_state, gnorm = _update_body(
+                tx_update, params, opt_state, grads, clip_norm, clip_value
+            )
+            return new_params, new_opt_state, losses, gnorm
+
+        donate = (0, 1)
+        out_shardings = None
+        if self.optimizer._host_offload_requested:
+            if jax.default_backend() == "tpu":
+                # Pinned-host opt state must come back pinned (same contract
+                # as the eager update, optimizer.py:_init_state).
+                opt_sh = jax.tree_util.tree_map(
+                    lambda x: x.sharding if isinstance(x, jax.Array) else None,
+                    self.optimizer.opt_state,
+                )
+                out_shardings = (None, opt_sh, None, None)
+            else:
+                # CPU smoke path: donating a pinned_host input against a
+                # device-kind output crashes; donate params only.
+                donate = (0,)
+        if out_shardings is not None:
+            self._jit = jax.jit(step, donate_argnums=donate, out_shardings=out_shardings)
+        else:
+            self._jit = jax.jit(step, donate_argnums=donate)
+
+    def _maybe_introspect(self, jit_args):
+        """First-call AOT capture of the fused program
+        (``ACCELERATE_TPU_INTROSPECT=1``): cost/memory analysis, comms ledger
+        and resharding lint flow through the same ``capture()`` hook the
+        eager fused step uses — the one-dispatch program is observable too."""
+        if not self._introspect_pending:
+            return
+        self._introspect_pending = False
+        from ..telemetry import introspect as _introspect
+
+        if not _introspect.enabled_from_env():
+            return
+        _introspect.capture(
+            self._jit,
+            jit_args,
+            name=f"{self.model._program_label}.train_step",
+            mesh=self.accelerator.mesh,
+            declared_specs=self.model._param_specs,
+            count_in_step=True,
+        )
+
+    # -- execution ------------------------------------------------------------
+
+    def __call__(self, *batches):
+        from ..accelerator import _torch_to_jax_tree
+
+        # Only a LIST unpacks as the accumulation window: a tuple is a valid
+        # single micro-batch (positional model args) and must not be split
+        # into per-element "micro-batches".
+        if len(batches) == 1 and isinstance(batches[0], list):
+            batches = tuple(batches[0])
+        if len(batches) != self.accum_steps:
+            raise ValueError(
+                f"fused train step was built for {self.accum_steps} micro-batch"
+                f"{'es' if self.accum_steps > 1 else ''} per optimizer step but "
+                f"received {len(batches)} — pass the whole accumulation window "
+                "in one call as a LIST of micro-batches (a tuple is treated as "
+                "one positional-args micro-batch)."
+            )
+        batches = tuple(
+            _as_args_kwargs(_torch_to_jax_tree(b)) for b in batches
+        )
+        self._build_jit()
+        opt = self.optimizer
+        # Clip resolution mirrors the eager update: one-shot arms win once,
+        # then this step fn's persistent clips, then the optimizer's.
+        clip_norm = (
+            opt._clip_norm_once
+            if opt._clip_norm_once is not None
+            else (self.clip_norm if self.clip_norm is not None else opt._clip_norm)
+        )
+        clip_value = (
+            opt._clip_value_once
+            if opt._clip_value_once is not None
+            else (self.clip_value if self.clip_value is not None else opt._clip_value)
+        )
+        opt._clip_norm_once = None
+        opt._clip_value_once = None
+        jit_args = (
+            self.model.params,
+            opt.opt_state,
+            batches,
+            jnp.asarray(clip_norm if clip_norm is not None else -1.0, jnp.float32),
+            jnp.asarray(clip_value if clip_value is not None else -1.0, jnp.float32),
+        )
+        self._maybe_introspect(jit_args)
+        try:
+            with _span("pipeline.train_step"):
+                new_params, new_opt_state, losses, gnorm = self._jit(*jit_args)
+        except Exception as e:
+            # Params/opt-state are DONATED: an execution failure (e.g.
+            # RESOURCE_EXHAUSTED mid-step) may have consumed the buffers the
+            # model/optimizer still reference.  Trace-time failures leave
+            # them intact (donation only consumes at execution) — in that
+            # case re-raise as-is and the step is safely retryable.
+            leaves = jax.tree_util.tree_leaves((self.model.params, opt.opt_state))
+            consumed = any(
+                x.is_deleted() for x in leaves
+                if isinstance(x, jax.Array) and hasattr(x, "is_deleted")
+            )
+            if consumed:
+                raise RuntimeError(
+                    "fused train step failed AFTER its donated parameter/"
+                    "optimizer buffers were consumed; in-process model state "
+                    "is unrecoverable. Do not retry the step (e.g. via "
+                    "find_executable_batch_size) — restore from the latest "
+                    "checkpoint (accelerator.resume_from_latest / load_state) "
+                    "or rebuild via prepare()."
+                ) from e
+            raise
+        # Write-back: the model/optimizer stay the source of truth for
+        # checkpointing, schedulers and any interleaved eager steps.
+        self.model._set_params(new_params)
+        self.model._clear_grads()
+        opt.opt_state = new_opt_state
+        opt._last_grad_norm = gnorm
+        opt._step_was_skipped = False
+        opt._step_count += 1
+        if opt.torch_optimizer is not None:
+            opt.torch_optimizer._opt_called = True
+            opt.torch_optimizer._step_count = (
+                getattr(opt.torch_optimizer, "_step_count", 0) + 1
+            )
+        # A fused call IS a sync step — schedulers gate on this flag.
+        opt.gradient_state._set_sync_gradients(True)
+        self.last_grad_norm = gnorm
+        self.step_count += 1
+        self.dispatch_count += 1
+        tel = _get_telemetry()
+        tel.count_dispatch()
+        tel.record_step()
+        return losses
+
+
+def make_train_step(
+    accelerator,
+    model,
+    optimizer,
+    accum_steps: Optional[int] = None,
+    clip_norm: Optional[float] = None,
+    clip_value: Optional[float] = None,
+) -> TrainStep:
+    """Build a :class:`TrainStep` (the function behind
+    :meth:`Accelerator.make_train_step`)."""
+    return TrainStep(
+        accelerator,
+        model,
+        optimizer,
+        accum_steps=accum_steps,
+        clip_norm=clip_norm,
+        clip_value=clip_value,
+    )

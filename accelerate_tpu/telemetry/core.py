@@ -24,7 +24,7 @@ import threading
 import time
 from typing import Optional
 
-from .metrics import COMPILE_EVENT, MetricsRegistry, StepTimer, collect_hbm
+from .metrics import CACHE_HIT_EVENT, COMPILE_EVENT, MetricsRegistry, StepTimer, collect_hbm
 
 __all__ = [
     "Telemetry",
@@ -64,6 +64,9 @@ class Telemetry:
         self._lock = threading.Lock()
         self._proc: Optional[int] = None
         self._atexit_registered = False
+        # pipeline.dispatches value at the last completed step — the delta
+        # is the dispatches/step gauge.
+        self._dispatch_mark = 0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -79,6 +82,7 @@ class Telemetry:
         # Fresh-run semantics: a re-enable starts a new measurement window.
         self.registry.reset()
         self.step_timer.reset()
+        self._dispatch_mark = 0
         self._file = None
         self.enabled = True
         _install_compile_listener()
@@ -153,13 +157,29 @@ class Telemetry:
         if self.watchdog is not None:
             self.watchdog.beat()
 
+    def count_dispatch(self, n: int = 1):
+        """Tally ``n`` Python→XLA dispatch sites on the training hot path
+        (a jitted call, a host-side gradient scale/accumulate, an optimizer
+        update).  ``record_step`` folds the tally into the
+        ``pipeline.dispatches_per_step`` gauge — the eager loop lands at
+        ``3 × accum_steps`` per optimizer step, the fused train step at 1."""
+        if self.enabled:
+            self.registry.counter("pipeline.dispatches").inc(n)
+
     def record_step(self):
         """Mark one COMPLETED optimizer step: step-time histogram, derived
-        tokens/sec + MFU gauges, HBM gauges, watchdog heartbeat."""
+        tokens/sec + MFU gauges, HBM gauges, dispatches/step gauge, watchdog
+        heartbeat."""
         if not self.enabled:
             return
         self.step_timer.step()
         collect_hbm(self.registry)
+        dispatches = self.registry.counter("pipeline.dispatches").value
+        if dispatches:
+            self.registry.gauge("pipeline.dispatches_per_step").set(
+                dispatches - self._dispatch_mark
+            )
+        self._dispatch_mark = dispatches
         self.heartbeat()
 
 
@@ -216,3 +236,16 @@ def _install_compile_listener():
         tel.write({"kind": "compile", "dur_ms": round(dur_ms, 3)})
 
     monitoring.register_event_duration_secs_listener(_on_duration)
+
+    # Persistent-compilation-cache hits (pipeline/compile_cache.py): jax
+    # records one event per executable loaded from the cache instead of
+    # compiled.  Every backend compile (counted above) is by definition a
+    # cache MISS, so jit.cache_hits/jit.compiles together are the cache's
+    # hit/miss ledger.
+    def _on_event(event, **kwargs):
+        tel = _TELEMETRY
+        if not tel.enabled or event != CACHE_HIT_EVENT:
+            return
+        tel.registry.counter("jit.cache_hits").inc()
+
+    monitoring.register_event_listener(_on_event)

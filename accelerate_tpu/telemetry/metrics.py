@@ -37,6 +37,10 @@ __all__ = [
 # jax.monitoring key emitted once per XLA backend compile (cache hits skip it).
 COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
+# jax.monitoring event recorded once per persistent-compilation-cache hit
+# (an executable deserialized instead of compiled).
+CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+
 
 class Counter:
     """Monotonic counter."""

@@ -727,6 +727,12 @@ class DataLoaderConfiguration:
     # step includes the duplicates — so this is opt-in; the default follows
     # the reference, which never reshards at num_processes == 1.
     static_shape_tail: bool = False
+    # TPU extension (pipeline/prefetch.py): number of batches a background
+    # thread converts + device_puts AHEAD of the training loop (0 = the
+    # synchronous one-batch double-buffer; 1-2 is plenty — each slot pins one
+    # global batch in device memory).  ``ACCELERATE_TPU_PREFETCH=N`` is the
+    # no-code-change form and applies when this field is left at 0.
+    prefetch_to_device: int = 0
 
 
 @dataclass

@@ -403,6 +403,192 @@ def _checkpoint_probe() -> dict:
     }
 
 
+def _pipeline_probe() -> dict:
+    """Eager-vs-fused train-step micro-benchmark on CPU (the overlapped
+    execution pipeline, pipeline/train_step.py + prefetch.py): steps/s and
+    dispatches/step for both paths, host-blocked ms/step with prefetch on vs
+    off, and a loss-parity check.  Host-side comparison — the relative
+    dispatch/overlap win is what transfers to TPU, not the absolute steps/s."""
+    import tempfile
+
+    import torch
+
+    from accelerate_tpu import Accelerator, telemetry
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.utils import DataLoaderConfiguration, set_seed
+
+    tel = telemetry.enable(dir=tempfile.mkdtemp(prefix="atpu_bench_pipeline_"))
+    ACCUM = 2
+    STEPS = 12  # optimizer steps per timed loop
+    DIM = 256
+    BATCH = 16
+
+    class MLPWithLoss(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.net = torch.nn.Sequential(
+                torch.nn.Linear(DIM, DIM),
+                torch.nn.Tanh(),
+                torch.nn.Linear(DIM, DIM),
+                torch.nn.Tanh(),
+                torch.nn.Linear(DIM, 1),
+            )
+
+        def forward(self, x, y):
+            pred = self.net(x)
+            return {"loss": torch.nn.functional.mse_loss(pred, y), "logits": pred}
+
+    n_batches = ACCUM * STEPS
+
+    def build(prefetch: int):
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        set_seed(0)
+        acc = Accelerator(
+            gradient_accumulation_steps=ACCUM,
+            dataloader_config=DataLoaderConfiguration(prefetch_to_device=prefetch),
+        )
+        model = MLPWithLoss()
+        opt = torch.optim.AdamW(model.parameters(), lr=1e-3)
+        rng = np.random.default_rng(0)
+        data = [
+            {
+                "x": torch.from_numpy(rng.standard_normal((BATCH, DIM)).astype("float32")),
+                "y": torch.from_numpy(rng.standard_normal((BATCH, 1)).astype("float32")),
+            }
+            for _ in range(n_batches)
+        ]
+        model, opt = acc.prepare(model, opt)
+        dl = acc.prepare_data_loader(data)
+        return acc, model, opt, dl
+
+    dispatches = tel.registry.counter("pipeline.dispatches")
+
+    def eager_loop(prefetch: int):
+        acc, model, opt, dl = build(prefetch)
+        losses = []
+
+        def one_epoch(timed: bool):
+            blocked = 0.0
+            it = iter(dl)
+            t_start = time.perf_counter()
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    break
+                blocked += time.perf_counter() - t0
+                with acc.accumulate(model):
+                    out = model(**batch)
+                    acc.backward(out.loss)
+                    opt.step()
+                    opt.zero_grad()
+                    if timed:
+                        losses.append(float(out.loss.detach()))
+            import jax
+
+            jax.block_until_ready(model.params)
+            return time.perf_counter() - t_start, blocked
+
+        one_epoch(timed=False)  # warmup epoch: compiles
+        d0 = dispatches.value
+        dt, blocked = one_epoch(timed=True)
+        return {
+            "steps_per_s": round(STEPS / dt, 2),
+            "dispatches_per_step": (dispatches.value - d0) / STEPS,
+            "host_blocked_ms_per_step": round(blocked / STEPS * 1e3, 3),
+        }, losses
+
+    def fused_loop(prefetch: int):
+        acc, model, opt, dl = build(prefetch)
+        step_fn = acc.make_train_step(model, opt)
+        losses = []
+
+        def one_epoch(timed: bool):
+            blocked = 0.0
+            window = []
+            it = iter(dl)
+            t_start = time.perf_counter()
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    break
+                blocked += time.perf_counter() - t0
+                window.append(batch)
+                if len(window) == ACCUM:
+                    out = step_fn(window)
+                    if timed:
+                        losses.extend(float(x) for x in np.asarray(out))
+                    window = []
+            import jax
+
+            jax.block_until_ready(model.params)
+            return time.perf_counter() - t_start, blocked
+
+        one_epoch(timed=False)
+        d0 = dispatches.value
+        dt, blocked = one_epoch(timed=True)
+        return {
+            "steps_per_s": round(STEPS / dt, 2),
+            "dispatches_per_step": (dispatches.value - d0) / STEPS,
+            "host_blocked_ms_per_step": round(blocked / STEPS * 1e3, 3),
+        }, losses
+
+    eager_off, losses_off = eager_loop(prefetch=0)
+    eager_on, losses_on = eager_loop(prefetch=2)
+    fused_on, losses_fused = fused_loop(prefetch=2)
+    return {
+        "pipeline": {
+            "accum_steps": ACCUM,
+            "optimizer_steps": STEPS,
+            "eager": eager_off,
+            "eager_prefetch": eager_on,
+            "fused_prefetch": fused_on,
+            "fused_speedup": round(
+                fused_on["steps_per_s"] / max(eager_off["steps_per_s"], 1e-9), 3
+            ),
+            "prefetch_host_blocked_ms_per_step": {
+                "off": eager_off["host_blocked_ms_per_step"],
+                "on": eager_on["host_blocked_ms_per_step"],
+            },
+            "losses_match": losses_off == losses_on == losses_fused,
+        }
+    }
+
+
+def _run_pipeline_probe_subprocess(timeout_s: float = 240.0):
+    """Pipeline probe in a bounded CPU subprocess (same contract as the rung
+    children: last JSON line on stdout is the result, silence is failure)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--pipeline-probe"],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"pipeline probe timeout after {timeout_s:.0f}s"
+    if proc.returncode != 0:
+        return None, (proc.stderr or "")[-200:].replace("\n", " ")
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except ValueError:
+                continue
+    return None, "no parseable pipeline-probe line"
+
+
 def _run_checkpoint_probe_subprocess(timeout_s: float = 180.0):
     """Checkpoint-latency probe in a bounded CPU subprocess (same contract as
     the rung children: last JSON line on stdout is the result, silence is
@@ -502,6 +688,9 @@ def main():
         sys.exit(0 if ok else 1)
     if "--checkpoint-probe" in sys.argv:
         print(json.dumps(_checkpoint_probe()))
+        return
+    if "--pipeline-probe" in sys.argv:
+        print(json.dumps(_pipeline_probe()))
         return
     if "--rung" in sys.argv or "--proof-rung" in sys.argv or "--frontier-rung" in sys.argv:
         if "--rung" in sys.argv:
@@ -754,6 +943,14 @@ def main():
         ckpt_block = ckpt_probe["checkpoint"] if ckpt_probe else {"status": ckpt_err}
         print(f"# checkpoint probe: {ckpt_block}", file=sys.stderr, flush=True)
 
+    # Overlapped-pipeline probe (eager vs fused dispatch counts + prefetch
+    # host-blocked time): CPU subprocess, never zeroes the headline.
+    pipeline_block = None
+    if os.environ.get("BENCH_PIPELINE_PROBE", "1") != "0":
+        pipe_probe, pipe_err = _run_pipeline_probe_subprocess()
+        pipeline_block = pipe_probe["pipeline"] if pipe_probe else {"status": pipe_err}
+        print(f"# pipeline probe: {pipeline_block}", file=sys.stderr, flush=True)
+
     detail = {
         "config": result["config"],
         "rung": rung_cfg,
@@ -771,6 +968,8 @@ def main():
         detail["frontier"] = frontier
     if ckpt_block is not None:
         detail["checkpoint"] = ckpt_block
+    if pipeline_block is not None:
+        detail["pipeline"] = pipeline_block
     if proof is not None:
         detail["hbm_bound_proof"] = {
             "config": proof_cfg,
@@ -801,7 +1000,14 @@ if __name__ == "__main__":
     # for a measurement; their silence IS the failure signal.
     _is_child = any(
         flag in sys.argv
-        for flag in ("--rung", "--proof-rung", "--frontier-rung", "--probe", "--checkpoint-probe")
+        for flag in (
+            "--rung",
+            "--proof-rung",
+            "--frontier-rung",
+            "--probe",
+            "--checkpoint-probe",
+            "--pipeline-probe",
+        )
     )
     try:
         main()

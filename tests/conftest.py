@@ -14,6 +14,11 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # power-loss durability; per-file fsync on the CI filesystem costs real
 # wall-clock across the suite's many save_state calls.
 os.environ.setdefault("ACCELERATE_TPU_CHECKPOINT_FSYNC", "0")
+# The persistent compilation cache is default-ON for real runs; the suite
+# compiles thousands of tiny programs and must stay hermetic (no cross-run
+# state under ~/.cache, no per-program disk writes).  Tests of the cache
+# itself point it at a tmpdir explicitly.
+os.environ.setdefault("ACCELERATE_TPU_COMPILE_CACHE", "")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
