@@ -1,11 +1,18 @@
 # Test-suite splits mirroring the reference Makefile:25-77.
 
-.PHONY: test test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels bench telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke
+.PHONY: test test-quick test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels bench telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke
 
 PYTEST = python -m pytest -q
 
-test: telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke
+test: test-quick telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke
 	$(PYTEST) tests/
+
+# <5 min tier (VERDICT r5 item 6): oracles, state, sharding-spec/mesh,
+# resilience + health unit tests — no subprocess smokes.  First stage of
+# `make test` so fast failures surface before the multi-minute suites run.
+test-quick:
+	$(PYTEST) tests/test_oracles.py tests/test_state.py tests/test_mesh_matrix.py \
+	  tests/test_resilience.py tests/test_health.py -m 'not slow'
 
 # 3-step CPU training loop with telemetry ON; asserts the JSONL trace is
 # non-empty and parseable (docs/usage_guides/telemetry.md).
@@ -31,6 +38,14 @@ resilience-smoke:
 # (docs/usage_guides/performance.md).
 pipeline-smoke:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.pipeline.smoke
+
+# Numerical-health proof: NaN-poisons a CPU run's gradients (fault
+# injection), asserts the in-program gate skips the step with bit-identical
+# params at ONE dispatch/step, and that a 3x-consecutive-NaN run rewinds to
+# the last verified checkpoint and continues bit-exact vs a clean resume
+# (docs/usage_guides/resilience.md).
+health-smoke:
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.resilience.health_smoke
 
 # Everything except big-modeling / engine dialects / CLI / examples.
 test_core:

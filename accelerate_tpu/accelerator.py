@@ -729,6 +729,10 @@ class Accelerator:
         # Resilience: no guard (and no signal handlers, no per-step cost)
         # unless enable_preemption_handling() opts in.
         self._preemption_guard = None
+        # Numerical health: no host-side policy runs unless
+        # enable_health_guard() opts in (the in-program zero-delta gate on
+        # non-finite updates is always on — it rides the existing dispatch).
+        self._health_guard = None
         self._pending_checkpoint_finalize = None
         self.trackers: list = []
         self.log_with = log_with if isinstance(log_with, (list, tuple)) else ([log_with] if log_with else [])
@@ -1745,6 +1749,63 @@ class Accelerator:
         manifest = read_manifest(ckpt) or {}
         step = manifest.get("step")
         return int(step) if step is not None else 0
+
+    def enable_health_guard(
+        self,
+        optimizer=None,
+        dataloader=None,
+        max_skips: int = 3,
+        max_rewinds: int = 2,
+        lr_backoff: Optional[float] = None,
+        checkpoint_dir: Optional[str] = None,
+        quarantine_after: int = 2,
+        quarantine_log: Optional[str] = None,
+    ):
+        """Install a :class:`~accelerate_tpu.resilience.HealthGuard`: NaN/Inf
+        loss+gradient detection inside the jitted step (the anomalous update
+        is gated to a zero delta in-program — no extra dispatch), plus the
+        host-side policy: skip up to ``max_skips`` consecutive anomalous
+        steps, then rewind to the newest manifest-complete checkpoint under
+        ``checkpoint_dir`` (via :meth:`resume_from_latest`, with an optional
+        ``lr_backoff`` multiplier), raising ``NumericalDivergenceError``
+        after ``max_rewinds``.  A batch that produces a non-finite step
+        ``quarantine_after`` times is quarantined: fingerprinted by (epoch,
+        batch index), logged to JSONL next to the telemetry trace, and
+        skipped by the dataloader on replay.  ``optimizer``/``dataloader``
+        default to the prepared ones.  Call :meth:`check_health` once per
+        step.  Returns the guard."""
+        from .resilience.health import HealthGuard
+
+        if optimizer is None:
+            optimizer = self._optimizers[-1] if self._optimizers else None
+        if dataloader is None:
+            dataloader = self._dataloaders[0] if self._dataloaders else None
+        self._health_guard = HealthGuard(
+            self,
+            optimizer=optimizer,
+            dataloader=dataloader,
+            max_skips=max_skips,
+            max_rewinds=max_rewinds,
+            lr_backoff=lr_backoff,
+            checkpoint_dir=checkpoint_dir,
+            quarantine_after=quarantine_after,
+            quarantine_log=quarantine_log,
+        )
+        return self._health_guard
+
+    def check_health(self, step: Optional[int] = None, loss=None):
+        """Judge the optimizer step that just completed (call right after
+        ``optimizer.step()`` or the fused ``step_fn(batch)``).  Returns a
+        :class:`~accelerate_tpu.resilience.HealthVerdict`; on
+        ``verdict.rewound`` the caller should reset its step counter to
+        ``verdict.resumed_step`` and re-enter its dataloader loop (the
+        loader's position was restored with the checkpoint).  A no-op
+        healthy verdict when no guard is installed."""
+        if self._health_guard is None:
+            from .resilience.health import HealthVerdict
+
+            return HealthVerdict()
+        return self._health_guard.check(step=step, loss=loss)
 
     def free_memory(self, *objects):
         """Reference ``accelerator.py:3497``: drop references + clear caches.

@@ -46,8 +46,11 @@ from .utils.operations import (
     to_numpy,
 )
 from .utils.random import synchronize_rng_states
+from .logging import get_logger
 from .telemetry import get_telemetry as _get_telemetry
 from .telemetry import span as _span
+
+logger = get_logger(__name__)
 
 __all__ = [
     "SeedableRandomSampler",
@@ -553,6 +556,47 @@ class DataLoaderStateMixin:
             self.skip_batches = 0
             self._skip_once = False
 
+    # -- numerical-health hooks (resilience/health.py) ------------------------
+    #
+    # Quarantine: positions fingerprinted as (epoch, user-visible batch index)
+    # are consumed but never yielded — the post-rewind replay of a run whose
+    # step went non-finite twice on the same batch silently drops that batch.
+    # The fingerprint is EPOCH-scoped: under a shuffling sampler the data at
+    # index i differs between epochs, so only replays of the same epoch (the
+    # rewind case — ``load_state_dict`` restores ``iteration``) skip it;
+    # later epochs run the position normally.  ``load_state_dict`` never
+    # touches the set itself, so a health-guard rewind keeps its quarantine
+    # across the restore.
+
+    def quarantine(self, fingerprints) -> None:
+        """Register ``(epoch, batch_index)`` fingerprints to skip at yield
+        time (``HealthGuard`` pushes its quarantine set through here)."""
+        q = getattr(self, "_quarantined", None)
+        if q is None:
+            q = self._quarantined = set()
+        q.update((int(e), int(i)) for e, i in fingerprints)
+
+    def _is_quarantined(self, index: int) -> bool:
+        q = getattr(self, "_quarantined", None)
+        return bool(q) and (self.iteration, index) in q
+
+    def _count_quarantine_skip(self, index: int) -> None:
+        tel = _get_telemetry()
+        if tel.enabled:
+            tel.registry.counter("health.quarantine_skips").inc()
+        logger.warning(
+            f"health: skipping quarantined batch (epoch={self.iteration}, index={index})"
+        )
+
+    def _maybe_poison(self, batch, index: int):
+        """Fault injection (``ACCELERATE_TPU_FAULT_BAD_BATCH=<i>``): NaN-lace
+        the armed per-epoch position.  One cached-None check when unarmed."""
+        from .resilience import faultinject
+
+        if faultinject.bad_batch_index() is None:
+            return batch
+        return faultinject.maybe_poison_batch(batch, index)
+
 
 class DataLoaderShard(DataLoaderStateMixin):
     """Per-process loader: RNG sync at epoch start, one-batch prefetch to detect the
@@ -713,11 +757,17 @@ class DataLoaderShard(DataLoaderStateMixin):
             for converted, pad, is_last in prefetcher:
                 if is_last:
                     self.end_of_dataloader = True
+                pos = self.skip_batches + emitted
+                emitted += 1
+                self._yielded = pos + 1
+                if self._is_quarantined(pos):
+                    # Consumed (position advances for state_dict) but never
+                    # yielded — the health-guard replay-skip.
+                    self._count_quarantine_skip(pos)
+                    continue
                 self.gradient_state.device_pad_rows = pad[0]
                 self.gradient_state.device_batch_rows = pad[1]
-                emitted += 1
-                self._yielded = self.skip_batches + emitted
-                yield converted
+                yield self._maybe_poison(converted, pos)
         finally:
             # Runs on break/close too: an abandoned epoch must not leave a
             # worker thread converting batches into a dead queue.
@@ -762,31 +812,42 @@ class DataLoaderShard(DataLoaderStateMixin):
         current_pad = (0, 0)
         _convert_tracked = self._convert_tracked
 
+        def _emits(index: int) -> bool:
+            # A quarantined position is consumed (state_dict position still
+            # advances) but neither converted nor yielded.
+            return index >= self.skip_batches and not self._is_quarantined(index)
+
         while True:
-            if current_converted is None and batch_index >= self.skip_batches:
+            if current_converted is None and _emits(batch_index):
                 current_converted, current_pad = _convert_tracked(current)
             try:
                 upcoming = next(iterator)
             except StopIteration:
                 self.end_of_dataloader = True
                 if batch_index >= self.skip_batches:
-                    self.gradient_state.device_pad_rows = current_pad[0]
-                    self.gradient_state.device_batch_rows = current_pad[1]
                     self._yielded = batch_index + 1
-                    yield current_converted
+                    if _emits(batch_index):
+                        self.gradient_state.device_pad_rows = current_pad[0]
+                        self.gradient_state.device_batch_rows = current_pad[1]
+                        yield self._maybe_poison(current_converted, batch_index)
+                    else:
+                        self._count_quarantine_skip(batch_index)
                 break
             # Double buffering (reference MpDeviceLoader's background preload,
             # data_loader.py:643-693): issue batch n+1's async device transfer
             # BEFORE yielding batch n, so the H2D overlaps the user's step.
-            if batch_index + 1 >= self.skip_batches:
+            if _emits(batch_index + 1):
                 upcoming_converted, upcoming_pad = _convert_tracked(upcoming)
             else:
                 upcoming_converted, upcoming_pad = None, (0, 0)
             if batch_index >= self.skip_batches:
-                self.gradient_state.device_pad_rows = current_pad[0]
-                self.gradient_state.device_batch_rows = current_pad[1]
                 self._yielded = batch_index + 1
-                yield current_converted
+                if _emits(batch_index):
+                    self.gradient_state.device_pad_rows = current_pad[0]
+                    self.gradient_state.device_batch_rows = current_pad[1]
+                    yield self._maybe_poison(current_converted, batch_index)
+                else:
+                    self._count_quarantine_skip(batch_index)
             batch_index += 1
             current = upcoming
             current_converted, current_pad = upcoming_converted, upcoming_pad
@@ -952,12 +1013,16 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
                     self.end_of_dataloader = True
                     if bs is not None:
                         self.remainder = bs % self.total_batch_size or self.remainder
+                pos = self.skip_batches + emitted
+                emitted += 1
+                self._yielded = pos + 1
+                if self._is_quarantined(pos):
+                    self._count_quarantine_skip(pos)
+                    continue
                 if self._placer is not None:
                     self.gradient_state.device_pad_rows = pad[0]
                     self.gradient_state.device_batch_rows = pad[1]
-                emitted += 1
-                self._yielded = self.skip_batches + emitted
-                yield placed
+                yield self._maybe_poison(placed, pos)
         finally:
             prefetcher.close()
         if emitted == 0:
@@ -983,11 +1048,17 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
                             self.remainder = bs % self.total_batch_size or self.remainder
                         if batch_index - 1 >= self.skip_batches:
                             self._yielded = batch_index
-                            yield self._emit(prev)
+                            if self._is_quarantined(batch_index - 1):
+                                self._count_quarantine_skip(batch_index - 1)
+                            else:
+                                yield self._maybe_poison(self._emit(prev), batch_index - 1)
                     break
                 if prev is not None and batch_index - 1 >= self.skip_batches:
                     self._yielded = batch_index
-                    yield self._emit(prev)
+                    if self._is_quarantined(batch_index - 1):
+                        self._count_quarantine_skip(batch_index - 1)
+                    else:
+                        yield self._maybe_poison(self._emit(prev), batch_index - 1)
                 prev = batch
                 batch_index += 1
         self.iteration += 1

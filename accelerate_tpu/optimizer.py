@@ -28,7 +28,7 @@ from .telemetry import span as _span
 __all__ = ["AcceleratedOptimizer"]
 
 
-def _update_body(tx_update, params, opt_state, grads, clip_norm, clip_value):
+def _update_body(tx_update, params, opt_state, grads, clip_norm, clip_value, health_ok=None):
     """One optimizer update (traced body shared by the jit variants).
 
     ``clip_norm`` / ``clip_value`` < 0 disable the respective clip (static
@@ -36,7 +36,23 @@ def _update_body(tx_update, params, opt_state, grads, clip_norm, clip_value):
     clip that zeroes gradients, matching torch's ``clip_grad_{norm,value}_(0)``.
     Value clip (elementwise, reference ``clip_grad_value_``) applies first,
     then norm clip — matching a torch loop that calls both before ``step()``.
+
+    Numerical-health gate (resilience/health.py): the PRE-clip global norm is
+    the health verdict — a value clip would mask an Inf gradient into a
+    finite one, so finiteness must be judged before any clip touches the
+    tree.  When the verdict (optionally ANDed with ``health_ok``, the fused
+    step's loss-finiteness flag) fails, the whole update is ``jnp.where``-
+    gated to a zero delta: params AND optimizer state come back bit-identical
+    (optax ``count`` included), all inside this one traced program — no extra
+    dispatch, no host round-trip.  The returned ``health_norm`` is that
+    pre-clip norm, forced non-finite whenever the verdict failed, so the host
+    can detect the skip from a value it was reading anyway.
     """
+    health_norm = optax.global_norm(grads)
+    ok = jnp.isfinite(health_norm)
+    if health_ok is not None:
+        ok = jnp.logical_and(ok, health_ok)
+        health_norm = jnp.where(health_ok, health_norm, jnp.nan)
     grads = jax.tree_util.tree_map(
         lambda g: jnp.where(clip_value >= 0, jnp.clip(g, -clip_value, clip_value), g), grads
     )
@@ -47,7 +63,13 @@ def _update_body(tx_update, params, opt_state, grads, clip_norm, clip_value):
     grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
     updates, new_opt_state = tx_update(grads, opt_state, params)
     new_params = optax.apply_updates(params, updates)
-    return new_params, new_opt_state, gnorm
+    new_params = jax.tree_util.tree_map(
+        lambda n, o: jnp.where(ok, n, o), new_params, params
+    )
+    new_opt_state = jax.tree_util.tree_map(
+        lambda n, o: jnp.where(ok, n, o), new_opt_state, opt_state
+    )
+    return new_params, new_opt_state, gnorm, health_norm
 
 
 _update_step = partial(jax.jit, donate_argnums=(1, 2), static_argnums=(0,))(_update_body)
@@ -90,6 +112,12 @@ class AcceleratedOptimizer:
         self._clip_norm_once: Optional[float] = None
         self._clip_value_once: Optional[float] = None
         self._step_count = 0
+        # Health-guard observables: the post-value-clip norm the clip logic
+        # used, and the PRE-clip norm (non-finite <=> the update was gated to
+        # a zero delta in-program).  Device scalars — reading them is a sync,
+        # so only HealthGuard.check() (or the user) ever floats them.
+        self._last_grad_norm = None
+        self._last_health_norm = None
         if model is not None:
             self._init_state()
 
@@ -123,7 +151,7 @@ class AcceleratedOptimizer:
                 self._update_fn = jax.jit(
                     partial(_update_body, self.tx.update),
                     donate_argnums=(0, 1),
-                    out_shardings=(None, opt_sh, None),
+                    out_shardings=(None, opt_sh, None, None),
                 )
             else:
                 # CPU smoke path: the backend cannot execute D2H placement
@@ -185,12 +213,18 @@ class AcceleratedOptimizer:
     def _apply_update(self):
         _get_telemetry().count_dispatch()  # jitted optax update program
         grads = self.model._consume_grads()
+        from .resilience import faultinject
+
+        if faultinject.nan_armed():
+            poison = faultinject.grad_poison_scale(self._step_count + 1)
+            if poison is not None:
+                grads = jax.tree_util.tree_map(lambda g: g * poison, grads)
         clip_norm = self._clip_norm if self._clip_norm_once is None else self._clip_norm_once
         clip_value = self._clip_value if self._clip_value_once is None else self._clip_value_once
         self._clip_norm_once = None
         self._clip_value_once = None
         if self._update_fn is not None:
-            new_params, self.opt_state, gnorm = self._update_fn(
+            new_params, self.opt_state, gnorm, health_norm = self._update_fn(
                 self.model.params,
                 self.opt_state,
                 grads,
@@ -198,7 +232,7 @@ class AcceleratedOptimizer:
                 jnp.asarray(clip_value, jnp.float32),
             )
         else:
-            new_params, self.opt_state, gnorm = _update_step(
+            new_params, self.opt_state, gnorm, health_norm = _update_step(
                 self.tx.update,
                 self.model.params,
                 self.opt_state,
@@ -208,6 +242,7 @@ class AcceleratedOptimizer:
             )
         self.model._set_params(new_params)
         self._last_grad_norm = gnorm
+        self._last_health_norm = health_norm
         self._step_was_skipped = False
         self._step_count += 1
         if self.torch_optimizer is not None:

@@ -51,6 +51,13 @@ def offload_to_host(tree):
 
     def put(x):
         if isinstance(x, jax.Array):
+            if getattr(x.sharding, "memory_kind", None) == kind:
+                # Already in host memory (CPU backends: host IS the default
+                # kind).  A same-kind device_put would still COMMIT the leaf
+                # to its current device, and a committed scalar (optax
+                # ``count`` on device 0) breaks jit placement against
+                # multi-device params.
+                return x
             return jax.device_put(x, x.sharding.with_memory_kind(kind))
         return x
 
@@ -70,6 +77,18 @@ def host_offload(tx):
     """
     import optax
 
+    kind = host_memory_kind()
+    try:
+        default_kind = jax.devices()[0].default_memory().kind
+    except Exception:  # pragma: no cover - backend without memory spaces
+        default_kind = None
+    # When host memory IS the backend's default memory (CPU), "offload" is a
+    # placement no-op: the wrapper keeps its call contract (the before-init
+    # guard) but must not device_put — a same-kind put still COMMITS
+    # uncommitted scalar leaves (optax ``count``) to one device and breaks
+    # jit placement against multi-device params.
+    placement_noop = kind is None or kind == default_kind
+
     shardings = {}
 
     def _put(tree, target):
@@ -79,20 +98,29 @@ def host_offload(tx):
 
     def init(params):
         state = offload_to_host(tx.init(params))
+        if placement_noop:
+            shardings["host"] = None
+            shardings["device"] = None
+            return state
         host = jax.tree_util.tree_map(
             lambda x: x.sharding if isinstance(x, jax.Array) else None, state
         )
         shardings["host"] = host
+        # The compute-side kind is the device's DEFAULT memory, not the
+        # literal "device" (older backends spelled it differently, and CPU
+        # has no device kind at all).
         shardings["device"] = jax.tree_util.tree_map(
-            lambda s: None if s is None else s.with_memory_kind("device"), host
+            lambda s: None if s is None else s.with_memory_kind(default_kind), host
         )
         return state
 
     def update(grads, state, params=None, **kw):
         if "host" not in shardings:
             raise RuntimeError("host_offload(tx).update called before init")
-        on_device = _put(state, shardings["device"])
+        on_device = state if shardings["device"] is None else _put(state, shardings["device"])
         updates, new_state = tx.update(grads, on_device, params, **kw)
-        return updates, _put(new_state, shardings["host"])
+        if shardings["host"] is not None:
+            new_state = _put(new_state, shardings["host"])
+        return updates, new_state
 
     return optax.GradientTransformation(init, update)

@@ -104,12 +104,18 @@ class TrainStep:
         self.clip_norm = clip_norm
         self.clip_value = clip_value
         self.last_grad_norm = None
+        # Pre-clip global grad norm of the last call, forced non-finite when
+        # the in-program health gate skipped the update (loss or grads went
+        # NaN/Inf) — what HealthGuard.check() reads.  Device scalar; floating
+        # it is the caller's sync.
+        self.last_health_norm = None
         self.step_count = 0
         # Python-side dispatch tally (telemetry-independent; the
         # ``pipeline.dispatches`` counter is the observable twin).
         self.dispatch_count = 0
         self._jit = None
         self._introspect_pending = True
+        self._poison_armed = False  # resolved at trace time in _build_jit
 
     # -- program construction -------------------------------------------------
 
@@ -117,11 +123,16 @@ class TrainStep:
         if self._jit is not None:
             return
         from ..optimizer import _update_body
+        from ..resilience import faultinject
 
         model = self.model
         tx_update = self.optimizer.tx.update
         accum = self.accum_steps
         scale = 1.0 / accum
+        # Trace-time fork: only a NaN-fault-armed process carries the poison
+        # scalar in its program signature — production programs are untouched.
+        # Either way the window stays ONE dispatch (the health-smoke proof).
+        poison_armed = self._poison_armed = faultinject.nan_armed()
         # DDP comm-hook parity: the eager path casts each scaled micro-grad
         # to the sync dtype (bf16 under fp16/bf16 hooks) before accumulating
         # (PreparedModel._accumulate); the fused window must do the same or
@@ -144,7 +155,7 @@ class TrainStep:
 
             return jax.value_and_grad(lossf)(params)
 
-        def step(params, opt_state, batches, clip_norm, clip_value):
+        def step(params, opt_state, batches, clip_norm, clip_value, *fault):
             if accum == 1:
                 loss, grads = _loss_and_grads(params, batches[0])
                 # Eager parity: backward() accumulates grads * (1/accum) —
@@ -173,10 +184,19 @@ class TrainStep:
 
                 zeros = jax.tree_util.tree_map(_zeros_like_accum, params)
                 grads, losses = jax.lax.scan(body, zeros, stacked)
-            new_params, new_opt_state, gnorm = _update_body(
-                tx_update, params, opt_state, grads, clip_norm, clip_value
+            if poison_armed:
+                # In-program fault injection: grads *= grad_scale (1.0 or NaN)
+                # rides the existing dispatch instead of adding one.
+                grads = jax.tree_util.tree_map(lambda g: g * fault[0], grads)
+            # Health gate: the update must also zero out when any micro-loss
+            # went non-finite — grads usually follow the loss, but an Inf loss
+            # with (pathologically) finite grads must not slip an update in.
+            losses_ok = jnp.all(jnp.isfinite(jnp.asarray(losses)))
+            new_params, new_opt_state, gnorm, health_norm = _update_body(
+                tx_update, params, opt_state, grads, clip_norm, clip_value,
+                health_ok=losses_ok,
             )
-            return new_params, new_opt_state, losses, gnorm
+            return new_params, new_opt_state, losses, gnorm, health_norm
 
         donate = (0, 1)
         out_shardings = None
@@ -188,7 +208,7 @@ class TrainStep:
                     lambda x: x.sharding if isinstance(x, jax.Array) else None,
                     self.optimizer.opt_state,
                 )
-                out_shardings = (None, opt_sh, None, None)
+                out_shardings = (None, opt_sh, None, None, None)
             else:
                 # CPU smoke path: donating a pinned_host input against a
                 # device-kind output crashes; donate params only.
@@ -263,10 +283,17 @@ class TrainStep:
             jnp.asarray(clip_norm if clip_norm is not None else -1.0, jnp.float32),
             jnp.asarray(clip_value if clip_value is not None else -1.0, jnp.float32),
         )
+        if self._poison_armed:
+            from ..resilience import faultinject
+
+            poison = faultinject.grad_poison_scale(opt._step_count + 1)
+            jit_args = jit_args + (
+                jnp.asarray(1.0 if poison is None else poison, jnp.float32),
+            )
         self._maybe_introspect(jit_args)
         try:
             with _span("pipeline.train_step"):
-                new_params, new_opt_state, losses, gnorm = self._jit(*jit_args)
+                new_params, new_opt_state, losses, gnorm, health_norm = self._jit(*jit_args)
         except Exception as e:
             # Params/opt-state are DONATED: an execution failure (e.g.
             # RESOURCE_EXHAUSTED mid-step) may have consumed the buffers the
@@ -294,6 +321,8 @@ class TrainStep:
         self.model._clear_grads()
         opt.opt_state = new_opt_state
         opt._last_grad_norm = gnorm
+        opt._last_health_norm = health_norm
+        self.last_health_norm = health_norm
         opt._step_was_skipped = False
         opt._step_count += 1
         if opt.torch_optimizer is not None:

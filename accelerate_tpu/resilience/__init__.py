@@ -24,10 +24,16 @@ pieces here make the training loop survive those (see
 - **auto-resume** — ``Accelerator.resume_from_latest(dir)`` restores the
   newest *manifest-complete* checkpoint (skipping torn partials) and returns
   the resumed step.
+- **numerical-health guard** (``health.py``) — NaN/Inf loss+gradient
+  detection *inside* the jitted step (zero-delta ``jnp.where`` gate, no
+  extra dispatch), host-side skip/rewind policy via
+  ``Accelerator.enable_health_guard()`` / ``check_health()``, and bad-batch
+  quarantine with a JSONL audit trail.
 - **fault injection** (``faultinject.py``) — env-driven failure modes (fail
   the Nth checkpoint write, SIGTERM at step K, one synthetic
-  RESOURCE_EXHAUSTED) that ``make resilience-smoke`` uses to prove
-  kill-and-resume gives bit-exact loss continuation.
+  RESOURCE_EXHAUSTED, NaN-poisoned gradients at step K, a NaN-laced batch)
+  that ``make resilience-smoke`` / ``make health-smoke`` use to prove
+  kill-and-resume and skip/rewind give bit-exact loss continuation.
 
 Zero overhead when unused: no signal handlers are installed and no manifest
 hashing runs unless a guard is installed / a checkpoint is saved; hashing is
@@ -46,10 +52,14 @@ from .manifest import (
     verify_checkpoint,
     write_manifest,
 )
+from .health import HealthGuard, HealthVerdict, NumericalDivergenceError
 from .preemption import PreemptionGuard
 from .retry import RetryPolicy, retrying
 
 __all__ = [
+    "HealthGuard",
+    "HealthVerdict",
+    "NumericalDivergenceError",
     "MANIFEST_NAME",
     "ENV_MANIFEST_HASH",
     "CheckpointVerificationError",

@@ -16,6 +16,20 @@ under test can be broken without code changes (``make resilience-smoke`` and
 - ``ACCELERATE_TPU_FAULT_OOM_ONCE=1`` — :func:`maybe_oom` raises one
   synthetic ``RESOURCE_EXHAUSTED`` RuntimeError, then goes quiet (drives
   ``find_executable_batch_size``'s halving path).
+- ``ACCELERATE_TPU_FAULT_NAN_STEP=<k>`` — poison the gradients of optimizer
+  step ``k`` (1-based) with NaN; ``ACCELERATE_TPU_FAULT_NAN_COUNT=<n>``
+  extends that to ``n`` consecutive steps (``k .. k+n-1``, default 1).
+  Each armed step fires ONCE — after a health-guard rewind the replayed
+  steps run clean, which is exactly what the rewind-then-bit-exact smoke
+  needs.  Eager updates multiply the gradient tree host-side; the fused
+  :func:`make_train_step` program folds the poison in as a traced scalar so
+  the 1-dispatch-per-step invariant holds even while injecting
+  (``make health-smoke`` proves this).
+- ``ACCELERATE_TPU_FAULT_BAD_BATCH=<i>`` — every epoch, the dataloader
+  laces batch index ``i`` (0-based, user-visible position) with NaN in all
+  floating-point tensors.  Unlike ``NAN_STEP`` this is a property of the
+  *data*, so it re-fires on every replay — the trigger for the health
+  guard's bad-batch quarantine.
 
 Zero overhead when unarmed: the env is read once, and every hook is a single
 ``if`` on a cached None.
@@ -39,12 +53,19 @@ __all__ = [
     "tick",
     "maybe_oom",
     "reload",
+    "nan_armed",
+    "grad_poison_scale",
+    "bad_batch_index",
+    "maybe_poison_batch",
 ]
 
 ENV_WRITE_N = "ACCELERATE_TPU_FAULT_WRITE_N"
 ENV_WRITE_STICKY = "ACCELERATE_TPU_FAULT_WRITE_STICKY"
 ENV_SIGTERM_STEP = "ACCELERATE_TPU_FAULT_SIGTERM_STEP"
 ENV_OOM_ONCE = "ACCELERATE_TPU_FAULT_OOM_ONCE"
+ENV_NAN_STEP = "ACCELERATE_TPU_FAULT_NAN_STEP"
+ENV_NAN_COUNT = "ACCELERATE_TPU_FAULT_NAN_COUNT"
+ENV_BAD_BATCH = "ACCELERATE_TPU_FAULT_BAD_BATCH"
 
 
 class InjectedWriteError(OSError):
@@ -52,7 +73,10 @@ class InjectedWriteError(OSError):
 
 
 class _Config:
-    __slots__ = ("write_n", "write_sticky", "sigterm_step", "oom_once")
+    __slots__ = (
+        "write_n", "write_sticky", "sigterm_step", "oom_once",
+        "nan_step", "nan_count", "bad_batch",
+    )
 
     def __init__(self):
         def _int(key) -> Optional[int]:
@@ -67,10 +91,19 @@ class _Config:
         self.oom_once = os.environ.get(ENV_OOM_ONCE, "").strip().lower() in (
             "1", "true", "yes", "on",
         )
+        self.nan_step = _int(ENV_NAN_STEP)
+        self.nan_count = _int(ENV_NAN_COUNT) or 1
+        self.bad_batch = _int(ENV_BAD_BATCH)
 
     @property
     def any_armed(self) -> bool:
-        return self.write_n is not None or self.sigterm_step is not None or self.oom_once
+        return (
+            self.write_n is not None
+            or self.sigterm_step is not None
+            or self.oom_once
+            or self.nan_step is not None
+            or self.bad_batch is not None
+        )
 
 
 _cfg: Optional[_Config] = None
@@ -78,6 +111,7 @@ _lock = threading.Lock()
 _write_count = 0
 _sigterm_fired = False
 _oom_fired = False
+_nan_fired: set = set()
 
 
 def _config() -> _Config:
@@ -88,7 +122,9 @@ def _config() -> _Config:
             logger.warning(
                 "fault injection ARMED: "
                 f"write_n={_cfg.write_n} sticky={_cfg.write_sticky} "
-                f"sigterm_step={_cfg.sigterm_step} oom_once={_cfg.oom_once}"
+                f"sigterm_step={_cfg.sigterm_step} oom_once={_cfg.oom_once} "
+                f"nan_step={_cfg.nan_step} nan_count={_cfg.nan_count} "
+                f"bad_batch={_cfg.bad_batch}"
             )
     return _cfg
 
@@ -101,6 +137,7 @@ def reload() -> None:
         _write_count = 0
         _sigterm_fired = False
         _oom_fired = False
+        _nan_fired.clear()
 
 
 def armed() -> bool:
@@ -154,4 +191,58 @@ def maybe_oom() -> None:
     raise RuntimeError(
         "RESOURCE_EXHAUSTED: injected out-of-memory (fault injection "
         f"{ENV_OOM_ONCE}=1; fires once)"
+    )
+
+
+def nan_armed() -> bool:
+    """True when NaN-gradient injection is configured (the fused train step
+    checks this ONCE at trace time so the unarmed program carries no poison
+    plumbing at all)."""
+    return _config().nan_step is not None
+
+
+def grad_poison_scale(step: int) -> Optional[float]:
+    """``float('nan')`` when optimizer step ``step`` (1-based) falls in the
+    armed ``[nan_step, nan_step + nan_count)`` window and has not fired yet,
+    else None.  Fires once per armed step: post-rewind replays of the same
+    step numbers run clean."""
+    cfg = _config()
+    if cfg.nan_step is None:
+        return None
+    if not (cfg.nan_step <= step < cfg.nan_step + cfg.nan_count):
+        return None
+    with _lock:
+        if step in _nan_fired:
+            return None
+        _nan_fired.add(step)
+    logger.warning(f"fault injection: poisoning gradients of step {step} with NaN")
+    return float("nan")
+
+
+def bad_batch_index() -> Optional[int]:
+    """The armed per-epoch batch index for NaN-laced batches, or None."""
+    return _config().bad_batch
+
+
+def maybe_poison_batch(batch, index: int):
+    """Return ``batch`` with every floating-point tensor multiplied by NaN
+    when ``index`` is the armed bad-batch position (fires every epoch — a bad
+    batch stays bad on replay, unlike the fire-once step poison)."""
+    cfg = _config()
+    if cfg.bad_batch is None or index != cfg.bad_batch:
+        return batch
+    import jax.tree_util
+
+    nan = float("nan")
+
+    def _is_floating(x):
+        dtype = getattr(x, "dtype", None)
+        if dtype is None:
+            return False
+        name = str(dtype)
+        return "float" in name or "bfloat" in name
+
+    logger.warning(f"fault injection: NaN-lacing batch index {index}")
+    return jax.tree_util.tree_map(
+        lambda x: x * nan if _is_floating(x) else x, batch
     )

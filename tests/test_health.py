@@ -1,0 +1,541 @@
+"""Numerical-health guard (``accelerate_tpu/resilience/health.py``): in-step
+NaN/Inf detection, zero-delta skip, rewind-to-checkpoint policy, bad-batch
+quarantine, and the fault-injection knobs that drive ``make health-smoke``.
+
+The clip-then-guard interplay tests are the load-bearing ones: the guard's
+verdict must come from the PRE-clip global gradient norm — a value clip maps
+an Inf gradient into a finite one, so judging after the clip would let a
+poisoned update through looking healthy.
+"""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+import torch
+from torch.utils.data import DataLoader
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from accelerate_tpu import Accelerator, telemetry
+from accelerate_tpu.optimizer import _update_body
+from accelerate_tpu.resilience import (
+    HealthGuard,
+    HealthVerdict,
+    NumericalDivergenceError,
+    faultinject,
+)
+from accelerate_tpu.test_utils import RegressionDataset, RegressionModelWithLoss
+from accelerate_tpu.test_utils.training import regression_collate
+from accelerate_tpu.utils import DataLoaderConfiguration, ProjectConfiguration, set_seed
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    """Disarm the fault injector and leave the telemetry singleton pristine
+    (same contract as the test_resilience fixture)."""
+    faultinject.reload()
+    yield
+    faultinject.reload()
+    telemetry.disable()
+    telemetry.get_telemetry().registry.reset()
+
+
+def _reset_singletons():
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+
+def _build_training(tmp_path=None, accum=1, length=32, batch_size=1, stateful=True):
+    """Under conftest's 8-device mesh the loader re-batches globally
+    (total_batch_size = batch_size x 8), so batch_size=1 + length=32 yields
+    4 global batches per epoch."""
+    _reset_singletons()
+    set_seed(1234)
+    kwargs = {}
+    if tmp_path is not None:
+        kwargs["project_config"] = ProjectConfiguration(project_dir=str(tmp_path))
+    accelerator = Accelerator(
+        gradient_accumulation_steps=accum,
+        dataloader_config=DataLoaderConfiguration(use_stateful_dataloader=stateful),
+        **kwargs,
+    )
+    model = RegressionModelWithLoss()
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    dl = DataLoader(
+        list(RegressionDataset(length=length)),
+        batch_size=batch_size,
+        collate_fn=regression_collate,
+    )
+    model, opt, dl = accelerator.prepare(model, opt, dl)
+    return accelerator, model, opt, dl
+
+
+def _flat(tree):
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(tree)]
+
+
+def _trees_identical(a, b):
+    return all(
+        np.array_equal(x, y, equal_nan=True) for x, y in zip(_flat(a), _flat(b))
+    )
+
+
+# ---------------------------------------------------------------------------
+# _update_body: the in-program gate (unit, eager trace)
+# ---------------------------------------------------------------------------
+
+
+def _toy_update(grads, clip_norm=-1.0, clip_value=-1.0, health_ok=None):
+    params = {"w": jnp.arange(4.0), "b": jnp.ones(())}
+    tx = optax.adam(0.1)
+    opt_state = tx.init(params)
+    new_params, new_opt_state, gnorm, health_norm = _update_body(
+        tx.update,
+        params,
+        opt_state,
+        grads,
+        jnp.asarray(clip_norm, jnp.float32),
+        jnp.asarray(clip_value, jnp.float32),
+        health_ok=health_ok,
+    )
+    return params, opt_state, new_params, new_opt_state, gnorm, health_norm
+
+
+def test_finite_grads_update_and_finite_health_norm():
+    grads = {"w": jnp.full((4,), 0.5), "b": jnp.asarray(0.5)}
+    params, opt_state, new_params, new_opt_state, _, health_norm = _toy_update(grads)
+    assert math.isfinite(float(health_norm))
+    assert not _trees_identical(new_params, params)
+    # optax count advanced: the update really applied.
+    assert int(jax.tree_util.tree_leaves(new_opt_state)[0]) != int(
+        jax.tree_util.tree_leaves(opt_state)[0]
+    ) or not _trees_identical(new_opt_state, opt_state)
+
+
+@pytest.mark.parametrize("poison", [float("nan"), float("inf"), float("-inf")])
+def test_nonfinite_grads_gate_params_and_opt_state_to_zero_delta(poison):
+    grads = {"w": jnp.full((4,), 0.5).at[2].set(poison), "b": jnp.asarray(0.5)}
+    params, opt_state, new_params, new_opt_state, _, health_norm = _toy_update(grads)
+    assert not math.isfinite(float(health_norm))
+    assert _trees_identical(new_params, params)
+    assert _trees_identical(new_opt_state, opt_state)  # count included
+
+
+def test_value_clip_must_not_mask_inf_into_a_finite_update():
+    """The clip-then-guard interplay: clip(Inf, -1, 1) == 1 is finite, so a
+    post-clip verdict would wave the poisoned step through.  The guard judges
+    the PRE-clip norm and must still gate."""
+    grads = {"w": jnp.full((4,), 0.5).at[0].set(jnp.inf), "b": jnp.asarray(0.5)}
+    params, opt_state, new_params, new_opt_state, gnorm, health_norm = _toy_update(
+        grads, clip_value=1.0
+    )
+    # The clip itself produced a finite post-clip norm...
+    assert math.isfinite(float(gnorm))
+    # ...but the health verdict saw the pre-clip Inf and gated the update.
+    assert float(health_norm) == float("inf")
+    assert _trees_identical(new_params, params)
+    assert _trees_identical(new_opt_state, opt_state)
+
+
+def test_norm_clip_with_nonfinite_grads_still_gates():
+    grads = {"w": jnp.full((4,), jnp.nan), "b": jnp.asarray(0.5)}
+    params, opt_state, new_params, new_opt_state, _, health_norm = _toy_update(
+        grads, clip_norm=1.0
+    )
+    assert math.isnan(float(health_norm))
+    assert _trees_identical(new_params, params)
+    assert _trees_identical(new_opt_state, opt_state)
+
+
+def test_health_ok_flag_vetoes_an_otherwise_finite_update():
+    """The fused step folds micro-loss finiteness into the gate: finite grads
+    with a non-finite loss must still apply a zero delta, and the returned
+    health norm goes non-finite so the host can see the skip."""
+    grads = {"w": jnp.full((4,), 0.5), "b": jnp.asarray(0.5)}
+    params, opt_state, new_params, new_opt_state, _, health_norm = _toy_update(
+        grads, health_ok=jnp.asarray(False)
+    )
+    assert not math.isfinite(float(health_norm))
+    assert _trees_identical(new_params, params)
+    assert _trees_identical(new_opt_state, opt_state)
+
+
+# ---------------------------------------------------------------------------
+# HealthGuard policy (host side, stubs)
+# ---------------------------------------------------------------------------
+
+
+class _StubOptimizer:
+    def __init__(self):
+        self._last_health_norm = 1.0
+        self._step_was_skipped = False
+        self.learning_rate = 0.1
+        self.lr_history = []
+
+    def set_learning_rate(self, lr):
+        self.learning_rate = lr
+        self.lr_history.append(lr)
+
+
+class _StubAccelerator:
+    def __init__(self, resume_step=2):
+        self.resume_step = resume_step
+        self.resume_calls = 0
+
+    def resume_from_latest(self, checkpoint_dir=None):
+        self.resume_calls += 1
+        return self.resume_step
+
+
+class _StubLoader:
+    def __init__(self):
+        self.iteration = 0
+        self._yielded = 0
+        self.pushed = []
+
+    def quarantine(self, fingerprints):
+        self.pushed.append(set(fingerprints))
+
+
+def _stub_guard(**kw):
+    acc = _StubAccelerator()
+    opt = _StubOptimizer()
+    dl = _StubLoader()
+    guard = HealthGuard(acc, optimizer=opt, dataloader=dl, **kw)
+    return guard, acc, opt, dl
+
+
+def test_healthy_step_resets_the_skip_streak():
+    guard, _, opt, _ = _stub_guard(max_skips=2)
+    opt._last_health_norm = float("nan")
+    assert guard.check(step=1).skipped
+    assert guard.check(step=2).skipped
+    opt._last_health_norm = 3.0
+    verdict = guard.check(step=3)
+    assert not verdict.anomalous and verdict.grad_norm == 3.0
+    assert guard.consecutive_anomalies == 0
+    # The streak restarted: two more skips fit before a rewind.
+    opt._last_health_norm = float("inf")
+    assert guard.check(step=4).skipped
+    assert guard.check(step=5).skipped
+
+
+def test_skip_budget_exhaustion_rewinds_and_marks_step_skipped():
+    guard, acc, opt, _ = _stub_guard(max_skips=1)
+    opt._last_health_norm = float("nan")
+    assert guard.check(step=1).skipped
+    verdict = guard.check(step=2)
+    assert verdict.rewound and verdict.resumed_step == 2
+    assert acc.resume_calls == 1
+    assert opt._step_was_skipped  # step_was_skipped parity flag
+    # One healthy streak later the guard can rewind again (budget is 2).
+    opt._last_health_norm = 1.0
+    guard.check(step=3)
+    opt._last_health_norm = float("nan")
+    guard.check(step=4)
+    assert guard.check(step=5).rewound
+    # Third rewind exceeds max_rewinds=2.
+    opt._last_health_norm = 1.0
+    guard.check(step=6)
+    opt._last_health_norm = float("nan")
+    guard.check(step=7)
+    with pytest.raises(NumericalDivergenceError):
+        guard.check(step=8)
+
+
+def test_rewind_with_no_checkpoint_raises():
+    guard, acc, opt, _ = _stub_guard(max_skips=0)
+    acc.resume_step = None
+    opt._last_health_norm = float("nan")
+    with pytest.raises(NumericalDivergenceError, match="no manifest-complete"):
+        guard.check(step=1)
+
+
+def test_lr_backoff_applied_on_rewind():
+    guard, _, opt, _ = _stub_guard(max_skips=0, lr_backoff=0.5)
+    opt._last_health_norm = float("nan")
+    verdict = guard.check(step=1)
+    assert verdict.rewound
+    assert opt.lr_history == [pytest.approx(0.05)]
+
+
+def test_eager_loss_finiteness_judged_host_side():
+    """The eager path has no fused loss gate; check(loss=) folds the host
+    value in so an Inf loss with a finite grad norm still counts."""
+    guard, _, opt, _ = _stub_guard()
+    opt._last_health_norm = 1.0
+    verdict = guard.check(step=1, loss=float("inf"))
+    assert verdict.anomalous and verdict.skipped
+
+
+def test_no_guard_check_health_is_a_healthy_noop():
+    _reset_singletons()
+    acc = Accelerator()
+    verdict = acc.check_health(step=1)
+    assert isinstance(verdict, HealthVerdict)
+    assert not verdict.anomalous and not bool(verdict)
+
+
+def test_quarantine_fingerprints_after_repeat_offense(tmp_path):
+    qlog = str(tmp_path / "quarantine.jsonl")
+    guard, _, opt, dl = _stub_guard(max_skips=5, quarantine_after=2, quarantine_log=qlog)
+    opt._last_health_norm = float("nan")
+    dl._yielded = 1  # step consumed batch (0, 0)
+    v1 = guard.check(step=1)
+    assert v1.skipped and v1.quarantined == ()  # first offense: not yet
+    # Replay of the same position breaks again -> quarantined.
+    guard._pos_mark = (0, 0)
+    dl._yielded = 1
+    v2 = guard.check(step=1)
+    assert v2.quarantined == ((0, 0),)
+    assert dl.pushed and (0, 0) in dl.pushed[-1]
+    records = [json.loads(line) for line in open(qlog)]
+    assert records[0]["epoch"] == 0 and records[0]["batch_index"] == 0
+    assert records[0]["nonfinite_count"] == 2
+
+
+def test_accumulation_window_fingerprints_every_consumed_batch():
+    guard, _, opt, dl = _stub_guard(max_skips=5, quarantine_after=1)
+    opt._last_health_norm = float("nan")
+    dl._yielded = 4  # accum window of 4 micro-batches
+    verdict = guard.check(step=1)
+    assert verdict.quarantined == ((0, 0), (0, 1), (0, 2), (0, 3))
+
+
+def test_telemetry_counters_and_gauge(tmp_path):
+    tel = telemetry.enable(dir=str(tmp_path / "tel"))
+    guard, _, opt, _ = _stub_guard(max_skips=1)
+    opt._last_health_norm = 2.5
+    guard.check(step=1)
+    assert tel.registry.gauge("health.last_grad_norm").value == 2.5
+    opt._last_health_norm = float("nan")
+    guard.check(step=2)
+    guard.check(step=3)  # rewind
+    assert tel.registry.counter("health.nonfinite_grads").value == 2
+    assert tel.registry.counter("health.skipped_steps").value == 1
+    assert tel.registry.counter("health.rewinds").value == 1
+
+
+def test_guard_constructor_validates_budgets():
+    acc = _StubAccelerator()
+    with pytest.raises(ValueError):
+        HealthGuard(acc, max_skips=-1)
+    with pytest.raises(ValueError):
+        HealthGuard(acc, max_rewinds=-1)
+    with pytest.raises(ValueError):
+        HealthGuard(acc, quarantine_after=0)
+
+
+# ---------------------------------------------------------------------------
+# Fused train step x fault injection x clip: the end-to-end gate
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("accum", [1, 4])
+@pytest.mark.parametrize("clip", [(None, None), (1.0, None), (None, 0.5)])
+def test_fused_step_skips_poisoned_step_under_clip(monkeypatch, accum, clip, tmp_path):
+    """NaN-poisoned grads at step 2 of 4: the fused program applies a zero
+    delta (params bit-identical) whatever clip arms are set, the next clean
+    step moves params again, and the window stays ONE dispatch with the
+    injector armed and the guard enabled."""
+    clip_norm, clip_value = clip
+    monkeypatch.setenv("ACCELERATE_TPU_FAULT_NAN_STEP", "2")
+    faultinject.reload()
+    tel = telemetry.enable(dir=str(tmp_path / "tel"))
+    accelerator, model, opt, dl = _build_training(accum=accum, length=32 * accum)
+    guard = accelerator.enable_health_guard(max_skips=3)
+    step_fn = accelerator.make_train_step(
+        model, opt, clip_norm=clip_norm, clip_value=clip_value
+    )
+    dispatches = tel.registry.counter("pipeline.dispatches")
+
+    digests, skipped, window, steps = [], [], [], 0
+    digests.append(_flat(model.params))
+    for batch in dl:
+        window.append(batch)
+        if len(window) < accum:
+            continue
+        step_fn(window if accum > 1 else window[0])
+        window = []
+        steps += 1
+        verdict = accelerator.check_health(step=steps)
+        assert not verdict.rewound
+        if verdict.skipped:
+            skipped.append(steps)
+        digests.append(_flat(model.params))
+    assert steps == 4 and skipped == [2]
+    p = digests
+    assert all(np.array_equal(a, b) for a, b in zip(p[1], p[2]))  # skip: frozen
+    assert not all(np.array_equal(a, b) for a, b in zip(p[2], p[3]))  # clean: moves
+    assert dispatches.value == steps  # 1 dispatch/step, guard + injector on
+    assert guard.consecutive_anomalies == 0  # healthy steps reset the streak
+
+
+def test_eager_path_skips_poisoned_step(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_TPU_FAULT_NAN_STEP", "2")
+    faultinject.reload()
+    accelerator, model, opt, dl = _build_training()
+    accelerator.enable_health_guard(max_skips=3)
+    digests, skipped = [_flat(model.params)], []
+    for i, batch in enumerate(dl, start=1):
+        out = model(x=batch["x"], y=batch["y"])
+        accelerator.backward(out.loss)
+        opt.step()
+        opt.zero_grad()
+        if accelerator.check_health(step=i, loss=out.loss).skipped:
+            skipped.append(i)
+        digests.append(_flat(model.params))
+        if i == 3:
+            break
+    assert skipped == [2]
+    assert all(np.array_equal(a, b) for a, b in zip(digests[1], digests[2]))
+    assert not all(np.array_equal(a, b) for a, b in zip(digests[2], digests[3]))
+
+
+def test_rewind_to_checkpoint_and_bit_exact_replay(monkeypatch, tmp_path):
+    """3 consecutive NaN steps with max_skips=2 -> rewind to the step-2
+    checkpoint; the fire-once injector leaves the replay clean, and the
+    replayed trajectory matches an uninjected run bit-exactly."""
+
+    def run(inject: bool):
+        if inject:
+            monkeypatch.setenv("ACCELERATE_TPU_FAULT_NAN_STEP", "4")
+            monkeypatch.setenv("ACCELERATE_TPU_FAULT_NAN_COUNT", "3")
+        else:
+            monkeypatch.delenv("ACCELERATE_TPU_FAULT_NAN_STEP", raising=False)
+            monkeypatch.delenv("ACCELERATE_TPU_FAULT_NAN_COUNT", raising=False)
+        faultinject.reload()
+        root = str(tmp_path / ("inj" if inject else "clean"))
+        accelerator, model, opt, dl = _build_training(tmp_path=root)
+        accelerator.enable_health_guard(max_skips=2, max_rewinds=1, checkpoint_dir=root)
+        step_fn = accelerator.make_train_step(model, opt)
+        losses, rewound_at, step = {}, None, 0
+        while step < 8:
+            restart = False
+            for batch in dl:
+                loss = step_fn(batch)
+                verdict = accelerator.check_health(step=step + 1)
+                if verdict.rewound:
+                    rewound_at = step + 1
+                    losses = {s: v for s, v in losses.items() if s <= verdict.resumed_step}
+                    step = verdict.resumed_step
+                    restart = True
+                    break
+                step += 1
+                losses[step] = float(np.asarray(loss))
+                if step == 2 and rewound_at is None:
+                    accelerator.save_state(os.path.join(root, "step_2"), step=2)
+                if step >= 8:
+                    break
+            if restart:
+                continue
+        return losses, rewound_at
+
+    injected, rewound_at = run(inject=True)
+    assert rewound_at == 6  # steps 4,5 skipped, third anomaly rewinds
+    clean, no_rewind = run(inject=False)
+    assert no_rewind is None
+    for s in range(3, 9):
+        assert injected[s] == clean[s], f"replay diverged from clean run at step {s}"
+
+
+# ---------------------------------------------------------------------------
+# Dataloader quarantine replay-skip
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("stateful", [False, True])
+def test_loader_quarantine_skips_at_yield_time(stateful, tmp_path):
+    tel = telemetry.enable(dir=str(tmp_path / "tel"))
+    _, _, _, dl = _build_training(length=32, stateful=stateful)
+    dl.quarantine([(0, 1)])
+    first_epoch = [np.asarray(b["x"])[0, 0] for b in dl]
+    assert len(first_epoch) == 3  # batch 1 consumed, never yielded
+    assert tel.registry.counter("health.quarantine_skips").value == 1
+    # The fingerprint is epoch-scoped: epoch 1 yields all four batches.
+    second_epoch = [np.asarray(b["x"])[0, 0] for b in dl]
+    assert len(second_epoch) == 4
+
+
+def test_loader_quarantine_applies_on_stateful_replay(tmp_path):
+    """The rewind scenario: restore the loader mid-epoch state, quarantine a
+    later position, and the replay drops exactly that batch."""
+    _, _, _, dl = _build_training(length=32, stateful=True)
+    it = iter(dl)
+    next(it)  # consume batch 0
+    state = dl.state_dict()
+    for _ in it:
+        pass
+    dl.load_state_dict(state)
+    dl.quarantine([(0, 2)])
+    replay = list(dl)
+    assert len(replay) == 2  # positions 1 and 3; 2 is quarantined
+
+
+# ---------------------------------------------------------------------------
+# Fault-injection knobs
+# ---------------------------------------------------------------------------
+
+
+def test_grad_poison_scale_fires_once_per_armed_step(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_TPU_FAULT_NAN_STEP", "3")
+    monkeypatch.setenv("ACCELERATE_TPU_FAULT_NAN_COUNT", "2")
+    faultinject.reload()
+    assert faultinject.nan_armed()
+    assert faultinject.grad_poison_scale(2) is None
+    assert math.isnan(faultinject.grad_poison_scale(3))
+    assert faultinject.grad_poison_scale(3) is None  # fire-once: replays run clean
+    assert math.isnan(faultinject.grad_poison_scale(4))
+    assert faultinject.grad_poison_scale(5) is None
+
+
+def test_bad_batch_poison_refires_and_spares_integers(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_TPU_FAULT_BAD_BATCH", "1")
+    faultinject.reload()
+    assert faultinject.bad_batch_index() == 1
+    batch = {"x": jnp.ones((2, 2)), "ids": jnp.arange(2, dtype=jnp.int32)}
+    poisoned = faultinject.maybe_poison_batch(batch, 1)
+    assert bool(jnp.isnan(poisoned["x"]).all())
+    assert np.array_equal(np.asarray(poisoned["ids"]), [0, 1])
+    # Unlike NAN_STEP the data stays bad: a second pass poisons again.
+    again = faultinject.maybe_poison_batch(batch, 1)
+    assert bool(jnp.isnan(again["x"]).all())
+    # Other positions untouched.
+    clean = faultinject.maybe_poison_batch(batch, 0)
+    assert not bool(jnp.isnan(clean["x"]).any())
+
+
+def test_bad_batch_through_loader_then_guard_quarantines(monkeypatch, tmp_path):
+    """End to end: a NaN-laced batch makes the step anomalous; after the
+    second offense the guard quarantines the fingerprint and the loader's
+    next pass over that position skips it."""
+    monkeypatch.setenv("ACCELERATE_TPU_FAULT_BAD_BATCH", "1")
+    faultinject.reload()
+    accelerator, model, opt, dl = _build_training(length=32)
+    guard = accelerator.enable_health_guard(max_skips=8, quarantine_after=2)
+    step_fn = accelerator.make_train_step(model, opt)
+    anomalies = []
+    for i, batch in enumerate(dl):
+        step_fn(batch)
+        if accelerator.check_health(step=i + 1).anomalous:
+            anomalies.append(i)
+    assert anomalies == [1]
+    assert guard._nonfinite_counts == {(0, 1): 1}
+    # Simulate the post-rewind replay of the same epoch going bad again.
+    dl.iteration = 0
+    guard._pos_mark = (0, 1)
+    dl._yielded = 2
+    opt._last_health_norm = float("nan")
+    verdict = guard.check(step=2)
+    assert verdict.quarantined == ((0, 1),)
+    dl._yielded = 0
+    replayed = list(dl)
+    assert len(replayed) == 3  # quarantined position dropped on the replay
