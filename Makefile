@@ -1,10 +1,10 @@
 # Test-suite splits mirroring the reference Makefile:25-77.
 
-.PHONY: test test-quick test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels bench telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke
+.PHONY: test test-quick test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels bench telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke perf-gate
 
 PYTEST = python -m pytest -q
 
-test: test-quick telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke
+test: test-quick telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke perf-gate
 	$(PYTEST) tests/
 
 # <5 min tier (VERDICT r5 item 6): oracles, state, sharding-spec/mesh,
@@ -46,6 +46,22 @@ pipeline-smoke:
 # (docs/usage_guides/resilience.md).
 health-smoke:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.resilience.health_smoke
+
+# Black-box proof: SIGTERMs a flight-recorder-enabled CPU training run
+# mid-step, asserts the crash-safe JSONL snapshot on disk carries the final
+# step's events + the signal, that the chained PreemptionGuard still wrote
+# its manifest-complete checkpoint, and that telemetry.report renders a
+# postmortem from the snapshot (docs/package_reference/flightrec.md).
+flightrec-smoke:
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.telemetry.flightrec_smoke
+
+# CPU-tier perf-regression gate: eager-vs-fused probe judged against the
+# committed baseline (benchmarks/perf_baseline_cpu.json) — dispatches/step
+# must stay 1 on the fused path, the fused-vs-eager steps/s ratio above its
+# floor, host-blocked ms under its ceiling.  Also run inside tier-1 by
+# tests/test_perf_gate.py (docs/usage_guides/performance.md).
+perf-gate:
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.pipeline.perf_gate
 
 # Everything except big-modeling / engine dialects / CLI / examples.
 test_core:

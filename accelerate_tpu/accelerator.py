@@ -1793,6 +1793,17 @@ class Accelerator:
         )
         return self._health_guard
 
+    def enable_flight_recorder(self, dir: Optional[str] = None, capacity: Optional[int] = None, flush_every: Optional[int] = None):
+        """Enable the black-box flight recorder: a bounded ring of per-step
+        events (step time, dispatches, compiles, health verdicts, checkpoint
+        publishes, preemption signals) flushed to a crash-safe JSONL snapshot
+        periodically and on SIGTERM/exit/unhandled-exception, with online
+        anomaly detection (``telemetry/flightrec.py``).  Env-only runs get
+        the same via ``ACCELERATE_TPU_FLIGHTREC=1``.  Returns the recorder."""
+        from .telemetry import flightrec
+
+        return flightrec.enable(dir=dir, capacity=capacity, flush_every=flush_every)
+
     def check_health(self, step: Optional[int] = None, loss=None):
         """Judge the optimizer step that just completed (call right after
         ``optimizer.step()`` or the fused ``step_fn(batch)``).  Returns a

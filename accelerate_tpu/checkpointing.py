@@ -704,6 +704,16 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, **save
                     accelerator.wait_for_everyone()
                 if is_writer:
                     _io_policy("checkpoint.publish").call(_publish_io)
+                    from .telemetry import get_telemetry
+
+                    tel = get_telemetry()
+                    if tel.enabled:
+                        # event() mirrors into the flight recorder: the
+                        # postmortem of a killed run shows exactly which
+                        # checkpoints made it to a published, verified state.
+                        tel.event(
+                            "checkpoint.publish", step=step, path=final_dir
+                        )
                     cfg = accelerator.project_configuration
                     if cfg.automatic_checkpoint_naming and cfg.total_limit is not None:
                         from .resilience.manifest import prune_checkpoints

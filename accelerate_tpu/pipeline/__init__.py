@@ -16,7 +16,11 @@ Two coupled pieces (see ``docs/usage_guides/performance.md``):
 
 Plus the **persistent XLA compilation cache** (``compile_cache.py``),
 default-on via ``ACCELERATE_TPU_COMPILE_CACHE`` so repeated runs skip the
-multi-minute warmup compile entirely.
+multi-minute warmup compile entirely, and the **CPU-tier perf-regression
+gate** (``perf_gate.py``, ``make perf-gate``) that asserts the fused-path
+invariants — 1 dispatch/step, the fused-vs-eager speedup, bounded
+host-blocked time — against a committed baseline inside tier-1, so the
+wins above cannot silently rot while the TPU backend is unreachable.
 """
 
 from .compile_cache import (
@@ -34,6 +38,11 @@ from .prefetch import (
     sharding_cache_info,
 )
 from .train_step import TrainStep, make_train_step
+
+# perf_gate is intentionally NOT imported here: it pulls in torch/numpy probe
+# machinery that the hot-path import of accelerate_tpu.pipeline must not pay
+# for.  Use `python -m accelerate_tpu.pipeline.perf_gate` or import it
+# directly (accelerate_tpu.pipeline.perf_gate).
 
 __all__ = [
     "DevicePrefetcher",

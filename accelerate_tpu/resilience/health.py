@@ -251,6 +251,15 @@ class HealthGuard:
             verdict.skipped = True
             if tel.enabled:
                 tel.registry.counter("health.skipped_steps").inc()
+                # Narrate the skip through event() (the rewind branch already
+                # does): the flight recorder mirrors events, so a postmortem
+                # of a died run shows which steps the zero-delta gate absorbed.
+                tel.event(
+                    "health.skip",
+                    step=step,
+                    grad_norm=repr(norm),
+                    streak=self.consecutive_anomalies,
+                )
             logger.warning(
                 f"health: non-finite step (grad norm {norm!r}, loss {loss_value!r}) "
                 f"— zero delta applied, skip {self.consecutive_anomalies}/{self.max_skips}"

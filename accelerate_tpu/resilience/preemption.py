@@ -38,9 +38,12 @@ class PreemptionGuard:
 
     The handler is async-signal-minimal: it records the signal, notes it in
     telemetry, and invokes any registered raw callbacks (bench uses this to
-    share the guard with its emergency-JSON path).  A SECOND delivery of the
-    same signal restores the default disposition and re-raises it, so an
-    operator can still hard-kill a run stuck in its final checkpoint.
+    share the guard with its emergency-JSON path).  It then CHAINS to the
+    Python handler that was installed before it (the flight recorder's
+    flush-on-signal, a user's own hook) — installing the guard composes with,
+    never replaces, existing handlers.  A SECOND delivery of the same signal
+    restores the default disposition and re-raises it, so an operator can
+    still hard-kill a run stuck in its final checkpoint.
     """
 
     def __init__(
@@ -62,6 +65,7 @@ class PreemptionGuard:
         self._agreed = False
         self._installed = False
         self._prev_handlers: dict[int, object] = {}
+        self._in_signal: dict[int, bool] = {}
         self._flag = False
         self._signum: Optional[int] = None
         self._callbacks: list[Callable[[int], None]] = []
@@ -73,23 +77,59 @@ class PreemptionGuard:
     # -- signal plumbing -----------------------------------------------------
 
     def _handler(self, signum, frame):
+        if not self._installed:
+            # Uninstalled, but still referenced by an OUTER handler's chain
+            # (non-LIFO teardown): a dead guard must not act — no flags, no
+            # callbacks, and above all no second-delivery kill — but the rest
+            # of the chain behind it must keep firing; and if the dead guard
+            # ended up the registered handler over the default disposition, it
+            # must re-raise rather than swallow the kill.
+            prev = self._prev_handlers.get(signum)
+            if callable(prev):
+                prev(signum, frame)
+            elif prev == signal.SIG_DFL and signal.getsignal(signum) == self._handler:
+                signal.signal(signum, signal.SIG_DFL)
+                os.kill(os.getpid(), signum)
+            return
+        if self._in_signal.get(signum):
+            # Re-entered through a handler CYCLE (this guard chained to a
+            # handler that chains back): this delivery is already being
+            # processed — it is NOT a second, operator-sent kill.
+            return
         if self._flag and self._signum == signum:
-            # Second delivery: get out of the way of a determined kill.
+            # Second delivery: get out of the way of a determined kill.  This
+            # replaces the outermost registration (ours or a handler chained
+            # over us) — the process is dying; preserving the chain is moot.
             signal.signal(signum, signal.SIG_DFL)
             os.kill(os.getpid(), signum)
             return
-        # Async-signal-minimal: set flags ONLY.  Telemetry here would acquire
-        # non-reentrant locks (Telemetry._lock / MetricsRegistry._lock) that
-        # the interrupted main thread may already hold — a deadlock inside the
-        # handler at exactly the moment the guard exists for.  The signal is
-        # recorded into telemetry at the next should_stop() call instead.
-        self._flag = True
-        self._signum = signum
-        for cb in self._callbacks:
-            try:
-                cb(signum)
-            except Exception:
-                logger.exception("PreemptionGuard callback failed")
+        self._in_signal[signum] = True
+        try:
+            # Async-signal-minimal: set flags ONLY.  Telemetry here would acquire
+            # non-reentrant locks (Telemetry._lock / MetricsRegistry._lock) that
+            # the interrupted main thread may already hold — a deadlock inside the
+            # handler at exactly the moment the guard exists for.  The signal is
+            # recorded into telemetry at the next should_stop() call instead.
+            self._flag = True
+            self._signum = signum
+            for cb in self._callbacks:
+                try:
+                    cb(signum)
+                except Exception:
+                    logger.exception("PreemptionGuard callback failed")
+            # Chain to whatever Python handler was installed before this guard
+            # (e.g. the flight recorder's flush-on-signal) instead of silently
+            # replacing it — both must fire regardless of install order.  SIG_DFL
+            # is NOT chained: intercepting the default die-on-signal disposition
+            # is the guard's entire purpose.
+            prev = self._prev_handlers.get(signum)
+            if callable(prev):
+                try:
+                    prev(signum, frame)
+                except Exception:
+                    logger.exception("chained previous signal handler failed")
+        finally:
+            self._in_signal[signum] = False
 
     def _note_signal_in_telemetry(self) -> None:
         """Deferred signal bookkeeping, run from the training thread (a safe,
@@ -117,16 +157,24 @@ class PreemptionGuard:
         return self
 
     def uninstall(self) -> None:
-        """Restore the previous handlers (idempotent)."""
+        """Restore the previous handlers (idempotent).  Only restores a signal
+        whose registration is still ours — when someone (e.g. the flight
+        recorder) installed over this guard, yanking their registration would
+        break THEIR chain; the kept ``_prev_handlers`` entry lets the
+        now-inert guard keep passing the signal through instead."""
         if not self._installed:
             return
-        for signum, prev in self._prev_handlers.items():
-            try:
-                signal.signal(signum, prev)
-            except (ValueError, TypeError, OSError):
-                pass
-        self._prev_handlers.clear()
         self._installed = False
+        for signum in list(self._prev_handlers):
+            if signal.getsignal(signum) != self._handler:
+                continue
+            try:
+                signal.signal(signum, self._prev_handlers[signum])
+            except (ValueError, TypeError, OSError):
+                # e.g. called off the main thread: we are still the registered
+                # handler, so the chain entry must survive for pass-through.
+                continue
+            self._prev_handlers.pop(signum)
 
     def __enter__(self) -> "PreemptionGuard":
         return self.install()

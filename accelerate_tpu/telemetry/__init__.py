@@ -13,7 +13,12 @@ Three pillars (see ``docs/usage_guides/telemetry.md``):
 - **compiled-program introspection** — XLA cost/memory analysis, the
   per-program collective-communication ledger, and the resharding lint
   (``ACCELERATE_TPU_INTROSPECT=1``; see ``introspect.py`` /
-  ``docs/package_reference/introspect.md``).
+  ``docs/package_reference/introspect.md``);
+- **flight recorder + anomaly sentinel** — a bounded ring of per-step events
+  flushed crash-safe on SIGTERM/exit/crash, with online rolling-median
+  anomaly detection and a one-shot profiler capture
+  (``ACCELERATE_TPU_FLIGHTREC=1``; see ``flightrec.py`` / ``sentinel.py`` /
+  ``docs/package_reference/flightrec.md``).
 
 Default-off: enable with ``ACCELERATE_TPU_TELEMETRY=1`` or
 ``telemetry.enable()``.  Summarize a run with
@@ -41,7 +46,9 @@ from .metrics import (
     collect_hbm,
     peak_flops_per_chip,
 )
+from .flightrec import FlightRecorder, get_flight_recorder
 from .hlo_scan import CollectiveOp, CommsLedger, parse_collectives, scan_hlo
+from .sentinel import AnomalySentinel
 from .introspect import (
     ENV_INTROSPECT,
     LintFinding,
@@ -71,6 +78,10 @@ __all__ = [
     "peak_flops_per_chip",
     "StallWatchdog",
     "thread_dump",
+    # flight recorder + anomaly sentinel
+    "FlightRecorder",
+    "get_flight_recorder",
+    "AnomalySentinel",
     "ENV_ENABLE",
     "ENV_DIR",
     "ENV_STALL_TIMEOUT",

@@ -24,6 +24,7 @@ import threading
 import time
 from typing import Optional
 
+from .flightrec import get_flight_recorder
 from .metrics import CACHE_HIT_EVENT, COMPILE_EVENT, MetricsRegistry, StepTimer, collect_hbm
 
 __all__ = [
@@ -146,9 +147,25 @@ class Telemetry:
                 # crashed run still leaves a parseable file.
                 self._file = open(self.jsonl_path, "a", buffering=1)
             self._file.write(line + "\n")
+        if record.get("kind") == "stall":
+            # Mirror watchdog stalls into the flight recorder as anomalies:
+            # a stalled run is exactly the one about to be killed from
+            # outside, so the durable timeline must carry it.
+            rec = get_flight_recorder()
+            if rec.enabled:
+                rec.note_stall(
+                    record.get("elapsed_s") or 0.0, record.get("deadline_s") or 0.0
+                )
 
     def event(self, name: str, **fields):
         self.write({"kind": "event", "name": name, **fields})
+        # Mirror ad-hoc markers into the flight recorder: preemption signals
+        # and checkpoints, I/O retries, health rewinds — the resilience
+        # subsystem already narrates itself through event(), so the durable
+        # ring gets the same narration for free.
+        rec = get_flight_recorder()
+        if rec.enabled:
+            rec.record("event", name=name, **fields)
 
     # -- hot-path hooks ------------------------------------------------------
 
@@ -172,14 +189,23 @@ class Telemetry:
         heartbeat."""
         if not self.enabled:
             return
-        self.step_timer.step()
+        dt = self.step_timer.step()
         collect_hbm(self.registry)
         dispatches = self.registry.counter("pipeline.dispatches").value
+        per_step = None
         if dispatches:
-            self.registry.gauge("pipeline.dispatches_per_step").set(
-                dispatches - self._dispatch_mark
-            )
+            per_step = dispatches - self._dispatch_mark
+            self.registry.gauge("pipeline.dispatches_per_step").set(per_step)
         self._dispatch_mark = dispatches
+        rec = get_flight_recorder()
+        if rec.enabled:
+            blocked = self.registry.peek("pipeline.host_blocked_ms")
+            rec.note_step(
+                step=self.registry.counter("step.count").value,
+                dur_ms=dt * 1e3 if dt is not None else None,
+                dispatches=per_step,
+                host_blocked_ms=blocked.last if blocked is not None else None,
+            )
         self.heartbeat()
 
 
@@ -204,9 +230,14 @@ def disable():
 
 def maybe_enable_from_env() -> bool:
     """Enable iff ``$ACCELERATE_TPU_TELEMETRY`` is truthy (the Accelerator
-    constructor calls this so env-only runs need no code changes)."""
+    constructor calls this so env-only runs need no code changes).  Also
+    honors ``$ACCELERATE_TPU_FLIGHTREC`` for the flight recorder (which
+    enables telemetry as a side effect — the recorder feeds off its hooks)."""
     if not _TELEMETRY.enabled and _env_flag(ENV_ENABLE):
         _TELEMETRY.enable()
+    from .flightrec import maybe_enable_from_env as _flightrec_from_env
+
+    _flightrec_from_env()
     return _TELEMETRY.enabled
 
 
@@ -234,6 +265,11 @@ def _install_compile_listener():
         tel.registry.counter("jit.compiles").inc()
         tel.registry.histogram("jit.compile_ms").observe(dur_ms)
         tel.write({"kind": "compile", "dur_ms": round(dur_ms, 3)})
+        rec = get_flight_recorder()
+        if rec.enabled:
+            # A mid-training compile is both a recorder-worthy event and a
+            # recompile smell the postmortem should surface.
+            rec.record("compile", dur_ms=round(dur_ms, 3))
 
     monitoring.register_event_duration_secs_listener(_on_duration)
 

@@ -1,0 +1,171 @@
+"""Flight-recorder smoke: SIGTERM a training run mid-step, read the black box.
+
+Run via ``make flightrec-smoke`` (or ``python -m
+accelerate_tpu.telemetry.flightrec_smoke``).  The parent launches one child:
+
+1. **victim** — a CPU training run with the flight recorder enabled
+   (``ACCELERATE_TPU_FLIGHTREC=1``, picked up by ``Accelerator()``) and
+   preemption handling installed; ``ACCELERATE_TPU_FAULT_SIGTERM_STEP=K``
+   delivers a real SIGTERM mid-run.  The PreemptionGuard's flags-only handler
+   fires AND chains to the recorder's flush-on-signal handler (the
+   composition under test), the guard writes its final verified checkpoint,
+   and the child ``os._exit``\\ s — deliberately skipping atexit, so whatever
+   is on disk got there from the signal-time flush alone.
+
+The parent then asserts the postmortem story holds with the process gone:
+
+- ``flightrec_p0.jsonl`` exists and parses;
+- it contains the final step's ``step`` event (step K) and the ``signal``
+  event — the crash-safe flush captured the timeline up to the kill;
+- the guard's final checkpoint is manifest-complete — BOTH chained handlers
+  did their jobs on one signal delivery;
+- ``telemetry.report`` renders a postmortem block from the snapshot.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+STEPS = 8
+KILL_STEP = 4
+
+
+def _train(ckpt_root: str, losses_path: str) -> int:
+    import torch
+    from torch.utils.data import DataLoader
+
+    from ..accelerator import Accelerator
+    from ..telemetry.flightrec import get_flight_recorder
+    from ..test_utils import RegressionDataset, RegressionModelWithLoss
+    from ..test_utils.training import regression_collate
+    from ..utils import set_seed
+
+    set_seed(1234)
+    accelerator = Accelerator()  # env enables telemetry + flight recorder
+    rec = get_flight_recorder()
+    assert rec.enabled, "ACCELERATE_TPU_FLIGHTREC=1 did not enable the recorder"
+    model = RegressionModelWithLoss()
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    dl = DataLoader(
+        list(RegressionDataset(length=64)), batch_size=4, collate_fn=regression_collate
+    )
+    model, opt, dl = accelerator.prepare(model, opt, dl)
+    # Installed AFTER the recorder's handler: the guard must chain to it.
+    accelerator.enable_preemption_handling(save_dir=os.path.join(ckpt_root, "preempt-ckpt"))
+
+    global_step = 0
+    losses: dict = {}
+    preempted = False
+    while global_step < STEPS and not preempted:
+        for batch in dl:
+            out = model(x=batch["x"], y=batch["y"])
+            accelerator.backward(out.loss)
+            opt.step()
+            opt.zero_grad()
+            global_step += 1
+            losses[str(global_step)] = float(out.loss.detach())
+            if accelerator.check_preemption(step=global_step):
+                print(f"# preempted at step {global_step}", file=sys.stderr)
+                preempted = True
+                break
+            if global_step >= STEPS:
+                break
+    with open(losses_path, "w") as f:
+        json.dump({"losses": losses, "preempted": preempted, "last_step": global_step}, f)
+    # Hard exit: atexit (and its recorder flush) must NOT run — the parent's
+    # assertions then prove the signal-time flush alone wrote the black box.
+    os._exit(0)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--role", choices=("train",), default=None)
+    parser.add_argument("--ckpt-root", default=None)
+    parser.add_argument("--losses", default=None)
+    args = parser.parse_args()
+
+    if args.role is not None:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        return _train(args.ckpt_root, args.losses)
+
+    # -- parent orchestration -------------------------------------------------
+    work = tempfile.mkdtemp(prefix="atpu_flightrec_smoke_")
+    rec_dir = os.path.join(work, "flightrec")
+    ckpt_root = os.path.join(work, "ckpts")
+    losses_path = os.path.join(work, "victim.json")
+    os.makedirs(ckpt_root)
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.update(
+        {
+            "ACCELERATE_TPU_FLIGHTREC": "1",
+            "ACCELERATE_TPU_FLIGHTREC_DIR": rec_dir,
+            "ACCELERATE_TPU_TELEMETRY_DIR": os.path.join(work, "telemetry"),
+            "ACCELERATE_TPU_SENTINEL_PROFILE": "0",
+            "ACCELERATE_TPU_FAULT_SIGTERM_STEP": str(KILL_STEP),
+        }
+    )
+    print(f"# flightrec-smoke: victim run (SIGTERM at step {KILL_STEP})", file=sys.stderr)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "accelerate_tpu.telemetry.flightrec_smoke",
+            "--role", "train", "--ckpt-root", ckpt_root, "--losses", losses_path,
+        ],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    if proc.returncode != 0:
+        print(proc.stdout)
+        print(proc.stderr, file=sys.stderr)
+        raise RuntimeError(f"victim exited rc={proc.returncode}")
+    sys.stderr.write(proc.stderr)
+    with open(losses_path) as f:
+        victim = json.load(f)
+    assert victim["preempted"], f"victim was never preempted: {victim}"
+    assert victim["last_step"] == KILL_STEP, victim
+
+    # -- the black box survived the kill --------------------------------------
+    snapshot_path = os.path.join(rec_dir, "flightrec_p0.jsonl")
+    assert os.path.exists(snapshot_path), f"no flight-recorder snapshot at {snapshot_path}"
+    records = [json.loads(line) for line in open(snapshot_path)]
+    kinds = {r["kind"] for r in records}
+    step_events = [r for r in records if r["kind"] == "step"]
+    assert step_events, f"no step events in snapshot (kinds: {kinds})"
+    last_steps = {r.get("step") for r in step_events}
+    assert KILL_STEP in last_steps, (
+        f"final step {KILL_STEP} missing from snapshot (steps: {sorted(last_steps)})"
+    )
+    signals = [r for r in records if r["kind"] == "signal"]
+    assert signals and signals[-1].get("name") == "SIGTERM", (
+        f"no SIGTERM signal event in snapshot (kinds: {kinds})"
+    )
+
+    # -- AND the chained PreemptionGuard still wrote its checkpoint -----------
+    from ..resilience.manifest import find_latest_complete, verify_checkpoint
+
+    ckpt = find_latest_complete(os.path.join(ckpt_root, "preempt-ckpt"))
+    assert ckpt is not None, "guard's final checkpoint missing — chain broke"
+    manifest = verify_checkpoint(ckpt)
+    assert manifest["step"] == KILL_STEP, manifest
+
+    # -- and the report CLI renders a postmortem from it ----------------------
+    from .report import format_flight_report, load_flight_records, summarize_flight
+
+    postmortem = format_flight_report(summarize_flight(load_flight_records(rec_dir)))
+    assert "flight recorder" in postmortem and "SIGTERM" in postmortem, postmortem
+    print(postmortem)
+    print(
+        f"flightrec-smoke OK — SIGTERM at step {KILL_STEP}: snapshot has the final "
+        f"step + signal events, guard checkpoint {os.path.basename(ckpt)} is "
+        "manifest-complete, postmortem renders"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

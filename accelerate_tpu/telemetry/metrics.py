@@ -140,6 +140,13 @@ class MetricsRegistry:
     def histogram(self, name: str) -> Histogram:
         return self._get_or_create(name, Histogram)
 
+    def peek(self, name: str):
+        """Read a metric WITHOUT creating it (None when absent) — for readers
+        like the flight recorder that must not materialize metrics the
+        instrumented path never touched."""
+        with self._lock:
+            return self._metrics.get(name)
+
     def reset(self):
         with self._lock:
             self._metrics.clear()

@@ -1,8 +1,11 @@
 """Telemetry run summarizer: ``python -m accelerate_tpu.telemetry.report <path>``.
 
-``<path>`` is a telemetry JSONL file or a directory holding
-``telemetry_p*.jsonl`` files (one per process).  Prints a per-span time
-breakdown, compile statistics, stall events, and the final metrics snapshot.
+``<path>`` is a telemetry or flight-recorder JSONL file, or a run directory
+holding ``telemetry_p*.jsonl`` / ``flightrec_p*.jsonl`` files (one per
+process).  Prints a per-span time breakdown, compile statistics, stall
+events, the final metrics snapshot, and — when a flight-recorder snapshot is
+present — a postmortem block: the last N steps, the anomaly list, and the
+final event before the process died.
 """
 
 from __future__ import annotations
@@ -12,19 +15,20 @@ import glob
 import json
 import os
 import sys
+import time
 
-__all__ = ["load_records", "summarize", "format_report", "main"]
+__all__ = [
+    "load_records",
+    "load_flight_records",
+    "summarize",
+    "summarize_flight",
+    "format_report",
+    "format_flight_report",
+    "main",
+]
 
 
-def load_records(path: str) -> list[dict]:
-    """Parse every record from a JSONL file or a run directory.  Unparseable
-    lines (a crashed writer's torn tail) are skipped, not fatal."""
-    if os.path.isdir(path):
-        files = sorted(glob.glob(os.path.join(path, "telemetry_p*.jsonl")))
-        if not files:
-            files = sorted(glob.glob(os.path.join(path, "*.jsonl")))
-    else:
-        files = [path]
+def _parse_jsonl(files: list) -> list[dict]:
     records = []
     for file in files:
         with open(file) as f:
@@ -37,6 +41,34 @@ def load_records(path: str) -> list[dict]:
                 except ValueError:
                     continue
     return records
+
+
+def load_records(path: str) -> list[dict]:
+    """Parse every telemetry record from a JSONL file or a run directory.
+    Unparseable lines (a crashed writer's torn tail) are skipped, not fatal.
+    Flight-recorder snapshots are deliberately excluded — their step/anomaly
+    kinds would double-count compiles/stalls; use :func:`load_flight_records`."""
+    if os.path.isdir(path):
+        files = sorted(glob.glob(os.path.join(path, "telemetry_p*.jsonl")))
+        if not files:
+            files = [
+                f
+                for f in sorted(glob.glob(os.path.join(path, "*.jsonl")))
+                if not os.path.basename(f).startswith("flightrec_")
+            ]
+    else:
+        files = [path]
+    return _parse_jsonl(files)
+
+
+def load_flight_records(path: str) -> list[dict]:
+    """Parse flight-recorder snapshots: ``flightrec_p*.jsonl`` under a run
+    directory, or the given file directly."""
+    if os.path.isdir(path):
+        files = sorted(glob.glob(os.path.join(path, "flightrec_p*.jsonl")))
+    else:
+        files = [path]
+    return _parse_jsonl(files)
 
 
 def summarize(records: list[dict]) -> dict:
@@ -84,6 +116,98 @@ def summarize(records: list[dict]) -> dict:
         "introspect": introspect,
         "n_records": len(records),
     }
+
+
+def summarize_flight(records: list[dict]) -> dict:
+    """Aggregate flight-recorder events into the postmortem's sections."""
+    steps = []
+    anomalies = []
+    signals = []
+    crashes = []
+    compiles = 0
+    events = 0
+    for rec in records:
+        kind = rec.get("kind")
+        if kind == "step":
+            steps.append(rec)
+        elif kind == "anomaly":
+            anomalies.append(rec)
+        elif kind == "signal":
+            signals.append(rec)
+        elif kind == "crash":
+            crashes.append(rec)
+        elif kind == "compile":
+            compiles += 1
+        elif kind == "event":
+            events += 1
+    final = max(records, key=lambda r: (r.get("t") or 0, r.get("seq") or 0)) if records else None
+    return {
+        "n_events": len(records),
+        "steps": steps,
+        "anomalies": anomalies,
+        "signals": signals,
+        "crashes": crashes,
+        "compiles": compiles,
+        "events": events,
+        "final_event": final,
+    }
+
+
+def _event_str(rec: dict) -> str:
+    skip = ("kind", "t", "proc", "seq")
+    fields = ", ".join(f"{k}={rec[k]!r}" for k in rec if k not in skip)
+    return f"{rec.get('kind')}" + (f" ({fields})" if fields else "")
+
+
+def format_flight_report(fsummary: dict, last_n: int = 10) -> str:
+    """Render the flight-recorder postmortem block."""
+    lines = []
+    lines.append(
+        f"flight recorder — {fsummary['n_events']} events in snapshot "
+        f"({len(fsummary['steps'])} steps, {fsummary['compiles']} compiles, "
+        f"{fsummary['events']} markers)"
+    )
+    steps = fsummary["steps"][-last_n:]
+    if steps:
+        lines.append("")
+        lines.append(f"last {len(steps)} steps:")
+        lines.append(f"  {'step':>8} {'dur_ms':>10} {'dispatches':>11} {'host_blk_ms':>12}")
+        for s in steps:
+
+            def cell(value):
+                return "-" if value is None else value
+
+            lines.append(
+                f"  {cell(s.get('step')):>8} "
+                f"{cell(s.get('dur_ms')):>10} "
+                f"{cell(s.get('dispatches')):>11} "
+                f"{cell(s.get('host_blocked_ms')):>12}"
+            )
+    if fsummary["anomalies"]:
+        lines.append("")
+        lines.append(f"anomalies: {len(fsummary['anomalies'])}")
+        for a in fsummary["anomalies"][-last_n:]:
+            detail = {
+                k: v for k, v in a.items() if k not in ("kind", "t", "proc", "seq")
+            }
+            lines.append(f"  - {detail.pop('reason', '?')}: {detail}")
+    for sig in fsummary["signals"]:
+        lines.append(
+            f"signal: {sig.get('name', sig.get('signum'))} at t={sig.get('t')}"
+        )
+    for crash in fsummary["crashes"]:
+        lines.append(f"crash: {crash.get('error')}: {crash.get('message')}")
+    final = fsummary["final_event"]
+    if final is not None:
+        when = final.get("t")
+        stamp = (
+            time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(when))
+            if isinstance(when, (int, float))
+            else "?"
+        )
+        lines.append("")
+        lines.append(f"final event before death: {_event_str(final)} at {stamp}")
+    return "\n".join(lines)
 
 
 def _human(n) -> str:
@@ -179,18 +303,38 @@ def format_report(summary: dict) -> str:
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m accelerate_tpu.telemetry.report",
-        description="Summarize a telemetry JSONL run into a per-span time breakdown.",
+        description=(
+            "Summarize a telemetry/flight-recorder JSONL run: per-span time "
+            "breakdown, compile stats, metrics snapshot, and (when a "
+            "flight-recorder snapshot exists) a postmortem of the last steps."
+        ),
     )
-    parser.add_argument("path", help="telemetry JSONL file or run directory")
+    parser.add_argument("path", help="telemetry/flightrec JSONL file or run directory")
+    parser.add_argument(
+        "--last",
+        type=int,
+        default=10,
+        metavar="N",
+        help="steps/anomalies to show in the flight-recorder block (default 10)",
+    )
     args = parser.parse_args(argv)
     if not os.path.exists(args.path):
         print(f"no such file or directory: {args.path}", file=sys.stderr)
         return 1
-    records = load_records(args.path)
-    if not records:
+    is_flight_file = not os.path.isdir(args.path) and os.path.basename(
+        args.path
+    ).startswith("flightrec_")
+    records = [] if is_flight_file else load_records(args.path)
+    flight = load_flight_records(args.path) if (os.path.isdir(args.path) or is_flight_file) else []
+    if not records and not flight:
         print(f"no telemetry records found under {args.path}", file=sys.stderr)
         return 1
-    print(format_report(summarize(records)))
+    blocks = []
+    if records:
+        blocks.append(format_report(summarize(records)))
+    if flight:
+        blocks.append(format_flight_report(summarize_flight(flight), last_n=args.last))
+    print("\n\n".join(blocks))
     return 0
 
 

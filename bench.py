@@ -338,6 +338,79 @@ def _run_rung_subprocess(rung_index: int, timeout_s: int, flag: str = "--rung"):
     return None, "no parseable result line"
 
 
+class _PartialResults:
+    """Per-rung partial-result checkpointing through the resilience manifest.
+
+    4 of 5 bench rounds died to device flake; when the *process* dies too
+    (SIGKILL, OOM killer, machine loss — the cases the emergency-JSON
+    watchdog cannot catch), every completed rung measurement died with it.
+    After every successful rung the current best result is published to
+    ``BENCH_partial/`` as a manifest-verified directory (same staging + atomic
+    swap + retry policy as training checkpoints), so a mid-bench death leaves
+    the best completed rung on disk: the emergency path reads it back, and a
+    human (or the next round) finds ``BENCH_partial/result.json`` with a
+    manifest certifying it is complete, not a torn write."""
+
+    def __init__(self, root: str = "BENCH_partial"):
+        self.root = root
+
+    def clear(self):
+        """Fresh round: a stale partial from an older run must not masquerade
+        as this round's measurement."""
+        import shutil
+
+        for suffix in ("", ".tmp", ".old"):
+            shutil.rmtree(self.root + suffix, ignore_errors=True)
+
+    def publish(self, payload: dict):
+        import shutil
+
+        from accelerate_tpu.resilience.manifest import write_manifest
+        from accelerate_tpu.resilience.retry import retrying
+
+        def _io():
+            tmp = f"{self.root}.tmp"
+            shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(tmp)
+            with open(os.path.join(tmp, "result.json"), "w") as f:
+                json.dump(payload, f)
+            write_manifest(tmp)
+            # Same displaced-old swap as checkpoint publish: the previous
+            # partial stays readable until the new one is fully in place.
+            old = f"{self.root}.old"
+            if os.path.isdir(old):
+                shutil.rmtree(old)
+            displaced = False
+            if os.path.isdir(self.root):
+                os.rename(self.root, old)
+                displaced = True
+            try:
+                os.rename(tmp, self.root)
+            except BaseException:
+                if displaced:
+                    os.rename(old, self.root)
+                raise
+            if displaced:
+                shutil.rmtree(old, ignore_errors=True)
+
+        try:
+            retrying(label="bench.partial", tries=3, deadline_s=30.0).call(_io)
+        except Exception as e:  # a journal failure must never fail the bench
+            print(f"# partial-result publish failed: {e}", file=sys.stderr, flush=True)
+
+    def load(self):
+        """Best completed rung from a previous flush of THIS run, manifest-
+        verified; None when absent or torn."""
+        from accelerate_tpu.resilience.manifest import verify_checkpoint
+
+        try:
+            verify_checkpoint(self.root)
+            with open(os.path.join(self.root, "result.json")) as f:
+                return json.load(f)
+        except Exception:
+            return None
+
+
 def _emit_error_json(error: str, detail: dict = None):
     """The driver parses the LAST JSON line on stdout; every failure path must
     leave one (round 5 regressed to ``rc=124, parsed=null`` when the probe
@@ -895,11 +968,21 @@ def main():
     # result, not a zero — a budget hit late in the run must never discard a
     # valid number.
     landed: dict = {}
+    journal = _PartialResults()
+    journal.clear()
 
     def _emergency_exit(reason: str):
         if landed:
             rec = dict(landed)
             rec["detail"] = dict(rec["detail"], truncated=reason)
+            print(json.dumps(rec), flush=True)
+            os._exit(0)
+        # Nothing landed in-memory: a partial published earlier in THIS run
+        # (manifest-verified) still beats a zero.
+        partial = journal.load()
+        if partial and "metric" in partial:
+            rec = dict(partial)
+            rec["detail"] = dict(rec.get("detail") or {}, truncated=reason)
             print(json.dumps(rec), flush=True)
             os._exit(0)
         _emit_error_json(reason)
@@ -1033,6 +1116,8 @@ def main():
             },
         }
     )
+    # ... and the on-disk journal carries it past even a SIGKILL.
+    journal.publish(landed)
 
     # HBM-bound proof: run the >=1B-param rungs after the headline so the
     # round artifact carries MFU evidence off the smallest model.  First
@@ -1154,6 +1239,17 @@ def main():
         }
         if "telemetry" in proof:
             detail["hbm_bound_proof"]["telemetry"] = proof["telemetry"]
+    # Re-publish the journal with the full detail (proof/frontier/probes
+    # attached) so the on-disk partial matches the final line.
+    journal.publish(
+        {
+            "metric": "train_mfu",
+            "value": round(result["mfu"], 4),
+            "unit": "mfu_fraction",
+            "vs_baseline": round(result["mfu"] / 0.45, 4),
+            "detail": detail,
+        }
+    )
     print(
         json.dumps(
             {

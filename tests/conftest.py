@@ -19,6 +19,17 @@ os.environ.setdefault("ACCELERATE_TPU_CHECKPOINT_FSYNC", "0")
 # state under ~/.cache, no per-program disk writes).  Tests of the cache
 # itself point it at a tmpdir explicitly.
 os.environ.setdefault("ACCELERATE_TPU_COMPILE_CACHE", "")
+# Flight recorder hermeticity: the sentinel's one-shot jax.profiler capture
+# must never fire inside the suite (it would drop trace dumps and fight other
+# profiler tests), and any stray enable writes its snapshot under a tmpdir,
+# not the checkout.  Tests of the recorder pass dir= explicitly.
+os.environ.setdefault("ACCELERATE_TPU_SENTINEL_PROFILE", "0")
+import tempfile as _tempfile
+
+os.environ.setdefault(
+    "ACCELERATE_TPU_FLIGHTREC_DIR",
+    _tempfile.mkdtemp(prefix="atpu_test_flightrec_"),
+)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

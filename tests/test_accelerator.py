@@ -66,6 +66,7 @@ def _accelerated_run(model_cls, fused: bool, num_epochs=3, lr=0.1, batch_size=16
     return params["a"], params["b"]
 
 
+@pytest.mark.slow  # >10s; overlapping coverage stays in the bounded tier-1 run
 def test_training_check_fused_mode():
     """Fused (model-computes-loss) path matches single-process torch weights."""
     base_a, base_b = _torch_baseline()

@@ -758,3 +758,39 @@ def test_bench_ladder_configs_construct():
         assert cfg.num_params() > 0, name
         assert s % 128 == 0, (name, s)  # VMEM tiling contract
         assert isinstance(host_opt, bool)
+
+
+def test_bench_partial_results_journal(tmp_path):
+    """Per-rung partial results publish through the resilience manifest:
+    atomic staging + swap, manifest-verified on read-back, torn writes
+    rejected — the piece that lets a SIGKILLed bench still report its best
+    completed rung from disk."""
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location("bench_mod2", os.path.join(repo, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    journal = bench._PartialResults(root=str(tmp_path / "BENCH_partial"))
+    assert journal.load() is None  # nothing published yet
+
+    journal.publish({"metric": "train_mfu", "value": 0.5, "detail": {"rung": "r0"}})
+    loaded = journal.load()
+    assert loaded["value"] == 0.5 and loaded["detail"]["rung"] == "r0"
+    assert os.path.exists(os.path.join(journal.root, "manifest.json"))
+
+    # Re-publish replaces atomically (no .tmp/.old leftovers).
+    journal.publish({"metric": "train_mfu", "value": 0.61, "detail": {"rung": "r1"}})
+    assert journal.load()["value"] == 0.61
+    assert not os.path.isdir(journal.root + ".tmp")
+    assert not os.path.isdir(journal.root + ".old")
+
+    # A torn/corrupted result must NOT be reported as a measurement.
+    with open(os.path.join(journal.root, "result.json"), "w") as f:
+        f.write('{"metric": "train_mfu", "value": 9')
+    assert journal.load() is None
+
+    journal.clear()
+    assert not os.path.isdir(journal.root)

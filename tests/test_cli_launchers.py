@@ -39,6 +39,7 @@ def test_launch_parser_and_env():
     assert env["ACCELERATE_PROCESS_ID"] == "1"
 
 
+@pytest.mark.slow  # >10s; overlapping coverage stays in the bounded tier-1 run
 def test_cli_help_and_env_command():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"  # device-independent (and TPU-outage-proof)

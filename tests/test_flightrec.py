@@ -1,0 +1,417 @@
+"""Flight recorder + anomaly sentinel: ring semantics, crash-safe flush,
+signal-handler composition with PreemptionGuard, sentinel detection bounds,
+and the report CLI's postmortem block.
+
+The recorder is process-global state like telemetry; every test enables into
+a tmp dir and the autouse fixture guarantees both are off afterwards.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from accelerate_tpu import telemetry
+from accelerate_tpu.telemetry import AnomalySentinel, get_flight_recorder
+from accelerate_tpu.telemetry import flightrec
+from accelerate_tpu.telemetry import report as telemetry_report
+from accelerate_tpu.telemetry.report import (
+    format_flight_report,
+    load_flight_records,
+    summarize_flight,
+)
+
+
+@pytest.fixture(autouse=True)
+def _recorder_off():
+    yield
+    flightrec.disable()
+    telemetry.disable()
+
+
+def _read_snapshot(rec):
+    with open(rec.jsonl_path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# ---------------------------------------------------------------------------
+# Ring semantics
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_by_default_records_nothing(tmp_path):
+    rec = get_flight_recorder()
+    assert not rec.enabled
+    rec.record("step", step=1)
+    rec.note_step(step=1, dur_ms=5.0)
+    assert rec.snapshot() == []
+
+
+def test_enable_forces_telemetry_on(tmp_path):
+    assert not telemetry.enabled()
+    flightrec.enable(dir=str(tmp_path))
+    assert telemetry.enabled()  # the recorder feeds off telemetry's hooks
+
+
+def test_ring_wraparound_keeps_last_capacity_events(tmp_path):
+    rec = flightrec.enable(dir=str(tmp_path), capacity=16, flush_every=10_000)
+    for i in range(50):
+        rec.record("step", step=i)
+    snap = rec.snapshot()
+    assert len(snap) == 16
+    # Oldest events (and the enable-time meta record) aged out; the survivors
+    # are exactly the last 16 in order.
+    assert [r["step"] for r in snap] == list(range(34, 50))
+    seqs = [r["seq"] for r in snap]
+    assert seqs == sorted(seqs) and len(set(seqs)) == 16
+
+
+def test_flush_writes_atomic_snapshot(tmp_path):
+    rec = flightrec.enable(dir=str(tmp_path), capacity=8, flush_every=10_000)
+    for i in range(20):
+        rec.record("step", step=i)
+    assert rec.flush(reason="test")
+    records = _read_snapshot(rec)
+    assert [r["step"] for r in records] == list(range(12, 20))  # older + meta aged out
+    assert not os.path.exists(rec.jsonl_path + ".tmp")
+
+
+def test_periodic_flush_every_n_events(tmp_path):
+    rec = flightrec.enable(dir=str(tmp_path), capacity=64, flush_every=4)
+    for i in range(3):
+        rec.record("step", step=i)  # meta + 3 == 4 -> first flush fired
+    assert os.path.exists(rec.jsonl_path)
+    assert len(_read_snapshot(rec)) == 4
+
+
+def test_concurrent_writers_keep_sequence_consistent(tmp_path):
+    rec = flightrec.enable(dir=str(tmp_path), capacity=4096, flush_every=100)
+    n_threads, per_thread = 8, 200
+
+    def worker(tid):
+        for i in range(per_thread):
+            rec.record("step", thread=tid, i=i)
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = rec.snapshot()
+    assert len(snap) == n_threads * per_thread + 1  # + enable meta
+    seqs = [r["seq"] for r in snap]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    # Per-thread order preserved through the interleaving.
+    for tid in range(n_threads):
+        own = [r["i"] for r in snap if r.get("thread") == tid]
+        assert own == list(range(per_thread))
+    rec.flush()
+    assert len(_read_snapshot(rec)) == len(snap)
+
+
+# ---------------------------------------------------------------------------
+# Telemetry wiring
+# ---------------------------------------------------------------------------
+
+
+def test_record_step_feeds_recorder_and_event_mirror(tmp_path):
+    rec = flightrec.enable(dir=str(tmp_path), flush_every=10_000)
+    tel = telemetry.get_telemetry()
+    for _ in range(3):
+        tel.registry.counter("pipeline.dispatches").inc()
+        tel.record_step()
+    tel.event("resilience.preempt_signal", signum=15)
+    snap = rec.snapshot()
+    steps = [r for r in snap if r["kind"] == "step"]
+    assert [s["step"] for s in steps] == [1, 2, 3]
+    assert steps[-1]["dispatches"] == 1
+    assert steps[-1]["dur_ms"] > 0
+    events = [r for r in snap if r["kind"] == "event"]
+    assert events and events[-1]["name"] == "resilience.preempt_signal"
+
+
+def test_stall_mirrors_as_anomaly(tmp_path):
+    rec = flightrec.enable(dir=str(tmp_path), flush_every=10_000)
+    tel = telemetry.get_telemetry()
+    tel.write({"kind": "stall", "elapsed_s": 12.5, "deadline_s": 10.0, "threads": ""})
+    anomalies = [r for r in rec.snapshot() if r["kind"] == "anomaly"]
+    assert len(anomalies) == 1
+    assert anomalies[0]["reason"] == "stall"
+    assert anomalies[0]["elapsed_s"] == 12.5
+    # A stall flushes immediately — the run may be about to be killed.
+    assert os.path.exists(rec.jsonl_path)
+
+
+def test_excepthook_records_crash_and_chains(tmp_path):
+    rec = flightrec.enable(dir=str(tmp_path), flush_every=10_000)
+    seen = []
+    prev = sys.excepthook
+
+    def fake_prev(exc_type, exc, tb):
+        seen.append(exc_type)
+
+    sys.excepthook = fake_prev
+    try:
+        rec._uninstall_excepthook()
+        rec._install_excepthook()  # re-install over fake_prev to test chaining
+        sys.excepthook(ValueError, ValueError("boom"), None)
+    finally:
+        rec._uninstall_excepthook()
+        sys.excepthook = prev
+    assert seen == [ValueError]
+    crashes = [r for r in _read_snapshot(rec) if r["kind"] == "crash"]
+    assert crashes and crashes[0]["error"] == "ValueError"
+    assert "boom" in crashes[0]["message"]
+
+
+# ---------------------------------------------------------------------------
+# Signal composition (the regression test for chain-don't-overwrite)
+# ---------------------------------------------------------------------------
+
+
+def _deliver_sigterm():
+    os.kill(os.getpid(), signal.SIGTERM)
+    # CPython delivers at the next bytecode boundary; give it one.
+    time.sleep(0.01)
+
+
+def test_recorder_then_guard_both_fire_on_sigterm(tmp_path):
+    from accelerate_tpu.resilience import PreemptionGuard
+
+    rec = flightrec.enable(dir=str(tmp_path), flush_every=10_000)
+    guard = PreemptionGuard(signals=(signal.SIGTERM,), coordinated=False)
+    guard.install()  # guard OVER recorder: guard must chain to the flush
+    try:
+        _deliver_sigterm()
+        assert guard.preempted_locally()
+        signals = [r for r in _read_snapshot(rec) if r["kind"] == "signal"]
+        assert signals and signals[0]["name"] == "SIGTERM"
+    finally:
+        guard.uninstall()
+
+
+def test_guard_then_recorder_both_fire_on_sigterm(tmp_path):
+    from accelerate_tpu.resilience import PreemptionGuard
+
+    guard = PreemptionGuard(signals=(signal.SIGTERM,), coordinated=False)
+    guard.install()
+    rec = flightrec.enable(dir=str(tmp_path), flush_every=10_000)
+    # recorder OVER guard: the recorder chains to the guard's flags-only
+    # handler instead of swallowing the signal.
+    try:
+        _deliver_sigterm()
+        assert guard.preempted_locally()
+        signals = [r for r in _read_snapshot(rec) if r["kind"] == "signal"]
+        assert signals and signals[0]["name"] == "SIGTERM"
+    finally:
+        flightrec.disable()
+        guard.uninstall()
+
+
+def test_handler_cycle_from_reenable_does_not_hard_kill(tmp_path):
+    """enable -> guard install -> disable (entry kept: guard is registered
+    over us) -> re-enable leaves the recorder both registered AND in the
+    guard's chain — a cycle.  The reentrancy latches must break it: the first
+    SIGTERM flushes + sets the guard flag and the process SURVIVES (pre-fix:
+    the guard saw its own just-set flag on the cycled re-entry and
+    hard-killed via the second-delivery branch)."""
+    code = (
+        "import os, signal, sys, time\n"
+        "from accelerate_tpu.telemetry import flightrec\n"
+        "from accelerate_tpu.resilience import PreemptionGuard\n"
+        "rec = flightrec.enable(dir=sys.argv[1], flush_every=100000)\n"
+        "guard = PreemptionGuard(signals=(signal.SIGTERM,), coordinated=False).install()\n"
+        "flightrec.disable()\n"
+        "rec = flightrec.enable(dir=sys.argv[1], flush_every=100000)\n"
+        "os.kill(os.getpid(), signal.SIGTERM)\n"
+        "time.sleep(0.05)\n"
+        "assert guard.preempted_locally()\n"
+        "print('SURVIVED', flush=True)\n"
+    )
+    env = dict(os.environ)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "ACCELERATE_TPU_SENTINEL_PROFILE": "0",
+            "ACCELERATE_TPU_TELEMETRY_DIR": str(tmp_path),
+        }
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code, str(tmp_path)],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=120,
+    )
+    assert proc.returncode == 0, (proc.returncode, proc.stdout, proc.stderr)
+    assert "SURVIVED" in proc.stdout
+    files = [f for f in os.listdir(tmp_path) if f.startswith("flightrec_")]
+    records = [json.loads(line) for line in open(os.path.join(tmp_path, files[0]))]
+    assert sum(1 for r in records if r["kind"] == "signal") == 1  # one delivery, once
+
+
+def test_recorder_alone_preserves_die_on_sigterm_semantics(tmp_path):
+    """Flush-then-die in a subprocess: with NO other handler installed the
+    recorder must not make the process unkillable, and the snapshot on disk
+    after death is the flush-on-crash proof (periodic flush disabled)."""
+    code = (
+        "import os, sys, time\n"
+        "from accelerate_tpu.telemetry import flightrec\n"
+        "rec = flightrec.enable(dir=sys.argv[1], flush_every=100000)\n"
+        "rec.record('marker', i=0)\n"
+        "print('READY', flush=True)\n"
+        "time.sleep(120)\n"
+    )
+    env = dict(os.environ)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "ACCELERATE_TPU_SENTINEL_PROFILE": "0",
+            "ACCELERATE_TPU_TELEMETRY_DIR": str(tmp_path),
+        }
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code, str(tmp_path)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=env,
+    )
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    assert proc.returncode == -signal.SIGTERM  # default disposition re-raised
+    files = [f for f in os.listdir(tmp_path) if f.startswith("flightrec_")]
+    assert files, "no snapshot flushed before death"
+    records = [
+        json.loads(line) for line in open(os.path.join(tmp_path, files[0]))
+    ]
+    kinds = [r["kind"] for r in records]
+    assert "marker" in kinds and "signal" in kinds
+
+
+# ---------------------------------------------------------------------------
+# Sentinel
+# ---------------------------------------------------------------------------
+
+
+def test_sentinel_no_false_positives_on_steady_stream():
+    sentinel = AnomalySentinel(window=64, warmup=16, factor=3.0, min_excess_ms=10.0)
+    import random
+
+    rng = random.Random(0)
+    for _ in range(1000):
+        assert sentinel.observe(100.0 + rng.uniform(-10, 10)) is None
+    assert sentinel.anomaly_count == 0
+
+
+def test_sentinel_flags_slow_step_and_recenters_after_regime_change():
+    sentinel = AnomalySentinel(window=16, warmup=8, factor=3.0, min_excess_ms=10.0)
+    for _ in range(20):
+        assert sentinel.observe(100.0) is None
+    verdict = sentinel.observe(400.0)
+    assert verdict is not None and verdict["reason"] == "slow_step"
+    assert verdict["median_ms"] == 100.0 and verdict["ratio"] == 4.0
+    # A persistent slowdown stops alerting once the window re-centers.
+    alerts = sum(1 for _ in range(64) if sentinel.observe(400.0) is not None)
+    assert 0 < alerts <= 16
+    assert sentinel.observe(400.0) is None
+
+
+def test_sentinel_warmup_judges_nothing():
+    sentinel = AnomalySentinel(window=32, warmup=16)
+    for _ in range(15):
+        assert sentinel.observe(1.0) is None
+    assert sentinel.observe(1000.0) is None  # 16th sample: still warming up
+    assert sentinel.observe(1000.0) is not None  # 17th: judged
+
+
+def test_sentinel_straggler_report():
+    sentinel = AnomalySentinel(window=32, warmup=4, straggler_factor=1.5)
+    for host in range(4):
+        for _ in range(8):
+            sentinel.observe_host_step(host, 100.0 if host != 3 else 180.0)
+    report = sentinel.straggler_report()
+    assert [r["host"] for r in report] == [3]
+    assert report[0]["ratio"] == 1.8
+
+
+def test_anomaly_recorded_and_counted(tmp_path):
+    rec = flightrec.enable(
+        dir=str(tmp_path),
+        flush_every=10_000,
+        sentinel=AnomalySentinel(window=8, warmup=2, factor=2.0, min_excess_ms=1.0),
+    )
+    for i in range(5):
+        rec.note_step(step=i, dur_ms=10.0)
+    rec.note_step(step=5, dur_ms=100.0)
+    anomalies = [r for r in rec.snapshot() if r["kind"] == "anomaly"]
+    assert len(anomalies) == 1 and anomalies[0]["reason"] == "slow_step"
+    tel = telemetry.get_telemetry()
+    assert tel.registry.counter("sentinel.anomalies").value == 1
+    # Anomalies flush immediately.
+    assert any(r["kind"] == "anomaly" for r in _read_snapshot(rec))
+
+
+# ---------------------------------------------------------------------------
+# Report CLI postmortem
+# ---------------------------------------------------------------------------
+
+
+def test_report_renders_postmortem_block(tmp_path, capsys):
+    rec = flightrec.enable(dir=str(tmp_path), flush_every=10_000)
+    tel = telemetry.get_telemetry()
+    for _ in range(12):
+        tel.registry.counter("pipeline.dispatches").inc()
+        tel.record_step()
+    rec.record("anomaly", reason="slow_step", dur_ms=500.0, median_ms=10.0, ratio=50.0)
+    rec.record("signal", signum=15, name="SIGTERM")
+    rec.flush()
+    flightrec.disable()
+    telemetry.disable()
+    assert telemetry_report.main([str(tmp_path), "--last", "5"]) == 0
+    out = capsys.readouterr().out
+    assert "flight recorder" in out
+    assert "last 5 steps" in out
+    assert "slow_step" in out
+    assert "SIGTERM" in out
+    assert "final event before death" in out
+
+
+def test_report_empty_registry_and_steps(tmp_path, capsys):
+    """A snapshot with no step events and no metrics must still render (the
+    process died before the first optimizer step — the emptiness IS the
+    postmortem)."""
+    path = tmp_path / "flightrec_p0.jsonl"
+    path.write_text(json.dumps({"kind": "meta", "event": "enabled", "t": 1.0, "seq": 1}) + "\n")
+    summary = summarize_flight(load_flight_records(str(tmp_path)))
+    assert summary["n_events"] == 1 and summary["steps"] == []
+    text = format_flight_report(summary)
+    assert "0 steps" in text and "final event before death" in text
+    assert telemetry_report.main([str(tmp_path)]) == 0
+    assert "flight recorder" in capsys.readouterr().out
+
+
+def test_report_excludes_flightrec_from_telemetry_block(tmp_path):
+    """flightrec compiles/stalls must not double-count into the telemetry
+    summary when both files live in one run dir."""
+    (tmp_path / "telemetry_p0.jsonl").write_text(
+        json.dumps({"kind": "compile", "dur_ms": 5.0}) + "\n"
+    )
+    (tmp_path / "flightrec_p0.jsonl").write_text(
+        json.dumps({"kind": "compile", "dur_ms": 5.0, "seq": 1, "t": 1.0}) + "\n"
+    )
+    records = telemetry_report.load_records(str(tmp_path))
+    assert len(records) == 1
+    assert telemetry_report.summarize(records)["compiles"] == 1
+    assert len(load_flight_records(str(tmp_path))) == 1

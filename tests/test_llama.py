@@ -133,6 +133,7 @@ def test_min_num_params_keeps_small_arrays_replicated():
     assert "fsdp" in tuple(specs["layers"]["wq"])
 
 
+@pytest.mark.slow  # >10s; overlapping coverage stays in the bounded tier-1 run
 def test_bf16_params_loss_curve_tracks_fp32():
     """Loss-curve parity guard for the bench's rung-0 config (pure-bf16
     params, the reference's downcast_bf16 semantics): training with bf16

@@ -114,6 +114,7 @@ def test_load_balance_loss_minimal_when_uniform():
     assert float(moe.load_balancing_loss(peaked, skewed)) > 1.5
 
 
+@pytest.mark.slow  # >10s; overlapping coverage stays in the bounded tier-1 run
 def test_mixtral_forward_and_training():
     cfg = mixtral.MixtralConfig.tiny()
     params = mixtral.init_params(cfg, jax.random.key(0))

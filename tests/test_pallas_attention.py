@@ -171,6 +171,7 @@ def test_pallas_ring_matches_dense(kv_heads, causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow  # >10s; overlapping coverage stays in the bounded tier-1 run
 def test_pallas_ring_grads_match_dense():
     """Backward ring: dQ local accumulation + dK/dV riding home with their
     chunks must reproduce the dense gradients."""
@@ -412,6 +413,7 @@ def test_llama_sp_pallas_matches_dense_model():
     )
 
 
+@pytest.mark.slow  # >10s; overlapping coverage stays in the bounded tier-1 run
 def test_llama_padded_batch_pallas_matches_einsum():
     """attention_impl='pallas' with an attention_mask (the padded-batch path
     that round 5 moved INTO the kernel) must match the einsum model: loss

@@ -1,0 +1,77 @@
+"""CPU-tier perf-regression gate (pipeline/perf_gate.py): the committed
+baseline parses, the evaluate() thresholds cut both ways, the real probe
+passes the gate on CPU inside tier-1, and the degrade knob demonstrably
+fails it — the proof the gate can actually catch a fused-path rot.
+"""
+
+import json
+
+import pytest
+
+from accelerate_tpu import telemetry
+from accelerate_tpu.pipeline.perf_gate import (
+    DEFAULT_BASELINE_PATH,
+    evaluate,
+    load_baseline,
+    run_gate,
+    run_probe,
+)
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_off():
+    yield
+    telemetry.disable()
+
+
+def _passing_measurements():
+    return {
+        "fused_vs_eager_ratio": 2.0,
+        "dispatches_per_step": 1.0,
+        "fused_host_blocked_ms_per_step": 2.0,
+    }
+
+
+def test_baseline_is_committed_and_parses():
+    baseline = load_baseline()
+    assert baseline["max_dispatches_per_step"] == 1.0
+    assert baseline["min_fused_vs_eager_ratio"] > 1.0
+    assert baseline["max_fused_host_blocked_ms_per_step"] > 0
+    assert baseline["probe"]["accum"] >= 2  # the contrast the ratio floor assumes
+
+
+def test_evaluate_passes_clean_measurements():
+    assert evaluate(_passing_measurements(), load_baseline()) == []
+
+
+def test_evaluate_fails_each_threshold():
+    baseline = load_baseline()
+    m = dict(_passing_measurements(), dispatches_per_step=6.0)
+    assert any("dispatches" in f for f in evaluate(m, baseline))
+    m = dict(_passing_measurements(), fused_vs_eager_ratio=1.0)
+    assert any("ratio" in f for f in evaluate(m, baseline))
+    m = dict(_passing_measurements(), fused_host_blocked_ms_per_step=500.0)
+    assert any("host-blocked" in f for f in evaluate(m, baseline))
+
+
+def test_gate_passes_on_cpu(capsys):
+    """The real gate, inside tier-1: perf regressions in the fused pipeline
+    fail the test suite even when no TPU answers (ROADMAP item 5).  Two
+    timed epochs instead of the standalone gate's three — same invariants,
+    smaller bite out of the tier-1 budget."""
+    assert run_gate(probe_kwargs={"epochs": 2}) == 0
+    out = capsys.readouterr().out
+    line = next(l for l in out.splitlines() if l.startswith("{"))
+    measurements = json.loads(line)["perf_gate"]
+    assert measurements["dispatches_per_step"] == 1.0
+
+
+def test_gate_fails_when_fused_path_degraded(monkeypatch):
+    """Forcing the fused arm onto the eager loop must trip the gate — the
+    dispatches/step integer jumps to 3 x accum, immune to timing noise."""
+    monkeypatch.setenv("ACCELERATE_TPU_PERF_GATE_DEGRADE", "eager")
+    measurements = run_probe(accum=2, steps=4, dim=64, batch=8, epochs=1, prefetch=0)
+    assert measurements["probe"]["degrade"] == "eager"
+    assert measurements["dispatches_per_step"] == 6.0
+    failures = evaluate(measurements, load_baseline())
+    assert any("dispatches" in f for f in failures)

@@ -280,6 +280,7 @@ def test_pipelined_bridge_matches_plain_lowering():
     AcceleratorState._reset_state()
 
 
+@pytest.mark.slow  # >10s; overlapping coverage stays in the bounded tier-1 run
 def test_prepare_pipelines_bridged_module_under_pp():
     """Accelerator.prepare with pp>1 pipelines a torch module's block chain:
     the prepared model trains (bridge mode) and its loss matches the pp=1

@@ -547,24 +547,30 @@ def test_fused_step_honors_check_preemption_boundary(tmp_path):
 
     acc, model, opt, dl = _build_ckpt_training(tmp_path / "run")
     guard = acc.enable_preemption_handling(save_dir=str(tmp_path / "preempt"))
-    step_fn = acc.make_train_step(model, opt)
-    it = iter(dl)
-    stopped_at = None
-    for step in range(1, 5):
-        step_fn(next(it))
-        if step == 3:
-            guard._flag = True  # simulated signal delivery
-        if acc.check_preemption(step=step):
-            stopped_at = step
-            break
-    assert stopped_at == 3
-    ckpt = find_latest_complete(str(tmp_path))
-    assert ckpt is not None
-    live = model.state_dict()
-    acc.load_state(ckpt)
-    restored = model.state_dict()
-    for key in live:
-        np.testing.assert_array_equal(live[key], restored[key])
+    try:
+        step_fn = acc.make_train_step(model, opt)
+        it = iter(dl)
+        stopped_at = None
+        for step in range(1, 5):
+            step_fn(next(it))
+            if step == 3:
+                guard._flag = True  # simulated signal delivery
+            if acc.check_preemption(step=step):
+                stopped_at = step
+                break
+        assert stopped_at == 3
+        ckpt = find_latest_complete(str(tmp_path))
+        assert ckpt is not None
+        live = model.state_dict()
+        acc.load_state(ckpt)
+        restored = model.state_dict()
+        for key in live:
+            np.testing.assert_array_equal(live[key], restored[key])
+    finally:
+        # A leaked installed guard with _flag set is a process-wide landmine:
+        # later tests' real SIGTERMs chain into it and its second-delivery
+        # branch hard-kills the whole pytest run.
+        guard.uninstall()
 
 
 # ---------------------------------------------------------------------------

@@ -96,6 +96,7 @@ def test_skip_modules_filter():
     assert isinstance(q["layers"]["wq"], QuantizedArray)
 
 
+@pytest.mark.slow  # >10s; overlapping coverage stays in the bounded tier-1 run
 def test_llama_quantized_forward_parity():
     """4-bit nf4 llama predictions match fp32 predictions on a model with real
     signal (briefly overfit, so its argmax is confident — a random-init model's
@@ -205,6 +206,7 @@ def test_int8_serialization_roundtrip():
     assert any(isinstance(l, QuantizedArray) for l in leaves)
 
 
+@pytest.mark.slow  # >10s; overlapping coverage stays in the bounded tier-1 run
 def test_generate_quality_quantized():
     """Reference test_generate_quality: greedy generation from the quantized
     model matches the full-precision model token-for-token (on a briefly
@@ -320,6 +322,7 @@ def _dense_from_q(qstack):
     return out
 
 
+@pytest.mark.slow  # ~40s across the family sweep; decode/speculative/kv-cache int8 parity stays in tier-1
 @pytest.mark.parametrize("family", ["gpt2", "mixtral", "t5"])
 def test_int8_layer_stack_all_families(family):
     """Every decoder family runs int8-weight-resident bit-identically to the

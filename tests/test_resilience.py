@@ -420,6 +420,28 @@ def test_preemption_signal_sets_flag_and_checkpoint_written_once(tmp_path):
         guard.uninstall()
 
 
+def test_uninstalled_guard_left_in_chain_is_inert(tmp_path):
+    """Non-LIFO teardown: a guard uninstalled while an outer handler still
+    chains to it must neither act nor hard-kill.  Regression for the tier-1
+    suite dying of SIGTERM: a leaked flagged guard in the chain treated a
+    later test's first delivery as its own second and restored SIG_DFL."""
+    before = signal.getsignal(signal.SIGTERM)
+    inner = PreemptionGuard(signals=(signal.SIGTERM,), coordinated=False).install()
+    outer = PreemptionGuard(signals=(signal.SIGTERM,), coordinated=False).install()
+    try:
+        # Arm the zombie exactly like a past run: flag + signum already set.
+        inner._flag = True
+        inner._signum = signal.SIGTERM
+        inner.uninstall()
+        # Chain-safe uninstall: the OUTER registration must not be yanked.
+        assert signal.getsignal(signal.SIGTERM) == outer._handler
+        os.kill(os.getpid(), signal.SIGTERM)  # pre-fix: killed the process
+        assert outer.preempted_locally()  # outer saw its first delivery
+    finally:
+        outer.uninstall()
+        signal.signal(signal.SIGTERM, before)
+
+
 def test_fault_sigterm_tick_fires_through_guard(tmp_path, monkeypatch):
     monkeypatch.setenv("ACCELERATE_TPU_FAULT_SIGTERM_STEP", "2")
     faultinject.reload()

@@ -38,6 +38,7 @@ def test_forward_shapes_and_param_count():
     assert resnet.ResNetConfig.resnet18().num_params() == 11_689_512
 
 
+@pytest.mark.slow  # >10s; overlapping coverage stays in the bounded tier-1 run
 def test_bottleneck_and_deep_presets_build():
     for cfg in (
         resnet.ResNetConfig.tiny(block="bottleneck"),
@@ -50,6 +51,7 @@ def test_bottleneck_and_deep_presets_build():
         assert pooled.shape[0] == 2
 
 
+@pytest.mark.slow  # >10s; overlapping coverage stays in the bounded tier-1 run
 def test_train_updates_stats_and_converges():
     cfg = resnet.ResNetConfig.tiny(dtype=jnp.float32)
     params = resnet.init_params(cfg, jax.random.key(0))

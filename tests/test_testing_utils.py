@@ -145,6 +145,7 @@ def test_test_ops_script_multiprocess():
     assert "op checker ok" in res.stdout
 
 
+@pytest.mark.slow  # >10s; overlapping coverage stays in the bounded tier-1 run
 def test_test_sync_script():
     """Grad-accum oracle script runs green end-to-end."""
     out = execute_subprocess_async(
@@ -182,6 +183,7 @@ def test_shipped_notebook_script():
     script.main()
 
 
+@pytest.mark.slow  # >10s; overlapping coverage stays in the bounded tier-1 run
 def test_accelerate_test_smoke_payload():
     """The full `accelerate-tpu test` payload (RNG sync, dataloader prep,
     training_check across precisions, split_between_processes, triggers) runs
