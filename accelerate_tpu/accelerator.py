@@ -783,6 +783,12 @@ class Accelerator:
         from .pipeline.compile_cache import maybe_enable_compile_cache_from_env
 
         maybe_enable_compile_cache_from_env()
+        # ZeRO sharded weight update (ACCELERATE_TPU_ZERO=1): arm the XLA
+        # latency-hiding scheduler flags before the TPU backend boots so the
+        # per-leaf grad reduce-scatters overlap remaining backward compute.
+        from .parallel.zero import maybe_enable_from_env as _zero_flags_from_env
+
+        _zero_flags_from_env()
 
     # -- state passthroughs (reference properties) ---------------------------
 
@@ -1282,6 +1288,7 @@ class Accelerator:
         accum_steps: Optional[int] = None,
         clip_norm: Optional[float] = None,
         clip_value: Optional[float] = None,
+        zero=None,
     ):
         """Build the fused train step: ONE jitted, buffer-donated callable
         running forward+backward, gradient accumulation over the micro-batch
@@ -1300,6 +1307,11 @@ class Accelerator:
                 loss = step_fn(batch)
             for window in windows:        # accum_steps == N: list of N batches
                 losses = step_fn(window)
+
+        ``zero`` opts into the ZeRO-style cross-replica sharded weight update
+        (``parallel/zero.py``: reduce-scatter grads, update the local shard,
+        all-gather params — dp-fold less opt-state HBM per chip and half the
+        grad-sync bandwidth); ``None`` defers to ``ACCELERATE_TPU_ZERO=1``.
         """
         from .pipeline.train_step import make_train_step as _make
 
@@ -1310,6 +1322,7 @@ class Accelerator:
             accum_steps=accum_steps,
             clip_norm=clip_norm,
             clip_value=clip_value,
+            zero=zero,
         )
 
     @_span("accelerator.backward")

@@ -662,10 +662,25 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, **save
     if verified:
         staging_dir = output_dir
 
+        # Manifest records how each optimizer's carried state was laid out at
+        # save time ("replicated", or "zero" with its axes/degree when the
+        # ZeRO fused step sharded it).  The saved payload is always the
+        # GATHERED host form (optimizer.state_dict device_gets), so a resume
+        # may legally change layout — the field documents/validates the
+        # migration rather than gating it (load_accelerator_state logs it).
+        opt_layouts = [
+            getattr(opt, "_opt_state_layout", {"kind": "replicated", "axes": [], "degree": 1})
+            for opt in accelerator._optimizers
+        ]
+
         def _publish_io():
             from .resilience.manifest import fsync_dir, fsync_enabled, write_manifest
 
-            write_manifest(staging_dir, step=step)
+            write_manifest(
+                staging_dir,
+                step=step,
+                extra={"opt_state_layout": opt_layouts} if opt_layouts else None,
+            )
             # Overwriting an existing final dir: move it aside FIRST (one
             # metadata op), swing staging in, then delete the old tree.  The
             # previous checkpoint is destroyed only AFTER the new one is
@@ -784,11 +799,43 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None, **load_
             input_dir = os.path.join(base, existing[-1])
     if input_dir is None:
         raise ValueError("input_dir required")
+    manifest = None
     if verify:
         from .resilience.manifest import read_manifest, verify_checkpoint
 
         if read_manifest(input_dir) is not None:
-            verify_checkpoint(input_dir)
+            manifest = verify_checkpoint(input_dir)
+    if manifest is None:
+        from .resilience.manifest import read_manifest
+
+        manifest = read_manifest(input_dir) or {}
+
+    # Opt-state layout record: the saved payload is the gathered host form,
+    # so resuming a ZeRO (dp-sharded) checkpoint with ZeRO off — or the
+    # reverse — is supported; load_state_dict re-places each leaf onto
+    # whatever layout is live when the next train step builds.  The live
+    # layout is NOT knowable here (the ZeRO decision happens per
+    # make_train_step, usually after load), so validate the field's shape
+    # and surface what was saved rather than guessing a comparison.
+    saved_layouts = manifest.get("opt_state_layout")
+    if saved_layouts is not None:
+        if not isinstance(saved_layouts, list) or not all(
+            isinstance(entry, dict) and "kind" in entry for entry in saved_layouts
+        ):
+            logger.warning(
+                f"checkpoint {input_dir!r} carries a malformed opt_state_layout "
+                f"field ({saved_layouts!r}); ignoring it"
+            )
+        else:
+            for i, saved in enumerate(saved_layouts[: len(accelerator._optimizers)]):
+                if saved.get("kind") == "zero":
+                    logger.info(
+                        f"optimizer {i}: checkpoint opt state was saved under the "
+                        f"ZeRO layout (axes={saved.get('axes')}, "
+                        f"degree={saved.get('degree')}); the gathered payload "
+                        "re-places onto whatever layout the next train step "
+                        "builds — replicated unless ZeRO is enabled again"
+                    )
 
     # load_state pre-hooks (reference accelerator.py:3106-3112): run before
     # any state is restored.

@@ -28,7 +28,10 @@ from .telemetry import span as _span
 __all__ = ["AcceleratedOptimizer"]
 
 
-def _update_body(tx_update, params, opt_state, grads, clip_norm, clip_value, health_ok=None):
+def _update_body(
+    tx_update, params, opt_state, grads, clip_norm, clip_value, health_ok=None,
+    norm_ndp=None,
+):
     """One optimizer update (traced body shared by the jit variants).
 
     ``clip_norm`` / ``clip_value`` < 0 disable the respective clip (static
@@ -47,8 +50,40 @@ def _update_body(tx_update, params, opt_state, grads, clip_norm, clip_value, hea
     dispatch, no host round-trip.  The returned ``health_norm`` is that
     pre-clip norm, forced non-finite whenever the verdict failed, so the host
     can detect the skip from a value it was reading anyway.
+
+    ``norm_ndp`` (static; set by every caller on a mesh with active
+    data-parallel axes, ``parallel/zero.py:zero_degree``) switches the two
+    global norms to the canonical dp-chunked association and select-fences
+    the update's dataflow boundaries.  Both are numerics-parity devices for
+    the ZeRO sharded update: the chunked norm reduces identically over a
+    replicated and a dp-sharded gradient tree, and the fences (selects on an
+    always-true-at-runtime pred) stop XLA from FMA-contracting multiplies
+    across stage boundaries differently in differently-partitioned programs.
+    Selects pass values through bit-exactly, so on any single program this is
+    a no-op numerically; across the eager / fused / fused+ZeRO programs it is
+    what makes them agree to the last bit (tests/test_zero.py matrix).  With
+    ``norm_ndp=None`` (no dp axes — the overwhelmingly common single-device
+    test path) this body is exactly the legacy one.
     """
-    health_norm = optax.global_norm(grads)
+    if norm_ndp:
+        from .parallel.zero import chunked_global_norm
+
+        # Runtime-true, compile-time-opaque fence pred.  x == x is the
+        # NaN-check: True for every real clip argument INCLUDING inf
+        # (clip_grad_norm_(inf) is the standard measure-without-clipping
+        # idiom and must not trip the fence), never constant-foldable for
+        # floats.  ANDing health_ok keeps the poisoned-step semantics:
+        # zeroed grads make the norms finite, but ``ok`` still fails via
+        # health_ok and health_norm is forced NaN below.
+        fence = jnp.logical_and(clip_norm == clip_norm, clip_value == clip_value)
+        if health_ok is not None:
+            fence = jnp.logical_and(fence, health_ok)
+        grads = jax.tree_util.tree_map(
+            lambda g: jnp.where(fence, g, jnp.zeros_like(g)), grads
+        )
+        health_norm = chunked_global_norm(grads, norm_ndp, fence)
+    else:
+        health_norm = optax.global_norm(grads)
     ok = jnp.isfinite(health_norm)
     if health_ok is not None:
         ok = jnp.logical_and(ok, health_ok)
@@ -56,12 +91,23 @@ def _update_body(tx_update, params, opt_state, grads, clip_norm, clip_value, hea
     grads = jax.tree_util.tree_map(
         lambda g: jnp.where(clip_value >= 0, jnp.clip(g, -clip_value, clip_value), g), grads
     )
-    gnorm = optax.global_norm(grads)
+    if norm_ndp:
+        gnorm = chunked_global_norm(grads, norm_ndp, fence)
+    else:
+        gnorm = optax.global_norm(grads)
     scale = jnp.where(
         clip_norm >= 0, jnp.minimum(1.0, clip_norm / jnp.maximum(gnorm, 1e-12)), 1.0
     )
     grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+    if norm_ndp:
+        grads = jax.tree_util.tree_map(
+            lambda g: jnp.where(ok, g, jnp.zeros_like(g)), grads
+        )
     updates, new_opt_state = tx_update(grads, opt_state, params)
+    if norm_ndp:
+        updates = jax.tree_util.tree_map(
+            lambda u: jnp.where(ok, u, jnp.zeros_like(u)), updates
+        )
     new_params = optax.apply_updates(params, updates)
     new_params = jax.tree_util.tree_map(
         lambda n, o: jnp.where(ok, n, o), new_params, params
@@ -118,6 +164,10 @@ class AcceleratedOptimizer:
         # so only HealthGuard.check() (or the user) ever floats them.
         self._last_grad_norm = None
         self._last_health_norm = None
+        # Checkpoint-manifest record of the carried opt-state layout; the
+        # ZeRO fused step (pipeline/train_step.py) flips it to its sharded
+        # descriptor when it re-places the state.
+        self._opt_state_layout = {"kind": "replicated", "axes": [], "degree": 1}
         if model is not None:
             self._init_state()
 
@@ -139,6 +189,27 @@ class AcceleratedOptimizer:
             else:
                 self.tx = host_offload(self.tx)
         self.opt_state = self.tx.init(self.model.params)
+        self._build_update_fn()
+
+    def _norm_ndp(self) -> Optional[int]:
+        """Static dp-chunking degree for the canonical global norm — set on
+        any mesh with active data-parallel axes so the eager update, the
+        fused step and the ZeRO fused step all reduce in the same association
+        (see ``_update_body``); None on dp=1 meshes keeps the legacy path."""
+        mesh = getattr(self.accelerator_state, "mesh", None)
+        if mesh is None:
+            return None
+        from .parallel.zero import supported, zero_degree
+
+        if not supported(mesh)[0]:
+            # Model-axis meshes keep the legacy norm (ZeRO can't run there,
+            # and the chunked reshape would fight fsdp/tp layouts).
+            return None
+        ndp = zero_degree(mesh)
+        return ndp if ndp > 1 else None
+
+    def _build_update_fn(self):
+        body = partial(_update_body, self.tx.update, norm_ndp=self._norm_ndp())
         if self._host_offload_requested:
             if jax.default_backend() == "tpu":
                 # The carried state must come back in host memory: pin the out
@@ -149,7 +220,7 @@ class AcceleratedOptimizer:
                     self.opt_state,
                 )
                 self._update_fn = jax.jit(
-                    partial(_update_body, self.tx.update),
+                    body,
                     donate_argnums=(0, 1),
                     out_shardings=(None, opt_sh, None, None),
                 )
@@ -158,7 +229,12 @@ class AcceleratedOptimizer:
                 # inside jit (the state silently returns in device memory —
                 # numerics identical); donating the pinned_host input against
                 # a device-kind output would crash, so no donation here.
-                self._update_fn = jax.jit(partial(_update_body, self.tx.update))
+                self._update_fn = jax.jit(body)
+        else:
+            # Same donation contract as the legacy module-level _update_step
+            # (params + opt state); per-optimizer so the static norm_ndp and
+            # this optimizer's tx ride the closure.
+            self._update_fn = jax.jit(body, donate_argnums=(0, 1))
 
     # -- torch-optimizer-shaped surface -------------------------------------
 
@@ -223,6 +299,9 @@ class AcceleratedOptimizer:
         clip_value = self._clip_value if self._clip_value_once is None else self._clip_value_once
         self._clip_norm_once = None
         self._clip_value_once = None
+        if self._update_fn is None and self.tx is not None:
+            # Rebuilt lazily after unpickle (the jitted closure doesn't pickle).
+            self._build_update_fn()
         if self._update_fn is not None:
             new_params, self.opt_state, gnorm, health_norm = self._update_fn(
                 self.model.params,
@@ -263,7 +342,14 @@ class AcceleratedOptimizer:
     # the transform rebuilds from the picklable shadow torch optimizer, and
     # the model re-pairs at the next prepare() (same contract as Accelerator).
     def __getstate__(self):
-        state = {k: v for k, v in self.__dict__.items() if k not in ("tx", "model")}
+        state = {
+            k: v
+            for k, v in self.__dict__.items()
+            if k not in ("tx", "model", "_update_fn")
+        }
+        # Jitted update (a closure over tx.update) is unpicklable; it rebuilds
+        # lazily in _apply_update after the next prepare() re-pairs a model.
+        state["_update_fn"] = None
         state["opt_state"] = jax.device_get(self.opt_state) if self.opt_state is not None else None
         return state
 

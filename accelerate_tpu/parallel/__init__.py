@@ -1,1 +1,2 @@
 from .mesh import build_mesh, data_axes, local_mesh_shape, mesh_axis_names, model_axes
+from .zero import ZeROConfig, zero_axes, zero_degree

@@ -24,7 +24,9 @@ optimization auto-wrap exists for.
 
 from __future__ import annotations
 
+import contextlib
 import re
+import threading
 from typing import Any, Optional
 
 import numpy as np
@@ -45,7 +47,32 @@ __all__ = [
     "batch_spec",
     "constrain",
     "embed_lookup",
+    "manual_region",
 ]
+
+
+# Thread-local "inside a shard_map manual region" latch (parallel/zero.py
+# traces the model forward/backward under shard_map with every mesh axis
+# manual).  with_sharding_constraint on a manual axis is an error there, and
+# the constraints are layout hints the manual region has already realized —
+# so constrain() becomes a no-op while the latch is set.
+_MANUAL = threading.local()
+
+
+@contextlib.contextmanager
+def manual_region():
+    """Mark the current (tracing) thread as inside a fully-manual shard_map
+    region: :func:`constrain` passes values through untouched."""
+    prev = getattr(_MANUAL, "active", False)
+    _MANUAL.active = True
+    try:
+        yield
+    finally:
+        _MANUAL.active = prev
+
+
+def in_manual_region() -> bool:
+    return getattr(_MANUAL, "active", False)
 
 
 def _abstract_mesh():
@@ -80,6 +107,11 @@ def constrain(x: jax.Array, spec: P) -> jax.Array:
     are pruned per-dimension rather than dropping the whole constraint, so a
     user-installed mesh with a subset of our named axes still gets the valid
     placement hints."""
+    if in_manual_region():
+        # Inside the ZeRO shard_map region every mesh axis is manual: the
+        # sharding is physically realized by the in/out specs, and a wsc
+        # naming a manual axis would be an error.
+        return x
     m = _abstract_mesh()
     if m is None or m.empty or not m.axis_names:
         return x

@@ -1,0 +1,436 @@
+"""Cross-replica sharded weight update (ZeRO) for the fused train step.
+
+Under pure data parallelism every chip holds a full replica of the
+parameters AND the optimizer state, and every optimizer step redundantly
+recomputes the identical optax update on all of them — O(params) wasted
+compute and O(2x params, for Adam) wasted HBM per dp replica, synced by one
+monolithic blocking gradient all-reduce.  This module implements the recipe
+of "Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+Training" (arXiv:2004.13336) inside the single-dispatch fused program
+(``pipeline/train_step.py``):
+
+- **reduce-scatter** the gradients over the data-parallel mesh axes
+  (``dcn_dp`` x ``dp``) instead of all-reducing them — each replica receives
+  only the summed *shard* it will update (half the bandwidth of an
+  all-reduce);
+- run gradient clipping and the optax update **on the local shard** — the
+  optimizer state lives dp-sharded in HBM across steps (``out_shardings``
+  pins it there under buffer donation), shrinking opt-state HBM per chip by
+  the dp degree and the update FLOPs with it;
+- **all-gather** the updated parameters back to replicated form for the next
+  forward.
+
+Comms accounting (the introspection ledger makes this visible): the dp
+``all-reduce == param-bytes`` invariant becomes ``reduce-scatter +
+all-gather ~= param-bytes`` each — same per-step bytes at accum=1, and at
+``accum_steps = N`` the window pays N reduce-scatters (half an all-reduce
+each) plus ONE all-gather instead of N full all-reduces.
+
+Comms/compute overlap (2BP, arXiv:2405.18047): the reduce-scatters are
+emitted *per gradient leaf*, so XLA's latency-hiding scheduler can issue
+each leaf's collective as soon as its backward slice finishes while the
+remaining gradients are still computing.  On TPU the
+:func:`enable_overlap_flags` knob turns on the async-collective-fusion XLA
+pass family that performs that overlap; on CPU the flags are inert and the
+scheduling freedom is still in the HLO.
+
+Numerics: the update math is elementwise, so sharding it is exact — but the
+*global-norm* clip reduces across the whole gradient tree, and a reduction's
+result depends on its association order.  :func:`chunked_global_norm`
+computes the norm in a canonical dp-chunked association (per-chunk partial
+sums combined in a fixed sequential order) that is identical whether the
+tree is replicated or dp-sharded; ``_update_body`` (optimizer.py) uses it on
+every path (eager, fused, fused+ZeRO) whenever the mesh has active dp axes,
+which is what makes the ZeRO step bit-exact against the unsharded fused step
+(asserted by ``tests/test_zero.py`` and ``make zero-smoke``).
+
+Scope: ZeRO engages on the dp-like axes of a mesh with **no active model
+axes** — under ``fsdp`` the optimizer state is already sharded (ZeRO-3 is
+the FULL_SHARD strategy in ``parallel/sharding.py``), and ``tp``/``sp``/
+``ep``/``pp`` meshes interleave model collectives with the step in ways the
+manual dp region does not compose with.  ``supported()`` reports the exact
+reason when it declines, and ``make_train_step`` falls back to the standard
+fused path with a warning.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import warnings
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+__all__ = [
+    "ENV_ZERO",
+    "ENV_ZERO_OVERLAP",
+    "ZERO_AXES",
+    "ZeROConfig",
+    "zero_axes",
+    "zero_degree",
+    "shard_dim",
+    "shard_spec",
+    "shard_shape",
+    "chunked_global_norm",
+    "shard_opt_state",
+    "opt_state_shardings",
+    "opt_state_layout",
+    "per_chip_bytes",
+    "supported",
+    "enable_overlap_flags",
+    "LATENCY_HIDING_TPU_FLAGS",
+]
+
+ENV_ZERO = "ACCELERATE_TPU_ZERO"
+ENV_ZERO_OVERLAP = "ACCELERATE_TPU_ZERO_OVERLAP"
+
+_TRUTHY = {"1", "true", "yes", "on"}
+
+# Mesh axes the weight update may be sharded over: the pure data-parallel
+# axes.  ``fsdp`` is deliberately absent — FULL_SHARD already shards the
+# update (ZeRO-3); this module covers the replicated (DDP-style) remainder.
+ZERO_AXES = ("dcn_dp", "dp")
+
+# Model axes whose activity disqualifies the manual dp region (their
+# collectives live inside the model forward/backward, which ZeRO runs under
+# shard_map with the dp axes manual).
+_MODEL_AXES = ("fsdp", "pp", "sp", "ep", "tp")
+
+# XLA's latency-hiding scheduler knobs for overlapping the per-leaf
+# reduce-scatters with the remaining backward compute (the 2BP effect).
+# Applied to LIBTPU_INIT_ARGS — TPU-only; other backends ignore them.
+LATENCY_HIDING_TPU_FLAGS = (
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+    "--xla_enable_async_all_gather=true",
+)
+
+
+def _env_truthy(name: str, default: bool = False) -> bool:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() in _TRUTHY
+
+
+@dataclasses.dataclass
+class ZeROConfig:
+    """How ``make_train_step`` shards the weight update.
+
+    ``enabled``: shard the update across the dp axes (``ACCELERATE_TPU_ZERO=1``
+    is the env spelling).  ``overlap``: wire the XLA latency-hiding flags for
+    async per-leaf grad collectives (TPU only; default follows ``enabled``
+    unless ``ACCELERATE_TPU_ZERO_OVERLAP=0``).
+    """
+
+    enabled: bool = False
+    overlap: Optional[bool] = None
+
+    @classmethod
+    def from_env(cls) -> "ZeROConfig":
+        enabled = _env_truthy(ENV_ZERO)
+        overlap = None
+        if os.environ.get(ENV_ZERO_OVERLAP) is not None:
+            overlap = _env_truthy(ENV_ZERO_OVERLAP)
+        return cls(enabled=enabled, overlap=overlap)
+
+    @classmethod
+    def resolve(cls, zero) -> "ZeROConfig":
+        """Normalize a ``make_train_step(zero=...)`` argument: None defers to
+        the env, a bool toggles, a ZeROConfig passes through."""
+        if zero is None:
+            return cls.from_env()
+        if isinstance(zero, ZeROConfig):
+            return zero
+        return cls(enabled=bool(zero))
+
+    @property
+    def overlap_effective(self) -> bool:
+        return self.enabled if self.overlap is None else self.overlap
+
+
+# ---------------------------------------------------------------------------
+# Shard geometry
+# ---------------------------------------------------------------------------
+
+
+def zero_axes(mesh: Optional[Mesh]) -> tuple[str, ...]:
+    """Active (size > 1) data-parallel axes the update can shard over."""
+    if mesh is None:
+        return ()
+    return tuple(a for a in ZERO_AXES if a in mesh.axis_names and mesh.shape[a] > 1)
+
+
+def zero_degree(mesh: Optional[Mesh]) -> int:
+    """Total shard count across the active ZeRO axes (1 = nothing to shard)."""
+    n = 1
+    for a in zero_axes(mesh):
+        n *= mesh.shape[a]
+    return n
+
+
+def shard_dim(shape: tuple[int, ...], degree: int) -> Optional[int]:
+    """The dimension a leaf is sharded (and its norm chunked) along: the
+    largest dim divisible by ``degree`` (ties break to the lowest index —
+    ``sorted`` is stable).  None = the leaf stays replicated.  This single
+    deterministic rule is shared by gradient scatter, opt-state placement,
+    ``out_shardings`` and the chunked norm — they must agree leaf-for-leaf.
+    """
+    if degree <= 1 or not shape:
+        return None
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for i in order:
+        if shape[i] % degree == 0 and shape[i] >= degree:
+            return i
+    return None
+
+
+def shard_spec(shape: tuple[int, ...], axes: tuple[str, ...], degree: int) -> P:
+    """PartitionSpec placing the ZeRO axes on the leaf's shard dim."""
+    d = shard_dim(shape, degree)
+    entries: list = [None] * len(shape)
+    if d is not None and axes:
+        entries[d] = axes if len(axes) > 1 else axes[0]
+    return P(*entries)
+
+
+def shard_shape(shape: tuple[int, ...], degree: int) -> tuple[int, ...]:
+    """Per-device shape of a leaf under the ZeRO sharding rule."""
+    d = shard_dim(shape, degree)
+    if d is None:
+        return tuple(shape)
+    out = list(shape)
+    out[d] //= degree
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# Canonical (layout-independent) global norm
+# ---------------------------------------------------------------------------
+
+
+# Above this dp degree the sequential chunk combine rolls into a fori_loop
+# (same ((c0+c1)+c2)... association, O(1) HLO instead of O(degree) unrolled
+# slice+add chains).  Below it the combine stays unrolled — the form every
+# bit-exactness matrix in the suite runs.
+_COMBINE_UNROLL_MAX = 64
+
+
+def _sequential_combine(vec: jax.Array, degree: int) -> jax.Array:
+    """Sum a ``[degree]`` chunk-partial vector in strict left-to-right order
+    (the association both the replicated and dp-sharded norm programs must
+    share).  Large degrees first pin the vector replicated (one tiny
+    all-gather on the sharded layout, a no-op on the replicated one) so the
+    loop's dynamic indexing is local, then run a scalar-carry fori_loop."""
+    if degree <= _COMBINE_UNROLL_MAX:
+        total = vec[0]
+        for k in range(1, degree):
+            total = total + vec[k]
+        return total
+    vec = jax.lax.with_sharding_constraint(vec, P())
+    return jax.lax.fori_loop(1, degree, lambda i, t: t + vec[i], vec[0])
+
+
+def chunked_global_norm(tree: Any, degree: int, fence) -> jax.Array:
+    """Global L2 norm of a gradient pytree in the canonical dp-chunked
+    association.
+
+    Why not ``optax.global_norm``: a reduction's floating-point result
+    depends on its association order, and XLA picks different orders for a
+    replicated ``[N]`` reduce than for a dp-sharded ``[N/degree]``-local
+    reduce + cross-replica sum.  This formula fixes one order both layouts
+    lower to identically:
+
+    - per shardable leaf, reshape the shard dim into ``(degree, size/degree)``
+      and reduce each chunk to a scalar (on the sharded layout each device
+      reduces exactly its own chunk — zero communication);
+    - sum the per-chunk vectors elementwise across leaves;
+    - combine the ``degree`` chunk partials with an EXPLICIT sequential add
+      chain (``((c0+c1)+c2)+...`` — never a shape-dependent tree reduce);
+    - add unshardable (replicated) leaves' sum-of-squares in tree order.
+
+    ``fence`` is a traced boolean (True on every healthy step) used to
+    select-guard each squared term: the select blocks XLA from contracting
+    the square into the reduce-add as an FMA, whose rounding would otherwise
+    differ between fusion contexts.  Selects pass values through bit-exactly.
+    """
+    chunk_vecs = None
+    rep_total = None
+
+    def sq(x):
+        return jnp.where(fence, jnp.square(x), jnp.zeros_like(x))
+
+    for g in jax.tree_util.tree_leaves(tree):
+        shape = tuple(jnp.shape(g))
+        d = shard_dim(shape, degree)
+        if d is None:
+            s = jnp.sum(sq(g))
+            rep_total = s if rep_total is None else rep_total + s
+        else:
+            shp = shape[:d] + (degree, shape[d] // degree) + shape[d + 1:]
+            axes = tuple(i for i in range(len(shp)) if i != d)
+            v = jnp.sum(sq(jnp.reshape(g, shp)), axis=axes)  # [degree]
+            chunk_vecs = v if chunk_vecs is None else chunk_vecs + v
+    if chunk_vecs is not None:
+        total = _sequential_combine(chunk_vecs, degree)
+    else:
+        total = jnp.asarray(0.0, jnp.float32)
+    if rep_total is not None:
+        total = total + rep_total
+    return jnp.sqrt(total)
+
+
+# ---------------------------------------------------------------------------
+# Optimizer-state placement
+# ---------------------------------------------------------------------------
+
+
+def opt_state_shardings(opt_state: Any, mesh: Mesh) -> Any:
+    """NamedSharding tree pinning every shardable opt-state leaf to its ZeRO
+    shard (None for leaves that stay wherever they are — notably uncommitted
+    scalar leaves like optax's ``count``, which a ``device_put`` would pin to
+    one device and break later jit placement against multi-device params).
+    Pinned-host (offloaded) leaves keep their memory kind: the state shards
+    *and* offloads."""
+    axes = zero_axes(mesh)
+    degree = zero_degree(mesh)
+
+    def one(leaf):
+        if not isinstance(leaf, jax.Array):
+            return None
+        shape = tuple(leaf.shape)
+        if shard_dim(shape, degree) is None:
+            return None
+        sharding = NamedSharding(mesh, shard_spec(shape, axes, degree))
+        kind = getattr(leaf.sharding, "memory_kind", None)
+        if kind is not None:
+            try:
+                default_kind = next(iter(leaf.sharding.device_set)).default_memory().kind
+            except Exception:
+                default_kind = None
+            if default_kind is not None and kind != default_kind:
+                sharding = sharding.with_memory_kind(kind)
+        return sharding
+
+    return jax.tree_util.tree_map(one, opt_state)
+
+
+def shard_opt_state(opt_state: Any, mesh: Mesh) -> tuple[Any, Any]:
+    """Place the live opt state onto its ZeRO shards; returns
+    ``(new_state, shardings)`` where ``shardings`` mirrors the tree (None for
+    untouched leaves).  Host-offloaded leaves shard *before* they offload —
+    each host pins only its own shard bytes."""
+    shardings = opt_state_shardings(opt_state, mesh)
+    placed = jax.tree_util.tree_map(
+        lambda leaf, s: leaf if s is None else jax.device_put(leaf, s),
+        opt_state,
+        shardings,
+        is_leaf=lambda x: x is None,
+    )
+    return placed, shardings
+
+
+def per_chip_bytes(tree: Any) -> int:
+    """Per-device byte footprint of a pytree of jax Arrays (the HBM-shrink
+    observable: opt-state bytes/chip drop ~dp-fold under ZeRO)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if isinstance(leaf, jax.Array):
+            local = leaf.sharding.shard_shape(leaf.shape)
+            total += int(np.prod(local)) * leaf.dtype.itemsize
+    return total
+
+
+def opt_state_layout(mesh: Optional[Mesh], enabled: bool) -> dict:
+    """Checkpoint-manifest record of how the optimizer state was laid out at
+    save time.  Loading re-places leaves onto the live layout either way
+    (``state_dict`` gathers to host first), so this field documents and
+    validates the migration rather than gating it."""
+    if enabled and mesh is not None and zero_degree(mesh) > 1:
+        return {
+            "kind": "zero",
+            "axes": list(zero_axes(mesh)),
+            "degree": zero_degree(mesh),
+        }
+    return {"kind": "replicated", "axes": [], "degree": 1}
+
+
+# ---------------------------------------------------------------------------
+# Eligibility
+# ---------------------------------------------------------------------------
+
+
+def supported(mesh: Optional[Mesh]) -> tuple[bool, str]:
+    """Whether the ZeRO fused step can run on ``mesh``; (ok, reason)."""
+    if mesh is None:
+        return False, "no device mesh (prepare() not run?)"
+    axes = zero_axes(mesh)
+    if not axes:
+        return False, (
+            "no active data-parallel axis to shard over "
+            f"(mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))})"
+        )
+    active_model = [a for a in _MODEL_AXES if a in mesh.axis_names and mesh.shape[a] > 1]
+    if active_model:
+        return False, (
+            f"mesh has active model axes {active_model}; under fsdp the "
+            "optimizer state is already sharded (FULL_SHARD == ZeRO-3), and "
+            "tp/sp/ep/pp model collectives do not compose with the manual "
+            "dp region"
+        )
+    return True, ""
+
+
+# ---------------------------------------------------------------------------
+# Latency-hiding (overlap) flags
+# ---------------------------------------------------------------------------
+
+_overlap_enabled = False
+
+
+def enable_overlap_flags(warn_if_late: bool = True) -> bool:
+    """Compose the async-collective-fusion flag family into
+    ``LIBTPU_INIT_ARGS`` (idempotent; existing user flags win).  Must run
+    before the TPU backend initializes to take effect — called from
+    ``Accelerator.__init__`` via ``ACCELERATE_TPU_ZERO=1`` and from
+    ``make_train_step`` as a best-effort backstop.  Returns True when the
+    flags are (already) in place."""
+    global _overlap_enabled
+    existing = os.environ.get("LIBTPU_INIT_ARGS", "")
+    missing = [f for f in LATENCY_HIDING_TPU_FLAGS if f.split("=")[0] not in existing]
+    if not missing:
+        _overlap_enabled = True
+        return True
+    backend_up = False
+    try:
+        from jax._src import xla_bridge
+
+        backend_up = bool(xla_bridge._backends)
+    except Exception:
+        backend_up = False
+    os.environ["LIBTPU_INIT_ARGS"] = (existing + " " + " ".join(missing)).strip()
+    if backend_up and warn_if_late and jax.default_backend() == "tpu":
+        warnings.warn(
+            "ZeRO overlap flags were composed into LIBTPU_INIT_ARGS after the "
+            "TPU backend initialized — they take effect on the next process. "
+            "Set ACCELERATE_TPU_ZERO=1 (or call enable_overlap_flags()) before "
+            "the first jax operation."
+        )
+    _overlap_enabled = True
+    return not backend_up
+
+
+def maybe_enable_from_env() -> None:
+    """Accelerator.__init__ hook: arm the overlap flags early when ZeRO is
+    requested via env so the backend boots with the scheduler knobs on."""
+    cfg = ZeROConfig.from_env()
+    if cfg.enabled and cfg.overlap_effective:
+        enable_overlap_flags(warn_if_late=False)

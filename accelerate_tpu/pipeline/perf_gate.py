@@ -18,7 +18,12 @@ This gate re-runs a bounded version of that probe on CPU and asserts the
   ~2.1×; the floor is far below it so CI load cannot flake the gate, while a
   real fused-path rot — which lands the ratio at ~1.0 — still fails loudly);
 - fused-path host-blocked ms/step under a generous ceiling (catches a
-  reintroduced synchronous host round-trip, not scheduler jitter).
+  reintroduced synchronous host round-trip, not scheduler jitter);
+- a **ZeRO row** (multi-device runs): the sharded-update fused step must
+  report ``zero_active`` (the silent-fallback-to-replicated tripwire),
+  still run at ``dispatches/step == 1`` and hold the same fused-vs-eager
+  ratio floor — a regression that quietly rebuilds the replicated update
+  fails in tier-1, not on the next TPU window.
 
 Absolute steps/s are *reported* but never gated — a 2-core CI box drifts
 ±50% run to run; ratios and dispatch counts don't.
@@ -30,6 +35,8 @@ regression fails the test suite even when no TPU answers.
 ``ACCELERATE_TPU_PERF_GATE_DEGRADE=eager`` replaces the fused arm with the
 eager loop — the knob that *proves* the gate fails when the fused path is
 degraded (dispatches/step jumps to ``3 × accum``, the ratio collapses to ~1).
+``=zero-fallback`` runs the ZeRO arm with the replicated update — the knob
+that proves the ``zero_active`` tripwire catches a silent fallback.
 """
 
 from __future__ import annotations
@@ -161,11 +168,11 @@ def run_probe(
         per_step_dispatch = (dispatches.value - d0) / (epochs * steps)
         return steps / best_dt, per_step_dispatch, best_blocked / steps * 1e3
 
-    def fused_arm():
+    def fused_arm(zero=None):
         import jax
 
         acc, model, opt, dl = build()
-        step_fn = acc.make_train_step(model, opt)
+        step_fn = acc.make_train_step(model, opt, zero=zero)
 
         def one_epoch():
             blocked = 0.0
@@ -193,18 +200,42 @@ def run_probe(
             if dt < best_dt:
                 best_dt, best_blocked = dt, blocked
         per_step_dispatch = (dispatches.value - d0) / (epochs * steps)
-        return steps / best_dt, per_step_dispatch, best_blocked / steps * 1e3
+        return (
+            steps / best_dt,
+            per_step_dispatch,
+            best_blocked / steps * 1e3,
+            step_fn.zero_active,
+        )
 
     try:
         eager_sps, eager_disp, eager_blocked = eager_arm()
         if degrade == "eager":
             fused_sps, fused_disp, fused_blocked = eager_arm()
         else:
-            fused_sps, fused_disp, fused_blocked = fused_arm()
+            # zero=False pinned: the baseline arm must measure the replicated
+            # fused step even when the operator exports ACCELERATE_TPU_ZERO=1
+            # (zero=None would defer to that env and skew every ratio).
+            fused_sps, fused_disp, fused_blocked, _ = fused_arm(zero=False)
+        # ZeRO row: only meaningful on a multi-device mesh (a 1-device run
+        # has no dp axis to shard over — the arm is skipped, and evaluate()
+        # skips its judgments when zero_active is None).
+        import jax
+        import warnings
+
+        zero_sps = zero_disp = zero_blocked = None
+        zero_active = None
+        if jax.device_count() >= 2:
+            with warnings.catch_warnings():
+                # The deliberate zero-fallback degrade warns; the probe's
+                # numbers are the signal, not the warning.
+                warnings.simplefilter("ignore")
+                zero_sps, zero_disp, zero_blocked, zero_active = fused_arm(
+                    zero=False if degrade == "zero-fallback" else True
+                )
     finally:
         if owns_telemetry:
             telemetry.disable()
-    return {
+    measurements = {
         "probe": {
             "accum_steps": accum,
             "optimizer_steps": steps,
@@ -221,7 +252,18 @@ def run_probe(
         "dispatches_per_step": fused_disp,
         "fused_host_blocked_ms_per_step": round(fused_blocked, 3),
         "eager_host_blocked_ms_per_step": round(eager_blocked, 3),
+        "zero_active": zero_active,
     }
+    if zero_sps is not None:
+        measurements.update(
+            {
+                "zero_steps_per_s": round(zero_sps, 2),
+                "zero_vs_eager_ratio": round(zero_sps / max(eager_sps, 1e-9), 3),
+                "zero_dispatches_per_step": zero_disp,
+                "zero_host_blocked_ms_per_step": round(zero_blocked, 3),
+            }
+        )
+    return measurements
 
 
 def evaluate(measurements: dict, baseline: dict) -> list:
@@ -251,6 +293,38 @@ def evaluate(measurements: dict, baseline: dict) -> list:
             f"ms/step > baseline max {max_blocked} — a synchronous host wait "
             "crept back into the hot loop"
         )
+    # ZeRO row: judged only when the arm ran (multi-device probe).  A run
+    # where the sharded update silently fell back to the replicated one is
+    # exactly the regression this row exists to catch.
+    zero_active = measurements.get("zero_active")
+    if zero_active is not None or "zero_dispatches_per_step" in measurements:
+        if baseline.get("require_zero_active") and zero_active is False:
+            failures.append(
+                "zero_active is False — the ZeRO sharded update silently fell "
+                "back to the replicated fused update"
+            )
+        max_zero_disp = baseline.get("max_zero_dispatches_per_step")
+        if (
+            max_zero_disp is not None
+            and measurements.get("zero_dispatches_per_step") is not None
+            and measurements["zero_dispatches_per_step"] > max_zero_disp + 1e-9
+        ):
+            failures.append(
+                f"ZeRO dispatches/step {measurements['zero_dispatches_per_step']:.2f} > "
+                f"baseline max {max_zero_disp} — the sharded update broke the "
+                "one-dispatch fused window"
+            )
+        min_zero_ratio = baseline.get("min_zero_vs_eager_ratio")
+        if (
+            min_zero_ratio is not None
+            and measurements.get("zero_vs_eager_ratio") is not None
+            and measurements["zero_vs_eager_ratio"] < min_zero_ratio
+        ):
+            failures.append(
+                f"ZeRO-vs-eager steps/s ratio {measurements['zero_vs_eager_ratio']:.3f} < "
+                f"baseline min {min_zero_ratio} — the sharded update lost the "
+                "fused-path speedup"
+            )
     return failures
 
 
@@ -266,12 +340,21 @@ def run_gate(baseline_path: Optional[str] = None, probe_kwargs: Optional[dict] =
         for failure in failures:
             print(f"PERF GATE FAIL: {failure}", file=sys.stderr, flush=True)
         return 1
+    zero_note = ""
+    if measurements.get("zero_vs_eager_ratio") is not None:
+        zero_note = (
+            f", ZeRO {measurements['zero_vs_eager_ratio']}x at "
+            f"{measurements['zero_dispatches_per_step']:.0f} dispatch/step"
+        )
+    elif measurements.get("zero_active") is None:
+        zero_note = ", ZeRO row skipped (single-device probe)"
     print(
         "perf-gate OK — "
         f"fused/eager {measurements['fused_vs_eager_ratio']}x "
         f"({measurements['eager_steps_per_s']} -> {measurements['fused_steps_per_s']} steps/s), "
         f"{measurements['dispatches_per_step']:.0f} dispatch/step, "
         f"host-blocked {measurements['fused_host_blocked_ms_per_step']} ms/step"
+        + zero_note
     )
     return 0
 

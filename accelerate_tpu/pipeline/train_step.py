@@ -16,6 +16,14 @@ window into one compiled program:
 
 Params and optimizer state are donated, so the update is in-place in device
 memory and the gradient window never materializes on the host.
+
+``zero=True`` (or ``ACCELERATE_TPU_ZERO=1``) swaps the window's gradient
+engine for the ZeRO cross-replica sharded update (``parallel/zero.py``):
+per-device forward+backward under a manual dp region, per-leaf
+reduce-scatter instead of the monolithic gradient all-reduce, the clip +
+optax update on the local shard (opt state lives dp-sharded in HBM between
+steps), and one params all-gather per window — still a single dispatch, and
+bit-exact with the unsharded step on power-of-two dp degrees.
 """
 
 from __future__ import annotations
@@ -69,6 +77,7 @@ class TrainStep:
         accum_steps: Optional[int] = None,
         clip_norm: Optional[float] = None,
         clip_value: Optional[float] = None,
+        zero=None,
     ):
         from ..accelerator import PreparedModel
         from ..optimizer import AcceleratedOptimizer
@@ -116,19 +125,57 @@ class TrainStep:
         self._jit = None
         self._introspect_pending = True
         self._poison_armed = False  # resolved at trace time in _build_jit
+        # ZeRO sharded weight update (parallel/zero.py): resolved here (arg >
+        # ACCELERATE_TPU_ZERO env), eligibility-checked against the mesh at
+        # _build_jit.  ``zero_active`` is the observable truth of which
+        # program was built.
+        from ..parallel.zero import ZeROConfig
+
+        self.zero_config = ZeROConfig.resolve(zero)
+        self.zero_active = False
 
     # -- program construction -------------------------------------------------
+
+    def _resolve_zero(self):
+        """Eligibility-check the requested ZeRO config against the live mesh;
+        arms ``zero_active`` and (on TPU) the overlap scheduler flags."""
+        from ..parallel import zero as zero_mod
+
+        if not self.zero_config.enabled:
+            return
+        ok, reason = zero_mod.supported(self.accelerator.mesh)
+        if not ok:
+            import warnings
+
+            warnings.warn(
+                f"ZeRO sharded update requested but unsupported here: {reason}. "
+                "Falling back to the replicated fused update."
+            )
+            return
+        self.zero_active = True
+        if self.zero_config.overlap_effective:
+            zero_mod.enable_overlap_flags()
 
     def _build_jit(self):
         if self._jit is not None:
             return
         from ..optimizer import _update_body
+        from ..parallel import zero as zero_mod
         from ..resilience import faultinject
 
+        self._resolve_zero()
         model = self.model
+        mesh = self.accelerator.mesh
         tx_update = self.optimizer.tx.update
         accum = self.accum_steps
         scale = 1.0 / accum
+        # Canonical-norm chunking degree: set on any ZeRO-capable mesh so
+        # eager / fused / fused+ZeRO clip with the same reduction association
+        # (optimizer._update_body) — ZeRO on or off.  Meshes with active
+        # model axes keep the legacy norm: ZeRO can't run there, and chunked
+        # reshapes of fsdp/tp-sharded gradients would invite resharding.
+        ndp = zero_mod.zero_degree(mesh) if zero_mod.supported(mesh)[0] else 1
+        norm_ndp = ndp if ndp > 1 else None
         # Trace-time fork: only a NaN-fault-armed process carries the poison
         # scalar in its program signature — production programs are untouched.
         # Either way the window stays ONE dispatch (the health-smoke proof).
@@ -155,8 +202,29 @@ class TrainStep:
 
             return jax.value_and_grad(lossf)(params)
 
+        if self.zero_active:
+            grads_and_losses = self._build_zero_grads_fn(_loss_and_grads, _scaled)
+            # Where the updated param shards gather back to: each leaf's live
+            # sharding (replicated over dp on a pure-dp mesh).
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            gather_sh = jax.tree_util.tree_map(
+                lambda p: p.sharding
+                if isinstance(p, jax.Array) and isinstance(p.sharding, NamedSharding)
+                else NamedSharding(mesh, PartitionSpec()),
+                model.params,
+            )
+        else:
+            grads_and_losses = None
+            gather_sh = None
+
         def step(params, opt_state, batches, clip_norm, clip_value, *fault):
-            if accum == 1:
+            if grads_and_losses is not None:
+                # ZeRO: per-device fwd/bwd + per-leaf reduce-scatter inside a
+                # manual dp region; grads come back dp-SHARDED and the update
+                # below runs on the local shard only.
+                grads, losses = grads_and_losses(params, batches)
+            elif accum == 1:
                 loss, grads = _loss_and_grads(params, batches[0])
                 # Eager parity: backward() accumulates grads * (1/accum) —
                 # at accum == 1 the scale is exactly 1.0 (a no-op multiply).
@@ -194,13 +262,43 @@ class TrainStep:
             losses_ok = jnp.all(jnp.isfinite(jnp.asarray(losses)))
             new_params, new_opt_state, gnorm, health_norm = _update_body(
                 tx_update, params, opt_state, grads, clip_norm, clip_value,
-                health_ok=losses_ok,
+                health_ok=losses_ok, norm_ndp=norm_ndp,
             )
+            if grads_and_losses is not None:
+                # All-gather: the dp-sharded updated params return to each
+                # replica's layout for the next forward (the param-bytes
+                # all-gather of the ZeRO ledger signature).
+                new_params = jax.tree_util.tree_map(
+                    jax.lax.with_sharding_constraint, new_params, gather_sh
+                )
             return new_params, new_opt_state, losses, gnorm, health_norm
 
         donate = (0, 1)
         out_shardings = None
-        if self.optimizer._host_offload_requested:
+        if self.zero_active:
+            # Re-place the live opt state onto its dp shards (host-offloaded
+            # leaves keep their pinned-host kind: shard *then* offload), and
+            # pin the carried-state outputs there via out_shardings.
+            opt = self.optimizer
+            opt.opt_state, _ = zero_mod.shard_opt_state(opt.opt_state, mesh)
+            opt_sh = zero_mod.opt_state_shardings(opt.opt_state, mesh)
+            # Donate params ONLY: donating params AND opt state together into
+            # the shard_map program deterministically corrupts the XLA CPU
+            # runtime heap (segfault after a few steps on jaxlib 0.4.x;
+            # either donation alone is clean).  The un-donated opt-state copy
+            # is dp-fold smaller under ZeRO than the replicated state it
+            # replaces, so the transient costs less HBM than the feature
+            # saves.
+            donate = (0,)
+            param_sh = jax.tree_util.tree_map(
+                lambda x: x.sharding
+                if isinstance(x, jax.Array)
+                and isinstance(getattr(x, "sharding", None), jax.sharding.NamedSharding)
+                else None,
+                model.params,
+            )
+            out_shardings = (param_sh, opt_sh, None, None, None)
+        elif self.optimizer._host_offload_requested:
             if jax.default_backend() == "tpu":
                 # Pinned-host opt state must come back pinned (same contract
                 # as the eager update, optimizer.py:_init_state).
@@ -217,6 +315,140 @@ class TrainStep:
             self._jit = jax.jit(step, donate_argnums=donate, out_shardings=out_shardings)
         else:
             self._jit = jax.jit(step, donate_argnums=donate)
+        # Manifest observability: record the layout the carried opt state
+        # will have from now on (checkpointing threads it into manifest.json).
+        self.optimizer._opt_state_layout = zero_mod.opt_state_layout(
+            mesh, self.zero_active
+        )
+
+    def _build_zero_grads_fn(self, _loss_and_grads, _scaled):
+        """Build the manual-dp gradient engine of the ZeRO step: a shard_map
+        over the whole mesh in which each device runs forward+backward on its
+        LOCAL micro-batch shard, ``psum_scatter``s every gradient leaf over
+        the dp axes (the reduce-scatter — emitted per leaf, so the XLA
+        latency-hiding scheduler can overlap each leaf's collective with the
+        remaining backward), and accumulates accum windows on the local shard
+        (one reduce-scatter per micro keeps the replica-sum-then-micro-sum
+        association of the eager/fused paths — bit-exactness over comms
+        volume; the scatter is still half an all-reduce per micro and the
+        gather happens once per window).
+
+        Returns ``grads_and_losses(params, batches) -> (shard_grads, losses)``
+        where ``shard_grads`` is the dp-sharded global gradient tree and
+        ``losses`` matches the unsharded step's shape (scalar, or [accum]).
+        """
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel import zero as zero_mod
+        from ..parallel.sharding import manual_region
+
+        mesh = self.accelerator.mesh
+        model = self.model
+        accum = self.accum_steps
+        axes = zero_mod.zero_axes(mesh)
+        degree = zero_mod.zero_degree(mesh)
+        psum_axes = axes if len(axes) > 1 else axes[0]
+        axis_entry = axes if len(axes) > 1 else axes[0]
+        # 1/degree un-scales the per-lane loss seed (each lane differentiates
+        # its LOCAL mean; the global mean is the lane-mean mean).  Exactly a
+        # power of two on pow2 dp degrees — where the ZeRO step is bit-exact
+        # against the unsharded one (docs/usage_guides/performance.md).
+        lane_scale = 1.0 / degree
+        params = model.params
+        pspecs = jax.tree_util.tree_map(
+            lambda p: zero_mod.shard_spec(tuple(jnp.shape(p)), axes, degree), params
+        )
+
+        def batch_spec(leaf):
+            # Batch leaves are batch-major (dim 0) by the loader contract
+            # (_GlobalBatchPlacer shards dim 0 of every ndim>=1 leaf).  A
+            # non-divisible or scalar leaf stays replicated: every lane sees
+            # the full value — identical math, no silent slicing.
+            if hasattr(leaf, "ndim") and leaf.ndim >= 1 and leaf.shape[0] % degree == 0 and leaf.shape[0] > 0:
+                return P(*((axis_entry,) + (None,) * (leaf.ndim - 1)))
+            return P()
+
+        def scatter(g):
+            d = zero_mod.shard_dim(tuple(g.shape), degree)
+            if d is None:
+                # Unshardable leaf (no dim divisible by the dp degree): plain
+                # psum — it stays replicated, and its update is replicated
+                # too (same rule the norm chunking and opt-state placement
+                # use, so all three agree).
+                return jax.lax.psum(g, psum_axes)
+            return jax.lax.psum_scatter(g, psum_axes, scatter_dimension=d, tiled=True)
+
+        def one_micro(p, batch):
+            # Per-device: fwd+bwd on the local lane, then the per-leaf
+            # reduce-scatter, then the exact-pow2 lane unscale — giving each
+            # device precisely the replica-summed global-mean gradient SHARD
+            # the unsharded path's all-reduce would have given it in full.
+            loss, grads = _loss_and_grads(p, batch)
+            shards = jax.tree_util.tree_map(scatter, grads)
+            shards = jax.tree_util.tree_map(lambda g: g * lane_scale, shards)
+            return shards, loss
+
+        def wrapped(p, *micros):
+            if accum == 1:
+                shards, loss = one_micro(p, micros[0])
+                shards = jax.tree_util.tree_map(_scaled, shards)
+                losses = loss
+            else:
+                stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *micros)
+
+                def body(acc, micro):
+                    shards, loss = one_micro(p, micro)
+                    # Eager-order accumulation on the SHARD: replica-sum
+                    # (the scatter) first, then scale/cast, then add — the
+                    # same per-element association as the unsharded window.
+                    acc = jax.tree_util.tree_map(
+                        lambda a, g: a + _scaled(g), acc, shards
+                    )
+                    return acc, loss
+
+                sync_dtype = model._grad_sync_dtype
+
+                def _zeros_shard(leaf):
+                    dtype = leaf.dtype
+                    if sync_dtype is not None and jnp.issubdtype(dtype, jnp.floating):
+                        dtype = sync_dtype
+                    return jnp.zeros(
+                        zero_mod.shard_shape(tuple(leaf.shape), degree), dtype
+                    )
+
+                zeros = jax.tree_util.tree_map(_zeros_shard, params)
+                shards, losses = jax.lax.scan(body, zeros, stacked)
+            # Lane losses ride out stacked on a leading dp dim; the caller
+            # means over lanes (== the global mean, bit-exactly so when the
+            # per-lane element count is a power of two).
+            losses = jnp.expand_dims(jnp.asarray(losses), 0)
+            return shards, losses
+
+        lane_losses_spec = (
+            P(axis_entry) if accum == 1 else P(axis_entry, None)
+        )
+
+        def grads_and_losses(params, batches):
+            in_specs = (
+                jax.tree_util.tree_map(lambda _: P(), params),
+            ) + tuple(
+                jax.tree_util.tree_map(batch_spec, b) for b in batches
+            )
+            with manual_region():
+                shards, lane_losses = shard_map(
+                    wrapped,
+                    mesh=mesh,
+                    in_specs=in_specs,
+                    out_specs=(pspecs, lane_losses_spec),
+                    check_rep=False,
+                )(params, *batches)
+            losses = jnp.mean(lane_losses, axis=0)
+            if accum == 1:
+                losses = jnp.squeeze(losses)
+            return shards, losses
+
+        return grads_and_losses
 
     def _maybe_introspect(self, jit_args):
         """First-call AOT capture of the fused program
@@ -348,9 +580,13 @@ def make_train_step(
     accum_steps: Optional[int] = None,
     clip_norm: Optional[float] = None,
     clip_value: Optional[float] = None,
+    zero=None,
 ) -> TrainStep:
     """Build a :class:`TrainStep` (the function behind
-    :meth:`Accelerator.make_train_step`)."""
+    :meth:`Accelerator.make_train_step`).  ``zero`` opts into the
+    cross-replica sharded weight update (``parallel/zero.py``): ``True`` /
+    ``False`` / a :class:`~accelerate_tpu.parallel.zero.ZeROConfig`; ``None``
+    defers to ``ACCELERATE_TPU_ZERO``."""
     return TrainStep(
         accelerator,
         model,
@@ -358,4 +594,5 @@ def make_train_step(
         accum_steps=accum_steps,
         clip_norm=clip_norm,
         clip_value=clip_value,
+        zero=zero,
     )

@@ -8,10 +8,12 @@ the program — none of them appear in user code, so the only place they can be
 *counted* is the optimized HLO module of the compiled executable.  This module
 parses that text (``compiled.as_text()``) into a structured **comms ledger**:
 
-- one :class:`CollectiveOp` per HLO collective, with the result byte volume
-  (per participating device) and the mesh axis/axes the op communicates over,
-  recovered from ``replica_groups`` / ``source_target_pairs`` against the
-  mesh's device coordinates;
+- one :class:`CollectiveOp` per HLO collective, with the byte volume of the
+  LARGE side of the transfer per participating device (result bytes for most
+  kinds; for ``reduce-scatter``, whose result is the scattered shard, the
+  operand-side bytes — result x group size) and the mesh axis/axes the op
+  communicates over, recovered from ``replica_groups`` /
+  ``source_target_pairs`` against the mesh's device coordinates;
 - a :class:`CommsLedger` aggregate: op counts and byte volumes per collective
   kind and per mesh axis.
 
@@ -91,7 +93,7 @@ class CollectiveOp:
     """One collective instruction from the optimized HLO."""
 
     kind: str  # one of COLLECTIVE_KINDS
-    bytes: int  # result byte volume per participating device
+    bytes: int  # large-side byte volume per device (operand-side for reduce-scatter)
     axes: Optional[tuple[str, ...]]  # mesh axes communicated over (None: unknown)
     group_size: int  # devices per replica group (0 = unknown, 1 = degenerate)
     op_name: str = ""  # jax op_name metadata (trace provenance), may be ""
@@ -256,7 +258,17 @@ def classify_groups(
 
 
 def parse_collectives(hlo_text: str, mesh=None) -> list[CollectiveOp]:
-    """Scan optimized HLO text for collective instructions."""
+    """Scan optimized HLO text for collective instructions.
+
+    Byte convention: the LARGE side of the transfer, per participating
+    device.  For all-reduce/all-gather/all-to-all/collective-permute that is
+    the result shape.  ``reduce-scatter`` is the one collective whose result
+    is the SMALL side — each device receives ``operand/group_size`` — so its
+    result bytes are scaled back up by the replica-group size (operand-shape
+    accounting).  That keeps the cross-kind invariants comparable: a dp grad
+    all-reduce, its ZeRO reduce-scatter replacement, and the matching param
+    all-gather all ledger ≈ param bytes.
+    """
     ops = []
     coords = _mesh_coords(mesh) if mesh is not None else None
     for line in hlo_text.splitlines():
@@ -267,10 +279,15 @@ def parse_collectives(hlo_text: str, mesh=None) -> list[CollectiveOp]:
         axes, group_size = classify_groups(groups, mesh, coords)
         name_m = _OP_NAME_RE.search(line)
         shape = m.group("shape")
+        nbytes = _async_start_bytes(shape) if m.group("start") else parse_shape_bytes(shape)
+        if m.group("kind") == "reduce-scatter" and group_size > 1:
+            # Result is the scattered SHARD; the per-device transfer volume
+            # is the full (operand-sized) reduction the shard came from.
+            nbytes *= group_size
         ops.append(
             CollectiveOp(
                 kind=m.group("kind"),
-                bytes=_async_start_bytes(shape) if m.group("start") else parse_shape_bytes(shape),
+                bytes=nbytes,
                 axes=axes,
                 group_size=group_size,
                 op_name=name_m.group("name") if name_m else "",
@@ -282,12 +299,14 @@ def parse_collectives(hlo_text: str, mesh=None) -> list[CollectiveOp]:
 def scan_hlo(hlo_text: str, mesh=None) -> CommsLedger:
     """Build the comms ledger for one compiled program's optimized HLO.
 
-    Byte volumes are the collective's **result bytes on one participating
-    device** — for an all-reduce of a replicated gradient this equals the
-    gradient's full byte size, which is what makes the dp-grad-sync invariant
-    (`all-reduce bytes ≈ param bytes`) checkable.  Degenerate collectives
-    (single-member groups — no traffic) are counted separately, not in the
-    totals.
+    Byte volumes are the collective's **large-side bytes on one participating
+    device** (see :func:`parse_collectives`) — for an all-reduce of a
+    replicated gradient this equals the gradient's full byte size, and for
+    its ZeRO reduce-scatter replacement the operand-side accounting lands on
+    the same figure, which is what makes the dp-grad-sync invariants
+    (`all-reduce ≈ param bytes`, `reduce-scatter + all-gather ≈ param bytes
+    each`) checkable.  Degenerate collectives (single-member groups — no
+    traffic) are counted separately, not in the totals.
     """
     all_ops = parse_collectives(hlo_text, mesh)
     ops = [op for op in all_ops if not op.is_degenerate]

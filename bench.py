@@ -633,6 +633,91 @@ def _pipeline_probe() -> dict:
     }
 
 
+def _zero_probe() -> dict:
+    """ZeRO sharded-weight-update micro-benchmark on a forced 8-device CPU
+    mesh (parallel/zero.py + the fused step): steps/s and opt-state bytes per
+    chip with the sharded update OFF vs ON, a loss-parity check, and the
+    one-dispatch invariant.  The HBM-per-chip shrink is the number that
+    transfers to TPU; CPU steps/s only proves the sharded program isn't
+    pathologically slower."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import telemetry
+    from accelerate_tpu.accelerator import Accelerator, JaxModel
+    from accelerate_tpu.parallel import zero as zero_mod
+    from accelerate_tpu.parallel.sharding import data_sharding
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.utils.dataclasses import ParallelismConfig
+
+    tel = telemetry.enable(dir=tempfile.mkdtemp(prefix="atpu_bench_zero_"))
+    dispatches = tel.registry.counter("pipeline.dispatches")
+    NDP = jax.device_count()
+    STEPS = 12
+    DIM = 256
+    BATCH = 16
+
+    def build():
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        acc = Accelerator(parallelism_config=ParallelismConfig(dp=NDP))
+        params = {
+            "w1": jax.random.normal(jax.random.PRNGKey(0), (DIM, DIM), jnp.float32) * 0.05,
+            "b1": jax.random.normal(jax.random.PRNGKey(1), (DIM,), jnp.float32) * 0.05,
+            "w2": jax.random.normal(jax.random.PRNGKey(2), (DIM, DIM), jnp.float32) * 0.05,
+        }
+
+        def apply_fn(p, x, y):
+            h = jnp.tanh(x @ p["w1"] + p["b1"])
+            return {"loss": jnp.mean((h @ p["w2"] - y) ** 2)}
+
+        model, opt = acc.prepare(JaxModel(apply_fn, params), optax.adam(1e-3))
+        return acc, model, opt
+
+    def batch(acc, i):
+        sh = data_sharding(acc.mesh)
+        x = np.asarray(jax.random.normal(jax.random.PRNGKey(500 + i), (BATCH, DIM)), np.float32)
+        y = np.asarray(jax.random.normal(jax.random.PRNGKey(600 + i), (BATCH, DIM)), np.float32)
+        return {"x": jax.device_put(x, sh), "y": jax.device_put(y, sh)}
+
+    def loop(zero: bool):
+        acc, model, opt = build()
+        step_fn = acc.make_train_step(model, opt, clip_norm=1.0, zero=zero)
+        batches = [batch(acc, i) for i in range(STEPS)]
+        losses = [float(np.asarray(step_fn(batches[0])))]  # warmup: compiles
+        d0 = dispatches.value  # telemetry counter delta, as _pipeline_probe
+        t0 = time.perf_counter()
+        for i in range(1, STEPS):
+            losses.append(float(np.asarray(step_fn(batches[i]))))
+        jax.block_until_ready(model.params)
+        dt = time.perf_counter() - t0
+        return {
+            "steps_per_s": round((STEPS - 1) / dt, 2),
+            "opt_state_bytes_per_chip": zero_mod.per_chip_bytes(opt.opt_state),
+            "dispatches_per_step": (dispatches.value - d0) / (STEPS - 1),
+            "zero_active": step_fn.zero_active,
+        }, losses
+
+    off, losses_off = loop(False)
+    on, losses_on = loop(True)
+    return {
+        "zero": {
+            "devices": NDP,
+            "optimizer_steps": STEPS,
+            "off": off,
+            "on": on,
+            "opt_state_shrink": round(
+                off["opt_state_bytes_per_chip"] / max(on["opt_state_bytes_per_chip"], 1), 2
+            ),
+            "losses_match": losses_off == losses_on,
+        }
+    }
+
+
 def _health_probe() -> dict:
     """Numerical-health-guard overhead micro-benchmark (resilience/health.py):
     fused-step steps/s with the guard off vs on.  Detection lives INSIDE the
@@ -821,6 +906,39 @@ def _run_pipeline_probe_subprocess(timeout_s: float = 240.0):
     return None, "no parseable pipeline-probe line"
 
 
+def _run_zero_probe_subprocess(timeout_s: float = 240.0):
+    """ZeRO probe in a bounded CPU subprocess with 8 forced host devices (the
+    dp mesh the sharded update needs; same contract as the other probes:
+    last JSON line on stdout is the result, silence is failure)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--zero-probe"],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"zero probe timeout after {timeout_s:.0f}s"
+    if proc.returncode != 0:
+        return None, (proc.stderr or "")[-200:].replace("\n", " ")
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except ValueError:
+                continue
+    return None, "no parseable zero-probe line"
+
+
 def _run_checkpoint_probe_subprocess(timeout_s: float = 180.0):
     """Checkpoint-latency probe in a bounded CPU subprocess (same contract as
     the rung children: last JSON line on stdout is the result, silence is
@@ -923,6 +1041,9 @@ def main():
         return
     if "--pipeline-probe" in sys.argv:
         print(json.dumps(_pipeline_probe()))
+        return
+    if "--zero-probe" in sys.argv:
+        print(json.dumps(_zero_probe()))
         return
     if "--health-probe" in sys.argv:
         print(json.dumps(_health_probe()))
@@ -1207,6 +1328,15 @@ def main():
         health_block = health_probe["health"] if health_probe else {"status": health_err}
         print(f"# health probe: {health_block}", file=sys.stderr, flush=True)
 
+    # ZeRO sharded-update probe (parallel/zero.py): opt-state bytes/chip and
+    # steps/s with the sharded update on vs off, on a forced 8-device CPU
+    # mesh.  CPU subprocess, never zeroes the headline.
+    zero_block = None
+    if os.environ.get("BENCH_ZERO_PROBE", "1") != "0":
+        zero_probe, zero_err = _run_zero_probe_subprocess()
+        zero_block = zero_probe["zero"] if zero_probe else {"status": zero_err}
+        print(f"# zero probe: {zero_block}", file=sys.stderr, flush=True)
+
     detail = {
         "config": result["config"],
         "rung": rung_cfg,
@@ -1228,6 +1358,8 @@ def main():
         detail["pipeline"] = pipeline_block
     if health_block is not None:
         detail["health"] = health_block
+    if zero_block is not None:
+        detail["zero"] = zero_block
     if proof is not None:
         detail["hbm_bound_proof"] = {
             "config": proof_cfg,

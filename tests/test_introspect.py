@@ -87,6 +87,38 @@ def test_async_start_tuple_shapes_count_result_only():
     ]
 
 
+def test_reduce_scatter_counts_operand_side_bytes():
+    """Reduce-scatter's RESULT is the scattered shard — the ledger must scale
+    it back up by the replica-group size so the ZeRO invariant
+    (reduce-scatter ≈ param bytes ≈ the all-reduce it replaced) is checkable
+    on the same byte convention as every other collective."""
+    hlo = """
+  %rs = f32[32,128]{1,0} reduce-scatter(f32[256,128]{1,0} %g), channel_id=1, replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}, to_apply=%add
+  %ag = f32[256,128]{1,0} all-gather(f32[32,128]{1,0} %p), channel_id=2, replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}
+"""
+    ops = hlo_scan.parse_collectives(hlo)
+    full = 256 * 128 * 4
+    assert [op.kind for op in ops] == ["reduce-scatter", "all-gather"]
+    assert ops[0].bytes == full  # shard result (full/8) x group_size 8
+    assert ops[1].bytes == full  # gathered result counts as-is
+    ledger = hlo_scan.scan_hlo(hlo)
+    assert ledger.by_kind["reduce-scatter"]["bytes"] == full
+    assert ledger.by_kind["all-gather"]["bytes"] == full
+
+
+def test_reduce_scatter_async_start_and_unknown_groups():
+    """Async -start form: the result half of the tuple is the shard — still
+    scaled by group size.  Without replica_groups (group size unknown, 0) the
+    shard bytes stand unscaled rather than guessing."""
+    hlo = """
+  %rs = (f32[256,128]{1,0}, f32[32,128]{1,0}) reduce-scatter-start(f32[256,128]{1,0} %g), channel_id=1, replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}, to_apply=%add
+  %rs2 = f32[32,128]{1,0} reduce-scatter(f32[256,128]{1,0} %g2), dimensions={0}, to_apply=%add
+"""
+    ops = hlo_scan.parse_collectives(hlo)
+    assert ops[0].bytes == 256 * 128 * 4  # async: result element x group size
+    assert ops[1].group_size == 0 and ops[1].bytes == 32 * 128 * 4
+
+
 def test_iota_replica_groups_parse():
     hlo = "%ar = f32[8]{0} all-reduce(f32[8]{0} %x), replica_groups=[4,2]<=[8], to_apply=%add\n"
     ops = hlo_scan.parse_collectives(hlo)

@@ -75,3 +75,37 @@ def test_gate_fails_when_fused_path_degraded(monkeypatch):
     assert measurements["dispatches_per_step"] == 6.0
     failures = evaluate(measurements, load_baseline())
     assert any("dispatches" in f for f in failures)
+
+
+def _passing_zero_measurements():
+    return dict(
+        _passing_measurements(),
+        zero_active=True,
+        zero_vs_eager_ratio=2.0,
+        zero_dispatches_per_step=1.0,
+        zero_host_blocked_ms_per_step=2.0,
+    )
+
+
+def test_evaluate_zero_row_thresholds():
+    baseline = load_baseline()
+    assert evaluate(_passing_zero_measurements(), baseline) == []
+    m = dict(_passing_zero_measurements(), zero_active=False)
+    assert any("silently fell back" in f for f in evaluate(m, baseline))
+    m = dict(_passing_zero_measurements(), zero_dispatches_per_step=12.0)
+    assert any("ZeRO dispatches" in f for f in evaluate(m, baseline))
+    m = dict(_passing_zero_measurements(), zero_vs_eager_ratio=1.0)
+    assert any("ZeRO-vs-eager" in f for f in evaluate(m, baseline))
+    # Single-device probe: the arm was skipped — no zero judgments at all.
+    m = dict(_passing_measurements(), zero_active=None)
+    assert evaluate(m, baseline) == []
+
+
+def test_gate_fails_when_zero_silently_falls_back(monkeypatch):
+    """ACCELERATE_TPU_PERF_GATE_DEGRADE=zero-fallback runs the ZeRO arm with
+    the replicated update — the zero_active tripwire must fail the gate."""
+    monkeypatch.setenv("ACCELERATE_TPU_PERF_GATE_DEGRADE", "zero-fallback")
+    measurements = run_probe(accum=2, steps=4, dim=64, batch=8, epochs=1, prefetch=0)
+    assert measurements["zero_active"] is False
+    failures = evaluate(measurements, load_baseline())
+    assert any("silently fell back" in f for f in failures)

@@ -1,0 +1,377 @@
+"""ZeRO-style sharded weight update (parallel/zero.py + the fused step).
+
+The acceptance invariants of the sharded update, on the 8-virtual-device CPU
+mesh (conftest):
+
+- **bit-exactness**: losses AND params of the ZeRO fused step equal the
+  unsharded fused step to the last bit, for accum ∈ {1, 4} × clip on/off
+  (the canonical chunked norm + select fences in ``_update_body`` are what
+  make this hold — see parallel/zero.py docstring);
+- **ledger**: the dp gradient all-reduce (== param bytes on the unsharded
+  step) is REPLACED by reduce-scatter + all-gather, each ≈ param bytes ±10%,
+  with only scalar-sized all-reduces left on the dp axis;
+- **memory**: opt-state bytes per chip shrink ~dp-fold;
+- **composition**: still ONE dispatch per optimizer step, the health gate
+  skips poisoned steps leaving the SHARDED opt state bit-intact, and
+  state_dict round-trips through the gathered (host) form.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from accelerate_tpu.parallel import zero as zero_mod
+
+NDP = 8
+PARAM_SHAPES = {"w": (256, 128), "b": (128,), "tiny": (3,)}
+PARAM_BYTES = sum(int(np.prod(s)) * 4 for s in PARAM_SHAPES.values())
+
+
+def _build(accum=1):
+    from accelerate_tpu.accelerator import Accelerator, JaxModel
+    from accelerate_tpu.utils.dataclasses import ParallelismConfig
+
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(dp=NDP),
+        gradient_accumulation_steps=accum,
+    )
+    params = {
+        "w": jax.random.normal(jax.random.PRNGKey(0), PARAM_SHAPES["w"], jnp.float32) * 0.1,
+        "b": jax.random.normal(jax.random.PRNGKey(1), PARAM_SHAPES["b"], jnp.float32) * 0.1,
+        "tiny": jax.random.normal(jax.random.PRNGKey(7), PARAM_SHAPES["tiny"], jnp.float32),
+    }
+
+    def apply_fn(p, x, y):
+        pred = jnp.tanh(x @ p["w"] + p["b"]) * jnp.sum(p["tiny"])
+        return {"loss": jnp.mean((pred - y) ** 2)}
+
+    model, opt = acc.prepare(JaxModel(apply_fn, params), optax.adam(1e-2))
+    return acc, model, opt
+
+
+def _batch(acc, i, batch_size=16, poison=False):
+    from accelerate_tpu.parallel.sharding import data_sharding
+
+    sh = data_sharding(acc.mesh)
+    x = np.array(jax.random.normal(jax.random.PRNGKey(100 + i), (batch_size, 256)), np.float32)
+    y = np.array(jax.random.normal(jax.random.PRNGKey(200 + i), (batch_size, 128)), np.float32)
+    if poison:
+        x[0, 0] = np.nan
+    return {"x": jax.device_put(x, sh), "y": jax.device_put(y, sh)}
+
+
+def _run(zero, accum, clip_norm, steps=3):
+    acc, model, opt = _build(accum)
+    step = acc.make_train_step(model, opt, clip_norm=clip_norm, zero=zero)
+    losses = []
+    for it in range(steps):
+        window = [_batch(acc, it * accum + j) for j in range(accum)]
+        out = step(window if accum > 1 else window[0])
+        losses.append(np.asarray(out))
+    return acc, model, opt, step, np.asarray(losses)
+
+
+# ---------------------------------------------------------------------------
+# Shard-rule / config units
+# ---------------------------------------------------------------------------
+
+
+def test_shard_rule_units():
+    assert zero_mod.shard_dim((256, 128), 8) == 0  # largest divisible dim
+    assert zero_mod.shard_dim((100, 128), 8) == 1  # falls to next divisible
+    assert zero_mod.shard_dim((3,), 8) is None  # unshardable
+    assert zero_mod.shard_dim((), 8) is None  # scalar
+    assert zero_mod.shard_dim((256,), 1) is None  # degree 1: nothing to do
+    assert zero_mod.shard_shape((256, 128), 8) == (32, 128)
+    assert zero_mod.shard_shape((3,), 8) == (3,)
+    assert zero_mod.shard_spec((256, 128), ("dp",), 8) == P("dp", None)
+    assert zero_mod.shard_spec((3,), ("dp",), 8) == P(None)
+    assert zero_mod.shard_spec((16, 4), ("dcn_dp", "dp"), 8) == P(("dcn_dp", "dp"), None)
+
+
+def test_zero_config_env_resolution(monkeypatch):
+    monkeypatch.delenv(zero_mod.ENV_ZERO, raising=False)
+    assert not zero_mod.ZeROConfig.resolve(None).enabled
+    monkeypatch.setenv(zero_mod.ENV_ZERO, "1")
+    assert zero_mod.ZeROConfig.resolve(None).enabled
+    assert zero_mod.ZeROConfig.resolve(None).overlap_effective
+    monkeypatch.setenv(zero_mod.ENV_ZERO_OVERLAP, "0")
+    assert not zero_mod.ZeROConfig.resolve(None).overlap_effective
+    assert zero_mod.ZeROConfig.resolve(False).enabled is False  # arg beats env
+    cfg = zero_mod.ZeROConfig(enabled=True, overlap=False)
+    assert zero_mod.ZeROConfig.resolve(cfg) is cfg
+
+
+def test_chunked_norm_layout_independent():
+    """The canonical chunked norm must reduce bit-identically over replicated
+    and dp-sharded layouts of the same values — the property the clip-on
+    bit-exactness of the ZeRO step rests on."""
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(jax.devices()[:NDP]).reshape(NDP), ("dp",))
+    for t in range(6):
+        tree = {
+            "w": jax.random.normal(jax.random.PRNGKey(t), (256, 128)),
+            "b": jax.random.normal(jax.random.PRNGKey(t + 50), (128,)),
+            "tiny": jax.random.normal(jax.random.PRNGKey(t + 90), (3,)),
+        }
+        rep = jax.device_put(tree, NamedSharding(mesh, P()))
+
+        def shard_one(g):
+            spec = zero_mod.shard_spec(tuple(g.shape), ("dp",), NDP)
+            return jax.device_put(g, NamedSharding(mesh, spec))
+
+        shd = jax.tree_util.tree_map(shard_one, tree)
+        fence = jnp.asarray(True)
+        f = jax.jit(lambda tr: zero_mod.chunked_global_norm(tr, NDP, jnp.asarray(True)))
+        a, b = f(rep), f(shd)
+        assert bool(a == b), f"layout-dependent norm at seed {t}: {a} vs {b}"
+
+
+def test_supported_gating():
+    from jax.sharding import Mesh
+
+    names = ("dcn_dp", "dp", "fsdp", "pp", "sp", "ep", "tp")
+
+    def mesh_of(**sizes):
+        shape = tuple(sizes.get(n, 1) for n in names)
+        n = int(np.prod(shape))
+        return Mesh(np.asarray(jax.devices()[:n]).reshape(shape), names)
+
+    ok, _ = zero_mod.supported(mesh_of(dp=8))
+    assert ok
+    ok, reason = zero_mod.supported(mesh_of())
+    assert not ok and "data-parallel" in reason
+    ok, reason = zero_mod.supported(mesh_of(dp=2, fsdp=2))
+    assert not ok and "fsdp" in reason
+    ok, reason = zero_mod.supported(None)
+    assert not ok
+
+
+def test_fallback_when_unsupported_mesh():
+    """zero=True on a mesh with active model axes (fsdp already IS the
+    sharded update) warns and runs the standard fused step — training must
+    not break."""
+    from accelerate_tpu.accelerator import Accelerator, JaxModel
+    from accelerate_tpu.utils.dataclasses import ParallelismConfig
+
+    acc = Accelerator(parallelism_config=ParallelismConfig(dp=2, fsdp=4))
+    params = {"w": jnp.ones((8, 8), jnp.float32)}
+
+    def apply_fn(p, x, y):
+        return {"loss": jnp.mean((x @ p["w"] - y) ** 2)}
+
+    model, opt = acc.prepare(JaxModel(apply_fn, params), optax.adam(1e-2))
+    step = acc.make_train_step(model, opt, zero=True)
+    with pytest.warns(UserWarning, match="ZeRO"):
+        step({"x": jnp.ones((8, 8), jnp.float32), "y": jnp.zeros((8, 8), jnp.float32)})
+    assert step.zero_active is False
+    assert step.dispatch_count == 1
+
+
+# ---------------------------------------------------------------------------
+# The acceptance matrix: bit-exact vs the unsharded fused step
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("accum", [1, 4])
+@pytest.mark.parametrize("clip_norm", [None, 0.05])
+def test_zero_bitexact_vs_unsharded(accum, clip_norm):
+    """dp=8 CPU mesh: losses and params of the ZeRO fused step equal the
+    unsharded fused step bit-for-bit over multiple optimizer steps, for
+    accumulation windows and a BINDING global-norm clip."""
+    _, model_b, opt_b, step_b, losses_b = _run(False, accum, clip_norm)
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
+    _, model_z, opt_z, step_z, losses_z = _run(True, accum, clip_norm)
+
+    assert step_b.zero_active is False and step_z.zero_active is True
+    assert (losses_b == losses_z).all(), (
+        f"losses diverged: {losses_b} vs {losses_z}"
+    )
+    for key in model_b.params:
+        pb = np.asarray(model_b.params[key])
+        pz = np.asarray(model_z.params[key])
+        assert (pb == pz).all(), (
+            f"param {key!r} diverged (max |d| = {np.max(np.abs(pb - pz))})"
+        )
+    # The norms feeding the clip agree too (same chunked association).
+    assert float(step_b.last_grad_norm) == float(step_z.last_grad_norm)
+    # Still one dispatch per optimizer step.
+    assert step_z.dispatch_count == losses_z.shape[0]
+
+
+def test_zero_opt_state_sharded_and_smaller():
+    """Opt state lives dp-sharded between steps: per-chip bytes shrink
+    ~dp-fold and the moment leaves carry a dp sharding spec."""
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    _, _, opt_b, _, _ = _run(False, 1, None, steps=1)
+    base_bytes = zero_mod.per_chip_bytes(opt_b.opt_state)
+    AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
+    _, _, opt_z, step_z, _ = _run(True, 1, None, steps=1)
+    zero_bytes = zero_mod.per_chip_bytes(opt_z.opt_state)
+    # w + b shard 8-fold; tiny and count stay replicated — ratio just under 8.
+    assert base_bytes / zero_bytes > NDP * 0.9
+    mu_w = opt_z.opt_state[0].mu["w"]
+    assert "dp" in str(mu_w.sharding.spec)
+    assert mu_w.sharding.shard_shape(mu_w.shape) == (256 // NDP, 128)
+    # The manifest layout descriptor flipped to the sharded form.
+    assert opt_z._opt_state_layout["kind"] == "zero"
+    assert opt_z._opt_state_layout["degree"] == NDP
+
+
+def test_zero_ledger_rs_ag_replace_dp_allreduce():
+    """The introspection ledger of the compiled ZeRO step shows the
+    param-bytes dp all-reduce REPLACED: reduce-scatter ≈ param bytes ±10%,
+    all-gather ≈ param bytes ±10%, remaining all-reduce traffic scalar-sized."""
+    from accelerate_tpu.telemetry import hlo_scan
+
+    acc, model, opt, step, _ = _run(True, 1, None, steps=1)
+    args = (
+        model.params,
+        opt.opt_state,
+        ((tuple(), dict(_batch(acc, 0))),),
+        jnp.asarray(-1.0, jnp.float32),
+        jnp.asarray(-1.0, jnp.float32),
+    )
+    hlo = step._jit.lower(*args).compile().as_text()
+    ledger = hlo_scan.scan_hlo(hlo, acc.mesh)
+    rs = ledger.by_kind.get("reduce-scatter")
+    ag = ledger.by_kind.get("all-gather")
+    ar = ledger.by_kind.get("all-reduce", {"bytes": 0})
+    assert rs is not None, f"no reduce-scatter: {ledger.by_kind}"
+    assert ag is not None, f"no all-gather: {ledger.by_kind}"
+    # tiny (12 B) is psum'd, not scattered, so rs covers w+b only.
+    assert abs(rs["bytes"] - PARAM_BYTES) / PARAM_BYTES < 0.10
+    assert abs(ag["bytes"] - PARAM_BYTES) / PARAM_BYTES < 0.10
+    assert ar["bytes"] < 0.05 * PARAM_BYTES, (
+        f"monolithic grad all-reduce still present: {ar}"
+    )
+
+    # Contrast: the unsharded step's dp all-reduce == param bytes (the PR 2
+    # invariant this feature visibly replaces).
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
+    acc_b, model_b, opt_b, step_b, _ = _run(False, 1, None, steps=1)
+    args_b = (
+        model_b.params,
+        opt_b.opt_state,
+        ((tuple(), dict(_batch(acc_b, 0))),),
+        jnp.asarray(-1.0, jnp.float32),
+        jnp.asarray(-1.0, jnp.float32),
+    )
+    hlo_b = step_b._jit.lower(*args_b).compile().as_text()
+    ledger_b = hlo_scan.scan_hlo(hlo_b, acc_b.mesh)
+    ar_b = ledger_b.by_kind.get("all-reduce")
+    assert ar_b is not None
+    assert abs(ar_b["bytes"] - PARAM_BYTES) / PARAM_BYTES < 0.10
+    assert "reduce-scatter" not in ledger_b.by_kind
+
+
+def test_zero_health_gate_skips_and_keeps_shards():
+    """A poisoned batch (NaN loss) under ZeRO: the in-program gate skips the
+    update, the SHARDED opt state and params come back bit-identical, and the
+    health norm reads non-finite."""
+    acc, model, opt, step, _ = _run(True, 1, None, steps=1)
+    params_before = jax.tree_util.tree_map(np.asarray, model.params)
+    opt_before = jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, opt.opt_state
+    )
+    loss = step(_batch(acc, 99, poison=True))
+    assert not np.isfinite(np.asarray(loss))
+    assert not np.isfinite(float(step.last_health_norm))
+    for key in model.params:
+        assert (np.asarray(model.params[key]) == params_before[key]).all()
+    flat_after = jax.tree_util.tree_leaves(opt.opt_state)
+    flat_before = jax.tree_util.tree_leaves(opt_before)
+    for a, b in zip(flat_after, flat_before):
+        if isinstance(a, jax.Array):
+            assert (np.asarray(a) == b).all()
+    # Still sharded after the skip.
+    assert "dp" in str(opt.opt_state[0].mu["w"].sharding.spec)
+
+
+def test_zero_state_dict_roundtrip_gathers():
+    """state_dict gathers the sharded opt state to host (layout-free payload);
+    load_state_dict re-places it onto the live dp shards bit-exactly."""
+    acc, model, opt, step, _ = _run(True, 1, None, steps=2)
+    sd = opt.state_dict()
+    gathered = jax.tree_util.tree_leaves(sd["opt_state"])
+    assert all(isinstance(x, np.ndarray) or np.isscalar(x) or hasattr(x, "shape") for x in gathered)
+    before = [np.asarray(x) for x in jax.tree_util.tree_leaves(opt.opt_state)]
+    opt.load_state_dict(sd)
+    after_leaves = jax.tree_util.tree_leaves(opt.opt_state)
+    for a, b in zip(after_leaves, before):
+        assert (np.asarray(a) == b).all()
+    mu_w = opt.opt_state[0].mu["w"]
+    assert "dp" in str(mu_w.sharding.spec)
+    # And training continues from the restored shards.
+    step(_batch(acc, 5))
+
+
+def test_infinite_clip_norm_does_not_zero_update():
+    """clip_grad_norm_(inf) is the measure-without-clipping idiom: the fence
+    pred must treat inf clip args as healthy (only NaN is 'no value'), or
+    every step on a dp>1 mesh silently applies a zero update."""
+    acc, model, opt = _build(1)
+    step = acc.make_train_step(model, opt, clip_norm=float("inf"), zero=True)
+    w_before = np.asarray(model.params["w"]).copy()
+    loss = step(_batch(acc, 0))
+    assert np.isfinite(np.asarray(loss))
+    w_after = np.asarray(model.params["w"])
+    assert not (w_before == w_after).all(), "inf clip_norm froze the update"
+    assert np.isfinite(float(step.last_grad_norm))
+    # And the unsharded fused step agrees bit-for-bit under inf clip too.
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
+    acc_b, model_b, opt_b = _build(1)
+    step_b = acc_b.make_train_step(model_b, opt_b, clip_norm=float("inf"), zero=False)
+    step_b(_batch(acc_b, 0))
+    assert (np.asarray(model_b.params["w"]) == w_after).all()
+
+
+def test_sequential_combine_fori_path_matches_unrolled(monkeypatch):
+    """Above _COMBINE_UNROLL_MAX the chunk combine rolls into a fori_loop —
+    same left-to-right association, so forcing it at dp=8 must reproduce the
+    unrolled result bit-for-bit on both layouts."""
+    from jax.sharding import Mesh
+
+    from accelerate_tpu.parallel.mesh import install_global_mesh, reset_global_mesh
+    from accelerate_tpu.utils.dataclasses import ParallelismConfig
+    from accelerate_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh(ParallelismConfig(dp=NDP))
+    install_global_mesh(mesh)
+    try:
+        tree = {
+            "w": jax.random.normal(jax.random.PRNGKey(3), (256, 128)),
+            "b": jax.random.normal(jax.random.PRNGKey(4), (128,)),
+        }
+        rep = jax.device_put(tree, NamedSharding(mesh, P()))
+        shd = jax.tree_util.tree_map(
+            lambda g: jax.device_put(
+                g, NamedSharding(mesh, zero_mod.shard_spec(tuple(g.shape), ("dp",), NDP))
+            ),
+            tree,
+        )
+        f = jax.jit(lambda tr: zero_mod.chunked_global_norm(tr, NDP, jnp.asarray(True)))
+        unrolled_rep, unrolled_shd = f(rep), f(shd)
+        monkeypatch.setattr(zero_mod, "_COMBINE_UNROLL_MAX", 2)
+        g = jax.jit(lambda tr: zero_mod.chunked_global_norm(tr, NDP, jnp.asarray(True)))
+        fori_rep, fori_shd = g(rep), g(shd)
+        assert bool(unrolled_rep == fori_rep)
+        assert bool(unrolled_rep == fori_shd)
+        assert bool(unrolled_rep == unrolled_shd)
+    finally:
+        reset_global_mesh()
