@@ -1,10 +1,10 @@
 # Test-suite splits mirroring the reference Makefile:25-77.
 
-.PHONY: test test-quick test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels bench telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke perf-gate
+.PHONY: test test-quick test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels bench telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke profile-smoke perf-gate
 
 PYTEST = python -m pytest -q
 
-test: test-quick telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke perf-gate
+test: test-quick telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke profile-smoke perf-gate
 	$(PYTEST) tests/
 
 # <5 min tier (VERDICT r5 item 6): oracles, state, sharding-spec/mesh,
@@ -61,6 +61,15 @@ health-smoke:
 # postmortem from the snapshot (docs/package_reference/flightrec.md).
 flightrec-smoke:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.telemetry.flightrec_smoke
+
+# Trace-attribution proof on an 8-device CPU mesh: captures a jax.profiler
+# trace of the fused ZeRO step, asserts the scanner reconstructs a timeline
+# with >= 1 collective bucket, a finite realized-overlap fraction and
+# exposed-collective <= total-collective ms, and that the SAME parser passes
+# offline on the committed fixture with no JAX devices
+# (docs/package_reference/profile.md).
+profile-smoke:
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.telemetry.profile_smoke
 
 # CPU-tier perf-regression gate: eager-vs-fused probe judged against the
 # committed baseline (benchmarks/perf_baseline_cpu.json) — dispatches/step

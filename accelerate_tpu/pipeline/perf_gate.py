@@ -23,7 +23,12 @@ This gate re-runs a bounded version of that probe on CPU and asserts the
   report ``zero_active`` (the silent-fallback-to-replicated tripwire),
   still run at ``dispatches/step == 1`` and hold the same fused-vs-eager
   ratio floor — a regression that quietly rebuilds the replicated update
-  fails in tier-1, not on the next TPU window.
+  fails in tier-1, not on the next TPU window;
+- an **overlap row** (multi-device runs): a ``jax.profiler`` trace of the
+  ZeRO arm is scanned (``telemetry/profile_scan.py``) and the fraction of
+  collective time NOT hidden behind concurrent compute must stay under
+  ``max_exposed_collective_frac`` — the static byte ledger proves the
+  collectives exist; this row proves at runtime that they overlap.
 
 Absolute steps/s are *reported* but never gated — a 2-core CI box drifts
 ±50% run to run; ratios and dispatch counts don't.
@@ -37,6 +42,10 @@ eager loop — the knob that *proves* the gate fails when the fused path is
 degraded (dispatches/step jumps to ``3 × accum``, the ratio collapses to ~1).
 ``=zero-fallback`` runs the ZeRO arm with the replicated update — the knob
 that proves the ``zero_active`` tripwire catches a silent fallback.
+``=no-overlap`` scans the same trace with the concurrent-compute credit
+disabled (every collective µs counts as exposed — what stripping the
+latency-hiding scheduler flags does to a TPU run) — the knob that proves the
+overlap row fails when collectives stop hiding.
 """
 
 from __future__ import annotations
@@ -168,7 +177,7 @@ def run_probe(
         per_step_dispatch = (dispatches.value - d0) / (epochs * steps)
         return steps / best_dt, per_step_dispatch, best_blocked / steps * 1e3
 
-    def fused_arm(zero=None):
+    def fused_arm(zero=None, trace_dir=None):
         import jax
 
         acc, model, opt, dl = build()
@@ -200,6 +209,14 @@ def run_probe(
             if dt < best_dt:
                 best_dt, best_blocked = dt, blocked
         per_step_dispatch = (dispatches.value - d0) / (epochs * steps)
+        if trace_dir is not None:
+            # One extra, untimed epoch under the profiler: the overlap audit
+            # must not tax the steps/s measurement it rides along with.
+            jax.profiler.start_trace(trace_dir)
+            try:
+                one_epoch()
+            finally:
+                jax.profiler.stop_trace()
         return (
             steps / best_dt,
             per_step_dispatch,
@@ -224,14 +241,38 @@ def run_probe(
 
         zero_sps = zero_disp = zero_blocked = None
         zero_active = None
+        zero_exposed_frac = None
+        zero_profile = None
+        zero_profile_error = None
         if jax.device_count() >= 2:
+            trace_dir = tempfile.mkdtemp(prefix="atpu_perf_gate_trace_")
             with warnings.catch_warnings():
                 # The deliberate zero-fallback degrade warns; the probe's
                 # numbers are the signal, not the warning.
                 warnings.simplefilter("ignore")
                 zero_sps, zero_disp, zero_blocked, zero_active = fused_arm(
-                    zero=False if degrade == "zero-fallback" else True
+                    zero=False if degrade == "zero-fallback" else True,
+                    trace_dir=trace_dir,
                 )
+            # Overlap audit over the captured trace: the only *runtime* proof
+            # that the ZeRO collectives hide behind compute.  The "no-overlap"
+            # degrade disables the concurrent-compute credit — the self-test
+            # that shows the exposed-comms row actually judges this number.
+            try:
+                from ..telemetry import profile_scan
+
+                zero_profile = profile_scan.analyze_trace_dir(
+                    trace_dir, assume_no_overlap=(degrade == "no-overlap")
+                )
+                if zero_profile.collective_ms > 0:
+                    zero_exposed_frac = round(
+                        zero_profile.exposed_collective_ms / zero_profile.collective_ms,
+                        4,
+                    )
+                else:
+                    zero_profile_error = "trace has no collective ops"
+            except Exception as e:
+                zero_profile_error = str(e)[:200]
     finally:
         if owns_telemetry:
             telemetry.disable()
@@ -261,8 +302,15 @@ def run_probe(
                 "zero_vs_eager_ratio": round(zero_sps / max(eager_sps, 1e-9), 3),
                 "zero_dispatches_per_step": zero_disp,
                 "zero_host_blocked_ms_per_step": round(zero_blocked, 3),
+                "zero_exposed_collective_frac": zero_exposed_frac,
             }
         )
+        if zero_profile is not None and zero_exposed_frac is not None:
+            measurements["zero_overlap_fraction"] = zero_profile.overlap_fraction
+            measurements["zero_collective_ms"] = zero_profile.collective_ms
+            measurements["zero_exposed_collective_ms"] = zero_profile.exposed_collective_ms
+        if zero_profile_error is not None:
+            measurements["zero_profile_error"] = zero_profile_error
     return measurements
 
 
@@ -325,6 +373,24 @@ def evaluate(measurements: dict, baseline: dict) -> list:
                 f"baseline min {min_zero_ratio} — the sharded update lost the "
                 "fused-path speedup"
             )
+        # Overlap row: the runtime comms/compute-overlap invariant from the
+        # trace scan of the ZeRO arm.  A broken capture is a broken check —
+        # it fails loudly rather than silently skipping the row.
+        max_exposed = baseline.get("max_exposed_collective_frac")
+        if max_exposed is not None:
+            exposed_frac = measurements.get("zero_exposed_collective_frac")
+            if exposed_frac is None:
+                failures.append(
+                    "exposed-collective audit produced no number ("
+                    f"{measurements.get('zero_profile_error') or 'no trace analyzed'}) — "
+                    "the overlap invariant went unchecked"
+                )
+            elif exposed_frac > max_exposed:
+                failures.append(
+                    f"exposed-collective fraction {exposed_frac:.3f} > baseline max "
+                    f"{max_exposed} — ZeRO collectives are no longer hidden behind "
+                    "compute (comms/compute overlap regressed)"
+                )
     return failures
 
 
@@ -346,6 +412,11 @@ def run_gate(baseline_path: Optional[str] = None, probe_kwargs: Optional[dict] =
             f", ZeRO {measurements['zero_vs_eager_ratio']}x at "
             f"{measurements['zero_dispatches_per_step']:.0f} dispatch/step"
         )
+        if measurements.get("zero_exposed_collective_frac") is not None:
+            zero_note += (
+                f", exposed comms {measurements['zero_exposed_collective_frac']:.2f} "
+                "of collective time"
+            )
     elif measurements.get("zero_active") is None:
         zero_note = ", ZeRO row skipped (single-device probe)"
     print(

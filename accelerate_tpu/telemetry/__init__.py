@@ -48,7 +48,13 @@ from .metrics import (
 )
 from .flightrec import FlightRecorder, get_flight_recorder
 from .hlo_scan import CollectiveOp, CommsLedger, parse_collectives, scan_hlo
+from .profile_scan import (
+    ProfileReport as TraceProfileReport,
+    analyze_trace_dir,
+    analyze_trace_file,
+)
 from .sentinel import AnomalySentinel
+from .timeline import Timeline, TraceEvent, TraceParseError
 from .introspect import (
     ENV_INTROSPECT,
     LintFinding,
@@ -96,4 +102,11 @@ __all__ = [
     "lint_reshardings",
     "parse_collectives",
     "scan_hlo",
+    # trace-driven performance attribution
+    "TraceProfileReport",
+    "analyze_trace_dir",
+    "analyze_trace_file",
+    "Timeline",
+    "TraceEvent",
+    "TraceParseError",
 ]

@@ -34,7 +34,11 @@ step stream online: rolling-median slow-step detection, watchdog stalls, and
 per-host straggler hooks.  The first anomaly triggers a one-shot
 ``jax.profiler`` trace window (``ACCELERATE_TPU_SENTINEL_PROFILE=0``
 disables — the test suite does) so the profile of the *bad* steps is captured
-without anyone watching the run.
+without anyone watching the run.  The capture is then auto-analyzed off the
+hot path by ``profile_scan`` and its attribution digest (exposed-collective
+ms, overlap fraction, top ops) lands back in the ring as a
+``sentinel.profile_digest`` event — the postmortem explains *why* the slow
+step was slow, not just that it happened.
 
 Default-off.  ``ACCELERATE_TPU_FLIGHTREC=1`` (honored by ``Accelerator()``
 via ``telemetry.maybe_enable_from_env``) or ``flightrec.enable()`` turn it
@@ -126,6 +130,9 @@ class FlightRecorder:
         # one-shot profiler window: "armed" -> "tracing" -> "done"
         self._profile_state = "armed"
         self._profile_stop_step: Optional[int] = None
+        self._profile_dir: Optional[str] = None
+        self._profile_trigger_step: Optional[int] = None
+        self._analysis_thread: Optional[threading.Thread] = None
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -160,6 +167,9 @@ class FlightRecorder:
             self._since_flush = 0
         self._profile_state = "armed"
         self._profile_stop_step = None
+        self._profile_dir = None
+        self._profile_trigger_step = None
+        self._analysis_thread = None
         self.enabled = True
         self._install_signal_flush()
         self._install_excepthook()
@@ -179,6 +189,7 @@ class FlightRecorder:
         """Final flush, restore signal handlers / excepthook, turn off."""
         if not self.enabled:
             return
+        self._join_analysis(timeout=30.0)
         self.record("meta", event="disabled")
         self.flush(reason="disable")
         self.enabled = False
@@ -314,6 +325,7 @@ class FlightRecorder:
 
     def _atexit_flush(self):
         if self.enabled:
+            self._join_analysis(timeout=10.0)
             self.record("meta", event="exit")
             self.flush(reason="atexit")
 
@@ -416,6 +428,8 @@ class FlightRecorder:
             return
         self._profile_state = "tracing"
         self._profile_stop_step = (step or 0) + PROFILE_WINDOW_STEPS
+        self._profile_dir = trace_dir
+        self._profile_trigger_step = step
         self.record("event", name="sentinel.profile_start", dir=trace_dir, step=step)
 
     def _maybe_stop_profile(self, step: Optional[int]):
@@ -430,7 +444,69 @@ class FlightRecorder:
         except Exception:
             pass
         self._profile_state = "done"
-        self.record("event", name="sentinel.profile_stop", step=step)
+        # The capture is a flight-recorder fact (path + trigger step, so the
+        # postmortem can link it to its anomaly); analysis runs on a worker
+        # thread — the training loop never blocks on the scanner.
+        self.record(
+            "event",
+            name="sentinel.profile_captured",
+            dir=self._profile_dir,
+            trigger_step=self._profile_trigger_step,
+            stop_step=step,
+        )
+        self.flush(reason="profile_captured")
+        self._analysis_thread = threading.Thread(
+            target=self._analyze_capture,
+            args=(self._profile_dir, self._profile_trigger_step),
+            name="flightrec-profile-scan",
+            daemon=True,
+        )
+        self._analysis_thread.start()
+
+    def _analyze_capture(self, trace_dir: Optional[str], trigger_step: Optional[int]):
+        """Off-hot-path worker: scan the captured trace and append the
+        attribution digest to the ring, so the postmortem explains *why* the
+        slow step was slow, not just that it happened."""
+        report = None
+        try:
+            from . import profile_scan
+
+            report = profile_scan.analyze_trace_dir(trace_dir)
+            self.record(
+                "event",
+                name="sentinel.profile_digest",
+                trigger_step=trigger_step,
+                dir=trace_dir,
+                **profile_scan.digest(report),
+            )
+        except Exception as e:
+            # The analyzer must never take the run (or its shutdown) down.
+            self.record(
+                "event",
+                name="sentinel.profile_analysis_failed",
+                trigger_step=trigger_step,
+                dir=trace_dir,
+                error=str(e)[:200],
+            )
+        if report is not None:
+            # Outside the failure-recording try: a publish hiccup must not
+            # shadow the valid digest already sitting in the ring.
+            try:
+                from . import core
+
+                tel = core.get_telemetry()
+                if tel.enabled:
+                    profile_scan.publish(report, telemetry=tel)
+            except Exception:
+                pass
+        self.flush(reason="profile_digest")
+
+    def _join_analysis(self, timeout: float):
+        """Give an in-flight capture analysis a bounded chance to land its
+        digest in the snapshot before the recorder goes away."""
+        thread = self._analysis_thread
+        if thread is not None and thread.is_alive() and thread is not threading.current_thread():
+            thread.join(timeout=timeout)
 
     # -- views -----------------------------------------------------------------
 

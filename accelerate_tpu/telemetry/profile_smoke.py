@@ -1,0 +1,149 @@
+"""Profile-scanner smoke: capture a real trace of the fused ZeRO step on an
+8-device CPU mesh and audit it (``make profile-smoke``, wired into
+``make test``).
+
+Asserts, end to end through the public surface:
+
+1. ``jax.profiler`` capture of the ZeRO fused train step produces a trace the
+   scanner can reconstruct (non-empty device timeline);
+2. the timeline holds >= 1 collective-bucket op, the realized overlap
+   fraction is finite, and exposed-collective ms <= total collective ms (the
+   interval-arithmetic invariant);
+3. the per-step segmentation finds the fused dispatches;
+4. the SAME parser passes offline on the committed fixture in a subprocess
+   with **no JAX devices at all** (``JAX_PLATFORMS=''`` never imported) —
+   the postmortem workflow (analyze a trace from a dead TPU run on a laptop)
+   needs exactly that;
+5. ``telemetry.report --profile <dir> --json`` emits the machine-readable
+   block bench/CI consume.
+
+Run: ``env JAX_PLATFORMS=cpu python -m accelerate_tpu.telemetry.profile_smoke``
+(docs/package_reference/profile.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+FIXTURE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "tests", "fixtures", "profile", "sample.trace.json.gz",
+)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ..accelerator import Accelerator, JaxModel
+    from ..parallel.sharding import data_sharding
+    from ..state import AcceleratorState, GradientState, PartialState
+    from ..utils.dataclasses import ParallelismConfig
+    from . import profile_scan
+
+    ndp = jax.device_count()
+    assert ndp == 8, f"expected the forced 8-device CPU mesh, got {ndp}"
+    steps, dim, batch = 4, 128, 16
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    acc = Accelerator(parallelism_config=ParallelismConfig(dp=ndp))
+    params = {
+        "w1": jax.random.normal(jax.random.PRNGKey(0), (dim, dim), jnp.float32) * 0.05,
+        "w2": jax.random.normal(jax.random.PRNGKey(1), (dim, dim), jnp.float32) * 0.05,
+    }
+
+    def apply_fn(p, x, y):
+        return {"loss": jnp.mean((jnp.tanh(x @ p["w1"]) @ p["w2"] - y) ** 2)}
+
+    model, opt = acc.prepare(JaxModel(apply_fn, params), optax.adam(1e-3))
+    step_fn = acc.make_train_step(model, opt, clip_norm=1.0, zero=True)
+    sh = data_sharding(acc.mesh)
+
+    def make_batch(i):
+        x = np.asarray(jax.random.normal(jax.random.PRNGKey(10 + i), (batch, dim)), np.float32)
+        y = np.asarray(jax.random.normal(jax.random.PRNGKey(20 + i), (batch, dim)), np.float32)
+        return {"x": jax.device_put(x, sh), "y": jax.device_put(y, sh)}
+
+    batches = [make_batch(i) for i in range(steps + 1)]
+    float(np.asarray(step_fn(batches[0])))  # warmup: compiles outside the trace
+    assert step_fn.zero_active, "ZeRO did not activate on the dp=8 mesh"
+
+    # 1-3: live capture + audit ------------------------------------------------
+    trace_dir = tempfile.mkdtemp(prefix="atpu_profile_smoke_")
+    jax.profiler.start_trace(trace_dir)
+    try:
+        for i in range(1, steps + 1):
+            float(np.asarray(step_fn(batches[i])))
+    finally:
+        jax.profiler.stop_trace()
+    report = profile_scan.analyze_trace_dir(trace_dir)
+    assert report.n_device_events > 0, "empty device timeline"
+    assert report.collective_ms > 0, "no collective bucket in the ZeRO step trace"
+    assert report.overlap_fraction is not None, "overlap fraction not finite"
+    assert 0.0 <= report.overlap_fraction <= 1.0, report.overlap_fraction
+    assert report.exposed_collective_ms <= report.collective_ms + 1e-9, (
+        report.exposed_collective_ms, report.collective_ms,
+    )
+    assert report.steps, "no step segmentation"
+    print(profile_scan.format_profile_report(report))
+
+    # 4: same parser, zero JAX devices ----------------------------------------
+    # JAX_PLATFORMS='' makes any backend/device touch raise in the child, so
+    # a parse that survives proves the offline path needs no devices.
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = ""
+    env.pop("XLA_FLAGS", None)
+    check = (
+        "from accelerate_tpu.telemetry import profile_scan\n"
+        f"r = profile_scan.analyze_trace_file({FIXTURE!r})\n"
+        "assert r.collective_ms == 0.18 and r.exposed_collective_ms == 0.11\n"
+        "print('offline fixture OK', r.overlap_fraction)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", check], env=env, capture_output=True, text=True, timeout=120
+    )
+    if proc.returncode != 0:
+        print(proc.stdout)
+        print(proc.stderr, file=sys.stderr)
+        raise RuntimeError("offline (deviceless) fixture parse failed")
+    sys.stdout.write(proc.stdout)
+
+    # 5: the machine-readable report path -------------------------------------
+    from .report import main as report_main
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = report_main(["--profile", trace_dir, "--json"])
+    assert rc == 0, "telemetry.report --profile --json failed"
+    payload = json.loads(buf.getvalue())
+    assert payload["profile"]["collective_ms"] == report.collective_ms
+
+    print(
+        "profile-smoke OK — ZeRO step trace: "
+        f"{report.collective_ms} ms collective ({report.exposed_collective_ms} ms exposed, "
+        f"overlap {100.0 * report.overlap_fraction:.1f}%) over {len(report.steps)} steps; "
+        "offline fixture parse needed no devices; --json round-trips"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

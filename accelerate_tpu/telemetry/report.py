@@ -4,8 +4,16 @@
 holding ``telemetry_p*.jsonl`` / ``flightrec_p*.jsonl`` files (one per
 process).  Prints a per-span time breakdown, compile statistics, stall
 events, the final metrics snapshot, and — when a flight-recorder snapshot is
-present — a postmortem block: the last N steps, the anomaly list, and the
-final event before the process died.
+present — a postmortem block: the last N steps, the anomaly list, the
+sentinel's anomaly-capture digest, and the final event before the process
+died.
+
+``--profile <dir>`` additionally runs the trace scanner
+(``profile_scan.py``) over any ``jax.profiler`` output directory offline and
+appends the attribution block.  ``--json`` switches to machine-readable
+output (stable ``telemetry``/``postmortem``/``profile`` top-level keys) so
+bench/CI consume the same data without screen-scraping; the human renderer
+is unchanged.
 """
 
 from __future__ import annotations
@@ -80,6 +88,7 @@ def summarize(records: list[dict]) -> dict:
     stalls = []
     snapshot = None
     introspect = {}
+    profiles: dict = {}
     for rec in records:
         kind = rec.get("kind")
         if kind == "span":
@@ -106,6 +115,9 @@ def summarize(records: list[dict]) -> dict:
         elif kind == "introspect":
             # Latest capture per program name wins (a recompile re-captures).
             introspect[rec.get("name", "?")] = rec
+        elif kind == "profile":
+            # Latest scan per trace source wins (a re-armed capture re-scans).
+            profiles[rec.get("source") or "?"] = rec
     return {
         "spans": spans,
         "toplevel_ms": toplevel_ms,
@@ -114,6 +126,7 @@ def summarize(records: list[dict]) -> dict:
         "stalls": stalls,
         "snapshot": snapshot,
         "introspect": introspect,
+        "profiles": profiles,
         "n_records": len(records),
     }
 
@@ -126,6 +139,8 @@ def summarize_flight(records: list[dict]) -> dict:
     crashes = []
     compiles = 0
     events = 0
+    profile_captures = []
+    profile_digests = []
     for rec in records:
         kind = rec.get("kind")
         if kind == "step":
@@ -140,6 +155,11 @@ def summarize_flight(records: list[dict]) -> dict:
             compiles += 1
         elif kind == "event":
             events += 1
+            name = rec.get("name")
+            if name == "sentinel.profile_captured":
+                profile_captures.append(rec)
+            elif name in ("sentinel.profile_digest", "sentinel.profile_analysis_failed"):
+                profile_digests.append(rec)
     final = max(records, key=lambda r: (r.get("t") or 0, r.get("seq") or 0)) if records else None
     return {
         "n_events": len(records),
@@ -149,6 +169,8 @@ def summarize_flight(records: list[dict]) -> dict:
         "crashes": crashes,
         "compiles": compiles,
         "events": events,
+        "profile_captures": profile_captures,
+        "profile_digests": profile_digests,
         "final_event": final,
     }
 
@@ -191,6 +213,35 @@ def format_flight_report(fsummary: dict, last_n: int = 10) -> str:
                 k: v for k, v in a.items() if k not in ("kind", "t", "proc", "seq")
             }
             lines.append(f"  - {detail.pop('reason', '?')}: {detail}")
+    captures = fsummary.get("profile_captures") or []
+    digests = {d.get("trigger_step"): d for d in fsummary.get("profile_digests") or []}
+    for cap in captures:
+        trigger = cap.get("trigger_step")
+        lines.append("")
+        lines.append(
+            f"anomaly profile capture (trigger step {trigger}): {cap.get('dir')}"
+        )
+        dig = digests.get(trigger)
+        if dig is None:
+            lines.append("  no digest recorded (analysis still pending at flush time)")
+        elif dig.get("name") == "sentinel.profile_analysis_failed":
+            lines.append(f"  analysis FAILED: {dig.get('error')}")
+        else:
+            overlap = dig.get("overlap_fraction")
+            overlap_str = f"{100.0 * overlap:.1f}%" if overlap is not None else "n/a"
+            lines.append(
+                f"  digest: device busy {dig.get('device_busy_ms')} ms, "
+                f"compute {dig.get('compute_ms')} ms, "
+                f"collective {dig.get('collective_ms')} ms "
+                f"(exposed {dig.get('exposed_collective_ms')} ms, overlap {overlap_str}), "
+                f"idle {dig.get('idle_ms')} ms over {dig.get('n_steps')} step(s)"
+            )
+            top = dig.get("top_ops") or []
+            if top:
+                lines.append(
+                    "  top ops: "
+                    + ", ".join(f"{r.get('name')} {r.get('self_ms')} ms" for r in top)
+                )
     for sig in fsummary["signals"]:
         lines.append(
             f"signal: {sig.get('name', sig.get('signum'))} at t={sig.get('t')}"
@@ -288,6 +339,11 @@ def format_report(summary: dict) -> str:
             lines.append("  comms: no collectives (single-device program)")
         for finding in rec.get("lint") or []:
             lines.append(f"  LINT[{finding.get('kind')}]: {finding.get('message')}")
+    for source in sorted(summary.get("profiles") or {}):
+        from .profile_scan import format_profile_report, report_from_dict
+
+        lines.append("")
+        lines.append(format_profile_report(report_from_dict(summary["profiles"][source])))
     snapshot = summary["snapshot"]
     if snapshot:
         lines.append("")
@@ -309,7 +365,12 @@ def main(argv=None) -> int:
             "flight-recorder snapshot exists) a postmortem of the last steps."
         ),
     )
-    parser.add_argument("path", help="telemetry/flightrec JSONL file or run directory")
+    parser.add_argument(
+        "path",
+        nargs="?",
+        default=None,
+        help="telemetry/flightrec JSONL file or run directory",
+    )
     parser.add_argument(
         "--last",
         type=int,
@@ -317,23 +378,80 @@ def main(argv=None) -> int:
         metavar="N",
         help="steps/anomalies to show in the flight-recorder block (default 10)",
     )
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        help=(
+            "machine-readable output: one JSON object with telemetry/"
+            "postmortem/profile blocks instead of the human report"
+        ),
+    )
+    parser.add_argument(
+        "--profile",
+        default=None,
+        metavar="DIR",
+        help=(
+            "analyze a jax.profiler trace directory (or *.trace.json[.gz] "
+            "file) offline and append the attribution block"
+        ),
+    )
     args = parser.parse_args(argv)
-    if not os.path.exists(args.path):
-        print(f"no such file or directory: {args.path}", file=sys.stderr)
-        return 1
-    is_flight_file = not os.path.isdir(args.path) and os.path.basename(
-        args.path
-    ).startswith("flightrec_")
-    records = [] if is_flight_file else load_records(args.path)
-    flight = load_flight_records(args.path) if (os.path.isdir(args.path) or is_flight_file) else []
-    if not records and not flight:
-        print(f"no telemetry records found under {args.path}", file=sys.stderr)
-        return 1
+    if args.path is None and args.profile is None:
+        parser.error("a run path and/or --profile <dir> is required")
+    profile_report = None
+    if args.profile is not None:
+        from .profile_scan import TraceParseError, analyze_trace_dir
+
+        if not os.path.exists(args.profile):
+            print(f"no such file or directory: {args.profile}", file=sys.stderr)
+            return 1
+        try:
+            profile_report = analyze_trace_dir(args.profile)
+        except TraceParseError as e:
+            print(f"profile scan failed: {e}", file=sys.stderr)
+            return 1
+    records: list = []
+    flight: list = []
+    if args.path is not None:
+        if not os.path.exists(args.path):
+            print(f"no such file or directory: {args.path}", file=sys.stderr)
+            return 1
+        is_flight_file = not os.path.isdir(args.path) and os.path.basename(
+            args.path
+        ).startswith("flightrec_")
+        records = [] if is_flight_file else load_records(args.path)
+        flight = (
+            load_flight_records(args.path)
+            if (os.path.isdir(args.path) or is_flight_file)
+            else []
+        )
+        if not records and not flight:
+            print(f"no telemetry records found under {args.path}", file=sys.stderr)
+            # A successful --profile scan still renders: the run dir being
+            # empty must not throw away the half that worked.
+            if profile_report is None:
+                return 1
+    if args.json:
+        # Machine contract (bench/CI): stable top-level keys, no screen
+        # scraping.  Blocks are present only when their inputs are.
+        out: dict = {}
+        if records:
+            out["telemetry"] = summarize(records)
+        if flight:
+            out["postmortem"] = summarize_flight(flight)
+        if profile_report is not None:
+            out["profile"] = profile_report.to_dict()
+        print(json.dumps(out, default=str))
+        return 0
     blocks = []
     if records:
         blocks.append(format_report(summarize(records)))
     if flight:
         blocks.append(format_flight_report(summarize_flight(flight), last_n=args.last))
+    if profile_report is not None:
+        from .profile_scan import format_profile_report
+
+        blocks.append(format_profile_report(profile_report))
     print("\n\n".join(blocks))
     return 0
 

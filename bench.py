@@ -718,6 +718,83 @@ def _zero_probe() -> dict:
     }
 
 
+def _profile_probe() -> dict:
+    """Trace-driven overlap audit of the ZeRO fused step on a forced 8-device
+    CPU mesh (telemetry/profile_scan.py): captures a bounded ``jax.profiler``
+    window over a few optimizer steps and attributes the device timeline —
+    exposed-collective ms (comms NOT hidden behind concurrent compute),
+    realized overlap fraction, and the top ops by self time.  The overlap
+    fraction is the number that transfers to TPU; CPU absolute ms do not."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu.accelerator import Accelerator, JaxModel
+    from accelerate_tpu.parallel.sharding import data_sharding
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.telemetry import profile_scan
+    from accelerate_tpu.utils.dataclasses import ParallelismConfig
+
+    NDP = jax.device_count()
+    STEPS = 6
+    DIM = 256
+    BATCH = 16
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    acc = Accelerator(parallelism_config=ParallelismConfig(dp=NDP))
+    params = {
+        "w1": jax.random.normal(jax.random.PRNGKey(0), (DIM, DIM), jnp.float32) * 0.05,
+        "b1": jax.random.normal(jax.random.PRNGKey(1), (DIM,), jnp.float32) * 0.05,
+        "w2": jax.random.normal(jax.random.PRNGKey(2), (DIM, DIM), jnp.float32) * 0.05,
+    }
+
+    def apply_fn(p, x, y):
+        h = jnp.tanh(x @ p["w1"] + p["b1"])
+        return {"loss": jnp.mean((h @ p["w2"] - y) ** 2)}
+
+    model, opt = acc.prepare(JaxModel(apply_fn, params), optax.adam(1e-3))
+    step_fn = acc.make_train_step(model, opt, clip_norm=1.0, zero=NDP >= 2)
+    sh = data_sharding(acc.mesh)
+
+    def batch(i):
+        x = np.asarray(jax.random.normal(jax.random.PRNGKey(500 + i), (BATCH, DIM)), np.float32)
+        y = np.asarray(jax.random.normal(jax.random.PRNGKey(600 + i), (BATCH, DIM)), np.float32)
+        return {"x": jax.device_put(x, sh), "y": jax.device_put(y, sh)}
+
+    batches = [batch(i) for i in range(STEPS)]
+    float(np.asarray(step_fn(batches[0])))  # warmup: compiles
+    trace_dir = tempfile.mkdtemp(prefix="atpu_bench_profile_")
+    jax.profiler.start_trace(trace_dir)
+    try:
+        for i in range(1, STEPS):
+            float(np.asarray(step_fn(batches[i])))
+    finally:
+        jax.profiler.stop_trace()
+    report = profile_scan.analyze_trace_dir(trace_dir)
+    return {
+        "profile": {
+            "devices": NDP,
+            "zero_active": step_fn.zero_active,
+            "optimizer_steps": STEPS - 1,
+            "window_ms": report.window_ms,
+            "device_busy_ms": report.device_busy_ms,
+            "compute_ms": report.compute_ms,
+            "collective_ms": report.collective_ms,
+            "exposed_collective_ms": report.exposed_collective_ms,
+            "overlap_fraction": report.overlap_fraction,
+            "steps_in_trace": len(report.steps),
+            "top_ops": [
+                {"name": r["name"], "bucket": r["bucket"], "self_ms": r["self_ms"]}
+                for r in report.top_ops[:3]
+            ],
+        }
+    }
+
+
 def _health_probe() -> dict:
     """Numerical-health-guard overhead micro-benchmark (resilience/health.py):
     fused-step steps/s with the guard off vs on.  Detection lives INSIDE the
@@ -847,24 +924,32 @@ def _health_probe() -> dict:
     }
 
 
-def _run_health_probe_subprocess(timeout_s: float = 240.0):
-    """Health-guard probe in a bounded CPU subprocess (same contract as the
-    rung children: last JSON line on stdout is the result, silence is
-    failure)."""
+def _run_probe_subprocess(name: str, timeout_s: float, force_devices: int = 0):
+    """One bounded CPU probe child (same contract as the rung children: last
+    JSON line on stdout is the result, silence is failure).  ``name`` is the
+    probe's CLI-flag stem (``--<name>-probe``); ``force_devices`` > 0 adds
+    the virtual host-device XLA flag (the dp mesh the sharded-update and
+    trace-attribution probes need)."""
     import subprocess
 
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    if force_devices:
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={force_devices}"
+            ).strip()
     try:
         proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--health-probe"],
+            [sys.executable, os.path.abspath(__file__), f"--{name}-probe"],
             capture_output=True,
             text=True,
             timeout=timeout_s,
             env=env,
         )
     except subprocess.TimeoutExpired:
-        return None, f"health probe timeout after {timeout_s:.0f}s"
+        return None, f"{name} probe timeout after {timeout_s:.0f}s"
     if proc.returncode != 0:
         return None, (proc.stderr or "")[-200:].replace("\n", " ")
     for line in reversed(proc.stdout.splitlines()):
@@ -874,99 +959,27 @@ def _run_health_probe_subprocess(timeout_s: float = 240.0):
                 return json.loads(line), None
             except ValueError:
                 continue
-    return None, "no parseable health-probe line"
+    return None, f"no parseable {name}-probe line"
+
+
+def _run_health_probe_subprocess(timeout_s: float = 240.0):
+    return _run_probe_subprocess("health", timeout_s)
 
 
 def _run_pipeline_probe_subprocess(timeout_s: float = 240.0):
-    """Pipeline probe in a bounded CPU subprocess (same contract as the rung
-    children: last JSON line on stdout is the result, silence is failure)."""
-    import subprocess
-
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--pipeline-probe"],
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-            env=env,
-        )
-    except subprocess.TimeoutExpired:
-        return None, f"pipeline probe timeout after {timeout_s:.0f}s"
-    if proc.returncode != 0:
-        return None, (proc.stderr or "")[-200:].replace("\n", " ")
-    for line in reversed(proc.stdout.splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                return json.loads(line), None
-            except ValueError:
-                continue
-    return None, "no parseable pipeline-probe line"
+    return _run_probe_subprocess("pipeline", timeout_s)
 
 
 def _run_zero_probe_subprocess(timeout_s: float = 240.0):
-    """ZeRO probe in a bounded CPU subprocess with 8 forced host devices (the
-    dp mesh the sharded update needs; same contract as the other probes:
-    last JSON line on stdout is the result, silence is failure)."""
-    import subprocess
+    return _run_probe_subprocess("zero", timeout_s, force_devices=8)
 
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    flags = env.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--zero-probe"],
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-            env=env,
-        )
-    except subprocess.TimeoutExpired:
-        return None, f"zero probe timeout after {timeout_s:.0f}s"
-    if proc.returncode != 0:
-        return None, (proc.stderr or "")[-200:].replace("\n", " ")
-    for line in reversed(proc.stdout.splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                return json.loads(line), None
-            except ValueError:
-                continue
-    return None, "no parseable zero-probe line"
+
+def _run_profile_probe_subprocess(timeout_s: float = 240.0):
+    return _run_probe_subprocess("profile", timeout_s, force_devices=8)
 
 
 def _run_checkpoint_probe_subprocess(timeout_s: float = 180.0):
-    """Checkpoint-latency probe in a bounded CPU subprocess (same contract as
-    the rung children: last JSON line on stdout is the result, silence is
-    failure)."""
-    import subprocess
-
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--checkpoint-probe"],
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-            env=env,
-        )
-    except subprocess.TimeoutExpired:
-        return None, f"checkpoint probe timeout after {timeout_s:.0f}s"
-    if proc.returncode != 0:
-        return None, (proc.stderr or "")[-200:].replace("\n", " ")
-    for line in reversed(proc.stdout.splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                return json.loads(line), None
-            except ValueError:
-                continue
-    return None, "no parseable checkpoint-probe line"
+    return _run_probe_subprocess("checkpoint", timeout_s)
 
 
 def _honor_cpu_env():
@@ -1044,6 +1057,9 @@ def main():
         return
     if "--zero-probe" in sys.argv:
         print(json.dumps(_zero_probe()))
+        return
+    if "--profile-probe" in sys.argv:
+        print(json.dumps(_profile_probe()))
         return
     if "--health-probe" in sys.argv:
         print(json.dumps(_health_probe()))
@@ -1337,6 +1353,16 @@ def main():
         zero_block = zero_probe["zero"] if zero_probe else {"status": zero_err}
         print(f"# zero probe: {zero_block}", file=sys.stderr, flush=True)
 
+    # Trace-attribution probe (telemetry/profile_scan.py): exposed-collective
+    # ms + realized overlap of the ZeRO fused step from a bounded jax.profiler
+    # capture on a forced 8-device CPU mesh.  CPU subprocess, never zeroes the
+    # headline.
+    profile_block = None
+    if os.environ.get("BENCH_PROFILE_PROBE", "1") != "0":
+        prof_probe, prof_err = _run_profile_probe_subprocess()
+        profile_block = prof_probe["profile"] if prof_probe else {"status": prof_err}
+        print(f"# profile probe: {profile_block}", file=sys.stderr, flush=True)
+
     detail = {
         "config": result["config"],
         "rung": rung_cfg,
@@ -1360,6 +1386,8 @@ def main():
         detail["health"] = health_block
     if zero_block is not None:
         detail["zero"] = zero_block
+    if profile_block is not None:
+        detail["profile"] = profile_block
     if proof is not None:
         detail["hbm_bound_proof"] = {
             "config": proof_cfg,

@@ -415,3 +415,89 @@ def test_report_excludes_flightrec_from_telemetry_block(tmp_path):
     assert len(records) == 1
     assert telemetry_report.summarize(records)["compiles"] == 1
     assert len(load_flight_records(str(tmp_path))) == 1
+
+
+# ---------------------------------------------------------------------------
+# Anomaly capture -> digest -> postmortem (the write-only-capture fix)
+# ---------------------------------------------------------------------------
+
+
+def test_forced_slow_step_digest_reaches_postmortem(tmp_path):
+    """Subprocess regression (like flightrec-smoke): a forced slow step trips
+    the sentinel, the one-shot profiler window captures real device work, the
+    off-hot-path scanner appends ``sentinel.profile_captured`` +
+    ``sentinel.profile_digest`` to the ring, and the rendered postmortem
+    links the digest to its anomaly.  Pre-PR the capture directory was
+    write-only: recorded nowhere, analyzed never."""
+    code = (
+        "import sys, time\n"
+        "import numpy as np\n"
+        "import jax, jax.numpy as jnp\n"
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
+        "from accelerate_tpu import telemetry\n"
+        "from accelerate_tpu.telemetry import AnomalySentinel, flightrec\n"
+        "rec = flightrec.enable(dir=sys.argv[1], flush_every=100000,\n"
+        "    sentinel=AnomalySentinel(window=8, warmup=2, factor=2.0, min_excess_ms=5.0))\n"
+        "tel = telemetry.get_telemetry()\n"
+        "mesh = Mesh(np.array(jax.devices()).reshape(-1), ('dp',))\n"
+        "x = jax.device_put(jnp.ones((8, 128)), NamedSharding(mesh, P('dp')))\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    s = jax.lax.with_sharding_constraint(x.sum(axis=1), NamedSharding(mesh, P()))\n"
+        "    return x * 2 + s.sum()\n"
+        "f(x).block_until_ready()\n"
+        "for step in range(1, 12):\n"
+        "    f(x).block_until_ready()\n"
+        "    if step == 6:\n"
+        "        time.sleep(0.4)\n"  # the forced slow step
+        "    time.sleep(0.02)\n"
+        "    tel.record_step()\n"
+        "flightrec.disable()\n"  # joins the analysis thread: digest lands
+        "print('DONE', flush=True)\n"
+    )
+    env = dict(os.environ)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            # Conftest pins the sentinel profiler OFF suite-wide; this test
+            # exists to exercise it, in its own interpreter.
+            "ACCELERATE_TPU_SENTINEL_PROFILE": "1",
+            "ACCELERATE_TPU_TELEMETRY_DIR": str(tmp_path),
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        }
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code, str(tmp_path)],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=300,
+    )
+    assert proc.returncode == 0, (proc.returncode, proc.stdout[-500:], proc.stderr[-2000:])
+    assert "DONE" in proc.stdout
+
+    records = load_flight_records(str(tmp_path))
+    by_name = {}
+    for r in records:
+        if r.get("kind") == "event":
+            by_name.setdefault(r.get("name"), []).append(r)
+    assert any(r.get("reason") == "slow_step" for r in records if r.get("kind") == "anomaly")
+    captured = by_name.get("sentinel.profile_captured")
+    assert captured, f"no capture event (events: {sorted(by_name)})"
+    assert captured[0].get("dir") and captured[0].get("trigger_step") is not None
+    digests = by_name.get("sentinel.profile_digest")
+    assert digests, (
+        f"no digest event (events: {sorted(by_name)}; "
+        f"failure: {by_name.get('sentinel.profile_analysis_failed')})"
+    )
+    dig = digests[0]
+    assert dig["trigger_step"] == captured[0]["trigger_step"]
+    assert dig.get("device_busy_ms") is not None
+    assert dig.get("collective_ms", 0) > 0  # the jitted fn all-gathers
+
+    postmortem = format_flight_report(summarize_flight(records))
+    assert "slow_step" in postmortem
+    trigger = captured[0]["trigger_step"]
+    assert f"anomaly profile capture (trigger step {trigger})" in postmortem
+    assert "digest: device busy" in postmortem
+    assert "top ops:" in postmortem

@@ -84,6 +84,7 @@ def _passing_zero_measurements():
         zero_vs_eager_ratio=2.0,
         zero_dispatches_per_step=1.0,
         zero_host_blocked_ms_per_step=2.0,
+        zero_exposed_collective_frac=0.5,
     )
 
 
@@ -101,6 +102,22 @@ def test_evaluate_zero_row_thresholds():
     assert evaluate(m, baseline) == []
 
 
+def test_evaluate_overlap_row_thresholds():
+    """The exposed-collective row (PR 8): too-exposed fails, a missing audit
+    number fails LOUDLY (a broken capture is a broken check), and the
+    single-device skip still applies."""
+    baseline = load_baseline()
+    assert baseline["max_exposed_collective_frac"] < 1.0
+    m = dict(_passing_zero_measurements(), zero_exposed_collective_frac=1.0)
+    assert any("exposed-collective fraction" in f for f in evaluate(m, baseline))
+    m = dict(_passing_zero_measurements(), zero_exposed_collective_frac=None,
+             zero_profile_error="trace analysis exploded")
+    failures = evaluate(m, baseline)
+    assert any("unchecked" in f and "exploded" in f for f in failures)
+    m = dict(_passing_measurements(), zero_active=None)
+    assert evaluate(m, baseline) == []
+
+
 def test_gate_fails_when_zero_silently_falls_back(monkeypatch):
     """ACCELERATE_TPU_PERF_GATE_DEGRADE=zero-fallback runs the ZeRO arm with
     the replicated update — the zero_active tripwire must fail the gate."""
@@ -109,3 +126,17 @@ def test_gate_fails_when_zero_silently_falls_back(monkeypatch):
     assert measurements["zero_active"] is False
     failures = evaluate(measurements, load_baseline())
     assert any("silently fell back" in f for f in failures)
+
+
+@pytest.mark.slow
+def test_gate_fails_when_overlap_stripped(monkeypatch):
+    """ACCELERATE_TPU_PERF_GATE_DEGRADE=no-overlap scans the ZeRO arm's trace
+    with the concurrent-compute credit disabled (what stripping the TPU
+    latency-hiding flags does at runtime): exposed frac hits 1.0 by
+    construction and the overlap row must fail the gate.  Probe-level
+    self-test; the cheap evaluate()-level row tests run in tier-1."""
+    monkeypatch.setenv("ACCELERATE_TPU_PERF_GATE_DEGRADE", "no-overlap")
+    measurements = run_probe(accum=2, steps=4, dim=64, batch=8, epochs=1, prefetch=0)
+    assert measurements["zero_exposed_collective_frac"] == 1.0
+    failures = evaluate(measurements, load_baseline())
+    assert any("exposed-collective fraction" in f for f in failures)
