@@ -1,10 +1,10 @@
 # Test-suite splits mirroring the reference Makefile:25-77.
 
-.PHONY: test test-quick test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels bench telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke profile-smoke perf-gate
+.PHONY: test test-quick test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels bench telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke profile-smoke serving-smoke perf-gate
 
 PYTEST = python -m pytest -q
 
-test: test-quick telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke profile-smoke perf-gate
+test: test-quick telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke profile-smoke serving-smoke perf-gate
 	$(PYTEST) tests/
 
 # <5 min tier (VERDICT r5 item 6): oracles, state, sharding-spec/mesh,
@@ -70,6 +70,15 @@ flightrec-smoke:
 # (docs/package_reference/profile.md).
 profile-smoke:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.telemetry.profile_smoke
+
+# Continuous-batching proof on an 8-device CPU mesh: a staggered request mix
+# through the paged-KV serving engine (pool tight enough to force
+# preemption) must produce token-identical greedy outputs to the offline
+# generate_loop per request, keep the fused decode step at <= 1 dispatch per
+# tick (telemetry counter delta), and land the serving.* SLO metrics in the
+# telemetry report (docs/usage_guides/serving.md).
+serving-smoke:
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.serving.smoke
 
 # CPU-tier perf-regression gate: eager-vs-fused probe judged against the
 # committed baseline (benchmarks/perf_baseline_cpu.json) — dispatches/step

@@ -88,4 +88,8 @@ def __getattr__(name):
         from . import resilience
 
         return getattr(resilience, name)
+    if name in ("ServingEngine", "ServingConfig"):
+        from . import serving
+
+        return getattr(serving, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1325,6 +1325,50 @@ class Accelerator:
             zero=zero,
         )
 
+    def prepare_serving(
+        self,
+        apply_cached,
+        init_cache,
+        params,
+        config,
+        serving=None,
+        **serving_kwargs,
+    ):
+        """Build a continuous-batching serving engine over a model family's
+        cached-decode pair (``serving/engine.py``): a paged/block KV cache
+        shared by every in-flight request, an admission queue with LIFO
+        preemption under block pressure, bounded chunked prefill interleaved
+        with decode, and ONE fused jitted decode dispatch per step over the
+        active slots — greedy outputs token-identical to the offline
+        ``generate_loop`` per request.  Per-request SLO metrics (TTFT,
+        inter-token latency, queue wait) publish through the telemetry
+        registry as the ``serving.*`` families; completions emit
+        ``serving.request_complete`` events the flight recorder mirrors.
+
+        ``apply_cached``/``init_cache`` are a family's cached-inference pair
+        (``models/{gpt2,llama,mixtral}.py`` — fp or int8 KV); ``params`` stay
+        wherever the caller placed them (replicated params keep the decode
+        step mesh-shardable under GSPMD).  Geometry comes from a
+        :class:`~accelerate_tpu.serving.ServingConfig` (or its fields as
+        keyword arguments)::
+
+            engine = accelerator.prepare_serving(
+                gpt2.apply_cached, gpt2.init_cache, params, cfg,
+                max_slots=8, num_blocks=256, block_size=16,
+            )
+            rid = engine.submit(prompt_tokens, max_new_tokens=64)
+            outputs = engine.run()
+
+        See ``docs/usage_guides/serving.md``.
+        """
+        from .serving import ServingConfig, ServingEngine
+
+        if serving is not None and serving_kwargs:
+            raise ValueError("pass either a ServingConfig or its fields, not both")
+        if serving is None:
+            serving = ServingConfig(**serving_kwargs)
+        return ServingEngine(apply_cached, init_cache, params, config, serving=serving)
+
     @_span("accelerator.backward")
     def backward(self, loss, **kwargs):
         """Accumulate gradients for ``loss`` (reference ``accelerator.py:2437``)."""

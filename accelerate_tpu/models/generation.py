@@ -17,6 +17,8 @@ __all__ = [
     "generate_loop", "select_token", "make_kv_cache", "check_cache_room",
     "quantize_kv", "dequantize_kv", "pack_cache_for_scan",
     "unpack_cache_from_scan", "cache_write", "speculative_generate_loop",
+    "make_paged_pool", "gather_block_view", "extract_token_rows",
+    "scatter_token_rows",
 ]
 
 
@@ -96,6 +98,92 @@ def cache_write(cache_leaf, new_rows: jax.Array, index, dtype):
         cache_leaf, new_rows.astype(cache_leaf.dtype), (0, index, 0, 0)
     )
     return updated, updated
+
+
+# ---------------------------------------------------------------------------
+# Paged (block) KV cache primitives — the storage layer under the serving
+# engine (serving/engine.py).  The resident cache between decode steps is a
+# POOL of fixed-size blocks shared by every request ([L, num_blocks,
+# block_size, ...] per leaf) plus per-request block tables; these helpers
+# translate between that pool and the dense per-request [L, B=1, T, ...]
+# view the families' ``apply_cached`` already consumes, so paged serving
+# needs no per-family changes.
+# ---------------------------------------------------------------------------
+
+
+def make_paged_pool(init_cache: Callable, config, num_blocks: int, block_size: int) -> dict:
+    """Zeroed block pool derived from a family's own ``init_cache``: every
+    non-``index`` leaf ``[L, 1, block_size, *rest]`` of the batch-1 template
+    becomes ``[L, num_blocks, block_size, *rest]`` (so the int8 codes+scale
+    layout pages exactly like the fp one).  Block 0 is the engine's reserved
+    NULL block — table padding and inactive-slot writes route there, and no
+    allocated region ever reads it."""
+    template = init_cache(config, 1, block_size)
+    pool = {}
+    for name, leaf in template.items():
+        if name == "index":
+            continue
+        if leaf.ndim < 3 or leaf.shape[1] != 1 or leaf.shape[2] != block_size:
+            raise ValueError(
+                f"cache leaf {name!r} has shape {leaf.shape}; paged serving needs "
+                f"the make_kv_cache layout [L, B, max_len, ...] (batch axis 1, "
+                f"token axis 2)"
+            )
+        pool[name] = jnp.zeros(
+            (leaf.shape[0], num_blocks) + leaf.shape[2:], leaf.dtype
+        )
+    if not pool:
+        raise ValueError("init_cache produced no pageable KV leaves")
+    return pool
+
+
+def gather_block_view(pool_leaf: jax.Array, tables: jax.Array) -> jax.Array:
+    """Dense per-slot view of a pool leaf: ``[L, N, bs, *r]`` gathered through
+    block tables ``[S, M]`` -> ``[S, L, 1, M*bs, *r]`` (the families'
+    batch-1 cache layout, slot axis leading for ``vmap``).  Table entries
+    pointing at the null block contribute rows that the causal mask hides —
+    the engine keeps every real token position inside the allocated block
+    prefix."""
+    g = jnp.take(pool_leaf, tables, axis=1)  # [L, S, M, bs, *r]
+    g = jnp.moveaxis(g, 1, 0)  # [S, L, M, bs, *r]
+    s, l, m, bs = g.shape[:4]
+    return g.reshape(s, l, 1, m * bs, *g.shape[4:])
+
+
+def _token_positions(start: jax.Array, count: int) -> jax.Array:
+    return start[:, None].astype(jnp.int32) + jnp.arange(count, dtype=jnp.int32)[None, :]
+
+
+def extract_token_rows(view_leaf: jax.Array, start: jax.Array, count: int) -> jax.Array:
+    """Pull the rows a forward pass just wrote out of the dense view:
+    ``[S, L, 1, T, *r]`` at token positions ``start[s] + arange(count)`` ->
+    ``[S, L, count, *r]``."""
+    pos = _token_positions(start, count)  # [S, count]
+    idx = pos.reshape(pos.shape[0], 1, 1, count, *([1] * (view_leaf.ndim - 4)))
+    rows = jnp.take_along_axis(view_leaf, idx, axis=3)  # [S, L, 1, count, *r]
+    return rows.reshape(rows.shape[0], rows.shape[1], count, *rows.shape[4:])
+
+
+def scatter_token_rows(
+    pool_leaf: jax.Array,
+    rows: jax.Array,
+    tables: jax.Array,
+    start: jax.Array,
+    count: int,
+) -> jax.Array:
+    """Write token rows ``[S, L, count, *r]`` back into the pool at positions
+    ``start[s] + arange(count)`` through block tables ``[S, M]``.  Positions
+    past the table extent (chunked-prefill padding) are routed to the null
+    block explicitly — ``take_along_axis`` would otherwise CLAMP the block
+    index and corrupt a real block."""
+    bs = pool_leaf.shape[2]
+    m = tables.shape[1]
+    pos = _token_positions(start, count)  # [S, count]
+    blk_idx = pos // bs
+    blk = jnp.take_along_axis(tables, jnp.clip(blk_idx, 0, m - 1), axis=1)
+    blk = jnp.where(blk_idx < m, blk, 0)
+    off = pos % bs
+    return pool_leaf.at[:, blk, off].set(jnp.moveaxis(rows, 0, 1))
 
 
 def check_cache_room(index, new_tokens: int, max_len: int) -> None:

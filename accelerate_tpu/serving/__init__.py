@@ -1,0 +1,35 @@
+"""Production serving layer: continuous batching over a paged KV cache.
+
+Three pieces (see ``docs/usage_guides/serving.md``):
+
+- **blocks** — a fixed-size-block KV pool with a free-list allocator and
+  per-request block tables, so heterogeneous sequence lengths stop tiling
+  HBM to the maximum context (``blocks.py``);
+- **scheduler** — the continuous-batching request scheduler: admission
+  queue, slot map, LIFO preemption under block pressure
+  (``scheduler.py``);
+- **engine** — the serving engine itself: one fused jitted decode step
+  over the in-flight batch per tick plus bounded chunked prefill, with
+  per-request SLO metrics (TTFT, inter-token latency, queue wait)
+  published through the telemetry registry (``engine.py``).
+
+Entry point: :meth:`accelerate_tpu.Accelerator.prepare_serving`, or
+construct :class:`ServingEngine` directly from a model family's
+``apply_cached``/``init_cache`` pair.
+"""
+
+from .blocks import BlockAllocator, BlockOutOfMemory, PagedKVCache
+from .engine import CompletedRequest, ServingConfig, ServingEngine
+from .scheduler import Request, RequestState, Scheduler
+
+__all__ = [
+    "BlockAllocator",
+    "BlockOutOfMemory",
+    "PagedKVCache",
+    "CompletedRequest",
+    "Request",
+    "RequestState",
+    "Scheduler",
+    "ServingConfig",
+    "ServingEngine",
+]

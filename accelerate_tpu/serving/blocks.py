@@ -1,0 +1,127 @@
+"""Paged KV-cache storage: a block pool plus a host-side free-list allocator.
+
+The resident KV cache is a pool of ``num_blocks`` fixed-size blocks shared by
+every in-flight request (``[L, num_blocks, block_size, ...]`` per leaf — the
+int8 codes+scale layout from ``quantize_kv`` pages identically), with a
+per-request **block table** mapping logical token positions to physical
+blocks.  A request holding ``n`` tokens costs ``ceil(n / block_size)`` blocks
+instead of ``max_len`` rows, so a 32-token request and a 2k-token request can
+share the pool that a dense cache would tile to 2k each.
+
+Fixed-size blocks mean external fragmentation is structurally zero: any free
+block serves any request, and the only waste is the tail of the last block
+(< ``block_size`` rows per request).  The allocator is plain host Python —
+allocation decisions happen between dispatches, never inside the jitted
+decode step.
+
+Block 0 is reserved as the **null block**: it is never handed out, block
+tables are padded with it, and inactive decode slots write their garbage row
+into it, so stray gathers/scatters can never touch a live request's KV.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List
+
+__all__ = ["BlockAllocator", "BlockOutOfMemory", "PagedKVCache", "blocks_for_tokens"]
+
+NULL_BLOCK = 0
+
+
+class BlockOutOfMemory(RuntimeError):
+    """No free block available; the caller decides (preempt, queue, reject)."""
+
+
+def blocks_for_tokens(tokens: int, block_size: int) -> int:
+    """ceil(tokens / block_size) — blocks needed to hold ``tokens`` rows."""
+    return -(-tokens // block_size)
+
+
+class BlockAllocator:
+    """LIFO free-list over block ids ``1..num_blocks-1`` (0 is the null
+    block).  LIFO keeps recently-freed (cache-warm) blocks hot, and makes
+    alloc/free O(1)."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (one null + one usable), got {num_blocks}"
+            )
+        self.num_blocks = num_blocks
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._allocated: set = set()
+
+    @property
+    def capacity(self) -> int:
+        """Usable blocks (excludes the null block)."""
+        return self.num_blocks - 1
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return len(self._allocated)
+
+    @property
+    def occupancy(self) -> float:
+        """Fraction of usable blocks currently allocated."""
+        return self.used_blocks / self.capacity
+
+    def alloc(self, n: int = 1) -> List[int]:
+        """Pop ``n`` free blocks; raises :class:`BlockOutOfMemory` (allocating
+        NOTHING) when fewer than ``n`` are free — partial grants would leak
+        on the error path."""
+        if n < 0:
+            raise ValueError(f"alloc count must be >= 0, got {n}")
+        if n > len(self._free):
+            raise BlockOutOfMemory(
+                f"need {n} blocks, {len(self._free)} free of {self.capacity}"
+            )
+        out = [self._free.pop() for _ in range(n)]
+        self._allocated.update(out)
+        return out
+
+    def free(self, blocks: List[int]) -> None:
+        """Return blocks to the free list; double-free and freeing the null
+        block are hard errors (both indicate scheduler corruption)."""
+        for b in blocks:
+            if b == NULL_BLOCK:
+                raise ValueError("cannot free the null block")
+            if b not in self._allocated:
+                raise ValueError(f"double free / foreign block: {b}")
+            self._allocated.remove(b)
+            self._free.append(b)
+
+
+class PagedKVCache:
+    """The device-side block pool plus its allocator.
+
+    ``init_cache`` is a model family's cache constructor (``models/*.py``);
+    the pool leaves are derived from its batch-1 template, so the fp and
+    int8-quantized layouts both page without special cases
+    (:func:`accelerate_tpu.models.generation.make_paged_pool`).
+    """
+
+    def __init__(
+        self,
+        init_cache: Callable,
+        config,
+        num_blocks: int,
+        block_size: int,
+    ):
+        from ..models.generation import make_paged_pool
+
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.block_size = block_size
+        self.allocator = BlockAllocator(num_blocks)
+        self.pool = make_paged_pool(init_cache, config, num_blocks, block_size)
+
+    @property
+    def leaf_names(self) -> list:
+        return sorted(self.pool)
+
+    def pool_bytes(self) -> int:
+        return sum(leaf.size * leaf.dtype.itemsize for leaf in self.pool.values())

@@ -1,0 +1,461 @@
+"""The serving engine: continuous batching over the paged KV cache.
+
+One engine **tick** (:meth:`ServingEngine.step`) is:
+
+1. **admit** — queue-head requests take free decode slots (FIFO);
+2. **prefill** — at most ONE bounded chunk (``prefill_chunk`` tokens, padded
+   to a static shape) of the oldest prefilling request runs, so a 10k-token
+   prompt costs many small dispatches interleaved with decode instead of one
+   huge dispatch that stalls every in-flight request;
+3. **decode** — ONE fused jitted dispatch advances every decoding slot by one
+   token: the block tables gather each slot's paged KV into the dense view
+   the family's ``apply_cached`` consumes, a ``vmap`` over slots runs the
+   per-token forward with per-slot write indices, and the freshly written
+   K/V rows scatter back into the pool.  The 1-dispatch-per-decode-step
+   invariant from ``make_train_step`` carries over — the
+   ``serving.decode_dispatches`` counter is the proof hook.
+
+Token selection is **greedy** (argmax, inside the fused program): outputs are
+token-identical to the offline ``generate_loop`` with ``temperature=0`` per
+request, which is the engine's equivalence oracle (``tests/test_serving.py``,
+``make serving-smoke``).
+
+Chunked-prefill padding contract: chunks are padded to the static
+``prefill_chunk`` length.  Padded queries produce ignored logits; padded K/V
+rows land at positions past the real prefix — positions the causal mask hides
+from every existing query and that sequential future writes overwrite before
+any query of that position exists.  Pool writes for positions past the block
+table route to the null block.  The scheduler's geometry validation
+guarantees ``ceil(rows / prefill_chunk) * prefill_chunk <= max_blocks_per_seq
+* block_size``, so the padded write never clamps inside the dense view.
+
+SLO metrics per request — TTFT, inter-token latency, queue wait, tokens/s,
+preemption count — publish through the telemetry registry
+(``serving.*`` families) and each completion emits a
+``serving.request_complete`` event, which the flight recorder mirrors into
+its durable ring when enabled.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..models.generation import (
+    extract_token_rows,
+    gather_block_view,
+    scatter_token_rows,
+)
+from ..telemetry import get_telemetry
+from .blocks import PagedKVCache
+from .scheduler import Request, RequestState, Scheduler
+
+__all__ = ["ServingConfig", "ServingEngine", "CompletedRequest"]
+
+
+@dataclass
+class ServingConfig:
+    """Engine geometry (everything here is a static shape of the compiled
+    programs — two programs total, however many requests flow through).
+
+    - ``block_size``: tokens per KV block.  Small blocks waste less tail
+      space per request; large blocks shrink the tables.  16-64 is typical.
+    - ``num_blocks``: pool size (one block is reserved as the null block).
+      Pool HBM = ``num_blocks * block_size`` rows per layer — budget this
+      like a dense cache of total length ``num_blocks * block_size`` shared
+      by ALL requests, not tiled per request.
+    - ``max_slots``: the decode batch width (static).  More slots = more
+      requests advanced per decode dispatch.
+    - ``max_blocks_per_seq``: block-table width (static); caps any single
+      request at ``max_blocks_per_seq * block_size`` cache rows.
+    - ``prefill_chunk``: prompt tokens per prefill dispatch (static).
+    """
+
+    block_size: int = 16
+    num_blocks: int = 64
+    max_slots: int = 4
+    max_blocks_per_seq: Optional[int] = None
+    prefill_chunk: int = 32
+
+    def resolved_max_blocks(self) -> int:
+        if self.max_blocks_per_seq is not None:
+            return self.max_blocks_per_seq
+        return self.num_blocks - 1
+
+
+@dataclass
+class CompletedRequest:
+    """Completion record: the tokens plus the request's SLO timeline."""
+
+    id: int
+    tokens: List[int]
+    prompt_len: int
+    new_tokens: int
+    queue_wait_ms: float
+    ttft_ms: Optional[float]
+    mean_inter_token_ms: Optional[float]
+    tokens_per_s: Optional[float]
+    preemptions: int
+    inter_token_ms: List[float] = field(default_factory=list)
+
+
+class ServingEngine:
+    """Continuous-batching serving over a model family's
+    ``apply_cached``/``init_cache`` pair (any family following the
+    ``make_kv_cache`` layout — gpt2/llama/mixtral, fp or int8 KV).  The
+    token-identity-vs-``generate_loop`` guarantee needs a
+    chunking-independent forward (dense FFN); capacity-limited MoE routing
+    (mixtral) varies with prefill chunking here exactly as it does under
+    offline ``prefill_chunk``.
+
+    ::
+
+        engine = ServingEngine(gpt2.apply_cached, gpt2.init_cache, params, cfg,
+                               serving=ServingConfig(max_slots=8))
+        rid = engine.submit(prompt_tokens, max_new_tokens=64)
+        outputs = engine.run()          # {rid: full token list}
+
+    or drive it tick-by-tick with :meth:`step` / :meth:`pop_finished`.
+    """
+
+    def __init__(
+        self,
+        apply_cached: Callable,
+        init_cache: Callable,
+        params,
+        config,
+        serving: Optional[ServingConfig] = None,
+    ):
+        self.serving = serving or ServingConfig()
+        sc = self.serving
+        if sc.prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, got {sc.prefill_chunk}")
+        if sc.resolved_max_blocks() < 1:
+            raise ValueError("max_blocks_per_seq must be >= 1")
+        self._apply_cached = apply_cached
+        self._config = config
+        self.params = params
+        self.cache = PagedKVCache(init_cache, config, sc.num_blocks, sc.block_size)
+        self.sched = Scheduler(
+            self.cache.allocator,
+            num_slots=sc.max_slots,
+            block_size=sc.block_size,
+            max_blocks_per_seq=sc.resolved_max_blocks(),
+            prefill_chunk=sc.prefill_chunk,
+        )
+        max_len = sc.resolved_max_blocks() * sc.block_size
+        model_max = getattr(config, "max_seq_len", None)
+        if model_max is not None and max_len > model_max:
+            raise ValueError(
+                f"max_blocks_per_seq * block_size = {max_len} exceeds the "
+                f"model's max_seq_len {model_max}; shrink the table or blocks"
+            )
+        self._kv_names = self.cache.leaf_names
+        self._finished: List[CompletedRequest] = []
+        self._preempted_published = 0
+        self.ticks = 0
+        self.decode_dispatches = 0
+        self.prefill_dispatches = 0
+        self._decode_fn = jax.jit(self._build_decode(), donate_argnums=(1,))
+        self._prefill_fn = jax.jit(self._build_prefill(), donate_argnums=(1,))
+
+    # -- compiled programs ---------------------------------------------------
+
+    def _build_decode(self):
+        apply_cached, config, names = self._apply_cached, self._config, self._kv_names
+
+        def decode(params, pool, tables, lengths, tokens):
+            views = {n: gather_block_view(pool[n], tables) for n in names}
+            caches = dict(views, index=lengths)
+
+            def one(cache, tok):
+                logits, new_cache = apply_cached(params, tok[None, None], config, cache)
+                return logits[0, -1], new_cache
+
+            logits, new_caches = jax.vmap(one)(caches, tokens)
+            next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            new_pool = {}
+            for n in names:
+                rows = extract_token_rows(new_caches[n], lengths, 1)
+                new_pool[n] = scatter_token_rows(pool[n], rows, tables, lengths, 1)
+            return next_tok, new_pool
+
+        return decode
+
+    def _build_prefill(self):
+        apply_cached, config, names = self._apply_cached, self._config, self._kv_names
+        chunk_len = self.serving.prefill_chunk
+
+        def prefill(params, pool, table_row, length, chunk, n_real):
+            tables = table_row[None]  # [1, M]
+            start = length[None]
+            cache = {n: gather_block_view(pool[n], tables)[0] for n in names}
+            cache["index"] = length
+            logits, new_cache = apply_cached(params, chunk, config, cache)
+            next_tok = jnp.argmax(logits[0, n_real - 1], axis=-1).astype(jnp.int32)
+            new_pool = {}
+            for n in names:
+                rows = extract_token_rows(new_cache[n][None], start, chunk_len)
+                new_pool[n] = scatter_token_rows(pool[n], rows, tables, start, chunk_len)
+            return next_tok, new_pool
+
+        return prefill
+
+    # -- request API ---------------------------------------------------------
+
+    def submit(
+        self,
+        prompt_ids,
+        max_new_tokens: int,
+        arrival_t: Optional[float] = None,
+    ) -> int:
+        """Queue one request; returns its id.  ``max_new_tokens == 0``
+        completes immediately (the offline loop's contract)."""
+        req = Request(list(np.asarray(prompt_ids).reshape(-1)), max_new_tokens, arrival_t)
+        if req.max_new_tokens == 0:
+            now = time.monotonic()
+            req.state = RequestState.DONE
+            req.admit_t = req.finish_t = now
+        else:
+            self.sched.submit(req)  # geometry validation may reject — count after
+        tel = get_telemetry()
+        if tel.enabled:
+            tel.registry.counter("serving.requests").inc()
+        if req.state == RequestState.DONE:
+            self._complete(req)
+        return req.id
+
+    def step(self) -> List[CompletedRequest]:
+        """One engine tick: admit, one prefill chunk, one fused decode
+        dispatch.  Returns the requests that completed this tick."""
+        now = time.monotonic()
+        done_before = len(self._finished)
+        self.ticks += 1
+        self.sched.admit(now)
+        self._prefill_tick(now)
+        self._decode_tick(now)
+        self._publish_gauges()
+        return self._finished[done_before:]
+
+    def run(self, max_ticks: Optional[int] = None) -> Dict[int, List[int]]:
+        """Drive ticks until every submitted request completes; returns
+        ``{request_id: full token list (prompt + generated)}``."""
+        ticks = 0
+        while not self.sched.idle():
+            self.step()
+            ticks += 1
+            if max_ticks is not None and ticks >= max_ticks:
+                raise RuntimeError(
+                    f"engine did not drain within {max_ticks} ticks "
+                    f"(active {self.sched.active}, queued {self.sched.pending})"
+                )
+        return {c.id: c.tokens for c in self._finished}
+
+    def pop_finished(self) -> List[CompletedRequest]:
+        out, self._finished = self._finished, []
+        return out
+
+    # -- tick phases ---------------------------------------------------------
+
+    def _table_row(self, blocks: List[int]) -> np.ndarray:
+        m = self.serving.resolved_max_blocks()
+        row = np.zeros((m,), np.int32)
+        row[: len(blocks)] = blocks
+        return row
+
+    def _prefill_tick(self, now: float) -> None:
+        sched = self.sched
+        candidates = [
+            (slot.admit_seq, idx)
+            for idx, slot in sched.slots.items()
+            if slot.request.state == RequestState.PREFILLING
+        ]
+        if not candidates:
+            return
+        _, idx = min(candidates)
+        slot = sched.slots[idx]
+        req = slot.request
+        feed = req.to_feed
+        start = slot.cache_len
+        chunk_len = self.serving.prefill_chunk
+        n_real = min(chunk_len, len(feed) - start)
+        if not sched.grow_to(idx, start + n_real):
+            return  # the slot itself was preempted to find blocks
+        chunk = np.zeros((1, chunk_len), np.int32)
+        chunk[0, :n_real] = feed[start : start + n_real]
+        next_tok, self.cache.pool = self._prefill_fn(
+            self.params,
+            self.cache.pool,
+            self._table_row(slot.blocks),
+            np.int32(start),
+            chunk,
+            np.int32(n_real),
+        )
+        self.prefill_dispatches += 1
+        tel = get_telemetry()
+        if tel.enabled:
+            tel.registry.counter("serving.prefill_dispatches").inc()
+        slot.cache_len = start + n_real
+        if slot.cache_len == len(feed):
+            # Final chunk: its last real logits row IS the next token — the
+            # first generated token of a fresh request (TTFT lands here) or
+            # the resume token of a re-prefilled one.
+            self._emit(idx, int(next_tok), time.monotonic())
+            if idx in sched.slots:
+                sched.slots[idx].request.state = RequestState.DECODING
+
+    def _decode_tick(self, now: float) -> None:
+        sched = self.sched
+        decoding = sorted(
+            (idx for idx, slot in sched.slots.items()
+             if slot.request.state == RequestState.DECODING),
+            key=lambda i: sched.slots[i].admit_seq,
+        )
+        # Grow oldest-first so older requests steal blocks from younger ones
+        # (matching the LIFO victim policy), then re-collect the survivors.
+        for idx in decoding:
+            if idx in sched.slots and sched.slots[idx].request.state == RequestState.DECODING:
+                sched.grow_to(idx, sched.slots[idx].cache_len + 1)
+        live = [
+            idx for idx in decoding
+            if idx in sched.slots and sched.slots[idx].request.state == RequestState.DECODING
+        ]
+        if not live:
+            return
+        s = self.serving.max_slots
+        m = self.serving.resolved_max_blocks()
+        tables = np.zeros((s, m), np.int32)
+        lengths = np.zeros((s,), np.int32)
+        tokens = np.zeros((s,), np.int32)
+        for idx in live:
+            slot = sched.slots[idx]
+            tables[idx] = self._table_row(slot.blocks)
+            lengths[idx] = slot.cache_len
+            tokens[idx] = slot.request.emitted[-1]
+        next_tokens, self.cache.pool = self._decode_fn(
+            self.params, self.cache.pool, tables, lengths, tokens
+        )
+        self.decode_dispatches += 1
+        tel = get_telemetry()
+        if tel.enabled:
+            tel.registry.counter("serving.decode_dispatches").inc()
+        out = np.asarray(next_tokens)
+        emit_t = time.monotonic()
+        for idx in live:
+            sched.slots[idx].cache_len += 1
+            self._emit(idx, int(out[idx]), emit_t)
+
+    # -- completion / metrics ------------------------------------------------
+
+    def _emit(self, idx: int, token: int, now: float) -> None:
+        slot = self.sched.slots[idx]
+        req = slot.request
+        req.emitted.append(token)
+        req.note_token(now)
+        tel = get_telemetry()
+        if tel.enabled:
+            tel.registry.counter("serving.tokens").inc()
+            if len(req.emitted) == 1 and req.arrival_t is not None:
+                tel.registry.histogram("serving.ttft_ms").observe(
+                    (now - req.arrival_t) * 1e3
+                )
+            elif req.inter_token_ms:
+                tel.registry.histogram("serving.inter_token_ms").observe(
+                    req.inter_token_ms[-1]
+                )
+        if req.remaining == 0:
+            self.sched.finish(idx, now)
+            self._complete(req)
+
+    def _complete(self, req: Request) -> None:
+        ttft_ms = None
+        if req.first_token_t is not None and req.arrival_t is not None:
+            ttft_ms = (req.first_token_t - req.arrival_t) * 1e3
+        queue_wait_ms = (
+            (req.admit_t - req.arrival_t) * 1e3
+            if req.admit_t is not None and req.arrival_t is not None
+            else 0.0
+        )
+        mean_itl = (
+            sum(req.inter_token_ms) / len(req.inter_token_ms)
+            if req.inter_token_ms
+            else None
+        )
+        tps = None
+        if (
+            req.finish_t is not None
+            and req.first_token_t is not None
+            and req.finish_t > req.first_token_t
+            and len(req.emitted) > 1
+        ):
+            tps = (len(req.emitted) - 1) / (req.finish_t - req.first_token_t)
+        rec = CompletedRequest(
+            id=req.id,
+            tokens=req.output,
+            prompt_len=len(req.prompt),
+            new_tokens=len(req.emitted),
+            queue_wait_ms=queue_wait_ms,
+            ttft_ms=ttft_ms,
+            mean_inter_token_ms=mean_itl,
+            tokens_per_s=tps,
+            preemptions=req.preemptions,
+            inter_token_ms=list(req.inter_token_ms),
+        )
+        self._finished.append(rec)
+        tel = get_telemetry()
+        if tel.enabled:
+            reg = tel.registry
+            reg.counter("serving.completed").inc()
+            reg.histogram("serving.queue_wait_ms").observe(queue_wait_ms)
+            if tps is not None:
+                reg.histogram("serving.tokens_per_s").observe(tps)
+            tel.event(
+                "serving.request_complete",
+                request=req.id,
+                prompt_len=len(req.prompt),
+                new_tokens=len(req.emitted),
+                ttft_ms=round(ttft_ms, 3) if ttft_ms is not None else None,
+                queue_wait_ms=round(queue_wait_ms, 3),
+                preemptions=req.preemptions,
+            )
+
+    def _publish_gauges(self) -> None:
+        tel = get_telemetry()
+        if not tel.enabled:
+            return
+        reg = tel.registry
+        alloc = self.cache.allocator
+        reg.gauge("serving.active_slots").set(self.sched.active)
+        reg.gauge("serving.queue_depth").set(self.sched.pending)
+        reg.gauge("serving.blocks_used").set(alloc.used_blocks)
+        reg.gauge("serving.block_occupancy").set(round(alloc.occupancy, 4))
+        # Publish only preemptions since the last publish: a registry.reset()
+        # (e.g. scoping a measurement window) must not be re-inflated with
+        # engine-lifetime history.
+        new_preempted = self.sched.preempted_count - self._preempted_published
+        if new_preempted > 0:
+            reg.counter("serving.preempted").inc(new_preempted)
+        self._preempted_published = self.sched.preempted_count
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> dict:
+        alloc = self.cache.allocator
+        return {
+            "ticks": self.ticks,
+            "decode_dispatches": self.decode_dispatches,
+            "prefill_dispatches": self.prefill_dispatches,
+            "active_slots": self.sched.active,
+            "queue_depth": self.sched.pending,
+            "blocks_used": alloc.used_blocks,
+            "block_occupancy": round(alloc.occupancy, 4),
+            "completed": len(self._finished),
+            "preempted": self.sched.preempted_count,
+            "pool_bytes": self.cache.pool_bytes(),
+        }

@@ -272,6 +272,47 @@ def _human(n) -> str:
     return f"{n:.0f} "
 
 
+def format_serving_block(snapshot) -> list:
+    """Render the serving engine's SLO block from ``serving.*`` metric
+    families (``serving/engine.py``); empty list when the run never served."""
+    if not snapshot or not any(k.startswith("serving.") for k in snapshot):
+        return []
+    g = snapshot.get
+    lines = ["serving engine (continuous batching):"]
+    lines.append(
+        f"  requests: {g('serving.requests', 0)} submitted, "
+        f"{g('serving.completed', 0)} completed, "
+        f"{g('serving.preempted', 0)} preempted; "
+        f"{g('serving.tokens', 0)} tokens generated"
+    )
+    lines.append(
+        f"  dispatches: {g('serving.decode_dispatches', 0)} decode "
+        f"(fused, 1/step), {g('serving.prefill_dispatches', 0)} prefill chunks"
+    )
+
+    def hist(stem, label, unit="ms"):
+        if g(f"{stem}.count"):
+            lines.append(
+                f"  {label}: p50 {g(f'{stem}.p50', 0):.2f} / "
+                f"p95 {g(f'{stem}.p95', 0):.2f} / "
+                f"mean {g(f'{stem}.mean', 0):.2f} {unit} "
+                f"({g(f'{stem}.count')} samples)"
+            )
+
+    hist("serving.ttft_ms", "TTFT")
+    hist("serving.inter_token_ms", "inter-token")
+    hist("serving.queue_wait_ms", "queue wait")
+    hist("serving.tokens_per_s", "per-request throughput", unit="tok/s")
+    occ = g("serving.block_occupancy")
+    if occ is not None:
+        lines.append(
+            f"  kv blocks: {g('serving.blocks_used', 0)} in use "
+            f"(occupancy {occ:.1%}), queue depth {g('serving.queue_depth', 0)}, "
+            f"active slots {g('serving.active_slots', 0)}"
+        )
+    return lines
+
+
 def format_report(summary: dict) -> str:
     lines = []
     spans = summary["spans"]
@@ -345,6 +386,10 @@ def format_report(summary: dict) -> str:
         lines.append("")
         lines.append(format_profile_report(report_from_dict(summary["profiles"][source])))
     snapshot = summary["snapshot"]
+    serving = format_serving_block(snapshot)
+    if serving:
+        lines.append("")
+        lines.extend(serving)
     if snapshot:
         lines.append("")
         lines.append("final metrics snapshot:")

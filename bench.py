@@ -795,6 +795,79 @@ def _profile_probe() -> dict:
     }
 
 
+def _serving_probe() -> dict:
+    """Continuous-batching serving micro-benchmark (serving/engine.py) on a
+    bounded CPU run: a staggered request mix through the paged-KV engine —
+    requests/s and generated tokens/s over the drain window, mean TTFT, p95
+    inter-token latency, and peak block-cache occupancy.  The SLO shape
+    (occupancy, dispatch counts, preemption behavior) is what transfers to
+    TPU; CPU absolute latencies do not."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu import telemetry
+    from accelerate_tpu.models import gpt2
+    from accelerate_tpu.serving import ServingConfig, ServingEngine
+
+    tel = telemetry.enable(dir=tempfile.mkdtemp(prefix="atpu_bench_serving_"))
+    cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32)
+    params = gpt2.init_params(cfg, jax.random.key(0))
+    engine = ServingEngine(
+        gpt2.apply_cached, gpt2.init_cache, params, cfg,
+        serving=ServingConfig(block_size=8, num_blocks=33, max_slots=4,
+                              prefill_chunk=16, max_blocks_per_seq=8),
+    )
+
+    # Warmup request compiles the two serving programs outside the window;
+    # offsets scope the engine-lifetime counters to the measured window too.
+    engine.submit([1, 2, 3, 4], 2)
+    engine.run(max_ticks=200)
+    engine.pop_finished()
+    tel.registry.reset()
+    d0, p0, t0_ticks = engine.decode_dispatches, engine.prefill_dispatches, engine.ticks
+    preempt0 = engine.sched.preempted_count
+
+    N = 16
+    rng = np.random.default_rng(0)
+    requests = [
+        (list(rng.integers(0, cfg.vocab_size, size=int(rng.integers(4, 28)))),
+         int(rng.integers(2, 14)))
+        for _ in range(N)
+    ]
+    peak_occ = 0.0
+    submitted = 0
+    t0 = time.perf_counter()
+    while submitted < N or not engine.sched.idle():
+        # Staggered arrivals: two new requests per tick while any remain.
+        for _ in range(2):
+            if submitted < N:
+                engine.submit(*requests[submitted])
+                submitted += 1
+        engine.step()
+        peak_occ = max(peak_occ, engine.cache.allocator.occupancy)
+    wall = time.perf_counter() - t0
+    done = engine.pop_finished()
+    snap = tel.registry.snapshot()
+    tokens = sum(c.new_tokens for c in done)
+    return {
+        "serving": {
+            "requests": len(done),
+            "requests_per_s": round(len(done) / wall, 2),
+            "tokens_per_s": round(tokens / wall, 1),
+            "mean_ttft_ms": round(snap.get("serving.ttft_ms.mean", 0.0), 2),
+            "p95_inter_token_ms": round(snap.get("serving.inter_token_ms.p95", 0.0), 2),
+            "peak_block_occupancy": round(peak_occ, 4),
+            "preempted": engine.sched.preempted_count - preempt0,
+            "decode_dispatches": engine.decode_dispatches - d0,
+            "prefill_dispatches": engine.prefill_dispatches - p0,
+            "ticks": engine.ticks - t0_ticks,
+            "pool_bytes": engine.cache.pool_bytes(),
+        }
+    }
+
+
 def _health_probe() -> dict:
     """Numerical-health-guard overhead micro-benchmark (resilience/health.py):
     fused-step steps/s with the guard off vs on.  Detection lives INSIDE the
@@ -982,6 +1055,10 @@ def _run_checkpoint_probe_subprocess(timeout_s: float = 180.0):
     return _run_probe_subprocess("checkpoint", timeout_s)
 
 
+def _run_serving_probe_subprocess(timeout_s: float = 240.0):
+    return _run_probe_subprocess("serving", timeout_s)
+
+
 def _honor_cpu_env():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from accelerate_tpu.state import honor_cpu_platform_env
@@ -1063,6 +1140,9 @@ def main():
         return
     if "--health-probe" in sys.argv:
         print(json.dumps(_health_probe()))
+        return
+    if "--serving-probe" in sys.argv:
+        print(json.dumps(_serving_probe()))
         return
     if "--rung" in sys.argv or "--proof-rung" in sys.argv or "--frontier-rung" in sys.argv:
         if "--rung" in sys.argv:
@@ -1363,6 +1443,16 @@ def main():
         profile_block = prof_probe["profile"] if prof_probe else {"status": prof_err}
         print(f"# profile probe: {profile_block}", file=sys.stderr, flush=True)
 
+    # Continuous-batching serving probe (serving/engine.py): requests/s, mean
+    # TTFT, p95 inter-token latency and peak block-cache occupancy of a
+    # staggered request mix through the paged-KV engine.  CPU subprocess,
+    # never zeroes the headline.
+    serving_block = None
+    if os.environ.get("BENCH_SERVING_PROBE", "1") != "0":
+        serving_probe, serving_err = _run_serving_probe_subprocess()
+        serving_block = serving_probe["serving"] if serving_probe else {"status": serving_err}
+        print(f"# serving probe: {serving_block}", file=sys.stderr, flush=True)
+
     detail = {
         "config": result["config"],
         "rung": rung_cfg,
@@ -1388,6 +1478,8 @@ def main():
         detail["zero"] = zero_block
     if profile_block is not None:
         detail["profile"] = profile_block
+    if serving_block is not None:
+        detail["serving"] = serving_block
     if proof is not None:
         detail["hbm_bound_proof"] = {
             "config": proof_cfg,
@@ -1437,6 +1529,9 @@ if __name__ == "__main__":
             "--checkpoint-probe",
             "--pipeline-probe",
             "--health-probe",
+            "--zero-probe",
+            "--profile-probe",
+            "--serving-probe",
         )
     )
     try:

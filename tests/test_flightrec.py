@@ -32,6 +32,12 @@ def _recorder_off():
     yield
     flightrec.disable()
     telemetry.disable()
+    # disable() keeps the final registry contents (its job is to snapshot
+    # them); clearing here keeps the process-global singleton from leaking
+    # metrics into whichever module runs next (the test_telemetry
+    # disabled-by-default tests assert an EMPTY registry).
+    telemetry.get_telemetry().registry.reset()
+    telemetry.get_telemetry().step_timer.reset()
 
 
 def _read_snapshot(rec):

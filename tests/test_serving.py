@@ -1,0 +1,454 @@
+"""Serving subsystem: block allocator round-trips, paged gather/scatter
+primitives, the continuous-batching scheduler, and the engine's equivalence
+oracle — greedy outputs token-identical to the offline ``generate_loop`` per
+request across randomized arrival/length mixes, including under forced
+preemption and with the int8 KV cache."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu import telemetry
+from accelerate_tpu.models import gpt2
+from accelerate_tpu.models.generation import (
+    extract_token_rows,
+    gather_block_view,
+    make_paged_pool,
+    scatter_token_rows,
+)
+from accelerate_tpu.serving import (
+    BlockAllocator,
+    BlockOutOfMemory,
+    Request,
+    ServingConfig,
+    ServingEngine,
+)
+from accelerate_tpu.serving.blocks import NULL_BLOCK, blocks_for_tokens
+from accelerate_tpu.serving.scheduler import RequestState, Scheduler
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_clean():
+    yield
+    telemetry.disable()
+    telemetry.get_telemetry().registry.reset()
+    telemetry.get_telemetry().step_timer.reset()
+
+
+# ---------------------------------------------------------------------------
+# Block allocator
+# ---------------------------------------------------------------------------
+
+
+def test_allocator_alloc_free_round_trip():
+    alloc = BlockAllocator(9)  # 8 usable + null
+    assert alloc.capacity == 8
+    a = alloc.alloc(3)
+    b = alloc.alloc(2)
+    assert len(set(a) | set(b)) == 5 and NULL_BLOCK not in a + b
+    assert alloc.used_blocks == 5 and alloc.free_blocks == 3
+    alloc.free(a)
+    assert alloc.used_blocks == 2 and alloc.free_blocks == 6
+    c = alloc.alloc(6)
+    assert alloc.free_blocks == 0
+    alloc.free(b + c)
+    assert alloc.used_blocks == 0 and alloc.occupancy == 0.0
+
+
+def test_allocator_oom_grants_nothing():
+    alloc = BlockAllocator(5)
+    alloc.alloc(3)
+    free_before = alloc.free_blocks
+    with pytest.raises(BlockOutOfMemory):
+        alloc.alloc(2)
+    assert alloc.free_blocks == free_before  # no partial grant leaked
+
+
+def test_allocator_double_free_and_null_free_rejected():
+    alloc = BlockAllocator(4)
+    blocks = alloc.alloc(2)
+    alloc.free(blocks)
+    with pytest.raises(ValueError):
+        alloc.free([blocks[0]])
+    with pytest.raises(ValueError):
+        alloc.free([NULL_BLOCK])
+
+
+def test_allocator_fragmentation_free_round_trips():
+    """Interleaved alloc/free churn: any free block serves any request
+    (fixed-size blocks have no external fragmentation), so after arbitrary
+    churn the full capacity is still allocatable in one grant."""
+    alloc = BlockAllocator(17)
+    rng = np.random.default_rng(0)
+    held = []
+    for _ in range(200):
+        if held and rng.random() < 0.5:
+            idx = rng.integers(len(held))
+            alloc.free(held.pop(idx))
+        else:
+            n = int(rng.integers(1, 4))
+            if n <= alloc.free_blocks:
+                held.append(alloc.alloc(n))
+    for blocks in held:
+        alloc.free(blocks)
+    whole = alloc.alloc(alloc.capacity)  # one grant takes EVERYTHING back
+    assert sorted(whole) == list(range(1, 17))
+
+
+def test_blocks_for_tokens():
+    assert blocks_for_tokens(1, 4) == 1
+    assert blocks_for_tokens(4, 4) == 1
+    assert blocks_for_tokens(5, 4) == 2
+    assert blocks_for_tokens(0, 4) == 0
+
+
+# ---------------------------------------------------------------------------
+# Paged primitives (generation.py)
+# ---------------------------------------------------------------------------
+
+
+def _toy_pool(L=2, N=6, bs=4, K=2, hd=3):
+    key = jax.random.key(0)
+    return jax.random.normal(key, (L, N, bs, K, hd), jnp.float32)
+
+
+def test_gather_block_view_layout():
+    pool = _toy_pool()
+    tables = jnp.asarray([[2, 5, 0], [1, 3, 4]], jnp.int32)  # [S=2, M=3]
+    view = gather_block_view(pool, tables)
+    assert view.shape == (2, 2, 1, 12, 2, 3)  # [S, L, 1, M*bs, K, hd]
+    np.testing.assert_array_equal(
+        np.asarray(view[0, :, 0, 0:4]), np.asarray(pool[:, 2])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(view[1, :, 0, 4:8]), np.asarray(pool[:, 3])
+    )
+
+
+def test_scatter_then_gather_round_trip():
+    pool = jnp.zeros((2, 6, 4, 2, 3), jnp.float32)
+    tables = jnp.asarray([[2, 5, 0], [1, 3, 0]], jnp.int32)
+    rows = jax.random.normal(jax.random.key(1), (2, 2, 3, 2, 3), jnp.float32)
+    start = jnp.asarray([2, 6], jnp.int32)  # slot 0 spans blocks 2->5
+    pool2 = scatter_token_rows(pool, rows, tables, start, 3)
+    view = gather_block_view(pool2, tables)
+    got = extract_token_rows(view, start, 3)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(rows))
+    # null block (0) untouched regions stay zero for the OTHER slot's view
+    np.testing.assert_array_equal(np.asarray(pool2[:, 4]), np.zeros((2, 4, 2, 3)))
+
+
+def test_scatter_past_table_routes_to_null_block():
+    """Positions beyond the block table (chunked-prefill padding) must land
+    in the null block, NOT clamp into the last real block."""
+    pool = jnp.zeros((1, 4, 4, 1, 1), jnp.float32)
+    tables = jnp.asarray([[3, 2]], jnp.int32)  # M=2 -> positions >= 8 overflow
+    rows = jnp.ones((1, 1, 4, 1, 1), jnp.float32)
+    pool2 = scatter_token_rows(pool, rows, tables, jnp.asarray([6], jnp.int32), 4)
+    # positions 6,7 -> block 2 offsets 2,3; positions 8,9 -> null block
+    assert float(pool2[0, 2, 2, 0, 0]) == 1.0 and float(pool2[0, 2, 3, 0, 0]) == 1.0
+    np.testing.assert_array_equal(np.asarray(pool2[0, 3]), np.zeros((4, 1, 1)))
+    assert float(jnp.sum(pool2[0, 1])) == 0.0  # untouched block stays zero
+
+
+def test_make_paged_pool_rejects_foreign_layout():
+    def bad_init(config, batch, max_len):
+        return {"k": jnp.zeros((4, max_len)), "index": jnp.zeros((), jnp.int32)}
+
+    with pytest.raises(ValueError, match="make_kv_cache layout"):
+        make_paged_pool(bad_init, None, 4, 8)
+
+
+def test_make_paged_pool_int8_leaves_page_together():
+    cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32, kv_cache_quant=True)
+    pool = make_paged_pool(gpt2.init_cache, cfg, 5, 4)
+    assert set(pool) == {"k", "k_scale", "v", "v_scale"}
+    assert pool["k"].shape[1] == 5 and pool["k"].dtype == jnp.int8
+    assert pool["k_scale"].shape == pool["k"].shape[:-1]
+
+
+# ---------------------------------------------------------------------------
+# Scheduler
+# ---------------------------------------------------------------------------
+
+
+def _sched(num_blocks=9, slots=3, bs=4, m=6, chunk=4):
+    return Scheduler(
+        BlockAllocator(num_blocks), num_slots=slots, block_size=bs,
+        max_blocks_per_seq=m, prefill_chunk=chunk,
+    )
+
+
+def test_scheduler_rejects_oversized_requests():
+    s = _sched(num_blocks=5, m=3)  # capacity 4, per-seq cap 3
+    with pytest.raises(ValueError, match="max_blocks_per_seq"):
+        s.submit(Request(list(range(20)), 8))
+    with pytest.raises(ValueError, match="pool capacity"):
+        _sched(num_blocks=4, m=6).submit(Request(list(range(12)), 4))
+
+
+def test_scheduler_admits_fifo_and_preempts_lifo():
+    s = _sched()
+    a, b, c, d = (Request([1, 2, 3], 2) for _ in range(4))
+    for r in (a, b, c, d):
+        s.submit(r)
+    s.admit(now=0.0)
+    assert s.active == 3 and s.pending == 1  # FIFO head three admitted
+    admitted = [s.slots[i].request for i in sorted(s.slots)]
+    assert admitted == [a, b, c]
+    idx = s.preempt_one()
+    assert s.slots.get(idx) is None
+    assert s.queue[0] is c and c.preemptions == 1  # LIFO victim, queue FRONT
+    assert s.preempted_count == 1
+
+
+def test_scheduler_grow_preempts_until_satisfied():
+    s = _sched(num_blocks=5, bs=4, chunk=4)  # 4 usable blocks
+    old, young = Request([1] * 4, 8), Request([1] * 4, 8)
+    s.submit(old), s.submit(young)
+    s.admit(now=0.0)
+    oi = next(i for i in s.slots if s.slots[i].request is old)
+    yi = next(i for i in s.slots if s.slots[i].request is young)
+    assert s.grow_to(oi, 8) and s.grow_to(yi, 8)  # 2 blocks each: full pool
+    assert s.allocator.free_blocks == 0
+    # old grows again: the YOUNG slot must be evicted to find a block
+    assert s.grow_to(oi, 12)
+    assert yi not in s.slots and young.state == RequestState.QUEUED
+    assert len(s.slots[oi].blocks) == 3
+
+
+def test_scheduler_self_preemption_returns_false():
+    s = _sched(num_blocks=3, bs=4, chunk=4, m=6)  # 2 usable blocks
+    solo = Request([1] * 4, 4)
+    s.submit(solo)
+    s.admit(now=0.0)
+    idx = next(iter(s.slots))
+    assert s.grow_to(idx, 8)  # takes both blocks
+    assert not s.grow_to(idx, 12)  # needs a 3rd: only victim is itself
+    assert s.active == 0 and s.queue[0] is solo
+
+
+# ---------------------------------------------------------------------------
+# Engine equivalence (the acceptance oracle)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def gpt2_setup():
+    cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32)
+    params = gpt2.init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+def _oracle(cfg, params, prompt, max_new):
+    out = gpt2.generate(params, jnp.asarray([prompt], jnp.int32), cfg, max_new_tokens=max_new)
+    return [int(t) for t in np.asarray(out[0])]
+
+
+def test_continuous_batching_token_identical_randomized_mix(gpt2_setup):
+    """The acceptance criterion: a randomized arrival/length mix through the
+    continuous-batching engine produces, for EVERY request, exactly the
+    tokens the offline generate_loop produces for that prompt alone."""
+    cfg, params = gpt2_setup
+    rng = np.random.default_rng(42)
+    lengths = [int(rng.integers(3, 20)) for _ in range(6)]
+    max_new = [int(rng.integers(1, 10)) for _ in range(6)]
+    prompts = [list(rng.integers(0, cfg.vocab_size, size=n)) for n in lengths]
+    want = {i: _oracle(cfg, params, p, m) for i, (p, m) in enumerate(zip(prompts, max_new))}
+
+    eng = ServingEngine(
+        gpt2.apply_cached, gpt2.init_cache, params, cfg,
+        serving=ServingConfig(block_size=4, num_blocks=40, max_slots=3,
+                              prefill_chunk=8, max_blocks_per_seq=8),
+    )
+    ids = {}
+    arrivals = rng.permutation(6)
+    for k, i in enumerate(arrivals):
+        ids[eng.submit(prompts[i], max_new[i])] = i
+        if k % 2 == 1:
+            eng.step()  # staggered: requests join a batch already in flight
+    outputs = eng.run(max_ticks=1000)
+    assert len(outputs) == 6
+    for rid, out in outputs.items():
+        assert out == want[ids[rid]], f"request {rid} diverged"
+    # the fused decode step stayed at one dispatch per tick
+    assert eng.decode_dispatches <= eng.ticks
+
+
+def test_preemption_keeps_outputs_token_identical(gpt2_setup):
+    """A pool tight enough to force eviction mid-flight: preempted requests
+    re-prefill prompt+emitted and still finish token-identical."""
+    cfg, params = gpt2_setup
+    rng = np.random.default_rng(7)
+    prompts = [list(rng.integers(0, cfg.vocab_size, size=n)) for n in (5, 11, 9)]
+    max_new = [8, 6, 7]
+    want = {i: _oracle(cfg, params, p, m) for i, (p, m) in enumerate(zip(prompts, max_new))}
+    eng = ServingEngine(
+        gpt2.apply_cached, gpt2.init_cache, params, cfg,
+        serving=ServingConfig(block_size=4, num_blocks=9, max_slots=3,
+                              prefill_chunk=4, max_blocks_per_seq=6),
+    )
+    ids = {eng.submit(p, m): i for i, (p, m) in enumerate(zip(prompts, max_new))}
+    outputs = eng.run(max_ticks=2000)
+    assert eng.sched.preempted_count > 0, "pool was not tight enough to force preemption"
+    for rid, out in outputs.items():
+        assert out == want[ids[rid]]
+
+
+def test_int8_kv_cache_pages_and_stays_token_identical():
+    cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32, kv_cache_quant=True)
+    params = gpt2.init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(3)
+    prompts = [list(rng.integers(0, cfg.vocab_size, size=n)) for n in (6, 13)]
+    want = {i: _oracle(cfg, params, p, 5) for i, p in enumerate(prompts)}
+    eng = ServingEngine(
+        gpt2.apply_cached, gpt2.init_cache, params, cfg,
+        serving=ServingConfig(block_size=4, num_blocks=20, max_slots=2,
+                              prefill_chunk=8, max_blocks_per_seq=8),
+    )
+    ids = {eng.submit(p, 5): i for i, p in enumerate(prompts)}
+    outputs = eng.run(max_ticks=500)
+    for rid, out in outputs.items():
+        assert out == want[ids[rid]]
+
+
+@pytest.mark.slow
+def test_llama_family_token_identical():
+    """The engine is family-generic: llama's rope/GQA cached decode pages
+    and stays token-identical too (tier-2: llama tiny compiles are heavy)."""
+    from accelerate_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny(dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(2)
+    prompts = [list(rng.integers(0, cfg.vocab_size, size=n)) for n in (5, 9)]
+    want = {}
+    for i, p in enumerate(prompts):
+        out = llama.generate(params, jnp.asarray([p], jnp.int32), cfg, max_new_tokens=4)
+        want[i] = [int(t) for t in np.asarray(out[0])]
+    eng = ServingEngine(
+        llama.apply_cached, llama.init_cache, params, cfg,
+        serving=ServingConfig(block_size=4, num_blocks=20, max_slots=2,
+                              prefill_chunk=8, max_blocks_per_seq=4),
+    )
+    ids = {eng.submit(p, 4): i for i, p in enumerate(prompts)}
+    outputs = eng.run(max_ticks=200)
+    for rid, out in outputs.items():
+        assert out == want[ids[rid]]
+
+
+def test_chunked_prefill_interleaves_with_decode(gpt2_setup):
+    """A long prompt admitted while another request decodes: decode ticks
+    keep landing between the prefill chunks instead of stalling."""
+    cfg, params = gpt2_setup
+    rng = np.random.default_rng(11)
+    short = list(rng.integers(0, cfg.vocab_size, size=4))
+    long = list(rng.integers(0, cfg.vocab_size, size=30))
+    want_short = _oracle(cfg, params, short, 12)
+    want_long = _oracle(cfg, params, long, 3)
+    eng = ServingEngine(
+        gpt2.apply_cached, gpt2.init_cache, params, cfg,
+        serving=ServingConfig(block_size=4, num_blocks=40, max_slots=2,
+                              prefill_chunk=4, max_blocks_per_seq=9),
+    )
+    sid = eng.submit(short, 12)
+    eng.step(); eng.step()  # short is decoding now
+    lid = eng.submit(long, 3)  # 30-token prompt = 8 chunks of 4
+    decode_before = eng.decode_dispatches
+    for _ in range(6):
+        eng.step()
+    # while the long prompt chewed through its chunks, decode kept running
+    assert eng.decode_dispatches - decode_before >= 5
+    outputs = eng.run(max_ticks=500)
+    assert outputs[sid] == want_short and outputs[lid] == want_long
+
+
+# ---------------------------------------------------------------------------
+# Engine API / metrics
+# ---------------------------------------------------------------------------
+
+
+def test_submit_validation_and_zero_max_new(gpt2_setup):
+    cfg, params = gpt2_setup
+    eng = ServingEngine(
+        gpt2.apply_cached, gpt2.init_cache, params, cfg,
+        serving=ServingConfig(block_size=4, num_blocks=20, max_slots=2,
+                              prefill_chunk=4, max_blocks_per_seq=8),
+    )
+    with pytest.raises(ValueError, match="empty prompt"):
+        eng.submit([], 4)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.submit([1, 2], -1)
+    with pytest.raises(ValueError, match="max_blocks_per_seq"):
+        eng.submit(list(range(40)), 10)
+    rid = eng.submit([1, 2, 3], 0)
+    done = eng.pop_finished()
+    assert [c.id for c in done] == [rid] and done[0].tokens == [1, 2, 3]
+
+
+def test_engine_rejects_geometry_beyond_model_window(gpt2_setup):
+    cfg, params = gpt2_setup  # tiny max_seq_len = 128
+    with pytest.raises(ValueError, match="max_seq_len"):
+        ServingEngine(
+            gpt2.apply_cached, gpt2.init_cache, params, cfg,
+            serving=ServingConfig(block_size=16, num_blocks=64, max_slots=2),
+        )
+
+
+def test_slo_metrics_publish_through_telemetry(gpt2_setup, tmp_path):
+    cfg, params = gpt2_setup
+    tel = telemetry.enable(dir=str(tmp_path))
+    eng = ServingEngine(
+        gpt2.apply_cached, gpt2.init_cache, params, cfg,
+        serving=ServingConfig(block_size=4, num_blocks=40, max_slots=2,
+                              prefill_chunk=8, max_blocks_per_seq=8),
+    )
+    rng = np.random.default_rng(5)
+    for n, m in ((5, 4), (9, 3)):
+        eng.submit(list(rng.integers(0, cfg.vocab_size, size=n)), m)
+    eng.run(max_ticks=500)
+    snap = tel.registry.snapshot()
+    assert snap["serving.requests"] == 2
+    assert snap["serving.completed"] == 2
+    assert snap["serving.tokens"] == 7
+    assert snap["serving.decode_dispatches"] == eng.decode_dispatches
+    assert snap["serving.ttft_ms.count"] == 2 and snap["serving.ttft_ms.p50"] >= 0
+    assert snap["serving.queue_wait_ms.count"] == 2
+    assert snap["serving.inter_token_ms.count"] == 7 - 2  # non-first tokens
+    assert snap["serving.block_occupancy"] == 0.0  # drained
+    completions = [c for c in eng.pop_finished()]
+    assert all(c.ttft_ms is not None and c.ttft_ms >= 0 for c in completions)
+    assert all(c.queue_wait_ms >= 0 for c in completions)
+    telemetry.disable()
+    events = []
+    with open(tel.jsonl_path) as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("kind") == "event" and rec.get("name") == "serving.request_complete":
+                events.append(rec)
+    assert len(events) == 2 and all("ttft_ms" in e for e in events)
+
+
+def test_prepare_serving_entry_point(gpt2_setup):
+    from accelerate_tpu.accelerator import Accelerator
+
+    cfg, params = gpt2_setup
+    acc = Accelerator()
+    eng = acc.prepare_serving(
+        gpt2.apply_cached, gpt2.init_cache, params, cfg,
+        block_size=4, num_blocks=20, max_slots=2, prefill_chunk=8,
+        max_blocks_per_seq=8,
+    )
+    assert isinstance(eng, ServingEngine)
+    with pytest.raises(ValueError, match="not both"):
+        acc.prepare_serving(
+            gpt2.apply_cached, gpt2.init_cache, params, cfg,
+            serving=ServingConfig(), block_size=4,
+        )
+    rid = eng.submit([1, 2, 3, 4], 2)
+    out = eng.run(max_ticks=200)
+    assert len(out[rid]) == 6

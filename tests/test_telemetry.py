@@ -30,7 +30,14 @@ from accelerate_tpu.telemetry import report as telemetry_report
 
 @pytest.fixture(autouse=True)
 def _telemetry_off():
-    """Telemetry state is process-global; every test leaves it disabled."""
+    """Telemetry state is process-global; every test STARTS from a clean
+    disabled singleton (enable() resets the registry but disable() keeps it
+    for the final snapshot, so metrics from an earlier module — e.g. the
+    test_flightrec steps — would otherwise leak into the disabled-by-default
+    assertions here) and leaves it disabled."""
+    telemetry.disable()
+    get_telemetry().registry.reset()
+    get_telemetry().step_timer.reset()
     yield
     telemetry.disable()
 
