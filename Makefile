@@ -1,10 +1,10 @@
 # Test-suite splits mirroring the reference Makefile:25-77.
 
-.PHONY: test test-quick test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels bench telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke profile-smoke serving-smoke perf-gate
+.PHONY: test test-quick test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels bench telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke pp-smoke profile-smoke serving-smoke perf-gate
 
 PYTEST = python -m pytest -q
 
-test: test-quick telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke profile-smoke serving-smoke perf-gate
+test: test-quick telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke pp-smoke profile-smoke serving-smoke perf-gate
 	$(PYTEST) tests/
 
 # <5 min tier (VERDICT r5 item 6): oracles, state, sharding-spec/mesh,
@@ -45,6 +45,14 @@ pipeline-smoke:
 # shrink dp-fold (docs/usage_guides/performance.md).
 zero-smoke:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.parallel.zero_smoke
+
+# Fused pipeline-parallel proof on an 8-device CPU dryrun mesh: pp=2 x v=2
+# llama through make_train_step — gpipe/interleaved loss equivalence, exactly
+# ONE dispatch per optimizer step for both schedules, and the executed
+# collective-permute ledger (per-tick bytes x ticks, invariant in v)
+# (docs/usage_guides/performance.md, "Pipeline schedules").
+pp-smoke:
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.pipeline.pp_smoke
 
 # Numerical-health proof: NaN-poisons a CPU run's gradients (fault
 # injection), asserts the in-program gate skips the step with bit-identical

@@ -616,6 +616,7 @@ class Accelerator:
         rng_types: Optional[list[Union[str, RNGType]]] = None,
         fsdp_plugin: Optional[FullyShardedDataParallelPlugin] = None,
         parallelism_config: Optional[ParallelismConfig] = None,
+        pp_plugin=None,
         deepspeed_plugin=None,
         megatron_lm_plugin=None,
         even_batches: bool = True,
@@ -699,6 +700,7 @@ class Accelerator:
             cpu=cpu,
             parallelism_config=parallelism_config,
             fsdp_plugin=fsdp_plugin,
+            pp_plugin=pp_plugin,
             _from_accelerator=True,
         )
         if dialect is not None:
@@ -1138,9 +1140,16 @@ class Accelerator:
 
                 from .utils.torch_bridge import lower_module_pipelined
 
-                mb = getattr(self.state.pp_plugin, "num_micro_batches", 1) or 1
+                pp_plugin = self.state.pp_plugin
+                mb = getattr(pp_plugin, "num_micro_batches", 1) or 1
                 try:
-                    lowered = lower_module_pipelined(model, pp, num_micro_batches=mb)
+                    lowered = lower_module_pipelined(
+                        model,
+                        pp,
+                        num_micro_batches=mb,
+                        schedule=getattr(pp_plugin, "schedule", "gpipe") or "gpipe",
+                        virtual_stages=getattr(pp_plugin, "virtual_stages", 1) or 1,
+                    )
                     rules = [(r"\._stacked\.", _P("pp"))]
                 except TorchLoweringError as e:
                     warnings.warn(

@@ -60,16 +60,33 @@ def prepare_pippy(
 
     from .parallel import pipeline as pl
 
+    # Schedule resolution: an explicit plugin wins, else the state's pp_plugin
+    # (the same config the training-side lowering reads).  The GENERIC
+    # stage_fn mode only honors an EXPLICIT plugin: its params contract is
+    # caller-stacked leaves whose leading dim must match the schedule
+    # ([S] for gpipe, [S·v] for interleaved), so an ambient training plugin
+    # must not silently reinterpret previously-valid [S]-stacked params.
+    if stage_fn is not None:
+        sched_src = plugin
+    else:
+        sched_src = plugin if plugin is not None else getattr(state, "pp_plugin", None)
+    schedule = getattr(sched_src, "schedule", "gpipe") or "gpipe"
+    virtual_stages = getattr(sched_src, "virtual_stages", 1) or 1
+
     if stage_fn is not None:
         def forward(x):
-            return pl.pipeline_apply(stage_fn, params, x, num_micro_batches=chunks)
+            return pl.pipeline_apply(
+                stage_fn, params, x, num_micro_batches=chunks,
+                schedule=schedule, virtual_stages=virtual_stages,
+            )
     else:
         if config is None:
             raise ValueError("pass the model config for the flagship-model path")
 
         def forward(input_ids):
             return pl.pipeline_llama_apply(
-                params, input_ids, config, num_stages=pp, num_micro_batches=chunks
+                params, input_ids, config, num_stages=pp, num_micro_batches=chunks,
+                schedule=schedule, virtual_stages=virtual_stages,
             )
 
     return jax.jit(forward) if jit else forward

@@ -7,13 +7,28 @@ microbatch iterators).  Redesigned TPU-first — instead of per-rank processes
 exchanging activations over NCCL P2P with a hand-written schedule:
 
 - Every stage's parameters are stacked on a leading stage dim sharded on ``pp``.
-- One jit-compiled ``lax.scan`` runs M + S - 1 pipeline ticks.  Each tick, a
-  vmapped stage body computes ALL stages in parallel — XLA maps the stage-batched
+- One jit-compiled ``lax.scan`` runs the pipeline ticks.  Each tick, a vmapped
+  stage body computes ALL stages in parallel — XLA maps the stage-batched
   matmuls onto per-stage devices with zero communication.
 - Activations advance one stage per tick via ``jnp.roll`` on the stage dim, which
   GSPMD lowers to a neighbor ``CollectivePermute`` over ICI.
 - Backward needs no separate schedule: differentiating the scan reverses the
-  pipeline automatically (the bubble is the same (S-1)/(M+S-1) fraction as GPipe).
+  pipeline automatically.
+
+Two schedules share that machinery (``schedule=`` on :func:`pipeline_apply`):
+
+- ``"gpipe"`` — M + S - 1 ticks of L/S layers each; bubble (S-1)/(M+S-1).
+- ``"interleaved"`` — the GSPMD circular schedule (Megatron's interleaved
+  1F1B analog): each pp rank owns ``virtual_stages`` = v NON-CONTIGUOUS layer
+  chunks (rank r runs chunks r, S+r, ..., (v-1)S+r of L/(S·v) layers each).  A
+  microbatch laps the S-rank ring v times; between laps it parks in a hold
+  FIFO so the round-major schedule stays dense — every rank computes a valid
+  chunk every steady-state tick.  The scan runs (v-1)·max(M,S) + M + S - 1
+  ticks (= v·M + S - 1 for M >= S) of L/(S·v) layers each, cutting the bubble
+  to (S-1)/(v·M+S-1) and total per-rank work to M + (S-1)/v coarse ticks —
+  strictly less than GPipe's M + S - 1 for v > 1.  The advance is the same
+  roll→CollectivePermute; only the per-tick chunk (selected per rank by the
+  occupying microbatch's round) changes.
 """
 
 from __future__ import annotations
@@ -27,24 +42,64 @@ from jax.sharding import PartitionSpec as P
 from .sharding import constrain
 
 __all__ = [
+    "PIPELINE_SCHEDULES",
     "stack_pipeline_stages",
+    "pipeline_ticks",
+    "pipeline_bubble_fraction",
     "pipeline_apply",
     "pipeline_llama_apply",
     "pipeline_llama_loss_fn",
+    "pipeline_llama_model",
 ]
 
+PIPELINE_SCHEDULES = ("gpipe", "interleaved")
 
-def stack_pipeline_stages(layer_params: Any, num_stages: int) -> Any:
+
+def stack_pipeline_stages(layer_params: Any, num_stages: int, virtual_stages: int = 1) -> Any:
     """Reshape a layer-stacked pytree ([L, ...] leaves) into stage-stacked form
-    ([S, L/S, ...]).  The leading stage dim is what gets sharded on ``pp``."""
+    ([S·v, L/(S·v), ...]).  The leading stage dim is what gets sharded on
+    ``pp``; with ``virtual_stages`` = v > 1 each pp rank executes v of the
+    S·v chunks (the interleaved/circular assignment — chunk c·S + r runs on
+    rank r during round c)."""
+
+    if virtual_stages < 1:
+        raise ValueError(f"virtual_stages must be >= 1, got {virtual_stages}")
+    chunks = num_stages * virtual_stages
 
     def one(leaf):
         L = leaf.shape[0]
-        if L % num_stages:
-            raise ValueError(f"num_layers {L} not divisible by num_stages {num_stages}")
-        return leaf.reshape(num_stages, L // num_stages, *leaf.shape[1:])
+        if L % chunks:
+            if virtual_stages == 1:
+                raise ValueError(f"num_layers {L} not divisible by num_stages {num_stages}")
+            raise ValueError(
+                f"num_layers {L} not divisible by num_stages x virtual_stages "
+                f"= {num_stages} x {virtual_stages} = {chunks}"
+            )
+        return leaf.reshape(chunks, L // chunks, *leaf.shape[1:])
 
     return jax.tree.map(one, layer_params)
+
+
+def pipeline_ticks(num_stages: int, num_micro_batches: int, virtual_stages: int = 1) -> int:
+    """Analytic scan length of the pipeline schedule.
+
+    GPipe (v=1): M + S - 1.  Interleaved: (v-1)·max(M,S) + M + S - 1 — for the
+    usual M >= S that is v·M + S - 1 (each rank does v·M chunk-ticks of work,
+    plus the S - 1 fill/drain bubble; the round-major hold-FIFO schedule keeps
+    rounds dense instead of paying the naive v·M + S·v - 1 of v independent
+    fine-pipeline drains)."""
+    S, M, v = num_stages, num_micro_batches, virtual_stages
+    return (v - 1) * max(M, S) + M + S - 1
+
+
+def pipeline_bubble_fraction(
+    num_stages: int, num_micro_batches: int, virtual_stages: int = 1
+) -> float:
+    """Idle (bubble) fraction of the schedule: per rank, v·M of the T ticks do
+    useful chunk work.  GPipe: (S-1)/(M+S-1).  Interleaved at M >= S:
+    (S-1)/(v·M+S-1) — the GSPMD/Megatron interleaving win."""
+    T = pipeline_ticks(num_stages, num_micro_batches, virtual_stages)
+    return (T - virtual_stages * num_micro_batches) / T
 
 
 def pipeline_apply(
@@ -54,8 +109,10 @@ def pipeline_apply(
     *,
     num_micro_batches: int,
     state_spec: Optional[Any] = None,
+    schedule: str = "gpipe",
+    virtual_stages: int = 1,
 ) -> Any:
-    """Run ``x`` through ``num_stages`` sequential stages with a GPipe microbatch
+    """Run ``x`` through the pipeline's sequential stages with a microbatched
     schedule.
 
     ``stage_fn(params_for_one_stage, activations) -> activations`` is the
@@ -68,8 +125,35 @@ def pipeline_apply(
     spec-tuple for an array ``x``, or a matching pytree of spec-tuples; the
     stage buffer is constrained to ``P("pp", *state_spec)`` so GSPMD keeps
     stages on their own pp ranks.
+
+    ``schedule="gpipe"`` (default): ``stage_params`` leading dim S is the pp
+    degree; M + S - 1 ticks.  ``schedule="interleaved"`` with
+    ``virtual_stages`` = v: ``stage_params`` leading dim is S·v fine chunks
+    (see :func:`stack_pipeline_stages`); each rank runs chunk c·S + r during
+    round c, microbatches lap the ring v times, and the scan runs
+    :func:`pipeline_ticks` ticks of 1/v the per-tick work — same math as
+    gpipe (identical chunk order per microbatch), smaller bubble.
     """
-    S = jax.tree.leaves(stage_params)[0].shape[0]
+    if schedule not in PIPELINE_SCHEDULES:
+        raise ValueError(
+            f"unknown pipeline schedule {schedule!r}; pick one of {PIPELINE_SCHEDULES}"
+        )
+    if virtual_stages < 1:
+        raise ValueError(f"virtual_stages must be >= 1, got {virtual_stages}")
+    if schedule == "gpipe" and virtual_stages != 1:
+        raise ValueError(
+            "virtual_stages > 1 requires schedule='interleaved' (a gpipe scan has "
+            "one chunk per rank by construction)"
+        )
+    v = virtual_stages
+    S_chunks = jax.tree.leaves(stage_params)[0].shape[0]
+    if S_chunks % v:
+        raise ValueError(
+            f"stage_params leading dim {S_chunks} not divisible by "
+            f"virtual_stages {v} — stack with stack_pipeline_stages(..., "
+            f"num_stages, virtual_stages={v})"
+        )
+    S = S_chunks // v
     M = num_micro_batches
     leaves = jax.tree.leaves(x)
     B = leaves[0].shape[0]
@@ -96,16 +180,90 @@ def pipeline_apply(
     micro = _constrain_tree(micro, micro_p)
     state = jax.tree.map(lambda a: jnp.zeros((S, mb, *a.shape[1:]), a.dtype), x)
     outputs = jax.tree.map(jnp.zeros_like, micro)
-    vstage = jax.vmap(stage_fn)
+
+    if v == 1:
+        vstage = jax.vmap(stage_fn)
+
+        def tick(carry, t):
+            state, outputs = carry
+            # Inject microbatch t into the stage-0 slot (past t >= M this re-injects
+            # the last microbatch; its output lands outside the valid window and is
+            # never written to `outputs`).
+            inj = jax.tree.map(
+                lambda m: jax.lax.dynamic_index_in_dim(m, jnp.minimum(t, M - 1), 0, keepdims=False),
+                micro,
+            )
+            state = jax.tree.map(
+                lambda s_, i: jax.lax.dynamic_update_index_in_dim(s_, i.astype(s_.dtype), 0, 0),
+                state,
+                inj,
+            )
+            state = _constrain_tree(state, state_p)
+            state = vstage(stage_params, state)
+            state = _constrain_tree(state, state_p)
+            # Stage S-1 just finished microbatch t-(S-1).  Writes with t < S-1 clamp
+            # to slot 0 and are later overwritten by the valid t = S-1 write.
+            out = jax.tree.map(lambda s_: jax.lax.index_in_dim(s_, S - 1, 0, keepdims=False), state)
+            idx = jnp.maximum(t - (S - 1), 0)
+            outputs = jax.tree.map(
+                lambda o, u: jax.lax.dynamic_update_index_in_dim(o, u, idx, 0), outputs, out
+            )
+            # Advance the pipeline: stage i's output becomes stage i+1's input.
+            state = jax.tree.map(lambda s_: jnp.roll(s_, 1, axis=0), state)
+            return (state, outputs), None
+
+        (state, outputs), _ = jax.lax.scan(tick, (state, outputs), jnp.arange(M + S - 1))
+        outputs = _constrain_tree(outputs, micro_p)
+        return jax.tree.map(lambda o, a: o.reshape(B, *a.shape[1:]), outputs, x)
+
+    # -- interleaved/circular (v > 1) ---------------------------------------
+    # Round-major dense schedule: microbatch m starts round c at stage 0 on
+    # tick c·P + m (P = max(M, S)), visits stage s at c·P + m + s, and parks
+    # in a depth-D hold FIFO between rounds (D = P - S + 1: exit tick of round
+    # c plus D is exactly the re-entry tick of round c+1).  Every rank is busy
+    # with a valid chunk on every steady-state tick, so the bubble is only the
+    # S - 1 fill/drain — (S-1)/(v·M+S-1) of the schedule at M >= S.
+    P_period = max(M, S)
+    D = P_period - S + 1
+    T = pipeline_ticks(S, M, v)
+
+    # [S·v, chunk, ...] -> [S, v, chunk, ...]: rank r's row holds its v round
+    # chunks (chunk c·S + r at local index c) — contiguous on the sharded
+    # stage dim, so GSPMD keeps each rank's chunks local and the per-tick
+    # round select is a rank-local gather, not a collective.
+    rank_params = jax.tree.map(
+        lambda leaf: jnp.swapaxes(leaf.reshape(v, S, *leaf.shape[1:]), 0, 1),
+        stage_params,
+    )
+    hold = jax.tree.map(lambda a: jnp.zeros((D, mb, *a.shape[1:]), a.dtype), x)
+    stage_ids = jnp.arange(S)
+
+    def one_stage(chunks, act, round_idx):
+        chunk = jax.tree.map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, round_idx, 0, keepdims=False),
+            chunks,
+        )
+        return stage_fn(chunk, act)
+
+    vstage = jax.vmap(one_stage)
 
     def tick(carry, t):
-        state, outputs = carry
-        # Inject microbatch t into the stage-0 slot (past t >= M this re-injects
-        # the last microbatch; its output lands outside the valid window and is
-        # never written to `outputs`).
+        state, hold, outputs = carry
+        slot = jnp.mod(t, D)
+        # Injection: round-0 ticks take fresh microbatches (clamped re-inject
+        # past M, as in gpipe — those lineages never reach `outputs`); later
+        # rounds re-enter from the hold FIFO, written exactly D ticks ago by
+        # the last stage.
+        pos = jnp.minimum(jnp.mod(t, P_period), M - 1)
+        fresh = jax.tree.map(
+            lambda m: jax.lax.dynamic_index_in_dim(m, pos, 0, keepdims=False), micro
+        )
+        held = jax.tree.map(
+            lambda h: jax.lax.dynamic_index_in_dim(h, slot, 0, keepdims=False), hold
+        )
+        first_round = t < P_period
         inj = jax.tree.map(
-            lambda m: jax.lax.dynamic_index_in_dim(m, jnp.minimum(t, M - 1), 0, keepdims=False),
-            micro,
+            lambda f, h: jnp.where(first_round, f.astype(h.dtype), h), fresh, held
         )
         state = jax.tree.map(
             lambda s_, i: jax.lax.dynamic_update_index_in_dim(s_, i.astype(s_.dtype), 0, 0),
@@ -113,20 +271,37 @@ def pipeline_apply(
             inj,
         )
         state = _constrain_tree(state, state_p)
-        state = vstage(stage_params, state)
+        # Stage s computes the chunk of the round its occupant is in: the
+        # microbatch at stage s entered stage 0 on tick t - s.
+        rounds = jnp.clip((t - stage_ids) // P_period, 0, v - 1)
+        state = vstage(rank_params, state, rounds)
         state = _constrain_tree(state, state_p)
-        # Stage S-1 just finished microbatch t-(S-1).  Writes with t < S-1 clamp
-        # to slot 0 and are later overwritten by the valid t = S-1 write.
         out = jax.tree.map(lambda s_: jax.lax.index_in_dim(s_, S - 1, 0, keepdims=False), state)
-        idx = jnp.maximum(t - (S - 1), 0)
-        outputs = jax.tree.map(
-            lambda o, u: jax.lax.dynamic_update_index_in_dim(o, u, idx, 0), outputs, out
+        # Park the finished round for its re-entry D ticks from now (reads of
+        # this slot happened above, before the overwrite).
+        hold = jax.tree.map(
+            lambda h, u: jax.lax.dynamic_update_index_in_dim(h, u.astype(h.dtype), slot, 0),
+            hold,
+            out,
         )
-        # Advance the pipeline: stage i's output becomes stage i+1's input.
+        # Collect only final-round exits.  done = c·P + m for the microbatch
+        # that just finished stage S-1; all pre-final-round writes clamp to
+        # slot 0 and are overwritten by the valid m=0 write on tick
+        # (v-1)·P + S - 1 — every later tick's write is valid by construction
+        # (the scan ends exactly after the last microbatch's final exit).
+        done = t - (S - 1)
+        final = (done >= 0) & (done // P_period == v - 1)
+        idx = jnp.where(final, jnp.mod(done, P_period), 0)
+        outputs = jax.tree.map(
+            lambda o, u: jax.lax.dynamic_update_index_in_dim(o, u.astype(o.dtype), idx, 0),
+            outputs,
+            out,
+        )
+        # Advance the ring: the same roll -> neighbor CollectivePermute as gpipe.
         state = jax.tree.map(lambda s_: jnp.roll(s_, 1, axis=0), state)
-        return (state, outputs), None
+        return (state, hold, outputs), None
 
-    (state, outputs), _ = jax.lax.scan(tick, (state, outputs), jnp.arange(M + S - 1))
+    (state, hold, outputs), _ = jax.lax.scan(tick, (state, hold, outputs), jnp.arange(T))
     outputs = _constrain_tree(outputs, micro_p)
     return jax.tree.map(lambda o, a: o.reshape(B, *a.shape[1:]), outputs, x)
 
@@ -144,6 +319,8 @@ def pipeline_llama_apply(
     num_stages: int,
     num_micro_batches: int,
     attention_mask: Optional[jax.Array] = None,
+    schedule: str = "gpipe",
+    virtual_stages: int = 1,
 ) -> jax.Array:
     """Pipelined llama forward: embed + head replicated across stages (they are
     fsdp/tp-sharded anyway), decoder layers pipelined over ``pp``.
@@ -169,7 +346,7 @@ def pipeline_llama_apply(
     x = llama.embed_tokens(params, input_ids, c)
     x = constrain(x, P(data_spec, None, None))
 
-    stage_layers = stack_pipeline_stages(params["layers"], num_stages)
+    stage_layers = stack_pipeline_stages(params["layers"], num_stages, virtual_stages)
     has_valid = attention_mask is not None
 
     def run_layers(lp, h, kv_valid=None, pos=None):
@@ -209,6 +386,8 @@ def pipeline_llama_apply(
                 "valid": (data_spec, None),
                 "pos": (data_spec, None),
             },
+            schedule=schedule,
+            virtual_stages=virtual_stages,
         )
         x = out["h"]
     else:
@@ -218,6 +397,8 @@ def pipeline_llama_apply(
             x,
             num_micro_batches=num_micro_batches,
             state_spec=(data_spec, None, None),
+            schedule=schedule,
+            virtual_stages=virtual_stages,
         )
 
     return llama.unembed(params, x, c)
@@ -230,6 +411,8 @@ def pipeline_llama_loss_fn(
     *,
     num_stages: int,
     num_micro_batches: int,
+    schedule: str = "gpipe",
+    virtual_stages: int = 1,
 ) -> jax.Array:
     """Next-token cross-entropy through the pipelined forward."""
     from ..models import llama
@@ -242,5 +425,69 @@ def pipeline_llama_loss_fn(
         num_stages=num_stages,
         num_micro_batches=num_micro_batches,
         attention_mask=batch.get("attention_mask"),
+        schedule=schedule,
+        virtual_stages=virtual_stages,
     )
     return llama.cross_entropy(logits, labels, weights)
+
+
+def pipeline_llama_model(
+    params: dict,
+    config,
+    *,
+    num_stages: Optional[int] = None,
+    num_micro_batches: Optional[int] = None,
+    schedule: Optional[str] = None,
+    virtual_stages: Optional[int] = None,
+):
+    """Wrap the pipelined llama loss as a :class:`~accelerate_tpu.JaxModel` so
+    pp training routes through the FUSED train step::
+
+        model, opt = accelerator.prepare(
+            pipeline_llama_model(params, cfg, num_micro_batches=8), optax.adamw(1e-3)
+        )
+        step_fn = accelerator.make_train_step(model, opt)   # ONE dispatch/step
+
+    Unspecified settings resolve from the live
+    :class:`~accelerate_tpu.utils.PipelineParallelPlugin` (``AcceleratorState
+    .pp_plugin``) and the mesh's pp degree — the same resolution the
+    torch-bridge pipelined lowering uses, so native and bridged pp training
+    read one config.
+    """
+    from ..accelerator import JaxModel
+    from ..models import llama
+    from ..state import AcceleratorState
+
+    state = AcceleratorState()
+    plugin = getattr(state, "pp_plugin", None)
+    pp = num_stages or dict(state.mesh.shape).get("pp", 1)
+    if pp < 2:
+        raise ValueError(
+            "pipeline_llama_model needs a pp mesh axis of size >= 2 (got "
+            f"{dict(state.mesh.shape)}); configure ParallelismConfig(pp=...)"
+        )
+    if num_micro_batches is None:
+        num_micro_batches = getattr(plugin, "num_micro_batches", 1) or 1
+        if num_micro_batches <= 1:
+            num_micro_batches = pp
+    if schedule is None:
+        schedule = getattr(plugin, "schedule", "gpipe") or "gpipe"
+    if virtual_stages is None:
+        virtual_stages = getattr(plugin, "virtual_stages", 1) or 1
+
+    def apply_fn(p, input_ids, attention_mask=None):
+        batch = {"input_ids": input_ids}
+        if attention_mask is not None:
+            batch["attention_mask"] = attention_mask
+        loss = pipeline_llama_loss_fn(
+            p,
+            batch,
+            config,
+            num_stages=pp,
+            num_micro_batches=num_micro_batches,
+            schedule=schedule,
+            virtual_stages=virtual_stages,
+        )
+        return {"loss": loss}
+
+    return JaxModel(apply_fn, params, partition_rules=llama.PARTITION_RULES)

@@ -28,7 +28,17 @@ This gate re-runs a bounded version of that probe on CPU and asserts the
   ZeRO arm is scanned (``telemetry/profile_scan.py``) and the fraction of
   collective time NOT hidden behind concurrent compute must stay under
   ``max_exposed_collective_frac`` — the static byte ledger proves the
-  collectives exist; this row proves at runtime that they overlap.
+  collectives exist; this row proves at runtime that they overlap;
+- a **pp row** (multi-device runs): the fused pipeline-parallel train step
+  (pp=2 llama through ``make_train_step``) must stay at
+  ``max_pp_dispatches_per_step`` == 1 (the whole microbatch schedule +
+  backward + update in ONE donated dispatch), the interleaved schedule must
+  actually build (``pp_interleaved_active`` — the gpipe-only-fallback
+  tripwire, with the analytic tick counts as proof: v·M + S - 1 vs
+  M + S - 1), and interleaved-vs-gpipe steps/s must hold
+  ``min_interleaved_vs_gpipe_ratio`` (interleaved does
+  (v·M+S-1)/(v·(M+S-1)) of gpipe's total layer work — the realized
+  bubble-shrink this row keeps honest).
 
 Absolute steps/s are *reported* but never gated — a 2-core CI box drifts
 ±50% run to run; ratios and dispatch counts don't.
@@ -46,6 +56,9 @@ that proves the ``zero_active`` tripwire catches a silent fallback.
 disabled (every collective µs counts as exposed — what stripping the
 latency-hiding scheduler flags does to a TPU run) — the knob that proves the
 overlap row fails when collectives stop hiding.
+``=gpipe-only`` runs the pp row's interleaved arm with the gpipe schedule —
+the knob that proves the ``pp_interleaved_active`` tripwire catches a
+silently-degraded pipeline schedule.
 """
 
 from __future__ import annotations
@@ -58,7 +71,7 @@ import tempfile
 import time
 from typing import Optional
 
-__all__ = ["load_baseline", "run_probe", "evaluate", "run_gate", "main"]
+__all__ = ["load_baseline", "run_probe", "run_pp_probe", "evaluate", "run_gate", "main"]
 
 ENV_BASELINE = "ACCELERATE_TPU_PERF_BASELINE"
 ENV_DEGRADE = "ACCELERATE_TPU_PERF_GATE_DEGRADE"
@@ -77,6 +90,125 @@ def load_baseline(path: Optional[str] = None) -> dict:
         return json.load(f)
 
 
+def run_pp_probe(
+    steps: int = 3,
+    micro_batches: int = 4,
+    virtual_stages: int = 2,
+    degrade: Optional[str] = None,
+) -> dict:
+    """The pp row's measurement: gpipe vs interleaved fused pipeline train
+    steps on a pp=4 mesh (llama-tiny through ``make_train_step``), at the
+    SAME microbatch count M.  The batch geometry (B=32, seq=64) keeps the
+    probe in the compute-dominated regime where the schedule's tick count —
+    not the scan's per-tick fixed overhead — sets the step time, so the
+    interleaved win ((v·M+S-1)/(v·(M+S-1)) = 11/14 of gpipe's layer work at
+    these settings) is measurable on a CPU box.  Returns the ``pp_*``
+    measurement keys.  ``degrade="gpipe-only"`` builds the "interleaved" arm
+    with the gpipe schedule — the self-test that the
+    ``pp_interleaved_active`` tripwire actually judges this row."""
+    import numpy as np
+
+    import jax
+
+    from .. import telemetry
+    from ..accelerator import Accelerator
+    from ..models import llama
+    from ..parallel.pipeline import (
+        pipeline_bubble_fraction,
+        pipeline_llama_model,
+        pipeline_ticks,
+    )
+    from ..parallel.sharding import data_sharding
+    from ..state import AcceleratorState, GradientState, PartialState
+    from ..utils import set_seed
+    from ..utils.dataclasses import ParallelismConfig, PipelineParallelPlugin
+
+    import optax
+
+    pp = 4
+    M = micro_batches
+    v = virtual_stages
+    if jax.device_count() < pp or jax.device_count() % pp:
+        raise RuntimeError(
+            f"run_pp_probe needs a device count divisible by pp={pp} "
+            f"(got {jax.device_count()})"
+        )
+    if degrade is None:
+        degrade = os.environ.get(ENV_DEGRADE, "").strip().lower() or None
+    tel = telemetry.get_telemetry()
+    owns_telemetry = not tel.enabled
+    if owns_telemetry:
+        telemetry.enable(dir=tempfile.mkdtemp(prefix="atpu_pp_gate_"))
+    dispatches = tel.registry.counter("pipeline.dispatches")
+
+    def arm(schedule, vs):
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        set_seed(0)
+        acc = Accelerator(
+            parallelism_config=ParallelismConfig(pp=pp, dp=max(jax.device_count() // pp, 1)),
+            pp_plugin=PipelineParallelPlugin(
+                pp_size=pp, num_micro_batches=M, schedule=schedule, virtual_stages=vs
+            ),
+        )
+        cfg = llama.LlamaConfig.tiny(num_layers=8, hidden_size=64, intermediate_size=128)
+        params = llama.init_params(cfg, jax.random.key(0))
+        model, opt = acc.prepare(pipeline_llama_model(params, cfg), optax.adamw(1e-3))
+        step_fn = acc.make_train_step(model, opt)
+        rng = np.random.default_rng(0)
+        batches = [
+            {
+                "input_ids": jax.device_put(
+                    rng.integers(0, cfg.vocab_size, (32, 64)).astype("int32"),
+                    data_sharding(acc.mesh),
+                )
+            }
+            for _ in range(steps)
+        ]
+        # Warmup compiles AND syncs — its device tail must not bleed into the
+        # first timed step's window.
+        float(np.asarray(step_fn(batches[0])))
+        d0 = dispatches.value
+        t0 = time.perf_counter()
+        for b in batches[1:]:
+            step_fn(b)
+        jax.block_until_ready(model.params)
+        dt = time.perf_counter() - t0
+        timed = max(steps - 1, 1)
+        return timed / dt, (dispatches.value - d0) / timed, step_fn
+
+    try:
+        gpipe_sps, gpipe_disp, _ = arm("gpipe", 1)
+        if degrade == "gpipe-only":
+            inter_sps, inter_disp, step_fn = arm("gpipe", 1)
+            inter_schedule, inter_v = "gpipe", 1
+        else:
+            inter_sps, inter_disp, step_fn = arm("interleaved", v)
+            inter_schedule, inter_v = "interleaved", v
+    finally:
+        if owns_telemetry:
+            telemetry.disable()
+    return {
+        "pp_degree": pp,
+        "pp_micro_batches": M,
+        "pp_virtual_stages": inter_v,
+        "pp_gpipe_steps_per_s": round(gpipe_sps, 2),
+        "pp_interleaved_steps_per_s": round(inter_sps, 2),
+        "pp_interleaved_vs_gpipe_ratio": round(inter_sps / max(gpipe_sps, 1e-9), 3),
+        "pp_gpipe_dispatches_per_step": gpipe_disp,
+        "pp_dispatches_per_step": inter_disp,
+        "pp_active": step_fn.pp_active,
+        # The schedule tripwire: interleaved really built iff its analytic
+        # tick count differs from gpipe's (v > 1).
+        "pp_interleaved_active": inter_schedule == "interleaved" and inter_v > 1,
+        "pp_gpipe_ticks": pipeline_ticks(pp, M, 1),
+        "pp_interleaved_ticks": pipeline_ticks(pp, M, inter_v),
+        "pp_analytic_bubble_gpipe": round(pipeline_bubble_fraction(pp, M, 1), 4),
+        "pp_analytic_bubble_interleaved": round(pipeline_bubble_fraction(pp, M, inter_v), 4),
+    }
+
+
 def run_probe(
     accum: int = 2,
     steps: int = 10,
@@ -85,11 +217,13 @@ def run_probe(
     epochs: int = 3,
     prefetch: int = 2,
     degrade: Optional[str] = None,
+    pp: bool = True,
 ) -> dict:
     """Bounded eager-vs-fused micro-benchmark (the bench.py pipeline probe,
     trimmed for a test-suite budget).  Returns the measurements dict the gate
     judges.  ``degrade="eager"`` runs the eager loop in the fused arm — the
-    self-test knob."""
+    self-test knob.  ``pp=False`` skips the pipeline-parallel row (targeted
+    self-tests of the other rows don't pay for two pp compiles)."""
     import numpy as np
     import torch
 
@@ -273,6 +407,12 @@ def run_probe(
                     zero_profile_error = "trace has no collective ops"
             except Exception as e:
                 zero_profile_error = str(e)[:200]
+        # pp row: the probe builds a pp=4 mesh, so it needs a device count
+        # divisible by 4 (the ZeRO row's >= 2 condition is not enough here —
+        # a 2-device run must SKIP the row, not crash the gate).
+        pp_row = None
+        if pp and jax.device_count() >= 4 and jax.device_count() % 4 == 0:
+            pp_row = run_pp_probe(degrade=degrade)
     finally:
         if owns_telemetry:
             telemetry.disable()
@@ -311,6 +451,8 @@ def run_probe(
             measurements["zero_exposed_collective_ms"] = zero_profile.exposed_collective_ms
         if zero_profile_error is not None:
             measurements["zero_profile_error"] = zero_profile_error
+    if pp_row is not None:
+        measurements.update(pp_row)
     return measurements
 
 
@@ -391,6 +533,47 @@ def evaluate(measurements: dict, baseline: dict) -> list:
                     f"{max_exposed} — ZeRO collectives are no longer hidden behind "
                     "compute (comms/compute overlap regressed)"
                 )
+    # pp row: judged only when the arm ran (multi-device probe).  An
+    # "interleaved" request that silently built gpipe, a fused pp step that
+    # regressed to per-tick dispatches, or an interleaved schedule slower
+    # than gpipe are exactly the regressions this row exists to catch.
+    if "pp_dispatches_per_step" in measurements:
+        if baseline.get("require_pp_interleaved") and not measurements.get(
+            "pp_interleaved_active"
+        ):
+            failures.append(
+                "pp_interleaved_active is False — the interleaved pipeline "
+                "schedule silently fell back to gpipe "
+                f"(ticks {measurements.get('pp_interleaved_ticks')} vs gpipe "
+                f"{measurements.get('pp_gpipe_ticks')})"
+            )
+        max_pp_disp = baseline.get("max_pp_dispatches_per_step")
+        if max_pp_disp is not None:
+            # BOTH schedules' fused steps must hold the one-dispatch invariant
+            # (a schedule-conditional regression could break just one arm).
+            for key, label in (
+                ("pp_dispatches_per_step", "interleaved"),
+                ("pp_gpipe_dispatches_per_step", "gpipe"),
+            ):
+                disp = measurements.get(key)
+                if disp is not None and disp > max_pp_disp + 1e-9:
+                    failures.append(
+                        f"pp dispatches/step ({label}) {disp:.2f} > baseline max "
+                        f"{max_pp_disp} — the fused pipeline-parallel train step "
+                        "is no longer one dispatch per optimizer step"
+                    )
+        min_pp_ratio = baseline.get("min_interleaved_vs_gpipe_ratio")
+        if (
+            min_pp_ratio is not None
+            and measurements.get("pp_interleaved_vs_gpipe_ratio") is not None
+            and measurements["pp_interleaved_vs_gpipe_ratio"] < min_pp_ratio
+        ):
+            failures.append(
+                f"interleaved-vs-gpipe steps/s ratio "
+                f"{measurements['pp_interleaved_vs_gpipe_ratio']:.3f} < baseline min "
+                f"{min_pp_ratio} — the interleaved schedule lost its bubble-shrink "
+                "win over gpipe"
+            )
     return failures
 
 
@@ -419,6 +602,13 @@ def run_gate(baseline_path: Optional[str] = None, probe_kwargs: Optional[dict] =
             )
     elif measurements.get("zero_active") is None:
         zero_note = ", ZeRO row skipped (single-device probe)"
+    if measurements.get("pp_interleaved_vs_gpipe_ratio") is not None:
+        zero_note += (
+            f", pp interleaved/gpipe {measurements['pp_interleaved_vs_gpipe_ratio']}x "
+            f"at {measurements['pp_dispatches_per_step']:.0f} dispatch/step "
+            f"(analytic bubble {measurements['pp_analytic_bubble_gpipe']} -> "
+            f"{measurements['pp_analytic_bubble_interleaved']})"
+        )
     print(
         "perf-gate OK — "
         f"fused/eager {measurements['fused_vs_eager_ratio']}x "

@@ -24,6 +24,16 @@ reduce-scatter instead of the monolithic gradient all-reduce, the clip +
 optax update on the local shard (opt state lives dp-sharded in HBM between
 steps), and one params all-gather per window — still a single dispatch, and
 bit-exact with the unsharded step on power-of-two dp degrees.
+
+Pipeline parallelism composes the same way: on a pp mesh the prepared
+model's forward IS the compiled pipeline scan (the torch-bridge pipelined
+lowering, or ``parallel.pipeline.pipeline_llama_model`` for the native
+flagship path), so the fused step wraps the whole microbatch schedule —
+gpipe or interleaved — plus backward, clipping, the health gate and the
+optax update in ONE donated dispatch per optimizer step.  ``pp_active`` /
+``pp_degree`` record that the built program pipelines (the observability
+twin of ``zero_active``); ZeRO requests on a pp mesh keep their existing
+warning-fallback (``zero.supported`` declines model axes).
 """
 
 from __future__ import annotations
@@ -133,6 +143,14 @@ class TrainStep:
 
         self.zero_config = ZeROConfig.resolve(zero)
         self.zero_active = False
+        # pp observability: a fused step built on a pp mesh runs the whole
+        # pipeline schedule (microbatch scan + backward + update) inside its
+        # one dispatch.  The schedule itself lives in the prepared model's
+        # forward; these fields are the perf gate's / bench's truth of what
+        # was built (the zero_active pattern).
+        mesh = getattr(accelerator, "mesh", None)
+        self.pp_degree = int(dict(mesh.shape).get("pp", 1)) if mesh is not None else 1
+        self.pp_active = self.pp_degree > 1
 
     # -- program construction -------------------------------------------------
 

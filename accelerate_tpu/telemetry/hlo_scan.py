@@ -20,13 +20,18 @@ parses that text (``compiled.as_text()``) into a structured **comms ledger**:
 Pure text + numpy — no XLA bindings beyond the HLO string, so the scan works
 identically on CPU test meshes and real TPU slices.
 
-Known limitation: the scan is *static* — each HLO instruction counts once.  A
-collective inside a ``while`` body (e.g. the per-layer gradient all-reduce of
-a ``lax.scan`` over layers) executes once per iteration but appears once in
-the text, so scanned-layer programs under-report executed bytes by roughly the
-layer count for the in-loop portion.  Invariant tests pin ``num_layers=1``
-(static == executed there); ranking programs by comms pressure is unaffected
-as long as they scan the same depth.
+By default the scan is *static* — each HLO instruction counts once, so a
+collective inside a ``while`` body (e.g. the per-tick CollectivePermute of
+the pipeline scan) under-reports executed bytes by the loop trip count.
+``scan_hlo(..., unroll_loops=True)`` fixes that: while instructions carry
+XLA's ``backend_config={"known_trip_count":{"n":...}}`` (or a constant-vs-
+induction-variable ``compare`` in the condition computation), and each
+collective's bytes are multiplied by the product of its enclosing loops'
+trip counts — which is what makes the pp invariant checkable on the same
+convention as the dp/fsdp ones: executed ``collective-permute`` bytes over
+the ``pp`` axis == per-tick activation bytes x pipeline ticks, independent
+of the interleaving degree v.  The static default keeps the existing
+num_layers=1 invariant tests bit-stable.
 """
 
 from __future__ import annotations
@@ -87,6 +92,20 @@ _IOTA_GROUPS_RE = re.compile(r"replica_groups=\[(?P<dims>[0-9,]+)\]<=\[")
 _SOURCE_TARGET_RE = re.compile(r"source_target_pairs=\{(?P<pairs>(?:\{[^{}]*\}\s*,?\s*)*)\}")
 _OP_NAME_RE = re.compile(r'op_name="(?P<name>[^"]*)"')
 
+# Computation header: a non-indented "%name (args...) -> result {" line
+# (ENTRY-prefixed for the entry computation).
+_COMPUTATION_RE = re.compile(r"^(?P<entry>ENTRY\s+)?%?(?P<name>[\w.\-]+)\s*\(.*->.*\{\s*$")
+# While instruction: condition/body computation refs + XLA's analyzed trip
+# count (emitted for counted loops like lax.scan's).
+_WHILE_RE = re.compile(
+    r"=\s*(?:\([^)]*\)|[a-z][a-z0-9]*\[[0-9,]*\](?:\{[^}]*\})?)\s+while\("
+)
+_WHILE_COND_RE = re.compile(r"condition=%?(?P<name>[\w.\-]+)")
+_WHILE_BODY_RE = re.compile(r"body=%?(?P<name>[\w.\-]+)")
+_TRIP_COUNT_RE = re.compile(r'"known_trip_count":\{"n":"(?P<n>\d+)"\}')
+_COND_CONSTANT_RE = re.compile(r"=\s*s32\[\]\s+constant\((?P<n>-?\d+)\)")
+_COND_COMPARE_RE = re.compile(r"compare\(.*direction=(?P<dir>LT|LE|GT|GE)")
+
 
 @dataclasses.dataclass
 class CollectiveOp:
@@ -97,6 +116,14 @@ class CollectiveOp:
     axes: Optional[tuple[str, ...]]  # mesh axes communicated over (None: unknown)
     group_size: int  # devices per replica group (0 = unknown, 1 = degenerate)
     op_name: str = ""  # jax op_name metadata (trace provenance), may be ""
+    # Product of enclosing while-loop trip counts (1 = top level / unknown).
+    # ``bytes`` stays the per-execution figure; ``executed_bytes`` is the
+    # loop-unrolled volume the ``unroll_loops`` ledger aggregates.
+    trip_count: int = 1
+
+    @property
+    def executed_bytes(self) -> int:
+        return self.bytes * max(self.trip_count, 1)
 
     @property
     def is_degenerate(self) -> bool:
@@ -257,8 +284,90 @@ def classify_groups(
     return tuple(mesh.axis_names[d] for d in sorted(varying)), size
 
 
-def parse_collectives(hlo_text: str, mesh=None) -> list[CollectiveOp]:
+def _computation_multipliers(hlo_text: str) -> dict:
+    """Map computation name -> product of enclosing while-loop trip counts.
+
+    XLA stamps counted loops (every ``lax.scan``) with
+    ``backend_config={"known_trip_count":{"n":...}}``; when that is missing
+    the trip count falls back to the condition computation's
+    constant-vs-induction-variable ``compare`` (LT -> N, LE -> N+1), else 1
+    (the static convention).  Multipliers compose through nested loops (the
+    layer scan inside the pipeline tick scan) by walking while edges to a
+    fixpoint."""
+    # Pass 1: split into computations and find while edges.
+    comp_lines: dict = {}
+    current = None
+    entry = None
+    for line in hlo_text.splitlines():
+        m = _COMPUTATION_RE.match(line)
+        if m is not None:
+            current = m.group("name")
+            comp_lines[current] = []
+            if m.group("entry"):
+                entry = current
+            continue
+        if line.startswith("}"):
+            current = None
+            continue
+        if current is not None:
+            comp_lines[current].append(line)
+
+    def _cond_trip(cond_name: str) -> int:
+        direction = None
+        constants = []
+        for line in comp_lines.get(cond_name, ()):
+            mc = _COND_COMPARE_RE.search(line)
+            if mc is not None:
+                direction = mc.group("dir")
+            constants.extend(int(n) for n in _COND_CONSTANT_RE.findall(line))
+        if direction in ("LT", "GT") and constants:
+            return max(constants)
+        if direction in ("LE", "GE") and constants:
+            return max(constants) + 1
+        return 1
+
+    edges: dict = {}  # computation -> [(child computation, trip)]
+    for name, lines in comp_lines.items():
+        for line in lines:
+            if _WHILE_RE.search(line) is None:
+                continue
+            body_m = _WHILE_BODY_RE.search(line)
+            cond_m = _WHILE_COND_RE.search(line)
+            trip_m = _TRIP_COUNT_RE.search(line)
+            if trip_m is not None:
+                trip = int(trip_m.group("n"))
+            elif cond_m is not None:
+                trip = _cond_trip(cond_m.group("name"))
+            else:
+                trip = 1
+            for ref in (body_m, cond_m):
+                if ref is not None:
+                    edges.setdefault(name, []).append((ref.group("name"), trip))
+
+    # Pass 2: propagate from the entry down the while nest to a fixpoint
+    # (bounded by the computation count — while nests cannot be cyclic).
+    mult = {name: 1 for name in comp_lines}
+    if entry is not None:
+        mult[entry] = 1
+    for _ in range(len(comp_lines)):
+        changed = False
+        for parent, children in edges.items():
+            for child, trip in children:
+                new = mult.get(parent, 1) * max(trip, 1)
+                if new > mult.get(child, 1):
+                    mult[child] = new
+                    changed = True
+        if not changed:
+            break
+    return mult
+
+
+def parse_collectives(
+    hlo_text: str, mesh=None, trip_counts: bool = False
+) -> list[CollectiveOp]:
     """Scan optimized HLO text for collective instructions.
+    ``trip_counts=True`` additionally resolves each op's enclosing while-loop
+    trip-count product (``CollectiveOp.trip_count``; defaults to 1 otherwise).
 
     Byte convention: the LARGE side of the transfer, per participating
     device.  For all-reduce/all-gather/all-to-all/collective-permute that is
@@ -271,7 +380,18 @@ def parse_collectives(hlo_text: str, mesh=None) -> list[CollectiveOp]:
     """
     ops = []
     coords = _mesh_coords(mesh) if mesh is not None else None
+    # The loop-multiplier pass is a second full-text scan — only pay for it
+    # when the caller wants executed-bytes trip counts.
+    multipliers = _computation_multipliers(hlo_text) if trip_counts else {}
+    current_comp = None
     for line in hlo_text.splitlines():
+        cm = _COMPUTATION_RE.match(line)
+        if cm is not None:
+            current_comp = cm.group("name")
+            continue
+        if line.startswith("}"):
+            current_comp = None
+            continue
         m = _COLLECTIVE_RE.match(line)
         if m is None:
             continue
@@ -291,12 +411,13 @@ def parse_collectives(hlo_text: str, mesh=None) -> list[CollectiveOp]:
                 axes=axes,
                 group_size=group_size,
                 op_name=name_m.group("name") if name_m else "",
+                trip_count=multipliers.get(current_comp, 1),
             )
         )
     return ops
 
 
-def scan_hlo(hlo_text: str, mesh=None) -> CommsLedger:
+def scan_hlo(hlo_text: str, mesh=None, unroll_loops: bool = False) -> CommsLedger:
     """Build the comms ledger for one compiled program's optimized HLO.
 
     Byte volumes are the collective's **large-side bytes on one participating
@@ -307,19 +428,25 @@ def scan_hlo(hlo_text: str, mesh=None) -> CommsLedger:
     (`all-reduce ≈ param bytes`, `reduce-scatter + all-gather ≈ param bytes
     each`) checkable.  Degenerate collectives (single-member groups — no
     traffic) are counted separately, not in the totals.
+
+    ``unroll_loops=True`` aggregates EXECUTED bytes instead of static ones:
+    each op's bytes x the product of its enclosing while trip counts — the
+    convention the pp permute invariant (per-tick activation bytes x
+    pipeline ticks) is checked on.
     """
-    all_ops = parse_collectives(hlo_text, mesh)
+    all_ops = parse_collectives(hlo_text, mesh, trip_counts=unroll_loops)
     ops = [op for op in all_ops if not op.is_degenerate]
     by_kind: dict = {}
     by_axis: dict = {}
     total = 0
     for op in ops:
+        nbytes = op.executed_bytes if unroll_loops else op.bytes
         agg = by_kind.setdefault(op.kind, {"count": 0, "bytes": 0})
         agg["count"] += 1
-        agg["bytes"] += op.bytes
+        agg["bytes"] += nbytes
         axis_key = "+".join(op.axes) if op.axes else "?"
-        by_axis[axis_key] = by_axis.get(axis_key, 0) + op.bytes
-        total += op.bytes
+        by_axis[axis_key] = by_axis.get(axis_key, 0) + nbytes
+        total += nbytes
     return CommsLedger(
         ops=ops,
         by_kind=by_kind,

@@ -84,12 +84,37 @@ class ProfileReport:
     # None when the window holds no collectives (single-device program).
     overlap_fraction: Optional[float] = None
     idle_ms: float = 0.0
+    # Idle-gap share of the whole capture window across device scopes
+    # (idle_ms / (window_ms x n_scopes)) — the realized pipeline-bubble
+    # measurement the pp probes compare against the analytic
+    # (S-1)/(v·M+S-1).  None until device events exist.
+    bubble_fraction: Optional[float] = None
     step_marker: Optional[str] = None
     steps: list = field(default_factory=list)
     top_ops: list = field(default_factory=list)
 
     def to_dict(self) -> dict:
         return asdict(self)
+
+    def step_bubble_fraction(self, skip_first: bool = True) -> Optional[float]:
+        """Mean idle-gap share of the per-step windows (the realized bubble of
+        the steady-state step).  ``skip_first`` drops step 0 when more than
+        one step exists — its window absorbs warmup/compile idle that is not
+        schedule bubble.  Each step row carries the scope count of the host
+        it was built from (``n_scopes`` in the row) — on a merged multi-host
+        report the report-level ``n_scopes`` sums ALL hosts while the step
+        rows cover one, so the row value is the correct denominator."""
+        steps = self.steps
+        if skip_first and len(steps) > 1:
+            steps = steps[1:]
+        fracs = [
+            s["idle_ms"] / (s["dur_ms"] * max(s.get("n_scopes") or self.n_scopes, 1))
+            for s in steps
+            if s.get("dur_ms")
+        ]
+        if not fracs:
+            return None
+        return round(sum(fracs) / len(fracs), 4)
 
 
 # ---------------------------------------------------------------------------
@@ -229,6 +254,10 @@ def analyze_events(
         report.overlap_fraction = round(
             1.0 - report.exposed_collective_ms / report.collective_ms, 4
         )
+    if report.window_ms > 0 and report.n_scopes:
+        report.bubble_fraction = round(
+            report.idle_ms / (report.window_ms * report.n_scopes), 4
+        )
 
     # Top-k ops by self time (summed across lanes; uniquifier suffixes like
     # ``.3`` are kept — distinct HLO instructions are distinct rows).
@@ -263,6 +292,7 @@ def analyze_events(
     for index, (ws, we) in enumerate(windows):
         step = {
             "index": index,
+            "n_scopes": report.n_scopes,
             "start_ms": round((ws - t0) / 1e3, 3),
             "dur_ms": round((we - ws) / 1e3, 3),
             "compute_ms": 0.0,
@@ -357,6 +387,11 @@ def analyze_trace_dir(path: str, **kwargs) -> ProfileReport:
         merged.overlap_fraction = round(
             1.0 - merged.exposed_collective_ms / merged.collective_ms, 4
         )
+    # Idle share over the summed per-host device capacity (windows are
+    # per-host clocks, so capacity is the sum of window x scopes terms).
+    capacity = sum(r.window_ms * r.n_scopes for r in reports)
+    if capacity > 0:
+        merged.bubble_fraction = round(merged.idle_ms / capacity, 4)
     host_with_steps = max(reports, key=lambda r: len(r.steps))
     merged.steps = host_with_steps.steps
     merged.step_marker = host_with_steps.step_marker
@@ -404,6 +439,7 @@ def digest(report: ProfileReport, top_k: int = 3) -> dict:
         "exposed_collective_ms": report.exposed_collective_ms,
         "overlap_fraction": report.overlap_fraction,
         "idle_ms": report.idle_ms,
+        "bubble_fraction": report.bubble_fraction,
         "n_steps": len(report.steps),
         "top_ops": [
             {"name": r["name"], "bucket": r["bucket"], "self_ms": r["self_ms"]}

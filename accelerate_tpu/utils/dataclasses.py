@@ -645,26 +645,55 @@ class SequenceParallelPlugin:
 
 @dataclass
 class PipelineParallelPlugin:
-    """Pipeline parallelism over the ``pp`` mesh axis (microbatched GPipe schedule).
+    """Pipeline parallelism over the ``pp`` mesh axis (microbatched schedule).
 
     Parity: reference ``prepare_pippy`` (``inference.py:124-184``) + Megatron pp.
+
+    ``schedule="gpipe"`` runs the plain M + S - 1 tick microbatch scan;
+    ``schedule="interleaved"`` is the GSPMD circular schedule (Megatron's
+    interleaved 1F1B analog): each pp rank owns ``virtual_stages`` non-
+    contiguous layer chunks, cutting the pipeline bubble from (S-1)/(M+S-1)
+    to (S-1)/(v·M+S-1) at the same microbatch count
+    (``parallel/pipeline.py``).  Backward still needs no hand-written
+    schedule — both forward schedules differentiate through the scan.
     """
 
     pp_size: int = 1
     num_micro_batches: int = 1
     schedule: str = "gpipe"
+    virtual_stages: int = 1
 
     def __post_init__(self):
-        if self.schedule != "gpipe":
-            # Don't silently run a different schedule than requested.  The
-            # jitted pipeline differentiates the scan, so backward interleaving
-            # (1F1B) is an XLA scheduling concern, not a hand-written schedule;
-            # "gpipe" is the only explicit schedule.
+        from ..parallel.pipeline import PIPELINE_SCHEDULES
+
+        if self.schedule not in PIPELINE_SCHEDULES:
             raise ValueError(
-                f"schedule={self.schedule!r} is not supported: the compiled "
-                "pipeline runs a GPipe microbatch scan (backward is derived by "
-                "autodiff). Use schedule='gpipe'."
+                f"schedule={self.schedule!r} is not supported; pick one of "
+                f"{PIPELINE_SCHEDULES} (interleaved takes virtual_stages=v for "
+                "v non-contiguous layer chunks per pp rank)"
             )
+        if self.virtual_stages < 1:
+            raise ValueError(f"virtual_stages must be >= 1, got {self.virtual_stages}")
+        if self.schedule == "gpipe" and self.virtual_stages != 1:
+            raise ValueError(
+                "virtual_stages > 1 requires schedule='interleaved' (gpipe has "
+                "exactly one layer chunk per pp rank)"
+            )
+
+    def validate_num_layers(self, num_layers: int, num_stages: Optional[int] = None):
+        """Check L % (S·v) == 0 once the model depth is known (the stacking in
+        ``stack_pipeline_stages`` re-checks at trace time)."""
+        S = num_stages or self.pp_size
+        chunks = S * self.virtual_stages
+        if chunks and num_layers % chunks:
+            raise ValueError(
+                f"num_layers {num_layers} not divisible by num_stages x "
+                f"virtual_stages = {S} x {self.virtual_stages} = {chunks}"
+            )
+
+
+# The issue-tracker / launcher spelling; same object.
+PipelineParallelismConfig = PipelineParallelPlugin
 
 
 @dataclass

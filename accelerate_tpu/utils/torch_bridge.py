@@ -744,6 +744,8 @@ class PipelinedLoweredModule(LoweredModule):
         block_lowered,
         num_stages,
         num_micro_batches,
+        schedule="gpipe",
+        virtual_stages=1,
     ):
         super().__init__(module, graph_module, params, buffers)
         self.container = container
@@ -752,6 +754,8 @@ class PipelinedLoweredModule(LoweredModule):
         self.block_lowered = block_lowered
         self.num_stages = num_stages
         self.num_micro_batches = num_micro_batches
+        self.schedule = schedule
+        self.virtual_stages = virtual_stages
 
     # -- stacked <-> per-block naming ---------------------------------------
 
@@ -804,8 +808,9 @@ class PipelinedLoweredModule(LoweredModule):
         stacked_p = {k[len(pre):]: v for k, v in params.items() if k.startswith(pre)}
         stacked_b = {k[len(pre):]: v for k, v in buffers.items() if k.startswith(pre)}
         S = self.num_stages
-        stage_p = stack_pipeline_stages(stacked_p, S)  # [S, L/S, ...]
-        stage_b = stack_pipeline_stages(stacked_b, S) if stacked_b else {}
+        v = self.virtual_stages
+        stage_p = stack_pipeline_stages(stacked_p, S, v)  # [S·v, L/(S·v), ...]
+        stage_b = stack_pipeline_stages(stacked_b, S, v) if stacked_b else {}
         block_apply = self.block_lowered.apply
         # fsdp_plugin.activation_checkpointing: remat each block inside the
         # scan — per-layer activation memory instead of per-model (the same
@@ -838,7 +843,12 @@ class PipelinedLoweredModule(LoweredModule):
         merged = dict(stage_p)
         merged.update({f"__buf__{k}": v for k, v in stage_b.items()})
         return pipeline_apply(
-            stage_fn, merged, x, num_micro_batches=self.num_micro_batches
+            stage_fn,
+            merged,
+            x,
+            num_micro_batches=self.num_micro_batches,
+            schedule=self.schedule,
+            virtual_stages=self.virtual_stages,
         )
 
     def apply(self, params: dict, buffers: dict, *args, **kwargs):
@@ -863,15 +873,24 @@ class PipelinedLoweredModule(LoweredModule):
 
 
 def lower_module_pipelined(
-    module, num_stages: int, num_micro_batches: int = 1
+    module,
+    num_stages: int,
+    num_micro_batches: int = 1,
+    schedule: str = "gpipe",
+    virtual_stages: int = 1,
 ) -> "PipelinedLoweredModule":
     """Lower a torch module with its repeated-block chain pipelined over
     ``num_stages`` (the ``pp`` mesh degree).
 
+    ``schedule``/``virtual_stages`` pick the microbatch schedule
+    (``parallel/pipeline.py``): ``"interleaved"`` assigns each pp rank
+    ``virtual_stages`` non-contiguous block chunks for the smaller
+    (S-1)/(v·M+S-1) bubble; block count must then divide by S·v.
+
     Raises ``TorchLoweringError`` when the module has no pipelineable
     structure (no repeated container, blocks not a linear single-input chain,
-    or block count not divisible by ``num_stages``) — callers fall back to
-    plain GSPMD lowering with a loud warning.
+    or block count not divisible by ``num_stages`` x ``virtual_stages``) —
+    callers fall back to plain GSPMD lowering with a loud warning.
     """
     candidates = find_repeated_containers(module)
     if not candidates:
@@ -882,7 +901,8 @@ def lower_module_pipelined(
     for container, n_blocks in candidates:
         try:
             return _pipeline_container(
-                module, container, n_blocks, num_stages, num_micro_batches
+                module, container, n_blocks, num_stages, num_micro_batches,
+                schedule=schedule, virtual_stages=virtual_stages,
             )
         except TorchLoweringError as e:
             errors.append(f"{container!r}: {e}")
@@ -949,13 +969,15 @@ def _block_graph_signature(module, graph_module=None):
 
 
 def _pipeline_container(
-    module, container: str, n_blocks: int, num_stages: int, num_micro_batches: int
+    module, container: str, n_blocks: int, num_stages: int, num_micro_batches: int,
+    schedule: str = "gpipe", virtual_stages: int = 1
 ) -> "PipelinedLoweredModule":
     import torch
 
-    if n_blocks % num_stages:
+    if n_blocks % (num_stages * virtual_stages):
         raise TorchLoweringError(
-            f"{n_blocks} blocks not divisible by pp={num_stages}"
+            f"{n_blocks} blocks not divisible by pp x virtual_stages = "
+            f"{num_stages} x {virtual_stages}"
         )
 
     block_prefixes = [f"{container}.{i}" for i in range(n_blocks)]
@@ -1054,6 +1076,8 @@ def _pipeline_container(
         block_lowered=block_lowered,
         num_stages=num_stages,
         num_micro_batches=num_micro_batches,
+        schedule=schedule,
+        virtual_stages=virtual_stages,
     )
 
 

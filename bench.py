@@ -718,6 +718,147 @@ def _zero_probe() -> dict:
     }
 
 
+def _pp_probe() -> dict:
+    """Pipeline-schedule micro-benchmark on a forced 8-device CPU mesh
+    (parallel/pipeline.py): gpipe vs interleaved (v=2) at the SAME microbatch
+    count M, both through the FUSED pp train step — steps/s, dispatches/step
+    via the telemetry counter delta, the analytic tick/bubble numbers, and
+    the REALIZED bubble of each arm.  Two realized-bubble views: (a)
+    ``measured_bubble_fraction`` = 1 - t_dense/t_arm against a dense (no-pp)
+    fused step on the same mesh size — on a serializing CPU backend step
+    time tracks total layer work, so this is exactly the wasted-work share
+    the analytic (S-1)/(v·M+S-1) predicts; (b) the profile-scanner idle-gap
+    share of the step window (``idle_fraction``) from a bounded
+    ``jax.profiler`` capture — near zero on CPU (the collective-pipelining
+    formulation burns bubble as garbage compute, not idle), the view that
+    becomes load-bearing on a real TPU slice.  The dispatch count and the
+    bubble/tick ratios are what transfer to TPU; CPU absolute steps/s do
+    not."""
+    import tempfile
+
+    import jax
+    import optax
+
+    from accelerate_tpu import telemetry
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.models import llama
+    from accelerate_tpu.parallel.pipeline import (
+        pipeline_bubble_fraction,
+        pipeline_llama_model,
+        pipeline_ticks,
+    )
+    from accelerate_tpu.parallel.sharding import data_sharding
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.telemetry import profile_scan
+    from accelerate_tpu.utils.dataclasses import ParallelismConfig, PipelineParallelPlugin
+
+    PP = 4
+    M = 4
+    V = 2
+    STEPS = 4
+    tel = telemetry.enable(dir=tempfile.mkdtemp(prefix="atpu_bench_pp_"))
+    dispatches = tel.registry.counter("pipeline.dispatches")
+    cfg = llama.LlamaConfig.tiny(num_layers=8, hidden_size=64, intermediate_size=128)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, (32, 64)).astype(np.int32)
+
+    def arm(schedule, v):
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        if schedule == "dense":
+            acc = Accelerator(parallelism_config=ParallelismConfig(dp=jax.device_count()))
+            from accelerate_tpu.accelerator import JaxModel
+
+            params = llama.init_params(cfg, jax.random.key(0))
+            model = JaxModel(
+                lambda p, input_ids: {"loss": llama.loss_fn(p, {"input_ids": input_ids}, cfg)},
+                params,
+                partition_rules=llama.PARTITION_RULES,
+            )
+            model, opt = acc.prepare(model, optax.adamw(1e-3))
+        else:
+            acc = Accelerator(
+                parallelism_config=ParallelismConfig(pp=PP, dp=max(jax.device_count() // PP, 1)),
+                pp_plugin=PipelineParallelPlugin(
+                    pp_size=PP, num_micro_batches=M, schedule=schedule, virtual_stages=v
+                ),
+            )
+            params = llama.init_params(cfg, jax.random.key(0))
+            model, opt = acc.prepare(pipeline_llama_model(params, cfg), optax.adamw(1e-3))
+        step_fn = acc.make_train_step(model, opt)
+        batches = [
+            {"input_ids": jax.device_put(tokens, data_sharding(acc.mesh))}
+            for _ in range(STEPS)
+        ]
+        float(np.asarray(step_fn(batches[0])))  # warmup: compiles
+        d0 = dispatches.value
+        t0 = time.perf_counter()
+        for b in batches[1:]:
+            float(np.asarray(step_fn(b)))
+        jax.block_until_ready(model.params)
+        dt = time.perf_counter() - t0
+        per_step_dispatch = (dispatches.value - d0) / (STEPS - 1)
+        # Untimed traced replay: the idle-share audit must not tax the
+        # steps/s measurement (or the dispatch tally) it rides along with.
+        idle_fraction = None
+        if schedule != "dense":
+            trace_dir = tempfile.mkdtemp(prefix=f"atpu_bench_pp_{schedule}_")
+            jax.profiler.start_trace(trace_dir)
+            try:
+                for b in batches[1:]:
+                    float(np.asarray(step_fn(b)))
+                jax.block_until_ready(model.params)
+            finally:
+                jax.profiler.stop_trace()
+            try:
+                report = profile_scan.analyze_trace_dir(trace_dir)
+                idle_fraction = report.step_bubble_fraction()
+                if idle_fraction is None:
+                    idle_fraction = report.bubble_fraction
+            except Exception as e:
+                idle_fraction = f"scan failed: {str(e)[:120]}"
+        return {
+            "schedule": schedule,
+            "virtual_stages": v,
+            "steps_per_s": round((STEPS - 1) / dt, 2),
+            "step_ms": round(dt / (STEPS - 1) * 1e3, 1),
+            "dispatches_per_step": per_step_dispatch,
+            "pp_active": step_fn.pp_active,
+            "idle_fraction": idle_fraction,
+        }
+
+    dense = arm("dense", 1)
+    gpipe = arm("gpipe", 1)
+    inter = arm("interleaved", V)
+    for block, v in ((gpipe, 1), (inter, V)):
+        block["analytic_ticks"] = pipeline_ticks(PP, M, v)
+        block["analytic_bubble_fraction"] = round(pipeline_bubble_fraction(PP, M, v), 4)
+        # On the serializing CPU backend step time tracks total layer work,
+        # so the dense fused step is the zero-bubble reference: the excess
+        # over it IS the schedule's wasted-work (bubble) share.
+        block["measured_bubble_fraction"] = round(
+            max(0.0, 1.0 - dense["step_ms"] / max(block["step_ms"], 1e-9)), 4
+        )
+    return {
+        "pp": {
+            "devices": jax.device_count(),
+            "pp_degree": PP,
+            "micro_batches": M,
+            "optimizer_steps": STEPS - 1,
+            "dense_reference": dense,
+            "gpipe": gpipe,
+            "interleaved": inter,
+            "interleaved_vs_gpipe_ratio": round(
+                inter["steps_per_s"] / max(gpipe["steps_per_s"], 1e-9), 3
+            ),
+            "bubble_reduction": round(
+                gpipe["measured_bubble_fraction"] - inter["measured_bubble_fraction"], 4
+            ),
+        }
+    }
+
+
 def _profile_probe() -> dict:
     """Trace-driven overlap audit of the ZeRO fused step on a forced 8-device
     CPU mesh (telemetry/profile_scan.py): captures a bounded ``jax.profiler``
@@ -1047,6 +1188,10 @@ def _run_zero_probe_subprocess(timeout_s: float = 240.0):
     return _run_probe_subprocess("zero", timeout_s, force_devices=8)
 
 
+def _run_pp_probe_subprocess(timeout_s: float = 360.0):
+    return _run_probe_subprocess("pp", timeout_s, force_devices=8)
+
+
 def _run_profile_probe_subprocess(timeout_s: float = 240.0):
     return _run_probe_subprocess("profile", timeout_s, force_devices=8)
 
@@ -1134,6 +1279,9 @@ def main():
         return
     if "--zero-probe" in sys.argv:
         print(json.dumps(_zero_probe()))
+        return
+    if "--pp-probe" in sys.argv:
+        print(json.dumps(_pp_probe()))
         return
     if "--profile-probe" in sys.argv:
         print(json.dumps(_profile_probe()))
@@ -1433,6 +1581,16 @@ def main():
         zero_block = zero_probe["zero"] if zero_probe else {"status": zero_err}
         print(f"# zero probe: {zero_block}", file=sys.stderr, flush=True)
 
+    # Pipeline-schedule probe (parallel/pipeline.py): gpipe vs interleaved
+    # fused pp steps at fixed M on a forced 8-device CPU mesh — steps/s,
+    # dispatches/step, analytic + measured (profile-scanner idle share)
+    # bubble fractions.  CPU subprocess, never zeroes the headline.
+    pp_block = None
+    if os.environ.get("BENCH_PP_PROBE", "1") != "0":
+        pp_probe, pp_err = _run_pp_probe_subprocess()
+        pp_block = pp_probe["pp"] if pp_probe else {"status": pp_err}
+        print(f"# pp probe: {pp_block}", file=sys.stderr, flush=True)
+
     # Trace-attribution probe (telemetry/profile_scan.py): exposed-collective
     # ms + realized overlap of the ZeRO fused step from a bounded jax.profiler
     # capture on a forced 8-device CPU mesh.  CPU subprocess, never zeroes the
@@ -1476,6 +1634,8 @@ def main():
         detail["health"] = health_block
     if zero_block is not None:
         detail["zero"] = zero_block
+    if pp_block is not None:
+        detail["pp"] = pp_block
     if profile_block is not None:
         detail["profile"] = profile_block
     if serving_block is not None:
@@ -1530,6 +1690,7 @@ if __name__ == "__main__":
             "--pipeline-probe",
             "--health-probe",
             "--zero-probe",
+            "--pp-probe",
             "--profile-probe",
             "--serving-probe",
         )

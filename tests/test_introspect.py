@@ -392,3 +392,54 @@ def test_report_renders_comms_block():
     assert "all-reduce" in text and "dp=4.1K B" in text
     assert "comms/compute ratio 0.250" in text
     assert "LINT[implicit-reshard]" in text
+
+
+def test_while_trip_count_unrolls_executed_bytes():
+    """unroll_loops=True multiplies in-loop collective bytes by the while
+    trip count (XLA's known_trip_count backend config), including nested
+    loops; the static default is unchanged."""
+    hlo = """\
+%inner_body (p: (s32[], f32[16])) -> (s32[], f32[16]) {
+  %cp.inner = f32[16]{0} collective-permute(f32[16]{0} %y), source_target_pairs={{0,1},{1,0}}
+}
+
+%outer_body (q: (s32[], f32[16])) -> (s32[], f32[16]) {
+  %cp.outer = f32[16]{0} collective-permute(f32[16]{0} %z), source_target_pairs={{0,1},{1,0}}
+  %while.inner = (s32[], f32[16]) while((s32[], f32[16]) %t), condition=%inner_cond, body=%inner_body, backend_config={"known_trip_count":{"n":"3"}}
+}
+
+ENTRY %main (a: f32[16]) -> f32[16] {
+  %cp.top = f32[16]{0} collective-permute(f32[16]{0} %a), source_target_pairs={{0,1},{1,0}}
+  %while.outer = (s32[], f32[16]) while((s32[], f32[16]) %u), condition=%outer_cond, body=%outer_body, backend_config={"known_trip_count":{"n":"5"}}
+}
+"""
+    ops = hlo_scan.parse_collectives(hlo, trip_counts=True)
+    assert [op.trip_count for op in ops] == [3 * 5, 5, 1]
+    # The default (static) parse skips the multiplier pass entirely.
+    assert [op.trip_count for op in hlo_scan.parse_collectives(hlo)] == [1, 1, 1]
+    static = hlo_scan.scan_hlo(hlo)
+    assert static.by_kind["collective-permute"]["bytes"] == 3 * 16 * 4
+    unrolled = hlo_scan.scan_hlo(hlo, unroll_loops=True)
+    assert unrolled.by_kind["collective-permute"]["bytes"] == (15 + 5 + 1) * 16 * 4
+
+
+def test_while_trip_count_from_condition_compare():
+    """Without known_trip_count, the trip count falls back to the condition
+    computation's constant-vs-induction-variable compare (LT -> N)."""
+    hlo = """\
+%cond (c: (s32[], f32[16])) -> pred[] {
+  %limit = s32[] constant(7)
+  ROOT %lt = pred[] compare(s32[] %i, s32[] %limit), direction=LT
+}
+
+%body (p: (s32[], f32[16])) -> (s32[], f32[16]) {
+  %cp = f32[16]{0} collective-permute(f32[16]{0} %y), source_target_pairs={{0,1},{1,0}}
+}
+
+ENTRY %main (a: f32[16]) -> f32[16] {
+  %while.1 = (s32[], f32[16]) while((s32[], f32[16]) %u), condition=%cond, body=%body
+}
+"""
+    ops = hlo_scan.parse_collectives(hlo, trip_counts=True)
+    assert [op.trip_count for op in ops] == [7]
+    assert ops[0].executed_bytes == 7 * 16 * 4

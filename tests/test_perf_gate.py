@@ -14,6 +14,7 @@ from accelerate_tpu.pipeline.perf_gate import (
     evaluate,
     load_baseline,
     run_gate,
+    run_pp_probe,
     run_probe,
 )
 
@@ -70,7 +71,7 @@ def test_gate_fails_when_fused_path_degraded(monkeypatch):
     """Forcing the fused arm onto the eager loop must trip the gate — the
     dispatches/step integer jumps to 3 x accum, immune to timing noise."""
     monkeypatch.setenv("ACCELERATE_TPU_PERF_GATE_DEGRADE", "eager")
-    measurements = run_probe(accum=2, steps=4, dim=64, batch=8, epochs=1, prefetch=0)
+    measurements = run_probe(accum=2, steps=4, dim=64, batch=8, epochs=1, prefetch=0, pp=False)
     assert measurements["probe"]["degrade"] == "eager"
     assert measurements["dispatches_per_step"] == 6.0
     failures = evaluate(measurements, load_baseline())
@@ -122,7 +123,7 @@ def test_gate_fails_when_zero_silently_falls_back(monkeypatch):
     """ACCELERATE_TPU_PERF_GATE_DEGRADE=zero-fallback runs the ZeRO arm with
     the replicated update — the zero_active tripwire must fail the gate."""
     monkeypatch.setenv("ACCELERATE_TPU_PERF_GATE_DEGRADE", "zero-fallback")
-    measurements = run_probe(accum=2, steps=4, dim=64, batch=8, epochs=1, prefetch=0)
+    measurements = run_probe(accum=2, steps=4, dim=64, batch=8, epochs=1, prefetch=0, pp=False)
     assert measurements["zero_active"] is False
     failures = evaluate(measurements, load_baseline())
     assert any("silently fell back" in f for f in failures)
@@ -136,7 +137,69 @@ def test_gate_fails_when_overlap_stripped(monkeypatch):
     construction and the overlap row must fail the gate.  Probe-level
     self-test; the cheap evaluate()-level row tests run in tier-1."""
     monkeypatch.setenv("ACCELERATE_TPU_PERF_GATE_DEGRADE", "no-overlap")
-    measurements = run_probe(accum=2, steps=4, dim=64, batch=8, epochs=1, prefetch=0)
+    measurements = run_probe(accum=2, steps=4, dim=64, batch=8, epochs=1, prefetch=0, pp=False)
     assert measurements["zero_exposed_collective_frac"] == 1.0
     failures = evaluate(measurements, load_baseline())
     assert any("exposed-collective fraction" in f for f in failures)
+
+
+# ---------------------------------------------------------------------------
+# pp row (PR 11): fused pipeline-parallel step + interleaved schedule
+# ---------------------------------------------------------------------------
+
+
+def _passing_pp_measurements():
+    return dict(
+        _passing_measurements(),
+        pp_dispatches_per_step=1.0,
+        pp_interleaved_active=True,
+        pp_interleaved_vs_gpipe_ratio=1.1,
+        pp_gpipe_ticks=5,
+        pp_interleaved_ticks=9,
+    )
+
+
+def test_evaluate_pp_row_thresholds():
+    baseline = load_baseline()
+    assert baseline["max_pp_dispatches_per_step"] == 1.0
+    assert baseline["require_pp_interleaved"] is True
+    assert baseline["min_interleaved_vs_gpipe_ratio"] > 0
+    assert evaluate(_passing_pp_measurements(), baseline) == []
+    m = dict(_passing_pp_measurements(), pp_interleaved_active=False)
+    assert any("fell back to gpipe" in f for f in evaluate(m, baseline))
+    m = dict(_passing_pp_measurements(), pp_dispatches_per_step=9.0)
+    assert any("pp dispatches" in f for f in evaluate(m, baseline))
+    m = dict(_passing_pp_measurements(), pp_interleaved_vs_gpipe_ratio=0.4)
+    assert any("interleaved-vs-gpipe" in f for f in evaluate(m, baseline))
+    # Single-device probe: the pp arm was skipped — no pp judgments at all.
+    assert evaluate(_passing_measurements(), baseline) == []
+
+
+def test_pp_probe_fused_one_dispatch_and_interleaved_wins_ticks():
+    """The real pp probe inside tier-1: the fused pipeline-parallel train
+    step must be exactly 1 dispatch per optimizer step for BOTH schedules,
+    the interleaved schedule must actually build (tick count v*M + S - 1 <
+    the gpipe-equal-work v*(M+S-1)), and the analytic bubble must shrink."""
+    row = run_pp_probe(steps=3)
+    assert row["pp_dispatches_per_step"] == 1.0
+    assert row["pp_gpipe_dispatches_per_step"] == 1.0
+    assert row["pp_active"] is True
+    assert row["pp_interleaved_active"] is True
+    v, M, S = row["pp_virtual_stages"], row["pp_micro_batches"], row["pp_degree"]
+    assert row["pp_gpipe_ticks"] == M + S - 1
+    assert row["pp_interleaved_ticks"] == v * M + S - 1 < v * (M + S - 1)
+    assert row["pp_analytic_bubble_interleaved"] < row["pp_analytic_bubble_gpipe"]
+    assert evaluate(
+        dict(_passing_measurements(), **row), load_baseline()
+    ) == []
+
+
+def test_pp_row_fails_when_gpipe_only_degraded(monkeypatch):
+    """ACCELERATE_TPU_PERF_GATE_DEGRADE=gpipe-only runs the interleaved arm
+    on the gpipe schedule — the pp_interleaved_active tripwire must fail the
+    row (the proof the gate catches a silently-degraded schedule)."""
+    monkeypatch.setenv("ACCELERATE_TPU_PERF_GATE_DEGRADE", "gpipe-only")
+    row = run_pp_probe(steps=2)
+    assert row["pp_interleaved_active"] is False
+    failures = evaluate(dict(_passing_measurements(), **row), load_baseline())
+    assert any("fell back to gpipe" in f for f in failures)
