@@ -735,6 +735,9 @@ class Accelerator:
         # enable_health_guard() opts in (the in-program zero-delta gate on
         # non-finite updates is always on — it rides the existing dispatch).
         self._health_guard = None
+        # Elastic resume record: what the last resume_from_latest() actually
+        # did (resharded? recomputed skip geometry?) — ElasticResumeInfo.
+        self.last_resume_info = None
         self._pending_checkpoint_finalize = None
         self.trackers: list = []
         self.log_with = log_with if isinstance(log_with, (list, tuple)) else ([log_with] if log_with else [])
@@ -1376,7 +1379,14 @@ class Accelerator:
             raise ValueError("pass either a ServingConfig or its fields, not both")
         if serving is None:
             serving = ServingConfig(**serving_kwargs)
-        return ServingEngine(apply_cached, init_cache, params, config, serving=serving)
+        engine = ServingEngine(apply_cached, init_cache, params, config, serving=serving)
+        # Graceful drain: an installed PreemptionGuard (enable_preemption_
+        # handling) makes the engine stop admission and requeue-journal the
+        # in-flight requests when the preemption signal arrives, instead of
+        # dying mid-dispatch with work in the queue.
+        if self._preemption_guard is not None:
+            engine.install_preemption_guard(self._preemption_guard)
+        return engine
 
     @_span("accelerator.backward")
     def backward(self, loss, **kwargs):
@@ -1799,22 +1809,79 @@ class Accelerator:
         scheduler/RNG/dataloader position via ``load_state`` and returns the
         step recorded at save time (``save_state(..., step=N)`` /
         ``check_preemption(step=N)``), 0 when the checkpoint carries no step,
-        or None when no complete checkpoint exists."""
+        or None when no complete checkpoint exists.
+
+        **Elastic**: a checkpoint saved under a different topology (mesh
+        shape, world size, ZeRO layout) legally lands on the current mesh —
+        the manifest's topology record is validated leaf-by-leaf, every leaf
+        re-places onto the live sharding (GSPMD relayout), RNG streams fold
+        for new ranks, and the ``skip_first_batches`` count is recomputed for
+        the live global-batch split.  Pipeline stage-count changes are
+        rejected with :class:`~accelerate_tpu.resilience.ElasticTopologyError`.
+        Details of what happened land on ``self.last_resume_info``
+        (:class:`~accelerate_tpu.resilience.elastic.ElasticResumeInfo`);
+        legacy topology-less checkpoints resume on a warned best-effort path
+        identical to the pre-elastic behavior."""
+        from .resilience import elastic
         from .resilience.manifest import find_latest_complete, read_manifest
 
         root = checkpoint_dir or os.path.join(self.project_dir or ".", "checkpoints")
         ckpt = find_latest_complete(root)
         if ckpt is None:
             return None
+        manifest = read_manifest(ckpt) or {}
+        topology = manifest.get(elastic.TOPOLOGY_KEY)
+        step = manifest.get("step")
+        resumed_step = int(step) if step is not None else 0
+
+        plan = None
+        skip_batches = None
+        if topology is None:
+            from .logging import get_logger
+
+            get_logger(__name__).warning(
+                f"checkpoint {ckpt!r} carries no topology record (pre-elastic "
+                "save): resuming best-effort, assuming it was saved under the "
+                "current mesh — cross-topology state cannot be validated."
+            )
+        else:
+            # Plan + validate + recompute the loader geometry BEFORE anything
+            # is restored: an illegal reshape (pp change, leaf mismatch,
+            # non-divisible global-batch split) must fail with the live state
+            # untouched.  load_state re-runs plan/validate cheaply (pure
+            # metadata) so direct load_state callers get the same guard.
+            plan = elastic.plan_resume(topology, self)
+            elastic.validate_leaves(topology, self)
+            live_gb = None
+            for dl in self._dataloaders:
+                try:
+                    live_gb = int(dl.total_batch_size)
+                except Exception:
+                    live_gb = None
+                break
+            # Same-geometry resumes keep the stateful-loader/sampler position
+            # restored by load_state — only a changed global batch needs the
+            # recomputed skip (whole-epoch math is the caller's loop).
+            if plan.saved_global_batch is not None and live_gb is not None and (
+                plan.saved_global_batch != live_gb
+            ):
+                skip_batches = elastic.recompute_skip_batches(
+                    resumed_step, plan.saved_global_batch, live_gb
+                )
         self.load_state(ckpt, verify=verify)
         # Automatic naming must not overwrite the checkpoint we just resumed
         # from on the next save.
         tail = os.path.basename(ckpt).rsplit("_", 1)[-1]
         if os.path.basename(ckpt).startswith("checkpoint_") and tail.isdigit():
             self.project_configuration.iteration = int(tail) + 1
-        manifest = read_manifest(ckpt) or {}
-        step = manifest.get("step")
-        return int(step) if step is not None else 0
+        self.last_resume_info = elastic.ElasticResumeInfo(
+            step=resumed_step,
+            checkpoint=ckpt,
+            plan=plan,
+            legacy=topology is None,
+            skip_batches=skip_batches,
+        )
+        return resumed_step
 
     def enable_health_guard(
         self,

@@ -673,13 +673,34 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, **save
             for opt in accelerator._optimizers
         ]
 
+        # Full topology record (elastic resume): mesh axes/degrees, per-leaf
+        # layout of params + opt state, pipeline stage geometry, RNG stream
+        # count, and the global batch each loader fed — everything
+        # load/resume needs to legally land this checkpoint on a DIFFERENT
+        # mesh (resilience/elastic.py).  Capture failures degrade to a
+        # topology-less (legacy) manifest rather than failing the save.
+        manifest_extra: dict = {}
+        if opt_layouts:
+            manifest_extra["opt_state_layout"] = opt_layouts
+        try:
+            from .resilience import elastic as _elastic
+
+            manifest_extra[_elastic.TOPOLOGY_KEY] = _elastic.capture_topology(
+                accelerator, step=step
+            )
+        except Exception as e:
+            logger.warning(
+                f"could not capture checkpoint topology record ({type(e).__name__}: "
+                f"{e}); the checkpoint saves without one (legacy resume path)"
+            )
+
         def _publish_io():
             from .resilience.manifest import fsync_dir, fsync_enabled, write_manifest
 
             write_manifest(
                 staging_dir,
                 step=step,
-                extra={"opt_state_layout": opt_layouts} if opt_layouts else None,
+                extra=manifest_extra or None,
             )
             # Overwriting an existing final dir: move it aside FIRST (one
             # metadata op), swing staging in, then delete the old tree.  The
@@ -810,6 +831,40 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None, **load_
 
         manifest = read_manifest(input_dir) or {}
 
+    # Topology record (elastic resume): when the manifest carries one, the
+    # checkpoint may legally land on a DIFFERENT mesh — the payload is the
+    # gathered host form and every leaf re-places onto the live sharding
+    # (GSPMD relayout).  Validate leaf-by-leaf BEFORE restoring anything so a
+    # wrong-model or wrong-pipeline resume fails with the offending leaves
+    # named, and surface cross-topology migrations as an `elastic.reshard`
+    # event.  Topology-less (pre-elastic) checkpoints take the legacy path
+    # below byte-for-byte unchanged.
+    topology = manifest.get("topology") if isinstance(manifest, dict) else None
+    elastic_plan = None
+    if topology is not None:
+        from .resilience import elastic as _elastic
+
+        elastic_plan = _elastic.plan_resume(topology, accelerator)
+        _elastic.validate_leaves(topology, accelerator)
+        if elastic_plan.changed:
+            logger.warning(
+                f"elastic resume: checkpoint {input_dir!r} was saved under a "
+                f"different topology ({'; '.join(elastic_plan.changes)}); leaves "
+                "re-place onto the live mesh via GSPMD relayout."
+            )
+            from .telemetry import get_telemetry
+
+            tel = get_telemetry()
+            if tel.enabled:
+                tel.registry.counter("elastic.reshards").inc()
+                tel.event(
+                    "elastic.reshard",
+                    checkpoint=input_dir,
+                    changes=list(elastic_plan.changes),
+                    saved_mesh=elastic_plan.saved_mesh,
+                    live_mesh=elastic_plan.live_mesh,
+                )
+
     # Opt-state layout record: the saved payload is the gathered host form,
     # so resuming a ZeRO (dp-sharded) checkpoint with ZeRO off — or the
     # reverse — is supported; load_state_dict re-places each leaf onto
@@ -865,6 +920,7 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None, **load_
                 sched.load_state_dict(pickle.load(f))
     from .data_loader import SeedableRandomSampler
 
+    saved_loader_batches = list(((topology or {}).get("data") or {}).get("loader_batches") or [])
     for i, dl in enumerate(accelerator._dataloaders):
         name = f"{SAMPLER_NAME}.bin" if i == 0 else f"{SAMPLER_NAME}_{i}.bin"
         path = os.path.join(input_dir, name)
@@ -878,13 +934,57 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None, **load_
             input_dir, "dl_state_dict.bin" if i == 0 else f"dl_state_dict_{i}.bin"
         )
         if os.path.exists(dl_path) and getattr(dl, "use_stateful_dataloader", False):
-            with open(dl_path, "rb") as f:
-                dl.load_state_dict(pickle.load(f))
+            # A stateful loader's position is measured in BATCHES of the
+            # saved geometry.  When the global batch changed across the
+            # resume (elastic topology change), restoring that position
+            # would land mid-stream at the wrong example — skip it and let
+            # resume_from_latest's recomputed skip_first_batches geometry
+            # place the loader instead.
+            saved_b = saved_loader_batches[i] if i < len(saved_loader_batches) else None
+            try:
+                live_b = int(dl.total_batch_size)
+            except Exception:
+                live_b = None
+            if saved_b is not None and live_b is not None and saved_b != live_b:
+                # Give direct load_state() callers the actionable number here
+                # (resume_from_latest also lands it on last_resume_info, but
+                # this path must stand alone).
+                from .resilience import elastic as _elastic2
+
+                try:
+                    skip = _elastic2.recompute_skip_batches(
+                        manifest.get("step"), saved_b, live_b
+                    )
+                except _elastic2.ElasticTopologyError as e:
+                    logger.warning(
+                        f"dataloader {i}: saved stateful position is in global-"
+                        f"batch-{saved_b} units but the live loader feeds {live_b}, "
+                        f"and the consumed examples do not land on a new-batch "
+                        f"boundary ({e}); the mid-epoch position is LOST — the "
+                        "loader restarts the epoch."
+                    )
+                else:
+                    hint = (
+                        f"re-place it with skip_first_batches(dl, {skip})"
+                        if skip is not None
+                        else "the checkpoint records no step, so the position "
+                        "cannot be recomputed"
+                    )
+                    logger.warning(
+                        f"dataloader {i}: saved stateful position is in global-"
+                        f"batch-{saved_b} units but the live loader feeds {live_b}; "
+                        f"skipping the stateful restore — {hint}."
+                    )
+            else:
+                with open(dl_path, "rb") as f:
+                    dl.load_state_dict(pickle.load(f))
     for i, obj in enumerate(accelerator._custom_objects):
         load_custom_state(obj, input_dir, i)
 
-    rng_path = os.path.join(input_dir, f"random_states_{accelerator.state.process_index}.pkl")
-    if os.path.exists(rng_path):
-        with open(rng_path, "rb") as f:
-            _restore_rng_state(pickle.load(f))
+    # RNG restore: the per-rank bundle when saved; on an elastic world-size
+    # GROWTH the extra ranks fold a deterministic stream from rank 0's bundle
+    # (legacy checkpoints keep today's behavior: missing file, no restore).
+    from .resilience.elastic import restore_rng_for_rank
+
+    restore_rng_for_rank(input_dir, accelerator.state.process_index, topology)
     logger.info(f"Loaded accelerator state from {input_dir}")

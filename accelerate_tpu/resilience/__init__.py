@@ -34,6 +34,19 @@ pieces here make the training loop survive those (see
   RESOURCE_EXHAUSTED, NaN-poisoned gradients at step K, a NaN-laced batch)
   that ``make resilience-smoke`` / ``make health-smoke`` use to prove
   kill-and-resume and skip/rewind give bit-exact loss continuation.
+- **elastic topology resume** (``elastic.py``) — every verified checkpoint
+  manifest records the full save topology (mesh axes/degrees, per-leaf
+  sharding layout of params + opt state, pipeline geometry, RNG streams,
+  global batch); ``resume_from_latest`` validates it leaf-by-leaf and lands
+  the checkpoint on a *different* mesh (dp=8 → dp=4, dp → dp×fsdp, ZeRO
+  on↔off) via GSPMD relayout, with RNG-stream folding and
+  ``skip_first_batches`` geometry recomputed for the new global-batch split.
+  Pipeline stage-count changes are rejected loudly.
+- **chaos campaign** (``chaos.py``) — a seeded schedule of faults across
+  repeated kill→resume cycles that CHANGE the mesh shape between lives
+  (``make chaos-smoke``): every cycle must end with a manifest-complete
+  checkpoint, same-topology resumes stay bit-exact vs an unkilled run, and
+  cross-topology resumes load bit-identical state.
 
 Zero overhead when unused: no signal handlers are installed and no manifest
 hashing runs unless a guard is installed / a checkpoint is saved; hashing is
@@ -52,11 +65,33 @@ from .manifest import (
     verify_checkpoint,
     write_manifest,
 )
+from .elastic import (
+    ElasticPlan,
+    ElasticResumeInfo,
+    ElasticTopologyError,
+    capture_topology,
+    fold_rng_bundle,
+    plan_resume,
+    recompute_skip_batches,
+    reshard_tree,
+    state_digest,
+    validate_leaves,
+)
 from .health import HealthGuard, HealthVerdict, NumericalDivergenceError
 from .preemption import PreemptionGuard
 from .retry import RetryPolicy, retrying
 
 __all__ = [
+    "ElasticPlan",
+    "ElasticResumeInfo",
+    "ElasticTopologyError",
+    "capture_topology",
+    "plan_resume",
+    "validate_leaves",
+    "reshard_tree",
+    "fold_rng_bundle",
+    "recompute_skip_batches",
+    "state_digest",
     "HealthGuard",
     "HealthVerdict",
     "NumericalDivergenceError",

@@ -1,0 +1,534 @@
+"""Chaos campaign: seeded kill→resume cycles that change the mesh between lives.
+
+``make chaos-smoke`` (or ``python -m accelerate_tpu.resilience.chaos``) proves
+the elastic-resume story under hostile conditions.  A campaign is a seeded
+schedule of **lives**: each life is a fresh process that builds a mesh (whose
+shape may DIFFER from the previous life's), resumes from the newest
+manifest-complete checkpoint, trains, and dies from a scheduled fault drawn
+from the ``faultinject`` knobs:
+
+- ``sigterm`` — a real SIGTERM mid-run; the ``PreemptionGuard`` writes one
+  final verified checkpoint at the step boundary and the life exits cleanly;
+- ``torn_write`` — every checkpoint write fails from step K on (a dead
+  filesystem); the save exhausts its retries, the staging dir stays ``.tmp``,
+  and nothing torn is ever published;
+- ``oom`` — a synthetic RESOURCE_EXHAUSTED kills the life between steps;
+- ``nan`` — the gradients of one step are poisoned with NaN; the in-program
+  health gate skips the update (params bit-unchanged) and the life carries on.
+
+The parent asserts, across the whole campaign:
+
+1. **zero torn publishes** — every published checkpoint directory under the
+   shared root is manifest-complete (the atomic-save protocol held under
+   every fault);
+2. **bit-identical handoff** — each resumed life's post-load state digest
+   (params + opt state, host-gathered) equals the digest the previous life
+   recorded at its last successful save, ACROSS topology changes (dp=8 →
+   dp=4, dp → dp×fsdp, ZeRO on↔off);
+3. **same-topology bit-exactness** — lives running the reference topology
+   reproduce the unkilled reference run's losses bit-for-bit;
+4. **cross-topology tolerance** — lives on other meshes track the reference
+   losses within a small float tolerance (the global batch is fixed; only
+   reduction association changes) and stay finite;
+5. the final life completes the full step budget and leaves a verified
+   manifest-complete checkpoint.
+
+Every cycle emits a ``chaos.cycle`` telemetry event.  The schedule is fully
+deterministic for a given ``--seed`` (``plan_campaign``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import subprocess
+import sys
+import tempfile
+from dataclasses import asdict, dataclass
+from typing import Optional
+
+TOTAL_STEPS = 10
+CHILD_TIMEOUT_S = 600.0
+
+# Mesh shapes a life can wake up on.  Values are the env a child process
+# needs BEFORE importing jax (device count is forced via XLA_FLAGS).
+TOPOLOGIES = {
+    "dp8-zero": {
+        "devices": 8,
+        "env": {"ACCELERATE_PARALLELISM_DP": "8", "ACCELERATE_TPU_ZERO": "1"},
+    },
+    "dp8": {  # same mesh as the base, ZeRO off (layout-only migration)
+        "devices": 8,
+        "env": {"ACCELERATE_PARALLELISM_DP": "8", "ACCELERATE_TPU_ZERO": "0"},
+    },
+    "dp4": {
+        "devices": 4,
+        "env": {"ACCELERATE_PARALLELISM_DP": "4", "ACCELERATE_TPU_ZERO": "0"},
+    },
+    "dp2-fsdp2": {
+        "devices": 4,
+        "env": {
+            "ACCELERATE_PARALLELISM_DP": "2",
+            "ACCELERATE_PARALLELISM_FSDP": "2",
+            "ACCELERATE_USE_FSDP": "true",
+            # Keep the consolidated (manifest-verified) save path: the orbax
+            # SHARDED_STATE_DICT export is its own resharding story.
+            "FSDP_STATE_DICT_TYPE": "FULL_STATE_DICT",
+            "ACCELERATE_TPU_ZERO": "0",
+        },
+    },
+    "dp2-zero": {
+        "devices": 2,
+        "env": {"ACCELERATE_PARALLELISM_DP": "2", "ACCELERATE_TPU_ZERO": "1"},
+    },
+}
+
+BASE_TOPOLOGY = "dp8-zero"
+FAULTS = ("sigterm", "torn_write", "oom", "nan")
+
+# |loss - ref| <= CROSS_TOL * max(1, |ref|) for cross-topology lives: the
+# global batch is fixed, so only the reduction association (psum tree shape)
+# differs between dp degrees — ulp-scale on this f32 toy.
+CROSS_TOL = 1e-3
+
+
+@dataclass
+class Cycle:
+    """One planned life of the campaign."""
+
+    life: int
+    topology: str
+    fault: Optional[str]  # None = runs to completion
+    fault_step: Optional[int]
+    expect_resume: int  # step the NEXT life should land on
+
+
+def plan_campaign(seed: int, total_steps: int = TOTAL_STEPS) -> list[Cycle]:
+    """Deterministic seeded schedule: life 0 and 1 run the base topology
+    (the same-topology bit-exact pair), later lives draw CHANGED meshes (at
+    least two distinct changes), faults are drawn seeded with ``nan`` riding
+    the final, completing life (a NaN-skipped update forks the trajectory,
+    so it must not sit upstream of the bit-exactness oracle)."""
+    import random
+
+    rnd = random.Random(seed)
+    cycles: list[Cycle] = []
+
+    k0 = rnd.randint(2, 3)
+    cycles.append(Cycle(0, BASE_TOPOLOGY, "sigterm", k0, expect_resume=k0))
+
+    mid_faults = ["torn_write", "oom"]
+    rnd.shuffle(mid_faults)
+    k1 = cycles[-1].expect_resume + rnd.randint(2, 3)
+    cycles.append(
+        Cycle(1, BASE_TOPOLOGY, mid_faults[0], k1, expect_resume=k1 - 1)
+    )
+
+    # Draw only MESH-changing topologies for the later lives ("dp8" shares
+    # the base mesh — it exists for the layout-only elastic-smoke arm).
+    others = ["dp4", "dp2-fsdp2", "dp2-zero"]
+    rnd.shuffle(others)
+    k2 = min(cycles[-1].expect_resume + rnd.randint(2, 3), total_steps - 2)
+    cycles.append(Cycle(2, others[0], mid_faults[1], k2, expect_resume=k2 - 1))
+
+    k3 = min(cycles[-1].expect_resume + rnd.randint(1, 2), total_steps - 1)
+    cycles.append(Cycle(3, others[1], "nan", k3, expect_resume=total_steps))
+    return cycles
+
+
+# ---------------------------------------------------------------------------
+# The life (child-process role) — shared with elastic_smoke
+# ---------------------------------------------------------------------------
+
+
+def build_recipe(ckpt_root: str, total_limit: Optional[int] = 3):
+    """One deterministic training recipe every life (and the reference run)
+    shares: a toy two-leaf model through ``prepare`` + the fused
+    ``make_train_step`` (ZeRO from ``ACCELERATE_TPU_ZERO``), automatic
+    checkpoint naming under ``ckpt_root``, preemption handling installed.
+    The global batch is FIXED at 16 examples regardless of mesh shape, so
+    per-step math is identical across topologies up to reduction
+    association."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ..accelerator import Accelerator, JaxModel
+    from ..utils import ProjectConfiguration
+
+    acc = Accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=ckpt_root,
+            automatic_checkpoint_naming=True,
+            total_limit=total_limit,
+        )
+    )
+    params = {
+        "w": jax.random.normal(jax.random.PRNGKey(0), (64, 32), jnp.float32) * 0.1,
+        "b": jax.random.normal(jax.random.PRNGKey(1), (32,), jnp.float32) * 0.1,
+    }
+
+    def apply_fn(p, x, y):
+        pred = jnp.tanh(x @ p["w"] + p["b"])
+        return {"loss": jnp.mean((pred - y) ** 2)}
+
+    model, opt = acc.prepare(JaxModel(apply_fn, params), optax.adam(1e-2))
+    acc.enable_preemption_handling()
+    return acc, model, opt
+
+
+def make_batch(acc, i: int):
+    """Step ``i``'s global batch — host values depend only on ``i``, then
+    placed under the LIVE mesh's data sharding (identical content on every
+    topology)."""
+    import jax
+    import numpy as np
+
+    from ..parallel.sharding import data_sharding
+
+    sh = data_sharding(acc.mesh)
+    x = np.asarray(jax.random.normal(jax.random.PRNGKey(100 + i), (16, 64)), np.float32)
+    y = np.asarray(jax.random.normal(jax.random.PRNGKey(200 + i), (16, 32)), np.float32)
+    return {"x": jax.device_put(x, sh), "y": jax.device_put(y, sh)}
+
+
+def run_life(
+    ckpt_root: str,
+    out_path: str,
+    total: int,
+    fault: Optional[str],
+    fault_step: Optional[int],
+    save_every: bool = True,
+) -> int:
+    """One life: resume (if a checkpoint exists), train, die on schedule.
+    Writes a JSON record the campaign parent asserts over.  The ``sigterm``
+    and ``nan`` faults arrive via environment armed by the parent
+    (signal/trace-time paths need that); ``torn_write`` and ``oom`` are
+    armed in-process at the scheduled step."""
+    import numpy as np
+
+    from . import faultinject
+    from .elastic import state_digest
+
+    acc, model, opt = build_recipe(ckpt_root)
+    if fault == "nan":
+        # The in-program health gate skips the poisoned update; generous
+        # skip budget so a single poisoned step never escalates to a rewind.
+        acc.enable_health_guard(optimizer=opt, max_skips=total)
+    step_fn = acc.make_train_step(model, opt, clip_norm=0.05)
+
+    start = 0
+    resumed = acc.resume_from_latest()
+    loaded_digest = None
+    resharded = False
+    if resumed is not None:
+        start = resumed
+        loaded_digest = state_digest(acc)
+        info = acc.last_resume_info
+        resharded = bool(info is not None and info.resharded)
+        print(f"# life resumed at step {start} (resharded={resharded})", file=sys.stderr)
+
+    losses: dict[str, float] = {}
+    digests: dict[str, str] = {}
+    skipped: list[int] = []
+    death = "completed"
+    for i in range(start, total):
+        step = i + 1
+        if fault == "oom" and fault_step is not None and step == fault_step:
+            os.environ["ACCELERATE_TPU_FAULT_OOM_ONCE"] = "1"
+            faultinject.reload()
+            try:
+                faultinject.maybe_oom()
+            except RuntimeError as e:
+                assert "RESOURCE_EXHAUSTED" in str(e)
+                death = "oom"
+                break
+        if fault == "torn_write" and fault_step is not None and step == fault_step:
+            os.environ["ACCELERATE_TPU_FAULT_WRITE_N"] = "1"
+            os.environ["ACCELERATE_TPU_FAULT_WRITE_STICKY"] = "1"
+            faultinject.reload()
+        loss = float(np.asarray(step_fn(make_batch(acc, i))))
+        losses[str(step)] = loss
+        verdict = acc.check_health(step=step)
+        if verdict.skipped:
+            skipped.append(step)
+        if save_every:
+            try:
+                acc.save_state(step=step)
+            except Exception as e:
+                print(f"# life save failed at step {step}: {e}", file=sys.stderr)
+                death = "save_failed"
+                break
+            digests[str(step)] = state_digest(acc)
+        if acc.check_preemption(step=step):
+            death = "sigterm"
+            break
+
+    record = {
+        "resumed_at": resumed,
+        "loaded_digest": loaded_digest,
+        "resharded": resharded,
+        "losses": losses,
+        "digests": digests,
+        "skipped_steps": skipped,
+        "death": death,
+        "last_step": start + len(losses),
+    }
+    with open(out_path, "w") as f:
+        json.dump(record, f)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Orchestration (parent)
+# ---------------------------------------------------------------------------
+
+
+def child_env(topology: str, extra: Optional[dict] = None) -> dict:
+    """Subprocess env for a life on ``topology`` (device count + mesh axes +
+    ZeRO are decided before jax imports, so they MUST come in via env)."""
+    spec = TOPOLOGIES[topology]
+    env = dict(os.environ)
+    for key in (
+        "ACCELERATE_PARALLELISM_DP",
+        "ACCELERATE_PARALLELISM_FSDP",
+        "ACCELERATE_USE_FSDP",
+        "FSDP_STATE_DICT_TYPE",
+        "ACCELERATE_TPU_ZERO",
+        "ACCELERATE_TPU_FAULT_SIGTERM_STEP",
+        "ACCELERATE_TPU_FAULT_NAN_STEP",
+    ):
+        env.pop(key, None)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": f"--xla_force_host_platform_device_count={spec['devices']}",
+            "ACCELERATE_TPU_CHECKPOINT_FSYNC": "0",
+            "ACCELERATE_TPU_COMPILE_CACHE": "",
+            "ACCELERATE_TPU_IO_RETRIES": "2",
+            "ACCELERATE_TPU_IO_RETRY_BASE_S": "0.01",
+            "ACCELERATE_TPU_SENTINEL_PROFILE": "0",
+        }
+    )
+    env.update(spec["env"])
+    env.update(extra or {})
+    return env
+
+
+def spawn_life(
+    ckpt_root: str,
+    out_path: str,
+    topology: str,
+    total: int,
+    fault: Optional[str] = None,
+    fault_step: Optional[int] = None,
+    save_every: bool = True,
+) -> dict:
+    extra = {}
+    if fault == "sigterm" and fault_step is not None:
+        extra["ACCELERATE_TPU_FAULT_SIGTERM_STEP"] = str(fault_step)
+    if fault == "nan" and fault_step is not None:
+        extra["ACCELERATE_TPU_FAULT_NAN_STEP"] = str(fault_step)
+    cmd = [
+        sys.executable, "-m", "accelerate_tpu.resilience.chaos",
+        "--role", "life", "--ckpt-root", ckpt_root, "--out", out_path,
+        "--total", str(total),
+    ]
+    if fault:
+        cmd += ["--fault", fault]
+    if fault_step is not None:
+        cmd += ["--fault-step", str(fault_step)]
+    if not save_every:
+        cmd += ["--no-save"]
+    proc = subprocess.run(
+        cmd, env=child_env(topology, extra), capture_output=True, text=True,
+        timeout=CHILD_TIMEOUT_S,
+    )
+    if proc.returncode != 0:
+        print(proc.stdout)
+        print(proc.stderr, file=sys.stderr)
+        raise RuntimeError(f"life on {topology} exited rc={proc.returncode}")
+    sys.stderr.write(proc.stderr)
+    with open(out_path) as f:
+        return json.load(f)
+
+
+def _assert_no_torn_publishes(ckpt_root: str) -> int:
+    """Every PUBLISHED checkpoint directory must be manifest-complete; torn
+    saves may only exist as `.tmp` staging.  Returns the published count."""
+    from .manifest import is_complete, list_checkpoints
+
+    base = os.path.join(ckpt_root, "checkpoints")
+    published = list_checkpoints(base)
+    torn = [d for d in published if not is_complete(d)]
+    assert not torn, f"torn checkpoints were PUBLISHED: {torn}"
+    return len(published)
+
+
+def run_campaign(seed: int, total_steps: int = TOTAL_STEPS, workdir: Optional[str] = None) -> dict:
+    """Run the full campaign; returns a summary dict (also asserts every
+    oracle along the way)."""
+    from ..telemetry import get_telemetry
+
+    work = workdir or tempfile.mkdtemp(prefix="atpu_chaos_")
+    os.makedirs(work, exist_ok=True)
+    root = os.path.join(work, "campaign")
+    os.makedirs(root, exist_ok=True)
+    cycles = plan_campaign(seed, total_steps)
+    changes = sum(
+        1 for a, b in zip(cycles, cycles[1:]) if a.topology != b.topology
+    )
+    assert changes >= 2, f"campaign plan must change topology >= 2 times, got {changes}"
+    tel = get_telemetry()
+
+    print(f"# chaos: reference run ({BASE_TOPOLOGY}, {total_steps} steps, no faults)", file=sys.stderr)
+    reference = spawn_life(
+        os.path.join(work, "reference"),
+        os.path.join(work, "reference.json"),
+        BASE_TOPOLOGY,
+        total_steps,
+        save_every=False,
+    )
+    assert reference["death"] == "completed" and reference["last_step"] == total_steps, reference
+
+    lives = []
+    prev: Optional[dict] = None
+    nan_skip_from = math.inf
+    for cyc in cycles:
+        print(
+            f"# chaos: life {cyc.life} on {cyc.topology}, fault={cyc.fault}@{cyc.fault_step}",
+            file=sys.stderr,
+        )
+        rec = spawn_life(
+            root,
+            os.path.join(work, f"life{cyc.life}.json"),
+            cyc.topology,
+            total_steps,
+            fault=cyc.fault,
+            fault_step=cyc.fault_step,
+        )
+        lives.append(rec)
+
+        # -- per-cycle oracles ------------------------------------------------
+        expected_death = {
+            "sigterm": "sigterm", "torn_write": "save_failed",
+            "oom": "oom", "nan": "completed", None: "completed",
+        }[cyc.fault]
+        assert rec["death"] == expected_death, (cyc, rec["death"])
+        published = _assert_no_torn_publishes(root)
+        assert published >= 1, "cycle ended with no published checkpoint"
+
+        if cyc.life > 0:
+            assert prev is not None
+            assert rec["resumed_at"] == prev_expect, (
+                f"life {cyc.life} resumed at {rec['resumed_at']}, expected {prev_expect}"
+            )
+            want = prev["digests"].get(str(rec["resumed_at"]))
+            assert want is not None, (
+                f"previous life has no digest for step {rec['resumed_at']}"
+            )
+            assert rec["loaded_digest"] == want, (
+                f"life {cyc.life} loaded state digest {rec['loaded_digest'][:16]} != "
+                f"saved {want[:16]} (step {rec['resumed_at']})"
+            )
+            if cyc.topology != cycles[cyc.life - 1].topology:
+                assert rec["resharded"], (
+                    f"life {cyc.life} changed topology but reported no reshard"
+                )
+
+        if cyc.fault == "nan":
+            assert rec["skipped_steps"] == [cyc.fault_step], rec["skipped_steps"]
+            nan_skip_from = cyc.fault_step
+        for step_str, loss in rec["losses"].items():
+            step = int(step_str)
+            ref = reference["losses"].get(step_str)
+            assert math.isfinite(loss), f"life {cyc.life} step {step}: loss {loss}"
+            if ref is None or step > nan_skip_from:
+                continue  # post-skip trajectory legitimately forks
+            if cyc.topology == BASE_TOPOLOGY:
+                assert loss == ref, (
+                    f"same-topology life {cyc.life} step {step}: {loss!r} != {ref!r}"
+                )
+            else:
+                assert abs(loss - ref) <= CROSS_TOL * max(1.0, abs(ref)), (
+                    f"cross-topology life {cyc.life} step {step}: {loss} vs {ref}"
+                )
+
+        if tel.enabled:
+            tel.registry.counter("chaos.cycles").inc()
+            tel.event(
+                "chaos.cycle",
+                life=cyc.life,
+                topology=cyc.topology,
+                fault=cyc.fault,
+                fault_step=cyc.fault_step,
+                death=rec["death"],
+                resumed_at=rec["resumed_at"],
+                resharded=rec["resharded"],
+                last_step=rec["last_step"],
+            )
+        prev = rec
+        prev_expect = cyc.expect_resume
+
+    # -- final oracles --------------------------------------------------------
+    from .manifest import find_latest_complete, read_manifest, verify_checkpoint
+
+    final = find_latest_complete(os.path.join(root, "checkpoints"))
+    assert final is not None, "campaign left no complete checkpoint"
+    manifest = verify_checkpoint(final)  # raises on torn/corrupt
+    assert manifest["step"] == total_steps, manifest["step"]
+    assert read_manifest(final).get("topology") is not None, "final manifest lost its topology record"
+    resumes = sum(1 for rec in lives if rec["resumed_at"] is not None)
+    assert resumes >= 3, f"campaign needs >= 3 kill/resume cycles, got {resumes}"
+
+    return {
+        "seed": seed,
+        "cycles": [asdict(c) for c in cycles],
+        "topology_changes": changes,
+        "resumes": resumes,
+        "final_checkpoint": final,
+        "final_step": int(manifest["step"]),
+        "published": _assert_no_torn_publishes(root),
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--role", choices=("life",), default=None)
+    parser.add_argument("--ckpt-root", default=None)
+    parser.add_argument("--out", default=None)
+    parser.add_argument("--total", type=int, default=TOTAL_STEPS)
+    parser.add_argument("--fault", choices=FAULTS, default=None)
+    parser.add_argument("--fault-step", type=int, default=None)
+    parser.add_argument("--no-save", action="store_true")
+    parser.add_argument("--seed", type=int, default=20260804)
+    args = parser.parse_args()
+
+    if args.role == "life":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        return run_life(
+            args.ckpt_root,
+            args.out,
+            args.total,
+            args.fault,
+            args.fault_step,
+            save_every=not args.no_save,
+        )
+
+    from ..telemetry import enable as _enable_telemetry
+
+    _enable_telemetry(dir=tempfile.mkdtemp(prefix="atpu_chaos_telemetry_"))
+    summary = run_campaign(args.seed)
+    print(
+        f"chaos-smoke OK — seed {summary['seed']}: {len(summary['cycles'])} lives, "
+        f"{summary['resumes']} kill/resume cycles, {summary['topology_changes']} "
+        f"topology changes, {summary['published']} published checkpoints (0 torn), "
+        f"final verified checkpoint at step {summary['final_step']}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

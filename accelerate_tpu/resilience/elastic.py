@@ -1,0 +1,605 @@
+"""Elastic topology resume: reshard a checkpoint across mesh shapes.
+
+A preempted 256-chip run must be able to resume on 128 chips.  The atomic
+checkpoint payload is already topology-portable — params and optimizer state
+are saved in the *gathered* host form (``model.safetensors`` +
+``optimizer.bin``), and loading re-places every leaf onto whatever sharding
+the live mesh declares (``jax.device_put`` against a ``NamedSharding`` is
+exactly the GSPMD relayout of arxiv 2105.04663).  What was missing is the
+*contract*: nothing recorded which topology a checkpoint was saved under,
+nothing validated that a cross-topology load is legal, and the parts of
+training state that are NOT topology-portable (per-process RNG streams, the
+dataloader position measured in global batches, pipeline-stacked parameter
+shapes) silently resumed wrong.
+
+This module supplies that contract:
+
+- :func:`capture_topology` — a full topology record written into every
+  verified checkpoint manifest by ``save_state``: schema version, mesh axis
+  names/degrees, world/device size, per-leaf layout (shape/dtype/
+  PartitionSpec) for params AND optimizer state (including ZeRO dp-shard
+  placement from arxiv 2004.13336), pipeline stage geometry, RNG stream
+  count, and the global batch each prepared dataloader fed.
+- :func:`plan_resume` — compares a saved record against the live
+  accelerator.  Mesh reshapes (dp=8 → dp=4, dp → dp×fsdp, ZeRO on↔off,
+  world-size changes) produce an :class:`ElasticPlan` describing the
+  migration; pipeline stage-count or virtual-stage changes raise
+  :class:`ElasticTopologyError` loudly — pipelined params are stacked
+  ``[stages, layers/stage, ...]``, so a stage-count change is a different
+  *parameter pytree*, not a relayout.
+- :func:`validate_leaves` — leaf-by-leaf shape/dtype check of the saved
+  record against the live model/optimizer trees BEFORE anything is restored,
+  so a wrong-model resume fails with the offending leaf names instead of a
+  deep safetensors error.
+- :func:`reshard_tree` — explicit GSPMD relayout of live arrays onto new
+  shardings (the in-memory form of what load does from the host payload).
+- :func:`fold_rng_bundle` — deterministic derivation of RNG streams for
+  ranks that have no saved ``random_states_{rank}.pkl`` (resuming on MORE
+  processes than saved).  The JAX root key is functional and shared; the
+  stateful python/numpy/torch streams are re-derived by folding (seed, old
+  world, new world, rank) through SHA-256 so every new rank gets a distinct,
+  reproducible stream.
+- :func:`recompute_skip_batches` — ``skip_first_batches`` geometry for the
+  new global-batch split: the examples consumed under the old topology must
+  land on a batch boundary of the new one (raises otherwise), so a resumed
+  loader yields exactly the not-yet-seen examples — no skips, no repeats.
+
+``Accelerator.resume_from_latest`` drives all of this and stores an
+:class:`ElasticResumeInfo` on ``accelerator.last_resume_info``; cross-
+topology loads emit an ``elastic.reshard`` telemetry event.  Legacy
+checkpoints with no topology record resume on a warned best-effort path that
+is byte-for-byte today's behavior.  ``make elastic-smoke`` and the chaos
+campaign (``chaos.py``) prove the whole story end to end.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import random as _random
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+
+from ..logging import get_logger
+from ..utils.imports import is_torch_available
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "TOPOLOGY_KEY",
+    "TOPOLOGY_SCHEMA_VERSION",
+    "ElasticTopologyError",
+    "ElasticPlan",
+    "ElasticResumeInfo",
+    "capture_topology",
+    "describe_mesh",
+    "plan_resume",
+    "validate_leaves",
+    "reshard_tree",
+    "fold_rng_bundle",
+    "recompute_skip_batches",
+    "state_digest",
+]
+
+# Manifest key the topology record lives under (a sibling of the PR-7
+# ``opt_state_layout`` field, which stays for back-compat readers).
+TOPOLOGY_KEY = "topology"
+# Bump when the record's shape changes incompatibly; loaders reject records
+# NEWER than they understand (an old library must not half-parse a future
+# record and silently resume wrong).
+TOPOLOGY_SCHEMA_VERSION = 1
+
+
+class ElasticTopologyError(RuntimeError):
+    """A checkpoint cannot legally land on the current topology (pipeline
+    stage-count change, leaf shape/dtype mismatch, non-divisible batch
+    geometry, or a topology record newer than this library)."""
+
+
+# ---------------------------------------------------------------------------
+# Capture (save side)
+# ---------------------------------------------------------------------------
+
+
+def describe_mesh(mesh) -> dict:
+    """JSON-able record of a mesh's axis names and degrees (all axes, active
+    or size-1 — the axis ORDER is part of the layout contract)."""
+    if mesh is None:
+        return {"axes": [], "shape": []}
+    return {
+        "axes": [str(a) for a in mesh.axis_names],
+        "shape": [int(s) for s in mesh.devices.shape],
+    }
+
+
+def _spec_entry_json(entry):
+    if entry is None:
+        return None
+    if isinstance(entry, (tuple, list)):
+        return [str(a) for a in entry]
+    return str(entry)
+
+
+def _leaf_spec(leaf) -> Optional[list]:
+    """The leaf's PartitionSpec as JSON (one entry per dim), or None when the
+    leaf is replicated / host-side / not a named-sharded jax Array."""
+    sharding = getattr(leaf, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return None
+    entries = [_spec_entry_json(e) for e in tuple(spec)]
+    if all(e is None for e in entries):
+        return None
+    return entries
+
+
+def _leaf_record(leaf) -> dict:
+    dtype = getattr(leaf, "dtype", None)
+    if dtype is None:
+        dtype = np.asarray(leaf).dtype
+    return {
+        "shape": [int(s) for s in np.shape(leaf)],
+        "dtype": str(dtype),
+        "spec": _leaf_spec(leaf),
+    }
+
+
+def _model_leaves(model) -> Optional[dict]:
+    params = getattr(model, "params", None)
+    if params is None:
+        return None
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        out[jax.tree_util.keystr(path)] = _leaf_record(leaf)
+    return out
+
+
+def capture_topology(accelerator, step: Optional[int] = None) -> dict:
+    """Build the checkpoint manifest's topology record from the live
+    accelerator.  Pure metadata — shapes, dtypes and shardings are read off
+    the trees without materializing a single array on the host."""
+    state = accelerator.state
+    mesh = getattr(state, "mesh", None)
+    pcfg = getattr(state, "parallelism_config", None)
+    pp_plugin = getattr(state, "pp_plugin", None)
+
+    models = {}
+    for i, model in enumerate(getattr(accelerator, "_models", [])):
+        leaves = _model_leaves(model)
+        if leaves is not None:
+            models[str(i)] = leaves
+
+    optimizers = []
+    for opt in getattr(accelerator, "_optimizers", []):
+        layout = getattr(
+            opt, "_opt_state_layout", {"kind": "replicated", "axes": [], "degree": 1}
+        )
+        leaves = []
+        opt_state = getattr(opt, "opt_state", None)
+        if opt_state is not None:
+            leaves = [
+                _leaf_record(leaf) for leaf in jax.tree_util.tree_leaves(opt_state)
+            ]
+        optimizers.append({"layout": dict(layout), "leaves": leaves})
+
+    loader_batches = []
+    for dl in getattr(accelerator, "_dataloaders", []):
+        try:
+            loader_batches.append(int(dl.total_batch_size))
+        except Exception:
+            loader_batches.append(None)
+
+    from ..utils.random import rng_registry
+
+    return {
+        "schema": TOPOLOGY_SCHEMA_VERSION,
+        "step": step,
+        "world_size": int(state.num_processes),
+        "device_count": int(jax.device_count()),
+        "mesh": describe_mesh(mesh),
+        "parallelism": dict(pcfg.active_axes) if pcfg is not None else {},
+        "pp": {
+            "degree": int(getattr(pcfg, "pp", 1) or 1) if pcfg is not None else 1,
+            "virtual_stages": int(getattr(pp_plugin, "virtual_stages", 1) or 1),
+        },
+        "models": models,
+        "optimizers": optimizers,
+        "rng": {
+            "jax_seed": rng_registry.initial_seed,
+            "streams": int(state.num_processes),
+        },
+        "data": {
+            "global_batch_size": loader_batches[0] if loader_batches else None,
+            "loader_batches": loader_batches,
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# Plan / validate (load side)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ElasticPlan:
+    """What changes between the saved topology and the live one.  ``changed``
+    gates the ``elastic.reshard`` event; ``changes`` is human-readable, one
+    entry per migrated dimension."""
+
+    changed: bool = False
+    changes: list = field(default_factory=list)
+    saved_mesh: dict = field(default_factory=dict)
+    live_mesh: dict = field(default_factory=dict)
+    saved_world: int = 1
+    live_world: int = 1
+    saved_global_batch: Optional[int] = None
+    # Layout each optimizer's carried state was SAVED under ("replicated" or
+    # ZeRO with axes/degree).  Deliberately not compared against the live
+    # optimizer: its layout attribute is provisional until the next
+    # make_train_step re-decides ZeRO, so a comparison here would flag every
+    # ZeRO checkpoint as migrated (the PR 7 load-side logging trap).
+    saved_opt_layouts: list = field(default_factory=list)
+    schema: int = TOPOLOGY_SCHEMA_VERSION
+
+
+def _live_pp(accelerator) -> tuple[int, int]:
+    state = accelerator.state
+    pcfg = getattr(state, "parallelism_config", None)
+    pp = int(getattr(pcfg, "pp", 1) or 1) if pcfg is not None else 1
+    pp_plugin = getattr(state, "pp_plugin", None)
+    return pp, int(getattr(pp_plugin, "virtual_stages", 1) or 1)
+
+
+def plan_resume(topology: dict, accelerator) -> ElasticPlan:
+    """Compare a manifest topology record against the live accelerator.
+
+    Returns the migration plan for supported reshapes; raises
+    :class:`ElasticTopologyError` for a record newer than this library or a
+    pipeline stage-count / virtual-stage change (pipelined params are stacked
+    per stage — that is a different parameter pytree, not a relayout; export
+    the checkpoint through ``state_dict()``'s unstacked form instead)."""
+    schema = topology.get("schema")
+    if not isinstance(schema, int) or schema < 1:
+        raise ElasticTopologyError(
+            f"checkpoint topology record has no valid schema version ({schema!r})"
+        )
+    if schema > TOPOLOGY_SCHEMA_VERSION:
+        raise ElasticTopologyError(
+            f"checkpoint topology record is schema v{schema}, this library "
+            f"understands up to v{TOPOLOGY_SCHEMA_VERSION} — upgrade "
+            "accelerate_tpu to resume this checkpoint"
+        )
+
+    saved_pp = topology.get("pp") or {}
+    saved_pp_degree = int(saved_pp.get("degree", 1) or 1)
+    saved_virtual = int(saved_pp.get("virtual_stages", 1) or 1)
+    live_pp_degree, live_virtual = _live_pp(accelerator)
+    if (saved_pp_degree, saved_virtual) != (live_pp_degree, live_virtual):
+        raise ElasticTopologyError(
+            "pipeline stage geometry is not elastic: checkpoint was saved with "
+            f"pp={saved_pp_degree} x virtual_stages={saved_virtual}, the live mesh "
+            f"runs pp={live_pp_degree} x virtual_stages={live_virtual}.  Pipelined "
+            "parameters are stacked [stages, layers/stage, ...], so a stage-count "
+            "change is a different parameter tree, not a resharding — re-export "
+            "the checkpoint through the model's unstacked state_dict() (pp=1 "
+            "layout) and re-stack it under the new schedule."
+        )
+
+    plan = ElasticPlan(
+        saved_mesh=dict(topology.get("mesh") or {}),
+        live_mesh=describe_mesh(getattr(accelerator.state, "mesh", None)),
+        saved_world=int(topology.get("world_size", 1) or 1),
+        live_world=int(accelerator.state.num_processes),
+        saved_global_batch=(topology.get("data") or {}).get("global_batch_size"),
+        schema=schema,
+    )
+
+    def _active(mesh_rec: dict) -> dict:
+        return {
+            a: s
+            for a, s in zip(mesh_rec.get("axes", []), mesh_rec.get("shape", []))
+            if s and s > 1
+        }
+
+    saved_axes, live_axes = _active(plan.saved_mesh), _active(plan.live_mesh)
+    if saved_axes != live_axes:
+        plan.changes.append(f"mesh {saved_axes or '{}'} -> {live_axes or '{}'}")
+    if plan.saved_world != plan.live_world:
+        plan.changes.append(f"world_size {plan.saved_world} -> {plan.live_world}")
+    saved_devices = topology.get("device_count")
+    try:
+        live_devices = int(jax.device_count())
+    except Exception:
+        live_devices = None
+    if saved_devices is not None and live_devices is not None and saved_devices != live_devices:
+        plan.changes.append(f"device_count {saved_devices} -> {live_devices}")
+
+    # Opt-state layouts are recorded, not compared: the live layout is
+    # provisional until the next make_train_step re-decides ZeRO, so
+    # comparing against the pre-step attribute (always "replicated") would
+    # flag every ZeRO checkpoint as migrated.  The gathered payload re-places
+    # onto whatever layout the next step builds either way.
+    plan.saved_opt_layouts = [
+        dict(saved_opt.get("layout") or {})
+        for saved_opt in (topology.get("optimizers") or [])
+    ]
+
+    plan.changed = bool(plan.changes)
+    return plan
+
+
+def _shapes_agree(a: list, b: list) -> bool:
+    """Shape equality with ONE historical tolerance: the save path has always
+    written 0-d params as shape (1,) (``np.ascontiguousarray`` promotes 0-d),
+    so a scalar leaf legally appears as [] on one side and [1] on the other
+    after any save/load round trip."""
+    if a == b:
+        return True
+    return sorted((tuple(a), tuple(b))) == [(), (1,)]
+
+
+def validate_leaves(topology: dict, accelerator) -> None:
+    """Leaf-by-leaf validation of the saved topology record against the live
+    trees, BEFORE anything is restored: every saved param leaf must exist on
+    the live model with the same global shape and dtype, and optimizer
+    state must agree leaf-count- and shape-wise.  Raises
+    :class:`ElasticTopologyError` listing every offending leaf."""
+    problems: list[str] = []
+
+    saved_models = topology.get("models") or {}
+    live_models = getattr(accelerator, "_models", [])
+    for key, saved_leaves in saved_models.items():
+        try:
+            idx = int(key)
+        except ValueError:
+            continue
+        if idx >= len(live_models):
+            # The legacy load loop iterates the LIVE models and ignores extra
+            # saved files; keep that permissiveness (partial restores are a
+            # supported pattern), just don't validate what won't be loaded.
+            logger.warning(
+                f"checkpoint carries model {idx} but only {len(live_models)} "
+                "model(s) are prepared live; the extra payload is ignored."
+            )
+            continue
+        live_leaves = _model_leaves(live_models[idx])
+        if live_leaves is None:
+            continue  # bridged/foreign model with no jax param tree to check
+        for name, rec in saved_leaves.items():
+            live = live_leaves.get(name)
+            if live is None:
+                problems.append(f"model {idx} leaf {name}: missing on the live model")
+                continue
+            if not _shapes_agree(live["shape"], rec["shape"]):
+                problems.append(
+                    f"model {idx} leaf {name}: saved shape {rec['shape']}, "
+                    f"live {live['shape']}"
+                )
+            elif live["dtype"] != rec["dtype"]:
+                problems.append(
+                    f"model {idx} leaf {name}: saved dtype {rec['dtype']}, "
+                    f"live {live['dtype']}"
+                )
+        for name in live_leaves:
+            if name not in saved_leaves:
+                problems.append(
+                    f"model {idx} leaf {name}: live model has it, checkpoint does not"
+                )
+
+    live_opts = getattr(accelerator, "_optimizers", [])
+    for i, saved_opt in enumerate(topology.get("optimizers") or []):
+        if i >= len(live_opts):
+            logger.warning(
+                f"checkpoint carries optimizer {i} but only {len(live_opts)} "
+                "optimizer(s) are prepared live; the extra payload is ignored."
+            )
+            continue
+        saved_leaves = saved_opt.get("leaves") or []
+        opt_state = getattr(live_opts[i], "opt_state", None)
+        if opt_state is None or not saved_leaves:
+            continue
+        live_leaves = [
+            _leaf_record(leaf) for leaf in jax.tree_util.tree_leaves(opt_state)
+        ]
+        if len(live_leaves) != len(saved_leaves):
+            problems.append(
+                f"optimizer {i}: checkpoint carries {len(saved_leaves)} opt-state "
+                f"leaves, live optimizer has {len(live_leaves)} — different "
+                "optimizer family?"
+            )
+            continue
+        for j, (saved, live) in enumerate(zip(saved_leaves, live_leaves)):
+            if not _shapes_agree(saved["shape"], live["shape"]):
+                problems.append(
+                    f"optimizer {i} opt-state leaf {j}: saved shape "
+                    f"{saved['shape']}, live {live['shape']}"
+                )
+
+    if problems:
+        raise ElasticTopologyError(
+            "checkpoint cannot land on the live trees ("
+            + "; ".join(problems[:20])
+            + (f"; ... {len(problems) - 20} more" if len(problems) > 20 else "")
+            + ")"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Relayout
+# ---------------------------------------------------------------------------
+
+
+def reshard_tree(tree: Any, target: Any) -> Any:
+    """GSPMD relayout: place every leaf of ``tree`` onto the sharding of the
+    matching leaf in ``target`` (a pytree of shardings, or of arrays whose
+    ``.sharding`` is taken).  ``jax.device_put`` of a committed array onto a
+    new ``NamedSharding`` is the arbitrary sharded-to-sharded relayout GSPMD
+    makes tractable — XLA moves only the bytes each device is missing.
+    Leaves whose target has no sharding pass through unchanged."""
+
+    def one(leaf, tgt):
+        sharding = getattr(tgt, "sharding", tgt)
+        if sharding is None or not hasattr(sharding, "devices_indices_map"):
+            return leaf
+        return jax.device_put(leaf, sharding)
+
+    return jax.tree_util.tree_map(one, tree, target)
+
+
+# ---------------------------------------------------------------------------
+# RNG stream folding
+# ---------------------------------------------------------------------------
+
+
+def fold_rng_bundle(bundle: dict, rank: int, new_world: int, old_world: int) -> dict:
+    """Derive a deterministic RNG bundle for a rank that has no saved
+    ``random_states_{rank}.pkl`` (resume on MORE processes than saved).
+
+    The JAX root seed is functional and identical on every rank, so it passes
+    through — ``fold_in``-derived subkeys stay globally consistent.  The
+    stateful python/numpy/torch streams cannot be split, so each new rank
+    re-derives independent streams by hashing (saved jax seed, old world,
+    new world, rank): reproducible for a given elastic transition, distinct
+    per rank, and never a byte-copy of another rank's stream (which would
+    correlate per-host shuffles)."""
+    seed0 = bundle.get("jax_seed")
+    digest = hashlib.sha256(
+        f"elastic-rng:{seed0}:{old_world}->{new_world}:rank{rank}".encode()
+    ).hexdigest()
+    derived = int(digest[:16], 16)
+    out = {
+        "python": _random.Random(derived).getstate(),
+        "numpy": np.random.RandomState(derived % (2**32)).get_state(),
+        "jax_seed": seed0,
+    }
+    if "torch" in bundle and is_torch_available():
+        import torch
+
+        gen = torch.Generator()
+        gen.manual_seed(derived % (2**63))
+        out["torch"] = gen.get_state()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Dataloader geometry
+# ---------------------------------------------------------------------------
+
+
+def recompute_skip_batches(
+    saved_step: Optional[int],
+    saved_global_batch: Optional[int],
+    new_global_batch: Optional[int],
+) -> Optional[int]:
+    """``skip_first_batches`` count for the new global-batch split.
+
+    The old run consumed ``saved_step * saved_global_batch`` examples; under
+    the new split those must land exactly on a batch boundary, else the
+    resumed loader would repeat or skip examples — that is rejected loudly
+    rather than silently corrupting the data order.  Returns None when
+    either geometry is unknown (caller falls back to the stateful-loader /
+    sampler position as before)."""
+    if not saved_step or not saved_global_batch or not new_global_batch:
+        return None
+    examples = int(saved_step) * int(saved_global_batch)
+    if examples % int(new_global_batch):
+        raise ElasticTopologyError(
+            f"dataloader geometry does not reshape: the saved run consumed "
+            f"{examples} examples ({saved_step} steps x global batch "
+            f"{saved_global_batch}), which is not a whole number of new global "
+            f"batches ({new_global_batch}).  Pick a global batch size that "
+            f"divides {examples}, or resume at an epoch boundary."
+        )
+    return examples // int(new_global_batch)
+
+
+# ---------------------------------------------------------------------------
+# Digest (smoke/chaos oracle)
+# ---------------------------------------------------------------------------
+
+
+def state_digest(accelerator) -> str:
+    """SHA-256 over every model param and optimizer-state leaf in canonical
+    order (host-gathered bytes).  Two accelerators hold bit-identical state
+    iff their digests match — the cross-topology load oracle used by
+    ``make elastic-smoke`` and the chaos campaign."""
+    h = hashlib.sha256()
+    for i, model in enumerate(getattr(accelerator, "_models", [])):
+        params = getattr(model, "params", None)
+        if params is None:
+            continue
+        for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+            h.update(f"m{i}:{jax.tree_util.keystr(path)}".encode())
+            h.update(np.ascontiguousarray(jax.device_get(leaf)).tobytes())
+    for i, opt in enumerate(getattr(accelerator, "_optimizers", [])):
+        opt_state = getattr(opt, "opt_state", None)
+        if opt_state is None:
+            continue
+        for j, leaf in enumerate(jax.tree_util.tree_leaves(opt_state)):
+            h.update(f"o{i}:{j}".encode())
+            h.update(np.ascontiguousarray(jax.device_get(leaf)).tobytes())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# Resume info (stored on the accelerator by resume_from_latest)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ElasticResumeInfo:
+    """What ``resume_from_latest`` did: the resumed step, the migration plan
+    (None for legacy topology-less checkpoints), and the recomputed
+    ``skip_first_batches`` count for the live loader geometry (None when
+    either side's geometry is unknown)."""
+
+    step: int = 0
+    checkpoint: Optional[str] = None
+    plan: Optional[ElasticPlan] = None
+    legacy: bool = False
+    skip_batches: Optional[int] = None
+
+    @property
+    def resharded(self) -> bool:
+        return self.plan is not None and self.plan.changed
+
+
+def restore_rng_for_rank(input_dir: str, process_index: int, topology: Optional[dict]) -> bool:
+    """Elastic RNG restore: load ``random_states_{rank}.pkl`` when present;
+    when absent but the checkpoint carries a topology record, fold a
+    deterministic stream for this rank from rank 0's bundle (world size
+    grew).  Returns True when any RNG state was restored."""
+    from ..checkpointing import _restore_rng_state
+
+    rng_path = os.path.join(input_dir, f"random_states_{process_index}.pkl")
+    if os.path.exists(rng_path):
+        with open(rng_path, "rb") as f:
+            _restore_rng_state(pickle.load(f))
+        return True
+    if topology is None:
+        return False
+    base_path = os.path.join(input_dir, "random_states_0.pkl")
+    if not os.path.exists(base_path):
+        return False
+    with open(base_path, "rb") as f:
+        base = pickle.load(f)
+    old_world = int(topology.get("world_size", 1) or 1)
+    try:
+        from ..state import PartialState
+
+        new_world = int(PartialState().num_processes)
+    except Exception:
+        new_world = old_world
+    folded = fold_rng_bundle(base, rank=process_index, new_world=new_world, old_world=old_world)
+    _restore_rng_state(folded)
+    logger.warning(
+        f"no saved RNG stream for process {process_index} (checkpoint saved "
+        f"{old_world} streams); derived a deterministic elastic stream by "
+        f"folding (seed, {old_world}->{new_world}, rank)."
+    )
+    return True

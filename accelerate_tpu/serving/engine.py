@@ -159,6 +159,9 @@ class ServingEngine:
         self._kv_names = self.cache.leaf_names
         self._finished: List[CompletedRequest] = []
         self._preempted_published = 0
+        self._preemption_guard = None
+        self._drained = False
+        self.requeue_journal: Optional[List[dict]] = None
         self.ticks = 0
         self.decode_dispatches = 0
         self.prefill_dispatches = 0
@@ -209,6 +212,28 @@ class ServingEngine:
 
     # -- request API ---------------------------------------------------------
 
+    def install_preemption_guard(self, guard) -> None:
+        """Honor a resilience :class:`PreemptionGuard`
+        (``accelerator.enable_preemption_handling()`` installs one): once the
+        fleet agrees a preemption signal arrived, the next :meth:`step` call
+        DRAINS the engine instead of ticking — admission stops, in-flight
+        slots are preempted back to the queue with their emitted tokens
+        carried, and a ``serving.drained`` event records the requeue journal
+        of incomplete requests so a successor process can resubmit them
+        (re-prefilling prompt+emitted rebuilds each cache bit-identically,
+        the same path a block-pressure preemption takes)."""
+        if self._drained:
+            raise RuntimeError(
+                "engine already drained: the requeue journal is final and "
+                "admission is closed — build a successor engine instead of "
+                "re-arming this one."
+            )
+        self._preemption_guard = guard
+
+    @property
+    def drained(self) -> bool:
+        return self._drained
+
     def submit(
         self,
         prompt_ids,
@@ -217,6 +242,12 @@ class ServingEngine:
     ) -> int:
         """Queue one request; returns its id.  ``max_new_tokens == 0``
         completes immediately (the offline loop's contract)."""
+        if self._drained:
+            raise RuntimeError(
+                "engine drained after a preemption signal: admission is closed "
+                "and the requeue journal is final — resubmit to a successor "
+                "engine (see engine.requeue_journal)."
+            )
         req = Request(list(np.asarray(prompt_ids).reshape(-1)), max_new_tokens, arrival_t)
         if req.max_new_tokens == 0:
             now = time.monotonic()
@@ -233,9 +264,14 @@ class ServingEngine:
 
     def step(self) -> List[CompletedRequest]:
         """One engine tick: admit, one prefill chunk, one fused decode
-        dispatch.  Returns the requests that completed this tick."""
+        dispatch.  Returns the requests that completed this tick.  With an
+        installed :class:`PreemptionGuard` whose signal has arrived, the
+        tick drains instead (no admission, no dispatch)."""
         now = time.monotonic()
         done_before = len(self._finished)
+        if self._drained or self._drain_requested():
+            self.drain()
+            return []
         self.ticks += 1
         self.sched.admit(now)
         self._prefill_tick(now)
@@ -245,10 +281,14 @@ class ServingEngine:
 
     def run(self, max_ticks: Optional[int] = None) -> Dict[int, List[int]]:
         """Drive ticks until every submitted request completes; returns
-        ``{request_id: full token list (prompt + generated)}``."""
+        ``{request_id: full token list (prompt + generated)}``.  A
+        preemption-triggered drain ends the loop early: completed requests
+        are returned, incomplete ones are in :attr:`requeue_journal`."""
         ticks = 0
         while not self.sched.idle():
             self.step()
+            if self._drained:
+                break
             ticks += 1
             if max_ticks is not None and ticks >= max_ticks:
                 raise RuntimeError(
@@ -256,6 +296,61 @@ class ServingEngine:
                     f"(active {self.sched.active}, queued {self.sched.pending})"
                 )
         return {c.id: c.tokens for c in self._finished}
+
+    def _drain_requested(self) -> bool:
+        """Whether the installed guard says stop.  For a multi-host
+        COORDINATED guard the LOCAL flag is consulted, never should_stop():
+        that path gates a cross-host collective on a per-guard call counter
+        that every process must hit in lockstep, and engine tick counts are
+        data-dependent (queue depth differs per host) — one desynchronized
+        gather would hang the fleet.  Fleet-wide stop agreement belongs to
+        the training loop's check_preemption(); the drain itself is a local
+        action (each host journals its own queue)."""
+        guard = self._preemption_guard
+        if guard is None:
+            return False
+        coordinated = getattr(guard, "_coordination_on", None)
+        if coordinated is not None and coordinated():
+            return guard.preempted_locally()
+        return guard.should_stop()
+
+    def drain(self) -> List[dict]:
+        """Graceful drain: stop admission, preempt every in-flight slot back
+        to the queue (blocks freed, emitted tokens carried — the oldest
+        request ends up at the queue FRONT, preserving FIFO priority), and
+        publish the requeue journal of incomplete requests as a
+        ``serving.drained`` event.  Idempotent; returns the journal."""
+        if self._drained:
+            return self.requeue_journal or []
+        while self.sched.slots:
+            self.sched.preempt_one()
+        journal = [
+            {
+                "id": req.id,
+                # Full prompt + emitted tokens: a successor engine resubmits
+                # prompt+emitted with max_new=remaining and greedy decode
+                # finishes the request token-identically (the engine's own
+                # re-prefill path).
+                "prompt": list(req.prompt),
+                "emitted": list(req.emitted),
+                "remaining": req.remaining,
+                "preemptions": req.preemptions,
+            }
+            for req in self.sched.queue
+        ]
+        self._drained = True
+        self.requeue_journal = journal
+        tel = get_telemetry()
+        if tel.enabled:
+            tel.registry.counter("serving.drains").inc()
+            tel.event(
+                "serving.drained",
+                incomplete=len(journal),
+                completed=len(self._finished),
+                journal=journal,
+            )
+        self._publish_gauges()
+        return journal
 
     def pop_finished(self) -> List[CompletedRequest]:
         out, self._finished = self._finished, []

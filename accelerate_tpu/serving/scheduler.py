@@ -196,7 +196,13 @@ class Scheduler:
         index, or None when nothing is in flight."""
         if not self.slots:
             return None
-        idx = max(self.slots, key=lambda i: self.slots[i].admit_seq)
+        return self.preempt_slot(max(self.slots, key=lambda i: self.slots[i].admit_seq))
+
+    def preempt_slot(self, idx: int) -> int:
+        """Evict slot ``idx`` specifically (the LIFO victim policy lives in
+        :meth:`preempt_one`; the engine's graceful drain evicts EVERY slot):
+        free its blocks and requeue the request at the FRONT, emitted tokens
+        carried."""
         slot = self.slots.pop(idx)
         if slot.blocks:
             self.allocator.free(slot.blocks)

@@ -1211,42 +1211,78 @@ def _honor_cpu_env():
     honor_cpu_platform_env()
 
 
+# Last _acquire_device outcome, journaled into the bench detail block so a
+# round's artifact records how hard the tunnel fought back (ROADMAP item 5:
+# one flaky poll must not zero a whole round, and the fight must be visible).
+_ACQUIRE_STATS = {"attempts": 0, "retries": 0, "ok": False, "detail": "never probed"}
+
+
 def _acquire_device(deadline_s: float, attempt_timeout_s: float, wait_s: float):
     """Bounded device acquisition: killable-subprocess probes until the backend
     answers or the wall-clock window closes.  Each attempt is a fresh
     interpreter — the only real "backend reset" for a wedged tunnel (an
     in-process clear_backends cannot unwedge a blocked C call).
 
-    The wait between attempts backs off exponentially (capped): an observed
+    The attempt loop is the resilience ``RetryPolicy`` (exponential backoff +
+    jitter, capped at 300s between attempts, wall-clock deadline): an observed
     wedge (r4) lasted >15 min, so the window must ride it out instead of
-    burning all attempts in the first minutes.  Returns (ok, detail,
-    attempts)."""
+    burning all attempts in the first minutes.  Every retry also counts into
+    the shared ``resilience.retries`` telemetry counter, and the attempt/retry
+    totals are journaled into the bench ``detail.device_acquire`` block.
+    Returns (ok, detail, attempts)."""
+    from accelerate_tpu.resilience.retry import RetryPolicy
     from accelerate_tpu.utils.device_probe import probe_device_backend
 
-    t0 = time.monotonic()
-    attempts = 0
-    detail = "no attempts"
-    # First attempt with a SHORT timeout: a healthy tunnel answers in a few
-    # seconds, so a wedge is detected fast instead of after 180s.
-    timeout = min(60.0, attempt_timeout_s)
-    wait = wait_s
-    while True:
-        attempts += 1
+    state = {"attempts": 0, "detail": "no attempts"}
+
+    def _probe_once():
+        state["attempts"] += 1
+        # First attempt with a SHORT timeout: a healthy tunnel answers in a
+        # few seconds, so a wedge is detected fast instead of after 180s.
+        timeout = min(60.0, attempt_timeout_s) if state["attempts"] == 1 else attempt_timeout_s
         ok, detail = probe_device_backend(timeout_s=timeout, retries=1)
-        if ok:
-            return True, detail, attempts
-        elapsed = time.monotonic() - t0
-        print(
-            f"# probe attempt {attempts} failed after {elapsed:.0f}s: {detail} "
-            f"(next wait {wait:.0f}s)",
-            file=sys.stderr,
-            flush=True,
-        )
-        timeout = attempt_timeout_s
-        if elapsed + wait + timeout > deadline_s:
-            return False, detail, attempts
-        time.sleep(wait)
-        wait = min(wait * 2, 300.0)
+        state["detail"] = detail
+        if not ok:
+            print(
+                f"# probe attempt {state['attempts']} failed: {detail}",
+                file=sys.stderr,
+                flush=True,
+            )
+            # TimeoutError is in the policy's always-retryable set; the real
+            # failure text rides along for the give-up log.
+            raise TimeoutError(f"device probe failed: {detail}")
+        return detail
+
+    policy = RetryPolicy(
+        tries=64,  # the deadline is the real bound; tries just backstops it
+        base_delay_s=wait_s,
+        max_delay_s=300.0,
+        # The policy checks (elapsed + wait) against its deadline BEFORE
+        # sleeping; reserve the next attempt's probe timeout so the whole
+        # acquisition (old-code contract) stays inside deadline_s.
+        deadline_s=max(1.0, deadline_s - attempt_timeout_s),
+        # EVERY probe failure is retry-worthy here: the raised error embeds
+        # the probe subprocess's raw stderr, which for a TPU held by a dying
+        # process can contain RESOURCE_EXHAUSTED — default_retryable would
+        # give up on exactly the transient wedge this window exists to ride
+        # out (each attempt is a fresh interpreter, not a repeated alloc).
+        retryable=lambda exc: True,
+        label="bench.device_probe",
+    )
+    try:
+        detail = policy.call(_probe_once)
+        ok = True
+    except Exception:
+        detail, ok = state["detail"], False
+    _ACQUIRE_STATS.update(
+        {
+            "attempts": _ACQUIRE_STATS["attempts"] + state["attempts"],
+            "retries": _ACQUIRE_STATS["retries"] + max(0, state["attempts"] - 1),
+            "ok": ok,
+            "detail": detail,
+        }
+    )
+    return ok, detail, state["attempts"]
 
 
 def main():
@@ -1476,6 +1512,10 @@ def main():
                 "params": result["params"],
                 "tokens_per_sec": round(result["tokens_per_sec"], 1),
                 "step_ms": round(result["step_ms"], 2),
+                # Device-acquisition fight journal (retrying() policy): how
+                # many probes/backoff retries this round burned before the
+                # backend answered — the r1/r2/r4/r5 flake story, measured.
+                "device_acquire": dict(_ACQUIRE_STATS),
                 **({"telemetry": result["telemetry"]} if "telemetry" in result else {}),
                 **({"introspect": result["introspect"]} if "introspect" in result else {}),
             },

@@ -71,3 +71,58 @@ def test_preflight_raises_actionable_error(monkeypatch):
     finally:
         jax.config.update("jax_platforms", "cpu")
         device_probe._preflight_cache = None
+
+
+def test_bench_acquire_rides_retry_policy(monkeypatch):
+    """Bench device acquisition runs on the resilience RetryPolicy: a flaky
+    probe that answers on the third poll is healed (and counted), a dead one
+    gives up inside the window — and the retry journal feeds detail.device_acquire."""
+    import importlib.util
+    import sys as _sys
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_for_acquire_test",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py"),
+    )
+    bench = importlib.util.module_from_spec(spec)
+    # bench.py is import-safe (all work lives under main()).
+    spec.loader.exec_module(bench)
+
+    calls = {"n": 0}
+
+    def flaky(timeout_s, retries):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            # The detail is raw probe-subprocess stderr; a wedged tunnel can
+            # surface RESOURCE_EXHAUSTED, which default_retryable refuses —
+            # the acquire policy must retry it anyway (fresh interpreter per
+            # attempt, not a repeated allocation).
+            return False, f"RESOURCE_EXHAUSTED flake {calls['n']}"
+        return True, "8 devices"
+
+    monkeypatch.setattr(
+        device_probe, "probe_device_backend", flaky
+    )
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None, raising=False)
+    import time as _time
+    monkeypatch.setattr(_time, "sleep", lambda s: None)
+
+    ok, detail, attempts = bench._acquire_device(
+        deadline_s=30.0, attempt_timeout_s=5.0, wait_s=0.01
+    )
+    assert ok and attempts == 3 and detail == "8 devices"
+    stats = bench._ACQUIRE_STATS
+    assert stats["ok"] and stats["attempts"] >= 3 and stats["retries"] >= 2
+
+    calls["n"] = 0
+
+    def dead(timeout_s, retries):
+        calls["n"] += 1
+        return False, "wedged"
+
+    monkeypatch.setattr(device_probe, "probe_device_backend", dead)
+    ok, detail, attempts = bench._acquire_device(
+        deadline_s=1.0, attempt_timeout_s=0.5, wait_s=0.01
+    )
+    assert not ok and detail == "wedged" and attempts >= 1
+    assert not bench._ACQUIRE_STATS["ok"]

@@ -1,0 +1,438 @@
+"""Elastic topology resume (``accelerate_tpu/resilience/elastic.py``):
+manifest topology records, cross-mesh resume planning/validation, RNG-stream
+folding, skip_first_batches geometry recompute, legacy back-compat, and the
+chaos-campaign schedule."""
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from accelerate_tpu import Accelerator, telemetry
+from accelerate_tpu.accelerator import JaxModel
+from accelerate_tpu.resilience import (
+    ElasticTopologyError,
+    capture_topology,
+    faultinject,
+    fold_rng_bundle,
+    plan_resume,
+    read_manifest,
+    recompute_skip_batches,
+    reshard_tree,
+    state_digest,
+    validate_leaves,
+)
+from accelerate_tpu.resilience.elastic import TOPOLOGY_KEY, restore_rng_for_rank
+from accelerate_tpu.utils import ProjectConfiguration
+from accelerate_tpu.utils.dataclasses import ParallelismConfig
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_TPU_IO_RETRY_BASE_S", "0.01")
+    faultinject.reload()
+    yield
+    faultinject.reload()
+    telemetry.disable()
+    telemetry.get_telemetry().registry.reset()
+
+
+def _reset_singletons():
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+
+def _toy_accelerator(tmp_path, zero=True, steps=1):
+    """dp=8 jax-native accelerator, a deterministic two-leaf model, ``steps``
+    fused optimizer steps (ZeRO optional) — the save side of every elastic
+    scenario here."""
+    from accelerate_tpu.parallel.sharding import data_sharding
+
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(dp=8),
+        project_config=ProjectConfiguration(project_dir=str(tmp_path)),
+    )
+    params = {
+        "w": jax.random.normal(jax.random.PRNGKey(0), (64, 32), jnp.float32) * 0.1,
+        "b": jax.random.normal(jax.random.PRNGKey(1), (32,), jnp.float32) * 0.1,
+    }
+
+    def apply_fn(p, x, y):
+        pred = jnp.tanh(x @ p["w"] + p["b"])
+        return {"loss": jnp.mean((pred - y) ** 2)}
+
+    model, opt = acc.prepare(JaxModel(apply_fn, params), optax.adam(1e-2))
+    step_fn = acc.make_train_step(model, opt, clip_norm=0.05, zero=zero)
+    sh = data_sharding(acc.mesh)
+    for i in range(steps):
+        batch = {
+            "x": jax.device_put(
+                np.asarray(jax.random.normal(jax.random.PRNGKey(100 + i), (16, 64)), np.float32), sh
+            ),
+            "y": jax.device_put(
+                np.asarray(jax.random.normal(jax.random.PRNGKey(200 + i), (16, 32)), np.float32), sh
+            ),
+        }
+        step_fn(batch)
+    return acc, model, opt
+
+
+def _rewrite_manifest(ckpt, mutate):
+    """Edit a published manifest in place (the manifest itself is not covered
+    by its own hashes, so verification still passes)."""
+    path = os.path.join(ckpt, "manifest.json")
+    with open(path) as f:
+        manifest = json.load(f)
+    mutate(manifest)
+    with open(path, "w") as f:
+        json.dump(manifest, f)
+    return manifest
+
+
+# -- topology capture ---------------------------------------------------------
+
+
+def test_capture_topology_records_full_layout(tmp_path):
+    acc, model, opt = _toy_accelerator(tmp_path, zero=True)
+    topo = capture_topology(acc, step=1)
+    assert topo["schema"] == 1
+    assert topo["parallelism"] == {"dp": 8}
+    assert topo["device_count"] == 8 and topo["world_size"] == 1
+    assert topo["pp"] == {"degree": 1, "virtual_stages": 1}
+    leaves = topo["models"]["0"]
+    assert leaves["['w']"]["shape"] == [64, 32]
+    assert leaves["['b']"]["dtype"] == "float32"
+    assert topo["optimizers"][0]["layout"] == {"kind": "zero", "axes": ["dp"], "degree": 8}
+    # ZeRO shards record their dp placement per opt-state leaf
+    specs = [l["spec"] for l in topo["optimizers"][0]["leaves"]]
+    assert any(s is not None and "dp" in str(s) for s in specs)
+    assert topo["rng"]["streams"] == 1
+
+
+def test_save_state_writes_topology_into_manifest(tmp_path):
+    acc, model, opt = _toy_accelerator(tmp_path)
+    ckpt = acc.save_state(str(tmp_path / "ckpt"), step=1)
+    manifest = read_manifest(ckpt)
+    topo = manifest[TOPOLOGY_KEY]
+    assert topo["step"] == 1 and topo["parallelism"] == {"dp": 8}
+    # the PR-7 field stays alongside for back-compat readers
+    assert manifest["opt_state_layout"][0]["kind"] == "zero"
+
+
+# -- resume planning ----------------------------------------------------------
+
+
+def test_plan_same_topology_reports_unchanged(tmp_path):
+    acc, model, opt = _toy_accelerator(tmp_path)
+    plan = plan_resume(capture_topology(acc, step=1), acc)
+    assert not plan.changed and plan.changes == []
+    assert plan.saved_opt_layouts[0]["kind"] == "zero"
+
+
+def test_plan_detects_mesh_and_world_changes(tmp_path):
+    acc, model, opt = _toy_accelerator(tmp_path)
+    topo = capture_topology(acc, step=1)
+    topo["mesh"] = {"axes": ["dp"], "shape": [4]}
+    topo["device_count"] = 4
+    topo["world_size"] = 2
+    plan = plan_resume(topo, acc)
+    assert plan.changed
+    joined = "; ".join(plan.changes)
+    assert "mesh" in joined and "world_size 2 -> 1" in joined and "device_count 4 -> 8" in joined
+
+
+def test_plan_rejects_pipeline_stage_change(tmp_path):
+    acc, model, opt = _toy_accelerator(tmp_path)
+    topo = capture_topology(acc, step=1)
+    topo["pp"] = {"degree": 4, "virtual_stages": 1}
+    with pytest.raises(ElasticTopologyError, match="pipeline stage geometry"):
+        plan_resume(topo, acc)
+    topo["pp"] = {"degree": 1, "virtual_stages": 2}
+    with pytest.raises(ElasticTopologyError, match="virtual_stages"):
+        plan_resume(topo, acc)
+
+
+def test_plan_rejects_newer_schema(tmp_path):
+    acc, model, opt = _toy_accelerator(tmp_path)
+    topo = capture_topology(acc, step=1)
+    topo["schema"] = 99
+    with pytest.raises(ElasticTopologyError, match="schema v99"):
+        plan_resume(topo, acc)
+
+
+def test_validate_leaves_names_the_offenders(tmp_path):
+    acc, model, opt = _toy_accelerator(tmp_path)
+    topo = capture_topology(acc, step=1)
+    topo["models"]["0"]["['w']"]["shape"] = [128, 32]
+    del topo["models"]["0"]["['b']"]
+    with pytest.raises(ElasticTopologyError) as err:
+        validate_leaves(topo, acc)
+    msg = str(err.value)
+    assert "['w']" in msg and "saved shape [128, 32]" in msg
+    assert "['b']" in msg and "checkpoint does not" in msg
+
+
+def test_validate_leaves_checks_opt_state_count(tmp_path):
+    acc, model, opt = _toy_accelerator(tmp_path)
+    topo = capture_topology(acc, step=1)
+    topo["optimizers"][0]["leaves"] = topo["optimizers"][0]["leaves"][:-1]
+    with pytest.raises(ElasticTopologyError, match="opt-state"):
+        validate_leaves(topo, acc)
+
+
+def test_load_rejects_pp_change_before_touching_state(tmp_path):
+    """A doctored manifest claiming a different pipeline geometry must abort
+    the load with the live params bit-untouched."""
+    acc, model, opt = _toy_accelerator(tmp_path)
+    ckpt = acc.save_state(str(tmp_path / "ckpt"), step=1)
+    _rewrite_manifest(
+        ckpt, lambda m: m[TOPOLOGY_KEY].__setitem__("pp", {"degree": 2, "virtual_stages": 1})
+    )
+    before = state_digest(acc)
+    with pytest.raises(ElasticTopologyError, match="pipeline stage geometry"):
+        acc.load_state(ckpt)
+    assert state_digest(acc) == before
+
+
+def test_cross_topology_load_emits_reshard_event(tmp_path):
+    """Simulated mesh change (manifest claims the checkpoint was saved on
+    dp=4): the load succeeds bit-identically and emits elastic.reshard."""
+    acc, model, opt = _toy_accelerator(tmp_path)
+    ckpt = acc.save_state(str(tmp_path / "ckpt"), step=1)
+    saved = state_digest(acc)
+
+    def claim_dp4(m):
+        m[TOPOLOGY_KEY]["mesh"] = {"axes": ["dp"], "shape": [4]}
+        m[TOPOLOGY_KEY]["device_count"] = 4
+
+    _rewrite_manifest(ckpt, claim_dp4)
+    tel = telemetry.enable(dir=str(tmp_path / "tel"))
+    resumed = acc.resume_from_latest(str(tmp_path))
+    assert resumed == 1
+    info = acc.last_resume_info
+    assert info.resharded and not info.legacy
+    assert any("mesh" in c for c in info.plan.changes)
+    assert tel.registry.counter("elastic.reshards").value == 1
+    assert state_digest(acc) == saved
+
+
+# -- legacy (pre-elastic) back-compat ----------------------------------------
+
+
+def test_legacy_manifest_loads_byte_identically(tmp_path):
+    """Satellite: a checkpoint whose manifest has NO topology record (a
+    pre-elastic save) must load on a matching mesh exactly as before —
+    bit-identical state, no elastic events, no validation, legacy flag set."""
+    acc, model, opt = _toy_accelerator(tmp_path)
+    ckpt = acc.save_state(str(tmp_path / "ckpt"), step=1)
+    saved = state_digest(acc)
+
+    def strip(m):
+        m.pop(TOPOLOGY_KEY, None)
+        m.pop("opt_state_layout", None)
+
+    _rewrite_manifest(ckpt, strip)
+    assert read_manifest(ckpt).get(TOPOLOGY_KEY) is None
+
+    _reset_singletons()
+    acc2, model2, opt2 = _toy_accelerator(tmp_path / "second", zero=True)
+    tel = telemetry.enable(dir=str(tmp_path / "tel"))
+    resumed = acc2.resume_from_latest(str(tmp_path))
+    assert resumed == 1
+    assert acc2.last_resume_info.legacy and acc2.last_resume_info.plan is None
+    assert acc2.last_resume_info.skip_batches is None
+    assert tel.registry.counter("elastic.reshards").value == 0
+    assert state_digest(acc2) == saved
+
+
+# -- GSPMD relayout helper ----------------------------------------------------
+
+
+def test_reshard_tree_relayouts_bit_identically(tmp_path):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    acc, model, opt = _toy_accelerator(tmp_path)
+    arr = jnp.arange(64 * 32, dtype=jnp.float32).reshape(64, 32)
+    replicated = jax.device_put(arr, NamedSharding(acc.mesh, P()))
+    target = NamedSharding(acc.mesh, P("dp"))
+    out = reshard_tree({"w": replicated}, {"w": target})
+    assert out["w"].sharding == target
+    assert (np.asarray(out["w"]) == np.asarray(arr)).all()
+    # non-sharding targets pass through untouched
+    same = reshard_tree({"w": replicated}, {"w": None})
+    assert same["w"] is replicated
+
+
+# -- RNG stream folding -------------------------------------------------------
+
+
+def test_fold_rng_bundle_is_deterministic_and_distinct():
+    bundle = {"python": None, "numpy": None, "jax_seed": 1234}
+    a = fold_rng_bundle(bundle, rank=2, new_world=4, old_world=2)
+    b = fold_rng_bundle(bundle, rank=2, new_world=4, old_world=2)
+    c = fold_rng_bundle(bundle, rank=3, new_world=4, old_world=2)
+    assert a["jax_seed"] == 1234  # functional root key passes through
+    assert a["python"] == b["python"] and a["numpy"][1].tolist() == b["numpy"][1].tolist()
+    assert a["python"] != c["python"], "ranks must get distinct streams"
+
+
+def test_restore_rng_for_rank_folds_missing_stream(tmp_path):
+    import random as pyrandom
+
+    from accelerate_tpu.checkpointing import _rng_state_bundle
+
+    d = str(tmp_path)
+    pyrandom.seed(7)
+    np.random.seed(7)
+    with open(os.path.join(d, "random_states_0.pkl"), "wb") as f:
+        pickle.dump(_rng_state_bundle(), f)
+
+    # rank 0 restores its own saved stream byte-for-byte
+    want = pyrandom.random()
+    pyrandom.seed(99)
+    assert restore_rng_for_rank(d, 0, {"world_size": 1})
+    assert pyrandom.random() == want
+
+    # rank 2 has no file: legacy (no topology) leaves RNG untouched ...
+    pyrandom.seed(99)
+    assert not restore_rng_for_rank(d, 2, None)
+    # ... but the elastic path folds a deterministic stream from rank 0's
+    assert restore_rng_for_rank(d, 2, {"world_size": 1})
+    first = pyrandom.random()
+    assert restore_rng_for_rank(d, 2, {"world_size": 1})
+    assert pyrandom.random() == first
+
+
+# -- skip_first_batches geometry ---------------------------------------------
+
+
+def test_recompute_skip_batches_geometry():
+    # dp=8 with global batch 16, 3 steps seen -> 48 examples; dp=4 run feeds
+    # global batch 8 -> skip exactly 6 new-geometry batches.
+    assert recompute_skip_batches(3, 16, 8) == 6
+    assert recompute_skip_batches(3, 16, 16) == 3
+    assert recompute_skip_batches(4, 8, 32) == 1
+    assert recompute_skip_batches(None, 16, 8) is None
+    assert recompute_skip_batches(3, None, 8) is None
+    with pytest.raises(ElasticTopologyError, match="not a whole number"):
+        recompute_skip_batches(2, 8, 32 + 1)
+
+
+def test_resume_across_batch_geometry_yields_unseen_examples_exactly(tmp_path):
+    """Satellite: save mid-epoch under one global-batch split, resume under
+    another — the recomputed skip_first_batches geometry makes the resumed
+    loader yield exactly the not-yet-seen examples (no skips, no repeats).
+    The prepared loader's batch_size is PER data shard, so with per-shard
+    batch fixed the GLOBAL batch scales with the data-shard count — exactly
+    what a dp=8 -> dp=4 world-size change does.  Here the split shrinks
+    16 -> 8 examples per global batch (per-shard 2 -> 1 on the 8-dev mesh)."""
+    import torch
+    from torch.utils.data import DataLoader
+
+    data = list(range(256))
+
+    def collate(items):
+        return {"x": torch.tensor(items, dtype=torch.float32)}
+
+    acc = Accelerator(
+        project_config=ProjectConfiguration(project_dir=str(tmp_path)),
+    )
+    dl_a = acc.prepare(DataLoader(data, batch_size=2, collate_fn=collate))
+    assert dl_a.total_batch_size == 16
+    seen = []
+    it = iter(dl_a)
+    for _ in range(3):  # 3 "steps" of global batch 16 -> 48 examples consumed
+        seen.extend(np.asarray(next(it)["x"]).reshape(-1).astype(int).tolist())
+    ckpt = acc.save_state(str(tmp_path / "ckpt"), step=3)
+    assert read_manifest(ckpt)[TOPOLOGY_KEY]["data"]["global_batch_size"] == 16
+
+    _reset_singletons()
+    acc2 = Accelerator(project_config=ProjectConfiguration(project_dir=str(tmp_path / "b")))
+    dl_b = acc2.prepare(DataLoader(data, batch_size=1, collate_fn=collate))
+    assert dl_b.total_batch_size == 8
+    resumed = acc2.resume_from_latest(str(tmp_path))
+    assert resumed == 3
+    info = acc2.last_resume_info
+    assert info.skip_batches == 6  # 48 examples / new global batch 8
+    rest = []
+    for batch in acc2.skip_first_batches(dl_b, info.skip_batches):
+        rest.extend(np.asarray(batch["x"]).reshape(-1).astype(int).tolist())
+    assert sorted(seen + rest) == data, "resumed loader skipped or repeated examples"
+    assert rest == data[48:], "resumed loader must yield exactly the unseen tail"
+
+
+def test_resume_rejects_non_divisible_batch_geometry_before_load(tmp_path):
+    import torch
+    from torch.utils.data import DataLoader
+
+    data = list(range(240))
+
+    def collate(items):
+        return {"x": torch.tensor(items, dtype=torch.float32)}
+
+    acc = Accelerator(project_config=ProjectConfiguration(project_dir=str(tmp_path)))
+    acc.prepare(DataLoader(data, batch_size=2, collate_fn=collate))  # global 16
+    acc.save_state(str(tmp_path / "ckpt"), step=1)  # 16 examples seen
+
+    _reset_singletons()
+    acc2 = Accelerator(project_config=ProjectConfiguration(project_dir=str(tmp_path / "b")))
+    acc2.prepare(DataLoader(data, batch_size=5, collate_fn=collate))  # global 40; 16 % 40 != 0
+    with pytest.raises(ElasticTopologyError, match="not a whole number"):
+        acc2.resume_from_latest(str(tmp_path))
+
+
+# -- chaos campaign schedule --------------------------------------------------
+
+
+def test_chaos_plan_is_deterministic_and_constrained():
+    from accelerate_tpu.resilience.chaos import BASE_TOPOLOGY, plan_campaign
+
+    a = plan_campaign(42)
+    b = plan_campaign(42)
+    assert a == b, "the campaign schedule must be seed-deterministic"
+    assert [c.topology for c in a[:2]] == [BASE_TOPOLOGY] * 2
+    changes = sum(1 for x, y in zip(a, a[1:]) if x.topology != y.topology)
+    assert changes >= 2
+    assert a[-1].fault == "nan", "the trajectory-forking fault must ride the last life"
+    steps = [c.fault_step for c in a]
+    assert all(s is not None and 1 <= s <= 10 for s in steps)
+    # seeds actually vary the schedule somewhere in a small window
+    assert any(plan_campaign(s) != a for s in range(43, 48))
+
+
+# -- cross-topology resume, for real (subprocess) -----------------------------
+
+
+@pytest.mark.slow
+def test_cross_topology_resume_bit_identical_subprocess(tmp_path):
+    """The real-subprocess elastic oracle: a dp=8 (ZeRO) checkpoint resumes
+    in a REAL dp=4 process with a bit-identical state digest and keeps
+    training.  Marked slow for the tier-1 budget — `make elastic-smoke`
+    runs the full matrix (and `make chaos-smoke` the hostile version) on
+    every `make test`; the in-process doctored-manifest tests above keep
+    cross-topology planning/validation/eventing in tier-1."""
+    from accelerate_tpu.resilience.chaos import spawn_life
+
+    root = str(tmp_path / "root")
+    os.makedirs(root)
+    saver = spawn_life(root, str(tmp_path / "saver.json"), "dp8-zero", 2)
+    assert saver["death"] == "completed" and str(2) in saver["digests"]
+    resumer = spawn_life(
+        root, str(tmp_path / "resume.json"), "dp4", 4, save_every=False
+    )
+    assert resumer["resumed_at"] == 2
+    assert resumer["resharded"] is True
+    assert resumer["loaded_digest"] == saver["digests"]["2"]
+    assert resumer["death"] == "completed" and resumer["last_step"] == 4
+    assert all(np.isfinite(v) for v in resumer["losses"].values())

@@ -175,8 +175,9 @@ def test_evaluate_pp_row_thresholds():
     assert evaluate(_passing_measurements(), baseline) == []
 
 
+@pytest.mark.slow
 def test_pp_probe_fused_one_dispatch_and_interleaved_wins_ticks():
-    """The real pp probe inside tier-1: the fused pipeline-parallel train
+    """The real pp probe: the fused pipeline-parallel train
     step must be exactly 1 dispatch per optimizer step for BOTH schedules,
     the interleaved schedule must actually build (tick count v*M + S - 1 <
     the gpipe-equal-work v*(M+S-1)), and the analytic bubble must shrink."""
@@ -194,6 +195,7 @@ def test_pp_probe_fused_one_dispatch_and_interleaved_wins_ticks():
     ) == []
 
 
+@pytest.mark.slow
 def test_pp_row_fails_when_gpipe_only_degraded(monkeypatch):
     """ACCELERATE_TPU_PERF_GATE_DEGRADE=gpipe-only runs the interleaved arm
     on the gpipe schedule — the pp_interleaved_active tripwire must fail the

@@ -452,3 +452,169 @@ def test_prepare_serving_entry_point(gpt2_setup):
     rid = eng.submit([1, 2, 3, 4], 2)
     out = eng.run(max_ticks=200)
     assert len(out[rid]) == 6
+
+
+# -- graceful drain under a PreemptionGuard -----------------------------------
+
+
+def _drain_engine(cfg, params, **overrides):
+    kw = dict(block_size=4, num_blocks=40, max_slots=2, prefill_chunk=8,
+              max_blocks_per_seq=8)
+    kw.update(overrides)
+    return ServingEngine(
+        gpt2.apply_cached, gpt2.init_cache, params, cfg,
+        serving=ServingConfig(**kw),
+    )
+
+
+def test_drain_on_preemption_signal(gpt2_setup, tmp_path):
+    """An installed PreemptionGuard whose signal arrived makes the next tick
+    DRAIN: admission stops, in-flight slots are preempted back to the queue
+    with their emitted tokens, blocks are all freed, and the requeue journal
+    covers exactly the incomplete requests (serving.drained event)."""
+    import os as _os
+    import signal as _signal
+
+    from accelerate_tpu.resilience import PreemptionGuard
+
+    cfg, params = gpt2_setup
+    tel = telemetry.enable(dir=str(tmp_path))
+    eng = _drain_engine(cfg, params)
+    rng = np.random.default_rng(11)
+    prompts = [list(rng.integers(0, cfg.vocab_size, size=n)) for n in (5, 7, 6)]
+    ids = [eng.submit(p, 12) for p in prompts]
+    for _ in range(6):  # some requests mid-flight, at least one decoding
+        eng.step()
+    assert eng.sched.active > 0
+
+    guard = PreemptionGuard(signals=(_signal.SIGTERM,), coordinated=False)
+    guard.install()
+    try:
+        eng.install_preemption_guard(guard)
+        _os.kill(_os.getpid(), _signal.SIGTERM)
+        out = eng.step()  # this tick drains instead of dispatching
+        assert out == [] and eng.drained
+        assert eng.sched.active == 0, "drain left slots occupied"
+        assert eng.cache.allocator.used_blocks == 0, "drain leaked blocks"
+        journal = eng.requeue_journal
+        completed_ids = {c.id for c in eng._finished}
+        assert {r["id"] for r in journal} == set(ids) - completed_ids
+        for rec in journal:
+            assert rec["remaining"] == 12 - len(rec["emitted"])
+            assert rec["prompt"] == prompts[ids.index(rec["id"])]
+        # admission is closed, further ticks are inert no-ops
+        with pytest.raises(RuntimeError, match="drained"):
+            eng.submit([1, 2, 3], 2)
+        dispatches_after = eng.decode_dispatches
+        assert eng.step() == [] and eng.decode_dispatches == dispatches_after
+    finally:
+        guard.uninstall()
+        telemetry.disable()
+    # the serving.drained event landed in the telemetry JSONL
+    found = []
+    for fname in _os.listdir(tmp_path):
+        if not fname.endswith(".jsonl"):
+            continue
+        with open(tmp_path / fname) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("kind") == "event" and rec.get("name") == "serving.drained":
+                    found.append(rec)
+    assert len(found) == 1 and found[0]["incomplete"] == len(journal)
+
+
+def test_drain_journal_resubmission_token_identical(gpt2_setup):
+    """The requeue journal is sufficient to finish the work elsewhere: a
+    successor engine resubmits prompt+emitted with max_new=remaining and the
+    concatenated output is token-identical to the oracle."""
+    import os as _os
+    import signal as _signal
+
+    from accelerate_tpu.resilience import PreemptionGuard
+
+    cfg, params = gpt2_setup
+    rng = np.random.default_rng(23)
+    prompts = [list(rng.integers(0, cfg.vocab_size, size=n)) for n in (6, 9)]
+    max_new = [10, 8]
+    want = {i: _oracle(cfg, params, p, m) for i, (p, m) in enumerate(zip(prompts, max_new))}
+
+    eng = _drain_engine(cfg, params)
+    ids = {eng.submit(p, m): i for i, (p, m) in enumerate(zip(prompts, max_new))}
+    for _ in range(8):
+        eng.step()
+    guard = PreemptionGuard(signals=(_signal.SIGTERM,), coordinated=False)
+    guard.install()
+    try:
+        eng.install_preemption_guard(guard)
+        _os.kill(_os.getpid(), _signal.SIGTERM)
+        eng.step()
+    finally:
+        guard.uninstall()
+    assert eng.drained
+    done = {ids[c.id]: c.tokens for c in eng._finished}
+
+    successor = _drain_engine(cfg, params)
+    rebind = {}
+    for rec in eng.requeue_journal:
+        rid = successor.submit(rec["prompt"] + rec["emitted"], rec["remaining"])
+        rebind[rid] = (ids[rec["id"]], rec)
+    out = successor.run(max_ticks=1000)
+    # every request finishes exactly once: either pre-drain or via the journal
+    assert set(done) | {rebind[rid][0] for rid in out} == set(range(len(prompts)))
+    for rid, tokens in out.items():
+        i, _rec = rebind[rid]
+        assert tokens == want[i], f"request {i} diverged after journal resubmission"
+    for i, tokens in done.items():
+        assert tokens == want[i]
+
+
+def test_drain_without_guard_is_manual_and_idempotent(gpt2_setup):
+    cfg, params = gpt2_setup
+    eng = _drain_engine(cfg, params)
+    rid = eng.submit([1, 2, 3, 4, 5], 6)
+    eng.step()
+    j1 = eng.drain()
+    j2 = eng.drain()
+    assert j1 is not None and j1 == j2 and eng.drained
+    assert [r["id"] for r in j1] == [rid]
+    # a drained engine cannot be re-armed: its journal is final
+    with pytest.raises(RuntimeError, match="already drained"):
+        eng.install_preemption_guard(object())
+
+
+def test_coordinated_guard_uses_local_flag_not_collective(gpt2_setup):
+    """With a multi-host COORDINATED guard the engine must consult the LOCAL
+    flag (calling should_stop would gate a cross-host gather on a per-guard
+    call counter that engine ticks — data-dependent per host — would
+    desynchronize), must NOT drain while no signal arrived, and must drain
+    once the local flag is set."""
+    from accelerate_tpu.resilience import PreemptionGuard
+
+    cfg, params = gpt2_setup
+    eng = _drain_engine(cfg, params)
+    guard = PreemptionGuard(coordinated=True)  # never installed: flag-only
+    eng.install_preemption_guard(guard)
+    rid = eng.submit([1, 2, 3, 4], 8)
+    out = eng.step()  # coordinated branch, flag unset -> a normal tick
+    assert not eng.drained and eng.sched.active == 1
+    guard._flag = True  # the signal handler's only action is setting this
+    eng.step()
+    assert eng.drained and [r["id"] for r in eng.requeue_journal] == [rid]
+
+
+def test_prepare_serving_wires_installed_guard(gpt2_setup, tmp_path):
+    from accelerate_tpu.accelerator import Accelerator
+
+    cfg, params = gpt2_setup
+    acc = Accelerator()
+    guard = acc.enable_preemption_handling(save_dir=str(tmp_path / "ckpt"))
+    try:
+        eng = acc.prepare_serving(
+            gpt2.apply_cached, gpt2.init_cache, params, cfg,
+            block_size=4, num_blocks=20, max_slots=2, prefill_chunk=8,
+            max_blocks_per_seq=8,
+        )
+        assert eng._preemption_guard is guard
+    finally:
+        guard.uninstall()
+        acc._preemption_guard = None
