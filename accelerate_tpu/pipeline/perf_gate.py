@@ -59,6 +59,10 @@ overlap row fails when collectives stop hiding.
 ``=gpipe-only`` runs the pp row's interleaved arm with the gpipe schedule —
 the knob that proves the ``pp_interleaved_active`` tripwire catches a
 silently-degraded pipeline schedule.
+``=badput`` sleeps between the goodput arm's steps (pure idle badput) — the
+knob that proves the **goodput row** (wall-clock productive fraction from
+``telemetry/goodput.py``'s attribution ledger, compiles warmed outside the
+window) actually judges where the wall clock went.
 """
 
 from __future__ import annotations
@@ -413,6 +417,45 @@ def run_probe(
         pp_row = None
         if pp and jax.device_count() >= 4 and jax.device_count() % 4 == 0:
             pp_row = run_pp_probe(degrade=degrade)
+
+        # goodput row: one fused epoch (compiles warmed OUTSIDE the window)
+        # through the wall-clock attribution ledger — the productive fraction
+        # is the runtime proof that steps, not overhead, own the wall clock.
+        # ``degrade="badput"`` sleeps between steps: pure idle badput, the
+        # self-test that this row actually judges the fraction.
+        def goodput_arm():
+            from ..telemetry import goodput as goodput_mod
+
+            acc, model, opt, dl = build()
+            step_fn = acc.make_train_step(model, opt, zero=False)
+            # Pre-staged windows: the row judges the step-dominated regime
+            # (loader overhead has its own host-blocked row above).
+            windows, window = [], []
+            for batch_data in dl:
+                window.append(batch_data)
+                if len(window) == accum:
+                    windows.append(window)
+                    window = []
+            # Warmup epoch: BOTH compiles (the uncommitted-params first call
+            # and the committed-sharding steady-state program) land outside
+            # the measured window.
+            for w in windows:
+                step_fn(w)
+            jax.block_until_ready(model.params)
+            badput_sleep = 0.1 if degrade == "badput" else 0.0
+            # attached() restores any pre-existing ledger: the gate running
+            # inside a goodput-enabled process must not destroy its host
+            # run's accounting.
+            with goodput_mod.attached() as led:
+                for _ in range(epochs):
+                    for w in windows:
+                        step_fn(w)
+                        if badput_sleep:
+                            time.sleep(badput_sleep)
+                jax.block_until_ready(model.params)
+                return led.summary()
+
+        goodput_summary = goodput_arm()
     finally:
         if owns_telemetry:
             telemetry.disable()
@@ -434,6 +477,9 @@ def run_probe(
         "fused_host_blocked_ms_per_step": round(fused_blocked, 3),
         "eager_host_blocked_ms_per_step": round(eager_blocked, 3),
         "zero_active": zero_active,
+        "goodput_productive_frac": round(goodput_summary["goodput_fraction"], 4),
+        "goodput_elapsed_s": round(goodput_summary["elapsed_s"], 3),
+        "goodput_conservation_error_s": goodput_summary["conservation_error_s"],
     }
     if zero_sps is not None:
         measurements.update(
@@ -533,6 +579,36 @@ def evaluate(measurements: dict, baseline: dict) -> list:
                     f"{max_exposed} — ZeRO collectives are no longer hidden behind "
                     "compute (comms/compute overlap regressed)"
                 )
+    # goodput row: the wall-clock productive fraction of a fused epoch (the
+    # attribution-ledger audit).  Like the overlap row, a missing number is
+    # a broken check and fails loudly; the conservation residual must also
+    # stay at float noise — a ledger that double-counts is no ledger.
+    min_goodput = baseline.get("min_goodput_productive_frac")
+    if min_goodput is not None:
+        frac = measurements.get("goodput_productive_frac")
+        if frac is None:
+            failures.append(
+                "goodput audit produced no number — the goodput row went "
+                "unchecked"
+            )
+        elif frac < min_goodput:
+            failures.append(
+                f"goodput productive fraction {frac:.3f} < baseline min "
+                f"{min_goodput} — wall-clock is leaking into badput "
+                "(idle/input-wait) around the fused step"
+            )
+    max_conservation = baseline.get("max_goodput_conservation_error_s")
+    if (
+        max_conservation is not None
+        and measurements.get("goodput_conservation_error_s") is not None
+        and abs(measurements["goodput_conservation_error_s"]) > max_conservation
+    ):
+        failures.append(
+            f"goodput conservation error "
+            f"{measurements['goodput_conservation_error_s']} s exceeds "
+            f"{max_conservation} — the ledger's categories no longer sum to "
+            "the elapsed wall-clock window"
+        )
     # pp row: judged only when the arm ran (multi-device probe).  An
     # "interleaved" request that silently built gpipe, a fused pp step that
     # regressed to per-tick dispatches, or an interleaved schedule slower
@@ -608,6 +684,10 @@ def run_gate(baseline_path: Optional[str] = None, probe_kwargs: Optional[dict] =
             f"at {measurements['pp_dispatches_per_step']:.0f} dispatch/step "
             f"(analytic bubble {measurements['pp_analytic_bubble_gpipe']} -> "
             f"{measurements['pp_analytic_bubble_interleaved']})"
+        )
+    if measurements.get("goodput_productive_frac") is not None:
+        zero_note += (
+            f", goodput {measurements['goodput_productive_frac']:.2f} productive"
         )
     print(
         "perf-gate OK — "

@@ -237,10 +237,13 @@ def run_life(
     for i in range(start, total):
         step = i + 1
         if fault == "oom" and fault_step is not None and step == fault_step:
-            os.environ["ACCELERATE_TPU_FAULT_OOM_ONCE"] = "1"
-            faultinject.reload()
+            # The synthetic RESOURCE_EXHAUSTED rides the retry machinery: the
+            # life still dies, but the acquisition fight is narrated into
+            # telemetry (resilience.retry/gave_up events) — which is how the
+            # campaign's goodput ledger attributes this fault to
+            # ``device_acquire``.
             try:
-                faultinject.maybe_oom()
+                faultinject.synthetic_oom_acquire("chaos.device_acquire")
             except RuntimeError as e:
                 assert "RESOURCE_EXHAUSTED" in str(e)
                 death = "oom"
@@ -299,6 +302,11 @@ def child_env(topology: str, extra: Optional[dict] = None) -> dict:
         "ACCELERATE_TPU_ZERO",
         "ACCELERATE_TPU_FAULT_SIGTERM_STEP",
         "ACCELERATE_TPU_FAULT_NAN_STEP",
+        "ACCELERATE_TPU_TELEMETRY",
+        "ACCELERATE_TPU_TELEMETRY_DIR",
+        "ACCELERATE_TPU_GOODPUT",
+        "ACCELERATE_TPU_METRICS_PORT",
+        "ACCELERATE_TPU_METRICS_SNAPSHOT",
     ):
         env.pop(key, None)
     env.update(
@@ -325,12 +333,18 @@ def spawn_life(
     fault: Optional[str] = None,
     fault_step: Optional[int] = None,
     save_every: bool = True,
+    telemetry_dir: Optional[str] = None,
 ) -> dict:
     extra = {}
     if fault == "sigterm" and fault_step is not None:
         extra["ACCELERATE_TPU_FAULT_SIGTERM_STEP"] = str(fault_step)
     if fault == "nan" and fault_step is not None:
         extra["ACCELERATE_TPU_FAULT_NAN_STEP"] = str(fault_step)
+    if telemetry_dir is not None:
+        # The life narrates itself into its own JSONL stream; the campaign
+        # parent replays it through the goodput ledger post-hoc.
+        extra["ACCELERATE_TPU_TELEMETRY"] = "1"
+        extra["ACCELERATE_TPU_TELEMETRY_DIR"] = telemetry_dir
     cmd = [
         sys.executable, "-m", "accelerate_tpu.resilience.chaos",
         "--role", "life", "--ckpt-root", ckpt_root, "--out", out_path,
@@ -408,6 +422,7 @@ def run_campaign(seed: int, total_steps: int = TOTAL_STEPS, workdir: Optional[st
             total_steps,
             fault=cyc.fault,
             fault_step=cyc.fault_step,
+            telemetry_dir=os.path.join(work, f"telemetry_life{cyc.life}"),
         )
         lives.append(rec)
 
@@ -483,6 +498,49 @@ def run_campaign(seed: int, total_steps: int = TOTAL_STEPS, workdir: Optional[st
     resumes = sum(1 for rec in lives if rec["resumed_at"] is not None)
     assert resumes >= 3, f"campaign needs >= 3 kill/resume cycles, got {resumes}"
 
+    # -- goodput-ledger oracle -------------------------------------------------
+    # Each life narrated itself into a telemetry JSONL stream; replaying it
+    # through the goodput ledger must (a) conserve wall time and (b) attribute
+    # every injected fault class to its correct badput category.
+    from ..telemetry import goodput as goodput_mod
+    from ..telemetry.report import load_records
+
+    fault_category = {
+        "sigterm": "preempt",
+        "torn_write": "checkpoint",
+        "oom": "device_acquire",
+        "nan": "rewind_replay",
+    }
+    ledgers = []
+    for cyc in cycles:
+        records = load_records(os.path.join(work, f"telemetry_life{cyc.life}"))
+        assert records, f"life {cyc.life} left no telemetry records"
+        ledger = goodput_mod.summary_from_records(records)
+        assert ledger is not None, f"life {cyc.life}: empty goodput ledger"
+        assert abs(ledger["conservation_error_s"]) < 1e-6, (cyc.life, ledger)
+        assert ledger["seconds"]["productive"] >= 0.0 and all(
+            v >= 0.0 for v in ledger["seconds"].values()
+        ), (cyc.life, ledger["seconds"])
+        category = fault_category[cyc.fault]
+        assert ledger["markers"].get(category, 0) >= 1, (
+            f"life {cyc.life} fault {cyc.fault!r} left no {category!r} marker "
+            f"in its ledger: {ledger['markers']}"
+        )
+        ledgers.append(
+            {
+                "life": cyc.life,
+                "fault": cyc.fault,
+                "category": category,
+                "markers": ledger["markers"],
+                "goodput_fraction": ledger["goodput_fraction"],
+            }
+        )
+    print(
+        "# chaos: goodput ledger attributed every fault class "
+        f"({', '.join(f'{e[0]}->{e[1]}' for e in fault_category.items())})",
+        file=sys.stderr,
+    )
+
     return {
         "seed": seed,
         "cycles": [asdict(c) for c in cycles],
@@ -491,6 +549,7 @@ def run_campaign(seed: int, total_steps: int = TOTAL_STEPS, workdir: Optional[st
         "final_checkpoint": final,
         "final_step": int(manifest["step"]),
         "published": _assert_no_torn_publishes(root),
+        "goodput": ledgers,
     }
 
 
@@ -525,7 +584,10 @@ def main() -> int:
         f"chaos-smoke OK — seed {summary['seed']}: {len(summary['cycles'])} lives, "
         f"{summary['resumes']} kill/resume cycles, {summary['topology_changes']} "
         f"topology changes, {summary['published']} published checkpoints (0 torn), "
-        f"final verified checkpoint at step {summary['final_step']}"
+        f"final verified checkpoint at step {summary['final_step']}; goodput ledger "
+        "conserved + every fault class attributed "
+        "(sigterm->preempt, torn_write->checkpoint, oom->device_acquire, "
+        "nan->rewind_replay)"
     )
     return 0
 

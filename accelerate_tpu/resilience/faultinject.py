@@ -52,6 +52,7 @@ __all__ = [
     "maybe_fail_write",
     "tick",
     "maybe_oom",
+    "synthetic_oom_acquire",
     "reload",
     "nan_armed",
     "grad_poison_scale",
@@ -192,6 +193,31 @@ def maybe_oom() -> None:
         "RESOURCE_EXHAUSTED: injected out-of-memory (fault injection "
         f"{ENV_OOM_ONCE}=1; fires once)"
     )
+
+
+def synthetic_oom_acquire(label: str, tries: int = 2) -> None:
+    """Drive a synthetic RESOURCE_EXHAUSTED through the retry machinery —
+    re-armed per attempt, so the policy exhausts its tries and the
+    acquisition fight is narrated into telemetry (``resilience.retry`` /
+    ``resilience.gave_up`` events, which the goodput ledger attributes to
+    ``device_acquire``) before the final error re-raises.  Shared by the
+    chaos campaign's ``oom`` fault and the goodput smoke; cleans up its own
+    env arming either way."""
+    from .retry import RetryPolicy
+
+    def _acquire():
+        os.environ[ENV_OOM_ONCE] = "1"
+        reload()
+        maybe_oom()
+
+    try:
+        RetryPolicy(
+            tries=max(2, int(tries)), base_delay_s=0.02, max_delay_s=0.05,
+            deadline_s=5.0, retryable=lambda e: True, label=label,
+        ).call(_acquire)
+    finally:
+        os.environ.pop(ENV_OOM_ONCE, None)
+        reload()
 
 
 def nan_armed() -> bool:

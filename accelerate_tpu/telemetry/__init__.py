@@ -18,7 +18,14 @@ Three pillars (see ``docs/usage_guides/telemetry.md``):
   flushed crash-safe on SIGTERM/exit/crash, with online rolling-median
   anomaly detection and a one-shot profiler capture
   (``ACCELERATE_TPU_FLIGHTREC=1``; see ``flightrec.py`` / ``sentinel.py`` /
-  ``docs/package_reference/flightrec.md``).
+  ``docs/package_reference/flightrec.md``);
+- **goodput accounting + metrics export** — the wall-clock attribution
+  ledger (every second classified into exactly one category, with a
+  conservation invariant; ``ACCELERATE_TPU_GOODPUT=1``), fleet straggler
+  aggregation (min-over-hosts goodput), and a Prometheus text-exposition
+  endpoint / atomic snapshot (``ACCELERATE_TPU_METRICS_PORT`` /
+  ``..._SNAPSHOT``; see ``goodput.py`` / ``export.py`` /
+  ``docs/package_reference/goodput.md``).
 
 Default-off: enable with ``ACCELERATE_TPU_TELEMETRY=1`` or
 ``telemetry.enable()``.  Summarize a run with
@@ -53,6 +60,8 @@ from .profile_scan import (
     analyze_trace_dir,
     analyze_trace_file,
 )
+from .export import MetricsExporter, render_prometheus
+from .goodput import FleetAggregator, GoodputLedger
 from .sentinel import AnomalySentinel
 from .timeline import Timeline, TraceEvent, TraceParseError
 from .introspect import (
@@ -102,6 +111,11 @@ __all__ = [
     "lint_reshardings",
     "parse_collectives",
     "scan_hlo",
+    # goodput accounting + metrics export
+    "GoodputLedger",
+    "FleetAggregator",
+    "MetricsExporter",
+    "render_prometheus",
     # trace-driven performance attribution
     "TraceProfileReport",
     "analyze_trace_dir",

@@ -68,6 +68,15 @@ class Telemetry:
         # pipeline.dispatches value at the last completed step — the delta
         # is the dispatches/step gauge.
         self._dispatch_mark = 0
+        # Goodput ledger (goodput.py): when attached, every record written
+        # through this hub is also classified into the wall-clock ledger.
+        self.goodput = None
+        self._goodput_steps = 0
+        # Fleet aggregator (multi-host straggler/goodput gather); resolved
+        # lazily on the first completed step so construction never touches
+        # the backend.
+        self._fleet = None
+        self._fleet_resolved = False
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -100,6 +109,11 @@ class Telemetry:
         if not self._atexit_registered:
             self._atexit_registered = True
             atexit.register(self.disable)
+        from . import export, goodput
+
+        if goodput.enabled_from_env():
+            goodput.attach()
+        export.maybe_start_from_env()
         self.write({"kind": "meta", "event": "enabled", "pid": os.getpid()})
         return self
 
@@ -107,8 +121,22 @@ class Telemetry:
         """Flush the final metrics snapshot and turn everything off."""
         if not self.enabled:
             return
+        if self.goodput is not None:
+            # The ledger's last word lands in the final snapshot (and in the
+            # exporter's final file write below).
+            try:
+                self.goodput.publish(self.registry)
+            except Exception:
+                pass
         self.write({"kind": "metrics", "snapshot": self.registry.snapshot()})
         self.enabled = False
+        from . import export
+
+        export.stop_if_running()
+        self.goodput = None
+        self._goodput_steps = 0
+        self._fleet = None
+        self._fleet_resolved = False
         if self.watchdog is not None:
             self.watchdog.stop()
             self.watchdog = None
@@ -147,6 +175,13 @@ class Telemetry:
                 # crashed run still leaves a parseable file.
                 self._file = open(self.jsonl_path, "a", buffering=1)
             self._file.write(line + "\n")
+        ledger = self.goodput
+        if ledger is not None:
+            # Classify outside the sink lock: the ledger has its own.
+            try:
+                ledger.observe_record(record)
+            except Exception:
+                pass
         if record.get("kind") == "stall":
             # Mirror watchdog stalls into the flight recorder as anomalies:
             # a stalled run is exactly the one about to be killed from
@@ -206,7 +241,45 @@ class Telemetry:
                 dispatches=per_step,
                 host_blocked_ms=blocked.last if blocked is not None else None,
             )
+        if self.goodput is not None:
+            # Cadence-gated: the gauge refresh runs a full interval sweep,
+            # which has no business on every hot-path step — the exporter
+            # re-publishes on each scrape and disable() lands the final
+            # value; this keeps the in-registry gauges merely *fresh-ish*
+            # (first step, then every 16th).
+            self._goodput_steps += 1
+            if self._goodput_steps % 16 == 1:
+                try:
+                    self.goodput.publish(self.registry)
+                except Exception:
+                    pass
+        fleet = self._fleet
+        if fleet is None and not self._fleet_resolved:
+            # Multi-host runs get fleet straggler/goodput aggregation for
+            # free; single-host runs never build the aggregator (tests
+            # install one explicitly via install_fleet_aggregator).
+            self._fleet_resolved = True
+            try:
+                import jax
+
+                if jax.process_count() > 1:
+                    from .goodput import FleetAggregator
+
+                    fleet = self._fleet = FleetAggregator()
+            except Exception:
+                pass
+        if fleet is not None and dt is not None:
+            try:
+                fleet.on_step(dt * 1e3, telemetry=self)
+            except Exception:
+                pass
         self.heartbeat()
+
+    def install_fleet_aggregator(self, aggregator) -> None:
+        """Install (or replace) the fleet aggregator ``record_step`` drives —
+        the explicit entry point for custom cadence/gather wiring and tests."""
+        self._fleet = aggregator
+        self._fleet_resolved = True
 
 
 _TELEMETRY = Telemetry()

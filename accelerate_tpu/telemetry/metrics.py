@@ -18,6 +18,7 @@ wins on ``bench.py``'s MFU metric:
 
 from __future__ import annotations
 
+import bisect
 import collections
 import threading
 import time
@@ -70,11 +71,23 @@ class Gauge:
 
 class Histogram:
     """Streaming distribution: exact count/sum/min/max plus a bounded window of
-    recent observations for percentile estimates."""
+    recent observations for percentile estimates, and exact per-bucket counts
+    over fixed bounds so the Prometheus exporter (``export.py``) can render a
+    true ``_bucket``/``_sum``/``_count`` triplet over ALL observations, not
+    just the recent window."""
 
-    __slots__ = ("name", "count", "total", "min", "max", "last", "_recent")
+    __slots__ = ("name", "count", "total", "min", "max", "last", "_recent", "bucket_counts")
 
     WINDOW = 1024
+    # Exposition bucket upper bounds.  The registry's histograms are
+    # millisecond-scale latencies (step time, TTFT, compile ms), so the
+    # bounds span sub-ms to a minute; an implicit +Inf bucket catches the
+    # rest.  Unit-free values (tokens/s) still render correctly — bucket
+    # placement is just coarser.
+    BOUNDS = (
+        1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+        1000.0, 2500.0, 5000.0, 10000.0, 30000.0, 60000.0,
+    )
 
     def __init__(self, name: str):
         self.name = name
@@ -84,6 +97,7 @@ class Histogram:
         self.max = None
         self.last = None
         self._recent = collections.deque(maxlen=self.WINDOW)
+        self.bucket_counts = [0] * (len(self.BOUNDS) + 1)
 
     def observe(self, value):
         value = float(value)
@@ -93,6 +107,15 @@ class Histogram:
         self.min = value if self.min is None else min(self.min, value)
         self.max = value if self.max is None else max(self.max, value)
         self._recent.append(value)
+        self.bucket_counts[bisect.bisect_left(self.BOUNDS, value)] += 1
+
+    def over_threshold_fraction(self, threshold: float) -> Optional[float]:
+        """Fraction of the RECENT window strictly above ``threshold`` (the
+        SLO burn-rate input; None before any observation)."""
+        if not self._recent:
+            return None
+        over = sum(1 for v in self._recent if v > threshold)
+        return over / len(self._recent)
 
     def summary(self) -> dict:
         if self.count == 0:

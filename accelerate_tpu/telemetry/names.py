@@ -1,0 +1,148 @@
+"""Canonical registry of every telemetry name the codebase emits.
+
+The metric surface is now large enough to drift: a renamed counter silently
+breaks dashboards, the Prometheus exporter, the report renderer, and every
+consumer grepping a JSONL stream.  This module is the single source of truth
+— one frozen set per kind — and ``tests/test_metric_names.py`` is the lint:
+it greps every emit site in ``accelerate_tpu/`` and fails when
+
+- an emitted name is missing from this registry (undocumented drift), or
+- a registered name never appears under ``docs/`` (documented nowhere).
+
+Adding a metric therefore means three edits, on purpose: the emit site, this
+registry, and the docs table (``docs/package_reference/telemetry.md`` holds
+the full catalogue).  Dynamic (f-string) names must match a pattern in
+:data:`DYNAMIC_PATTERNS`.
+"""
+
+from __future__ import annotations
+
+import re
+
+__all__ = [
+    "COUNTERS",
+    "GAUGES",
+    "HISTOGRAMS",
+    "EVENTS",
+    "DYNAMIC_PATTERNS",
+    "all_names",
+    "matches_dynamic",
+]
+
+COUNTERS = frozenset({
+    "chaos.cycles",
+    "dataloader.batches",
+    "elastic.reshards",
+    "health.nonfinite_grads",
+    "health.quarantine_skips",
+    "health.quarantined_batches",
+    "health.rewinds",
+    "health.skipped_steps",
+    "jit.cache_hits",
+    "jit.compiles",
+    "memory.oom_halvings",
+    "pipeline.dispatches",
+    "resilience.gave_up",
+    "resilience.preempt_checkpoints",
+    "resilience.preempt_signals",
+    "resilience.retries",
+    "sentinel.anomalies",
+    "serving.completed",
+    "serving.decode_dispatches",
+    "serving.drains",
+    "serving.preempted",
+    "serving.prefill_dispatches",
+    "serving.requests",
+    "serving.tokens",
+    "stall.count",
+    "step.count",
+})
+
+GAUGES = frozenset({
+    "goodput.attributed_s",
+    "goodput.elapsed_s",
+    "goodput.fleet_fraction",
+    "goodput.fleet_hosts",
+    "goodput.fraction",
+    "goodput.straggler_count",
+    # per-category ledger gauges (goodput.{category}_s)
+    "goodput.compile_s",
+    "goodput.checkpoint_s",
+    "goodput.device_acquire_s",
+    "goodput.input_wait_s",
+    "goodput.rewind_replay_s",
+    "goodput.productive_s",
+    "goodput.preempt_s",
+    "goodput.idle_s",
+    "hbm.bytes_in_use",
+    "hbm.peak_bytes",
+    "health.last_grad_norm",
+    "pipeline.dispatches_per_step",
+    "profile.collective_ms",
+    "profile.device_busy_ms",
+    "profile.exposed_collective_ms",
+    "profile.overlap_fraction",
+    "serving.active_slots",
+    "serving.block_occupancy",
+    "serving.blocks_used",
+    "serving.queue_depth",
+    "serving.slo.ttft_target_ms",
+    "serving.slo.ttft_burn_rate",
+    "serving.slo.inter_token_target_ms",
+    "serving.slo.inter_token_burn_rate",
+    "step.mfu",
+    "step.tokens_per_sec",
+})
+
+HISTOGRAMS = frozenset({
+    "jit.compile_ms",
+    "pipeline.host_blocked_ms",
+    "serving.inter_token_ms",
+    "serving.queue_wait_ms",
+    "serving.tokens_per_s",
+    "serving.ttft_ms",
+    "step.time_ms",
+})
+
+EVENTS = frozenset({
+    "chaos.cycle",
+    "checkpoint.publish",
+    "elastic.reshard",
+    "health.rewind",
+    "health.skip",
+    "memory.oom_halving",
+    "resilience.gave_up",
+    "resilience.preempt_checkpoint",
+    "resilience.preempt_signal",
+    "resilience.retry",
+    "sentinel.anomaly",
+    "sentinel.profile_analysis_failed",
+    "sentinel.profile_captured",
+    "sentinel.profile_digest",
+    "sentinel.profile_failed",
+    "sentinel.profile_start",
+    "sentinel.straggler",
+    "serving.drained",
+    "serving.request_complete",
+    "smoke.retried",
+})
+
+# Templates for f-string emit sites: the lint rewrites ``{expr}`` holes to a
+# wildcard and requires the result to match one of these.
+DYNAMIC_PATTERNS = (
+    re.compile(r"^span\..+_ms$"),                 # span.{name}_ms histograms
+    re.compile(r"^introspect\..+\.(flops|comms_bytes)$"),
+    re.compile(r"^goodput\..+_s$"),               # goodput.{category}_s gauges
+    re.compile(r"^serving\.slo\..+_(target_ms|burn_rate)$"),
+)
+
+
+def all_names() -> frozenset:
+    return COUNTERS | GAUGES | HISTOGRAMS | EVENTS
+
+
+def matches_dynamic(name: str) -> bool:
+    """True when ``name`` (an f-string template with ``{...}`` holes replaced
+    by a placeholder, or a concrete runtime name) fits a dynamic pattern."""
+    probe = re.sub(r"\{[^{}]*\}", "X", name)
+    return any(p.match(probe) for p in DYNAMIC_PATTERNS)

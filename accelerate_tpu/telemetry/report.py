@@ -89,8 +89,14 @@ def summarize(records: list[dict]) -> dict:
     snapshot = None
     introspect = {}
     profiles: dict = {}
+    stragglers: dict = {}
     for rec in records:
         kind = rec.get("kind")
+        if kind == "event" and rec.get("name") == "sentinel.straggler":
+            # Latest verdict per host wins: the fleet aggregator emits an
+            # explicit cleared=True event when a previously-named host
+            # recovers, so stale verdicts genuinely age out of the report.
+            stragglers[rec.get("host")] = rec
         if kind == "span":
             name = rec.get("name", "?")
             agg = spans.setdefault(
@@ -118,6 +124,8 @@ def summarize(records: list[dict]) -> dict:
         elif kind == "profile":
             # Latest scan per trace source wins (a re-armed capture re-scans).
             profiles[rec.get("source") or "?"] = rec
+    from .goodput import summary_from_records
+
     return {
         "spans": spans,
         "toplevel_ms": toplevel_ms,
@@ -127,6 +135,11 @@ def summarize(records: list[dict]) -> dict:
         "snapshot": snapshot,
         "introspect": introspect,
         "profiles": profiles,
+        # Wall-clock attribution ledger, recomputed offline from the same
+        # record stream (so a crashed run that never published its goodput
+        # gauges still gets a ledger in the postmortem).
+        "goodput": summary_from_records(records),
+        "stragglers": [stragglers[h] for h in sorted(stragglers, key=lambda x: (x is None, x))],
         "n_records": len(records),
     }
 
@@ -313,6 +326,45 @@ def format_serving_block(snapshot) -> list:
     return lines
 
 
+def format_goodput_block(summary: dict) -> list:
+    """Render the wall-clock attribution ledger (goodput accounting);
+    empty list when there is nothing attributed (no instrumented activity)."""
+    gp = summary.get("goodput")
+    if not gp or gp.get("attributed_s", 0.0) <= 0.0:
+        return []
+    from .goodput import CATEGORIES
+
+    lines = [
+        f"goodput ledger — elapsed {gp['elapsed_s']:.2f}s, "
+        f"productive {100.0 * gp['goodput_fraction']:.1f}% "
+        f"(conservation error {gp['conservation_error_s']:.6f}s)"
+    ]
+    markers = gp.get("markers") or {}
+    for name in CATEGORIES:
+        seconds = gp["seconds"].get(name, 0.0)
+        frac = gp["fractions"].get(name, 0.0)
+        if seconds <= 0.0 and name not in markers:
+            continue
+        mark = f"  [{markers[name]} marker(s)]" if name in markers else ""
+        lines.append(f"  {name:<16} {seconds:>10.3f}s {100.0 * frac:>6.1f}%{mark}")
+    snapshot = summary.get("snapshot") or {}
+    fleet = snapshot.get("goodput.fleet_fraction")
+    if fleet is not None:
+        hosts = snapshot.get("goodput.fleet_hosts")
+        lines.append(
+            f"  fleet goodput (min over {int(hosts) if hosts else '?'} host(s)): "
+            f"{100.0 * fleet:.1f}%"
+        )
+    for s in summary.get("stragglers") or []:
+        if s.get("cleared"):
+            continue  # the host recovered after its last straggler verdict
+        lines.append(
+            f"  STRAGGLER host {s.get('host')}: median {s.get('median_ms')} ms "
+            f"vs fleet {s.get('fleet_median_ms')} ms ({s.get('ratio')}x)"
+        )
+    return lines
+
+
 def format_report(summary: dict) -> str:
     lines = []
     spans = summary["spans"]
@@ -385,6 +437,10 @@ def format_report(summary: dict) -> str:
 
         lines.append("")
         lines.append(format_profile_report(report_from_dict(summary["profiles"][source])))
+    goodput = format_goodput_block(summary)
+    if goodput:
+        lines.append("")
+        lines.extend(goodput)
     snapshot = summary["snapshot"]
     serving = format_serving_block(snapshot)
     if serving:
@@ -481,7 +537,12 @@ def main(argv=None) -> int:
         # scraping.  Blocks are present only when their inputs are.
         out: dict = {}
         if records:
-            out["telemetry"] = summarize(records)
+            summary = summarize(records)
+            # The ledger is its own machine contract (bench/perf_gate/chaos
+            # consume it): a stable top-level key, independent of where the
+            # telemetry block's internals move.
+            out["goodput"] = summary.pop("goodput", None)
+            out["telemetry"] = summary
         if flight:
             out["postmortem"] = summarize_flight(flight)
         if profile_report is not None:

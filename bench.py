@@ -936,6 +936,96 @@ def _profile_probe() -> dict:
     }
 
 
+def _goodput_probe() -> dict:
+    """Wall-clock attribution micro-benchmark (telemetry/goodput.py): a short
+    fused CPU run with a NaN-skipped step and a checkpoint save, classified
+    second-by-second by the goodput ledger.  Reports the productive fraction,
+    the per-category split, the fault markers, and the conservation residual
+    — the CPU-tier twin of the fleet operator's first question."""
+    import tempfile
+
+    import torch
+
+    from accelerate_tpu import Accelerator, telemetry
+    from accelerate_tpu.resilience import faultinject
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.telemetry import goodput as goodput_mod
+    from accelerate_tpu.utils import set_seed
+
+    telemetry.enable(dir=tempfile.mkdtemp(prefix="atpu_bench_goodput_"))
+    STEPS = 40
+    DIM = 256
+    BATCH = 16
+    NAN_STEP = 7
+
+    class MLPWithLoss(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.net = torch.nn.Sequential(
+                torch.nn.Linear(DIM, DIM),
+                torch.nn.Tanh(),
+                torch.nn.Linear(DIM, 1),
+            )
+
+        def forward(self, x, y):
+            pred = self.net(x)
+            return {"loss": torch.nn.functional.mse_loss(pred, y), "logits": pred}
+
+    os.environ["ACCELERATE_TPU_FAULT_NAN_STEP"] = str(NAN_STEP)
+    faultinject.reload()
+    try:
+        import jax
+
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        set_seed(0)
+        acc = Accelerator()
+        model = MLPWithLoss()
+        opt = torch.optim.AdamW(model.parameters(), lr=1e-3)
+        rng = np.random.default_rng(0)
+        data = [
+            {
+                "x": torch.from_numpy(rng.standard_normal((BATCH, DIM)).astype("float32")),
+                "y": torch.from_numpy(rng.standard_normal((BATCH, 1)).astype("float32")),
+            }
+            for _ in range(STEPS)
+        ]
+        model, opt = acc.prepare(model, opt)
+        acc.enable_health_guard(max_skips=3)
+        dl = acc.prepare_data_loader(data)
+        step_fn = acc.make_train_step(model, opt)
+        # The ledger window opens BEFORE the first (compiling) step: compile
+        # badput is part of this probe's story, unlike the perf-gate row.
+        ledger = goodput_mod.attach()
+        skipped = []
+        for i, batch in enumerate(dl):
+            step_fn(batch)
+            if acc.check_health(step=i + 1).skipped:
+                skipped.append(i + 1)
+        acc.save_state(os.path.join(tempfile.mkdtemp(prefix="atpu_bench_goodput_ck_"), "ckpt"))
+        jax.block_until_ready(model.params)
+        summary = ledger.summary()
+        goodput_mod.detach()
+    finally:
+        del os.environ["ACCELERATE_TPU_FAULT_NAN_STEP"]
+        faultinject.reload()
+
+    seconds = summary["seconds"]
+    return {
+        "goodput": {
+            "optimizer_steps": STEPS,
+            "elapsed_s": round(summary["elapsed_s"], 3),
+            "productive_frac": summary["goodput_fraction"],
+            "seconds": {k: round(v, 4) for k, v in seconds.items()},
+            "markers": summary["markers"],
+            "skipped_steps": skipped,
+            "conservation_error_s": summary["conservation_error_s"],
+            "conservation_ok": abs(summary["conservation_error_s"]) < 1e-6,
+        }
+    }
+
+
 def _serving_probe() -> dict:
     """Continuous-batching serving micro-benchmark (serving/engine.py) on a
     bounded CPU run: a staggered request mix through the paged-KV engine —
@@ -1204,6 +1294,10 @@ def _run_serving_probe_subprocess(timeout_s: float = 240.0):
     return _run_probe_subprocess("serving", timeout_s)
 
 
+def _run_goodput_probe_subprocess(timeout_s: float = 240.0):
+    return _run_probe_subprocess("goodput", timeout_s)
+
+
 def _honor_cpu_env():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from accelerate_tpu.state import honor_cpu_platform_env
@@ -1327,6 +1421,9 @@ def main():
         return
     if "--serving-probe" in sys.argv:
         print(json.dumps(_serving_probe()))
+        return
+    if "--goodput-probe" in sys.argv:
+        print(json.dumps(_goodput_probe()))
         return
     if "--rung" in sys.argv or "--proof-rung" in sys.argv or "--frontier-rung" in sys.argv:
         if "--rung" in sys.argv:
@@ -1651,6 +1748,16 @@ def main():
         serving_block = serving_probe["serving"] if serving_probe else {"status": serving_err}
         print(f"# serving probe: {serving_block}", file=sys.stderr, flush=True)
 
+    # Goodput-attribution probe (telemetry/goodput.py): what fraction of a
+    # short fused run's wall clock was productive step compute, and where the
+    # rest (compile, checkpoint, input wait, health-skip replay) went.  CPU
+    # subprocess, never zeroes the headline.
+    goodput_block = None
+    if os.environ.get("BENCH_GOODPUT_PROBE", "1") != "0":
+        goodput_probe, goodput_err = _run_goodput_probe_subprocess()
+        goodput_block = goodput_probe["goodput"] if goodput_probe else {"status": goodput_err}
+        print(f"# goodput probe: {goodput_block}", file=sys.stderr, flush=True)
+
     detail = {
         "config": result["config"],
         "rung": rung_cfg,
@@ -1680,6 +1787,8 @@ def main():
         detail["profile"] = profile_block
     if serving_block is not None:
         detail["serving"] = serving_block
+    if goodput_block is not None:
+        detail["goodput"] = goodput_block
     if proof is not None:
         detail["hbm_bound_proof"] = {
             "config": proof_cfg,
@@ -1733,6 +1842,7 @@ if __name__ == "__main__":
             "--pp-probe",
             "--profile-probe",
             "--serving-probe",
+            "--goodput-probe",
         )
     )
     try:

@@ -1,0 +1,254 @@
+"""Prometheus metrics export (telemetry/export.py): text exposition renders
+and parses (counter _total, label escaping, exact histogram
+_bucket/_sum/_count triplets), the SLO burn-rate math, the env-gated
+endpoint + atomic snapshot file (SIGKILL mid-write leaves a parseable
+snapshot), and the disabled-by-default contract.
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from accelerate_tpu import telemetry
+from accelerate_tpu.telemetry import get_telemetry
+from accelerate_tpu.telemetry.export import (
+    MetricsExporter,
+    escape_label_value,
+    maybe_start_from_env,
+    publish_slo_burn_rates,
+    render_prometheus,
+    sanitize_metric_name,
+)
+from accelerate_tpu.telemetry.metrics import Histogram, MetricsRegistry
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_off():
+    telemetry.disable()
+    get_telemetry().registry.reset()
+    yield
+    telemetry.disable()
+
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^{}]*\})?\s+"
+    r"([-+]?[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|[+-]Inf|NaN)$"
+)
+
+
+def parse_exposition(text: str) -> dict:
+    """Strict line-by-line parse; raises AssertionError on malformed lines.
+    Returns {name+labels: float}."""
+    assert text.endswith("\n")
+    samples = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed exposition line: {line!r}"
+        samples[m.group(1) + (m.group(2) or "")] = float(m.group(3))
+    return samples
+
+
+def _populated_registry() -> MetricsRegistry:
+    reg = MetricsRegistry()
+    reg.counter("serving.requests").inc(7)
+    reg.gauge("step.mfu").set(0.42)
+    reg.gauge("unset.gauge")  # value None: must be omitted, not rendered
+    hist = reg.histogram("serving.ttft_ms")
+    for v in (0.5, 3.0, 30.0, 300.0, 3000.0, 70000.0):
+        hist.observe(v)
+    return reg
+
+
+# ---------------------------------------------------------------------------
+# Rendering
+# ---------------------------------------------------------------------------
+
+
+def test_exposition_parses_and_counter_total():
+    samples = parse_exposition(render_prometheus(_populated_registry()))
+    assert samples["accelerate_tpu_serving_requests_total"] == 7
+    assert samples["accelerate_tpu_step_mfu"] == pytest.approx(0.42)
+    assert not any("unset_gauge" in k for k in samples)
+
+
+def test_histogram_triplet_exact():
+    text = render_prometheus(_populated_registry())
+    samples = parse_exposition(text)
+    stem = "accelerate_tpu_serving_ttft_ms"
+    # Cumulative buckets are monotone and +Inf equals _count.
+    bounds = [b for b in Histogram.BOUNDS]
+    counts = [samples[f'{stem}_bucket{{le="{int(b) if b == int(b) else b}"}}'] for b in bounds]
+    assert counts == sorted(counts)
+    assert samples[f'{stem}_bucket{{le="+Inf"}}'] == samples[f"{stem}_count"] == 6
+    assert samples[f"{stem}_sum"] == pytest.approx(0.5 + 3 + 30 + 300 + 3000 + 70000)
+    # The 70000 observation lives ONLY past the last finite bound.
+    assert counts[-1] == 5
+    # Exact bucket placement: 0.5 <= le=1, 3.0 <= le=5 etc.
+    assert samples[f'{stem}_bucket{{le="1"}}'] == 1
+    assert samples[f'{stem}_bucket{{le="5"}}'] == 2
+
+
+def test_sanitize_and_escape():
+    assert sanitize_metric_name("serving.ttft_ms") == "accelerate_tpu_serving_ttft_ms"
+    assert sanitize_metric_name("a-b c.d") == "accelerate_tpu_a_b_c_d"
+    assert sanitize_metric_name("9lives") == "accelerate_tpu__9lives"
+    assert escape_label_value('say "hi"\\now\n') == 'say \\"hi\\"\\\\now\\n'
+
+
+# ---------------------------------------------------------------------------
+# SLO burn rate
+# ---------------------------------------------------------------------------
+
+
+def test_burn_rate_math(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_TPU_SLO_TTFT_MS", "500")
+    monkeypatch.setenv("ACCELERATE_TPU_SLO_AVAILABILITY", "0.99")
+    reg = MetricsRegistry()
+    hist = reg.histogram("serving.ttft_ms")
+    for _ in range(10):
+        hist.observe(100.0)   # within target
+    for _ in range(10):
+        hist.observe(600.0)   # violation
+    rates = publish_slo_burn_rates(reg)
+    # violation rate 0.5 over a 0.01 budget = burn 50.
+    assert rates["serving.slo.ttft_burn_rate"] == pytest.approx(50.0)
+    assert reg.gauge("serving.slo.ttft_target_ms").value == 500.0
+    # No inter-token histogram was ever observed: no gauge materialized.
+    assert reg.peek("serving.slo.inter_token_burn_rate") is None
+
+
+def test_burn_rate_absent_without_serving_traffic():
+    reg = MetricsRegistry()
+    reg.counter("step.count").inc()
+    assert publish_slo_burn_rates(reg) == {}
+    assert reg.peek("serving.slo.ttft_burn_rate") is None
+
+
+# ---------------------------------------------------------------------------
+# Endpoint + snapshot
+# ---------------------------------------------------------------------------
+
+
+def test_endpoint_scrapes_and_404s(tmp_path):
+    telemetry.enable(dir=str(tmp_path))
+    get_telemetry().registry.counter("step.count").inc(3)
+    exporter = MetricsExporter()
+    exporter.start(port=0)
+    try:
+        url = f"http://127.0.0.1:{exporter.port}/metrics"
+        body = urllib.request.urlopen(url, timeout=10).read().decode()
+        samples = parse_exposition(body)
+        assert samples["accelerate_tpu_step_count_total"] == 3
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{exporter.port}/other", timeout=10
+            )
+        assert err.value.code == 404
+    finally:
+        exporter.stop(final_snapshot=False)
+
+
+def test_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("ACCELERATE_TPU_METRICS_PORT", raising=False)
+    monkeypatch.delenv("ACCELERATE_TPU_METRICS_SNAPSHOT", raising=False)
+    assert maybe_start_from_env() is None
+
+
+def test_env_gated_lifecycle_with_final_snapshot(tmp_path, monkeypatch):
+    """ACCELERATE_TPU_METRICS_SNAPSHOT through the real telemetry lifecycle:
+    enable starts the exporter, disable stops it and writes a final snapshot
+    that reflects the end-of-run registry."""
+    snap = tmp_path / "metrics.prom"
+    monkeypatch.setenv("ACCELERATE_TPU_METRICS_SNAPSHOT", str(snap))
+    monkeypatch.setenv("ACCELERATE_TPU_METRICS_SNAPSHOT_EVERY_S", "60")
+    tel = telemetry.enable(dir=str(tmp_path))
+    from accelerate_tpu.telemetry import export
+
+    assert export.get_exporter() is not None and export.get_exporter().running
+    tel.registry.counter("step.count").inc(5)
+    telemetry.disable()
+    assert not export.get_exporter().running
+    samples = parse_exposition(snap.read_text())
+    assert samples["accelerate_tpu_step_count_total"] == 5
+
+
+def test_snapshot_atomic_rewrite(tmp_path):
+    telemetry.enable(dir=str(tmp_path))
+    get_telemetry().registry.counter("step.count").inc()
+    exporter = MetricsExporter()
+    path = tmp_path / "m.prom"
+    exporter.start(snapshot_path=str(path), snapshot_every_s=60.0)
+    try:
+        first = path.read_text()
+        parse_exposition(first)
+        get_telemetry().registry.counter("step.count").inc()
+        exporter.write_snapshot()
+        assert parse_exposition(path.read_text())["accelerate_tpu_step_count_total"] == 2
+        assert not (tmp_path / "m.prom.tmp").exists()  # temp never lingers
+    finally:
+        exporter.stop(final_snapshot=False)
+
+
+def test_snapshot_survives_sigkill_mid_write(tmp_path):
+    """A writer SIGKILLed while hammering snapshots must leave a complete,
+    parseable file on disk (write-temp + os.replace) — never a torn one."""
+    script = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+from accelerate_tpu import telemetry
+from accelerate_tpu.telemetry.export import MetricsExporter
+tel = telemetry.enable(dir=sys.argv[1])
+for i in range(4000):
+    tel.registry.counter("step.count").inc()
+    tel.registry.histogram("step.time_ms").observe(float(i % 97))
+exp = MetricsExporter()
+exp._snapshot_path = sys.argv[2]
+print("READY", flush=True)
+while True:
+    exp.write_snapshot()
+"""
+    path = tmp_path / "kill.prom"
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script, str(tmp_path / "tel"), str(path)],
+        stdout=subprocess.PIPE,
+        text=True,
+        env={**os.environ, "ACCELERATE_TPU_CHECKPOINT_FSYNC": "0"},
+    )
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        deadline = time.time() + 20
+        while not path.exists() and time.time() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.2)  # let it race through many rewrites
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    samples = parse_exposition(path.read_text())
+    assert samples["accelerate_tpu_step_count_total"] == 4000
+
+
+def test_render_includes_goodput_and_burn_rates_live(tmp_path, monkeypatch):
+    """render() refreshes the derived gauges: an attached ledger and serving
+    traffic both land in the same scrape."""
+    monkeypatch.setenv("ACCELERATE_TPU_SLO_TTFT_MS", "500")
+    tel = telemetry.enable(dir=str(tmp_path))
+    from accelerate_tpu.telemetry import goodput
+
+    led = goodput.attach(start_t=time.time() - 1.0)
+    led.note_interval("productive", led.start_t, led.start_t + 0.25)
+    tel.registry.histogram("serving.ttft_ms").observe(600.0)
+    samples = parse_exposition(MetricsExporter().render())
+    assert samples["accelerate_tpu_goodput_productive_s"] == pytest.approx(0.25, abs=0.01)
+    assert "accelerate_tpu_serving_slo_ttft_burn_rate" in samples
+    goodput.detach()

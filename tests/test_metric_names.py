@@ -1,0 +1,111 @@
+"""Event/metric name drift lint (telemetry/names.py): every name the
+codebase emits must be in the canonical registry, every canonical name must
+be documented under docs/, and the registry must not accumulate stale
+entries nobody emits.  Adding a metric is deliberately three edits: the emit
+site, names.py, and the docs catalogue."""
+
+import pathlib
+import re
+
+from accelerate_tpu.telemetry import names
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "accelerate_tpu"
+DOCS = REPO / "docs"
+
+# Literal emit sites: .counter("x") / .gauge("x") / .histogram("x") /
+# .event("x"), with optional whitespace/newlines after the paren (black
+# wraps long calls) and an f-prefix marking dynamic names.
+_EMIT_RE = re.compile(
+    r"\.(counter|gauge|histogram|event)\(\s*(f?)\"([^\"]+)\"", re.S
+)
+# Indirect event emissions: flight-recorder records and raw sink writes.
+_INDIRECT_EVENT_RE = re.compile(
+    r"record\(\s*\"event\",\s*name=\"([^\"]+)\"|\"name\":\s*\"([^\"]+)\"", re.S
+)
+
+_KIND_SETS = {
+    "counter": names.COUNTERS,
+    "gauge": names.GAUGES,
+    "histogram": names.HISTOGRAMS,
+    "event": names.EVENTS,
+}
+
+
+def _scan_sources():
+    literal = {kind: set() for kind in _KIND_SETS}
+    dynamic = []
+    for path in sorted(PKG.rglob("*.py")):
+        text = path.read_text()
+        for m in _EMIT_RE.finditer(text):
+            kind, is_f, name = m.group(1), m.group(2), m.group(3)
+            if is_f:
+                dynamic.append((str(path.relative_to(REPO)), kind, name))
+            else:
+                literal[kind].add(name)
+        for m in _INDIRECT_EVENT_RE.finditer(text):
+            name = m.group(1) or m.group(2)
+            # Only telemetry-style dotted names; raw dict keys like "name"
+            # in unrelated JSON literals are not event emissions.
+            if name and "." in name and re.fullmatch(r"[a-z0-9_.]+", name):
+                literal["event"].add(name)
+    return literal, dynamic
+
+
+def test_every_emitted_name_is_registered():
+    literal, dynamic = _scan_sources()
+    missing = []
+    for kind, emitted in literal.items():
+        for name in sorted(emitted):
+            if name not in _KIND_SETS[kind] and not names.matches_dynamic(name):
+                missing.append((kind, name))
+    assert not missing, (
+        "emitted names missing from telemetry/names.py (add them there AND "
+        f"to the docs catalogue): {missing}"
+    )
+    unmatched = [d for d in dynamic if not names.matches_dynamic(d[2])]
+    assert not unmatched, (
+        f"dynamic (f-string) emit sites with no DYNAMIC_PATTERNS entry: {unmatched}"
+    )
+
+
+def test_every_registered_name_is_emitted_somewhere():
+    """The registry must not rot in the other direction either: a canonical
+    name nobody emits (literally or via a dynamic template) is a stale entry
+    from a rename — delete it."""
+    literal, _ = _scan_sources()
+    emitted = set().union(*literal.values())
+    stale = [
+        name
+        for name in sorted(names.all_names())
+        if name not in emitted and not names.matches_dynamic(name)
+    ]
+    assert not stale, f"registered but never emitted (stale registry entries): {stale}"
+
+
+def test_every_registered_name_is_documented():
+    docs_text = "\n".join(
+        p.read_text() for p in sorted(DOCS.rglob("*.md"))
+    )
+    undocumented = [
+        name for name in sorted(names.all_names()) if name not in docs_text
+    ]
+    assert not undocumented, (
+        "canonical names missing from docs/ (the catalogue lives in "
+        f"docs/package_reference/telemetry.md): {undocumented}"
+    )
+
+
+def test_registered_names_are_well_formed():
+    for name in names.all_names():
+        assert re.fullmatch(r"[a-z0-9_.]+", name), name
+        assert not name.startswith(".") and not name.endswith("."), name
+
+
+def test_kinds_do_not_collide():
+    """One name, one kind: a name registered as two kinds would break the
+    registry's get-or-create type check at runtime."""
+    kinds = [names.COUNTERS, names.GAUGES, names.HISTOGRAMS]
+    for i, a in enumerate(kinds):
+        for b in kinds[i + 1:]:
+            assert not (a & b), a & b

@@ -30,6 +30,8 @@ def _passing_measurements():
         "fused_vs_eager_ratio": 2.0,
         "dispatches_per_step": 1.0,
         "fused_host_blocked_ms_per_step": 2.0,
+        "goodput_productive_frac": 0.3,
+        "goodput_conservation_error_s": 0.0,
     }
 
 
@@ -141,6 +143,42 @@ def test_gate_fails_when_overlap_stripped(monkeypatch):
     assert measurements["zero_exposed_collective_frac"] == 1.0
     failures = evaluate(measurements, load_baseline())
     assert any("exposed-collective fraction" in f for f in failures)
+
+
+# ---------------------------------------------------------------------------
+# goodput row (PR 13): wall-clock attribution ledger audit
+# ---------------------------------------------------------------------------
+
+
+def test_evaluate_goodput_row_thresholds():
+    """The goodput row: a too-low productive fraction fails, a MISSING number
+    fails loudly (the overlap-row convention: a broken audit is a broken
+    check), and a blown conservation residual fails the ledger itself."""
+    baseline = load_baseline()
+    assert 0 < baseline["min_goodput_productive_frac"] < 1
+    assert baseline["max_goodput_conservation_error_s"] > 0
+    assert evaluate(_passing_measurements(), baseline) == []
+    m = dict(_passing_measurements(), goodput_productive_frac=0.01)
+    assert any("goodput productive fraction" in f for f in evaluate(m, baseline))
+    m = dict(_passing_measurements(), goodput_productive_frac=None)
+    assert any("goodput audit produced no number" in f for f in evaluate(m, baseline))
+    m = dict(_passing_measurements(), goodput_conservation_error_s=0.5)
+    assert any("conservation error" in f for f in evaluate(m, baseline))
+
+
+def test_gate_fails_when_badput_degraded(monkeypatch):
+    """ACCELERATE_TPU_PERF_GATE_DEGRADE=badput sleeps between the goodput
+    arm's steps (pure idle badput) — the productive-fraction floor must fail
+    the gate, and the ledger must still conserve."""
+    monkeypatch.setenv("ACCELERATE_TPU_PERF_GATE_DEGRADE", "badput")
+    measurements = run_probe(accum=2, steps=4, dim=64, batch=8, epochs=1, prefetch=0, pp=False)
+    baseline = load_baseline()
+    assert measurements["goodput_productive_frac"] < baseline["min_goodput_productive_frac"]
+    assert abs(measurements["goodput_conservation_error_s"]) <= (
+        baseline["max_goodput_conservation_error_s"]
+    )
+    failures = evaluate(measurements, baseline)
+    assert any("goodput productive fraction" in f for f in failures)
 
 
 # ---------------------------------------------------------------------------
