@@ -1,10 +1,10 @@
 # Test-suite splits mirroring the reference Makefile:25-77.
 
-.PHONY: test test-quick test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels bench telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke pp-smoke profile-smoke serving-smoke elastic-smoke chaos-smoke goodput-smoke perf-gate
+.PHONY: test test-quick test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels bench telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke pp-smoke profile-smoke serving-smoke elastic-smoke chaos-smoke serving-chaos-smoke goodput-smoke perf-gate
 
 PYTEST = python -m pytest -q
 
-test: test-quick telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke pp-smoke profile-smoke serving-smoke elastic-smoke chaos-smoke goodput-smoke perf-gate
+test: test-quick telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke pp-smoke profile-smoke serving-smoke elastic-smoke chaos-smoke serving-chaos-smoke goodput-smoke perf-gate
 	$(PYTEST) tests/
 
 # <5 min tier (VERDICT r5 item 6): oracles, state, sharding-spec/mesh,
@@ -71,9 +71,12 @@ health-smoke:
 # params + opt state bit-identical after the GSPMD relayout (SHA-256 state
 # digest), the manifest topology record validated leaf-by-leaf, and 4
 # post-resume training steps run on each new mesh
-# (docs/usage_guides/resilience.md, "Elastic resume").
+# (docs/usage_guides/resilience.md, "Elastic resume").  Quarantined like
+# resilience-smoke: same multi-subprocess XLA-CPU-under-load workload, same
+# environmental flake class — one loud bounded retry via smoke_retry.
 elastic-smoke:
-	env JAX_PLATFORMS=cpu python -m accelerate_tpu.resilience.elastic_smoke
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.resilience.smoke_retry \
+	  --label elastic-smoke -- python -m accelerate_tpu.resilience.elastic_smoke
 
 # Chaos campaign: a seeded schedule of faults (SIGTERM mid-step, sticky torn
 # checkpoint writes, synthetic OOM, NaN-poisoned gradients) across repeated
@@ -81,8 +84,10 @@ elastic-smoke:
 # zero torn publishes, bit-identical state handoff across topology changes,
 # same-topology bit-exact losses vs an unkilled reference, and a final
 # manifest-complete verified checkpoint (docs/usage_guides/resilience.md).
+# Quarantined with one loud bounded retry (see resilience-smoke note).
 chaos-smoke:
-	env JAX_PLATFORMS=cpu python -m accelerate_tpu.resilience.chaos
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.resilience.smoke_retry \
+	  --label chaos-smoke -- python -m accelerate_tpu.resilience.chaos
 
 # Black-box proof: SIGTERMs a flight-recorder-enabled CPU training run
 # mid-step, asserts the crash-safe JSONL snapshot on disk carries the final
@@ -109,6 +114,20 @@ profile-smoke:
 # telemetry report (docs/usage_guides/serving.md).
 serving-smoke:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.serving.smoke
+
+# Serving-under-fire proof: a seeded campaign mixing an overload burst
+# (exact shed count), a NaN-poisoned request (in-program detection ->
+# quarantine while other slots decode bit-identically), a deadline storm
+# (queued requests shed before any prefill chunk), a SIGTERM drain, and a
+# SIGKILL followed by TWO write-ahead-journal recoveries.  Every surviving
+# request's tokens must equal the offline generate_loop oracle, the block
+# allocator must leak nothing, and shed/expired/quarantined counts must
+# match the plan (docs/usage_guides/serving.md, "Overload & failure
+# handling").  Quarantined with one loud bounded retry (subprocess XLA-CPU
+# workload, same flake class as resilience-smoke).
+serving-chaos-smoke:
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.resilience.smoke_retry \
+	  --label serving-chaos-smoke -- python -m accelerate_tpu.serving.chaos
 
 # Goodput-accounting proof: a short chaos-style CPU run with every badput
 # source injected (NaN health-skip, torn checkpoint write, synthetic OOM,

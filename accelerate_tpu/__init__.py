@@ -88,7 +88,7 @@ def __getattr__(name):
         from . import resilience
 
         return getattr(resilience, name)
-    if name in ("ServingEngine", "ServingConfig"):
+    if name in ("ServingEngine", "ServingConfig", "AdmissionRejected", "ServingJournal"):
         from . import serving
 
         return getattr(serving, name)

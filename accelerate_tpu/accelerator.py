@@ -1357,6 +1357,16 @@ class Accelerator:
         registry as the ``serving.*`` families; completions emit
         ``serving.request_complete`` events the flight recorder mirrors.
 
+        The engine is production-robust out of the box: bound the queue with
+        ``max_queue_depth`` (overload sheds with a typed
+        ``AdmissionRejected``), set default TTFT/total deadlines
+        (``default_ttft_deadline_ms`` / ``default_deadline_ms``), quarantine
+        NaN-poisoned requests via in-program detection, and arm the
+        crash-recovery write-ahead journal with ``journal_path`` (a
+        SIGKILLed engine's successor rebuilds its queue via
+        ``recover_from_journal`` and finishes token-identically) — see
+        "Overload & failure handling" in ``docs/usage_guides/serving.md``.
+
         ``apply_cached``/``init_cache`` are a family's cached-inference pair
         (``models/{gpt2,llama,mixtral}.py`` — fp or int8 KV); ``params`` stay
         wherever the caller placed them (replicated params keep the decode

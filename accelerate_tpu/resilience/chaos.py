@@ -563,7 +563,18 @@ def main() -> int:
     parser.add_argument("--fault-step", type=int, default=None)
     parser.add_argument("--no-save", action="store_true")
     parser.add_argument("--seed", type=int, default=20260804)
+    parser.add_argument(
+        "--mode", choices=("train", "serving"), default="train",
+        help="'serving' runs the serving chaos campaign (overload burst, "
+        "poisoned request, deadline storm, SIGTERM drain, SIGKILL + journal "
+        "recovery) instead of the kill->resume training campaign",
+    )
     args = parser.parse_args()
+
+    if args.mode == "serving":
+        from ..serving.chaos import main as serving_main
+
+        return serving_main(["--seed", str(args.seed)])
 
     if args.role == "life":
         os.environ.setdefault("JAX_PLATFORMS", "cpu")

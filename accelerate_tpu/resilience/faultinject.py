@@ -30,6 +30,14 @@ under test can be broken without code changes (``make resilience-smoke`` and
   floating-point tensors.  Unlike ``NAN_STEP`` this is a property of the
   *data*, so it re-fires on every replay — the trigger for the health
   guard's bad-batch quarantine.
+- ``ACCELERATE_TPU_FAULT_SERVING_NAN_REQUEST=<n>`` — poison the ``n``-th
+  request (1-based, per engine) submitted to a :class:`ServingEngine`: its
+  logits are multiplied by NaN inside the fused decode program on its first
+  decode dispatch (the poison rides in as a traced per-slot scalar, so the
+  1-dispatch invariant holds while injecting — the ``NAN_STEP`` trick).
+  The engine's in-program non-finite detection must quarantine exactly that
+  request while every other slot keeps decoding bit-identically
+  (``make serving-chaos-smoke`` proves this).  Fires once.
 
 Zero overhead when unarmed: the env is read once, and every hook is a single
 ``if`` on a cached None.
@@ -58,6 +66,7 @@ __all__ = [
     "grad_poison_scale",
     "bad_batch_index",
     "maybe_poison_batch",
+    "serving_nan_ordinal",
 ]
 
 ENV_WRITE_N = "ACCELERATE_TPU_FAULT_WRITE_N"
@@ -67,6 +76,7 @@ ENV_OOM_ONCE = "ACCELERATE_TPU_FAULT_OOM_ONCE"
 ENV_NAN_STEP = "ACCELERATE_TPU_FAULT_NAN_STEP"
 ENV_NAN_COUNT = "ACCELERATE_TPU_FAULT_NAN_COUNT"
 ENV_BAD_BATCH = "ACCELERATE_TPU_FAULT_BAD_BATCH"
+ENV_SERVING_NAN = "ACCELERATE_TPU_FAULT_SERVING_NAN_REQUEST"
 
 
 class InjectedWriteError(OSError):
@@ -76,7 +86,7 @@ class InjectedWriteError(OSError):
 class _Config:
     __slots__ = (
         "write_n", "write_sticky", "sigterm_step", "oom_once",
-        "nan_step", "nan_count", "bad_batch",
+        "nan_step", "nan_count", "bad_batch", "serving_nan",
     )
 
     def __init__(self):
@@ -95,6 +105,7 @@ class _Config:
         self.nan_step = _int(ENV_NAN_STEP)
         self.nan_count = _int(ENV_NAN_COUNT) or 1
         self.bad_batch = _int(ENV_BAD_BATCH)
+        self.serving_nan = _int(ENV_SERVING_NAN)
 
     @property
     def any_armed(self) -> bool:
@@ -104,6 +115,7 @@ class _Config:
             or self.oom_once
             or self.nan_step is not None
             or self.bad_batch is not None
+            or self.serving_nan is not None
         )
 
 
@@ -125,7 +137,7 @@ def _config() -> _Config:
                 f"write_n={_cfg.write_n} sticky={_cfg.write_sticky} "
                 f"sigterm_step={_cfg.sigterm_step} oom_once={_cfg.oom_once} "
                 f"nan_step={_cfg.nan_step} nan_count={_cfg.nan_count} "
-                f"bad_batch={_cfg.bad_batch}"
+                f"bad_batch={_cfg.bad_batch} serving_nan={_cfg.serving_nan}"
             )
     return _cfg
 
@@ -243,6 +255,14 @@ def grad_poison_scale(step: int) -> Optional[float]:
         _nan_fired.add(step)
     logger.warning(f"fault injection: poisoning gradients of step {step} with NaN")
     return float("nan")
+
+
+def serving_nan_ordinal() -> Optional[int]:
+    """The armed 1-based submission ordinal for serving NaN poisoning, or
+    None.  The serving engine checks this ONCE at construction so the
+    unarmed fused decode program carries no poison plumbing at all (the
+    ``nan_armed`` trace-time gating trick)."""
+    return _config().serving_nan
 
 
 def bad_batch_index() -> Optional[int]:

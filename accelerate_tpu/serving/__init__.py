@@ -16,20 +16,34 @@ Three pieces (see ``docs/usage_guides/serving.md``):
 Entry point: :meth:`accelerate_tpu.Accelerator.prepare_serving`, or
 construct :class:`ServingEngine` directly from a model family's
 ``apply_cached``/``init_cache`` pair.
+
+Robustness layer (overload shedding, request deadlines, poison-request
+quarantine, crash-recovery journal): ``engine.py`` + ``journal.py``, proven
+under fire by the seeded serving chaos campaign (``serving/chaos.py``,
+``make serving-chaos-smoke``).
 """
 
 from .blocks import BlockAllocator, BlockOutOfMemory, PagedKVCache
-from .engine import CompletedRequest, ServingConfig, ServingEngine
+from .engine import (
+    AdmissionRejected,
+    CompletedRequest,
+    ServingConfig,
+    ServingEngine,
+)
+from .journal import JournalError, ServingJournal
 from .scheduler import Request, RequestState, Scheduler
 
 __all__ = [
+    "AdmissionRejected",
     "BlockAllocator",
     "BlockOutOfMemory",
     "PagedKVCache",
     "CompletedRequest",
+    "JournalError",
     "Request",
     "RequestState",
     "Scheduler",
     "ServingConfig",
     "ServingEngine",
+    "ServingJournal",
 ]

@@ -1,0 +1,456 @@
+"""Serving chaos campaign: the serving engine under fire, seeded.
+
+``make serving-chaos-smoke`` (or ``python -m accelerate_tpu.serving.chaos``,
+also reachable as ``python -m accelerate_tpu.resilience.chaos --mode
+serving``) drives one engine lineage through every robustness front at once
+— the serving analog of the training chaos campaign:
+
+1. **overload burst** — more submissions than ``max_queue_depth`` can hold;
+   the surplus must shed with :class:`AdmissionRejected` (``serving.shed``),
+   exactly as many as the plan predicts;
+2. **poison request** — ``ACCELERATE_TPU_FAULT_SERVING_NAN_REQUEST`` NaNs
+   one request's logits inside the fused decode; it must quarantine while
+   every other slot keeps decoding bit-identically;
+3. **deadline storm** — a batch of already-expired requests; all must shed
+   from the queue before a prefill chunk is spent on them;
+4. **SIGTERM drain** — a real signal through a ``PreemptionGuard``; the
+   next tick drains and the write-ahead journal persists emitted progress;
+5. **SIGKILL + journal recovery** — a successor recovers the journal,
+   makes progress, and is SIGKILLed mid-flight (no handler runs); a second
+   successor recovers again and finishes everything.
+
+The parent asserts, across the whole campaign:
+
+- **token identity** — every surviving request's tokens equal the offline
+  ``generate_loop`` oracle for its prompt alone, no matter which life (or
+  how many journal recoveries later) completed it;
+- **zero block leaks** — each life that exits cleanly reports its allocator
+  free count back at full capacity;
+- **no starvation** — every non-shed request reaches a terminal state
+  (completed, deadline-expired, or quarantined);
+- **exact fault accounting** — shed / deadline_expired / quarantined
+  counts match the plan, and the SIGKILLed life really died by signal 9.
+
+Fully deterministic for a given ``--seed`` (:func:`plan_serving_campaign`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+from typing import Optional
+
+CHILD_TIMEOUT_S = 600.0
+QUEUE_DEPTH = 4
+MAX_TICKS = 2000
+
+
+def plan_serving_campaign(seed: int) -> dict:
+    """Deterministic request mix for one campaign.  ``burst`` arrives before
+    any tick, so exactly ``len(burst) - queue_depth`` requests shed (queue
+    admission only happens inside ``step``).  The poison ordinal counts
+    ACCEPTED submissions (shed raises before the ordinal increments):
+    ``queue_depth`` burst survivors, then the poison request itself."""
+    import random
+
+    rnd = random.Random(seed)
+
+    def prompt(n):
+        return [rnd.randrange(0, 64) for _ in range(n)]
+
+    burst = [
+        {"tag": f"n{i}", "prompt": prompt(rnd.randint(3, 12)),
+         "max_new": rnd.randint(3, 7)}
+        for i in range(QUEUE_DEPTH + 2)
+    ]
+    poison = {"tag": "poison", "prompt": prompt(rnd.randint(4, 9)),
+              "max_new": rnd.randint(3, 6)}
+    storm = [
+        {"tag": f"s{i}", "prompt": prompt(rnd.randint(3, 8)),
+         "max_new": rnd.randint(2, 5), "deadline_ms": 0.0}
+        for i in range(3)
+    ]
+    # Submitted right before the SIGTERM with zero ticks left: guaranteed
+    # in-flight at the drain, so the SIGKILL-recovery leg always has real
+    # work to hand across TWO journal recoveries.
+    late = [
+        {"tag": f"l{i}", "prompt": prompt(rnd.randint(3, 10)),
+         "max_new": rnd.randint(3, 6)}
+        for i in range(2)
+    ]
+    return {
+        "seed": seed,
+        "queue_depth": QUEUE_DEPTH,
+        "burst": burst,
+        "poison": poison,
+        "poison_ordinal": QUEUE_DEPTH + 1,
+        "storm": storm,
+        "late": late,
+        "expect_shed": [r["tag"] for r in burst[QUEUE_DEPTH:]],
+        "expect_expired": [r["tag"] for r in storm],
+        "survivor_tags": [r["tag"] for r in burst[:QUEUE_DEPTH]]
+        + [r["tag"] for r in late],
+    }
+
+
+# ---------------------------------------------------------------------------
+# Lives (child-process roles)
+# ---------------------------------------------------------------------------
+
+
+def _build_engine(journal_path: str, queue_depth: Optional[int] = None):
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import gpt2
+    from . import ServingConfig, ServingEngine
+
+    cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32)
+    params = gpt2.init_params(cfg, jax.random.key(0))
+    engine = ServingEngine(
+        gpt2.apply_cached, gpt2.init_cache, params, cfg,
+        serving=ServingConfig(
+            block_size=4, num_blocks=40, max_slots=2, prefill_chunk=8,
+            max_blocks_per_seq=8, max_queue_depth=queue_depth,
+            journal_path=journal_path,
+        ),
+    )
+    return engine
+
+
+def _emit(out, record: dict) -> None:
+    """One JSON line per fact, flushed immediately: a SIGKILL later must not
+    lose what already happened (the parent parses whatever landed)."""
+    print(json.dumps(record), file=out, flush=True)
+
+
+def run_first_life(plan: dict, journal_path: str) -> int:
+    """Overload burst -> poison quarantine -> deadline storm -> SIGTERM
+    drain.  Every observable lands on stdout as JSON lines."""
+    from ..resilience import PreemptionGuard
+    from . import AdmissionRejected
+
+    engine = _build_engine(journal_path, queue_depth=plan["queue_depth"])
+    out = sys.stdout
+
+    shed = []
+    for rec in plan["burst"]:
+        try:
+            engine.submit(rec["prompt"], rec["max_new"], tag=rec["tag"])
+        except AdmissionRejected:
+            shed.append(rec["tag"])
+    _emit(out, {"kind": "shed", "tags": shed})
+
+    for _ in range(4):
+        engine.step()
+
+    # Poison request: the armed ordinal (env) matches THIS submission.
+    engine.submit(
+        plan["poison"]["prompt"], plan["poison"]["max_new"],
+        tag=plan["poison"]["tag"],
+    )
+    ticks = 0
+    while engine.quarantined_count < 1 and ticks < MAX_TICKS:
+        engine.step()
+        ticks += 1
+    assert engine.quarantined_count == 1, "poison request never quarantined"
+
+    # Deadline storm: drain the queue enough that overload shedding cannot
+    # race the deadline shed (the storm must die by deadline, not depth).
+    for rec in plan["storm"]:
+        ticks = 0
+        while engine.sched.pending >= plan["queue_depth"] and ticks < MAX_TICKS:
+            engine.step()
+            ticks += 1
+        engine.submit(
+            rec["prompt"], rec["max_new"], tag=rec["tag"],
+            deadline_ms=rec["deadline_ms"],
+        )
+    engine.step()  # expiry runs before admission: the whole storm sheds here
+
+    # Late arrivals: no tick runs between these and the SIGTERM, so they are
+    # guaranteed to ride the journal into the successor lives.
+    for rec in plan["late"]:
+        ticks = 0
+        while engine.sched.pending >= plan["queue_depth"] and ticks < MAX_TICKS:
+            engine.step()
+            ticks += 1
+        engine.submit(rec["prompt"], rec["max_new"], tag=rec["tag"])
+
+    for c in engine.pop_finished():
+        _emit(out, {"kind": "done", "tag": c.tag, "status": c.status,
+                    "tokens": c.tokens})
+
+    # SIGTERM drain through a REAL signal + guard (not a direct drain()).
+    guard = PreemptionGuard(signals=(signal.SIGTERM,), coordinated=False)
+    guard.install()
+    try:
+        engine.install_preemption_guard(guard)
+        os.kill(os.getpid(), signal.SIGTERM)
+        engine.step()  # this tick drains
+    finally:
+        guard.uninstall()
+    assert engine.drained, "SIGTERM did not drain the engine"
+    for c in engine.pop_finished():
+        _emit(out, {"kind": "done", "tag": c.tag, "status": c.status,
+                    "tokens": c.tokens})
+    _emit(out, {
+        "kind": "exit",
+        "counters": {
+            "shed": engine.shed_count,
+            "deadline_expired": engine.deadline_expired_count,
+            "quarantined": engine.quarantined_count,
+        },
+        "drain_pending": [r["tag"] for r in engine.requeue_journal],
+        "free_blocks": engine.cache.allocator.free_blocks,
+        "capacity": engine.cache.allocator.capacity,
+    })
+    return 0
+
+
+def run_victim_life(journal_path: str, kill_after: int) -> int:
+    """Recover the journal, complete ``kill_after`` requests, then SIGKILL
+    ourselves mid-flight — no handler, no drain, no atexit.  The write-ahead
+    journal alone must carry the rest."""
+    engine = _build_engine(journal_path)
+    mapping = engine.recover_from_journal()
+    _emit(sys.stdout, {"kind": "recovered", "count": len(mapping)})
+    completed = 0
+    ticks = 0
+    while ticks < MAX_TICKS:
+        engine.step()
+        ticks += 1
+        for c in engine.pop_finished():
+            _emit(sys.stdout, {"kind": "done", "tag": c.tag,
+                               "status": c.status, "tokens": c.tokens})
+            completed += 1
+        if completed >= kill_after:
+            os.kill(os.getpid(), signal.SIGKILL)
+    raise AssertionError("victim life drained before reaching its kill point")
+
+
+def run_finisher_life(journal_path: str) -> int:
+    """Recover whatever the SIGKILL left behind and finish every request."""
+    engine = _build_engine(journal_path)
+    mapping = engine.recover_from_journal()
+    _emit(sys.stdout, {"kind": "recovered", "count": len(mapping)})
+    engine.run(max_ticks=MAX_TICKS)
+    for c in engine.pop_finished():
+        _emit(sys.stdout, {"kind": "done", "tag": c.tag, "status": c.status,
+                           "tokens": c.tokens})
+    _emit(sys.stdout, {
+        "kind": "exit",
+        "free_blocks": engine.cache.allocator.free_blocks,
+        "capacity": engine.cache.allocator.capacity,
+    })
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Orchestration (parent)
+# ---------------------------------------------------------------------------
+
+
+def _child_env(extra: Optional[dict] = None) -> dict:
+    env = dict(os.environ)
+    for key in (
+        "ACCELERATE_TPU_FAULT_SERVING_NAN_REQUEST",
+        "ACCELERATE_TPU_TELEMETRY",
+        "ACCELERATE_TPU_TELEMETRY_DIR",
+        "XLA_FLAGS",  # token identity across lives needs ONE device layout
+    ):
+        env.pop(key, None)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "ACCELERATE_TPU_COMPILE_CACHE": "",
+            "ACCELERATE_TPU_SENTINEL_PROFILE": "0",
+            "ACCELERATE_TPU_CHECKPOINT_FSYNC": "0",
+        }
+    )
+    env.update(extra or {})
+    return env
+
+
+def _spawn(role: str, plan_path: str, journal_path: str,
+           extra_env: Optional[dict] = None, expect_rc=0,
+           kill_after: Optional[int] = None) -> list[dict]:
+    cmd = [
+        sys.executable, "-m", "accelerate_tpu.serving.chaos",
+        "--role", role, "--plan", plan_path, "--journal", journal_path,
+    ]
+    if kill_after is not None:
+        cmd += ["--kill-after", str(kill_after)]
+    proc = subprocess.run(
+        cmd, env=_child_env(extra_env), capture_output=True, text=True,
+        timeout=CHILD_TIMEOUT_S,
+    )
+    sys.stderr.write(proc.stderr)
+    if proc.returncode != expect_rc:
+        print(proc.stdout)
+        raise RuntimeError(
+            f"serving life {role!r} exited rc={proc.returncode}, "
+            f"expected {expect_rc}"
+        )
+    records = []
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            records.append(json.loads(line))
+    return records
+
+
+def run_serving_campaign(seed: int, workdir: Optional[str] = None) -> dict:
+    """Run the full campaign; asserts every oracle, returns a summary."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import gpt2
+
+    work = workdir or tempfile.mkdtemp(prefix="atpu_serving_chaos_")
+    os.makedirs(work, exist_ok=True)
+    plan = plan_serving_campaign(seed)
+    plan_path = os.path.join(work, "plan.json")
+    with open(plan_path, "w") as f:
+        json.dump(plan, f)
+    journal_path = os.path.join(work, "journal.json")
+
+    # Offline oracle, computed in THIS process: greedy generate_loop per
+    # prompt alone (the same determinism contract the serving smoke uses
+    # cross-process — same code, same params key, same CPU backend).
+    cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32)
+    params = gpt2.init_params(cfg, jax.random.key(0))
+    oracle = {}
+    for rec in plan["burst"] + [plan["poison"]] + plan["storm"] + plan["late"]:
+        out = gpt2.generate(
+            params, jnp.asarray([rec["prompt"]], jnp.int32), cfg,
+            max_new_tokens=rec["max_new"],
+        )
+        oracle[rec["tag"]] = [int(t) for t in np.asarray(out[0])]
+
+    print(f"# serving-chaos: life 0 (burst + poison + storm + SIGTERM drain), seed {seed}",
+          file=sys.stderr)
+    recs0 = _spawn(
+        "first", plan_path, journal_path,
+        extra_env={
+            "ACCELERATE_TPU_FAULT_SERVING_NAN_REQUEST": str(plan["poison_ordinal"]),
+        },
+    )
+    by_kind = lambda recs, kind: [r for r in recs if r["kind"] == kind]
+    shed = by_kind(recs0, "shed")[0]["tags"]
+    assert shed == plan["expect_shed"], (shed, plan["expect_shed"])
+    exit0 = by_kind(recs0, "exit")[0]
+    assert exit0["counters"]["shed"] == len(plan["expect_shed"]), exit0
+    assert exit0["counters"]["deadline_expired"] == len(plan["expect_expired"]), exit0
+    assert exit0["counters"]["quarantined"] == 1, exit0
+    assert exit0["free_blocks"] == exit0["capacity"], f"life 0 leaked blocks: {exit0}"
+
+    done: dict[str, dict] = {}
+
+    def collect(records):
+        for r in by_kind(records, "done"):
+            assert r["tag"] not in done, f"request {r['tag']} completed twice"
+            done[r["tag"]] = r
+
+    collect(recs0)
+    quarantined = [t for t, r in done.items() if r["status"] == "quarantined"]
+    expired = [t for t, r in done.items() if r["status"] == "deadline_expired"]
+    assert quarantined == [plan["poison"]["tag"]], quarantined
+    assert sorted(expired) == sorted(plan["expect_expired"]), expired
+
+    pending = set(exit0["drain_pending"])
+    assert pending >= {r["tag"] for r in plan["late"]}, (
+        f"late requests missing from the drain journal: {pending}"
+    )
+    print(f"# serving-chaos: life 1 (journal recovery, then SIGKILL mid-flight); "
+          f"{len(pending)} pending", file=sys.stderr)
+    recs1 = _spawn(
+        "victim", plan_path, journal_path,
+        expect_rc=-signal.SIGKILL, kill_after=1,
+    )
+    assert by_kind(recs1, "recovered")[0]["count"] == len(pending), recs1
+    collect(recs1)
+
+    print("# serving-chaos: life 2 (journal recovery after SIGKILL, finish everything)",
+          file=sys.stderr)
+    recs2 = _spawn("finisher", plan_path, journal_path)
+    collect(recs2)
+    exit2 = by_kind(recs2, "exit")[0]
+    assert exit2["free_blocks"] == exit2["capacity"], f"life 2 leaked blocks: {exit2}"
+
+    # -- campaign-wide oracles ------------------------------------------------
+    all_tags = {
+        r["tag"]
+        for r in plan["burst"] + [plan["poison"]] + plan["storm"] + plan["late"]
+    }
+    terminal = set(done) | set(shed)
+    assert terminal == all_tags, (
+        f"starvation: requests never reached a terminal state: {all_tags - terminal}"
+    )
+    survivors = [t for t, r in done.items() if r["status"] == "ok"]
+    assert sorted(survivors) == sorted(plan["survivor_tags"]), (
+        survivors, plan["survivor_tags"]
+    )
+    for tag in survivors:
+        assert done[tag]["tokens"] == oracle[tag], (
+            f"survivor {tag} diverged from generate_loop:\n"
+            f"  got  {done[tag]['tokens']}\n  want {oracle[tag]}"
+        )
+
+    return {
+        "seed": seed,
+        "requests": len(all_tags),
+        "survivors": len(survivors),
+        "shed": len(shed),
+        "deadline_expired": len(expired),
+        "quarantined": len(quarantined),
+        "recoveries": 2,
+        "workdir": work,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m accelerate_tpu.serving.chaos",
+    )
+    parser.add_argument("--role", choices=("first", "victim", "finisher"),
+                        default=None)
+    parser.add_argument("--plan", default=None)
+    parser.add_argument("--journal", default=None)
+    parser.add_argument("--kill-after", type=int, default=1)
+    parser.add_argument("--seed", type=int, default=20260804)
+    args = parser.parse_args(argv)
+
+    if args.role is not None:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        with open(args.plan) as f:
+            plan = json.load(f)
+        if args.role == "first":
+            return run_first_life(plan, args.journal)
+        if args.role == "victim":
+            return run_victim_life(args.journal, args.kill_after)
+        return run_finisher_life(args.journal)
+
+    summary = run_serving_campaign(args.seed)
+    print(
+        f"serving-chaos-smoke OK — seed {summary['seed']}: "
+        f"{summary['requests']} requests through overload burst "
+        f"({summary['shed']} shed), a poisoned request "
+        f"({summary['quarantined']} quarantined), a deadline storm "
+        f"({summary['deadline_expired']} expired), SIGTERM drain, and "
+        f"SIGKILL + {summary['recoveries']} journal recoveries; every "
+        f"survivor ({summary['survivors']}) token-identical to generate_loop, "
+        "zero block leaks, terminal state for every request"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,10 +34,43 @@ preemption count — publish through the telemetry registry
 (``serving.*`` families) and each completion emits a
 ``serving.request_complete`` event, which the flight recorder mirrors into
 its durable ring when enabled.
+
+Production-robustness layer (overload / deadlines / quarantine / journal):
+
+- **Overload protection** — ``ServingConfig.max_queue_depth`` bounds the
+  admission queue; past it ``submit`` raises :class:`AdmissionRejected`
+  (``serving.shed`` counter), so a traffic burst degrades to load-shedding
+  instead of unbounded queue growth.
+- **Deadlines** — per-request TTFT and total-latency deadlines (defaults on
+  the config).  Expired QUEUED requests are shed before a prefill chunk is
+  spent on them; expired in-flight requests are cancelled with their blocks
+  freed.  Both complete with ``status="deadline_expired"``
+  (``serving.deadline_expired`` counter); a TTFT expiry observes its
+  elapsed wait into ``serving.ttft_ms`` so the PR 13 SLO burn-rate gauges
+  see the violation instead of a survivorship-biased histogram.
+- **Poison quarantine** — both compiled programs carry an in-program
+  per-slot logit-finiteness check (a reduction folded into the existing
+  dispatch — zero extra dispatch, the health-guard trick).  A non-finite
+  slot's request completes with ``status="quarantined"``
+  (``serving.quarantined`` counter + event) while every other slot keeps
+  decoding bit-identically (vmap lanes are independent).  The quarantined
+  request's pool blocks are **scrubbed to zero before being freed**: the
+  attention mask zeroes a hidden row's *probability*, but ``0 * NaN = NaN``
+  in ``probs @ v``, so a NaN row left in a recycled block would poison its
+  next owner.  ``ACCELERATE_TPU_FAULT_SERVING_NAN_REQUEST`` injects the
+  poison for tests (trace-time-gated, like the train-step NaN knob).
+- **Crash-recovery journal** — ``ServingConfig.journal_path`` arms a
+  write-ahead journal (``serving/journal.py``): admissions and terminal
+  transitions land on disk atomically, the drain path persists emitted
+  progress, and a successor engine's :meth:`recover_from_journal` resubmits
+  every non-terminal request and finishes it token-identically — even
+  after a SIGKILL that skipped every handler.
 """
 
 from __future__ import annotations
 
+import contextlib
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
@@ -53,10 +86,23 @@ from ..models.generation import (
     scatter_token_rows,
 )
 from ..telemetry import get_telemetry
-from .blocks import PagedKVCache
+from .blocks import NULL_BLOCK, PagedKVCache
+from .journal import JournalError, ServingJournal
 from .scheduler import Request, RequestState, Scheduler
 
-__all__ = ["ServingConfig", "ServingEngine", "CompletedRequest"]
+__all__ = [
+    "AdmissionRejected",
+    "ServingConfig",
+    "ServingEngine",
+    "CompletedRequest",
+]
+
+
+class AdmissionRejected(RuntimeError):
+    """Typed load-shedding rejection: the admission queue is at
+    ``max_queue_depth``.  Deliberately NOT a ``ValueError`` — the request
+    was well-formed; the engine is overloaded.  Callers retry with backoff
+    or fail over; the ``serving.shed`` counter records every rejection."""
 
 
 @dataclass
@@ -75,6 +121,17 @@ class ServingConfig:
     - ``max_blocks_per_seq``: block-table width (static); caps any single
       request at ``max_blocks_per_seq * block_size`` cache rows.
     - ``prefill_chunk``: prompt tokens per prefill dispatch (static).
+
+    Robustness knobs (all host-side policy, no effect on the compiled
+    programs):
+
+    - ``max_queue_depth``: admission-queue bound; ``submit`` past it raises
+      :class:`AdmissionRejected` (None = unbounded, the pre-overload
+      behavior).
+    - ``default_ttft_deadline_ms`` / ``default_deadline_ms``: deadlines
+      applied to requests that do not pass their own (None = no deadline).
+    - ``journal_path``: arm the crash-recovery write-ahead journal at this
+      path (see ``serving/journal.py``).
     """
 
     block_size: int = 16
@@ -82,6 +139,10 @@ class ServingConfig:
     max_slots: int = 4
     max_blocks_per_seq: Optional[int] = None
     prefill_chunk: int = 32
+    max_queue_depth: Optional[int] = None
+    default_ttft_deadline_ms: Optional[float] = None
+    default_deadline_ms: Optional[float] = None
+    journal_path: Optional[str] = None
 
     def resolved_max_blocks(self) -> int:
         if self.max_blocks_per_seq is not None:
@@ -91,7 +152,13 @@ class ServingConfig:
 
 @dataclass
 class CompletedRequest:
-    """Completion record: the tokens plus the request's SLO timeline."""
+    """Completion record: the tokens plus the request's SLO timeline.
+
+    ``status`` is ``"ok"`` for a normal completion, ``"deadline_expired"``
+    for a request cancelled/shed past its deadline (``tokens`` holds
+    whatever was emitted before expiry), or ``"quarantined"`` for a request
+    whose decode produced non-finite logits (``tokens`` excludes the
+    poisoned token — it was never meaningful)."""
 
     id: int
     tokens: List[int]
@@ -103,6 +170,8 @@ class CompletedRequest:
     tokens_per_s: Optional[float]
     preemptions: int
     inter_token_ms: List[float] = field(default_factory=list)
+    status: str = "ok"
+    tag: Optional[str] = None
 
 
 class ServingEngine:
@@ -165,15 +234,40 @@ class ServingEngine:
         self.ticks = 0
         self.decode_dispatches = 0
         self.prefill_dispatches = 0
+        self.shed_count = 0
+        self.deadline_expired_count = 0
+        self.quarantined_count = 0
+        self._submissions = 0
+        self._recovering = False
+        # NaN poison injection is gated at TRACE time (the train-step trick):
+        # the unarmed decode program carries no poison plumbing at all; the
+        # in-program finiteness detection is always compiled in.
+        from ..resilience import faultinject
+
+        self._poison_ordinal = faultinject.serving_nan_ordinal()
+        self.journal: Optional[ServingJournal] = (
+            ServingJournal(sc.journal_path) if sc.journal_path else None
+        )
         self._decode_fn = jax.jit(self._build_decode(), donate_argnums=(1,))
         self._prefill_fn = jax.jit(self._build_prefill(), donate_argnums=(1,))
+        # Pre-create the robustness counters so the Prometheus endpoint
+        # exposes serving.shed/deadline_expired/quarantined at 0 from the
+        # first scrape — a dashboard can alert on rate() without waiting for
+        # the first incident to make the series exist.
+        tel = get_telemetry()
+        if tel.enabled:
+            for name in (
+                "serving.shed", "serving.deadline_expired",
+                "serving.quarantined", "serving.journal_recoveries",
+            ):
+                tel.registry.counter(name)
 
     # -- compiled programs ---------------------------------------------------
 
     def _build_decode(self):
         apply_cached, config, names = self._apply_cached, self._config, self._kv_names
 
-        def decode(params, pool, tables, lengths, tokens):
+        def decode(params, pool, tables, lengths, tokens, *poison):
             views = {n: gather_block_view(pool[n], tables) for n in names}
             caches = dict(views, index=lengths)
 
@@ -182,12 +276,18 @@ class ServingEngine:
                 return logits[0, -1], new_cache
 
             logits, new_caches = jax.vmap(one)(caches, tokens)
+            if poison:  # trace-time gate: unarmed programs carry no plumbing
+                logits = logits * poison[0][:, None]
             next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            # Per-slot finiteness, folded into the SAME dispatch (a [S, V]
+            # reduction — zero extra dispatch): a poisoned slot is detected
+            # the tick it happens, before its garbage token is emitted.
+            ok = jnp.all(jnp.isfinite(logits), axis=-1)
             new_pool = {}
             for n in names:
                 rows = extract_token_rows(new_caches[n], lengths, 1)
                 new_pool[n] = scatter_token_rows(pool[n], rows, tables, lengths, 1)
-            return next_tok, new_pool
+            return next_tok, ok, new_pool
 
         return decode
 
@@ -202,11 +302,12 @@ class ServingEngine:
             cache["index"] = length
             logits, new_cache = apply_cached(params, chunk, config, cache)
             next_tok = jnp.argmax(logits[0, n_real - 1], axis=-1).astype(jnp.int32)
+            ok = jnp.all(jnp.isfinite(logits))
             new_pool = {}
             for n in names:
                 rows = extract_token_rows(new_cache[n][None], start, chunk_len)
                 new_pool[n] = scatter_token_rows(pool[n], rows, tables, start, chunk_len)
-            return next_tok, new_pool
+            return next_tok, ok, new_pool
 
         return prefill
 
@@ -239,22 +340,69 @@ class ServingEngine:
         prompt_ids,
         max_new_tokens: int,
         arrival_t: Optional[float] = None,
+        *,
+        tag: Optional[str] = None,
+        ttft_deadline_ms: Optional[float] = None,
+        deadline_ms: Optional[float] = None,
     ) -> int:
         """Queue one request; returns its id.  ``max_new_tokens == 0``
-        completes immediately (the offline loop's contract)."""
+        completes immediately (the offline loop's contract).
+
+        Raises :class:`AdmissionRejected` when the queue is at
+        ``max_queue_depth`` (load shedding — ``serving.shed``); ``ValueError``
+        when the request's geometry can never be served.  Deadlines default
+        from the :class:`ServingConfig`; an explicit per-request value wins
+        (``None`` means "use the default", so a config default cannot be
+        waived per request).  ``tag`` is an opaque caller label carried
+        into the :class:`CompletedRequest`, the journal, and the
+        ``serving.request_complete`` event — the stable identity across a
+        journal recovery, where engine ids change."""
         if self._drained:
             raise RuntimeError(
                 "engine drained after a preemption signal: admission is closed "
                 "and the requeue journal is final — resubmit to a successor "
                 "engine (see engine.requeue_journal)."
             )
-        req = Request(list(np.asarray(prompt_ids).reshape(-1)), max_new_tokens, arrival_t)
+        sc = self.serving
+        if (
+            sc.max_queue_depth is not None
+            and not self._recovering
+            and self.sched.pending >= sc.max_queue_depth
+        ):
+            self.shed_count += 1
+            tel = get_telemetry()
+            if tel.enabled:
+                tel.registry.counter("serving.shed").inc()
+            raise AdmissionRejected(
+                f"admission queue full ({self.sched.pending} >= "
+                f"max_queue_depth {sc.max_queue_depth}): request shed"
+            )
+        req = Request(
+            list(np.asarray(prompt_ids).reshape(-1)),
+            max_new_tokens,
+            arrival_t,
+            tag=tag,
+            ttft_deadline_ms=(
+                ttft_deadline_ms if ttft_deadline_ms is not None
+                else sc.default_ttft_deadline_ms
+            ),
+            deadline_ms=(
+                deadline_ms if deadline_ms is not None else sc.default_deadline_ms
+            ),
+        )
         if req.max_new_tokens == 0:
             now = time.monotonic()
             req.state = RequestState.DONE
             req.admit_t = req.finish_t = now
         else:
             self.sched.submit(req)  # geometry validation may reject — count after
+        self._submissions += 1
+        if self._poison_ordinal is not None and self._submissions == self._poison_ordinal:
+            req._poison_pending = True  # fires on this request's first decode
+        # Write-ahead: the admission lands on disk BEFORE the id is returned,
+        # so every acknowledged request is recoverable after a SIGKILL.
+        if self.journal is not None:
+            self.journal.record_admit(req)
         tel = get_telemetry()
         if tel.enabled:
             tel.registry.counter("serving.requests").inc()
@@ -273,7 +421,11 @@ class ServingEngine:
             self.drain()
             return []
         self.ticks += 1
-        self.sched.admit(now)
+        # Deadline expiry FIRST: an expired queued request is shed before a
+        # slot, a prefill chunk, or any blocks are spent on it.
+        self._expire_deadlines(now)
+        admitted = self.sched.admit(now)
+        self._observe_requeue_waits(admitted)
         self._prefill_tick(now)
         self._decode_tick(now)
         self._publish_gauges()
@@ -335,11 +487,16 @@ class ServingEngine:
                 "emitted": list(req.emitted),
                 "remaining": req.remaining,
                 "preemptions": req.preemptions,
+                "tag": req.tag,
             }
             for req in self.sched.queue
         ]
         self._drained = True
         self.requeue_journal = journal
+        if self.journal is not None:
+            # Persist emitted progress so the successor resumes mid-request
+            # (prompt+emitted) instead of re-decoding from the prompt.
+            self.journal.record_progress(self.sched.queue)
         tel = get_telemetry()
         if tel.enabled:
             tel.registry.counter("serving.drains").inc()
@@ -355,6 +512,152 @@ class ServingEngine:
     def pop_finished(self) -> List[CompletedRequest]:
         out, self._finished = self._finished, []
         return out
+
+    # -- crash recovery ------------------------------------------------------
+
+    def recover_from_journal(self, path: Optional[str] = None) -> Dict[int, int]:
+        """Rebuild a dead predecessor's queue from its write-ahead journal:
+        every journaled request with no terminal record is resubmitted as
+        ``prompt + emitted`` with ``max_new = remaining`` (the bit-exact
+        re-prefill path), so this engine finishes each one token-identically
+        to the uninterrupted run.  Returns ``{old id: new id}``.
+
+        Call BEFORE the first ``submit`` when this engine journals to the
+        same path — the first admission overwrites the file.  Deadlines
+        restart from recovery time (the predecessor's arrival clock died
+        with it); a request that already blew its deadline there was either
+        already shed (terminal in the journal) or gets a fresh budget here.
+        Terminal requests — completed, shed, quarantined — are never
+        replayed."""
+        path = path or self.serving.journal_path
+        if path is None:
+            raise ValueError("no journal path: pass one or set ServingConfig.journal_path")
+        if self.journal is not None and self.journal.flushed and os.path.abspath(
+            path
+        ) == os.path.abspath(self.journal.path):
+            raise JournalError(
+                "this engine already overwrote the journal at "
+                f"{path!r}; recover_from_journal must run before the first submit"
+            )
+        state = ServingJournal.load(path)
+        pending = ServingJournal.pending(state)
+        mapping: Dict[int, int] = {}
+        # Recovery resubmissions bypass the max_queue_depth shed (a dead
+        # engine's backlog is not a traffic burst — shedding here would
+        # silently LOSE acknowledged requests) and batch the journal into
+        # ONE atomic flush: flushing per resubmit would overwrite the
+        # predecessor's file after the first one, so a SIGKILL mid-recovery
+        # would strand the rest with no journal anywhere.
+        batch = self.journal.deferred() if self.journal is not None else contextlib.nullcontext()
+        self._recovering = True
+        try:
+            with batch:
+                for rec in pending:
+                    emitted = rec.get("emitted") or []
+                    rid = self.submit(
+                        rec["prompt"] + list(emitted),
+                        rec["max_new_tokens"] - len(emitted),
+                        tag=rec.get("tag"),
+                        ttft_deadline_ms=rec.get("ttft_deadline_ms"),
+                        deadline_ms=rec.get("deadline_ms"),
+                    )
+                    mapping[rec["id"]] = rid
+        finally:
+            self._recovering = False
+        tel = get_telemetry()
+        if tel.enabled:
+            tel.registry.counter("serving.journal_recoveries").inc()
+            tel.event(
+                "serving.journal_recovered",
+                path=path,
+                recovered=len(mapping),
+                terminal=len(state["done"]),
+            )
+        return mapping
+
+    # -- deadline / quarantine enforcement -----------------------------------
+
+    def _observe_requeue_waits(self, admitted: List[int]) -> None:
+        """Land the re-queue wait samples of just-(re)admitted requests in
+        ``serving.requeue_wait_ms`` — the preemption-wait blind spot that
+        first-admission-only ``queue_wait_ms`` cannot see."""
+        tel = get_telemetry()
+        if not tel.enabled:
+            return
+        hist = tel.registry.histogram("serving.requeue_wait_ms")
+        for idx in admitted:
+            slot = self.sched.slots.get(idx)
+            if slot is None:
+                continue
+            for sample in slot.request.pop_requeue_waits():
+                hist.observe(sample)
+
+    def _expire_deadlines(self, now: float) -> None:
+        """Shed expired QUEUED requests (no prefill chunk is ever spent on a
+        corpse) and cancel expired in-flight ones (blocks freed, slot
+        returned to the pool)."""
+        expired_queued = [req for req in self.sched.queue if req.expired(now)]
+        for req in expired_queued:
+            self.sched.cancel_queued(req)
+            self._finish_expired(req, now)
+        for idx in list(self.sched.slots):
+            req = self.sched.slots[idx].request
+            if req.expired(now):
+                self.sched.finish(idx, now)  # frees the blocks
+                self._finish_expired(req, now)
+
+    def _finish_expired(self, req: Request, now: float) -> None:
+        req.state = RequestState.DONE
+        req.finish_t = now
+        self.deadline_expired_count += 1
+        tel = get_telemetry()
+        if tel.enabled:
+            tel.registry.counter("serving.deadline_expired").inc()
+            if req.first_token_t is None:
+                # Feed the violation into the TTFT histogram so the SLO
+                # burn-rate gauges see it: without this, expired requests
+                # never observe a latency and the burn rate only measures
+                # the survivors.
+                tel.registry.histogram("serving.ttft_ms").observe(
+                    (now - req.arrival_t) * 1e3
+                )
+        self._complete(req, status="deadline_expired")
+
+    def _quarantine(self, idx: int, now: float) -> None:
+        """A slot's logits came back non-finite: complete its request with an
+        error status and scrub its pool blocks to ZERO before freeing them.
+        The scrub is load-bearing, not hygiene — the attention mask zeroes a
+        hidden row's probability, but ``0 * NaN = NaN`` in ``probs @ v``, so
+        a NaN row left in a recycled block would corrupt the block's next
+        owner.  (Finite garbage in recycled blocks is safe for exactly that
+        reason, which is why normal frees never scrub.)"""
+        slot = self.sched.slots[idx]
+        self._scrub_blocks(slot.blocks)
+        req = self.sched.finish(idx, now)
+        self.quarantined_count += 1
+        tel = get_telemetry()
+        if tel.enabled:
+            tel.registry.counter("serving.quarantined").inc()
+            tel.event(
+                "serving.quarantined",
+                request=req.id,
+                tag=req.tag,
+                emitted=len(req.emitted),
+                prompt_len=len(req.prompt),
+            )
+        self._complete(req, status="quarantined")
+
+    def _scrub_blocks(self, blocks: List[int]) -> None:
+        # The NULL block is always scrubbed too: a poisoned request's padded
+        # prefill rows route PAST its block table into block 0 (the
+        # scatter's explicit overflow target), so genuine NaN K/V — unlike
+        # the logits-only injection — can land in the one block every slot's
+        # gathered view shares.  Zero is always safe there: null-block rows
+        # are only ever read at masked positions.
+        idx = jnp.asarray(sorted(set(blocks) | {NULL_BLOCK}), jnp.int32)
+        self.cache.pool = {
+            n: leaf.at[:, idx].set(0) for n, leaf in self.cache.pool.items()
+        }
 
     # -- tick phases ---------------------------------------------------------
 
@@ -384,7 +687,7 @@ class ServingEngine:
             return  # the slot itself was preempted to find blocks
         chunk = np.zeros((1, chunk_len), np.int32)
         chunk[0, :n_real] = feed[start : start + n_real]
-        next_tok, self.cache.pool = self._prefill_fn(
+        next_tok, ok, self.cache.pool = self._prefill_fn(
             self.params,
             self.cache.pool,
             self._table_row(slot.blocks),
@@ -397,6 +700,9 @@ class ServingEngine:
         if tel.enabled:
             tel.registry.counter("serving.prefill_dispatches").inc()
         slot.cache_len = start + n_real
+        if not bool(ok):
+            self._quarantine(idx, time.monotonic())
+            return
         if slot.cache_len == len(feed):
             # Final chunk: its last real logits row IS the next token — the
             # first generated token of a fresh request (TTFT lands here) or
@@ -433,17 +739,34 @@ class ServingEngine:
             tables[idx] = self._table_row(slot.blocks)
             lengths[idx] = slot.cache_len
             tokens[idx] = slot.request.emitted[-1]
-        next_tokens, self.cache.pool = self._decode_fn(
-            self.params, self.cache.pool, tables, lengths, tokens
-        )
+        args = [self.params, self.cache.pool, tables, lengths, tokens]
+        if self._poison_ordinal is not None:
+            # Armed: the program was traced with the poison lane.  NaN rides
+            # into exactly one slot's logits on that request's first decode
+            # dispatch; every other lane multiplies by 1.0 (vmap lanes are
+            # independent, so their tokens are bit-identical to unarmed).
+            poison = np.ones((s,), np.float32)
+            for idx in live:
+                req = sched.slots[idx].request
+                if getattr(req, "_poison_pending", False):
+                    poison[idx] = np.nan
+                    req._poison_pending = False  # fires once
+            args.append(poison)
+        next_tokens, ok_flags, self.cache.pool = self._decode_fn(*args)
         self.decode_dispatches += 1
         tel = get_telemetry()
         if tel.enabled:
             tel.registry.counter("serving.decode_dispatches").inc()
         out = np.asarray(next_tokens)
+        oks = np.asarray(ok_flags)
         emit_t = time.monotonic()
         for idx in live:
             sched.slots[idx].cache_len += 1
+            if not bool(oks[idx]):
+                # Quarantine instead of emitting the garbage argmax; the
+                # other slots' emissions proceed untouched.
+                self._quarantine(idx, emit_t)
+                continue
             self._emit(idx, int(out[idx]), emit_t)
 
     # -- completion / metrics ------------------------------------------------
@@ -468,7 +791,7 @@ class ServingEngine:
             self.sched.finish(idx, now)
             self._complete(req)
 
-    def _complete(self, req: Request) -> None:
+    def _complete(self, req: Request, status: str = "ok") -> None:
         ttft_ms = None
         if req.first_token_t is not None and req.arrival_t is not None:
             ttft_ms = (req.first_token_t - req.arrival_t) * 1e3
@@ -501,8 +824,12 @@ class ServingEngine:
             tokens_per_s=tps,
             preemptions=req.preemptions,
             inter_token_ms=list(req.inter_token_ms),
+            status=status,
+            tag=req.tag,
         )
         self._finished.append(rec)
+        if self.journal is not None:
+            self.journal.record_done(req.id, status)
         tel = get_telemetry()
         if tel.enabled:
             reg = tel.registry
@@ -513,6 +840,8 @@ class ServingEngine:
             tel.event(
                 "serving.request_complete",
                 request=req.id,
+                tag=req.tag,
+                status=status,
                 prompt_len=len(req.prompt),
                 new_tokens=len(req.emitted),
                 ttft_ms=round(ttft_ms, 3) if ttft_ms is not None else None,
@@ -552,5 +881,8 @@ class ServingEngine:
             "block_occupancy": round(alloc.occupancy, 4),
             "completed": len(self._finished),
             "preempted": self.sched.preempted_count,
+            "shed": self.shed_count,
+            "deadline_expired": self.deadline_expired_count,
+            "quarantined": self.quarantined_count,
             "pool_bytes": self.cache.pool_bytes(),
         }

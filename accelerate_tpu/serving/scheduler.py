@@ -47,11 +47,25 @@ class Request:
     ``emitted`` accumulates generated tokens across preemptions; the tokens a
     slot must (re)prefill are always ``prompt + emitted`` — the final chunk's
     logits produce the next emitted token, whether that is the first token of
-    a fresh request or the resume point of a preempted one."""
+    a fresh request or the resume point of a preempted one.
+
+    Deadlines are relative to ``arrival_t``: ``ttft_deadline_ms`` bounds the
+    wait for the FIRST token, ``deadline_ms`` bounds the whole request.  The
+    engine sheds expired queued requests before spending a prefill chunk on
+    them and cancels expired in-flight ones (blocks freed) — see
+    :meth:`ServingEngine.step`."""
 
     _ids = itertools.count()
 
-    def __init__(self, prompt_ids: List[int], max_new_tokens: int, arrival_t: Optional[float] = None):
+    def __init__(
+        self,
+        prompt_ids: List[int],
+        max_new_tokens: int,
+        arrival_t: Optional[float] = None,
+        tag: Optional[str] = None,
+        ttft_deadline_ms: Optional[float] = None,
+        deadline_ms: Optional[float] = None,
+    ):
         if max_new_tokens < 0:
             raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
         if not prompt_ids:
@@ -60,6 +74,9 @@ class Request:
         self.prompt = [int(t) for t in prompt_ids]
         self.max_new_tokens = int(max_new_tokens)
         self.arrival_t = time.monotonic() if arrival_t is None else arrival_t
+        self.tag = tag
+        self.ttft_deadline_ms = ttft_deadline_ms
+        self.deadline_ms = deadline_ms
         self.emitted: List[int] = []
         self.state = RequestState.QUEUED
         # SLO timeline (monotonic seconds; None until the event happens).
@@ -69,6 +86,33 @@ class Request:
         self.last_token_t: Optional[float] = None
         self.inter_token_ms: List[float] = []
         self.preemptions = 0
+        # Re-queue wait accounting: ``admit_t`` records the FIRST admission
+        # only, so time spent re-queued after a preemption would otherwise be
+        # invisible to the queue-wait metrics.  ``requeued_t`` marks each
+        # re-queue; re-admission moves the elapsed wait into
+        # ``requeue_waits_ms``, which the engine drains into the
+        # ``serving.requeue_wait_ms`` histogram (one sample per re-admission).
+        self.requeued_t: Optional[float] = None
+        self.requeue_waits_ms: List[float] = []
+
+    def pop_requeue_waits(self) -> List[float]:
+        out, self.requeue_waits_ms = self.requeue_waits_ms, []
+        return out
+
+    def expired(self, now: float) -> Optional[str]:
+        """``"deadline"`` / ``"ttft"`` when the matching deadline has passed
+        (total first: a request past its overall budget is expired even if
+        its first token already landed), else None."""
+        elapsed_ms = (now - self.arrival_t) * 1e3
+        if self.deadline_ms is not None and elapsed_ms > self.deadline_ms:
+            return "deadline"
+        if (
+            self.ttft_deadline_ms is not None
+            and self.first_token_t is None
+            and elapsed_ms > self.ttft_deadline_ms
+        ):
+            return "ttft"
+        return None
 
     @property
     def to_feed(self) -> List[int]:
@@ -183,9 +227,17 @@ class Scheduler:
             head.state = RequestState.PREFILLING
             if head.admit_t is None:
                 head.admit_t = now
+            if head.requeued_t is not None:
+                head.requeue_waits_ms.append((now - head.requeued_t) * 1e3)
+                head.requeued_t = None
             self.slots[idx] = _Slot(head, next(self._admit_seq))
             admitted.append(idx)
         return admitted
+
+    def cancel_queued(self, request: Request) -> None:
+        """Remove a QUEUED request (deadline shed); the caller completes it
+        with its error status.  Raises ValueError when it is not queued."""
+        self.queue.remove(request)
 
     # -- preemption ----------------------------------------------------------
 
@@ -209,6 +261,7 @@ class Scheduler:
         req = slot.request
         req.state = RequestState.QUEUED
         req.preemptions += 1
+        req.requeued_t = time.monotonic()
         self.preempted_count += 1
         self.queue.appendleft(req)
         return idx

@@ -48,11 +48,15 @@ COUNTERS = frozenset({
     "resilience.retries",
     "sentinel.anomalies",
     "serving.completed",
+    "serving.deadline_expired",
     "serving.decode_dispatches",
     "serving.drains",
+    "serving.journal_recoveries",
     "serving.preempted",
     "serving.prefill_dispatches",
+    "serving.quarantined",
     "serving.requests",
+    "serving.shed",
     "serving.tokens",
     "stall.count",
     "step.count",
@@ -99,6 +103,7 @@ HISTOGRAMS = frozenset({
     "pipeline.host_blocked_ms",
     "serving.inter_token_ms",
     "serving.queue_wait_ms",
+    "serving.requeue_wait_ms",
     "serving.tokens_per_s",
     "serving.ttft_ms",
     "step.time_ms",
@@ -123,6 +128,8 @@ EVENTS = frozenset({
     "sentinel.profile_start",
     "sentinel.straggler",
     "serving.drained",
+    "serving.journal_recovered",
+    "serving.quarantined",
     "serving.request_complete",
     "smoke.retried",
 })

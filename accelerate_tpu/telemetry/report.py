@@ -312,9 +312,18 @@ def format_serving_block(snapshot) -> list:
                 f"({g(f'{stem}.count')} samples)"
             )
 
+    shed = g("serving.shed", 0)
+    expired = g("serving.deadline_expired", 0)
+    quarantined = g("serving.quarantined", 0)
+    if shed or expired or quarantined:
+        lines.append(
+            f"  robustness: {shed} shed (queue bound), "
+            f"{expired} deadline-expired, {quarantined} quarantined"
+        )
     hist("serving.ttft_ms", "TTFT")
     hist("serving.inter_token_ms", "inter-token")
     hist("serving.queue_wait_ms", "queue wait")
+    hist("serving.requeue_wait_ms", "re-queue wait (post-preemption)")
     hist("serving.tokens_per_s", "per-request throughput", unit="tok/s")
     occ = g("serving.block_occupancy")
     if occ is not None:

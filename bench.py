@@ -1082,6 +1082,68 @@ def _serving_probe() -> dict:
     done = engine.pop_finished()
     snap = tel.registry.snapshot()
     tokens = sum(c.new_tokens for c in done)
+
+    # Overload arm: more submissions than slots + queue bound can hold, with
+    # per-request deadlines — measures how the engine DEGRADES (shed rate,
+    # deadline-hit rate) instead of how it cruises, plus the wall time a
+    # successor needs to rebuild a dead engine's queue from the write-ahead
+    # journal and finish the recovered requests (serving/journal.py).
+    from accelerate_tpu.serving import AdmissionRejected
+
+    journal_path = os.path.join(
+        tempfile.mkdtemp(prefix="atpu_bench_serving_j_"), "journal.json"
+    )
+    overload = ServingEngine(
+        gpt2.apply_cached, gpt2.init_cache, params, cfg,
+        serving=ServingConfig(block_size=8, num_blocks=33, max_slots=4,
+                              prefill_chunk=16, max_blocks_per_seq=8,
+                              max_queue_depth=4, default_deadline_ms=300.0,
+                              journal_path=journal_path),
+    )
+    M = 24
+    burst = [
+        (list(rng.integers(0, cfg.vocab_size, size=int(rng.integers(4, 20)))),
+         int(rng.integers(2, 10)))
+        for _ in range(M)
+    ]
+    shed = accepted = 0
+    submitted = 0
+    while submitted < M or not overload.sched.idle():
+        for _ in range(6):  # burst arrivals: 6/tick vs 4 slots + 4 queue
+            if submitted < M:
+                try:
+                    overload.submit(*burst[submitted])
+                    accepted += 1
+                except AdmissionRejected:
+                    shed += 1
+                submitted += 1
+        overload.step()
+    statuses = [c.status for c in overload.pop_finished()]
+    expired = sum(1 for s in statuses if s == "deadline_expired")
+
+    # Journal recovery: admit work, make partial progress, abandon the
+    # engine (the SIGKILL stand-in), then time a successor's rebuild.
+    victim = ServingEngine(
+        gpt2.apply_cached, gpt2.init_cache, params, cfg,
+        serving=ServingConfig(block_size=8, num_blocks=33, max_slots=4,
+                              prefill_chunk=16, max_blocks_per_seq=8,
+                              journal_path=journal_path),
+    )
+    for p, m in burst[:6]:
+        victim.submit(p, m)
+    for _ in range(3):
+        victim.step()
+    successor = ServingEngine(
+        gpt2.apply_cached, gpt2.init_cache, params, cfg,
+        serving=ServingConfig(block_size=8, num_blocks=33, max_slots=4,
+                              prefill_chunk=16, max_blocks_per_seq=8,
+                              journal_path=journal_path),
+    )
+    tr = time.perf_counter()
+    recovered = successor.recover_from_journal()
+    successor.run(max_ticks=2000)
+    recovery_wall_ms = (time.perf_counter() - tr) * 1e3
+
     return {
         "serving": {
             "requests": len(done),
@@ -1095,6 +1157,15 @@ def _serving_probe() -> dict:
             "prefill_dispatches": engine.prefill_dispatches - p0,
             "ticks": engine.ticks - t0_ticks,
             "pool_bytes": engine.cache.pool_bytes(),
+            "overload": {
+                "submitted": M,
+                "shed": shed,
+                "shed_rate": round(shed / M, 4),
+                "deadline_expired": expired,
+                "deadline_hit_rate": round(expired / max(accepted, 1), 4),
+                "journal_recovered": len(recovered),
+                "journal_recovery_ms": round(recovery_wall_ms, 1),
+            },
         }
     }
 

@@ -21,11 +21,14 @@ from accelerate_tpu.models.generation import (
     scatter_token_rows,
 )
 from accelerate_tpu.serving import (
+    AdmissionRejected,
     BlockAllocator,
     BlockOutOfMemory,
+    JournalError,
     Request,
     ServingConfig,
     ServingEngine,
+    ServingJournal,
 )
 from accelerate_tpu.serving.blocks import NULL_BLOCK, blocks_for_tokens
 from accelerate_tpu.serving.scheduler import RequestState, Scheduler
@@ -600,6 +603,389 @@ def test_coordinated_guard_uses_local_flag_not_collective(gpt2_setup):
     guard._flag = True  # the signal handler's only action is setting this
     eng.step()
     assert eng.drained and [r["id"] for r in eng.requeue_journal] == [rid]
+
+
+# ---------------------------------------------------------------------------
+# Overload protection / deadlines / quarantine / journal (serving under fire)
+# ---------------------------------------------------------------------------
+
+
+def _robust_engine(cfg, params, **overrides):
+    kw = dict(block_size=4, num_blocks=40, max_slots=2, prefill_chunk=8,
+              max_blocks_per_seq=8)
+    kw.update(overrides)
+    return ServingEngine(
+        gpt2.apply_cached, gpt2.init_cache, params, cfg,
+        serving=ServingConfig(**kw),
+    )
+
+
+def test_overload_sheds_with_typed_rejection(gpt2_setup, tmp_path):
+    """Past max_queue_depth, submit raises AdmissionRejected (serving.shed):
+    a burst degrades to load shedding, never unbounded queue growth — and
+    already-accepted requests still complete normally."""
+    cfg, params = gpt2_setup
+    tel = telemetry.enable(dir=str(tmp_path))
+    eng = _robust_engine(cfg, params, max_queue_depth=2)
+    rng = np.random.default_rng(31)
+    prompts = [list(rng.integers(0, cfg.vocab_size, size=5)) for _ in range(4)]
+    accepted = [eng.submit(p, 3) for p in prompts[:2]]
+    for p in prompts[2:]:
+        with pytest.raises(AdmissionRejected, match="max_queue_depth"):
+            eng.submit(p, 3)
+    assert eng.shed_count == 2
+    assert tel.registry.snapshot()["serving.shed"] == 2
+    out = eng.run(max_ticks=300)
+    assert set(out) == set(accepted)  # shed requests never entered the queue
+    # the bound is on QUEUE depth: once the queue drains, admission reopens
+    rid = eng.submit(prompts[2], 2)
+    assert rid in eng.run(max_ticks=300)
+
+
+def test_queued_deadline_sheds_before_prefill(gpt2_setup, tmp_path):
+    """An already-expired queued request is shed at the next tick WITHOUT
+    spending a prefill dispatch, a slot, or any blocks on it; the expiry
+    feeds serving.deadline_expired and the TTFT histogram (so the SLO burn
+    rate sees the violation, not just the survivors)."""
+    cfg, params = gpt2_setup
+    tel = telemetry.enable(dir=str(tmp_path))
+    eng = _robust_engine(cfg, params)
+    rid = eng.submit([1, 2, 3, 4, 5], 4, deadline_ms=0.0)
+    prefill_before = eng.prefill_dispatches
+    done = eng.step()
+    assert [c.id for c in done] == [rid]
+    assert done[0].status == "deadline_expired"
+    assert eng.prefill_dispatches == prefill_before, "burned a chunk on a corpse"
+    assert eng.cache.allocator.used_blocks == 0
+    snap = tel.registry.snapshot()
+    assert snap["serving.deadline_expired"] == 1
+    assert snap["serving.ttft_ms.count"] == 1  # the violation was observed
+
+
+def test_inflight_deadline_cancels_and_frees_blocks(gpt2_setup):
+    """A decoding request whose total deadline passes mid-flight is
+    cancelled: blocks freed, slot returned, partial tokens reported with
+    status deadline_expired — while a deadline-less neighbor finishes
+    normally."""
+    import time as _time
+
+    cfg, params = gpt2_setup
+    eng = _robust_engine(cfg, params)
+    rng = np.random.default_rng(33)
+    doomed = eng.submit(list(rng.integers(0, cfg.vocab_size, size=5)), 20,
+                        deadline_ms=60.0)
+    healthy = eng.submit(list(rng.integers(0, cfg.vocab_size, size=5)), 3)
+    eng.step(); eng.step()  # both prefilled, decoding underway
+    _time.sleep(0.08)  # blow the doomed request's 60 ms total budget
+    out = eng.run(max_ticks=300)
+    by_id = {c.id: c for c in eng.pop_finished()}
+    assert by_id[doomed].status == "deadline_expired"
+    assert by_id[doomed].new_tokens < 20  # cancelled mid-flight
+    assert by_id[healthy].status == "ok" and len(out[healthy]) == 5 + 3
+    assert eng.cache.allocator.used_blocks == 0, "cancellation leaked blocks"
+    assert eng.deadline_expired_count == 1
+
+
+def test_config_default_deadlines_apply(gpt2_setup):
+    cfg, params = gpt2_setup
+    eng = _robust_engine(cfg, params, default_deadline_ms=0.0)
+    rid = eng.submit([1, 2, 3], 4)  # inherits the config default
+    eng.step()
+    assert eng.pop_finished()[0].status == "deadline_expired"
+    # per-request override beats the default
+    eng2 = _robust_engine(cfg, params, default_deadline_ms=0.0)
+    rid2 = eng2.submit([1, 2, 3], 2, deadline_ms=60_000.0)
+    out = eng2.run(max_ticks=300)
+    assert len(out[rid2]) == 5
+
+
+def test_poisoned_request_quarantined_others_bit_identical(gpt2_setup, tmp_path):
+    """The health-guard analog for decode: NaN logits are detected INSIDE
+    the fused program, the poisoned request completes with an error status,
+    its blocks are scrubbed (0 * NaN = NaN in probs @ v would poison the
+    blocks' next owner), and every other request's output is bit-identical
+    to the offline oracle."""
+    import os as _os
+
+    from accelerate_tpu.resilience import faultinject
+
+    cfg, params = gpt2_setup
+    rng = np.random.default_rng(37)
+    prompts = [list(rng.integers(0, cfg.vocab_size, size=n)) for n in (6, 9, 5)]
+    want = {i: _oracle(cfg, params, p, 6) for i, p in enumerate(prompts)}
+    _os.environ["ACCELERATE_TPU_FAULT_SERVING_NAN_REQUEST"] = "2"
+    faultinject.reload()
+    try:
+        tel = telemetry.enable(dir=str(tmp_path))
+        eng = _robust_engine(cfg, params, max_slots=3)
+        ids = {eng.submit(p, 6): i for i, p in enumerate(prompts)}
+        eng.run(max_ticks=500)
+    finally:
+        _os.environ.pop("ACCELERATE_TPU_FAULT_SERVING_NAN_REQUEST", None)
+        faultinject.reload()
+    done = {ids[c.id]: c for c in eng.pop_finished()}
+    assert done[1].status == "quarantined"  # the 2nd submission
+    for i in (0, 2):
+        assert done[i].status == "ok"
+        assert done[i].tokens == want[i], f"survivor {i} diverged"
+    assert eng.quarantined_count == 1
+    assert eng.cache.allocator.used_blocks == 0
+    snap = tel.registry.snapshot()
+    assert snap["serving.quarantined"] == 1
+    # scrub proof: no non-finite value anywhere in the pool afterwards
+    for name, leaf in eng.cache.pool.items():
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert bool(jnp.all(jnp.isfinite(leaf))), name
+    # a fresh request reusing the scrubbed blocks still decodes clean
+    rid = eng.submit(prompts[0], 6)
+    assert eng.run(max_ticks=300)[rid] == want[0]
+
+
+def test_requeue_wait_histogram_under_forced_preemption(gpt2_setup, tmp_path):
+    """Satellite: admit_t records the FIRST admission only, so time spent
+    re-queued after a preemption is invisible to queue_wait_ms — the
+    serving.requeue_wait_ms histogram records one sample per re-admission."""
+    cfg, params = gpt2_setup
+    tel = telemetry.enable(dir=str(tmp_path))
+    eng = _robust_engine(cfg, params, num_blocks=9, max_slots=3,
+                         prefill_chunk=4, max_blocks_per_seq=6)
+    rng = np.random.default_rng(7)
+    prompts = [list(rng.integers(0, cfg.vocab_size, size=n)) for n in (5, 11, 9)]
+    for p, m in zip(prompts, (8, 6, 7)):
+        eng.submit(p, m)
+    eng.run(max_ticks=2000)
+    assert eng.sched.preempted_count > 0, "pool was not tight enough"
+    snap = tel.registry.snapshot()
+    assert snap.get("serving.requeue_wait_ms.count", 0) >= 1, (
+        "no re-queue wait sample landed despite forced preemption"
+    )
+    assert snap["serving.requeue_wait_ms.mean"] >= 0.0
+
+
+def test_journal_wal_and_recovery_token_identical(gpt2_setup, tmp_path):
+    """Write-ahead journal: admissions land on disk before submit returns;
+    an ABANDONED engine (the in-process SIGKILL stand-in) leaves a journal
+    a successor rebuilds its queue from and finishes token-identically.
+    Terminal requests (completed / quarantined / expired) are not replayed."""
+    cfg, params = gpt2_setup
+    jp = str(tmp_path / "journal.json")
+    rng = np.random.default_rng(41)
+    prompts = [list(rng.integers(0, cfg.vocab_size, size=n)) for n in (5, 8, 11)]
+    want = {i: _oracle(cfg, params, p, 5) for i, p in enumerate(prompts)}
+
+    eng = _robust_engine(cfg, params, journal_path=jp)
+    ids = {eng.submit(p, 5, tag=f"t{i}"): i for i, p in enumerate(prompts)}
+    state = ServingJournal.load(jp)  # WAL: on disk before any tick ran
+    assert len(ServingJournal.pending(state)) == 3
+    eng.step(); eng.step(); eng.step()  # partial progress, then abandon
+    finished_tags = {c.tag for c in eng.pop_finished()}
+
+    succ = _robust_engine(cfg, params, journal_path=jp)
+    mapping = succ.recover_from_journal()
+    assert set(mapping) == {rid for rid in ids if f"t{ids[rid]}" not in finished_tags}
+    succ.run(max_ticks=500)
+    done = {c.tag: c.tokens for c in succ.pop_finished()}
+    for old_id, i in ids.items():
+        if f"t{i}" in finished_tags:
+            continue
+        assert done[f"t{i}"] == want[i], f"recovered request {i} diverged"
+    # completed requests are terminal in the successor's journal too
+    state2 = ServingJournal.load(jp)
+    assert not ServingJournal.pending(state2)
+    # double-recovery guard: the successor already overwrote the journal
+    with pytest.raises(JournalError, match="before the first submit"):
+        succ.recover_from_journal()
+
+
+def test_recovery_bypasses_queue_bound(gpt2_setup, tmp_path):
+    """Review-found: recovery resubmits through submit(), so a successor
+    sharing the predecessor's max_queue_depth would SHED journaled requests
+    past the bound — silently losing acknowledged work (a drained engine's
+    backlog legally exceeds the queue depth: its in-flight slots requeue).
+    A dead engine's backlog is not a traffic burst; recovery must admit it
+    all."""
+    cfg, params = gpt2_setup
+    jp = str(tmp_path / "journal.json")
+    rng = np.random.default_rng(47)
+    eng = _robust_engine(cfg, params, journal_path=jp, max_queue_depth=None)
+    n = 5
+    for i in range(n):
+        eng.submit(list(rng.integers(0, cfg.vocab_size, size=4)), 2, tag=f"t{i}")
+    # abandon with all 5 pending; successor has a bound SMALLER than that
+    succ = _robust_engine(cfg, params, journal_path=jp, max_queue_depth=2)
+    mapping = succ.recover_from_journal()
+    assert len(mapping) == n, "recovery shed journaled requests at the queue bound"
+    out = succ.run(max_ticks=500)
+    assert len(out) == n
+    # the bound still applies to NEW traffic after recovery
+    for i in range(2):
+        succ.submit([1, 2, 3], 2)
+    with pytest.raises(AdmissionRejected):
+        succ.submit([1, 2, 3], 2)
+
+
+def test_journal_deferred_batches_into_one_atomic_flush(tmp_path):
+    """Review-found: recovery must not overwrite the predecessor's journal
+    until EVERY pending request is re-journaled — deferred() holds all
+    mutations for one atomic os.replace, so a SIGKILL mid-recovery leaves
+    the predecessor's complete file, never a partial successor one."""
+    jp = str(tmp_path / "journal.json")
+    old = ServingJournal(jp)
+    old.record_admit(Request([1, 2, 3], 4, tag="a"))
+    old.record_admit(Request([4, 5], 3, tag="b"))
+    before = open(jp).read()
+    new = ServingJournal(jp)
+    with new.deferred():
+        new.record_admit(Request([1, 2, 3], 4, tag="a2"))
+        # mid-batch: the predecessor's file is untouched on disk
+        assert open(jp).read() == before
+        assert not new.flushed
+        new.record_admit(Request([4, 5], 3, tag="b2"))
+    state = ServingJournal.load(jp)
+    assert {r["tag"] for r in ServingJournal.pending(state)} == {"a2", "b2"}
+    assert new.flushed
+
+
+def test_scrub_covers_null_block(gpt2_setup):
+    """Review-found: a poisoned request's padded prefill rows scatter PAST
+    its block table into the shared null block, so quarantine must scrub
+    block 0 too — NaN there would reach every slot's gathered view (and
+    0 * NaN = NaN in probs @ v ignores the mask's zero probability)."""
+    cfg, params = gpt2_setup
+    eng = _robust_engine(cfg, params)
+    name = next(n for n, leaf in eng.cache.pool.items()
+                if jnp.issubdtype(leaf.dtype, jnp.floating))
+    leaf = eng.cache.pool[name]
+    poisoned = jnp.full(leaf.shape[2:], jnp.nan, leaf.dtype)
+    eng.cache.pool[name] = leaf.at[:, NULL_BLOCK].set(poisoned).at[:, 3].set(poisoned)
+    eng._scrub_blocks([3])
+    for n, leaf in eng.cache.pool.items():
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert bool(jnp.all(jnp.isfinite(leaf))), n
+
+
+def test_journal_load_rejects_missing_torn_and_newer(tmp_path):
+    with pytest.raises(JournalError, match="no journal"):
+        ServingJournal.load(str(tmp_path / "absent.json"))
+    torn = tmp_path / "torn.json"
+    torn.write_text('{"version": 1, "requests": {"0": ')
+    with pytest.raises(JournalError, match="unreadable"):
+        ServingJournal.load(str(torn))
+    newer = tmp_path / "newer.json"
+    newer.write_text(json.dumps({"version": 99, "requests": {}, "done": {}}))
+    with pytest.raises(JournalError, match="schema version"):
+        ServingJournal.load(str(newer))
+
+
+def test_sigkill_successor_finishes_from_journal_alone(gpt2_setup, tmp_path):
+    """Acceptance criterion: a SIGKILLed engine's successor, rebuilt from
+    the persisted journal ALONE (no drain ran, no handler, no atexit),
+    completes every in-flight request token-identically (subprocess, the
+    flightrec-smoke pattern)."""
+    import os as _os
+    import signal as _signal
+    import subprocess
+    import sys as _sys
+
+    cfg, params = gpt2_setup
+    jp = str(tmp_path / "journal.json")
+    rng = np.random.default_rng(43)
+    prompts = [
+        [int(t) for t in rng.integers(0, cfg.vocab_size, size=n)]
+        for n in (6, 10)
+    ]
+    want = {i: _oracle(cfg, params, p, 5) for i, p in enumerate(prompts)}
+
+    script = f"""
+import json, os, signal
+import jax, jax.numpy as jnp
+from accelerate_tpu.models import gpt2
+from accelerate_tpu.serving import ServingConfig, ServingEngine
+
+cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32)
+params = gpt2.init_params(cfg, jax.random.key(0))
+eng = ServingEngine(
+    gpt2.apply_cached, gpt2.init_cache, params, cfg,
+    serving=ServingConfig(block_size=4, num_blocks=40, max_slots=2,
+                          prefill_chunk=8, max_blocks_per_seq=8,
+                          journal_path={jp!r}),
+)
+for i, p in enumerate({prompts!r}):
+    eng.submit(p, 5, tag=f"t{{i}}")
+for _ in range(3):
+    eng.step()
+os.kill(os.getpid(), signal.SIGKILL)  # no handler, no drain, no atexit
+"""
+    env = dict(_os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "ACCELERATE_TPU_COMPILE_CACHE": "",
+                "ACCELERATE_TPU_SENTINEL_PROFILE": "0"})
+    env.pop("XLA_FLAGS", None)  # token identity needs the parent's device layout
+    proc = subprocess.run([_sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == -_signal.SIGKILL, (proc.returncode, proc.stderr)
+
+    succ = _robust_engine(cfg, params, journal_path=jp)
+    mapping = succ.recover_from_journal()
+    succ.run(max_ticks=500)
+    done = {c.tag: c for c in succ.pop_finished()}
+    assert set(done) == {"t0", "t1"} and len(mapping) == 2
+    for i in range(2):
+        assert done[f"t{i}"].status == "ok"
+        assert done[f"t{i}"].tokens == want[i], (
+            f"request {i} not token-identical after SIGKILL recovery"
+        )
+
+
+def test_fuzz_admission_deadline_preemption_shed_interleavings(gpt2_setup):
+    """Satellite: randomized interleavings of admission x deadlines x forced
+    preemption x shed.  Invariants: the allocator's free count round-trips
+    to its initial value (block conservation) and every request reaches a
+    terminal state within the tick bound (the LIFO victim policy cannot
+    livelock the oldest request)."""
+    cfg, params = gpt2_setup
+    for seed in (0, 1, 2):
+        rng = np.random.default_rng(seed)
+        eng = _robust_engine(cfg, params, num_blocks=11, max_slots=3,
+                             prefill_chunk=4, max_blocks_per_seq=6,
+                             max_queue_depth=3)
+        capacity = eng.cache.allocator.capacity
+        submitted, shed = [], 0
+        for k in range(10):
+            prompt = list(rng.integers(0, cfg.vocab_size, size=int(rng.integers(3, 12))))
+            max_new = int(rng.integers(1, 6))
+            deadline = [None, None, 0.0, 40.0][int(rng.integers(4))]
+            try:
+                submitted.append(eng.submit(prompt, max_new, deadline_ms=deadline))
+            except AdmissionRejected:
+                shed += 1
+            for _ in range(int(rng.integers(0, 3))):
+                eng.step()
+            if eng.sched.slots and rng.random() < 0.3:
+                eng.sched.preempt_one()  # adversarial forced preemption
+        eng.run(max_ticks=2000)  # raises on livelock (no drain in bound)
+        done = eng.pop_finished()
+        assert {c.id for c in done} == set(submitted), (
+            f"seed {seed}: starved requests "
+            f"{set(submitted) - {c.id for c in done}}"
+        )
+        assert eng.cache.allocator.free_blocks == capacity, (
+            f"seed {seed}: leaked {capacity - eng.cache.allocator.free_blocks} blocks"
+        )
+        assert eng.shed_count == shed
+
+
+def test_shed_and_deadline_counters_exposed_via_prometheus(gpt2_setup):
+    """Satellite: the new robustness counters exist in the registry from
+    engine construction (a dashboard can rate() them before the first
+    incident) and render through the Prometheus exposition."""
+    from accelerate_tpu.telemetry.export import render_prometheus
+
+    cfg, params = gpt2_setup
+    tel = telemetry.enable()
+    _robust_engine(cfg, params)
+    text = render_prometheus(tel.registry)
+    for stem in ("serving_shed", "serving_deadline_expired", "serving_quarantined"):
+        assert f"accelerate_tpu_{stem}_total 0" in text, stem
 
 
 def test_prepare_serving_wires_installed_guard(gpt2_setup, tmp_path):
