@@ -18,7 +18,8 @@ __all__ = [
     "quantize_kv", "dequantize_kv", "pack_cache_for_scan",
     "unpack_cache_from_scan", "cache_write", "speculative_generate_loop",
     "make_paged_pool", "gather_block_view", "extract_token_rows",
-    "scatter_token_rows",
+    "scatter_token_rows", "paged_cache_write", "pack_paged_pool_for_scan",
+    "unpack_paged_rows_from_scan",
 ]
 
 
@@ -184,6 +185,88 @@ def scatter_token_rows(
     blk = jnp.where(blk_idx < m, blk, 0)
     off = pos % bs
     return pool_leaf.at[:, blk, off].set(jnp.moveaxis(rows, 0, 1))
+
+
+def _insert_rows(ctx: jax.Array, new_rows: jax.Array, starts: jax.Array) -> jax.Array:
+    """Overlay ``new_rows`` ``[B, T, *r]`` onto the gathered context ``[B, P,
+    *r]`` at positions ``starts[b] .. starts[b]+T-1`` — the paged analog of
+    the dense view after ``cache_write``: attention sees exactly the values a
+    dense-view write would have produced, without an updated view ever being
+    materialized as a program output."""
+    b, p = ctx.shape[:2]
+    t = new_rows.shape[1]
+    rel = (
+        jnp.arange(p, dtype=jnp.int32)[None, :]
+        - starts[:, None].astype(jnp.int32)
+    )  # [B, P]: position minus the slot's write start
+    tail = (1,) * (ctx.ndim - 2)
+    picked = jnp.take_along_axis(
+        new_rows, jnp.clip(rel, 0, t - 1).reshape(b, p, *tail), axis=1
+    )
+    in_new = ((rel >= 0) & (rel < t)).reshape(b, p, *tail)
+    return jnp.where(in_new, picked, ctx)
+
+
+def paged_cache_write(pool_layer, new_rows: jax.Array, tables: jax.Array, starts: jax.Array, dtype):
+    """Per-layer paged analog of :func:`cache_write`: compute the stored
+    representation of ``new_rows`` ``[B, T, K, hd]`` (cast for the fp pool,
+    ``(codes, scale)`` for the int8 one) and the **dense attention context**
+    ``[B, M*bs, K, hd]`` gathered straight through the block tables ``[B, M]``
+    with the new rows overlaid at ``starts[b] + arange(T)``.
+
+    Unlike the dense path, nothing here flows back out as an updated cache:
+    the pool leaf is consumed read-only (a scan ``xs``), the stored rows ride
+    out as tiny per-layer ``ys``, and the engine scatters them into the
+    donated pool after the forward — HBM write traffic per token is the new
+    rows, not the per-slot worst-case view."""
+    b = tables.shape[0]
+    m = tables.shape[1]
+    if isinstance(pool_layer, tuple):  # int8: (codes [N, bs, K, hd], scale [N, bs, K])
+        codes, scale = pool_layer
+        bs = codes.shape[1]
+        n_codes, n_scale = quantize_kv(new_rows)
+        stored = (n_codes, n_scale)
+        ctx = dequantize_kv(
+            jnp.take(codes, tables, axis=0).reshape(b, m * bs, *codes.shape[2:]),
+            jnp.take(scale, tables, axis=0).reshape(b, m * bs, *scale.shape[2:]),
+            dtype,
+        )
+        # Attention must see the QUANTIZED new rows (the dense path writes
+        # codes then dequantizes the whole view) or int8 serving would not be
+        # token-identical to the offline int8 cache.
+        new_full = dequantize_kv(n_codes, n_scale, dtype)
+    else:
+        bs = pool_layer.shape[1]
+        stored = new_rows.astype(pool_layer.dtype)
+        ctx = jnp.take(pool_layer, tables, axis=0).reshape(
+            b, m * bs, *pool_layer.shape[2:]
+        )
+        new_full = stored
+    return stored, _insert_rows(ctx, new_full, starts)
+
+
+def pack_paged_pool_for_scan(pool: dict):
+    """Pool leaves in the tuple form the per-layer scan body consumes:
+    ``(k, v)`` arrays, or ``((k, k_scale), (v, v_scale))`` for int8 — each
+    leading with the layer axis so ``lax.scan`` slices one layer per step."""
+    quant = "k_scale" in pool
+    pk = (pool["k"], pool["k_scale"]) if quant else pool["k"]
+    pv = (pool["v"], pool["v_scale"]) if quant else pool["v"]
+    return pk, pv, quant
+
+
+def unpack_paged_rows_from_scan(k_rows, v_rows, quant: bool) -> dict:
+    """Stacked per-layer stored rows ``[L, B, T, ...]`` (scan ``ys``) ->
+    ``{leaf: [B, L, T, ...]}``, the layout ``scatter_token_rows`` writes."""
+    def out(rows):
+        return jnp.moveaxis(rows, 0, 1)
+
+    if quant:
+        return {
+            "k": out(k_rows[0]), "k_scale": out(k_rows[1]),
+            "v": out(v_rows[0]), "v_scale": out(v_rows[1]),
+        }
+    return {"k": out(k_rows), "v": out(v_rows)}
 
 
 def check_cache_room(index, new_tokens: int, max_len: int) -> None:

@@ -347,6 +347,87 @@ def apply_cached(
     return logits, unpack_cache_from_scan(new_k, new_v, index + s, quant)
 
 
+def apply_paged(
+    params: dict,
+    input_ids: jax.Array,
+    config: GPT2Config,
+    pool: dict,
+    tables: jax.Array,
+    starts: jax.Array,
+    kernel: bool = False,
+) -> tuple[jax.Array, dict]:
+    """Forward over new tokens straight against the paged block pool — the
+    serving engine's decode/prefill fast path (no per-slot dense cache view
+    is ever built or returned).
+
+    Row ``b``'s tokens ``input_ids[b]`` sit at positions ``starts[b] ..
+    starts[b]+T-1``; attention consumes pool K/V through the block tables
+    ``tables [B, M]`` (``paged_cache_write``) and the freshly written rows
+    come back as ``{leaf: [B, L, T, ...]}`` for the caller to scatter into
+    the pool.  ``kernel=True`` routes single-token fp decode through the
+    Pallas paged-attention kernel (``ops/pallas_attention.py``); everything
+    else takes the always-correct XLA path."""
+    from .generation import (
+        pack_paged_pool_for_scan,
+        paged_cache_write,
+        unpack_paged_rows_from_scan,
+    )
+
+    c = config
+    b, t = input_ids.shape
+    pk_in, pv_in, quant = pack_paged_pool_for_scan(pool)
+    bs = pool["k"].shape[2]
+    total = tables.shape[1] * bs
+    if total > c.max_seq_len:
+        raise ValueError(
+            f"block table extent {total} exceeds max_seq_len {c.max_seq_len} "
+            "(GPT-2's learned position table)"
+        )
+    positions = starts[:, None].astype(jnp.int32) + jnp.arange(t, dtype=jnp.int32)[None]
+    x = _embed_lookup(params["wte"], input_ids, c.dtype) + params["wpe"].astype(c.dtype)[positions]
+    k_pos = jnp.arange(total, dtype=jnp.int32)
+    mask = positions[:, :, None] >= k_pos[None, None, :]  # [B, T, M*bs]
+    use_kernel = kernel and not quant and t == 1
+    if use_kernel:
+        from ..ops.pallas_attention import pallas_available
+
+        use_kernel = pallas_available()
+
+    def body(carry, xs):
+        if quant:
+            lp, ck, cks, cv, cvs = xs
+            pk, pv = (ck, cks), (cv, cvs)
+        else:
+            lp, pk, pv = xs
+        lp = _dequant_layer(lp)
+        x = carry
+        q, k, v = _qkv(x, lp, c)
+        if use_kernel:
+            from ..ops.pallas_attention import pallas_paged_attention
+
+            k_store = k.astype(pk.dtype)
+            v_store = v.astype(pv.dtype)
+            attn = pallas_paged_attention(
+                q[:, 0], k_store[:, 0], v_store[:, 0], pk, pv, tables, starts
+            )[:, None].reshape(b, t, c.hidden_size)
+        else:
+            k_store, k_full = paged_cache_write(pk, k, tables, starts, c.dtype)
+            v_store, v_full = paged_cache_write(pv, v, tables, starts, c.dtype)
+            attn = _attend(q, k_full, v_full, mask[:, None], c)
+        x = x + attn @ lp["w_proj"].astype(c.dtype) + lp["b_proj"].astype(c.dtype)
+        x = _mlp_block(x, lp, c)
+        return x, (k_store, v_store)
+
+    xs = (params["layers"],) + (
+        (pool["k"], pool["k_scale"], pool["v"], pool["v_scale"]) if quant
+        else (pool["k"], pool["v"])
+    )
+    x, (k_rows, v_rows) = jax.lax.scan(body, x, xs)
+    x = _layer_norm(x, params["final_ln_scale"], params["final_ln_bias"], c.layer_norm_eps)
+    logits = (x @ params["wte"].astype(c.dtype).T).astype(jnp.float32)
+    return logits, unpack_paged_rows_from_scan(k_rows, v_rows, quant)
+
+
 def generate(
     params: dict,
     input_ids: jax.Array,

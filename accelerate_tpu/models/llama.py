@@ -823,6 +823,86 @@ def apply_cached(
     return logits, unpack_cache_from_scan(new_k, new_v, index + s, quant)
 
 
+def apply_paged(
+    params: dict,
+    input_ids: jax.Array,
+    config: LlamaConfig,
+    pool: dict,
+    tables: jax.Array,
+    starts: jax.Array,
+    kernel: bool = False,
+) -> tuple[jax.Array, dict]:
+    """Forward over new tokens straight against the paged block pool — the
+    serving engine's decode/prefill fast path (see ``gpt2.apply_paged``; the
+    contract is shared).  Row ``b``'s tokens sit at positions ``starts[b] ..
+    starts[b]+T-1`` (RoPE is position-exact per slot); attention consumes
+    pool K/V through the block tables via ``paged_cache_write`` and the
+    written rows return as ``{leaf: [B, L, T, ...]}`` for the caller's
+    scatter.  ``kernel=True`` routes single-token fp decode through the
+    Pallas paged-attention kernel."""
+    from .generation import (
+        pack_paged_pool_for_scan,
+        paged_cache_write,
+        unpack_paged_rows_from_scan,
+    )
+
+    c = config
+    b, t = input_ids.shape
+    hd = c.head_dim_
+    _, _, quant = pack_paged_pool_for_scan(pool)
+    bs = pool["k"].shape[2]
+    total = tables.shape[1] * bs
+    positions = starts[:, None].astype(jnp.int32) + jnp.arange(t, dtype=jnp.int32)[None]
+    x = embed_tokens(params, input_ids, c)
+    k_pos = jnp.arange(total, dtype=jnp.int32)
+    mask = positions[:, :, None] >= k_pos[None, None, :]  # [B, T, M*bs]
+    use_kernel = kernel and not quant and t == 1
+    if use_kernel:
+        from ..ops.pallas_attention import pallas_available
+
+        use_kernel = pallas_available()
+
+    def body(carry, xs):
+        if quant:
+            lp, ck, cks, cv, cvs = xs
+            pk, pv = (ck, cks), (cv, cvs)
+        else:
+            lp, pk, pv = xs
+        lp = _dequant_layer(lp)
+        x = carry
+        h = _norm(x, lp["ln_attn"], c)
+        q, k, v = _qkv_proj(h, lp, c, b, t)
+        q, k = _rope(q, k, positions, c.rope_theta, getattr(c, "rope_scaling", None))
+        if use_kernel:
+            from ..ops.pallas_attention import pallas_paged_attention
+
+            k_store = k.astype(pk.dtype)
+            v_store = v.astype(pv.dtype)
+            attn = pallas_paged_attention(
+                q[:, 0], k_store[:, 0], v_store[:, 0], pk, pv, tables, starts
+            )[:, None]
+        else:
+            k_store, k_full = paged_cache_write(pk, k, tables, starts, c.dtype)
+            v_store, v_full = paged_cache_write(pv, v, tables, starts, c.dtype)
+            attn = _attention(q, k_full, v_full, mask, c.num_heads // c.num_kv_heads)
+        out = _mm(attn.reshape(b, t, c.num_heads * hd), lp["wo"], c)
+        if "bo" in lp:
+            out = out + lp["bo"].astype(out.dtype)
+        y = x + out
+        h = _norm(y, lp["ln_mlp"], c)
+        gate = _act(_mm(h, lp["w_gate"], c), c)
+        up = _mm(h, lp["w_up"], c)
+        return y + _mm(gate * up, lp["w_down"], c), (k_store, v_store)
+
+    xs = (params["layers"],) + (
+        (pool["k"], pool["k_scale"], pool["v"], pool["v_scale"]) if quant
+        else (pool["k"], pool["v"])
+    )
+    x, (k_rows, v_rows) = jax.lax.scan(body, x, xs)
+    logits = unembed(params, x, c)
+    return logits, unpack_paged_rows_from_scan(k_rows, v_rows, quant)
+
+
 def generate(
     params: dict,
     input_ids: jax.Array,

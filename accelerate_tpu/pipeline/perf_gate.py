@@ -63,6 +63,13 @@ silently-degraded pipeline schedule.
 knob that proves the **goodput row** (wall-clock productive fraction from
 ``telemetry/goodput.py``'s attribution ledger, compiles warmed outside the
 window) actually judges where the wall clock went.
+``=dense-decode`` runs the **serving row**'s paged arm on the dense
+gather-view decode program — the knob that proves the
+``serving_paged_active`` tripwire and the paged-vs-dense throughput floor
+actually judge the serving decode fast path (PR 15: the paged program reads
+pool K/V in place through bucketed block tables; a regression back to
+"gather the worst-case dense view every token" lands the ratio at ~1.0 and
+fails loudly).
 """
 
 from __future__ import annotations
@@ -75,7 +82,10 @@ import tempfile
 import time
 from typing import Optional
 
-__all__ = ["load_baseline", "run_probe", "run_pp_probe", "evaluate", "run_gate", "main"]
+__all__ = [
+    "load_baseline", "run_probe", "run_pp_probe", "run_serving_probe",
+    "evaluate", "run_gate", "main",
+]
 
 ENV_BASELINE = "ACCELERATE_TPU_PERF_BASELINE"
 ENV_DEGRADE = "ACCELERATE_TPU_PERF_GATE_DEGRADE"
@@ -213,6 +223,82 @@ def run_pp_probe(
     }
 
 
+def run_serving_probe(decode_ticks: int = 25, degrade: Optional[str] = None) -> dict:
+    """The serving row's measurement: paged vs dense decode throughput on a
+    bounded CPU engine pair (gpt2-tiny, identical geometry and request mix).
+
+    The dense arm is the PR 9 program — gather every slot's worst-case
+    ``[S, L, 1, M*bs, *r]`` view, vmap ``apply_cached``, flow the updated
+    view back out; the paged arm reads pool K/V in place through bucketed
+    block tables and returns only the written rows.  The request geometry is
+    chosen so the paged arm's table bucket is CONSTANT across the timed
+    window (prompt 33 rows + 30 budget stays under the 64-row bucket):
+    a bucket crossing recompiles once, which is steady-state-invisible but
+    would poison a 25-tick window.  Judged invariants: decode dispatches per
+    tick == 1 on the paged path, paged-vs-dense steps/s over the committed
+    floor, and ``serving_paged_active`` (the dense-fallback tripwire).
+    ``degrade="dense-decode"`` builds the paged arm on the dense program —
+    the self-test that this row actually judges the fast path."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ..models import gpt2
+    from ..serving import ServingConfig, ServingEngine
+    from ..serving.scheduler import RequestState
+
+    if degrade is None:
+        degrade = os.environ.get(ENV_DEGRADE, "").strip().lower() or None
+    cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32)
+    import jax
+
+    params = gpt2.init_params(cfg, jax.random.key(0))
+
+    def arm(path):
+        rng = np.random.default_rng(0)
+        eng = ServingEngine(
+            gpt2.apply_cached, gpt2.init_cache, params, cfg,
+            serving=ServingConfig(
+                block_size=8, num_blocks=80, max_slots=4, prefill_chunk=8,
+                max_blocks_per_seq=16, decode_path=path, prefix_cache=False,
+            ),
+        )
+        for _ in range(4):
+            eng.submit(list(rng.integers(0, cfg.vocab_size, size=33)), 30)
+        # Prefill everyone into the decode batch, then warm the decode
+        # program for the active bucket outside the timed window.
+        while (
+            any(s.request.state != RequestState.DECODING for s in eng.sched.slots.values())
+            or eng.sched.pending
+        ):
+            eng.step()
+        for _ in range(2):
+            eng.step()
+        d0 = eng.decode_dispatches
+        t0 = time.perf_counter()
+        for _ in range(decode_ticks):
+            eng.step()
+        dt = time.perf_counter() - t0
+        return (
+            decode_ticks / dt,
+            (eng.decode_dispatches - d0) / decode_ticks,
+            eng.stats()["decode_path"],
+        )
+
+    dense_sps, dense_disp, _ = arm("dense")
+    paged_sps, paged_disp, paged_path = arm(
+        "dense" if degrade == "dense-decode" else "paged"
+    )
+    return {
+        "serving_dense_decode_steps_per_s": round(dense_sps, 2),
+        "serving_paged_decode_steps_per_s": round(paged_sps, 2),
+        "serving_paged_vs_dense_ratio": round(paged_sps / max(dense_sps, 1e-9), 3),
+        "serving_decode_dispatches_per_tick": paged_disp,
+        "serving_dense_decode_dispatches_per_tick": dense_disp,
+        "serving_paged_active": paged_path == "paged",
+    }
+
+
 def run_probe(
     accum: int = 2,
     steps: int = 10,
@@ -222,12 +308,14 @@ def run_probe(
     prefetch: int = 2,
     degrade: Optional[str] = None,
     pp: bool = True,
+    serving: bool = True,
 ) -> dict:
     """Bounded eager-vs-fused micro-benchmark (the bench.py pipeline probe,
     trimmed for a test-suite budget).  Returns the measurements dict the gate
     judges.  ``degrade="eager"`` runs the eager loop in the fused arm — the
-    self-test knob.  ``pp=False`` skips the pipeline-parallel row (targeted
-    self-tests of the other rows don't pay for two pp compiles)."""
+    self-test knob.  ``pp=False`` / ``serving=False`` skip the
+    pipeline-parallel / serving-decode rows (targeted self-tests of the
+    other rows don't pay for their extra compiles)."""
     import numpy as np
     import torch
 
@@ -418,6 +506,13 @@ def run_probe(
         if pp and jax.device_count() >= 4 and jax.device_count() % 4 == 0:
             pp_row = run_pp_probe(degrade=degrade)
 
+        # serving row: paged vs dense decode on the continuous-batching
+        # engine — single-device by design (the engine is mesh-agnostic), so
+        # unlike the ZeRO/pp rows it runs on every probe.
+        serving_row = None
+        if serving:
+            serving_row = run_serving_probe(degrade=degrade)
+
         # goodput row: one fused epoch (compiles warmed OUTSIDE the window)
         # through the wall-clock attribution ledger — the productive fraction
         # is the runtime proof that steps, not overhead, own the wall clock.
@@ -499,6 +594,8 @@ def run_probe(
             measurements["zero_profile_error"] = zero_profile_error
     if pp_row is not None:
         measurements.update(pp_row)
+    if serving_row is not None:
+        measurements.update(serving_row)
     return measurements
 
 
@@ -650,6 +747,38 @@ def evaluate(measurements: dict, baseline: dict) -> list:
                 f"{min_pp_ratio} — the interleaved schedule lost its bubble-shrink "
                 "win over gpipe"
             )
+    # serving row: judged only when the arm ran.  A paged decode that
+    # silently fell back to the dense gather-view program, a tick that grew a
+    # second dispatch, or a paged path slower than the dense one it replaces
+    # are exactly the regressions this row exists to catch.
+    if "serving_paged_vs_dense_ratio" in measurements:
+        if baseline.get("require_serving_paged") and not measurements.get(
+            "serving_paged_active"
+        ):
+            failures.append(
+                "serving_paged_active is False — the serving decode silently "
+                "fell back to the dense gather-view program"
+            )
+        max_serving_disp = baseline.get("max_serving_decode_dispatches_per_tick")
+        if max_serving_disp is not None:
+            disp = measurements.get("serving_decode_dispatches_per_tick")
+            if disp is not None and disp > max_serving_disp + 1e-9:
+                failures.append(
+                    f"serving decode dispatches/tick {disp:.2f} > baseline max "
+                    f"{max_serving_disp} — the paged decode is no longer one "
+                    "fused dispatch per engine tick"
+                )
+        min_serving_ratio = baseline.get("min_paged_vs_dense_ratio")
+        if (
+            min_serving_ratio is not None
+            and measurements["serving_paged_vs_dense_ratio"] < min_serving_ratio
+        ):
+            failures.append(
+                f"paged-vs-dense decode steps/s ratio "
+                f"{measurements['serving_paged_vs_dense_ratio']:.3f} < baseline min "
+                f"{min_serving_ratio} — the serving decode fast path lost its "
+                "win over the dense gather-view program"
+            )
     return failures
 
 
@@ -688,6 +817,12 @@ def run_gate(baseline_path: Optional[str] = None, probe_kwargs: Optional[dict] =
     if measurements.get("goodput_productive_frac") is not None:
         zero_note += (
             f", goodput {measurements['goodput_productive_frac']:.2f} productive"
+        )
+    if measurements.get("serving_paged_vs_dense_ratio") is not None:
+        zero_note += (
+            f", serving paged/dense {measurements['serving_paged_vs_dense_ratio']}x "
+            f"at {measurements['serving_decode_dispatches_per_tick']:.0f} "
+            "dispatch/tick"
         )
     print(
         "perf-gate OK — "

@@ -23,7 +23,7 @@ under fire by the seeded serving chaos campaign (``serving/chaos.py``,
 ``make serving-chaos-smoke``).
 """
 
-from .blocks import BlockAllocator, BlockOutOfMemory, PagedKVCache
+from .blocks import BlockAllocator, BlockOutOfMemory, PagedKVCache, PrefixCache
 from .engine import (
     AdmissionRejected,
     CompletedRequest,
@@ -38,6 +38,7 @@ __all__ = [
     "BlockAllocator",
     "BlockOutOfMemory",
     "PagedKVCache",
+    "PrefixCache",
     "CompletedRequest",
     "JournalError",
     "Request",

@@ -1,4 +1,5 @@
-"""Paged KV-cache storage: a block pool plus a host-side free-list allocator.
+"""Paged KV-cache storage: a block pool, a refcounting allocator, and a
+content-addressed prefix cache.
 
 The resident KV cache is a pool of ``num_blocks`` fixed-size blocks shared by
 every in-flight request (``[L, num_blocks, block_size, ...]`` per leaf — the
@@ -14,16 +15,58 @@ block serves any request, and the only waste is the tail of the last block
 allocation decisions happen between dispatches, never inside the jitted
 decode step.
 
+Blocks are **refcounted** so physical blocks can be shared: a fresh ``alloc``
+grants refcount 1, :meth:`BlockAllocator.retain` adds a reader (prefix
+sharing), and ``free`` releases one reference — the block returns to the free
+list only when the last holder lets go.  Two additional states ride the
+refcounts:
+
+- **dirty** (:meth:`mark_dirty`) — the quarantine path poisons a block's
+  K/V; a dirty block must be scrubbed to zero before any reuse.  With
+  sharing this becomes **scrub-on-last-release**: a dirty block that still
+  has live readers keeps serving them (their own finiteness checks guard
+  them) and is zeroed only when its refcount hits 0, so a shared block is
+  never scrubbed under a live reader.  Such blocks land in a
+  ``pending_scrub`` set the engine drains (the scrub is a device write) and
+  re-enters the free list via :meth:`finish_scrub`.
+- **reclaimable** — blocks whose only reference is the
+  :class:`PrefixCache`.  They count as free capacity (``free_blocks``):
+  ``alloc`` evicts them LRU-first when the free list runs dry, so caching
+  never causes an OOM a cacheless pool would not have had.
+
 Block 0 is reserved as the **null block**: it is never handed out, block
 tables are padded with it, and inactive decode slots write their garbage row
 into it, so stray gathers/scatters can never touch a live request's KV.
+
+:class:`PrefixCache` shares **full prompt blocks across requests by
+content**: block ``i`` of a request's token feed is keyed by a chain hash
+``h_i = H(h_{i-1} || tokens[i*bs:(i+1)*bs])`` — K/V rows depend on the whole
+prefix, so the chain (not the block's own tokens) is the sound identity.  A
+lookup walks the chain until the first miss, retains every matched block for
+the new reader, and the engine starts that request's prefill past the shared
+prefix (TTFT collapses to the unshared suffix).  The partial tail is handled
+with **copy-on-write**: when the cached chain covers more rows than the new
+request may reuse wholesale (it must keep >= 1 token to feed), the next
+chain block is copied into a private block and writing continues there —
+shared blocks are never written after registration (writes always move
+forward from ``cache_len``; every shared block ends before it).
 """
 
 from __future__ import annotations
 
-from typing import Callable, List
+import hashlib
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Tuple
 
-__all__ = ["BlockAllocator", "BlockOutOfMemory", "PagedKVCache", "blocks_for_tokens"]
+import numpy as np
+
+__all__ = [
+    "BlockAllocator",
+    "BlockOutOfMemory",
+    "PagedKVCache",
+    "PrefixCache",
+    "blocks_for_tokens",
+]
 
 NULL_BLOCK = 0
 
@@ -38,9 +81,10 @@ def blocks_for_tokens(tokens: int, block_size: int) -> int:
 
 
 class BlockAllocator:
-    """LIFO free-list over block ids ``1..num_blocks-1`` (0 is the null
-    block).  LIFO keeps recently-freed (cache-warm) blocks hot, and makes
-    alloc/free O(1)."""
+    """Refcounting LIFO free-list over block ids ``1..num_blocks-1`` (0 is
+    the null block).  LIFO keeps recently-freed (cache-warm) blocks hot, and
+    makes alloc/free O(1).  ``free`` releases ONE reference; a block shared
+    via :meth:`retain` stays allocated until its last holder frees it."""
 
     def __init__(self, num_blocks: int):
         if num_blocks < 2:
@@ -49,7 +93,15 @@ class BlockAllocator:
             )
         self.num_blocks = num_blocks
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
-        self._allocated: set = set()
+        self._ref: Dict[int, int] = {}
+        self._dirty: set = set()
+        self._pending_scrub: List[int] = []
+        self._cache: Optional["PrefixCache"] = None
+
+    def attach_cache(self, cache: "PrefixCache") -> None:
+        """Wire a :class:`PrefixCache` in: its cache-only blocks count as
+        reclaimable free capacity and are evicted LRU-first on pressure."""
+        self._cache = cache
 
     @property
     def capacity(self) -> int:
@@ -58,41 +110,236 @@ class BlockAllocator:
 
     @property
     def free_blocks(self) -> int:
-        return len(self._free)
+        """Immediately allocatable blocks: the free list plus cache-only
+        (reclaimable) blocks an ``alloc`` would evict on demand."""
+        n = len(self._free)
+        if self._cache is not None:
+            n += self._cache.reclaimable_count
+        return n
 
     @property
     def used_blocks(self) -> int:
-        return len(self._allocated)
+        """Blocks held by at least one non-cache reference."""
+        n = len(self._ref)
+        if self._cache is not None:
+            n -= self._cache.reclaimable_count
+        return n
 
     @property
     def occupancy(self) -> float:
-        """Fraction of usable blocks currently allocated."""
+        """Fraction of usable blocks currently allocated (cache-only blocks
+        are reclaimable and therefore not counted)."""
         return self.used_blocks / self.capacity
 
+    def refcount(self, block: int) -> int:
+        return self._ref.get(block, 0)
+
     def alloc(self, n: int = 1) -> List[int]:
-        """Pop ``n`` free blocks; raises :class:`BlockOutOfMemory` (allocating
-        NOTHING) when fewer than ``n`` are free — partial grants would leak
-        on the error path."""
+        """Pop ``n`` free blocks (each at refcount 1); evicts cache-only
+        blocks when the free list alone cannot cover the grant.  Raises
+        :class:`BlockOutOfMemory` (allocating NOTHING) when fewer than ``n``
+        are reachable — partial grants would leak on the error path."""
         if n < 0:
             raise ValueError(f"alloc count must be >= 0, got {n}")
+        if n > len(self._free) and self._cache is not None:
+            self._cache.evict(n - len(self._free))
         if n > len(self._free):
             raise BlockOutOfMemory(
-                f"need {n} blocks, {len(self._free)} free of {self.capacity}"
+                f"need {n} blocks, {self.free_blocks} free of {self.capacity}"
             )
         out = [self._free.pop() for _ in range(n)]
-        self._allocated.update(out)
+        for b in out:
+            self._ref[b] = 1
         return out
 
+    def retain(self, block: int) -> None:
+        """Add one reference to an allocated block (prefix sharing)."""
+        if block == NULL_BLOCK:
+            raise ValueError("cannot retain the null block")
+        if block not in self._ref:
+            raise ValueError(f"retain of unallocated block: {block}")
+        if self._ref[block] == 1 and self._cache is not None:
+            self._cache._note_first_reader(block)
+        self._ref[block] += 1
+
     def free(self, blocks: List[int]) -> None:
-        """Return blocks to the free list; double-free and freeing the null
-        block are hard errors (both indicate scheduler corruption)."""
+        """Release one reference per block; the last release returns the
+        block to the free list (or to ``pending_scrub`` when it was marked
+        dirty — scrub-on-last-release).  Releasing the null block or a block
+        with no references is a hard error (scheduler corruption)."""
         for b in blocks:
             if b == NULL_BLOCK:
                 raise ValueError("cannot free the null block")
-            if b not in self._allocated:
+            if b not in self._ref:
                 raise ValueError(f"double free / foreign block: {b}")
-            self._allocated.remove(b)
-            self._free.append(b)
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                del self._ref[b]
+                if b in self._dirty:
+                    self._pending_scrub.append(b)
+                else:
+                    self._free.append(b)
+            elif self._ref[b] == 1 and self._cache is not None:
+                self._cache._note_last_reader_left(b)
+
+    # -- dirty blocks (quarantine scrub-on-last-release) ----------------------
+
+    def mark_dirty(self, blocks: List[int]) -> None:
+        """Mark blocks as needing a zero-scrub before reuse.  Blocks still
+        referenced keep serving their live readers; they are scrubbed when
+        the last reference releases."""
+        for b in blocks:
+            if b in self._ref:
+                self._dirty.add(b)
+
+    def pop_pending_scrub(self) -> List[int]:
+        """Dirty blocks whose last reference released since the previous
+        drain.  The caller (the engine) zeroes them on device and hands them
+        back via :meth:`finish_scrub`; until then they are NOT allocatable."""
+        out, self._pending_scrub = self._pending_scrub, []
+        for b in out:
+            self._dirty.discard(b)
+        return out
+
+    def finish_scrub(self, blocks: List[int]) -> None:
+        """Return scrubbed blocks to the free list."""
+        self._free.extend(blocks)
+
+
+class PrefixCache:
+    """Content-addressed cache of full prompt blocks for cross-request
+    sharing (see the module docstring for the chain-hash identity and the
+    copy-on-write tail rule).
+
+    The cache holds ONE allocator reference per cached block, so a finished
+    request's prefix blocks survive it; :meth:`evict` releases cache-only
+    blocks LRU-first when the allocator needs room.  Evicting a middle chain
+    block strands the later entries of that chain (a lookup stops at the
+    first miss); they age out of the same LRU order.
+    """
+
+    def __init__(self, allocator: BlockAllocator, block_size: int):
+        self.allocator = allocator
+        self.block_size = block_size
+        self._entries: "OrderedDict[bytes, int]" = OrderedDict()  # LRU: oldest first
+        self._by_block: Dict[int, bytes] = {}
+        # Cache-only block count, maintained incrementally: the scheduler
+        # reads free_blocks (and the gauges occupancy) several times per
+        # tick, so an O(cached-blocks) refcount scan here would put an O(N)
+        # walk on the per-tick host path the allocator promises is O(1).
+        self._reclaimable = 0
+        allocator.attach_cache(self)
+
+    @staticmethod
+    def chain_keys(tokens: List[int], block_size: int, limit: Optional[int] = None) -> List[bytes]:
+        """Chain hash per FULL block of ``tokens``: ``h_i`` digests every
+        token up to and including block ``i`` — the identity of a block's
+        K/V content, which depends on the entire prefix."""
+        nb = len(tokens) // block_size
+        if limit is not None:
+            nb = min(nb, limit)
+        h = hashlib.sha256()
+        keys = []
+        for i in range(nb):
+            h.update(np.asarray(
+                tokens[i * block_size:(i + 1) * block_size], np.int64
+            ).tobytes())
+            keys.append(h.digest())
+        return keys
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def reclaimable_count(self) -> int:
+        """Cached blocks whose ONLY reference is this cache (free capacity
+        in waiting).  O(1): tracked on the allocator's 1<->2 refcount
+        transitions of cached blocks and this cache's own entry churn."""
+        return self._reclaimable
+
+    def _note_first_reader(self, block: int) -> None:
+        """Allocator hook: a block at refcount 1 gained a reader — if that
+        lone reference was ours, the block just stopped being reclaimable."""
+        if block in self._by_block:
+            self._reclaimable -= 1
+
+    def _note_last_reader_left(self, block: int) -> None:
+        """Allocator hook: a block dropped back to refcount 1 — if the
+        survivor is our reference, the block is reclaimable again."""
+        if block in self._by_block:
+            self._reclaimable += 1
+
+    def lookup(self, tokens: List[int], max_rows: int) -> Tuple[List[int], int, Optional[int]]:
+        """Longest cached chain over the full blocks of ``tokens``, capped at
+        ``max_rows`` reusable rows.  Returns ``(blocks, rows, cow_src)``:
+        ``blocks`` are the wholesale-shared full blocks (each retained for
+        the caller), ``rows = len(blocks) * block_size``, and ``cow_src`` —
+        also retained, the caller MUST release it after copying — is the next
+        chain block when a partial tail (``max_rows % block_size`` rows of
+        it) is still reusable via copy-on-write."""
+        bs = self.block_size
+        matched: List[Tuple[bytes, int]] = []
+        for key in self.chain_keys(tokens, bs, limit=blocks_for_tokens(max_rows, bs)):
+            block = self._entries.get(key)
+            if block is None:
+                break
+            matched.append((key, block))
+        if not matched:
+            return [], 0, None
+        full_usable = min(len(matched), max_rows // bs)
+        blocks = []
+        for key, block in matched[:full_usable]:
+            self.allocator.retain(block)
+            self._entries.move_to_end(key)
+            blocks.append(block)
+        cow_src = None
+        if len(matched) > full_usable and max_rows % bs:
+            key, cow_src = matched[full_usable]
+            self.allocator.retain(cow_src)
+            self._entries.move_to_end(key)
+        return blocks, full_usable * bs, cow_src
+
+    def register(self, chain_key: bytes, block: int) -> bool:
+        """Publish a fully-written prompt block under its chain key; returns
+        False when the key (a concurrent prefill of the same prefix) or the
+        block is already cached.  The block must never be written again —
+        the engine registers only blocks entirely below ``cache_len``, and
+        writes only move forward from there."""
+        if chain_key in self._entries or block in self._by_block:
+            return False
+        self.allocator.retain(block)
+        self._entries[chain_key] = block
+        self._by_block[block] = chain_key
+        return True
+
+    def evict(self, n: int) -> int:
+        """Release up to ``n`` cache-only blocks, least recently used first;
+        returns how many were released.  Blocks with live readers are never
+        touched."""
+        released = 0
+        for key in list(self._entries):
+            if released >= n:
+                break
+            block = self._entries[key]
+            if self.allocator.refcount(block) == 1:
+                del self._entries[key]
+                del self._by_block[block]
+                self._reclaimable -= 1
+                self.allocator.free([block])
+                released += 1
+        return released
+
+    def invalidate_blocks(self, blocks: List[int]) -> None:
+        """Drop cached entries for ``blocks`` (quarantine: no new sharers may
+        attach to a possibly-poisoned block) and release the cache's
+        reference."""
+        for b in blocks:
+            key = self._by_block.pop(b, None)
+            if key is not None:
+                del self._entries[key]
+                if self.allocator.refcount(b) == 1:
+                    self._reclaimable -= 1
+                self.allocator.free([b])
 
 
 class PagedKVCache:
@@ -125,3 +372,12 @@ class PagedKVCache:
 
     def pool_bytes(self) -> int:
         return sum(leaf.size * leaf.dtype.itemsize for leaf in self.pool.values())
+
+    def block_bytes(self) -> int:
+        """Bytes of pool data behind ONE block across every leaf and layer —
+        the unit of the ``serving.decode_gather_bytes`` accounting."""
+        num_blocks = next(iter(self.pool.values())).shape[1]
+        return sum(
+            (leaf.size // num_blocks) * leaf.dtype.itemsize
+            for leaf in self.pool.values()
+        )

@@ -7,13 +7,40 @@ One engine **tick** (:meth:`ServingEngine.step`) is:
    to a static shape) of the oldest prefilling request runs, so a 10k-token
    prompt costs many small dispatches interleaved with decode instead of one
    huge dispatch that stalls every in-flight request;
-3. **decode** — ONE fused jitted dispatch advances every decoding slot by one
-   token: the block tables gather each slot's paged KV into the dense view
-   the family's ``apply_cached`` consumes, a ``vmap`` over slots runs the
-   per-token forward with per-slot write indices, and the freshly written
-   K/V rows scatter back into the pool.  The 1-dispatch-per-decode-step
-   invariant from ``make_train_step`` carries over — the
-   ``serving.decode_dispatches`` counter is the proof hook.
+3. **decode** — ONE fused jitted dispatch advances every decoding slot by
+   one token.  On the default **paged fast path** the family's
+   ``apply_paged`` consumes pool K/V *in place* through the block tables
+   (``models/generation.py paged_cache_write``): no dense per-slot cache
+   view is ever materialized, no updated view ever flows back out of the
+   program — only the freshly written K/V rows, which scatter into the
+   donated pool.  Block tables are **bucketed** to the next power of two of
+   the widest live slot, so per-token gather traffic scales with the blocks
+   requests actually own, not the worst-case table width (the
+   ``serving.decode_gather_bytes`` counter is the accounting).  Families
+   without ``apply_paged`` (capacity-routed MoE) or
+   ``ServingConfig(decode_path="dense")`` fall back to the PR 9 program:
+   gather the dense view, ``vmap`` the family's ``apply_cached``, extract
+   and scatter the written rows.  Either way the
+   1-dispatch-per-decode-step invariant from ``make_train_step`` carries
+   over — the ``serving.decode_dispatches`` counter is the proof hook, and
+   the perf_gate serving row holds paged-vs-dense decode throughput above a
+   committed floor.
+
+Prefill takes the same paged path: a chunk's program consumes the pool
+through the (bucketed) block table and returns only the rows it writes —
+the full per-slot view is materialized on neither side of the dispatch.
+
+**Prefix caching** (``ServingConfig.prefix_cache``, default on): full
+prompt blocks are content-hashed (a chain hash — K/V rows depend on the
+whole prefix) into a :class:`~accelerate_tpu.serving.blocks.PrefixCache`
+shared across requests.  A new request's prefill skips the shared prefix
+(its blocks are refcount-retained into the slot's table; TTFT collapses to
+the unshared suffix), the partial tail block is reused via copy-on-write,
+and cache-only blocks are reclaimable capacity the allocator evicts
+LRU-first under pressure.  Quarantine's scrub becomes
+**scrub-on-last-release**: a poisoned shared block keeps serving its live
+readers (their own finiteness checks guard them) and is zeroed only when
+the last reference drops — never under a live reader.
 
 Token selection is **greedy** (argmax, inside the fused program): outputs are
 token-identical to the offline ``generate_loop`` with ``temperature=0`` per
@@ -70,6 +97,7 @@ Production-robustness layer (overload / deadlines / quarantine / journal):
 from __future__ import annotations
 
 import contextlib
+import inspect
 import os
 import time
 from dataclasses import dataclass, field
@@ -86,7 +114,13 @@ from ..models.generation import (
     scatter_token_rows,
 )
 from ..telemetry import get_telemetry
-from .blocks import NULL_BLOCK, PagedKVCache
+from .blocks import (
+    NULL_BLOCK,
+    BlockOutOfMemory,
+    PagedKVCache,
+    PrefixCache,
+    blocks_for_tokens,
+)
 from .journal import JournalError, ServingJournal
 from .scheduler import Request, RequestState, Scheduler
 
@@ -132,6 +166,22 @@ class ServingConfig:
       applied to requests that do not pass their own (None = no deadline).
     - ``journal_path``: arm the crash-recovery write-ahead journal at this
       path (see ``serving/journal.py``).
+
+    Decode fast-path knobs:
+
+    - ``decode_path``: ``"paged"`` (default) computes attention straight
+      through the block tables via the family's ``apply_paged`` — falling
+      back to ``"dense"`` automatically when the family has none (MoE);
+      ``"dense"`` forces the PR 9 gather-view program (the always-correct
+      reference path, and the perf_gate contrast arm).
+    - ``paged_kernel``: route single-token fp decode attention through the
+      Pallas paged-attention kernel (``ops/pallas_attention.py``).  The XLA
+      paged path is the always-correct fallback (int8 pools and prefill
+      chunks stay on it); the kernel's online softmax may differ from it in
+      final ulps.
+    - ``prefix_cache``: share full prompt blocks across requests by content
+      hash (copy-on-write tail, refcounted blocks, LRU reclaim).  Host-side
+      policy only — the compiled programs are identical either way.
     """
 
     block_size: int = 16
@@ -143,6 +193,9 @@ class ServingConfig:
     default_ttft_deadline_ms: Optional[float] = None
     default_deadline_ms: Optional[float] = None
     journal_path: Optional[str] = None
+    decode_path: str = "paged"
+    paged_kernel: bool = False
+    prefix_cache: bool = True
 
     def resolved_max_blocks(self) -> int:
         if self.max_blocks_per_seq is not None:
@@ -237,6 +290,10 @@ class ServingEngine:
         self.shed_count = 0
         self.deadline_expired_count = 0
         self.quarantined_count = 0
+        self.prefix_hits = 0
+        self.prefix_blocks_reused = 0
+        self.cow_copies = 0
+        self.decode_gather_bytes = 0
         self._submissions = 0
         self._recovering = False
         # NaN poison injection is gated at TRACE time (the train-step trick):
@@ -248,21 +305,96 @@ class ServingEngine:
         self.journal: Optional[ServingJournal] = (
             ServingJournal(sc.journal_path) if sc.journal_path else None
         )
-        self._decode_fn = jax.jit(self._build_decode(), donate_argnums=(1,))
-        self._prefill_fn = jax.jit(self._build_prefill(), donate_argnums=(1,))
-        # Pre-create the robustness counters so the Prometheus endpoint
-        # exposes serving.shed/deadline_expired/quarantined at 0 from the
-        # first scrape — a dashboard can alert on rate() without waiting for
-        # the first incident to make the series exist.
+        # Decode-path resolution: "paged" consumes the pool in place through
+        # the family's apply_paged (same module as apply_cached); a family
+        # without one (capacity-routed MoE — per-batch routing is not
+        # row-independent) falls back to the dense gather-view program.
+        if sc.decode_path not in ("paged", "dense"):
+            raise ValueError(
+                f"decode_path must be 'paged' or 'dense', got {sc.decode_path!r}"
+            )
+        self._paged_apply = None
+        if sc.decode_path == "paged":
+            family = inspect.getmodule(apply_cached)
+            self._paged_apply = getattr(family, "apply_paged", None)
+        self.decode_path = "paged" if self._paged_apply is not None else "dense"
+        self._block_bytes = self.cache.block_bytes()
+        self._prefix: Optional[PrefixCache] = (
+            PrefixCache(self.cache.allocator, sc.block_size)
+            if sc.prefix_cache else None
+        )
+        if self.decode_path == "paged":
+            # One jitted wrapper each; bucketed table widths retrace under it
+            # (jit caches per shape), so a tick is still exactly one decode
+            # dispatch — just of the program matching the live bucket.
+            self._decode_fn = jax.jit(self._build_decode_paged(), donate_argnums=(1,))
+            self._prefill_fn = jax.jit(self._build_prefill_paged(), donate_argnums=(1,))
+        else:
+            self._decode_fn = jax.jit(self._build_decode(), donate_argnums=(1,))
+            self._prefill_fn = jax.jit(self._build_prefill(), donate_argnums=(1,))
+        # Pre-create the robustness + fast-path counters so the Prometheus
+        # endpoint exposes them at 0 from the first scrape — a dashboard can
+        # alert on rate() without waiting for the first incident (or the
+        # first prefix hit) to make the series exist.
         tel = get_telemetry()
         if tel.enabled:
             for name in (
                 "serving.shed", "serving.deadline_expired",
                 "serving.quarantined", "serving.journal_recoveries",
+                "serving.prefix_hits", "serving.prefix_blocks_reused",
+                "serving.prefix_cow_copies", "serving.decode_gather_bytes",
             ):
                 tel.registry.counter(name)
 
     # -- compiled programs ---------------------------------------------------
+
+    def _build_decode_paged(self):
+        """The in-dispatch paged decode: the family's ``apply_paged`` reads
+        pool K/V straight through the (bucketed) block tables — no dense
+        per-slot view in, no updated view out, only the written rows, which
+        scatter into the donated pool inside the same dispatch."""
+        apply_paged, config = self._paged_apply, self._config
+        kernel = self.serving.paged_kernel
+
+        def decode(params, pool, tables, lengths, tokens, *poison):
+            logits, rows = apply_paged(
+                params, tokens[:, None], config, pool, tables, lengths,
+                kernel=kernel,
+            )
+            logits = logits[:, -1]
+            if poison:  # trace-time gate: unarmed programs carry no plumbing
+                logits = logits * poison[0][:, None]
+            next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            ok = jnp.all(jnp.isfinite(logits), axis=-1)
+            new_pool = dict(pool)
+            for n, r in rows.items():
+                new_pool[n] = scatter_token_rows(pool[n], r, tables, lengths, 1)
+            return next_tok, ok, new_pool
+
+        return decode
+
+    def _build_prefill_paged(self):
+        """Paged prefill: the chunk's program consumes the pool through the
+        bucketed table row and returns ONLY the rows it writes — the dense
+        per-slot view is materialized on neither side of the dispatch (the
+        PR 9 program gathered it in AND flowed the updated copy out)."""
+        apply_paged, config = self._paged_apply, self._config
+        chunk_len = self.serving.prefill_chunk
+
+        def prefill(params, pool, table_row, length, chunk, n_real):
+            logits, rows = apply_paged(
+                params, chunk, config, pool, table_row[None], length[None]
+            )
+            next_tok = jnp.argmax(logits[0, n_real - 1], axis=-1).astype(jnp.int32)
+            ok = jnp.all(jnp.isfinite(logits))
+            new_pool = dict(pool)
+            for n, r in rows.items():
+                new_pool[n] = scatter_token_rows(
+                    pool[n], r, table_row[None], length[None], chunk_len
+                )
+            return next_tok, ok, new_pool
+
+        return prefill
 
     def _build_decode(self):
         apply_cached, config, names = self._apply_cached, self._config, self._kv_names
@@ -421,13 +553,17 @@ class ServingEngine:
             self.drain()
             return []
         self.ticks += 1
+        self._drain_scrubs()
         # Deadline expiry FIRST: an expired queued request is shed before a
         # slot, a prefill chunk, or any blocks are spent on it.
         self._expire_deadlines(now)
         admitted = self.sched.admit(now)
+        for idx in admitted:
+            self._attach_prefix(idx)
         self._observe_requeue_waits(admitted)
         self._prefill_tick(now)
         self._decode_tick(now)
+        self._drain_scrubs()
         self._publish_gauges()
         return self._finished[done_before:]
 
@@ -493,6 +629,7 @@ class ServingEngine:
         ]
         self._drained = True
         self.requeue_journal = journal
+        self._drain_scrubs()
         if self.journal is not None:
             # Persist emitted progress so the successor resumes mid-request
             # (prompt+emitted) instead of re-decoding from the prompt.
@@ -625,15 +762,28 @@ class ServingEngine:
 
     def _quarantine(self, idx: int, now: float) -> None:
         """A slot's logits came back non-finite: complete its request with an
-        error status and scrub its pool blocks to ZERO before freeing them.
-        The scrub is load-bearing, not hygiene — the attention mask zeroes a
-        hidden row's probability, but ``0 * NaN = NaN`` in ``probs @ v``, so
-        a NaN row left in a recycled block would corrupt the block's next
-        owner.  (Finite garbage in recycled blocks is safe for exactly that
-        reason, which is why normal frees never scrub.)"""
+        error status and mark its pool blocks for a zero-scrub.  The scrub is
+        load-bearing, not hygiene — the attention mask zeroes a hidden row's
+        probability, but ``0 * NaN = NaN`` in ``probs @ v``, so a NaN row
+        left in a recycled block would corrupt the block's next owner.
+        (Finite garbage in recycled blocks is safe for exactly that reason,
+        which is why normal frees never scrub.)
+
+        With prefix sharing the scrub happens **on last release**: a block
+        another request is still reading is never zeroed under it (the live
+        reader's own finiteness check guards it — if the shared content were
+        truly poisoned, that reader quarantines itself the same way).  The
+        block is dropped from the prefix cache immediately, so no NEW reader
+        can attach to it."""
         slot = self.sched.slots[idx]
-        self._scrub_blocks(slot.blocks)
+        if self._prefix is not None:
+            self._prefix.invalidate_blocks(slot.blocks)
+        self.cache.allocator.mark_dirty(slot.blocks)
         req = self.sched.finish(idx, now)
+        # Unshared blocks just hit refcount 0 and are scrubbed right here;
+        # the null block is always included (a poisoned request's padded
+        # prefill rows scatter past its table into block 0).
+        self._drain_scrubs(always_null=True)
         self.quarantined_count += 1
         tel = get_telemetry()
         if tel.enabled:
@@ -659,10 +809,113 @@ class ServingEngine:
             n: leaf.at[:, idx].set(0) for n, leaf in self.cache.pool.items()
         }
 
+    def _drain_scrubs(self, always_null: bool = False) -> None:
+        """Scrub-on-last-release: zero the dirty blocks whose final reference
+        dropped since the previous drain and hand them back to the free
+        list.  They are not allocatable in between, so a dirty block can
+        never be granted unscrubbed."""
+        pending = self.cache.allocator.pop_pending_scrub()
+        if pending or always_null:
+            self._scrub_blocks(pending)
+            self.cache.allocator.finish_scrub(pending)
+
+    # -- prefix cache --------------------------------------------------------
+
+    def _attach_prefix(self, idx: int) -> None:
+        """On admission, reuse the cached prefix of the slot's feed: matched
+        full blocks are refcount-shared into the slot's table wholesale, a
+        reusable partial tail is claimed via copy-on-write, and
+        ``cache_len`` starts past the shared rows — prefill (and TTFT)
+        collapse to the unshared suffix.  At least one feed token is always
+        left to process: the final chunk's logits ARE the next token."""
+        if self._prefix is None:
+            return
+        slot = self.sched.slots.get(idx)
+        if slot is None:
+            return
+        feed = slot.request.to_feed
+        max_rows = len(feed) - 1
+        if max_rows < self.serving.block_size:
+            return
+        blocks, rows, cow_src = self._prefix.lookup(feed, max_rows)
+        reused = len(blocks)
+        registered = len(blocks)  # leading blocks came FROM the cache
+        if cow_src is not None:
+            dst = None
+            try:
+                dst = self.cache.allocator.alloc(1)[0]
+            except BlockOutOfMemory:
+                pass  # best effort: prefill the tail instead of copying it
+            if dst is not None:
+                self._copy_block(cow_src, dst)
+                blocks.append(dst)
+                rows = max_rows
+                reused += 1
+                self.cow_copies += 1
+            # Release the lookup's temporary reference on the source either
+            # way (the copy is done, or we declined it).
+            self.cache.allocator.free([cow_src])
+        if not blocks:
+            return
+        slot.blocks = blocks
+        slot.cache_len = rows
+        slot.registered_blocks = registered
+        self.prefix_hits += 1
+        self.prefix_blocks_reused += reused
+        tel = get_telemetry()
+        if tel.enabled:
+            tel.registry.counter("serving.prefix_hits").inc()
+            tel.registry.counter("serving.prefix_blocks_reused").inc(reused)
+            if rows > registered * self.serving.block_size:
+                tel.registry.counter("serving.prefix_cow_copies").inc()
+
+    def _copy_block(self, src: int, dst: int) -> None:
+        """Copy-on-write: duplicate one physical block across every pool
+        leaf so the new owner can keep writing where the shared prefix
+        stops.  Runs on the admission path, never inside the decode
+        dispatch."""
+        self.cache.pool = {
+            n: leaf.at[:, dst].set(leaf[:, src])
+            for n, leaf in self.cache.pool.items()
+        }
+
+    def _register_prefix_blocks(self, idx: int) -> None:
+        """Publish the slot's freshly prefilled FULL blocks under their chain
+        hashes.  Only blocks entirely below ``cache_len`` (real rows — the
+        padded tail of a chunk never counts) are registered, and writes only
+        move forward from ``cache_len``, so a registered block is never
+        written again."""
+        if self._prefix is None:
+            return
+        slot = self.sched.slots.get(idx)
+        if slot is None:
+            return
+        bs = self.serving.block_size
+        feed = slot.request.to_feed
+        full = min(slot.cache_len, len(feed)) // bs
+        if full <= slot.registered_blocks:
+            return
+        keys = PrefixCache.chain_keys(feed, bs, limit=full)
+        for i in range(slot.registered_blocks, full):
+            self._prefix.register(keys[i], slot.blocks[i])
+        slot.registered_blocks = full
+
     # -- tick phases ---------------------------------------------------------
 
-    def _table_row(self, blocks: List[int]) -> np.ndarray:
+    def _bucket_width(self, blocks_needed: int) -> int:
+        """Block-table width for the paged programs: the next power of two
+        covering ``blocks_needed``, capped at the configured maximum.  Each
+        width compiles once (jit caches per shape); gather traffic then
+        scales with what live requests actually own instead of the
+        worst-case table."""
         m = self.serving.resolved_max_blocks()
+        width = 1
+        while width < blocks_needed:
+            width *= 2
+        return min(width, m)
+
+    def _table_row(self, blocks: List[int], width: Optional[int] = None) -> np.ndarray:
+        m = width if width is not None else self.serving.resolved_max_blocks()
         row = np.zeros((m,), np.int32)
         row[: len(blocks)] = blocks
         return row
@@ -687,10 +940,17 @@ class ServingEngine:
             return  # the slot itself was preempted to find blocks
         chunk = np.zeros((1, chunk_len), np.int32)
         chunk[0, :n_real] = feed[start : start + n_real]
+        width = None
+        if self.decode_path == "paged":
+            # Bucket the table to the chunk's padded write extent — the
+            # gather reads the blocks this prefill can actually touch.
+            width = self._bucket_width(
+                blocks_for_tokens(start + chunk_len, self.serving.block_size)
+            )
         next_tok, ok, self.cache.pool = self._prefill_fn(
             self.params,
             self.cache.pool,
-            self._table_row(slot.blocks),
+            self._table_row(slot.blocks, width),
             np.int32(start),
             chunk,
             np.int32(n_real),
@@ -703,6 +963,7 @@ class ServingEngine:
         if not bool(ok):
             self._quarantine(idx, time.monotonic())
             return
+        self._register_prefix_blocks(idx)
         if slot.cache_len == len(feed):
             # Final chunk: its last real logits row IS the next token — the
             # first generated token of a fresh request (TTFT lands here) or
@@ -730,15 +991,25 @@ class ServingEngine:
         if not live:
             return
         s = self.serving.max_slots
-        m = self.serving.resolved_max_blocks()
+        if self.decode_path == "paged":
+            # Bucket the tables to the widest live slot: gather traffic (and
+            # attention width) scale with the blocks requests actually own.
+            m = self._bucket_width(max(len(sched.slots[idx].blocks) for idx in live))
+            gathered = sum(len(sched.slots[idx].blocks) for idx in live)
+        else:
+            m = self.serving.resolved_max_blocks()
+            # The dense program gathers every slot's full worst-case view,
+            # live or not — exactly the tax the paged path removes.
+            gathered = s * m
         tables = np.zeros((s, m), np.int32)
         lengths = np.zeros((s,), np.int32)
         tokens = np.zeros((s,), np.int32)
         for idx in live:
             slot = sched.slots[idx]
-            tables[idx] = self._table_row(slot.blocks)
+            tables[idx] = self._table_row(slot.blocks, m)
             lengths[idx] = slot.cache_len
             tokens[idx] = slot.request.emitted[-1]
+        self.decode_gather_bytes += gathered * self._block_bytes
         args = [self.params, self.cache.pool, tables, lengths, tokens]
         if self._poison_ordinal is not None:
             # Armed: the program was traced with the poison lane.  NaN rides
@@ -757,6 +1028,9 @@ class ServingEngine:
         tel = get_telemetry()
         if tel.enabled:
             tel.registry.counter("serving.decode_dispatches").inc()
+            tel.registry.counter("serving.decode_gather_bytes").inc(
+                gathered * self._block_bytes
+            )
         out = np.asarray(next_tokens)
         oks = np.asarray(ok_flags)
         emit_t = time.monotonic()
@@ -859,6 +1133,9 @@ class ServingEngine:
         reg.gauge("serving.queue_depth").set(self.sched.pending)
         reg.gauge("serving.blocks_used").set(alloc.used_blocks)
         reg.gauge("serving.block_occupancy").set(round(alloc.occupancy, 4))
+        reg.gauge("serving.prefix_cache_blocks").set(
+            len(self._prefix) if self._prefix is not None else 0
+        )
         # Publish only preemptions since the last publish: a registry.reset()
         # (e.g. scoping a measurement window) must not be re-inflated with
         # engine-lifetime history.
@@ -885,4 +1162,10 @@ class ServingEngine:
             "deadline_expired": self.deadline_expired_count,
             "quarantined": self.quarantined_count,
             "pool_bytes": self.cache.pool_bytes(),
+            "decode_path": self.decode_path,
+            "decode_gather_bytes": self.decode_gather_bytes,
+            "prefix_hits": self.prefix_hits,
+            "prefix_blocks_reused": self.prefix_blocks_reused,
+            "prefix_cow_copies": self.cow_copies,
+            "prefix_cached_blocks": len(self._prefix) if self._prefix else 0,
         }

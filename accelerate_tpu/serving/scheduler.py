@@ -140,15 +140,18 @@ class Request:
 
 class _Slot:
     """One decode-batch lane: the bound request, its block table, and how many
-    cache rows have been written."""
+    cache rows have been written.  ``registered_blocks`` is the prefix-cache
+    registration cursor — leading full blocks up to it are already published
+    (or were attached FROM the cache) and are never re-registered."""
 
-    __slots__ = ("request", "blocks", "cache_len", "admit_seq")
+    __slots__ = ("request", "blocks", "cache_len", "admit_seq", "registered_blocks")
 
     def __init__(self, request: Request, admit_seq: int):
         self.request = request
         self.blocks: List[int] = []
         self.cache_len = 0
         self.admit_seq = admit_seq
+        self.registered_blocks = 0
 
 
 class Scheduler:

@@ -50,10 +50,14 @@ COUNTERS = frozenset({
     "serving.completed",
     "serving.deadline_expired",
     "serving.decode_dispatches",
+    "serving.decode_gather_bytes",
     "serving.drains",
     "serving.journal_recoveries",
     "serving.preempted",
     "serving.prefill_dispatches",
+    "serving.prefix_blocks_reused",
+    "serving.prefix_cow_copies",
+    "serving.prefix_hits",
     "serving.quarantined",
     "serving.requests",
     "serving.shed",
@@ -89,6 +93,7 @@ GAUGES = frozenset({
     "serving.active_slots",
     "serving.block_occupancy",
     "serving.blocks_used",
+    "serving.prefix_cache_blocks",
     "serving.queue_depth",
     "serving.slo.ttft_target_ms",
     "serving.slo.ttft_burn_rate",

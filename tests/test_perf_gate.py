@@ -16,6 +16,7 @@ from accelerate_tpu.pipeline.perf_gate import (
     run_gate,
     run_pp_probe,
     run_probe,
+    run_serving_probe,
 )
 
 
@@ -73,7 +74,7 @@ def test_gate_fails_when_fused_path_degraded(monkeypatch):
     """Forcing the fused arm onto the eager loop must trip the gate — the
     dispatches/step integer jumps to 3 x accum, immune to timing noise."""
     monkeypatch.setenv("ACCELERATE_TPU_PERF_GATE_DEGRADE", "eager")
-    measurements = run_probe(accum=2, steps=4, dim=64, batch=8, epochs=1, prefetch=0, pp=False)
+    measurements = run_probe(accum=2, steps=4, dim=64, batch=8, epochs=1, prefetch=0, pp=False, serving=False)
     assert measurements["probe"]["degrade"] == "eager"
     assert measurements["dispatches_per_step"] == 6.0
     failures = evaluate(measurements, load_baseline())
@@ -125,7 +126,7 @@ def test_gate_fails_when_zero_silently_falls_back(monkeypatch):
     """ACCELERATE_TPU_PERF_GATE_DEGRADE=zero-fallback runs the ZeRO arm with
     the replicated update — the zero_active tripwire must fail the gate."""
     monkeypatch.setenv("ACCELERATE_TPU_PERF_GATE_DEGRADE", "zero-fallback")
-    measurements = run_probe(accum=2, steps=4, dim=64, batch=8, epochs=1, prefetch=0, pp=False)
+    measurements = run_probe(accum=2, steps=4, dim=64, batch=8, epochs=1, prefetch=0, pp=False, serving=False)
     assert measurements["zero_active"] is False
     failures = evaluate(measurements, load_baseline())
     assert any("silently fell back" in f for f in failures)
@@ -139,7 +140,7 @@ def test_gate_fails_when_overlap_stripped(monkeypatch):
     construction and the overlap row must fail the gate.  Probe-level
     self-test; the cheap evaluate()-level row tests run in tier-1."""
     monkeypatch.setenv("ACCELERATE_TPU_PERF_GATE_DEGRADE", "no-overlap")
-    measurements = run_probe(accum=2, steps=4, dim=64, batch=8, epochs=1, prefetch=0, pp=False)
+    measurements = run_probe(accum=2, steps=4, dim=64, batch=8, epochs=1, prefetch=0, pp=False, serving=False)
     assert measurements["zero_exposed_collective_frac"] == 1.0
     failures = evaluate(measurements, load_baseline())
     assert any("exposed-collective fraction" in f for f in failures)
@@ -171,7 +172,7 @@ def test_gate_fails_when_badput_degraded(monkeypatch):
     arm's steps (pure idle badput) — the productive-fraction floor must fail
     the gate, and the ledger must still conserve."""
     monkeypatch.setenv("ACCELERATE_TPU_PERF_GATE_DEGRADE", "badput")
-    measurements = run_probe(accum=2, steps=4, dim=64, batch=8, epochs=1, prefetch=0, pp=False)
+    measurements = run_probe(accum=2, steps=4, dim=64, batch=8, epochs=1, prefetch=0, pp=False, serving=False)
     baseline = load_baseline()
     assert measurements["goodput_productive_frac"] < baseline["min_goodput_productive_frac"]
     assert abs(measurements["goodput_conservation_error_s"]) <= (
@@ -243,3 +244,47 @@ def test_pp_row_fails_when_gpipe_only_degraded(monkeypatch):
     assert row["pp_interleaved_active"] is False
     failures = evaluate(dict(_passing_measurements(), **row), load_baseline())
     assert any("fell back to gpipe" in f for f in failures)
+
+
+# ---------------------------------------------------------------------------
+# serving row (PR 15): paged decode fast path vs the dense gather-view program
+# ---------------------------------------------------------------------------
+
+
+def _passing_serving_measurements():
+    return dict(
+        _passing_measurements(),
+        serving_paged_vs_dense_ratio=1.5,
+        serving_decode_dispatches_per_tick=1.0,
+        serving_paged_active=True,
+    )
+
+
+def test_evaluate_serving_row_thresholds():
+    baseline = load_baseline()
+    assert baseline["require_serving_paged"] is True
+    assert baseline["max_serving_decode_dispatches_per_tick"] == 1.0
+    assert baseline["min_paged_vs_dense_ratio"] > 1.0
+    assert evaluate(_passing_serving_measurements(), baseline) == []
+    m = dict(_passing_serving_measurements(), serving_paged_active=False)
+    assert any("fell back to the dense" in f for f in evaluate(m, baseline))
+    m = dict(_passing_serving_measurements(), serving_decode_dispatches_per_tick=2.0)
+    assert any("dispatches/tick" in f for f in evaluate(m, baseline))
+    m = dict(_passing_serving_measurements(), serving_paged_vs_dense_ratio=0.9)
+    assert any("paged-vs-dense" in f for f in evaluate(m, baseline))
+    # the row was skipped entirely: no serving judgments at all
+    assert evaluate(_passing_measurements(), baseline) == []
+
+
+@pytest.mark.slow
+def test_serving_row_fails_when_dense_decode_degraded(monkeypatch):
+    """ACCELERATE_TPU_PERF_GATE_DEGRADE=dense-decode runs the serving row's
+    paged arm on the dense gather-view program: the serving_paged_active
+    tripwire must fail the row, and the ratio collapses to ~1 below the
+    committed floor (the proof the gate catches a fast-path rot).
+    Probe-level self-test; the cheap evaluate()-row tests run in tier-1."""
+    monkeypatch.setenv("ACCELERATE_TPU_PERF_GATE_DEGRADE", "dense-decode")
+    row = run_serving_probe(decode_ticks=10)
+    assert row["serving_paged_active"] is False
+    failures = evaluate(dict(_passing_measurements(), **row), load_baseline())
+    assert any("fell back to the dense" in f for f in failures)

@@ -18,6 +18,8 @@ from accelerate_tpu.models.generation import (
     extract_token_rows,
     gather_block_view,
     make_paged_pool,
+    paged_cache_write,
+    quantize_kv,
     scatter_token_rows,
 )
 from accelerate_tpu.serving import (
@@ -25,6 +27,7 @@ from accelerate_tpu.serving import (
     BlockAllocator,
     BlockOutOfMemory,
     JournalError,
+    PrefixCache,
     Request,
     ServingConfig,
     ServingEngine,
@@ -172,6 +175,47 @@ def test_make_paged_pool_int8_leaves_page_together():
     assert set(pool) == {"k", "k_scale", "v", "v_scale"}
     assert pool["k"].shape[1] == 5 and pool["k"].dtype == jnp.int8
     assert pool["k_scale"].shape == pool["k"].shape[:-1]
+
+
+def test_paged_cache_write_matches_dense_view_math():
+    """The in-dispatch paged context equals the dense per-slot view after a
+    cache_write: gather through the tables, overlay the new rows at the
+    write position — exactly what attention would have seen, without the
+    updated view ever existing."""
+    rng = np.random.default_rng(5)
+    N, bs, K, hd = 7, 4, 2, 3
+    B, M, T = 2, 3, 2
+    pool = jnp.asarray(rng.standard_normal((N, bs, K, hd)), jnp.float32)
+    tables = jnp.asarray([[1, 2, 3], [4, 5, 0]], jnp.int32)
+    starts = jnp.asarray([5, 2], jnp.int32)
+    new = jnp.asarray(rng.standard_normal((B, T, K, hd)), jnp.float32)
+    stored, full = paged_cache_write(pool, new, tables, starts, jnp.float32)
+    np.testing.assert_array_equal(np.asarray(stored), np.asarray(new))
+    for b in range(B):
+        view = np.asarray(pool[tables[b]]).reshape(M * bs, K, hd).copy()
+        s = int(starts[b])
+        view[s:s + T] = np.asarray(new[b])
+        np.testing.assert_array_equal(np.asarray(full[b]), view)
+
+
+def test_paged_cache_write_int8_attends_quantized_rows():
+    """int8 pools: the overlaid new rows must be the DEQUANTIZED quantized
+    codes (the dense path writes codes then dequantizes the whole view) —
+    attending raw fp rows would break int8 token identity."""
+    rng = np.random.default_rng(6)
+    N, bs, K, hd = 5, 4, 2, 3
+    pool_f = rng.standard_normal((N, bs, K, hd)).astype(np.float32)
+    codes, scale = quantize_kv(jnp.asarray(pool_f.reshape(N * bs, K, hd)))
+    pk = (codes.reshape(N, bs, K, hd), scale.reshape(N, bs, K))
+    tables = jnp.asarray([[1, 2]], jnp.int32)
+    starts = jnp.asarray([3], jnp.int32)
+    new = jnp.asarray(rng.standard_normal((1, 1, K, hd)), jnp.float32)
+    (n_codes, n_scale), full = paged_cache_write(pk, new, tables, starts, jnp.float32)
+    from accelerate_tpu.models.generation import dequantize_kv
+
+    want_row = dequantize_kv(n_codes, n_scale, jnp.float32)[0, 0]
+    np.testing.assert_array_equal(np.asarray(full[0, 3]), np.asarray(want_row))
+    assert n_codes.dtype == jnp.int8 and n_scale.shape == (1, 1, K)
 
 
 # ---------------------------------------------------------------------------
@@ -368,6 +412,433 @@ def test_chunked_prefill_interleaves_with_decode(gpt2_setup):
     assert eng.decode_dispatches - decode_before >= 5
     outputs = eng.run(max_ticks=500)
     assert outputs[sid] == want_short and outputs[lid] == want_long
+
+
+# ---------------------------------------------------------------------------
+# Decode fast path: paged-vs-dense token-identity matrix + prefix caching
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("decode_path", ["paged", "dense"])
+@pytest.mark.parametrize("quant", [False, True])
+def test_decode_path_matrix_token_identical(decode_path, quant):
+    """The acceptance matrix: paged decode x int8 KV x forced preemption x
+    chunked-prefill interleaving stays token-identical to the offline
+    generate_loop — and the dense fallback (the always-correct reference
+    program, still used by families without apply_paged) agrees."""
+    cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32, kv_cache_quant=quant)
+    params = gpt2.init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(13)
+    # A tight pool (8 usable blocks vs 3 slots) forces preemption, and the
+    # 11-token prompt takes 3 prefill chunks interleaved with decode.
+    prompts = [list(rng.integers(0, cfg.vocab_size, size=n)) for n in (5, 11, 9)]
+    max_new = [8, 6, 7]
+    want = {i: _oracle(cfg, params, p, m) for i, (p, m) in enumerate(zip(prompts, max_new))}
+    eng = ServingEngine(
+        gpt2.apply_cached, gpt2.init_cache, params, cfg,
+        serving=ServingConfig(block_size=4, num_blocks=9, max_slots=3,
+                              prefill_chunk=4, max_blocks_per_seq=6,
+                              decode_path=decode_path),
+    )
+    assert eng.stats()["decode_path"] == decode_path
+    ids = {eng.submit(p, m): i for i, (p, m) in enumerate(zip(prompts, max_new))}
+    outputs = eng.run(max_ticks=2000)
+    assert eng.sched.preempted_count > 0, "pool was not tight enough to force preemption"
+    assert eng.decode_dispatches <= eng.ticks  # still exactly <= 1 dispatch/tick
+    for rid, out in outputs.items():
+        assert out == want[ids[rid]], f"{decode_path}/int8={quant}: request {rid} diverged"
+
+
+def test_paged_decode_gather_bytes_scale_with_live_blocks(gpt2_setup):
+    """The headline invariant: paged decode's per-tick gather traffic is
+    proportional to the blocks live requests own; the dense program always
+    pays the worst-case table."""
+    cfg, params = gpt2_setup
+
+    def gather_per_tick(path):
+        eng = ServingEngine(
+            gpt2.apply_cached, gpt2.init_cache, params, cfg,
+            serving=ServingConfig(block_size=4, num_blocks=40, max_slots=4,
+                                  prefill_chunk=8, max_blocks_per_seq=8,
+                                  decode_path=path, prefix_cache=False),
+        )
+        eng.submit([1, 2, 3], 6)  # one short request: 1-2 live blocks
+        eng.run(max_ticks=200)
+        assert eng.decode_dispatches > 0
+        return eng.decode_gather_bytes / eng.decode_dispatches, eng
+
+    paged_bytes, eng = gather_per_tick("paged")
+    dense_bytes, _ = gather_per_tick("dense")
+    block = eng.cache.block_bytes()
+    # dense: every slot's full table, live or not (4 slots * 8 blocks)
+    assert dense_bytes == 4 * 8 * block
+    # paged: the one live slot's owned blocks (<= 2 for 3+6 rows)
+    assert paged_bytes <= 2 * block
+    snap_stats = eng.stats()
+    assert snap_stats["decode_path"] == "paged"
+    assert snap_stats["decode_gather_bytes"] == eng.decode_gather_bytes
+
+
+def test_paged_kernel_token_identical(gpt2_setup):
+    """ServingConfig.paged_kernel routes single-token decode attention
+    through the Pallas paged kernel (interpreted off-TPU); outputs stay
+    token-identical to the offline oracle."""
+    cfg, params = gpt2_setup
+    rng = np.random.default_rng(17)
+    prompts = [list(rng.integers(0, cfg.vocab_size, size=n)) for n in (5, 7)]
+    want = {i: _oracle(cfg, params, p, 4) for i, p in enumerate(prompts)}
+    eng = ServingEngine(
+        gpt2.apply_cached, gpt2.init_cache, params, cfg,
+        serving=ServingConfig(block_size=4, num_blocks=20, max_slots=2,
+                              prefill_chunk=8, max_blocks_per_seq=4,
+                              paged_kernel=True),
+    )
+    ids = {eng.submit(p, 4): i for i, p in enumerate(prompts)}
+    outputs = eng.run(max_ticks=200)
+    for rid, out in outputs.items():
+        assert out == want[ids[rid]], f"request {rid} diverged under the Pallas kernel"
+
+
+def test_paged_kernel_gqa_unit_matches_reference():
+    """The kernel's grouped-query layout (groups > 1 — the [K, g, hd]
+    reshapes gpt2's MHA never exercises) against a direct reference:
+    gather the table's blocks, append the new row at ``length``, masked
+    softmax per kv-head group.  Unit-level so tier-1 pays no llama
+    compile; the e2e GQA identity runs in the slow tier below."""
+    from accelerate_tpu.ops.pallas_attention import pallas_paged_attention
+
+    rng = np.random.default_rng(29)
+    b, kh, groups, d, n, bs, m = 2, 2, 2, 8, 7, 4, 3
+    h = kh * groups
+    q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.float32)
+    k_new = jnp.asarray(rng.standard_normal((b, kh, d)), jnp.float32)
+    v_new = jnp.asarray(rng.standard_normal((b, kh, d)), jnp.float32)
+    pool_k = jnp.asarray(rng.standard_normal((n, bs, kh, d)), jnp.float32)
+    pool_v = jnp.asarray(rng.standard_normal((n, bs, kh, d)), jnp.float32)
+    tables = jnp.asarray([[1, 2, 0], [3, 4, 5]], jnp.int32)
+    lengths = jnp.asarray([6, 9], jnp.int32)
+
+    got = np.asarray(pallas_paged_attention(
+        q, k_new, v_new, pool_k, pool_v, tables, lengths, interpret=True
+    ))
+    for i in range(b):
+        ctx_k = np.asarray(pool_k)[np.asarray(tables)[i]].reshape(m * bs, kh, d)
+        ctx_v = np.asarray(pool_v)[np.asarray(tables)[i]].reshape(m * bs, kh, d)
+        ln = int(lengths[i])
+        ks = np.concatenate([ctx_k[:ln], np.asarray(k_new)[i][None]], axis=0)
+        vs = np.concatenate([ctx_v[:ln], np.asarray(v_new)[i][None]], axis=0)
+        for head in range(h):
+            s = ks[:, head // groups] @ np.asarray(q)[i, head] / np.sqrt(d)
+            p = np.exp(s - s.max()); p /= p.sum()
+            want = p @ vs[:, head // groups]
+            np.testing.assert_allclose(got[i, head], want, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.slow
+def test_paged_kernel_gqa_token_identical():
+    """E2e GQA kernel identity: llama tiny has 4 q heads over 2 kv heads,
+    so a head-grouping mismatch in the kernel would diverge here even
+    though every gpt2 kernel test passes.  Slow tier (llama compiles are
+    heavy); the layout itself is pinned in tier-1 by the unit test above."""
+    from accelerate_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny(dtype=jnp.float32)
+    assert cfg.num_heads != cfg.num_kv_heads  # the point of this test
+    params = llama.init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(23)
+    prompts = [list(rng.integers(0, cfg.vocab_size, size=n)) for n in (5, 9)]
+    want = {}
+    for i, p in enumerate(prompts):
+        out = llama.generate(params, jnp.asarray([p], jnp.int32), cfg, max_new_tokens=4)
+        want[i] = [int(t) for t in np.asarray(out[0])]
+    eng = ServingEngine(
+        llama.apply_cached, llama.init_cache, params, cfg,
+        serving=ServingConfig(block_size=4, num_blocks=20, max_slots=2,
+                              prefill_chunk=8, max_blocks_per_seq=4,
+                              paged_kernel=True),
+    )
+    ids = {eng.submit(p, 4): i for i, p in enumerate(prompts)}
+    outputs = eng.run(max_ticks=200)
+    for rid, out in outputs.items():
+        assert out == want[ids[rid]], f"GQA request {rid} diverged under the kernel"
+
+
+# -- prefix caching -----------------------------------------------------------
+
+
+def _prefix_engine(cfg, params, **overrides):
+    kw = dict(block_size=4, num_blocks=40, max_slots=2, prefill_chunk=8,
+              max_blocks_per_seq=8)
+    kw.update(overrides)
+    return ServingEngine(
+        gpt2.apply_cached, gpt2.init_cache, params, cfg,
+        serving=ServingConfig(**kw),
+    )
+
+
+def test_prefix_cache_shares_blocks_and_skips_prefill(gpt2_setup):
+    """Two requests sharing a prompt physically share refcounted blocks
+    (asserted via allocator accounting), the second request's prefill skips
+    the shared prefix entirely, and both outputs are token-identical."""
+    cfg, params = gpt2_setup
+    rng = np.random.default_rng(19)
+    prompt = list(rng.integers(0, cfg.vocab_size, size=13))  # 3 full blocks + 1
+    want = _oracle(cfg, params, prompt, 4)
+
+    eng = _prefix_engine(cfg, params)
+    a = eng.submit(prompt, 4)
+    out = eng.run(max_ticks=300)
+    assert out[a] == want
+    first_prefills = eng.prefill_dispatches
+    assert first_prefills == 2  # 13 tokens = 2 chunks of 8
+    assert eng.stats()["prefix_cached_blocks"] == 3  # the full prompt blocks
+    cached = list(eng._prefix._by_block)
+
+    b = eng.submit(prompt, 4)
+    eng.step()  # admit + attach the shared prefix (+ the tail chunk + 1 decode)
+    slot = next(iter(eng.sched.slots.values()))
+    assert set(slot.blocks[:3]) <= set(cached), "prefix blocks not shared from the cache"
+    for blk in slot.blocks[:3]:
+        assert eng.cache.allocator.refcount(blk) == 2, "block not physically shared"
+    out = eng.run(max_ticks=300)
+    assert out[b] == want, "prefix-cached request diverged"
+    assert eng.prefill_dispatches == first_prefills + 1  # only the 1-token tail
+    assert eng.prefix_hits == 1 and eng.prefix_blocks_reused == 3
+    # completion released the slot references; the cache keeps its own
+    for blk in cached:
+        assert eng.cache.allocator.refcount(blk) == 1
+    assert eng.cache.allocator.free_blocks == eng.cache.allocator.capacity
+
+
+def test_prefix_cow_reuses_partial_tail_block(gpt2_setup):
+    """A fully-cached feed still must keep >= 1 token to prefill (the final
+    chunk's logits ARE the next token): the partial tail is claimed by
+    copying the cached block (COW) and writing continues in the copy — the
+    shared block itself is never written."""
+    cfg, params = gpt2_setup
+    rng = np.random.default_rng(23)
+    prompt = list(rng.integers(0, cfg.vocab_size, size=12))  # exactly 3 blocks
+    want = _oracle(cfg, params, prompt, 4)
+    eng = _prefix_engine(cfg, params)
+    a = eng.submit(prompt, 4)
+    assert eng.run(max_ticks=300)[a] == want
+    cached_before = {
+        blk: np.asarray(eng.cache.pool["k"][:, blk]).copy()
+        for blk in eng._prefix._by_block
+    }
+    prefills_before = eng.prefill_dispatches
+    b = eng.submit(prompt, 4)
+    eng.step()
+    slot = next(iter(eng.sched.slots.values()))
+    # 11 reusable rows: 2 full shared blocks + a COW copy of the third
+    assert eng.cow_copies == 1 and eng.prefix_blocks_reused == 3
+    assert slot.blocks[2] not in cached_before, "tail was shared, not copied"
+    assert eng.run(max_ticks=300)[b] == want, "COW request diverged"
+    assert eng.prefill_dispatches == prefills_before + 1  # only the tail token
+    for blk, data in cached_before.items():
+        np.testing.assert_array_equal(
+            np.asarray(eng.cache.pool["k"][:, blk]), data,
+        ), "a shared block was written"
+
+
+def test_prefix_cache_refcounts_round_trip_to_capacity(gpt2_setup):
+    """Share/COW/refcount churn round-trips: after N requests sharing one
+    prompt complete, cache-held blocks are reclaimable capacity — a full-
+    capacity alloc succeeds by evicting them, and conservation holds."""
+    cfg, params = gpt2_setup
+    rng = np.random.default_rng(29)
+    prompt = list(rng.integers(0, cfg.vocab_size, size=13))
+    eng = _prefix_engine(cfg, params, max_slots=2)
+    for _ in range(5):
+        eng.submit(prompt, 3)
+    eng.run(max_ticks=1000)
+    alloc = eng.cache.allocator
+    assert eng.prefix_hits >= 3  # slots admitted after the first prefill hit
+    assert alloc.free_blocks == alloc.capacity  # cached blocks ARE capacity
+    assert alloc.used_blocks == 0
+    whole = alloc.alloc(alloc.capacity)  # evicts the cache to serve the grant
+    assert sorted(whole) == list(range(1, alloc.num_blocks))
+    assert len(eng._prefix) == 0
+    alloc.free(whole)
+    assert alloc.free_blocks == alloc.capacity
+
+
+def test_quarantine_never_scrubs_shared_block_under_live_reader(gpt2_setup):
+    """Scrub-on-last-release: a quarantined request's shared prefix blocks
+    are NOT zeroed while another request still reads them (refcount > 1) —
+    the survivor finishes token-identically — and they ARE scrubbed once the
+    last reference drops."""
+    import os as _os
+
+    from accelerate_tpu.resilience import faultinject
+
+    cfg, params = gpt2_setup
+    rng = np.random.default_rng(31)
+    prompt = list(rng.integers(0, cfg.vocab_size, size=13))
+    want = _oracle(cfg, params, prompt, 6)
+    _os.environ["ACCELERATE_TPU_FAULT_SERVING_NAN_REQUEST"] = "3"
+    faultinject.reload()
+    try:
+        eng = _prefix_engine(cfg, params, max_slots=2)
+        a = eng.submit(prompt, 6)
+        assert eng.run(max_ticks=300)[a] == want
+        shared = list(eng._prefix._by_block)
+        before = {blk: np.asarray(eng.cache.pool["k"][:, blk]).copy() for blk in shared}
+        survivor = eng.submit(prompt, 6)   # submission 2: shares the prefix
+        doomed = eng.submit(prompt, 6)     # submission 3: poisoned, shares too
+        # Drive until the poisoned request quarantines; the shared blocks
+        # must survive untouched while the survivor still reads them.
+        statuses = {}
+        for _ in range(200):
+            for c in eng.step():
+                statuses[c.id] = (c.status, c.tokens)
+            if doomed in statuses:
+                break
+        assert statuses[doomed][0] == "quarantined"
+        assert survivor not in statuses, "survivor finished before the quarantine"
+        for blk in shared:
+            if eng.cache.allocator.refcount(blk) > 0:
+                np.testing.assert_array_equal(
+                    np.asarray(eng.cache.pool["k"][:, blk]), before[blk],
+                )
+        eng.run(max_ticks=500)
+        done = {c.id: c for c in eng.pop_finished()}
+        assert done[survivor].status == "ok"
+        assert done[survivor].tokens == want, "survivor diverged"
+        # quarantine dropped the blocks from the cache (no new sharers) and
+        # the last release scrubbed them to zero before reuse
+        assert len(eng._prefix) == 0
+        for blk in shared:
+            assert eng.cache.allocator.refcount(blk) == 0
+            assert float(jnp.sum(jnp.abs(eng.cache.pool["k"][:, blk]))) == 0.0
+    finally:
+        _os.environ.pop("ACCELERATE_TPU_FAULT_SERVING_NAN_REQUEST", None)
+        faultinject.reload()
+
+
+def test_journal_recovery_rehits_prefix_cache(gpt2_setup, tmp_path):
+    """Recovered resubmissions flow through the same admission path, so a
+    successor serving journaled requests with a shared prefix re-hits its
+    prefix cache as soon as the first recovery populates it."""
+    cfg, params = gpt2_setup
+    jp = str(tmp_path / "journal.json")
+    rng = np.random.default_rng(37)
+    prompt = list(rng.integers(0, cfg.vocab_size, size=13))
+    want = _oracle(cfg, params, prompt, 4)
+    eng = _prefix_engine(cfg, params, journal_path=jp)
+    for i in range(3):
+        eng.submit(prompt, 4, tag=f"t{i}")
+    # abandon before any tick (the SIGKILL stand-in); recover in a successor
+    succ = _prefix_engine(cfg, params, journal_path=jp, max_slots=1)
+    mapping = succ.recover_from_journal()
+    assert len(mapping) == 3
+    succ.run(max_ticks=1000)
+    done = {c.tag: c.tokens for c in succ.pop_finished()}
+    assert all(done[f"t{i}"] == want for i in range(3))
+    assert succ.prefix_hits >= 2, "recovered siblings did not re-hit the prefix cache"
+
+
+def test_prefix_cache_unit_lookup_cow_and_eviction():
+    """PrefixCache mechanics without an engine: chain-key identity, the
+    max_rows cap, the COW tail handoff, LRU eviction of cache-only blocks,
+    and the stranded-chain rule (a lookup stops at the first miss)."""
+    alloc = BlockAllocator(9)
+    cache = PrefixCache(alloc, block_size=4)
+    tokens = list(range(12))
+    keys = PrefixCache.chain_keys(tokens, 4)
+    assert len(keys) == 3 and len(set(keys)) == 3
+    # chain identity: same third block tokens after a different prefix
+    other = [99] + tokens[1:]
+    assert PrefixCache.chain_keys(other, 4)[2] != keys[2]
+
+    blocks = alloc.alloc(3)
+    for k, b in zip(keys, blocks):
+        assert cache.register(k, b)
+    alloc.free(blocks)  # the requester is done; cache keeps them alive
+    assert alloc.free_blocks == alloc.capacity and cache.reclaimable_count == 3
+
+    got, rows, cow = cache.lookup(tokens, max_rows=11)
+    assert got == blocks[:2] and rows == 8 and cow == blocks[2]
+    for b in got + [cow]:
+        assert alloc.refcount(b) == 2
+    alloc.free(got + [cow])
+
+    # eviction: alloc beyond the free list reclaims LRU cache-only blocks
+    grant = alloc.alloc(8)
+    assert len(grant) == 8 and len(cache) == 0
+    assert cache.lookup(tokens, max_rows=11) == ([], 0, None)
+    alloc.free(grant)
+
+
+def test_allocator_fuzz_shared_block_churn():
+    """Allocator fuzz with sharing: random alloc/retain/free interleavings
+    keep block conservation (free + held == capacity, each block counted
+    once) and the whole pool round-trips to one full grant."""
+    alloc = BlockAllocator(17)
+    rng = np.random.default_rng(41)
+    held = []  # each entry is one reference: (block,)
+    for _ in range(400):
+        r = rng.random()
+        if held and r < 0.35:
+            idx = int(rng.integers(len(held)))
+            alloc.free([held.pop(idx)])
+        elif held and r < 0.55:
+            blk = held[int(rng.integers(len(held)))]
+            alloc.retain(blk)
+            held.append(blk)  # a second reference to the same block
+        else:
+            n = int(rng.integers(1, 4))
+            if n <= alloc.free_blocks:
+                held.extend(alloc.alloc(n))
+        distinct = len(set(held))
+        assert alloc.used_blocks == distinct
+        assert alloc.free_blocks + distinct == alloc.capacity, "conservation broke"
+    for blk in held:
+        alloc.free([blk])
+    whole = alloc.alloc(alloc.capacity)
+    assert sorted(whole) == list(range(1, 17))
+
+
+def test_prefix_cache_reclaimable_counter_fuzz():
+    """The O(1) incremental reclaimable counter must agree with the O(n)
+    refcount scan under random retain/free/register/invalidate/evict
+    interleavings — it feeds free_blocks, so drift would either strand
+    capacity or let alloc over-promise."""
+    from accelerate_tpu.serving.blocks import PrefixCache
+
+    alloc = BlockAllocator(17)
+    cache = PrefixCache(alloc, block_size=4)
+    rng = np.random.default_rng(43)
+    held = []
+    key_n = 0
+    for _ in range(600):
+        r = rng.random()
+        if held and r < 0.30:
+            alloc.free([held.pop(int(rng.integers(len(held))))])
+        elif held and r < 0.45:
+            blk = held[int(rng.integers(len(held)))]
+            alloc.retain(blk)
+            held.append(blk)
+        elif held and r < 0.60:
+            key_n += 1
+            cache.register(bytes([key_n % 256, key_n // 256]), held[int(rng.integers(len(held)))])
+        elif cache._by_block and r < 0.70:
+            cache.invalidate_blocks([int(rng.integers(1, 17))])
+        elif r < 0.78:
+            cache.evict(int(rng.integers(1, 3)))
+        else:
+            n = int(rng.integers(1, 4))
+            if n <= alloc.free_blocks:
+                held.extend(alloc.alloc(n))
+        scan = sum(1 for b in cache._by_block if alloc.refcount(b) == 1)
+        assert cache.reclaimable_count == scan, "incremental counter drifted"
+        assert alloc.free_blocks + alloc.used_blocks == alloc.capacity
+    for blk in held:
+        alloc.free([blk])
+    # every remaining cached block is reclaimable; one full grant evicts all
+    assert cache.reclaimable_count == len(cache._by_block)
+    whole = alloc.alloc(alloc.capacity)
+    assert sorted(whole) == list(range(1, 17)) and len(cache) == 0
 
 
 # ---------------------------------------------------------------------------
@@ -984,7 +1455,11 @@ def test_shed_and_deadline_counters_exposed_via_prometheus(gpt2_setup):
     tel = telemetry.enable()
     _robust_engine(cfg, params)
     text = render_prometheus(tel.registry)
-    for stem in ("serving_shed", "serving_deadline_expired", "serving_quarantined"):
+    for stem in (
+        "serving_shed", "serving_deadline_expired", "serving_quarantined",
+        "serving_prefix_hits", "serving_prefix_blocks_reused",
+        "serving_prefix_cow_copies", "serving_decode_gather_bytes",
+    ):
         assert f"accelerate_tpu_{stem}_total 0" in text, stem
 
 
