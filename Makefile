@@ -1,10 +1,10 @@
 # Test-suite splits mirroring the reference Makefile:25-77.
 
-.PHONY: test test-quick test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels bench telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke pp-smoke profile-smoke serving-smoke elastic-smoke chaos-smoke serving-chaos-smoke goodput-smoke perf-gate
+.PHONY: test test-quick test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels bench telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke pp-smoke profile-smoke serving-smoke serving-trace-smoke elastic-smoke chaos-smoke serving-chaos-smoke goodput-smoke perf-gate
 
 PYTEST = python -m pytest -q
 
-test: test-quick telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke pp-smoke profile-smoke serving-smoke elastic-smoke chaos-smoke serving-chaos-smoke goodput-smoke perf-gate
+test: test-quick telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke pp-smoke profile-smoke serving-smoke serving-trace-smoke elastic-smoke chaos-smoke serving-chaos-smoke goodput-smoke perf-gate
 	$(PYTEST) tests/
 
 # <5 min tier (VERDICT r5 item 6): oracles, state, sharding-spec/mesh,
@@ -114,6 +114,18 @@ profile-smoke:
 # telemetry report (docs/usage_guides/serving.md).
 serving-smoke:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.serving.smoke
+
+# Per-request trace proof: a forced-slow request mix (injected queue delay +
+# injected preemption) must be blamed on the right phase by the trace
+# decomposer with the conservation invariant holding per request, the Chrome
+# export must re-parse through telemetry/timeline.py with slot/request
+# tracks intact, a live mid-flight /debug/requests + /debug/blocks +
+# /healthz scrape must succeed (404s unchanged), the offline report block
+# must render from the trace JSONL alone, and steady-state decode throughput
+# with tracing on must stay close to off
+# (docs/package_reference/serving_tracing.md).
+serving-trace-smoke:
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.serving.trace_smoke
 
 # Serving-under-fire proof: a seeded campaign mixing an overload burst
 # (exact shed count), a NaN-poisoned request (in-program detection ->

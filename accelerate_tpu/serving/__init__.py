@@ -21,6 +21,12 @@ Robustness layer (overload shedding, request deadlines, poison-request
 quarantine, crash-recovery journal): ``engine.py`` + ``journal.py``, proven
 under fire by the seeded serving chaos campaign (``serving/chaos.py``,
 ``make serving-chaos-smoke``).
+
+Observability layer (per-request phase traces, tail-latency blame
+decomposition, Chrome-trace export, live ``/debug`` endpoints):
+``tracing.py`` + the metrics HTTP server, walked through in
+``docs/usage_guides/serving.md`` ("Tracing a slow request") and specified
+in ``docs/package_reference/serving_tracing.md``.
 """
 
 from .blocks import BlockAllocator, BlockOutOfMemory, PagedKVCache, PrefixCache
@@ -32,6 +38,14 @@ from .engine import (
 )
 from .journal import JournalError, ServingJournal
 from .scheduler import Request, RequestState, Scheduler
+from .tracing import (
+    RequestTrace,
+    ServingTracer,
+    export_chrome_trace,
+    load_serving_traces,
+    stitch_traces,
+    summarize_traces,
+)
 
 __all__ = [
     "AdmissionRejected",
@@ -43,8 +57,14 @@ __all__ = [
     "JournalError",
     "Request",
     "RequestState",
+    "RequestTrace",
     "Scheduler",
     "ServingConfig",
     "ServingEngine",
     "ServingJournal",
+    "ServingTracer",
+    "export_chrome_trace",
+    "load_serving_traces",
+    "stitch_traces",
+    "summarize_traces",
 ]

@@ -123,6 +123,7 @@ from .blocks import (
 )
 from .journal import JournalError, ServingJournal
 from .scheduler import Request, RequestState, Scheduler
+from .tracing import ServingTracer, resolve_trace_dir, tracing_enabled
 
 __all__ = [
     "AdmissionRejected",
@@ -182,6 +183,15 @@ class ServingConfig:
     - ``prefix_cache``: share full prompt blocks across requests by content
       hash (copy-on-write tail, refcounted blocks, LRU reclaim).  Host-side
       policy only — the compiled programs are identical either way.
+
+    Tracing knobs (``serving/tracing.py`` — host-side interval bookkeeping,
+    no effect on the compiled programs):
+
+    - ``trace``: per-request phase tracing.  ``None`` (default) defers to
+      ``ACCELERATE_TPU_SERVING_TRACE`` (default-on; ``0`` kills).
+    - ``trace_dir``: where trace JSONL persists; ``None`` defers to
+      ``ACCELERATE_TPU_SERVING_TRACE_DIR``, then the enabled telemetry run
+      dir, else in-memory only.
     """
 
     block_size: int = 16
@@ -196,6 +206,8 @@ class ServingConfig:
     decode_path: str = "paged"
     paged_kernel: bool = False
     prefix_cache: bool = True
+    trace: Optional[bool] = None
+    trace_dir: Optional[str] = None
 
     def resolved_max_blocks(self) -> int:
         if self.max_blocks_per_seq is not None:
@@ -323,6 +335,26 @@ class ServingEngine:
             PrefixCache(self.cache.allocator, sc.block_size)
             if sc.prefix_cache else None
         )
+        # Per-request phase tracing (host-side interval bookkeeping only).
+        # The scheduler's preemption callback is the one eviction site every
+        # preemption flavor funnels through (drain, block pressure, LIFO
+        # victim), so the tracer sees them all without per-caller plumbing.
+        self.tracer: Optional[ServingTracer] = None
+        if tracing_enabled(sc.trace):
+            self.tracer = ServingTracer(dir=resolve_trace_dir(sc.trace_dir))
+            self.sched.on_preempt = (
+                lambda req: self.tracer.on_preempt(req, time.monotonic())
+            )
+        # Per-width jit-cache bookkeeping for bucket-compile attribution:
+        # a width this engine has not dispatched yet means the next dispatch
+        # pays a trace+compile in the request's latency path.
+        self._seen_widths: Dict[str, set] = {"decode": set(), "prefill": set()}
+        # Live /debug endpoints: the metrics HTTP server asks registered
+        # engines for request/block snapshots (weakly — a collected engine
+        # just drops off the page).
+        from ..telemetry import export as _export
+
+        _export.register_debug_source(self)
         if self.decode_path == "paged":
             # One jitted wrapper each; bucketed table widths retrace under it
             # (jit caches per shape), so a tick is still exactly one decode
@@ -535,6 +567,8 @@ class ServingEngine:
         # so every acknowledged request is recoverable after a SIGKILL.
         if self.journal is not None:
             self.journal.record_admit(req)
+        if self.tracer is not None:
+            self.tracer.on_submit(req)
         tel = get_telemetry()
         if tel.enabled:
             tel.registry.counter("serving.requests").inc()
@@ -553,17 +587,25 @@ class ServingEngine:
             self.drain()
             return []
         self.ticks += 1
+        if self.tracer is not None:
+            self.tracer.begin_tick(now)
         self._drain_scrubs()
         # Deadline expiry FIRST: an expired queued request is shed before a
         # slot, a prefill chunk, or any blocks are spent on it.
         self._expire_deadlines(now)
         admitted = self.sched.admit(now)
+        if self.tracer is not None:
+            admit_t = time.monotonic()
+            for idx in admitted:
+                self.tracer.on_admit(self.sched.slots[idx].request, admit_t, idx)
         for idx in admitted:
             self._attach_prefix(idx)
         self._observe_requeue_waits(admitted)
         self._prefill_tick(now)
         self._decode_tick(now)
         self._drain_scrubs()
+        if self.tracer is not None:
+            self.tracer.end_tick(time.monotonic(), self.sched.slots)
         self._publish_gauges()
         return self._finished[done_before:]
 
@@ -643,6 +685,11 @@ class ServingEngine:
                 completed=len(self._finished),
                 journal=journal,
             )
+        if self.tracer is not None:
+            # Snapshot every still-live timeline: the successor's stitcher
+            # needs this life's partial phases even though no terminal
+            # record will ever land here.
+            self.tracer.flush()
         self._publish_gauges()
         return journal
 
@@ -699,8 +746,15 @@ class ServingEngine:
                         deadline_ms=rec.get("deadline_ms"),
                     )
                     mapping[rec["id"]] = rid
+                    if self.tracer is not None:
+                        self.tracer.on_recover(rid, rec)
         finally:
             self._recovering = False
+        if self.tracer is not None:
+            # Land the recovered requests' snapshot lines immediately: the
+            # stitcher can already pair this life with the victim's even if
+            # this engine is itself killed before any completes.
+            self.tracer.flush()
         tel = get_telemetry()
         if tel.enabled:
             tel.registry.counter("serving.journal_recoveries").inc()
@@ -914,6 +968,24 @@ class ServingEngine:
             width *= 2
         return min(width, m)
 
+    def _note_bucket(self, kind: str, width: Optional[int]) -> bool:
+        """Record a dispatch at this table width; returns True when the
+        width is FRESH for ``kind`` — the per-width jit cache misses and the
+        dispatch pays a trace+compile in the request's latency path.  The
+        ``serving.bucket_compile`` event makes that TTFT spike attributable
+        even with tracing disabled (the dense path keys on its one static
+        width: its first dispatch is the one compile)."""
+        key = width if width is not None else self.serving.resolved_max_blocks()
+        if key in self._seen_widths[kind]:
+            return False
+        self._seen_widths[kind].add(key)
+        tel = get_telemetry()
+        if tel.enabled:
+            # "dispatch" not "kind": event() reserves "kind" for the record
+            # envelope, and a field named kind would shadow it in the JSONL.
+            tel.event("serving.bucket_compile", dispatch=kind, width=key)
+        return True
+
     def _table_row(self, blocks: List[int], width: Optional[int] = None) -> np.ndarray:
         m = width if width is not None else self.serving.resolved_max_blocks()
         row = np.zeros((m,), np.int32)
@@ -947,6 +1019,7 @@ class ServingEngine:
             width = self._bucket_width(
                 blocks_for_tokens(start + chunk_len, self.serving.block_size)
             )
+        fresh = self._note_bucket("prefill", width)
         next_tok, ok, self.cache.pool = self._prefill_fn(
             self.params,
             self.cache.pool,
@@ -960,7 +1033,13 @@ class ServingEngine:
         if tel.enabled:
             tel.registry.counter("serving.prefill_dispatches").inc()
         slot.cache_len = start + n_real
-        if not bool(ok):
+        poisoned = not bool(ok)  # host sync point: the dispatch is done here
+        if self.tracer is not None:
+            self.tracer.on_prefill(
+                req, idx, time.monotonic(),
+                padded_rows=chunk_len - n_real, width=width, fresh=fresh,
+            )
+        if poisoned:
             self._quarantine(idx, time.monotonic())
             return
         self._register_prefix_blocks(idx)
@@ -1010,6 +1089,8 @@ class ServingEngine:
             lengths[idx] = slot.cache_len
             tokens[idx] = slot.request.emitted[-1]
         self.decode_gather_bytes += gathered * self._block_bytes
+        fresh = self._note_bucket("decode", m)
+        dispatch_t0 = time.monotonic()
         args = [self.params, self.cache.pool, tables, lengths, tokens]
         if self._poison_ordinal is not None:
             # Armed: the program was traced with the poison lane.  NaN rides
@@ -1031,9 +1112,18 @@ class ServingEngine:
             tel.registry.counter("serving.decode_gather_bytes").inc(
                 gathered * self._block_bytes
             )
+            tel.registry.gauge("serving.decode_bucket_width").set(m)
         out = np.asarray(next_tokens)
         oks = np.asarray(ok_flags)
         emit_t = time.monotonic()
+        if self.tracer is not None:
+            # emit_t is PAST the np.asarray sync point, so the interval
+            # covers the real device work despite async dispatch.
+            self.tracer.on_decode(
+                [(sched.slots[idx].request, idx) for idx in live],
+                emit_t, co_batch=len(live), width=m, fresh=fresh,
+                dispatch_ms=(emit_t - dispatch_t0) * 1e3,
+            )
         for idx in live:
             sched.slots[idx].cache_len += 1
             if not bool(oks[idx]):
@@ -1122,6 +1212,8 @@ class ServingEngine:
                 queue_wait_ms=round(queue_wait_ms, 3),
                 preemptions=req.preemptions,
             )
+        if self.tracer is not None:
+            self.tracer.on_terminal(req, status)
 
     def _publish_gauges(self) -> None:
         tel = get_telemetry()
@@ -1146,6 +1238,85 @@ class ServingEngine:
 
     # -- introspection -------------------------------------------------------
 
+    def debug_requests(self) -> List[dict]:
+        """Live request snapshot for the ``/debug/requests`` endpoint: every
+        queued and slotted request with its state, age, and (when tracing is
+        on) its phase-so-far decomposition.  Host-side reads only — safe to
+        call from the metrics server thread between ticks."""
+        now = time.monotonic()
+        out = []
+        seen = set()
+        for idx, slot in sorted(self.sched.slots.items()):
+            req = slot.request
+            seen.add(req.id)
+            out.append(self._debug_request(req, now, slot=idx))
+        for req in self.sched.queue:
+            if req.id not in seen:
+                out.append(self._debug_request(req, now, slot=None))
+        return out
+
+    def _debug_request(self, req: Request, now: float, slot: Optional[int]) -> dict:
+        rec = {
+            "id": req.id,
+            "tag": req.tag,
+            "state": req.state.name,
+            "slot": slot,
+            "age_ms": round((now - req.arrival_t) * 1e3, 3),
+            "prompt_len": len(req.prompt),
+            "emitted": len(req.emitted),
+            "max_new": req.max_new_tokens,
+            "preemptions": req.preemptions,
+        }
+        if self.tracer is not None:
+            rec["trace"] = self.tracer.snapshot_request(req.id, now)
+        return rec
+
+    def debug_blocks(self) -> dict:
+        """Pool snapshot for ``/debug/blocks``: occupancy, per-block
+        refcounts (shared prefix blocks show >1), and the prefix-cache
+        chains with their reclaimability."""
+        alloc = self.cache.allocator
+        refcounts = {
+            str(b): n for b, n in sorted(alloc._ref.items()) if n > 0
+        }
+        out = {
+            "capacity": alloc.capacity,
+            "free": alloc.free_blocks,
+            "used": alloc.used_blocks,
+            "occupancy": round(alloc.occupancy, 4),
+            "pending_scrub": sorted(alloc._pending_scrub),
+            "refcounts": refcounts,
+            "slots": {
+                str(idx): {
+                    "request": slot.request.id,
+                    "blocks": list(slot.blocks),
+                    "cache_len": slot.cache_len,
+                }
+                for idx, slot in sorted(self.sched.slots.items())
+            },
+        }
+        if self._prefix is not None:
+            out["prefix_cache"] = {
+                "blocks": len(self._prefix),
+                "reclaimable": self._prefix.reclaimable_count,
+                # LRU order, oldest first: block plus its live refcount so a
+                # stuck chain (refcount pinned > 1) is visible at a glance.
+                "chain": [
+                    {"block": b, "refcount": alloc.refcount(b)}
+                    for b in self._prefix._entries.values()
+                ],
+            }
+        return out
+
+    def export_chrome_trace(self, path: str) -> str:
+        """Dump every traced request (completed ring + live) as a
+        Chrome/Perfetto trace; see ``serving/tracing.py``."""
+        from .tracing import export_chrome_trace
+
+        if self.tracer is None:
+            raise RuntimeError("tracing is disabled on this engine")
+        return export_chrome_trace(path, self.tracer.traces())
+
     def stats(self) -> dict:
         alloc = self.cache.allocator
         return {
@@ -1168,4 +1339,8 @@ class ServingEngine:
             "prefix_blocks_reused": self.prefix_blocks_reused,
             "prefix_cow_copies": self.cow_copies,
             "prefix_cached_blocks": len(self._prefix) if self._prefix else 0,
+            "decode_bucket_widths": sorted(self._seen_widths["decode"]),
+            "trace_blame": (
+                dict(self.tracer.blame_counts) if self.tracer is not None else None
+            ),
         }

@@ -41,6 +41,7 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import time
 from typing import Dict, List, Optional
 
 __all__ = ["ServingJournal", "JournalError", "JOURNAL_VERSION"]
@@ -104,6 +105,11 @@ class ServingJournal:
             "ttft_deadline_ms": req.ttft_deadline_ms,
             "deadline_ms": req.deadline_ms,
             "emitted": [],
+            # Wall-clock admission anchor: the tracer's cross-life stitcher
+            # dates the victim's life from it even when the victim never
+            # flushed a trace line (monotonic clocks die with the process).
+            # Same schema version — readers ignore keys they do not use.
+            "arrival_wall": time.time(),
         }
         self._flush()
 

@@ -27,7 +27,7 @@ import itertools
 import time
 from collections import deque
 from enum import Enum
-from typing import Deque, Dict, List, Optional
+from typing import Callable, Deque, Dict, List, Optional
 
 from .blocks import BlockAllocator, BlockOutOfMemory, blocks_for_tokens
 
@@ -176,6 +176,10 @@ class Scheduler:
         self.slots: Dict[int, _Slot] = {}  # slot index -> lane
         self._admit_seq = itertools.count()
         self.preempted_count = 0
+        # Observer hook: called with the evicted Request on every preemption
+        # (the engine wires its tracer here — one site sees the LIFO victim,
+        # the self-preemption, and the drain flavors alike).
+        self.on_preempt: Optional[Callable[[Request], None]] = None
 
     # -- capacity validation -------------------------------------------------
 
@@ -267,6 +271,8 @@ class Scheduler:
         req.requeued_t = time.monotonic()
         self.preempted_count += 1
         self.queue.appendleft(req)
+        if self.on_preempt is not None:
+            self.on_preempt(req)
         return idx
 
     def grow_to(self, idx: int, rows: int) -> bool:

@@ -20,6 +20,20 @@ Default-off: with neither env var set, ``maybe_start_from_env`` does
 nothing.  The exporter starts when telemetry enables and stops (with one
 final snapshot) when it disables.
 
+Besides ``/metrics`` the endpoint serves:
+
+- ``GET /healthz`` — liveness probe (``200 ok``), so an orchestrator can
+  distinguish "exporter up" from "exporter gone" without paying for a full
+  registry render;
+- ``GET /debug/requests`` / ``GET /debug/blocks`` — live serving-engine
+  introspection (JSON): in-flight request states with phase-so-far trace
+  decomposition, and block-pool occupancy / refcounts / prefix-cache
+  chains.  Engines self-register via :func:`register_debug_source`
+  (weakly — a collected engine drops off the page); with no live engine
+  the endpoints return an empty payload, not an error.
+
+Everything else still 404s.
+
 Naming: registry names are dotted (``serving.ttft_ms``); Prometheus names
 are ``accelerate_tpu_`` + the dotted name with ``.`` → ``_``
 (``accelerate_tpu_serving_ttft_ms``).  Counters get the ``_total`` suffix;
@@ -39,10 +53,12 @@ gauges, so the report and the snapshot carry them too.
 
 from __future__ import annotations
 
+import json
 import math
 import os
 import threading
-from typing import Optional
+import weakref
+from typing import List, Optional
 
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
 
@@ -55,6 +71,8 @@ __all__ = [
     "get_exporter",
     "maybe_start_from_env",
     "stop_if_running",
+    "register_debug_source",
+    "debug_payload",
     "ENV_PORT",
     "ENV_SNAPSHOT",
     "ENV_SNAPSHOT_EVERY",
@@ -84,6 +102,45 @@ def _env_float(key: str, default: float) -> float:
         return float(os.environ.get(key, "") or default)
     except ValueError:
         return default
+
+
+# ---------------------------------------------------------------------------
+# Live /debug sources (serving engines self-register, weakly)
+# ---------------------------------------------------------------------------
+
+_DEBUG_SOURCES: List["weakref.ref"] = []
+
+
+def register_debug_source(engine) -> None:
+    """Register an object exposing ``debug_requests()`` / ``debug_blocks()``
+    for the ``/debug/*`` endpoints.  Held weakly: a garbage-collected engine
+    silently drops out, so registration never extends an engine's life."""
+    _DEBUG_SOURCES.append(weakref.ref(engine))
+
+
+def _live_debug_sources() -> list:
+    alive = []
+    for ref in list(_DEBUG_SOURCES):
+        obj = ref()
+        if obj is None:
+            _DEBUG_SOURCES.remove(ref)
+        else:
+            alive.append(obj)
+    return alive
+
+
+def debug_payload(kind: str) -> dict:
+    """The JSON body for ``/debug/requests`` or ``/debug/blocks``: one entry
+    per live registered engine (keyed by position — multiple engines in one
+    process are rare but legal)."""
+    method = {"requests": "debug_requests", "blocks": "debug_blocks"}[kind]
+    engines = []
+    for obj in _live_debug_sources():
+        try:
+            engines.append(getattr(obj, method)())
+        except Exception as e:  # a torn snapshot must not kill the scrape
+            engines.append({"error": str(e)[:200]})
+    return {"engines": engines}
 
 
 # ---------------------------------------------------------------------------
@@ -244,8 +301,31 @@ class MetricsExporter:
         exporter = self
 
         class Handler(BaseHTTPRequestHandler):
+            def _reply(self, body: bytes, content_type: str):
+                self.send_response(200)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):  # noqa: N802 — http.server API
-                if self.path.split("?", 1)[0] != "/metrics":
+                path = self.path.split("?", 1)[0]
+                if path == "/healthz":
+                    # Liveness, not readiness: answering at all is the signal,
+                    # so no registry render on the probe path.
+                    self._reply(b"ok\n", "text/plain; charset=utf-8")
+                    return
+                if path in ("/debug/requests", "/debug/blocks"):
+                    try:
+                        body = json.dumps(
+                            debug_payload(path.rsplit("/", 1)[1])
+                        ).encode()
+                    except Exception as e:
+                        self.send_error(500, str(e)[:100])
+                        return
+                    self._reply(body, "application/json; charset=utf-8")
+                    return
+                if path != "/metrics":
                     self.send_error(404)
                     return
                 try:
@@ -253,13 +333,9 @@ class MetricsExporter:
                 except Exception as e:  # a scrape must never crash the server
                     self.send_error(500, str(e)[:100])
                     return
-                self.send_response(200)
-                self.send_header(
-                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                self._reply(
+                    body, "text/plain; version=0.0.4; charset=utf-8"
                 )
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
 
             def log_message(self, *args):  # silence per-scrape stderr spam
                 pass

@@ -93,6 +93,7 @@ GAUGES = frozenset({
     "serving.active_slots",
     "serving.block_occupancy",
     "serving.blocks_used",
+    "serving.decode_bucket_width",
     "serving.prefix_cache_blocks",
     "serving.queue_depth",
     "serving.slo.ttft_target_ms",
@@ -132,6 +133,7 @@ EVENTS = frozenset({
     "sentinel.profile_failed",
     "sentinel.profile_start",
     "sentinel.straggler",
+    "serving.bucket_compile",
     "serving.drained",
     "serving.journal_recovered",
     "serving.quarantined",
@@ -146,6 +148,9 @@ DYNAMIC_PATTERNS = (
     re.compile(r"^introspect\..+\.(flops|comms_bytes)$"),
     re.compile(r"^goodput\..+_s$"),               # goodput.{category}_s gauges
     re.compile(r"^serving\.slo\..+_(target_ms|burn_rate)$"),
+    # serving.trace.blame.{phase} counters + serving.trace.unattributed_ms
+    # (the per-request trace family — see docs/package_reference/serving_tracing.md)
+    re.compile(r"^serving\.trace\..+$"),
 )
 
 

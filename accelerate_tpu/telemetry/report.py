@@ -28,6 +28,7 @@ import time
 __all__ = [
     "load_records",
     "load_flight_records",
+    "load_serving_trace_records",
     "summarize",
     "summarize_flight",
     "format_report",
@@ -77,6 +78,23 @@ def load_flight_records(path: str) -> list[dict]:
     else:
         files = [path]
     return _parse_jsonl(files)
+
+
+def load_serving_trace_records(path: str) -> list[dict]:
+    """Per-request serving trace records (``serving_trace_*.jsonl``) under a
+    run directory, or one such file directly.  The loader lives in
+    ``serving/tracing.py`` (stdlib-only code, but inside the serving
+    package); an unimportable serving package degrades to "no traces"
+    rather than killing the rest of the report."""
+    if not os.path.isdir(path) and not os.path.basename(path).startswith(
+        "serving_trace_"
+    ):
+        return []
+    try:
+        from ..serving.tracing import load_serving_traces
+    except Exception:
+        return []
+    return load_serving_traces(path)
 
 
 def summarize(records: list[dict]) -> dict:
@@ -522,6 +540,7 @@ def main(argv=None) -> int:
             return 1
     records: list = []
     flight: list = []
+    serving_traces: list = []
     if args.path is not None:
         if not os.path.exists(args.path):
             print(f"no such file or directory: {args.path}", file=sys.stderr)
@@ -529,13 +548,17 @@ def main(argv=None) -> int:
         is_flight_file = not os.path.isdir(args.path) and os.path.basename(
             args.path
         ).startswith("flightrec_")
-        records = [] if is_flight_file else load_records(args.path)
+        is_trace_file = not os.path.isdir(args.path) and os.path.basename(
+            args.path
+        ).startswith("serving_trace_")
+        records = [] if (is_flight_file or is_trace_file) else load_records(args.path)
         flight = (
             load_flight_records(args.path)
             if (os.path.isdir(args.path) or is_flight_file)
             else []
         )
-        if not records and not flight:
+        serving_traces = load_serving_trace_records(args.path)
+        if not records and not flight and not serving_traces:
             print(f"no telemetry records found under {args.path}", file=sys.stderr)
             # A successful --profile scan still renders: the run dir being
             # empty must not throw away the half that worked.
@@ -554,6 +577,12 @@ def main(argv=None) -> int:
             out["telemetry"] = summary
         if flight:
             out["postmortem"] = summarize_flight(flight)
+        if serving_traces:
+            # Offline blame decomposition, recomputed from the trace JSONL —
+            # a dead engine gets the same block a live one would.
+            from ..serving.tracing import summarize_traces
+
+            out["serving_traces"] = summarize_traces(serving_traces)
         if profile_report is not None:
             out["profile"] = profile_report.to_dict()
         print(json.dumps(out, default=str))
@@ -563,6 +592,12 @@ def main(argv=None) -> int:
         blocks.append(format_report(summarize(records)))
     if flight:
         blocks.append(format_flight_report(summarize_flight(flight), last_n=args.last))
+    if serving_traces:
+        from ..serving.tracing import format_trace_block, summarize_traces
+
+        trace_lines = format_trace_block(summarize_traces(serving_traces))
+        if trace_lines:
+            blocks.append("\n".join(trace_lines))
     if profile_report is not None:
         from .profile_scan import format_profile_report
 

@@ -116,6 +116,21 @@ class Timeline:
             lanes.setdefault((ev.pid, ev.tid), []).append(ev)
         return lanes
 
+    def tracks(self) -> dict:
+        """Human labels per (pid, tid) lane: ``"process/thread"`` from the
+        metadata events, falling back to the raw ids.  Covers every lane any
+        event (device or host) landed on — the serving Chrome-trace export
+        validates its slot/request tracks through this."""
+        out: dict = {}
+        for ev in self.events + self.host_events:
+            key = (ev.pid, ev.tid)
+            if key in out:
+                continue
+            proc = self.process_names.get(ev.pid, str(ev.pid))
+            thread = self.thread_names.get(key, str(ev.tid))
+            out[key] = f"{proc}/{thread}"
+        return out
+
 
 def classify_op(name: str) -> str:
     """Bucket one device op by its HLO name: collective / infeed / compute."""

@@ -1184,6 +1184,20 @@ def _serving_probe() -> dict:
 
     paged_row = run_serving_probe(decode_ticks=20)
 
+    # Per-request trace accounting over the staggered-mix window: blame
+    # tally plus the conservation residual the tracer could not attribute
+    # (serving/tracing.py) — a rising residual means the phase taxonomy is
+    # leaking wall time.
+    trace_stats = None
+    if engine.tracer is not None and engine.tracer.completed:
+        resids = [t.unattributed_ms() for t in engine.tracer.completed]
+        trace_stats = {
+            "requests": len(engine.tracer.completed),
+            "blame": dict(sorted(engine.tracer.blame_counts.items())),
+            "unattributed_ms_mean": round(sum(resids) / len(resids), 3),
+            "unattributed_ms_max": round(max(resids), 3),
+        }
+
     return {
         "serving": {
             "requests": len(done),
@@ -1217,6 +1231,7 @@ def _serving_probe() -> dict:
                     1.0 - ttft_with / max(ttft_without, 1e-9), 4
                 ),
             },
+            "trace": trace_stats,
             "paged_decode": {
                 "paged_steps_per_s": paged_row["serving_paged_decode_steps_per_s"],
                 "dense_steps_per_s": paged_row["serving_dense_decode_steps_per_s"],

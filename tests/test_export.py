@@ -157,6 +157,59 @@ def test_endpoint_scrapes_and_404s(tmp_path):
         exporter.stop(final_snapshot=False)
 
 
+def test_healthz_and_debug_endpoints(tmp_path):
+    """/healthz answers 200 ok (no registry access), /debug/requests and
+    /debug/blocks serve JSON from weakly-registered engines, and the
+    unknown-path 404 contract is unchanged by the new routes."""
+    import urllib.error
+
+    from accelerate_tpu.telemetry import export
+
+    telemetry.enable(dir=str(tmp_path))
+
+    class FakeEngine:
+        def debug_requests(self):
+            return [{"id": 7, "tag": "probe", "state": "DECODING"}]
+
+        def debug_blocks(self):
+            return {"capacity": 8, "used": 3, "occupancy": 0.375}
+
+    engine = FakeEngine()
+    export.register_debug_source(engine)
+    exporter = MetricsExporter()
+    exporter.start(port=0)
+    try:
+        base = f"http://127.0.0.1:{exporter.port}"
+        health = urllib.request.urlopen(f"{base}/healthz", timeout=10)
+        assert health.status == 200
+        assert health.read() == b"ok\n"
+        reqs = json.loads(
+            urllib.request.urlopen(f"{base}/debug/requests", timeout=10).read()
+        )
+        assert {"id": 7, "tag": "probe", "state": "DECODING"} in [
+            r for eng in reqs["engines"] for r in eng
+        ]
+        blocks = json.loads(
+            urllib.request.urlopen(f"{base}/debug/blocks", timeout=10).read()
+        )
+        assert {"capacity": 8, "used": 3, "occupancy": 0.375} in blocks["engines"]
+        for bad in ("/other", "/debug", "/debug/nope", "/healthz2"):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(f"{base}{bad}", timeout=10)
+            assert err.value.code == 404, bad
+        # A collected engine drops out of the payload (weak registration).
+        del engine
+        import gc
+
+        gc.collect()
+        reqs = json.loads(
+            urllib.request.urlopen(f"{base}/debug/requests", timeout=10).read()
+        )
+        assert reqs["engines"] == []
+    finally:
+        exporter.stop(final_snapshot=False)
+
+
 def test_disabled_by_default(monkeypatch):
     monkeypatch.delenv("ACCELERATE_TPU_METRICS_PORT", raising=False)
     monkeypatch.delenv("ACCELERATE_TPU_METRICS_SNAPSHOT", raising=False)

@@ -1,0 +1,401 @@
+"""Per-request serving traces (serving/tracing.py): the conservation
+invariant (phases partition admission→terminal wall time, residual exposed),
+blame decomposition naming the injected phase, Chrome-trace export
+round-tripping through telemetry/timeline.py, JSONL persistence with
+last-record-wins + torn-tail tolerance, cross-life stitching by journal tag
+(SIGKILL subprocess proof), and the engine-side bucket-compile attribution
+that works even with tracing off."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu import telemetry
+from accelerate_tpu.models import gpt2
+from accelerate_tpu.serving import ServingConfig, ServingEngine
+from accelerate_tpu.serving.tracing import (
+    RequestTrace,
+    decompose_blame,
+    export_chrome_trace,
+    format_trace_block,
+    load_serving_traces,
+    stitch_traces,
+    summarize_traces,
+)
+from accelerate_tpu.telemetry.timeline import build_timeline, load_trace_events
+
+
+@pytest.fixture(scope="module")
+def gpt2_setup():
+    cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32)
+    params = gpt2.init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+def _engine(cfg, params, trace=True, trace_dir=None, **overrides):
+    kw = dict(block_size=4, num_blocks=32, max_slots=2, max_blocks_per_seq=8,
+              prefill_chunk=8, trace=trace, trace_dir=trace_dir)
+    kw.update(overrides)
+    return ServingEngine(
+        gpt2.apply_cached, gpt2.init_cache, params, cfg,
+        serving=ServingConfig(**kw),
+    )
+
+
+# ---------------------------------------------------------------------------
+# The conservation invariant (unit: no engine, synthetic clock)
+# ---------------------------------------------------------------------------
+
+
+def test_cursor_makes_intervals_a_partition():
+    """add() clamps every interval's start to the cursor and advances it, so
+    intervals are disjoint and ordered NO MATTER what start times callers
+    pass — conservation is structural, not a property of polite callers."""
+    t = RequestTrace(1, "t", arrival=100.0, prompt_len=4, max_new=8)
+    t.add("queue_wait", 100.5)
+    t.add("prefill", 100.8, start=100.2)       # overlapping start: clamped
+    t.add("decode", 101.0, start=99.0)         # before arrival: clamped
+    t.add("preempted", 100.9, start=100.9)     # end < cursor: zero-dur marker
+    t.add("requeued_wait", 101.4)
+    for prev, cur in zip(t.intervals, t.intervals[1:]):
+        assert cur.start >= prev.end
+    t.finish = 101.5
+    window = t.window_ms()
+    attributed = sum(t.phase_ms().values())
+    assert abs(window - attributed - t.unattributed_ms()) < 1e-9
+    assert t.unattributed_ms() == pytest.approx(100.0)  # the 101.4→101.5 gap
+    assert t.phase_ms()["queue_wait"] == pytest.approx(500.0)
+
+
+def test_blame_floor_dominance_and_quarantine():
+    # Quarantine outranks everything, including a huge queue wait.
+    assert decompose_blame({"queue_wait": 900.0}, 1000.0, "quarantined") == "quarantine"
+    # Dominant badput phase above the 10%-of-window floor.
+    assert decompose_blame(
+        {"queue_wait": 400.0, "requeued_wait": 100.0, "decode": 500.0}, 1000.0
+    ) == "queue_wait"
+    # Goodput phases (prefill/decode) are never blamed, however large.
+    assert decompose_blame({"decode": 990.0, "queue_wait": 5.0}, 1000.0) == "none"
+    # Below the floor: immaterial badput is "none", not noise-blame.
+    assert decompose_blame({"compile_in_path": 50.0, "decode": 950.0}, 1000.0) == "none"
+    # The absolute 1 ms floor guards tiny windows.
+    assert decompose_blame({"queue_wait": 0.4, "decode": 0.2}, 0.8) == "none"
+    assert decompose_blame({"queue_wait": 3.0, "decode": 0.2}, 4.0) == "queue_wait"
+
+
+# ---------------------------------------------------------------------------
+# Chrome export / JSONL persistence / stitching (unit: synthetic traces)
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_trace(rid, tag, arrival, phases, slot=0):
+    """phases: [(name, dur_s, meta)] laid end to end from arrival."""
+    t = RequestTrace(rid, tag, arrival=arrival, prompt_len=3, max_new=4)
+    cur = arrival
+    for name, dur, meta in phases:
+        cur += dur
+        t.add(name, cur, **meta)
+    t.finish = cur
+    t.status = "ok"
+    t.blame = decompose_blame(t.phase_ms(), t.window_ms(), "ok")
+    return t
+
+
+def test_chrome_export_roundtrips_through_timeline(tmp_path):
+    now = time.monotonic()
+    traces = [
+        _synthetic_trace(0, "a", now, [
+            ("queue_wait", 0.1, {}),
+            ("prefill", 0.02, {"slot": 0, "chunk": 0}),
+            ("decode", 0.3, {"slot": 0, "co_batch": 2, "ticks": 7}),
+        ]),
+        _synthetic_trace(1, None, now + 0.05, [
+            ("queue_wait", 0.01, {}),
+            ("compile_in_path", 0.4, {"slot": 1, "kind": "decode", "width": 4}),
+        ]),
+    ]
+    for path in (str(tmp_path / "t.trace.json"), str(tmp_path / "t.trace.json.gz")):
+        export_chrome_trace(path, traces)
+        tl = build_timeline(load_trace_events(path), source=path)
+        # Serving events are host-side bookkeeping, never device ops.
+        assert tl.host_events and not tl.events
+        tracks = set(tl.tracks().values())
+        assert "serving engine slots/slot 0" in tracks
+        assert "serving requests/req 0 [a]" in tracks
+        names = {ev.name for ev in tl.host_events}
+        assert {"queue_wait", "decode", "compile_in_path"} <= names
+        # Request-track events carry the request id and phase in args-derived
+        # names; slot tracks mirror them as r<rid>/<phase>.
+        assert any(ev.name == "r0/decode" for ev in tl.host_events)
+
+
+def test_load_last_record_wins_and_tolerates_torn_tail(tmp_path):
+    path = tmp_path / "serving_trace_111_ab.jsonl"
+    rec_inflight = {"kind": "serving_trace", "rid": 5, "tag": "x",
+                    "status": "inflight", "arrival_wall": 10.0,
+                    "duration_ms": 50.0, "phase_ms": {"queue_wait": 50.0},
+                    "unattributed_ms": 0.0}
+    rec_final = dict(rec_inflight, status="ok", duration_ms=80.0,
+                     blame="queue_wait")
+    with open(path, "w") as f:
+        f.write(json.dumps(rec_inflight) + "\n")
+        f.write(json.dumps({"kind": "other"}) + "\n")      # foreign record
+        f.write(json.dumps(rec_final) + "\n")
+        f.write('{"kind": "serving_trace", "rid": 9, "sta')  # torn tail
+    records = load_serving_traces(str(tmp_path))
+    assert len(records) == 1
+    assert records[0]["status"] == "ok" and records[0]["duration_ms"] == 80.0
+    assert records[0]["source"] == path.name
+    # A direct file path loads too.
+    assert load_serving_traces(str(path))[0]["rid"] == 5
+
+
+def test_stitch_joins_lives_by_tag_with_recovery_gap():
+    victim = {"kind": "serving_trace", "rid": 0, "tag": "job", "status": "inflight",
+              "arrival_wall": 1000.0, "duration_ms": 200.0,
+              "phase_ms": {"queue_wait": 10.0, "decode": 190.0},
+              "unattributed_ms": 0.0}
+    successor = {"kind": "serving_trace", "rid": 7, "tag": "job", "status": "ok",
+                 "arrival_wall": 1000.5, "duration_ms": 100.0,
+                 "phase_ms": {"journal_recovery": 0.0, "prefill": 40.0,
+                              "decode": 60.0},
+                 "unattributed_ms": 0.0, "recovered_from": 0}
+    untagged = dict(victim, tag=None, rid=3)
+    stitched = stitch_traces([successor, victim, untagged])
+    assert len(stitched) == 1
+    st = stitched[0]
+    assert st["tag"] == "job" and st["lives"] == 2 and st["status"] == "ok"
+    # Gap between the victim's last trace end (1000.2) and the successor's
+    # arrival (1000.5) is the recovery dead time.
+    assert st["journal_recovery_ms"] == pytest.approx(300.0, abs=1.0)
+    assert st["total_ms"] == pytest.approx(600.0, abs=1.0)
+    assert st["conservation_ok"], st
+    # A single-life tag with no recovery marker does not stitch.
+    assert stitch_traces([victim]) == []
+    summary = summarize_traces([victim, successor])
+    assert summary["requests"] == 1 and summary["inflight"] == 1
+    assert summary["stitched"] == stitched
+    block = "\n".join(format_trace_block(summary))
+    assert "stitched tag 'job'" in block and "conservation ok" in block
+
+
+# ---------------------------------------------------------------------------
+# Engine integration
+# ---------------------------------------------------------------------------
+
+
+def test_kill_switch_and_config_override(gpt2_setup, monkeypatch, tmp_path):
+    cfg, params = gpt2_setup
+    monkeypatch.setenv("ACCELERATE_TPU_SERVING_TRACE", "0")
+    assert _engine(cfg, params, trace=None).tracer is None
+    eng = _engine(cfg, params, trace=True, trace_dir=str(tmp_path))
+    assert eng.tracer is not None  # explicit config beats the env
+    monkeypatch.delenv("ACCELERATE_TPU_SERVING_TRACE")
+    assert _engine(cfg, params, trace=None).tracer is not None  # default-on
+    # Idle-engine introspection payloads have their shape without dispatching.
+    assert eng.debug_requests() == []
+    blocks = eng.debug_blocks()
+    assert blocks["used"] == 0 and blocks["free"] == blocks["capacity"]
+    assert blocks["occupancy"] == 0.0 and blocks["slots"] == {}
+    with pytest.raises(RuntimeError, match="tracing"):
+        _engine(cfg, params, trace=False).export_chrome_trace(
+            str(tmp_path / "no.json")
+        )
+
+
+def test_conservation_and_blame_under_queue_pressure_and_preemption(
+    gpt2_setup, tmp_path
+):
+    """Acceptance criterion: a seeded mix with forced preemption and queue
+    pressure keeps every completed request's phase sum within epsilon of its
+    wall window, and blames the requests whose slowness was injected on the
+    injected phase."""
+    cfg, params = gpt2_setup
+    eng = _engine(cfg, params, trace=True, trace_dir=str(tmp_path))
+    rng = np.random.default_rng(0)
+
+    def prompt(n):
+        return list(rng.integers(0, cfg.vocab_size, size=n))
+
+    # Warm every bucket width the scenario can hit (prefill widths 2-8,
+    # decode widths 1-8) so scenario blame is the injected phase, not
+    # compile_in_path (see serving/trace_smoke.py for the width math).
+    eng.submit(prompt(3), 6, tag="w-short")
+    eng.run(max_ticks=500)
+    for i in range(2):
+        eng.submit(prompt(12), 18, tag=f"w{i}")
+    eng.submit(prompt(20), 4, tag="w-long")
+    eng.run(max_ticks=500)
+
+    # Injected queue delay: 120 ms between submit and the first tick.
+    rid_queue = eng.submit(prompt(6), 12, tag="slow-queue")
+    time.sleep(0.12)
+    for _ in range(3):
+        eng.step()
+    # Injected preemption: evict mid-decode, hold requeued 120 ms.
+    rid_preempt = eng.submit(prompt(6), 12, tag="slow-preempt")
+    for _ in range(6):
+        eng.step()
+    victim = [idx for idx, s in eng.sched.slots.items()
+              if s.request.id == rid_preempt]
+    assert victim, "preemption target never reached a slot"
+    eng.sched.preempt_slot(victim[0])
+    time.sleep(0.12)
+    eng.run(max_ticks=1000)
+
+    by_rid = {t.rid: t for t in eng.tracer.completed}
+    assert len(by_rid) == 6
+    for t in by_rid.values():
+        window = t.window_ms()
+        attributed = sum(t.phase_ms().values())
+        resid = t.unattributed_ms()
+        assert abs(window - attributed - resid) < 1e-6, (t.rid, window, attributed)
+        assert 0.0 <= resid <= max(5.0, 0.05 * window), (t.rid, resid, window)
+    assert by_rid[rid_queue].blame == "queue_wait", by_rid[rid_queue].phase_ms()
+    assert by_rid[rid_preempt].blame == "requeued_wait", (
+        by_rid[rid_preempt].phase_ms()
+    )
+    assert any(iv.phase == "preempted" for iv in by_rid[rid_preempt].intervals)
+    assert eng.tracer.blame_counts.get("queue_wait", 0) >= 1
+    assert eng.tracer.blame_counts.get("requeued_wait", 0) >= 1
+    assert eng.stats()["trace_blame"] == eng.tracer.blame_counts
+    # The terminal records persisted; the offline summary agrees on blame.
+    summary = summarize_traces(load_serving_traces(str(tmp_path)))
+    assert summary["requests"] == 6
+    assert summary["by_blame"].get("queue_wait", 0) >= 1
+
+
+def test_bucket_compile_event_and_width_gauge_without_tracing(
+    gpt2_setup, tmp_path
+):
+    """Satellite: per-width jit-cache-miss attribution must not depend on
+    tracing — with the tracer OFF, the engine still emits a
+    serving.bucket_compile event per fresh width and publishes the
+    serving.decode_bucket_width gauge."""
+    cfg, params = gpt2_setup
+    tel = telemetry.enable(dir=str(tmp_path))
+    try:
+        eng = _engine(cfg, params, trace=False)
+        assert eng.tracer is None
+        eng.submit([1, 2, 3, 4, 5], 6)
+        eng.run(max_ticks=200)
+        assert tel.registry.gauge("serving.decode_bucket_width").value >= 1
+        assert eng.stats()["decode_bucket_widths"], "no decode width recorded"
+        assert eng.stats()["trace_blame"] is None
+    finally:
+        telemetry.disable()
+    events = []
+    for fname in os.listdir(tmp_path):
+        if not fname.endswith(".jsonl"):
+            continue
+        with open(tmp_path / fname) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("kind") == "event" and rec.get("name") == "serving.bucket_compile":
+                    events.append(rec)
+    assert events, "no serving.bucket_compile event landed in telemetry"
+    assert {e["dispatch"] for e in events} <= {"prefill", "decode"}
+    assert all(isinstance(e["width"], int) for e in events)
+
+
+def test_sigkill_trace_stitches_across_engine_lives(gpt2_setup, tmp_path):
+    """Satellite (extends the PR 14 chaos proof): a SIGKILLed engine's
+    periodic in-flight snapshots plus the successor's terminal records
+    stitch under one journal tag — two lives, a journal_recovery phase, and
+    conservation across the stitch."""
+    cfg, params = gpt2_setup
+    jp = str(tmp_path / "journal.json")
+    tdir = str(tmp_path)
+
+    script = f"""
+import os, signal
+import jax, jax.numpy as jnp
+from accelerate_tpu.models import gpt2
+from accelerate_tpu.serving import ServingConfig, ServingEngine
+
+cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32)
+params = gpt2.init_params(cfg, jax.random.key(0))
+eng = ServingEngine(
+    gpt2.apply_cached, gpt2.init_cache, params, cfg,
+    serving=ServingConfig(block_size=4, num_blocks=40, max_slots=2,
+                          prefill_chunk=8, max_blocks_per_seq=8,
+                          journal_path={jp!r}, trace=True, trace_dir={tdir!r}),
+)
+eng.submit([5, 6, 7, 8, 9, 10], 8, tag="life0")
+eng.submit([11, 12, 13], 8, tag="life1")
+for _ in range(3):
+    eng.step()
+os.kill(os.getpid(), signal.SIGKILL)  # no drain, no flush, no atexit
+"""
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "ACCELERATE_TPU_COMPILE_CACHE": "",
+                "ACCELERATE_TPU_SENTINEL_PROFILE": "0",
+                "ACCELERATE_TPU_SERVING_TRACE_FLUSH_EVERY": "1"})
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == -signal.SIGKILL, (proc.returncode, proc.stderr)
+    victim_records = load_serving_traces(tdir)
+    assert {r["tag"] for r in victim_records} == {"life0", "life1"}
+    assert all(r["status"] == "inflight" for r in victim_records)
+
+    succ = _engine(cfg, params, trace=True, trace_dir=tdir,
+                   num_blocks=40, journal_path=jp)
+    mapping = succ.recover_from_journal()
+    assert len(mapping) == 2
+    succ.run(max_ticks=500)
+    assert {c.tag for c in succ.pop_finished()} == {"life0", "life1"}
+    # Successor traces carry the recovery marker and the predecessor's id.
+    for t in succ.tracer.completed:
+        assert t.recovered_from is not None
+        assert any(iv.phase == "journal_recovery" for iv in t.intervals)
+
+    stitched = {s["tag"]: s for s in stitch_traces(load_serving_traces(tdir))}
+    assert set(stitched) == {"life0", "life1"}
+    for tag, st in stitched.items():
+        assert st["lives"] == 2, (tag, st)
+        assert st["status"] == "ok"
+        assert "journal_recovery" in st["phase_ms"], st
+        assert st["journal_recovery_ms"] > 0.0
+        assert st["conservation_ok"], (
+            f"{tag}: conservation error {st['conservation_error_ms']} ms "
+            f"over {st['total_ms']} ms"
+        )
+    # The report renders the stitch offline from the files alone.
+    block = "\n".join(format_trace_block(
+        summarize_traces(load_serving_traces(tdir))
+    ))
+    assert "stitched tag 'life0'" in block
+    assert "serving traces (per-request blame)" in block
+
+
+def test_report_cli_renders_trace_block(tmp_path, capsys):
+    """telemetry.report picks the trace JSONL up from a run dir (human and
+    --json) with no engine or jax state present."""
+    from accelerate_tpu.telemetry import report
+
+    rec = {"kind": "serving_trace", "rid": 2, "tag": "r", "status": "ok",
+           "arrival_wall": 5.0, "duration_ms": 42.0, "blame": "queue_wait",
+           "phase_ms": {"queue_wait": 30.0, "decode": 12.0},
+           "unattributed_ms": 0.0, "phases": []}
+    with open(tmp_path / "serving_trace_7_aa.jsonl", "w") as f:
+        f.write(json.dumps(rec) + "\n")
+    assert report.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "serving traces (per-request blame) — 1 completed" in out
+    assert "blame: queue_wait 1" in out
+    assert report.main([str(tmp_path), "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["serving_traces"]["requests"] == 1
+    assert payload["serving_traces"]["by_blame"] == {"queue_wait": 1}
