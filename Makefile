@@ -1,10 +1,10 @@
 # Test-suite splits mirroring the reference Makefile:25-77.
 
-.PHONY: test test-quick test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels bench telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke pp-smoke profile-smoke serving-smoke serving-trace-smoke elastic-smoke chaos-smoke serving-chaos-smoke goodput-smoke perf-gate
+.PHONY: test test-quick test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels bench telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke pp-smoke profile-smoke serving-smoke serving-trace-smoke elastic-smoke chaos-smoke serving-chaos-smoke goodput-smoke memory-smoke perf-gate
 
 PYTEST = python -m pytest -q
 
-test: test-quick telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke pp-smoke profile-smoke serving-smoke serving-trace-smoke elastic-smoke chaos-smoke serving-chaos-smoke goodput-smoke perf-gate
+test: test-quick telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke pp-smoke profile-smoke serving-smoke serving-trace-smoke elastic-smoke chaos-smoke serving-chaos-smoke goodput-smoke memory-smoke perf-gate
 	$(PYTEST) tests/
 
 # <5 min tier (VERDICT r5 item 6): oracles, state, sharding-spec/mesh,
@@ -150,6 +150,14 @@ serving-chaos-smoke:
 # with the goodput.* gauges (docs/package_reference/goodput.md).
 goodput-smoke:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.telemetry.goodput_smoke
+
+# HBM-ledger smoke (telemetry/memledger.py) on an 8-device CPU dryrun mesh:
+# exact shard-level attribution, the per-device conservation contract with an
+# injected allocator view (negative residual exposed, CPU stats honestly
+# absent), a fault-injected RESOURCE_EXHAUSTED whose postmortem blames the
+# planted owner, and the memory.* scrape + /debug/memory endpoint.
+memory-smoke:
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.telemetry.memledger_smoke
 
 # CPU-tier perf-regression gate: eager-vs-fused probe judged against the
 # committed baseline (benchmarks/perf_baseline_cpu.json) — dispatches/step

@@ -114,11 +114,31 @@ class DevicePrefetcher:
         self._convert = convert
         self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
+        self._memledger_token = None
         self._thread = threading.Thread(
             target=self._worker, name="atpu-prefetch", daemon=True
         )
         self._thread.start()
         self._closed = False
+
+    def _register_staging(self, converted) -> int:
+        """One-time HBM-ledger reservation for the staging queue: the first
+        converted batch's per-device bytes × (depth + 1) — up to ``depth``
+        batches queued plus the one in the consumer's hands.  Integers only;
+        no reference to the batch survives."""
+        try:
+            from ..telemetry.memledger import get_memory_ledger, tree_device_bytes
+
+            per_device, _, _ = tree_device_bytes(converted)
+            if not per_device:
+                return 0
+            return get_memory_ledger().register(
+                "input.prefetch",
+                per_device={d: b * (self.depth + 1) for d, b in per_device.items()},
+                detail={"depth": self.depth},
+            )
+        except Exception:
+            return 0
 
     # -- worker ---------------------------------------------------------------
 
@@ -141,6 +161,8 @@ class DevicePrefetcher:
                 return
             while not self._stop.is_set():
                 converted, meta = self._convert(current)
+                if self._memledger_token is None:
+                    self._memledger_token = self._register_staging(converted)
                 try:
                     upcoming = next(self._iterator)
                 except StopIteration:
@@ -178,6 +200,15 @@ class DevicePrefetcher:
         if self._closed:
             return
         self._closed = True
+        if self._memledger_token:
+            try:
+                from ..telemetry.memledger import get_memory_ledger
+
+                get_memory_ledger().unregister(
+                    "input.prefetch", self._memledger_token
+                )
+            except Exception:
+                pass
         self._stop.set()
         # Drain so a worker blocked on put() observes the stop quickly.
         while True:

@@ -338,6 +338,28 @@ class TrainStep:
         self.optimizer._opt_state_layout = zero_mod.opt_state_layout(
             mesh, self.zero_active
         )
+        # HBM ledger: the train state's long-lived reservations, computed
+        # from the live trees' per-device sharded bytes AFTER ZeRO placement
+        # (so the sharded opt state charges each chip its shard, and
+        # host-offloaded moments land under host_bytes, not HBM).  The
+        # ledger stores integers only — no reference survives to fight the
+        # donated-buffer lifetimes.
+        try:
+            from ..telemetry.memledger import get_memory_ledger
+
+            ledger = get_memory_ledger()
+            ledger.register(
+                "train.params",
+                tree=self.model.params,
+                detail={"zero_active": self.zero_active},
+            )
+            ledger.register(
+                "train.opt_state",
+                tree=self.optimizer.opt_state,
+                detail={"zero_active": self.zero_active},
+            )
+        except Exception:
+            pass
 
     def _build_zero_grads_fn(self, _loss_and_grads, _scaled):
         """Build the manual-dp gradient engine of the ZeRO step: a shard_map

@@ -99,6 +99,16 @@ class RetryPolicy:
                 return fn(*args, **kwargs)
             except BaseException as exc:  # noqa: BLE001 — filtered below
                 if not self.retryable(exc):
+                    from ..telemetry.memledger import get_memory_ledger, looks_like_oom
+
+                    if looks_like_oom(exc):
+                        # RESOURCE_EXHAUSTED is deliberately non-retryable
+                        # (retrying the same allocation cannot succeed), so
+                        # this raise is the resilience path's terminal OOM —
+                        # snapshot the ranked ledger before it propagates.
+                        get_memory_ledger().note_oom(
+                            source=f"resilience.{self.label}", error=exc
+                        )
                     raise  # programming error / corrupt state: fail fast
                 if attempt == self.tries - 1:
                     self._give_up(attempt + 1, exc, "tries exhausted")

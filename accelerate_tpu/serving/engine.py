@@ -355,6 +355,40 @@ class ServingEngine:
         from ..telemetry import export as _export
 
         _export.register_debug_source(self)
+        # HBM ledger: the pool is a first-class reservation (its backing
+        # arrays live for the engine's life), the prefix-cache residents a
+        # subset entry (their bytes are INSIDE the pool — counting them
+        # twice would poison the conservation residual).  A second engine
+        # replaces the entries (last constructed wins); weakref.finalize
+        # drops them when the owning engine is collected, token-guarded so
+        # a replacement registration survives its predecessor's GC.
+        from ..telemetry.memledger import get_memory_ledger
+
+        ledger = get_memory_ledger()
+        pool_token = ledger.register(
+            "serving.kv_pool",
+            tree=self.cache.pool,
+            detail={
+                "num_blocks": sc.num_blocks,
+                "block_size": sc.block_size,
+                "block_bytes": self._block_bytes,
+            },
+        )
+        prefix_token = ledger.register(
+            "serving.prefix_cache", nbytes=0, subset_of="serving.kv_pool"
+        )
+        import weakref
+
+        weakref.finalize(self, ledger.unregister, "serving.kv_pool", pool_token)
+        weakref.finalize(self, ledger.unregister, "serving.prefix_cache", prefix_token)
+        self._memledger_tokens = (pool_token, prefix_token)
+        self._low_headroom = False
+        try:
+            self._headroom_watermark_frac = float(
+                os.environ.get("ACCELERATE_TPU_SERVING_HEADROOM_WATERMARK", "") or 0.1
+            )
+        except ValueError:
+            self._headroom_watermark_frac = 0.1
         if self.decode_path == "paged":
             # One jitted wrapper each; bucketed table widths retrace under it
             # (jit caches per shape), so a tick is still exactly one decode
@@ -1228,6 +1262,42 @@ class ServingEngine:
         reg.gauge("serving.prefix_cache_blocks").set(
             len(self._prefix) if self._prefix is not None else 0
         )
+        # HBM ledger + headroom: refresh the prefix-cache resident bytes
+        # (a subset of the pool reservation) and publish the serving
+        # headroom — free pool bytes, further clamped by measured free HBM
+        # when the backend reports stats (absent on CPU builds, where the
+        # pool bound is the whole truth).
+        from ..telemetry.memledger import get_memory_ledger
+
+        ledger = get_memory_ledger()
+        prefix_blocks = len(self._prefix) if self._prefix is not None else 0
+        ledger.update_bytes(
+            "serving.prefix_cache",
+            prefix_blocks * self._block_bytes,
+            token=self._memledger_tokens[1],
+        )
+        headroom = alloc.free_blocks * self._block_bytes
+        hbm_free = ledger.min_device_headroom()
+        if hbm_free is not None:
+            headroom = min(headroom, hbm_free)
+        reg.gauge("serving.headroom_bytes").set(headroom)
+        # Low-headroom watermark (item 3's future tiering control signal):
+        # one event per crossing, re-armed only after occupancy recovers —
+        # a pool hovering at the line must not spam the ring.
+        free_frac = alloc.free_blocks / max(alloc.capacity, 1)
+        if free_frac < self._headroom_watermark_frac:
+            if not self._low_headroom:
+                self._low_headroom = True
+                tel.event(
+                    "memory.low_headroom",
+                    source="serving",
+                    headroom_bytes=headroom,
+                    free_blocks=alloc.free_blocks,
+                    capacity=alloc.capacity,
+                    watermark_frac=self._headroom_watermark_frac,
+                )
+        elif self._low_headroom:
+            self._low_headroom = False
         # Publish only preemptions since the last publish: a registry.reset()
         # (e.g. scoping a measurement window) must not be re-inflated with
         # engine-lifetime history.
@@ -1333,6 +1403,7 @@ class ServingEngine:
             "deadline_expired": self.deadline_expired_count,
             "quarantined": self.quarantined_count,
             "pool_bytes": self.cache.pool_bytes(),
+            "free_pool_bytes": alloc.free_blocks * self._block_bytes,
             "decode_path": self.decode_path,
             "decode_gather_bytes": self.decode_gather_bytes,
             "prefix_hits": self.prefix_hits,

@@ -288,10 +288,23 @@ class Scheduler:
             try:
                 slot.blocks.extend(self.allocator.alloc(need))
                 return True
-            except BlockOutOfMemory:
+            except BlockOutOfMemory as exc:
                 victim = self.preempt_one()
                 if victim is None:
-                    raise  # nothing left to evict: geometry validation failed us
+                    # Terminal pool exhaustion (nothing left to evict —
+                    # geometry validation failed us): snapshot the ranked
+                    # HBM ledger before the engine dies on this raise.
+                    from ..telemetry.memledger import get_memory_ledger
+
+                    get_memory_ledger().note_oom(
+                        source="serving.admission",
+                        error=exc,
+                        slot=idx,
+                        rows=rows,
+                        free_blocks=self.allocator.free_blocks,
+                        capacity=self.allocator.capacity,
+                    )
+                    raise
                 slot = self.slots.get(idx)  # self-preemption returns None
         return False
 

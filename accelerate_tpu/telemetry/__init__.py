@@ -19,6 +19,10 @@ Three pillars (see ``docs/usage_guides/telemetry.md``):
   anomaly detection and a one-shot profiler capture
   (``ACCELERATE_TPU_FLIGHTREC=1``; see ``flightrec.py`` / ``sentinel.py`` /
   ``docs/package_reference/flightrec.md``);
+- **HBM ledger** — per-subsystem memory attribution with a per-device
+  conservation contract, OOM forensics (ranked-ledger postmortems into the
+  flight recorder) and serving-headroom gauges (``memledger.py`` /
+  ``docs/package_reference/memledger.md``);
 - **goodput accounting + metrics export** — the wall-clock attribution
   ledger (every second classified into exactly one category, with a
   conservation invariant; ``ACCELERATE_TPU_GOODPUT=1``), fleet straggler
@@ -62,6 +66,7 @@ from .profile_scan import (
 )
 from .export import MetricsExporter, render_prometheus
 from .goodput import FleetAggregator, GoodputLedger
+from .memledger import MemoryLedger, get_memory_ledger, tree_device_bytes
 from .sentinel import AnomalySentinel
 from .timeline import Timeline, TraceEvent, TraceParseError
 from .introspect import (
@@ -111,6 +116,10 @@ __all__ = [
     "lint_reshardings",
     "parse_collectives",
     "scan_hlo",
+    # HBM ledger (per-subsystem memory attribution + OOM forensics)
+    "MemoryLedger",
+    "get_memory_ledger",
+    "tree_device_bytes",
     # goodput accounting + metrics export
     "GoodputLedger",
     "FleetAggregator",

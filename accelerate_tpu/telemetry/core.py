@@ -25,6 +25,7 @@ import time
 from typing import Optional
 
 from .flightrec import get_flight_recorder
+from .memledger import get_memory_ledger
 from .metrics import CACHE_HIT_EVENT, COMPILE_EVENT, MetricsRegistry, StepTimer, collect_hbm
 
 __all__ = [
@@ -226,6 +227,16 @@ class Telemetry:
             return
         dt = self.step_timer.step()
         collect_hbm(self.registry)
+        ledger = get_memory_ledger()
+        if ledger.has_owners():
+            # Conservation pass: attributed + program + unattributed ==
+            # bytes_in_use per device, residual exposed as a gauge.  Owners
+            # register once (train-step build, engine construction), so the
+            # per-step cost is one memory_stats() round per local device.
+            try:
+                ledger.reconcile_and_publish(self.registry)
+            except Exception:
+                pass
         dispatches = self.registry.counter("pipeline.dispatches").value
         per_step = None
         if dispatches:

@@ -31,6 +31,10 @@ Besides ``/metrics`` the endpoint serves:
   chains.  Engines self-register via :func:`register_debug_source`
   (weakly — a collected engine drops off the page); with no live engine
   the endpoints return an empty payload, not an error.
+- ``GET /debug/memory`` — the process-wide HBM ledger
+  (``telemetry/memledger.py``): ranked owner reservations and per-device
+  conservation records (attributed + program + unattributed ==
+  bytes_in_use), reconciled at request time.
 
 Everything else still 404s.
 
@@ -130,9 +134,21 @@ def _live_debug_sources() -> list:
 
 
 def debug_payload(kind: str) -> dict:
-    """The JSON body for ``/debug/requests`` or ``/debug/blocks``: one entry
-    per live registered engine (keyed by position — multiple engines in one
-    process are rare but legal)."""
+    """The JSON body for ``/debug/requests``, ``/debug/blocks`` or
+    ``/debug/memory``.  The first two return one entry per live registered
+    engine (keyed by position — multiple engines in one process are rare but
+    legal); ``memory`` returns the process-wide :mod:`memledger` snapshot —
+    ranked owners plus per-device conservation records — refreshed at
+    request time so the residual is current, not last-step stale."""
+    if kind == "memory":
+        from .memledger import get_memory_ledger
+
+        ledger = get_memory_ledger()
+        try:
+            ledger.reconcile()
+        except Exception:
+            pass
+        return ledger.snapshot()
     method = {"requests": "debug_requests", "blocks": "debug_blocks"}[kind]
     engines = []
     for obj in _live_debug_sources():
@@ -291,6 +307,17 @@ class MetricsExporter:
             publish_slo_burn_rates(registry)
         except Exception:
             pass
+        from .memledger import get_memory_ledger
+
+        ledger = get_memory_ledger()
+        if ledger.has_owners():
+            # Scrape-fresh memory.* family: the conservation residual and
+            # per-owner gauges update here (like the goodput ledger), not
+            # only on record_step — serving-only processes never step.
+            try:
+                ledger.reconcile_and_publish(registry)
+            except Exception:
+                pass
         return render_prometheus(registry)
 
     # -- endpoint ------------------------------------------------------------
@@ -315,7 +342,7 @@ class MetricsExporter:
                     # so no registry render on the probe path.
                     self._reply(b"ok\n", "text/plain; charset=utf-8")
                     return
-                if path in ("/debug/requests", "/debug/blocks"):
+                if path in ("/debug/requests", "/debug/blocks", "/debug/memory"):
                     try:
                         body = json.dumps(
                             debug_payload(path.rsplit("/", 1)[1])

@@ -349,6 +349,17 @@ def inspect_compiled(
     flops = float(cost.get("flops", 0.0) or 0.0)
     bytes_accessed = float(cost.get("bytes accessed", 0.0) or 0.0)
     memory = _memory_analysis(compiled)
+    if memory:
+        # Feed the HBM ledger's conservation contract: temp/scratch +
+        # generated-code bytes are memory the *program* owns — neither a
+        # registered live array nor unattributed residue (argument/output
+        # bytes ARE live arrays and would double-count).
+        from .memledger import get_memory_ledger
+
+        get_memory_ledger().note_program_bytes(
+            name,
+            int(memory.get("temp_bytes", 0)) + int(memory.get("generated_code_bytes", 0)),
+        )
     try:
         hlo = compiled.as_text()
     except Exception:

@@ -1,0 +1,195 @@
+"""HBM-ledger smoke: attribution, conservation, OOM forensics, end to end
+on an 8-device CPU dryrun mesh (``make memory-smoke``, wired into
+``make test``).
+
+Asserts, through the public surfaces only:
+
+1. **attribution** — registered pytrees charge each device its actual shard
+   bytes (dp-sharded leaf → 1/8 per device, replicated leaf → full size per
+   device), ``subset_of`` entries are ranked but excluded from conservation,
+   and ``note_program_bytes`` feeds the program-estimate term;
+2. **conservation** — with an injected per-device ``stats_fn``,
+   ``attributed + program_estimate + unattributed == bytes_in_use`` holds
+   exactly on every device, a *negative* residual (stale registration) is
+   exposed rather than clamped, and the default CPU path honestly reports
+   ``stats_available: 0`` with no invented arithmetic;
+3. **OOM forensics** — a synthetic RESOURCE_EXHAUSTED
+   (``ACCELERATE_TPU_FAULT_OOM_ONCE=1``) thrown under
+   ``find_executable_batch_size`` halves the batch AND lands a
+   ``memory.oom_postmortem`` in the flight-recorder ring blaming the planted
+   largest owner, which the telemetry report renders by name;
+4. **export** — the Prometheus endpoint scrapes the ``memory.*`` gauge
+   family and ``GET /debug/memory`` returns the ranked-ledger JSON.
+
+Run: ``env JAX_PLATFORMS=cpu python -m accelerate_tpu.telemetry.memledger_smoke``
+(docs/usage_guides/telemetry.md, "Where did my HBM go?").
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    import json
+    import tempfile
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from .. import telemetry
+    from ..resilience import faultinject
+    from ..telemetry import flightrec, report
+    from ..telemetry.export import MetricsExporter
+    from ..telemetry.memledger import get_memory_ledger
+    from ..utils.memory import find_executable_batch_size
+
+    ndev = 8
+    assert jax.device_count() == ndev, jax.device_count()
+    work = tempfile.mkdtemp(prefix="atpu_memledger_smoke_")
+    tel = telemetry.enable(dir=work)
+    flightrec.enable(dir=os.path.join(work, "flightrec"))
+    ledger = get_memory_ledger()
+    ledger.reset()
+
+    # -- 1. attribution on a real mesh ---------------------------------------
+    mesh = jax.make_mesh((ndev,), ("dp",))
+    sharded = jax.device_put(
+        jnp.zeros((ndev * 16, 32), jnp.float32),
+        NamedSharding(mesh, PartitionSpec("dp", None)),
+    )  # 16*32*4 = 2048 B per device
+    replicated = jax.device_put(
+        jnp.ones((64,), jnp.float32), NamedSharding(mesh, PartitionSpec())
+    )  # 256 B per device
+    ledger.register("smoke.params", tree={"w": sharded, "b": replicated})
+    hog = jax.device_put(
+        jnp.zeros((4096,), jnp.float32), NamedSharding(mesh, PartitionSpec())
+    )  # 16384 B per device — the planted blame
+    hog_token = ledger.register("smoke.hog", tree=hog)
+    ledger.register("smoke.cache_resident", nbytes=512, subset_of="smoke.hog")
+    ledger.note_program_bytes("smoke.step", 1000)
+
+    att = ledger.attributed_per_device()
+    expect = {d.id: 2048 + 256 + 16384 for d in jax.local_devices()}
+    assert att == expect, (att, expect)
+    ranked = ledger.owners()
+    assert ranked[0].owner == "smoke.hog", [r.owner for r in ranked]
+    print(f"# attribution: {att[0]} B/chip across {ndev} devices", file=sys.stderr)
+
+    # -- 2. conservation with an injected allocator view ---------------------
+    def stats_fn(device):
+        return {
+            "bytes_in_use": att[device.id] + 1000 + 777,  # program + residual
+            "peak_bytes_in_use": att[device.id] + 5000,
+            "bytes_limit": 1 << 20,
+        }
+
+    records = ledger.reconcile(stats_fn=stats_fn)
+    assert len(records) == ndev, records
+    for rec in records:
+        assert rec["stats_available"] == 1
+        assert (
+            rec["attributed_bytes"]
+            + rec["program_estimate_bytes"]
+            + rec["unattributed_bytes"]
+            == rec["bytes_in_use"]
+        ), rec
+        assert rec["unattributed_bytes"] == 777, rec
+        assert rec["headroom_bytes"] == (1 << 20) - rec["bytes_in_use"], rec
+    # A stale registration (attribution above the allocator's count) must
+    # surface as a NEGATIVE residual, not be clamped away.
+    neg = ledger.reconcile(stats_fn=lambda d: {"bytes_in_use": 10})[0]
+    assert neg["unattributed_bytes"] < 0, neg
+    # The default CPU path reports no stats — and invents no arithmetic.
+    bare = ledger.reconcile()[0]
+    assert bare["stats_available"] == 0 and "bytes_in_use" not in bare, bare
+    ledger.reconcile(stats_fn=stats_fn)  # restore the synthetic watermark
+    ledger.publish(tel.registry)
+    snap = tel.registry.snapshot()
+    assert snap["memory.attributed_bytes"] == max(att.values()), snap
+    assert snap["memory.unattributed_bytes"] == 777, snap
+    assert snap["memory.owner.smoke_hog_bytes"] == 16384, snap
+    print("# conservation: residual 777 B on all 8 devices, exactly", file=sys.stderr)
+
+    # -- 3. OOM forensics under fault injection ------------------------------
+    os.environ[faultinject.ENV_OOM_ONCE] = "1"
+    faultinject.reload()
+    calls = []
+
+    @find_executable_batch_size(starting_batch_size=8)
+    def train(batch_size):
+        calls.append(batch_size)
+        faultinject.maybe_oom()
+        return batch_size
+
+    try:
+        landed = train()
+    finally:
+        os.environ.pop(faultinject.ENV_OOM_ONCE, None)
+        faultinject.reload()
+    assert landed == 4 and calls == [8, 4], (landed, calls)
+    assert ledger.oom_postmortems, "no postmortem recorded"
+    pm = ledger.oom_postmortems[-1]
+    assert pm["source"] == "find_executable_batch_size", pm
+    assert pm["blame"] == "smoke.hog" and pm["blame_bytes"] == 16384, pm
+    assert pm["batch_size"] == 8, pm
+    ring = [
+        r
+        for r in flightrec.get_flight_recorder().snapshot()
+        if r.get("kind") == "event" and r.get("name") == "memory.oom_postmortem"
+    ]
+    assert ring and ring[-1]["blame"] == "smoke.hog", ring
+    fsum = report.summarize_flight(flightrec.get_flight_recorder().snapshot())
+    text = report.format_flight_report(fsum)
+    assert "memory postmortem" in text and "smoke.hog" in text, text
+    mem_lines = "\n".join(report.format_memory_block(tel.registry.snapshot()))
+    assert "smoke_hog" in mem_lines, mem_lines  # gauge slug of smoke.hog
+    print("# forensics: postmortem blames smoke.hog, report renders it", file=sys.stderr)
+
+    # -- 4. export: Prometheus scrape + /debug/memory ------------------------
+    exporter = MetricsExporter().start(port=0)
+    try:
+        base = f"http://127.0.0.1:{exporter.port}"
+        scrape = urllib.request.urlopen(base + "/metrics", timeout=10).read().decode()
+        for needle in (
+            "accelerate_tpu_memory_attributed_bytes",
+            "accelerate_tpu_memory_owner_smoke_hog_bytes",
+        ):
+            assert needle in scrape, f"{needle} missing from scrape"
+        debug = json.loads(
+            urllib.request.urlopen(base + "/debug/memory", timeout=10).read()
+        )
+        assert debug["owners"][0]["owner"] == "smoke.hog", debug["owners"]
+        assert debug["oom_postmortems"] >= 1, debug
+    finally:
+        exporter.stop(final_snapshot=False)
+
+    # GC-path hygiene: a token-guarded unregister after a replacement keeps
+    # the replacement (the engine finalizer contract).
+    new_token = ledger.register("smoke.hog", nbytes=64)
+    assert not ledger.unregister("smoke.hog", hog_token)
+    assert ledger.unregister("smoke.hog", new_token)
+
+    telemetry.disable()
+    flightrec.disable()
+    print(
+        "memledger-smoke OK — attribution exact on 8 devices, conservation "
+        "residual 777 B by construction, negative residual exposed, OOM "
+        "postmortem blamed smoke.hog through find_executable_batch_size, "
+        "memory.* scraped and /debug/memory served"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -224,23 +224,53 @@ def peak_flops_per_chip(device=None) -> float:
 
 
 def collect_hbm(registry: MetricsRegistry, device=None) -> dict:
-    """Record live/peak device memory gauges where the backend exposes them
-    (``device.memory_stats()`` — absent on some CPU builds and tunnels)."""
+    """Record device memory gauges across EVERY local device (or just
+    ``device`` when given): worst-device live/peak bytes and the fleet-min
+    headroom (``bytes_limit - bytes_in_use`` over all devices — the binding
+    constraint, since the first chip to fill kills the whole SPMD program).
+
+    ``hbm.stats_available`` is always published (1/0) so a dashboard can
+    tell "no data" (CPU builds and tunnels return no ``memory_stats()``)
+    from "zero bytes"; the byte gauges only exist where stats do.
+    """
     try:
-        if device is None:
+        if device is not None:
+            devices = [device]
+        else:
             import jax
 
-            device = jax.local_devices()[0]
-        stats = device.memory_stats() or {}
+            devices = list(jax.local_devices())
     except Exception:
         return {}
-    out = {}
-    if "bytes_in_use" in stats:
-        registry.gauge("hbm.bytes_in_use").set(stats["bytes_in_use"])
-        out["hbm.bytes_in_use"] = stats["bytes_in_use"]
-    if "peak_bytes_in_use" in stats:
-        registry.gauge("hbm.peak_bytes").set(stats["peak_bytes_in_use"])
-        out["hbm.peak_bytes"] = stats["peak_bytes_in_use"]
+    in_use, peak, headroom = [], [], []
+    for d in devices:
+        try:
+            stats = d.memory_stats() or None
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        if "bytes_in_use" in stats:
+            in_use.append(int(stats["bytes_in_use"]))
+            limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+            if limit:
+                headroom.append(int(limit) - int(stats["bytes_in_use"]))
+        if "peak_bytes_in_use" in stats:
+            peak.append(int(stats["peak_bytes_in_use"]))
+    available = bool(in_use or peak)
+    registry.gauge("hbm.stats_available").set(1 if available else 0)
+    out = {"hbm.stats_available": 1 if available else 0}
+    if not available:
+        return {}
+    if in_use:
+        registry.gauge("hbm.bytes_in_use").set(max(in_use))
+        out["hbm.bytes_in_use"] = max(in_use)
+    if peak:
+        registry.gauge("hbm.peak_bytes").set(max(peak))
+        out["hbm.peak_bytes"] = max(peak)
+    if headroom:
+        registry.gauge("hbm.fleet_min_headroom_bytes").set(min(headroom))
+        out["hbm.fleet_min_headroom_bytes"] = min(headroom)
     return out
 
 

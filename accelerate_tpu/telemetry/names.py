@@ -41,6 +41,7 @@ COUNTERS = frozenset({
     "jit.cache_hits",
     "jit.compiles",
     "memory.oom_halvings",
+    "memory.oom_postmortems",
     "pipeline.dispatches",
     "resilience.gave_up",
     "resilience.preempt_checkpoints",
@@ -83,8 +84,13 @@ GAUGES = frozenset({
     "goodput.preempt_s",
     "goodput.idle_s",
     "hbm.bytes_in_use",
+    "hbm.fleet_min_headroom_bytes",
     "hbm.peak_bytes",
+    "hbm.stats_available",
     "health.last_grad_norm",
+    "memory.attributed_bytes",
+    "memory.headroom_bytes",
+    "memory.unattributed_bytes",
     "pipeline.dispatches_per_step",
     "profile.collective_ms",
     "profile.device_busy_ms",
@@ -94,6 +100,7 @@ GAUGES = frozenset({
     "serving.block_occupancy",
     "serving.blocks_used",
     "serving.decode_bucket_width",
+    "serving.headroom_bytes",
     "serving.prefix_cache_blocks",
     "serving.queue_depth",
     "serving.slo.ttft_target_ms",
@@ -121,7 +128,9 @@ EVENTS = frozenset({
     "elastic.reshard",
     "health.rewind",
     "health.skip",
+    "memory.low_headroom",
     "memory.oom_halving",
+    "memory.oom_postmortem",
     "resilience.gave_up",
     "resilience.preempt_checkpoint",
     "resilience.preempt_signal",
@@ -147,6 +156,8 @@ DYNAMIC_PATTERNS = (
     re.compile(r"^span\..+_ms$"),                 # span.{name}_ms histograms
     re.compile(r"^introspect\..+\.(flops|comms_bytes)$"),
     re.compile(r"^goodput\..+_s$"),               # goodput.{category}_s gauges
+    # memory.owner.{slug}_bytes — per-owner HBM-ledger gauges (memledger.py)
+    re.compile(r"^memory\.owner\..+_bytes$"),
     re.compile(r"^serving\.slo\..+_(target_ms|burn_rate)$"),
     # serving.trace.blame.{phase} counters + serving.trace.unattributed_ms
     # (the per-request trace family — see docs/package_reference/serving_tracing.md)

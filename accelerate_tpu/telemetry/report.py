@@ -33,6 +33,7 @@ __all__ = [
     "summarize_flight",
     "format_report",
     "format_flight_report",
+    "format_memory_block",
     "main",
 ]
 
@@ -172,6 +173,7 @@ def summarize_flight(records: list[dict]) -> dict:
     events = 0
     profile_captures = []
     profile_digests = []
+    oom_postmortems = []
     for rec in records:
         kind = rec.get("kind")
         if kind == "step":
@@ -191,6 +193,8 @@ def summarize_flight(records: list[dict]) -> dict:
                 profile_captures.append(rec)
             elif name in ("sentinel.profile_digest", "sentinel.profile_analysis_failed"):
                 profile_digests.append(rec)
+            elif name == "memory.oom_postmortem":
+                oom_postmortems.append(rec)
     final = max(records, key=lambda r: (r.get("t") or 0, r.get("seq") or 0)) if records else None
     return {
         "n_events": len(records),
@@ -202,6 +206,10 @@ def summarize_flight(records: list[dict]) -> dict:
         "events": events,
         "profile_captures": profile_captures,
         "profile_digests": profile_digests,
+        # Ranked-ledger snapshots from RESOURCE_EXHAUSTED sites (the HBM
+        # ledger's memory.oom_postmortem events) — a stable machine key for
+        # --json consumers, rendered as the memory block below.
+        "oom_postmortems": oom_postmortems,
         "final_event": final,
     }
 
@@ -244,6 +252,37 @@ def format_flight_report(fsummary: dict, last_n: int = 10) -> str:
                 k: v for k, v in a.items() if k not in ("kind", "t", "proc", "seq")
             }
             lines.append(f"  - {detail.pop('reason', '?')}: {detail}")
+    for pm in (fsummary.get("oom_postmortems") or [])[-last_n:]:
+        lines.append("")
+        lines.append(
+            f"memory postmortem (OOM at {pm.get('source', '?')}): "
+            f"blamed owner {pm.get('blame') or 'UNATTRIBUTED'}"
+            + (
+                f" holding {_human(pm.get('blame_bytes'))}B/chip"
+                if pm.get("blame_bytes")
+                else ""
+            )
+        )
+        if pm.get("watermark_bytes_in_use") is not None:
+            lines.append(
+                f"  watermark: {_human(pm.get('watermark_bytes_in_use'))}B in use"
+                + (
+                    f" (peak {_human(pm.get('watermark_peak_bytes'))}B)"
+                    if pm.get("watermark_peak_bytes") is not None
+                    else ""
+                )
+            )
+        ranked = pm.get("ranked") or []
+        if ranked:
+            lines.append(
+                "  ranked owners: "
+                + ", ".join(
+                    f"{r.get('owner')} {_human(r.get('device_bytes'))}B"
+                    for r in ranked
+                )
+            )
+        if pm.get("error"):
+            lines.append(f"  error: {pm['error']}")
     captures = fsummary.get("profile_captures") or []
     digests = {d.get("trigger_step"): d for d in fsummary.get("profile_digests") or []}
     for cap in captures:
@@ -349,6 +388,46 @@ def format_serving_block(snapshot) -> list:
             f"  kv blocks: {g('serving.blocks_used', 0)} in use "
             f"(occupancy {occ:.1%}), queue depth {g('serving.queue_depth', 0)}, "
             f"active slots {g('serving.active_slots', 0)}"
+        )
+    return lines
+
+
+def format_memory_block(snapshot) -> list:
+    """Render the HBM-ledger block from the ``memory.*``/``hbm.*`` gauge
+    family (``telemetry/memledger.py``): ranked per-owner per-chip bytes,
+    the conservation residual, and the fleet-min headroom.  Empty when the
+    run registered no owners."""
+    if not snapshot:
+        return []
+    owner_keys = [k for k in snapshot if k.startswith("memory.owner.")]
+    if not owner_keys and "memory.attributed_bytes" not in snapshot:
+        return []
+    g = snapshot.get
+    lines = ["memory ledger (per-chip HBM attribution):"]
+    for key in sorted(owner_keys, key=lambda k: (-snapshot[k], k)):
+        owner = key[len("memory.owner."):]
+        if owner.endswith("_bytes"):
+            owner = owner[: -len("_bytes")]
+        lines.append(f"  {owner:<28} {_human(snapshot[key])}B/chip")
+    att = g("memory.attributed_bytes")
+    if att is not None:
+        line = f"  attributed {_human(att)}B/chip"
+        if g("memory.unattributed_bytes") is not None:
+            line += f", unattributed residual {_human(g('memory.unattributed_bytes'))}B"
+        if g("memory.headroom_bytes") is not None:
+            line += f", fleet-min headroom {_human(g('memory.headroom_bytes'))}B"
+        lines.append(line)
+    if g("hbm.stats_available") == 0:
+        lines.append(
+            "  (backend reports no memory_stats — attribution only, "
+            "no conservation residual)"
+        )
+    if g("serving.headroom_bytes") is not None:
+        lines.append(f"  serving headroom: {_human(g('serving.headroom_bytes'))}B")
+    if g("memory.oom_postmortems"):
+        lines.append(
+            f"  OOM postmortems recorded: {int(g('memory.oom_postmortems'))} "
+            "(see the flight-recorder block)"
         )
     return lines
 
@@ -473,6 +552,10 @@ def format_report(summary: dict) -> str:
     if serving:
         lines.append("")
         lines.extend(serving)
+    memory = format_memory_block(snapshot)
+    if memory:
+        lines.append("")
+        lines.extend(memory)
     if snapshot:
         lines.append("")
         lines.append("final metrics snapshot:")

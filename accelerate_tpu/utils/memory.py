@@ -87,6 +87,19 @@ def find_executable_batch_size(
                 return function(batch_size, *args, **kwargs)
             except Exception as e:
                 if should_reduce_batch_size(e):
+                    # Forensics BEFORE the cache clear: the ledger snapshots
+                    # the ranked owners and the pre-halving HBM watermark into
+                    # a flight-recorder memory.oom_postmortem — clearing
+                    # first would report the post-GC world, not the one that
+                    # died.
+                    from ..telemetry.memledger import get_memory_ledger
+
+                    get_memory_ledger().note_oom(
+                        source="find_executable_batch_size",
+                        error=e,
+                        function=function.__name__,
+                        batch_size=batch_size,
+                    )
                     clear_device_cache(garbage_collection=True)
                     new_size = batch_size // 2
                     # OOM retries must be VISIBLE: a silently halved batch

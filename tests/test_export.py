@@ -210,6 +210,84 @@ def test_healthz_and_debug_endpoints(tmp_path):
         exporter.stop(final_snapshot=False)
 
 
+def test_debug_memory_endpoint_serves_ledger(tmp_path):
+    """/debug/memory serves the process-wide HBM-ledger snapshot (ranked
+    owners + request-time-reconciled device records), an engine GC'ing away
+    unregisters its reservation through the weak finalizer, and the
+    unknown-path 404 contract is unchanged by the route."""
+    import gc
+    import urllib.error
+
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models import gpt2
+    from accelerate_tpu.serving import ServingConfig, ServingEngine
+    from accelerate_tpu.telemetry.memledger import get_memory_ledger
+
+    telemetry.enable(dir=str(tmp_path))
+    ledger = get_memory_ledger()
+    ledger.reset()
+    ledger.register("unit.hog", nbytes=4096)
+    cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32)
+    import jax
+
+    engine = ServingEngine(
+        gpt2.apply_cached, gpt2.init_cache, gpt2.init_params(cfg, jax.random.key(0)),
+        cfg,
+        serving=ServingConfig(block_size=8, num_blocks=16, max_slots=2,
+                              prefill_chunk=8, max_blocks_per_seq=4),
+    )
+    exporter = MetricsExporter()
+    exporter.start(port=0)
+    try:
+        base = f"http://127.0.0.1:{exporter.port}"
+        body = json.loads(
+            urllib.request.urlopen(f"{base}/debug/memory", timeout=10).read()
+        )
+        owners = {r["owner"]: r["device_bytes"] for r in body["owners"]}
+        assert owners["unit.hog"] == 4096
+        assert owners["serving.kv_pool"] > 0
+        assert "serving.prefix_cache" in owners
+        # Request-time reconcile: device records present, honest on CPU.
+        assert body["devices"] and body["devices"][0]["stats_available"] in (0, 1)
+        assert body["attributed_bytes"] >= 4096
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/debug/memoryx", timeout=10)
+        assert err.value.code == 404
+        # The engine's reservations die with it (weakref.finalize) — the
+        # ledger must not keep reporting a freed pool.
+        del engine
+        gc.collect()
+        body = json.loads(
+            urllib.request.urlopen(f"{base}/debug/memory", timeout=10).read()
+        )
+        owners = {r["owner"] for r in body["owners"]}
+        assert "serving.kv_pool" not in owners
+        assert "serving.prefix_cache" not in owners
+        assert "unit.hog" in owners
+    finally:
+        exporter.stop(final_snapshot=False)
+        ledger.reset()
+
+
+def test_render_refreshes_memory_gauges_without_step_loop(tmp_path):
+    """A serving-only process never calls record_step, so the scrape itself
+    must refresh the memory.* family (render-time reconcile+publish)."""
+    from accelerate_tpu.telemetry.memledger import get_memory_ledger
+
+    telemetry.enable(dir=str(tmp_path))
+    ledger = get_memory_ledger()
+    ledger.reset()
+    ledger.register("scrape.owner", nbytes=1234)
+    try:
+        exporter = MetricsExporter()
+        samples = parse_exposition(exporter.render())
+        assert samples["accelerate_tpu_memory_attributed_bytes"] == 1234
+        assert samples["accelerate_tpu_memory_owner_scrape_owner_bytes"] == 1234
+    finally:
+        ledger.reset()
+
+
 def test_disabled_by_default(monkeypatch):
     monkeypatch.delenv("ACCELERATE_TPU_METRICS_PORT", raising=False)
     monkeypatch.delenv("ACCELERATE_TPU_METRICS_SNAPSHOT", raising=False)

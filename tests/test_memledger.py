@@ -1,0 +1,297 @@
+"""Unified HBM ledger (telemetry/memledger.py): shard-level attribution from
+live pytrees, token-guarded registration lifecycle, the per-device
+conservation contract (residual exposed, never absorbed), OOM forensics
+blaming the largest owner — including the fault-injected
+``find_executable_batch_size`` halving — and the per-device ``collect_hbm``
+sampling with the fleet-min headroom gauge.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from accelerate_tpu import telemetry
+from accelerate_tpu.resilience import faultinject
+from accelerate_tpu.telemetry.memledger import (
+    MemoryLedger,
+    get_memory_ledger,
+    looks_like_oom,
+    tree_device_bytes,
+)
+from accelerate_tpu.telemetry.metrics import MetricsRegistry, collect_hbm
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledger():
+    get_memory_ledger().reset()
+    telemetry.disable()
+    yield
+    get_memory_ledger().reset()
+    telemetry.disable()
+
+
+# ---------------------------------------------------------------------------
+# tree_device_bytes
+# ---------------------------------------------------------------------------
+
+
+def test_tree_device_bytes_counts_committed_arrays():
+    tree = {
+        "w": jax.device_put(jnp.zeros((16, 32), jnp.float32)),  # 2048 B
+        "b": jax.device_put(jnp.ones((64,), jnp.float32)),  # 256 B
+        "not_an_array": 3,
+    }
+    per_device, host_bytes, n_leaves = tree_device_bytes(tree)
+    dev = jax.local_devices()[0].id
+    assert per_device[dev] == 2048 + 256
+    assert host_bytes == 0
+    assert n_leaves == 2
+
+
+def test_tree_device_bytes_ignores_non_arrays():
+    per_device, host_bytes, n_leaves = tree_device_bytes({"a": 1, "b": [2, 3]})
+    assert per_device == {} and host_bytes == 0 and n_leaves == 0
+
+
+# ---------------------------------------------------------------------------
+# registration lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_register_requires_exactly_one_source():
+    ledger = MemoryLedger()
+    with pytest.raises(ValueError):
+        ledger.register("x")
+
+
+def test_register_nbytes_charges_every_local_device():
+    ledger = MemoryLedger()
+    ledger.register("pool", nbytes=4096)
+    att = ledger.attributed_per_device()
+    assert set(att) == {d.id for d in jax.local_devices()}
+    assert all(v == 4096 for v in att.values())
+
+
+def test_register_replaces_and_token_guards_unregister():
+    ledger = MemoryLedger()
+    old = ledger.register("owner", nbytes=100)
+    new = ledger.register("owner", nbytes=200)
+    # The stale token (a GC finalizer of the replaced object) must not
+    # clobber the replacement registration.
+    assert not ledger.unregister("owner", old)
+    assert ledger.owners()[0].device_bytes == 200
+    assert ledger.unregister("owner", new)
+    assert not ledger.has_owners()
+
+
+def test_update_bytes_keeps_registration_identity():
+    ledger = MemoryLedger()
+    token = ledger.register("cache", nbytes=0)
+    assert ledger.update_bytes("cache", 512, token=token)
+    assert ledger.owners()[0].device_bytes == 512
+    assert not ledger.update_bytes("cache", 999, token=token + 1)  # stale
+    assert not ledger.update_bytes("ghost", 1)
+    # Identity kept: the original token still unregisters.
+    assert ledger.unregister("cache", token)
+
+
+def test_subset_entries_ranked_but_not_double_counted():
+    ledger = MemoryLedger()
+    ledger.register("pool", nbytes=1000)
+    ledger.register("resident", nbytes=400, subset_of="pool")
+    assert [r.owner for r in ledger.owners()] == ["pool", "resident"]
+    att = ledger.attributed_per_device()
+    assert all(v == 1000 for v in att.values())  # subset excluded
+    snap = ledger.snapshot()
+    assert snap["owners"][1]["subset_of"] == "pool"
+
+
+# ---------------------------------------------------------------------------
+# conservation
+# ---------------------------------------------------------------------------
+
+
+def test_reconcile_conservation_by_construction():
+    ledger = MemoryLedger()
+    ledger.register("params", nbytes=5000)
+    ledger.note_program_bytes("step", 300)
+    records = ledger.reconcile(
+        stats_fn=lambda d: {
+            "bytes_in_use": 6000,
+            "peak_bytes_in_use": 7000,
+            "bytes_limit": 10000,
+        }
+    )
+    assert records
+    for rec in records:
+        assert rec["stats_available"] == 1
+        assert rec["unattributed_bytes"] == 6000 - 5000 - 300
+        assert (
+            rec["attributed_bytes"]
+            + rec["program_estimate_bytes"]
+            + rec["unattributed_bytes"]
+            == rec["bytes_in_use"]
+        )
+        assert rec["headroom_bytes"] == 4000
+    assert ledger.min_device_headroom() == 4000
+
+
+def test_reconcile_exposes_negative_residual():
+    """Attribution above the allocator's count = stale registration; the
+    residual must go negative, not get clamped to zero."""
+    ledger = MemoryLedger()
+    ledger.register("stale", nbytes=5000)
+    rec = ledger.reconcile(stats_fn=lambda d: {"bytes_in_use": 1000})[0]
+    assert rec["unattributed_bytes"] == -4000
+
+
+def test_reconcile_cpu_reports_stats_honestly_absent():
+    ledger = MemoryLedger()
+    ledger.register("params", nbytes=100)
+    rec = ledger.reconcile()[0]  # CPU: memory_stats() is None
+    assert rec["stats_available"] == 0
+    assert "bytes_in_use" not in rec and "unattributed_bytes" not in rec
+    assert ledger.min_device_headroom() is None
+
+
+def test_publish_gauges_and_owner_slugs():
+    ledger = MemoryLedger()
+    ledger.register("serving.kv_pool", nbytes=2048)
+    ledger.register("params", nbytes=512)
+    ledger.reconcile(stats_fn=lambda d: {"bytes_in_use": 3000, "bytes_limit": 4000})
+    reg = MetricsRegistry()
+    ledger.publish(reg)
+    snap = reg.snapshot()
+    assert snap["memory.attributed_bytes"] == 2560
+    assert snap["memory.unattributed_bytes"] == 3000 - 2560
+    assert snap["memory.headroom_bytes"] == 1000
+    assert snap["memory.owner.serving_kv_pool_bytes"] == 2048
+    assert snap["memory.owner.params_bytes"] == 512
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics
+# ---------------------------------------------------------------------------
+
+
+def test_looks_like_oom():
+    assert looks_like_oom(RuntimeError("RESOURCE_EXHAUSTED: out of HBM"))
+    assert looks_like_oom(MemoryError("CUDA out of memory"))
+    assert not looks_like_oom(ValueError("bad shape"))
+
+
+def test_note_oom_blames_largest_non_subset_owner():
+    ledger = MemoryLedger()
+    ledger.register("small", nbytes=10)
+    ledger.register("hog", nbytes=9000)
+    ledger.register("resident", nbytes=8000, subset_of="hog")
+    pm = ledger.note_oom(source="test", error=RuntimeError("RESOURCE_EXHAUSTED"))
+    assert pm["blame"] == "hog" and pm["blame_bytes"] == 9000
+    assert pm["source"] == "test"
+    assert pm["attributed_bytes"] == 9010  # subset excluded
+    assert [r["owner"] for r in pm["ranked"]][:2] == ["hog", "resident"]
+    assert pm["error"].startswith("RuntimeError: RESOURCE_EXHAUSTED")
+    assert ledger.oom_postmortems == [pm]
+    assert ledger.snapshot()["oom_postmortems"] == 1
+
+
+def test_note_oom_with_empty_ledger_never_raises():
+    ledger = MemoryLedger()
+    pm = ledger.note_oom(source="empty")
+    assert pm["source"] == "empty" and pm["blame"] is None
+
+
+def test_note_oom_mirrors_into_flight_recorder(tmp_path):
+    from accelerate_tpu.telemetry import flightrec
+
+    ledger = get_memory_ledger()
+    ledger.register("hog", nbytes=777)
+    flightrec.enable(dir=str(tmp_path / "flightrec"))
+    try:
+        ledger.note_oom(source="ring", error=RuntimeError("OOM"))
+        ring = [
+            r
+            for r in flightrec.get_flight_recorder().snapshot()
+            if r.get("kind") == "event" and r.get("name") == "memory.oom_postmortem"
+        ]
+        assert ring and ring[-1]["blame"] == "hog"
+    finally:
+        flightrec.disable()
+
+
+def test_find_executable_batch_size_records_postmortem(monkeypatch):
+    """Satellite regression test: a fault-injected RESOURCE_EXHAUSTED under
+    the halving decorator must land a postmortem carrying the pre-halving
+    batch size and the blamed owner, and the halving itself still works."""
+    from accelerate_tpu.utils.memory import find_executable_batch_size
+
+    ledger = get_memory_ledger()
+    ledger.register("planted.hog", nbytes=4096)
+    monkeypatch.setenv(faultinject.ENV_OOM_ONCE, "1")
+    faultinject.reload()
+    calls = []
+
+    @find_executable_batch_size(starting_batch_size=16)
+    def train(batch_size):
+        calls.append(batch_size)
+        faultinject.maybe_oom()
+        return batch_size
+
+    try:
+        assert train() == 8
+    finally:
+        monkeypatch.delenv(faultinject.ENV_OOM_ONCE)
+        faultinject.reload()
+    assert calls == [16, 8]
+    pm = ledger.oom_postmortems[-1]
+    assert pm["source"] == "find_executable_batch_size"
+    assert pm["function"] == "train" and pm["batch_size"] == 16
+    assert pm["blame"] == "planted.hog"
+
+
+def test_retry_fail_fast_records_postmortem():
+    from accelerate_tpu.resilience.retry import RetryPolicy
+
+    ledger = get_memory_ledger()
+    ledger.register("planted.hog", nbytes=64)
+    policy = RetryPolicy(tries=3, base_delay_s=0.01, label="unit")
+    with pytest.raises(RuntimeError):
+        policy.call(lambda: (_ for _ in ()).throw(RuntimeError("RESOURCE_EXHAUSTED: no")))
+    pm = ledger.oom_postmortems[-1]
+    assert pm["source"] == "resilience.unit" and pm["blame"] == "planted.hog"
+
+
+# ---------------------------------------------------------------------------
+# collect_hbm: per-device sampling + fleet-min headroom
+# ---------------------------------------------------------------------------
+
+
+class _FakeDevice:
+    def __init__(self, stats):
+        self._stats = stats
+
+    def memory_stats(self):
+        return self._stats
+
+
+def test_collect_hbm_fleet_min_headroom(monkeypatch):
+    devices = [
+        _FakeDevice({"bytes_in_use": 100, "peak_bytes_in_use": 400, "bytes_limit": 1000}),
+        _FakeDevice({"bytes_in_use": 700, "peak_bytes_in_use": 900, "bytes_limit": 1000}),
+    ]
+    monkeypatch.setattr(jax, "local_devices", lambda: devices)
+    reg = MetricsRegistry()
+    out = collect_hbm(reg)
+    snap = reg.snapshot()
+    assert snap["hbm.stats_available"] == 1
+    assert snap["hbm.bytes_in_use"] == 700  # worst device
+    assert snap["hbm.peak_bytes"] == 900
+    assert snap["hbm.fleet_min_headroom_bytes"] == 300  # binding constraint
+    assert out["hbm.fleet_min_headroom_bytes"] == 300
+
+
+def test_collect_hbm_publishes_availability_zero_without_stats():
+    reg = MetricsRegistry()
+    out = collect_hbm(reg)  # CPU devices: memory_stats() is None
+    assert reg.snapshot()["hbm.stats_available"] == 0
+    assert out == {}  # back-compat: callers treat "no stats" as empty

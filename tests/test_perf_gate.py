@@ -33,6 +33,7 @@ def _passing_measurements():
         "fused_host_blocked_ms_per_step": 2.0,
         "goodput_productive_frac": 0.3,
         "goodput_conservation_error_s": 0.0,
+        "train_state_bytes_per_chip": 200000,
     }
 
 
@@ -260,6 +261,7 @@ def _passing_serving_measurements():
         serving_paged_vs_dense_ratio=1.5,
         serving_decode_dispatches_per_tick=1.0,
         serving_paged_active=True,
+        serving_pool_bytes_per_chip=655360,
     )
 
 
@@ -291,3 +293,67 @@ def test_serving_row_fails_when_dense_decode_degraded(monkeypatch):
     assert row["serving_paged_active"] is False
     failures = evaluate(dict(_passing_measurements(), **row), load_baseline())
     assert any("fell back to the dense" in f for f in failures)
+
+
+# ---------------------------------------------------------------------------
+# memory row (PR 17): per-chip byte ceilings from the HBM ledger
+# ---------------------------------------------------------------------------
+
+
+def test_evaluate_memory_row_thresholds():
+    """The memory row: a bloated train state fails, a MISSING number fails
+    loudly (the overlap-row convention: a deleted registration hook is a
+    broken check, not an un-gated pass), and the serving-pool ceiling is
+    judged only when the serving arm ran."""
+    baseline = load_baseline()
+    assert baseline["max_train_state_bytes_per_chip"] > 0
+    assert baseline["max_serving_pool_bytes_per_chip"] > 0
+    assert evaluate(_passing_measurements(), baseline) == []
+    m = dict(_passing_measurements(), train_state_bytes_per_chip=10**9)
+    assert any("train-state footprint" in f for f in evaluate(m, baseline))
+    m = dict(_passing_measurements(), train_state_bytes_per_chip=None)
+    assert any(
+        "memory audit produced no number" in f for f in evaluate(m, baseline)
+    )
+    m = dict(_passing_serving_measurements(), serving_pool_bytes_per_chip=10**9)
+    assert any("serving KV pool" in f for f in evaluate(m, baseline))
+    m = dict(_passing_serving_measurements(), serving_pool_bytes_per_chip=None)
+    assert any(
+        "serving pool audit produced no number" in f for f in evaluate(m, baseline)
+    )
+    # No serving arm: the pool ceiling makes no judgment at all.
+    assert evaluate(_passing_measurements(), baseline) == []
+
+
+@pytest.mark.slow
+def test_gate_fails_when_memory_bloated(monkeypatch):
+    """ACCELERATE_TPU_PERF_GATE_DEGRADE=mem-bloat registers four live extra
+    parameter copies under perf_gate.bloat — the per-chip train-state ceiling
+    must fail the gate (the proof the memory row judges real bytes).  Runs at
+    the baseline's dim=128 geometry: the ceiling was committed against it.
+    Probe-level self-test (full probe, ~40s); the cheap evaluate()-level
+    memory-row tests run in tier-1."""
+    monkeypatch.setenv("ACCELERATE_TPU_PERF_GATE_DEGRADE", "mem-bloat")
+    measurements = run_probe(
+        accum=2, steps=4, dim=128, batch=8, epochs=1, prefetch=0,
+        pp=False, serving=False,
+    )
+    baseline = load_baseline()
+    assert (
+        measurements["train_state_bytes_per_chip"]
+        > baseline["max_train_state_bytes_per_chip"]
+    )
+    failures = evaluate(measurements, baseline)
+    assert any("train-state footprint" in f for f in failures)
+
+
+@pytest.mark.slow
+def test_serving_probe_reports_exact_pool_bytes():
+    """The serving arm's pool measurement is exact allocation arithmetic
+    (num_blocks x block rows x layer K/V), committed in the baseline — and
+    must stay under its ceiling.  Probe-level (paged + dense decode arms);
+    `make perf-gate` judges the same number against the baseline every run."""
+    baseline = load_baseline()
+    row = run_serving_probe(decode_ticks=4)
+    assert row["serving_pool_bytes_per_chip"] == 655360
+    assert row["serving_pool_bytes_per_chip"] <= baseline["max_serving_pool_bytes_per_chip"]
