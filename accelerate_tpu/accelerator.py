@@ -1787,8 +1787,13 @@ class Accelerator:
         cleanly.  ``step`` is recorded in the checkpoint manifest for
         :meth:`resume_from_latest`.  Without an installed guard this is a
         single attribute check (plus the env-armed fault-injection tick)."""
-        from .resilience import faultinject
+        from .resilience import faultinject, fleet
 
+        # Step-loop heartbeat for the FleetSupervisor (no-op unless the
+        # supervisor armed $ACCELERATE_TPU_HEARTBEAT_DIR): beaten HERE, from
+        # the main thread, so a rank wedged in a dead collective stops
+        # beating and the supervisor can kill the fleet instead of hanging.
+        fleet.maybe_beat(step if step is not None else self.step)
         if faultinject.armed():
             faultinject.tick(step if step is not None else self.step)
         guard = self._preemption_guard

@@ -109,6 +109,16 @@ def launch_command_parser(subparsers=None):
     parser.add_argument("--ipex", action="store_true", default=None)
     parser.add_argument("--debug_cpu", type=int, default=0,
                         help="Spawn N local CPU processes as a simulated cluster")
+    # Fleet supervision (applies to the --debug_cpu supervised launch).
+    parser.add_argument("--elastic", action="store_true", default=None,
+                        help="On a dead/wedged worker, relaunch the fleet at the "
+                        "reduced world size (elastic resume restores the run)")
+    parser.add_argument("--heartbeat_timeout", type=float, default=None,
+                        help="Seconds a worker's step-loop heartbeat may go stale "
+                        "before the supervisor declares it wedged (default 60)")
+    parser.add_argument("--grace_period", type=float, default=None,
+                        help="Seconds survivors get to exit after SIGTERM before "
+                        "the supervisor SIGKILLs them (default 10)")
     parser.add_argument("--quiet", "-q", action="store_true", default=None)
     # Precision / accumulation
     parser.add_argument("--mixed_precision", default=None, choices=["no", "fp16", "bf16", "fp8"])
@@ -480,30 +490,65 @@ def launch_command(args):
 
 
 def _debug_cpu_launch(args, merged):
-    """N localhost CPU workers forming a real jax.distributed cluster."""
-    import socket
+    """N localhost CPU workers forming a real jax.distributed cluster, run
+    under the :class:`~accelerate_tpu.launchers.FleetSupervisor`: a worker
+    that dies or wedges no longer leaves its siblings hung in their next
+    collective — the fleet is torn down within a bounded grace window (and
+    with ``--elastic`` relaunched at the reduced world size).  The supervisor
+    owns the coordinator port (fresh per attempt), so workers see a
+    consistent address and retry the connect with backoff."""
+    import tempfile
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
+    from ..launchers import FleetSupervisor
+
     n = args.debug_cpu
     merged = dict(merged)
     merged["num_machines"] = n
     merged["main_process_ip"] = "127.0.0.1"
-    merged["main_process_port"] = port
     merged["num_processes"] = n
-    procs = []
-    for rank in range(n):
+    cmd = _script_cmd(args)
+    telemetry_dir = os.environ.get("ACCELERATE_TPU_TELEMETRY_DIR") or os.environ.get(
+        "ACCELERATE_TPU_FLIGHTREC_DIR"
+    )
+
+    def spawn(rank, world, overrides):
         merged["machine_rank"] = rank
+        merged["num_machines"] = world
+        merged["num_processes"] = world
+        # Any port works here — the supervisor's coordinator address override
+        # below is what the workers actually dial.
+        merged["main_process_port"] = 0
         env = build_env(merged, debug=args.debug, cpu=True)
         env["XLA_FLAGS"] = env.get("XLA_FLAGS", "").replace(
             "--xla_force_host_platform_device_count=8", ""
         )
-        cmd = _script_cmd(args)
-        procs.append(subprocess.Popen(cmd, env=env))
-    codes = [p.wait() for p in procs]
-    if any(codes):
-        raise SystemExit(max(codes))
+        env.update(overrides)
+        return subprocess.Popen(cmd, env=env)
+
+    supervisor = FleetSupervisor(
+        spawn,
+        n,
+        workdir=tempfile.mkdtemp(prefix="atpu_fleet_"),
+        heartbeat_timeout_s=(
+            args.heartbeat_timeout if args.heartbeat_timeout is not None else 60.0
+        ),
+        grace_s=args.grace_period if args.grace_period is not None else 10.0,
+        elastic=bool(args.elastic),
+        telemetry_dir=telemetry_dir,
+    )
+    result = supervisor.run()
+    if result["verdict"] not in ("completed", "drained"):
+        last = result["attempts"][-1]
+        codes = [c for c in last["exit_codes"].values() if c]
+        detail = f"fleet {result['verdict']}"
+        if last.get("dead_rank") is not None:
+            detail += f" (rank {last['dead_rank']} exited {last['exit_code']})"
+        if last.get("wedged_rank") is not None:
+            detail += f" (rank {last['wedged_rank']} heartbeat stalled)"
+        if result.get("postmortem"):
+            detail += f"; postmortem: {result['postmortem']}"
+        print(detail, file=sys.stderr)
+        raise SystemExit(max(codes) if codes else 1)
 
 
 def register_subcommand(subparsers):
@@ -515,3 +560,10 @@ def main_launch():
     parser = launch_command_parser()
     args = parser.parse_args()
     launch_command(args)
+
+
+if __name__ == "__main__":
+    # ``python -m accelerate_tpu.commands.launch ...`` — without this guard
+    # the module imports, does nothing, and exits 0, which reads as a
+    # successful (but empty) launch.
+    main_launch()

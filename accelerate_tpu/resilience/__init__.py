@@ -47,6 +47,12 @@ pieces here make the training loop survive those (see
   (``make chaos-smoke``): every cycle must end with a manifest-complete
   checkpoint, same-topology resumes stay bit-exact vs an unkilled run, and
   cross-topology resumes load bit-identical state.
+- **fleet primitives** (``fleet.py``) — deadline-bounded ``barrier``/``agree``
+  over the ``jax.distributed`` coordinator (a dead member raises a loud
+  ``FleetError`` instead of hanging survivors), the step-loop file heartbeat
+  the ``FleetSupervisor`` watches for wedge detection, and the coordinator
+  connect-retry policy; exercised by the multi-process fleet chaos campaign
+  (``fleet_chaos.py``, ``make fleet-chaos-smoke``).
 
 Zero overhead when unused: no signal handlers are installed and no manifest
 hashing runs unless a guard is installed / a checkpoint is saved; hashing is
@@ -77,6 +83,7 @@ from .elastic import (
     state_digest,
     validate_leaves,
 )
+from .fleet import FleetError, Heartbeat, agree, barrier, fleet_client
 from .health import HealthGuard, HealthVerdict, NumericalDivergenceError
 from .preemption import PreemptionGuard
 from .retry import RetryPolicy, retrying
@@ -108,4 +115,9 @@ __all__ = [
     "RetryPolicy",
     "retrying",
     "PreemptionGuard",
+    "FleetError",
+    "Heartbeat",
+    "barrier",
+    "agree",
+    "fleet_client",
 ]

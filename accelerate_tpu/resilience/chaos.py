@@ -564,10 +564,13 @@ def main() -> int:
     parser.add_argument("--no-save", action="store_true")
     parser.add_argument("--seed", type=int, default=20260804)
     parser.add_argument(
-        "--mode", choices=("train", "serving"), default="train",
+        "--mode", choices=("train", "serving", "fleet"), default="train",
         help="'serving' runs the serving chaos campaign (overload burst, "
         "poisoned request, deadline storm, SIGTERM drain, SIGKILL + journal "
-        "recovery) instead of the kill->resume training campaign",
+        "recovery); 'fleet' runs the multi-process fleet campaign (SIGKILL, "
+        "coordinated drain, wedge, elastic 4->3 restart over a real "
+        "4-process jax.distributed cluster) instead of the kill->resume "
+        "training campaign",
     )
     args = parser.parse_args()
 
@@ -575,6 +578,11 @@ def main() -> int:
         from ..serving.chaos import main as serving_main
 
         return serving_main(["--seed", str(args.seed)])
+
+    if args.mode == "fleet":
+        from .fleet_chaos import main as fleet_main
+
+        return fleet_main(["--seed", str(args.seed)])
 
     if args.role == "life":
         os.environ.setdefault("JAX_PLATFORMS", "cpu")

@@ -1,0 +1,257 @@
+"""Worker-side fleet primitives: deadline-bounded coordination + heartbeats.
+
+The raw ``jax.distributed`` runtime is fault-naive: a collective entered by a
+fleet with one dead (or wedged) member never returns, so the default failure
+mode of a multi-host run is an *infinite silent hang* on every survivor.  This
+module is the worker-side half of the hardened runtime (the parent-side half
+is :class:`accelerate_tpu.launchers.FleetSupervisor`):
+
+- :func:`barrier` / :func:`agree` — rendezvous and agreement-gather built on
+  the coordinator's key-value service with a hard deadline.  A fleet member
+  that never shows up turns the hang into a loud :class:`FleetError` so the
+  caller can exit cleanly (and the supervisor can reap the rest).
+- :class:`Heartbeat` / :func:`maybe_beat` — a file heartbeat each worker
+  beats from its *step loop* (never from a helper thread: threads keep
+  beating while the main thread is stuck in a dead collective, which is
+  exactly the wedge the heartbeat exists to expose).  The supervisor watches
+  the files' mtimes and kills a fleet whose member went quiet.
+- :func:`connect_retry_policy` — the backoff policy ``PartialState`` rides
+  when dialing the coordinator, closing the launcher's bind-to-spawn port
+  race (the coordinator may come up a beat later than its workers).
+
+``PreemptionGuard.should_stop`` routes its cross-host agreement through
+:func:`agree` whenever a distributed client exists, which is what makes a
+coordinated SIGTERM drain converge even while part of the fleet is dying.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, List, Optional
+
+from ..logging import get_logger
+from ..telemetry import get_telemetry
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "FleetError",
+    "fleet_client",
+    "barrier",
+    "agree",
+    "Heartbeat",
+    "heartbeat_path",
+    "read_heartbeat",
+    "maybe_beat",
+    "connect_retry_policy",
+]
+
+# Supervisor → worker contract: when set, workers beat a per-rank file in this
+# directory from their step loop (see maybe_beat / Accelerator.check_preemption).
+ENV_HEARTBEAT_DIR = "ACCELERATE_TPU_HEARTBEAT_DIR"
+
+
+class FleetError(RuntimeError):
+    """A fleet-coordination primitive hit its deadline (a member is dead,
+    wedged, or unreachable).  The right response is a clean, loud exit — the
+    supervisor turns the exit into a bounded fleet teardown + postmortem."""
+
+
+def fleet_client():
+    """The live ``jax.distributed`` coordinator client, or None outside a
+    multi-process run.  Inspected directly (not via ``jax.process_count()``)
+    so calling this never initializes the backend."""
+    try:
+        from jax._src import distributed as _jax_distributed
+
+        return getattr(_jax_distributed.global_state, "client", None)
+    except Exception:
+        return None
+
+
+def _world() -> tuple:
+    import jax
+
+    return jax.process_count(), jax.process_index()
+
+
+def _note_deadline(primitive: str, name: str, timeout_s: float, exc: BaseException):
+    tel = get_telemetry()
+    if tel.enabled:
+        tel.registry.counter("fleet.deadline_errors").inc()
+        tel.event(
+            "fleet.deadline_error",
+            primitive=primitive,
+            name=name,
+            timeout_s=timeout_s,
+            error=f"{type(exc).__name__}: {exc}",
+        )
+    logger.error(
+        f"fleet {primitive} {name!r} missed its {timeout_s}s deadline: {exc}"
+    )
+
+
+# Each (primitive, name) pair needs a fresh coordinator key per call — the KV
+# store rejects overwrites.  Call-count suffixes stay in lockstep across ranks
+# for the same reason PreemptionGuard's agreement is call-count gated: every
+# rank must reach the same call site the same number of times anyway.
+_seq: dict = {}
+
+
+def _next_key(primitive: str, name: str) -> str:
+    n = _seq.get((primitive, name), 0)
+    _seq[(primitive, name)] = n + 1
+    return f"fleet/{primitive}/{name}/{n}"
+
+
+def barrier(name: str, timeout_s: float = 60.0) -> None:
+    """Deadline-bounded fleet rendezvous.  Raises :class:`FleetError` when any
+    member fails to arrive within ``timeout_s`` (instead of hanging forever in
+    a device collective).  No-op on a single process."""
+    client = fleet_client()
+    if client is None:
+        return
+    key = _next_key("barrier", name)
+    try:
+        client.wait_at_barrier(key, int(timeout_s * 1000))
+    except Exception as exc:
+        _note_deadline("barrier", name, timeout_s, exc)
+        raise FleetError(
+            f"fleet barrier {name!r} did not complete within {timeout_s}s — "
+            f"a fleet member is dead or wedged ({type(exc).__name__}: {exc})"
+        ) from exc
+
+
+def agree(name: str, value: Any, timeout_s: float = 60.0) -> List[Any]:
+    """Agreement-gather with a deadline: every rank contributes a
+    JSON-serializable ``value``; returns the rank-ordered list of all values.
+    Runs over the coordinator's key-value service — no device collective, so
+    it stays answerable (with :class:`FleetError`) while part of the fleet is
+    dying, which is exactly when agreement matters (coordinated drain)."""
+    client = fleet_client()
+    if client is None:
+        return [value]
+    num, rank = _world()
+    if num <= 1:
+        return [value]
+    key = _next_key("agree", name)
+    deadline = time.monotonic() + timeout_s
+    try:
+        client.key_value_set(f"{key}/{rank}", json.dumps(value))
+        out: List[Any] = []
+        for peer in range(num):
+            remaining_ms = max(1, int((deadline - time.monotonic()) * 1000))
+            raw = client.blocking_key_value_get(f"{key}/{peer}", remaining_ms)
+            out.append(json.loads(raw))
+        return out
+    except Exception as exc:
+        _note_deadline("agree", name, timeout_s, exc)
+        raise FleetError(
+            f"fleet agreement {name!r} did not complete within {timeout_s}s — "
+            f"a fleet member is dead or wedged ({type(exc).__name__}: {exc})"
+        ) from exc
+
+
+# ---------------------------------------------------------------------------
+# Heartbeats (worker side; the supervisor reads the files)
+# ---------------------------------------------------------------------------
+
+
+def heartbeat_path(directory: str, rank: int) -> str:
+    return os.path.join(directory, f"heartbeat_p{rank}.json")
+
+
+def read_heartbeat(path: str) -> Optional[dict]:
+    """The last beat's payload, or None when absent/torn."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+class Heartbeat:
+    """File heartbeat: ``beat()`` atomically rewrites the file, so its mtime
+    is the liveness signal and its payload carries the last step.  MUST be
+    driven from the step loop on the main thread — a background thread keeps
+    beating while the main thread is stuck in a dead collective."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.beats = 0
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def beat(self, step: Optional[int] = None) -> None:
+        payload = {"t": time.time(), "pid": os.getpid(), "step": step, "beats": self.beats}
+        tmp = f"{self.path}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, self.path)
+            self.beats += 1
+        except OSError:
+            # A failed beat must never kill the step loop; a persistently
+            # failing one will read as a stall, which is the honest signal.
+            logger.warning(f"heartbeat write failed: {self.path}", exc_info=True)
+
+
+_heartbeat: Optional[Heartbeat] = None
+
+
+def maybe_beat(step: Optional[int] = None) -> bool:
+    """Beat the supervisor's heartbeat file iff ``$ACCELERATE_TPU_HEARTBEAT_DIR``
+    is set (the FleetSupervisor sets it for every worker it spawns).  Wired
+    into ``Accelerator.check_preemption`` so any preemption-aware step loop is
+    automatically wedge-detectable; costs one env lookup when disabled."""
+    global _heartbeat
+    directory = os.environ.get(ENV_HEARTBEAT_DIR)
+    if not directory:
+        return False
+    path = None
+    if _heartbeat is None or os.path.dirname(_heartbeat.path) != directory:
+        try:
+            import jax
+
+            path = heartbeat_path(directory, jax.process_index())
+        except Exception:
+            path = heartbeat_path(directory, int(os.environ.get("ACCELERATE_PROCESS_ID", 0)))
+        _heartbeat = Heartbeat(path)
+    _heartbeat.beat(step)
+    return True
+
+
+def _reset_heartbeat_singleton() -> None:
+    """Drop the cached per-process heartbeat (tests re-point the env dir)."""
+    global _heartbeat
+    _heartbeat = None
+
+
+# ---------------------------------------------------------------------------
+# Coordinator connect backoff (closes the launcher's bind-to-spawn port race)
+# ---------------------------------------------------------------------------
+
+
+def _connect_retryable(exc: BaseException) -> bool:
+    # Bring-up failures arrive as RuntimeError/XlaRuntimeError with grpc
+    # status text; argument errors (TypeError/ValueError) fail fast.
+    return not isinstance(exc, (TypeError, ValueError))
+
+
+def connect_retry_policy():
+    """Backoff policy for ``jax.distributed.initialize``: the launcher probes
+    a free port before spawning, so the coordinator can lose the port (or come
+    up a beat late) — workers redial instead of dying on the first refusal.
+    Knobs: ``ACCELERATE_TPU_COORDINATOR_CONNECT_TRIES`` (default 3) and
+    ``ACCELERATE_TPU_COORDINATOR_CONNECT_DEADLINE_S`` (default 600)."""
+    from .retry import RetryPolicy
+
+    return RetryPolicy(
+        tries=max(1, int(os.environ.get("ACCELERATE_TPU_COORDINATOR_CONNECT_TRIES", "3"))),
+        base_delay_s=0.25,
+        max_delay_s=2.0,
+        deadline_s=float(os.environ.get("ACCELERATE_TPU_COORDINATOR_CONNECT_DEADLINE_S", "600")),
+        retryable=_connect_retryable,
+        label="coordinator_connect",
+    )

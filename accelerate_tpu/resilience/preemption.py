@@ -50,7 +50,8 @@ class PreemptionGuard:
         self,
         signals: Sequence[int] = (signal.SIGTERM, signal.SIGINT),
         coordinated: Optional[bool] = None,
-        coordinate_every: int = 10,
+        coordinate_every: Optional[int] = None,
+        agree_timeout_s: Optional[float] = None,
     ):
         self.signals = tuple(signals)
         # Multi-host coordination defaults to on only when >1 process exists;
@@ -60,7 +61,15 @@ class PreemptionGuard:
         # should_stop() call.  MUST be call-count based, not wall-clock: every
         # process has to enter the gather on the same step or the collective
         # deadlocks.
+        if coordinate_every is None:
+            coordinate_every = int(os.environ.get("ACCELERATE_TPU_PREEMPT_EVERY", "10"))
         self.coordinate_every = max(1, int(coordinate_every))
+        # Deadline on the cross-host agreement (fleet.agree path): a fleet
+        # losing members mid-drain must degrade to the local flag loudly, not
+        # hang the drain forever.
+        if agree_timeout_s is None:
+            agree_timeout_s = float(os.environ.get("ACCELERATE_TPU_PREEMPT_AGREE_TIMEOUT_S", "60"))
+        self.agree_timeout_s = agree_timeout_s
         self._should_stop_calls = 0
         self._agreed = False
         self._installed = False
@@ -227,10 +236,26 @@ class PreemptionGuard:
         self._should_stop_calls += 1
         if (self._should_stop_calls - 1) % self.coordinate_every != 0:
             return False
-        from ..utils.operations import gather_object
+        from . import fleet
 
         try:
-            flags = gather_object([bool(self._flag)])
+            if fleet.fleet_client() is not None:
+                # Real multi-process fleet: agree over the coordinator's KV
+                # service with a hard deadline — unlike a device collective,
+                # this stays answerable while part of the fleet is dying,
+                # which is exactly when a coordinated drain runs.
+                flags = fleet.agree(
+                    "preempt", bool(self._flag), timeout_s=self.agree_timeout_s
+                )
+            else:
+                from ..utils.operations import gather_object
+
+                flags = gather_object([bool(self._flag)])
+        except fleet.FleetError:
+            # A dead member mid-drain: the deadline fired instead of hanging.
+            # The local flag still drives this host's own checkpoint+exit.
+            logger.exception("preemption fleet agreement timed out; using local flag")
+            return self._flag
         except Exception:
             # Coordination path itself failing (a host already died) must not
             # mask the local signal.

@@ -204,12 +204,47 @@ class PartialState:
 
         if getattr(_jax_distributed.global_state, "client", None) is not None:
             return  # already initialized (e.g. by the launcher)
-        jax.distributed.initialize(
-            coordinator_address=coordinator,
-            num_processes=num_processes,
-            process_id=process_id,
-            local_device_ids=init_kwargs.local_device_ids,
-        )
+
+        # Dial the coordinator under backoff: the launcher probes a free port
+        # BEFORE spawning (bind-to-spawn race), and the coordinator process may
+        # come up a beat after its workers — the first refusal must not kill
+        # the worker.  A failed attempt tears the half-built client down so
+        # the retry starts clean.
+        from .resilience.fleet import connect_retry_policy
+
+        # Multi-process CPU clusters (the debug/dev fleet and the chaos
+        # campaigns) need an actual cross-process collectives backend — XLA:CPU
+        # refuses multiprocess computations otherwise.  Opt out (or pick
+        # "mpi") via ACCELERATE_TPU_CPU_COLLECTIVES; TPU/GPU paths ignore it.
+        if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+            impl = os.environ.get("ACCELERATE_TPU_CPU_COLLECTIVES", "gloo")
+            if impl:
+                try:
+                    jax.config.update("jax_cpu_collectives_implementation", impl)
+                except Exception:
+                    logger.warning(
+                        f"could not enable CPU collectives impl {impl!r}; "
+                        "cross-process collectives may be unavailable"
+                    )
+
+        def _connect():
+            if getattr(_jax_distributed.global_state, "client", None) is not None:
+                return
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                    local_device_ids=init_kwargs.local_device_ids,
+                )
+            except Exception:
+                try:
+                    jax.distributed.shutdown()
+                except Exception:
+                    pass
+                raise
+
+        connect_retry_policy().call(_connect)
 
     # -- properties ---------------------------------------------------------
 
@@ -546,8 +581,18 @@ class AcceleratorState:
             cfg = ParallelismConfig.from_env()
         if cfg.total_size == 1 and n > 1:
             # Default strategy: if an FSDP plugin is active put every chip on the
-            # fsdp axis, else pure data parallelism.
-            if self.fsdp_plugin is not None:
+            # fsdp axis, else pure data parallelism.  On a real multi-process
+            # fleet the process dimension lands on the OUTERMOST ``dcn_dp``
+            # axis (hybrid DCN+ICI mesh): within-host axes ride ICI while only
+            # the data-parallel gradient all-reduce crosses the slow DCN link.
+            procs = jax.process_count()
+            if procs > 1 and n % procs == 0:
+                local = n // procs
+                if self.fsdp_plugin is not None:
+                    cfg = ParallelismConfig(dcn_dp=procs, fsdp=max(1, local))
+                else:
+                    cfg = ParallelismConfig(dcn_dp=procs, dp=max(1, local))
+            elif self.fsdp_plugin is not None:
                 cfg = ParallelismConfig(fsdp=n)
             else:
                 cfg = ParallelismConfig(dp=n)

@@ -514,10 +514,14 @@ class FleetAggregator:
     def __init__(
         self,
         sentinel: Optional[AnomalySentinel] = None,
-        every: int = 32,
+        every: Optional[int] = None,
         gather_fn: Optional[Callable] = None,
         host: Optional[int] = None,
     ):
+        if every is None:
+            # Env-tunable cadence so short-lived fleets (the multi-process
+            # chaos campaign runs single-digit steps) still reach a gather.
+            every = int(os.environ.get("ACCELERATE_TPU_FLEET_EVERY", "32"))
         self.every = max(1, int(every))
         self._calls = 0
         self._pending: List[float] = []

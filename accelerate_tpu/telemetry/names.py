@@ -33,6 +33,10 @@ COUNTERS = frozenset({
     "chaos.cycles",
     "dataloader.batches",
     "elastic.reshards",
+    "fleet.deadline_errors",
+    "fleet.elastic_restarts",
+    "fleet.wedged_workers",
+    "fleet.worker_deaths",
     "health.nonfinite_grads",
     "health.quarantine_skips",
     "health.quarantined_batches",
@@ -126,6 +130,13 @@ EVENTS = frozenset({
     "chaos.cycle",
     "checkpoint.publish",
     "elastic.reshard",
+    "fleet.deadline_error",
+    "fleet.drain",
+    "fleet.postmortem",
+    "fleet.relaunch",
+    "fleet.teardown",
+    "fleet.wedged",
+    "fleet.worker_dead",
     "health.rewind",
     "health.skip",
     "memory.low_headroom",

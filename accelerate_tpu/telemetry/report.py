@@ -28,11 +28,14 @@ import time
 __all__ = [
     "load_records",
     "load_flight_records",
+    "load_fleet_records",
     "load_serving_trace_records",
     "summarize",
     "summarize_flight",
+    "summarize_fleet",
     "format_report",
     "format_flight_report",
+    "format_fleet_report",
     "format_memory_block",
     "main",
 ]
@@ -79,6 +82,147 @@ def load_flight_records(path: str) -> list[dict]:
     else:
         files = [path]
     return _parse_jsonl(files)
+
+
+def load_fleet_records(path: str) -> dict:
+    """Every rank's telemetry AND flight-recorder stream under a run
+    directory, keyed by process index: ``{proc: [records]}`` with each record
+    tagged ``source`` (``telemetry``/``flightrec``).  The raw material for the
+    fleet postmortem view (:func:`summarize_fleet`)."""
+    import re
+
+    by_proc: dict = {}
+    if not os.path.isdir(path):
+        return by_proc
+    for prefix, source in (("telemetry_p", "telemetry"), ("flightrec_p", "flightrec")):
+        for file in sorted(glob.glob(os.path.join(path, f"{prefix}*.jsonl"))):
+            match = re.search(r"_p(\d+)\.jsonl$", os.path.basename(file))
+            name_proc = int(match.group(1)) if match else 0
+            for rec in _parse_jsonl([file]):
+                rec = dict(rec)
+                rec["source"] = source
+                proc = rec.get("proc")
+                proc = name_proc if not isinstance(proc, int) else proc
+                rec["proc"] = proc
+                by_proc.setdefault(proc, []).append(rec)
+    for records in by_proc.values():
+        records.sort(key=lambda r: (r.get("t") or 0, r.get("seq") or 0))
+    return by_proc
+
+
+def _describe_record(rec: dict) -> str:
+    kind = rec.get("kind")
+    if kind == "step":
+        return f"step {rec.get('step')} ({rec.get('dur_ms')}ms)"
+    if kind == "event":
+        skip = ("kind", "t", "proc", "seq", "name", "source")
+        fields = ", ".join(f"{k}={rec[k]!r}" for k in rec if k not in skip)
+        return f"event {rec.get('name')}" + (f" ({fields})" if fields else "")
+    if kind == "span":
+        return f"span {rec.get('name')} ({rec.get('dur_ms')}ms)"
+    return _event_str(rec)
+
+
+def summarize_fleet(by_proc: dict, timeline_n: int = 40) -> dict:
+    """Merge every rank's streams into one rank-tagged postmortem: per-rank
+    last-sign-of-life, the rank that went silent FIRST (the usual suspect for
+    a dead/wedged member — everyone else's streams end later, wedged in the
+    collective the dead rank abandoned), and a merged tail timeline placing
+    the dead rank's final events adjacent to the survivors' last barrier."""
+    ranks: dict = {}
+    merged: list = []
+    for proc in sorted(by_proc):
+        records = by_proc[proc]
+        if not records:
+            continue
+        last = records[-1]
+        steps = [r for r in records if r.get("kind") == "step"]
+        ranks[str(proc)] = {
+            "n_records": len(records),
+            "last_t": last.get("t"),
+            "last_event": _describe_record(last),
+            "last_step": steps[-1].get("step") if steps else None,
+            "crashes": sum(1 for r in records if r.get("kind") == "crash"),
+            "signals": sum(1 for r in records if r.get("kind") == "signal"),
+        }
+        merged.extend(records)
+    merged.sort(key=lambda r: (r.get("t") or 0, r.get("seq") or 0))
+    end_t = merged[-1].get("t") if merged else None
+    first_silent = None
+    if len(ranks) >= 2:
+        first_silent = min(
+            ranks, key=lambda p: (ranks[p]["last_t"] is None, ranks[p]["last_t"] or 0)
+        )
+    timeline = [
+        {
+            "t": r.get("t"),
+            "behind_s": (
+                round(end_t - r["t"], 3)
+                if end_t is not None and isinstance(r.get("t"), (int, float))
+                else None
+            ),
+            "proc": r.get("proc"),
+            "source": r.get("source"),
+            "desc": _describe_record(r),
+        }
+        for r in merged[-timeline_n:]
+    ]
+    return {
+        "n_ranks": len(ranks),
+        "n_records": len(merged),
+        "ranks": ranks,
+        "first_silent_rank": int(first_silent) if first_silent is not None else None,
+        "timeline": timeline,
+    }
+
+
+def format_fleet_report(fsummary: dict, last_n: int = 20) -> str:
+    """Render the rank-tagged fleet postmortem block."""
+    lines = []
+    lines.append(
+        f"fleet postmortem — {fsummary['n_ranks']} ranks, "
+        f"{fsummary['n_records']} records"
+    )
+    ranks = fsummary["ranks"]
+    if ranks:
+        end_t = max(
+            (r["last_t"] for r in ranks.values() if r["last_t"] is not None),
+            default=None,
+        )
+        lines.append("")
+        lines.append(
+            f"  {'rank':>5} {'records':>8} {'last step':>10} {'behind_s':>9}  last sign of life"
+        )
+        for proc in sorted(ranks, key=int):
+            info = ranks[proc]
+            behind = (
+                f"{end_t - info['last_t']:9.3f}"
+                if end_t is not None and info["last_t"] is not None
+                else "        -"
+            )
+            lines.append(
+                f"  {proc:>5} {info['n_records']:>8} "
+                f"{info['last_step'] if info['last_step'] is not None else '-':>10} "
+                f"{behind}  {info['last_event']}"
+            )
+    if fsummary.get("first_silent_rank") is not None:
+        lines.append("")
+        lines.append(
+            f"first silent: rank {fsummary['first_silent_rank']} "
+            "(earliest last record — likely the dead/wedged member)"
+        )
+    timeline = fsummary["timeline"][-last_n:]
+    if timeline:
+        lines.append("")
+        lines.append(f"merged timeline (last {len(timeline)}):")
+        for entry in timeline:
+            behind = (
+                f"-{entry['behind_s']:.3f}s" if entry["behind_s"] is not None else "?"
+            )
+            lines.append(
+                f"  {behind:>10} p{entry['proc']} [{entry['source']}] {entry['desc']}"
+            )
+    return "\n".join(lines)
 
 
 def load_serving_trace_records(path: str) -> list[dict]:
@@ -606,6 +750,16 @@ def main(argv=None) -> int:
             "file) offline and append the attribution block"
         ),
     )
+    parser.add_argument(
+        "--fleet",
+        action="store_true",
+        help=(
+            "fleet postmortem view: merge every rank's telemetry_p*/"
+            "flightrec_p* stream under the run directory into one rank-tagged "
+            "timeline (last sign of life per rank, first-silent rank, merged "
+            "tail)"
+        ),
+    )
     args = parser.parse_args(argv)
     if args.path is None and args.profile is None:
         parser.error("a run path and/or --profile <dir> is required")
@@ -624,6 +778,7 @@ def main(argv=None) -> int:
     records: list = []
     flight: list = []
     serving_traces: list = []
+    fleet: dict = {}
     if args.path is not None:
         if not os.path.exists(args.path):
             print(f"no such file or directory: {args.path}", file=sys.stderr)
@@ -641,6 +796,14 @@ def main(argv=None) -> int:
             else []
         )
         serving_traces = load_serving_trace_records(args.path)
+        if args.fleet:
+            fleet = load_fleet_records(args.path)
+            if not fleet:
+                print(
+                    f"--fleet: no telemetry_p*/flightrec_p* streams under {args.path}",
+                    file=sys.stderr,
+                )
+                return 1
         if not records and not flight and not serving_traces:
             print(f"no telemetry records found under {args.path}", file=sys.stderr)
             # A successful --profile scan still renders: the run dir being
@@ -666,6 +829,8 @@ def main(argv=None) -> int:
             from ..serving.tracing import summarize_traces
 
             out["serving_traces"] = summarize_traces(serving_traces)
+        if fleet:
+            out["fleet"] = summarize_fleet(fleet)
         if profile_report is not None:
             out["profile"] = profile_report.to_dict()
         print(json.dumps(out, default=str))
@@ -675,6 +840,8 @@ def main(argv=None) -> int:
         blocks.append(format_report(summarize(records)))
     if flight:
         blocks.append(format_flight_report(summarize_flight(flight), last_n=args.last))
+    if fleet:
+        blocks.append(format_fleet_report(summarize_fleet(fleet), last_n=args.last))
     if serving_traces:
         from ..serving.tracing import format_trace_block, summarize_traces
 

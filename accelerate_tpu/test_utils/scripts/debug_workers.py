@@ -195,3 +195,74 @@ def run_local_state_dict_roundtrip(expected: int):
             np.asarray(sh.data), host_rows[start:start + np.asarray(sh.data).shape[0]]
         )
     state.wait_for_everyone()
+
+
+def check_fleet_agree(expected: int):
+    """fleet.agree over the coordinator KV service: every rank contributes a
+    value, all ranks see the rank-ordered list; two rounds under the SAME name
+    prove the lockstep sequence counters keep keys collision-free."""
+    from accelerate_tpu.resilience import fleet
+    from accelerate_tpu.state import PartialState
+
+    state = PartialState()
+    assert state.num_processes == expected
+    assert fleet.fleet_client() is not None
+
+    rank = state.process_index
+    out = fleet.agree("payload", {"rank": rank, "v": rank * 10}, timeout_s=60)
+    assert [o["rank"] for o in out] == list(range(expected)), out
+    assert [o["v"] for o in out] == [r * 10 for r in range(expected)], out
+    # Round 2, same name: a fresh key sequence, not a stale-read of round 1.
+    out2 = fleet.agree("payload", rank + 100, timeout_s=60)
+    assert out2 == [r + 100 for r in range(expected)], out2
+    fleet.barrier("fleet_agree_done", timeout_s=60)
+
+
+def check_fleet_barrier_timeout(expected: int):
+    """A barrier nobody else joins must raise FleetError within its deadline
+    instead of hanging forever — the anti-hang contract.  Rank 0 waits at a
+    barrier rank 1 skips; afterwards everyone resyncs on a joined barrier."""
+    import time as _time
+
+    from accelerate_tpu.resilience import fleet
+    from accelerate_tpu.state import PartialState
+
+    state = PartialState()
+    assert state.num_processes == expected
+
+    if state.process_index == 0:
+        t0 = _time.monotonic()
+        try:
+            fleet.barrier("lonely", timeout_s=2.0)
+        except fleet.FleetError:
+            elapsed = _time.monotonic() - t0
+            assert elapsed < 30, f"deadline not honored: {elapsed:.1f}s"
+        else:
+            raise AssertionError("barrier with an absent peer did not raise")
+    # Resync: everyone joins this one (generous window for rank 0's timeout).
+    fleet.barrier("resync", timeout_s=60.0)
+
+
+def check_drain_agreement(expected: int):
+    """Coordinated drain across real processes: ONE rank receives SIGTERM, yet
+    every rank's ``PreemptionGuard.should_stop()`` — routed through
+    ``fleet.agree`` — returns True on the same round."""
+    import os as _os
+    import signal as _signal
+
+    from accelerate_tpu.resilience import PreemptionGuard, fleet
+    from accelerate_tpu.state import PartialState
+
+    state = PartialState()
+    assert state.num_processes == expected
+
+    guard = PreemptionGuard(coordinate_every=1, agree_timeout_s=60)
+    guard.install()
+    # Round 1: nobody signaled — every rank must agree "keep going".
+    assert guard.should_stop() is False
+    fleet.barrier("pre_signal", timeout_s=60)
+    if state.process_index == expected - 1:
+        _os.kill(_os.getpid(), _signal.SIGTERM)
+    # Round 2: the one local flag must spread to every rank via the fleet.
+    assert guard.should_stop() is True
+    fleet.barrier("post_signal", timeout_s=60)

@@ -1,0 +1,472 @@
+"""Fleet runtime hardening tests: FleetSupervisor liveness/teardown/elastic
+relaunch (stub OS-process workers — fast, tier-1), fleet coordination
+primitives, heartbeat files, the rank-merged postmortem report view, and —
+under ``slow`` — the same contracts across REAL multi-process
+``jax.distributed`` clusters plus the full 4-process chaos campaign."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from accelerate_tpu.launchers import FleetSupervisor
+from accelerate_tpu.resilience import fleet
+from accelerate_tpu.telemetry.report import (
+    format_fleet_report,
+    load_fleet_records,
+    summarize_fleet,
+)
+
+
+# ---------------------------------------------------------------------------
+# Stub workers: plain OS processes (no jax import — these tests must be fast)
+# ---------------------------------------------------------------------------
+
+_SLEEP_WORKER = "import time; time.sleep(120)"
+
+_EXIT_CODE_WORKER = """
+import os, sys, time
+time.sleep(0.3)
+sys.exit(7 if os.environ["ACCELERATE_PROCESS_ID"] == "1" else 0)
+"""
+
+# Beats its heartbeat file every 0.1s; rank 0 stops beating after ~0.6s but
+# stays alive (the wedge shape: a hung process, not a dead one).
+_STALL_WORKER = """
+import json, os, time
+rank = os.environ["ACCELERATE_PROCESS_ID"]
+path = os.path.join(os.environ["ACCELERATE_TPU_HEARTBEAT_DIR"], f"heartbeat_p{rank}.json")
+t0 = time.time()
+while True:
+    if rank != "0" or time.time() - t0 < 0.6:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"t": time.time(), "pid": os.getpid()}, f)
+        os.replace(tmp, path)
+    time.sleep(0.1)
+"""
+
+_DRAIN_WORKER = """
+import signal, sys, time
+signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+time.sleep(120)
+"""
+
+# Dies (rc=1) on attempt 0 when it is the highest rank; otherwise finishes.
+_ELASTIC_WORKER = """
+import os, sys, time
+time.sleep(0.2)
+rank = int(os.environ["ACCELERATE_PROCESS_ID"])
+world = int(os.environ["ACCELERATE_NUM_PROCESSES"])
+attempt = int(os.environ["ACCELERATE_FLEET_ATTEMPT"])
+sys.exit(1 if (attempt == 0 and rank == world - 1) else 0)
+"""
+
+
+def _spawn_script(script):
+    def spawn(rank, world, env_overrides):
+        env = dict(os.environ)
+        env.update(env_overrides)
+        return subprocess.Popen(
+            [sys.executable, "-c", script],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+
+    return spawn
+
+
+def _assert_all_reaped(result):
+    for attempt in result["attempts"]:
+        assert all(rc is not None for rc in attempt["exit_codes"].values()), attempt
+
+
+def test_supervisor_reaps_dead_worker(tmp_path):
+    """First nonzero child exit -> worker_dead verdict, survivors torn down
+    within the grace bound, nothing leaked."""
+    sup = FleetSupervisor(
+        _spawn_script(_EXIT_CODE_WORKER),
+        3,
+        workdir=str(tmp_path),
+        grace_s=2.0,
+        poll_s=0.05,
+    )
+    t0 = time.monotonic()
+    result = sup.run()
+    took = time.monotonic() - t0
+    assert result["verdict"] == "worker_dead"
+    attempt = result["attempts"][0]
+    assert attempt["dead_rank"] == 1 and attempt["exit_code"] == 7
+    # Rank 1 sleeps 0.3s then exits; the sleep-120 survivors must NOT stretch
+    # the run: SIGTERM kills them instantly, well inside grace.
+    assert took < 30, took
+    assert attempt["teardown_s"] < 10, attempt
+    _assert_all_reaped(result)
+
+
+def test_supervisor_detects_heartbeat_stall(tmp_path):
+    """A worker that stops beating but never exits is detected via its stale
+    heartbeat file and the fleet is killed — no hang."""
+    sup = FleetSupervisor(
+        _spawn_script(_STALL_WORKER),
+        2,
+        workdir=str(tmp_path),
+        heartbeat_timeout_s=1.0,
+        grace_s=2.0,
+        poll_s=0.05,
+    )
+    t0 = time.monotonic()
+    result = sup.run()
+    took = time.monotonic() - t0
+    assert result["verdict"] == "wedged"
+    assert result["attempts"][0]["wedged_rank"] == 0
+    assert took < 30, took
+    _assert_all_reaped(result)
+
+
+def test_supervisor_never_beat_not_judged_by_default(tmp_path):
+    """An uninstrumented fleet (no heartbeat files at all) must NOT read as
+    wedged — liveness falls back to child-exit only."""
+    sup = FleetSupervisor(
+        _spawn_script("import sys, time; time.sleep(0.4); sys.exit(0)"),
+        2,
+        workdir=str(tmp_path),
+        heartbeat_timeout_s=0.1,  # far shorter than the worker's runtime
+        poll_s=0.05,
+    )
+    result = sup.run()
+    assert result["verdict"] == "completed"
+
+
+def test_supervisor_coordinated_drain(tmp_path):
+    """A drain signal arriving at the supervisor is forwarded to every worker,
+    and a fleet that exits cleanly within the window verdicts ``drained``."""
+    sup = FleetSupervisor(
+        _spawn_script(_DRAIN_WORKER),
+        2,
+        workdir=str(tmp_path),
+        drain_grace_s=20.0,
+        poll_s=0.05,
+    )
+    # The signal handler only installs on the main thread; inject the signal
+    # flag directly (the OS-level delivery path is exercised by the campaign).
+    threading.Timer(0.5, lambda: setattr(sup, "_drain_signum", signal.SIGTERM)).start()
+    result = sup.run()
+    assert result["verdict"] == "drained"
+    assert all(rc == 0 for rc in result["attempts"][0]["exit_codes"].values())
+
+
+def test_supervisor_drain_timeout_bounded(tmp_path):
+    """Workers that ignore the drain signal are killed at drain_grace_s —
+    a drain can never hang the supervisor."""
+    sup = FleetSupervisor(
+        _spawn_script(_SLEEP_WORKER),  # ignores SIGTERM by sleeping forever? no:
+        2,
+        workdir=str(tmp_path),
+        drain_grace_s=1.0,
+        grace_s=1.0,
+        poll_s=0.05,
+    )
+    # sleep() IS interrupted by SIGTERM's default handler -> use a worker that
+    # traps and ignores it instead.
+    sup.spawn = _spawn_script(
+        "import signal, time\n"
+        "signal.signal(signal.SIGTERM, lambda *_: None)\n"
+        "time.sleep(120)\n"
+    )
+    threading.Timer(0.3, lambda: setattr(sup, "_drain_signum", signal.SIGTERM)).start()
+    t0 = time.monotonic()
+    result = sup.run()
+    assert result["verdict"] == "drain_timeout"
+    assert time.monotonic() - t0 < 30
+    _assert_all_reaped(result)
+
+
+def test_supervisor_elastic_relaunch(tmp_path):
+    """elastic=True: a dead worker triggers one relaunch at world-1, which
+    completes; attempts and final world size are recorded."""
+    sup = FleetSupervisor(
+        _spawn_script(_ELASTIC_WORKER),
+        3,
+        workdir=str(tmp_path),
+        grace_s=2.0,
+        poll_s=0.05,
+        elastic=True,
+        min_processes=2,
+    )
+    result = sup.run()
+    assert result["verdict"] == "completed"
+    assert result["world_size"] == 2
+    assert [a["verdict"] for a in result["attempts"]] == ["worker_dead", "completed"]
+    assert result["attempts"][0]["dead_rank"] == 2
+    # Each attempt got its own coordinator port + attempt index.
+    assert result["attempts"][1]["attempt"] == 1
+
+
+def test_supervisor_elastic_respects_min_processes(tmp_path):
+    """Below min_processes there is no relaunch — the failure is final."""
+    sup = FleetSupervisor(
+        _spawn_script("import sys, time; time.sleep(0.2); sys.exit(3)"),
+        2,
+        workdir=str(tmp_path),
+        grace_s=1.0,
+        poll_s=0.05,
+        elastic=True,
+        min_processes=2,
+    )
+    result = sup.run()
+    assert result["verdict"] == "worker_dead"
+    assert len(result["attempts"]) == 1
+
+
+def test_supervisor_postmortem_merges_all_ranks(tmp_path):
+    """On failure the supervisor merges every rank's telemetry/flightrec
+    stream into one rank-tagged postmortem JSON."""
+    tdir = tmp_path / "telemetry"
+    tdir.mkdir()
+    (tdir / "telemetry_p0.jsonl").write_text(
+        json.dumps({"kind": "event", "event": "step", "t": 1.0, "step": 4}) + "\n"
+    )
+    (tdir / "flightrec_p1.jsonl").write_text(
+        json.dumps({"kind": "crash", "t": 2.0, "proc": 1, "error": "boom"}) + "\n"
+    )
+    sup = FleetSupervisor(
+        _spawn_script("import sys, time; time.sleep(0.2); sys.exit(9)"),
+        2,
+        workdir=str(tmp_path),
+        grace_s=1.0,
+        poll_s=0.05,
+        telemetry_dir=str(tdir),
+    )
+    result = sup.run()
+    assert result["verdict"] == "worker_dead"
+    path = result["postmortem"]
+    assert path and os.path.exists(path)
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["cause"] == "worker_dead"
+    assert doc["fleet"]["n_ranks"] == 2
+    assert set(doc["fleet"]["ranks"]) == {"0", "1"}
+
+
+# ---------------------------------------------------------------------------
+# fleet.py primitives (single-process semantics + helpers)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_noop_without_cluster():
+    """Outside a jax.distributed cluster the primitives degrade to local
+    no-ops: barrier returns, agree echoes the local value."""
+    assert fleet.fleet_client() is None
+    fleet.barrier("solo")  # must not raise or hang
+    assert fleet.agree("solo", {"x": 1}) == [{"x": 1}]
+
+
+def test_fleet_key_sequencing():
+    """Repeated rounds under one name get distinct, monotonically numbered
+    coordination keys (lockstep across ranks by call count)."""
+    a = fleet._next_key("barrier", "round")
+    b = fleet._next_key("barrier", "round")
+    c = fleet._next_key("agree", "round")
+    assert a != b and a.rsplit("/", 1)[0] == b.rsplit("/", 1)[0]
+    assert int(b.rsplit("/", 1)[1]) == int(a.rsplit("/", 1)[1]) + 1
+    assert c.startswith("fleet/agree/")
+
+
+def test_heartbeat_roundtrip(tmp_path):
+    hb = fleet.Heartbeat(fleet.heartbeat_path(str(tmp_path), 3))
+    hb.beat(step=17)
+    payload = fleet.read_heartbeat(fleet.heartbeat_path(str(tmp_path), 3))
+    assert payload["step"] == 17 and payload["pid"] == os.getpid()
+    hb.beat(step=18)
+    assert fleet.read_heartbeat(fleet.heartbeat_path(str(tmp_path), 3))["step"] == 18
+
+
+def test_maybe_beat_noop_without_env(monkeypatch):
+    monkeypatch.delenv(fleet.ENV_HEARTBEAT_DIR, raising=False)
+    fleet.maybe_beat(step=1)  # must be a cheap no-op, not an error
+
+
+def test_maybe_beat_writes_under_env(tmp_path, monkeypatch):
+    monkeypatch.setenv(fleet.ENV_HEARTBEAT_DIR, str(tmp_path))
+    monkeypatch.setenv("ACCELERATE_PROCESS_ID", "0")
+    fleet._reset_heartbeat_singleton()
+    try:
+        fleet.maybe_beat(step=5)
+        payload = fleet.read_heartbeat(fleet.heartbeat_path(str(tmp_path), 0))
+        assert payload["step"] == 5
+    finally:
+        fleet._reset_heartbeat_singleton()
+
+
+def test_connect_retry_policy_env(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_TPU_COORDINATOR_CONNECT_TRIES", "5")
+    policy = fleet.connect_retry_policy()
+    assert policy.tries == 5
+    # Config errors must NOT be retried (retrying a bad address is pure delay).
+    assert not policy.retryable(ValueError("bad address"))
+    assert policy.retryable(RuntimeError("connection refused"))
+
+
+# ---------------------------------------------------------------------------
+# telemetry.report fleet view
+# ---------------------------------------------------------------------------
+
+
+def _write_fleet_dir(tmp_path):
+    (tmp_path / "telemetry_p0.jsonl").write_text(
+        "\n".join(
+            json.dumps(r)
+            for r in [
+                {"kind": "event", "event": "step", "t": 10.0, "step": 1},
+                {"kind": "event", "event": "step", "t": 30.0, "step": 3},
+            ]
+        )
+        + "\n"
+    )
+    (tmp_path / "telemetry_p1.jsonl").write_text(
+        json.dumps({"kind": "event", "event": "step", "t": 11.0, "step": 1}) + "\n"
+    )
+    (tmp_path / "flightrec_p1.jsonl").write_text(
+        json.dumps({"kind": "crash", "t": 12.0, "proc": 1, "error": "sigkill"}) + "\n"
+    )
+
+
+def test_fleet_report_merges_ranks(tmp_path):
+    _write_fleet_dir(tmp_path)
+    by_proc = load_fleet_records(str(tmp_path))
+    assert set(by_proc) == {0, 1}
+    assert {r["source"] for r in by_proc[1]} == {"telemetry", "flightrec"}
+
+    summary = summarize_fleet(by_proc)
+    assert summary["n_ranks"] == 2
+    # Rank 1's last sign of life (t=12) predates rank 0's (t=30): rank 1 is
+    # the first-silent suspect.
+    assert summary["first_silent_rank"] == 1
+    assert summary["ranks"]["1"]["crashes"] == 1
+    timeline = summary["timeline"]
+    assert [e["t"] for e in timeline] == sorted(e["t"] for e in timeline)
+    assert {e["proc"] for e in timeline} == {0, 1}
+
+    text = format_fleet_report(summary)
+    assert "first silent" in text and "rank 1" in text
+
+
+def test_fleet_report_cli(tmp_path):
+    _write_fleet_dir(tmp_path)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(
+        [
+            sys.executable, "-m", "accelerate_tpu.telemetry.report",
+            str(tmp_path), "--fleet", "--json",
+        ],
+        capture_output=True, text=True, timeout=120, cwd="/root/repo", env=env,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    out = json.loads(res.stdout)
+    assert out["fleet"]["n_ranks"] == 2
+    assert out["fleet"]["first_silent_rank"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Launcher crash-path flight-recorder flush
+# ---------------------------------------------------------------------------
+
+
+def test_notebook_launcher_flushes_flightrec_on_crash(tmp_path):
+    """A worker exception must flush the flight recorder (crash record with
+    the traceback) BEFORE the error propagates — the forensic trail of a
+    failed launch may be all that's left of it."""
+    from accelerate_tpu import launchers
+    from accelerate_tpu.telemetry import flightrec, core as telemetry
+
+    flightrec.enable(dir=str(tmp_path), flush_every=10_000)
+    try:
+        with pytest.raises(RuntimeError, match="boom"):
+            launchers.notebook_launcher(
+                lambda: (_ for _ in ()).throw(RuntimeError("boom")),
+                num_processes=1,
+                max_restarts=0,
+            )
+        rec = flightrec.get_flight_recorder()
+        with open(rec.jsonl_path) as f:
+            records = [json.loads(line) for line in f if line.strip()]
+        crashes = [r for r in records if r.get("kind") == "crash"]
+        assert crashes, "no crash record flushed"
+        assert "boom" in crashes[-1]["error"]
+        assert crashes[-1]["origin"].startswith("notebook_launcher")
+    finally:
+        flightrec.disable()
+        # disable() flushes but keeps the ring; clear it so the
+        # disabled-by-default assertions in test_flightrec (which runs next
+        # alphabetically) see an empty recorder.
+        flightrec.get_flight_recorder()._ring.clear()
+        telemetry.disable()
+        telemetry.get_telemetry().registry.reset()
+        telemetry.get_telemetry().step_timer.reset()
+
+
+# ---------------------------------------------------------------------------
+# Real multi-process clusters (slow tier)
+# ---------------------------------------------------------------------------
+
+
+def _run_cluster_worker(worker: str, token: str, timeout: int = 300, nproc: int = 2):
+    code = (
+        "from accelerate_tpu.launchers import debug_launcher;"
+        f"from accelerate_tpu.test_utils.scripts.debug_workers import {worker};"
+        f"debug_launcher({worker}, args=({nproc},), num_processes={nproc});"
+        f"print('{token}')"
+    )
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=timeout, cwd="/root/repo", env=env,
+    )
+    assert res.returncode == 0, res.stderr[-3000:]
+    assert token in res.stdout
+
+
+@pytest.mark.slow
+def test_fleet_agree_on_real_cluster():
+    """fleet.agree round-trips rank-ordered values over a real 2-process
+    coordinator, twice under the same name (sequence-counter isolation)."""
+    _run_cluster_worker("check_fleet_agree", "FLEET_AGREE_OK", timeout=180)
+
+
+@pytest.mark.slow
+def test_fleet_barrier_timeout_on_real_cluster():
+    """A barrier with an absent peer raises FleetError within its deadline on
+    a real cluster — survivors of a dead rank never hang."""
+    _run_cluster_worker("check_fleet_barrier_timeout", "BARRIER_TIMEOUT_OK", timeout=180)
+
+
+@pytest.mark.slow
+def test_drain_agreement_on_real_cluster():
+    """SIGTERM on ONE rank -> PreemptionGuard.should_stop() True on EVERY
+    rank, through the fleet.agree coordinator path."""
+    _run_cluster_worker("check_drain_agreement", "DRAIN_AGREE_OK", timeout=180)
+
+
+@pytest.mark.slow
+def test_fleet_chaos_campaign():
+    """The full 4-process campaign: SIGKILL, coordinated drain, wedge,
+    elastic 4->3 restart with a bit-identical resume digest."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.resilience.chaos", "--mode", "fleet"],
+        capture_output=True, text=True, timeout=900, cwd="/root/repo", env=env,
+    )
+    assert res.returncode == 0, (res.stdout[-2000:], res.stderr[-4000:])
+    assert "fleet-chaos-smoke OK" in res.stdout

@@ -2,6 +2,7 @@
 
 import jax
 import numpy as np
+import pytest
 
 from accelerate_tpu import AcceleratorState, ParallelismConfig
 from accelerate_tpu.models import llama
@@ -50,6 +51,7 @@ def test_llama_sp_padded_batch_matches_dense():
         PartialState._reset_state()
 
 
+@pytest.mark.slow  # ~15s; tier-1 budget rebalance (PR 18) — llama SP parity stays tier-1
 def test_gpt2_sp_loss_matches_dense():
     """GPT-2 under an sp mesh routes through the shared ring/ulysses
     attention — loss parity vs the dense [S, S]-mask path, padded batch
@@ -133,6 +135,7 @@ def test_bert_sp_outputs_match_dense():
     PartialState._reset_state()
 
 
+@pytest.mark.slow  # ~14s; tier-1 budget rebalance (PR 18) — kernel numerics stay tier-1 in test_pallas_attention
 def test_sp_pallas_selection_policy(monkeypatch):
     """Pin the dispatch rules: explicit attention_impl='pallas' always takes
     the fused path; 'auto' requires a TPU backend; padded (kv_valid) batches

@@ -23,6 +23,10 @@ _EMIT_RE = re.compile(
 _INDIRECT_EVENT_RE = re.compile(
     r"record\(\s*\"event\",\s*name=\"([^\"]+)\"|\"name\":\s*\"([^\"]+)\"", re.S
 )
+# Best-effort emit helpers (FleetSupervisor runs with telemetry possibly
+# disabled, so its sites go through _note_event/_inc_counter wrappers).
+_HELPER_EVENT_RE = re.compile(r"_note_event\(\s*\n?\s*\"([^\"]+)\"", re.S)
+_HELPER_COUNTER_RE = re.compile(r"_inc_counter\(\s*\"([^\"]+)\"", re.S)
 
 _KIND_SETS = {
     "counter": names.COUNTERS,
@@ -49,6 +53,10 @@ def _scan_sources():
             # in unrelated JSON literals are not event emissions.
             if name and "." in name and re.fullmatch(r"[a-z0-9_.]+", name):
                 literal["event"].add(name)
+        for m in _HELPER_EVENT_RE.finditer(text):
+            literal["event"].add(m.group(1))
+        for m in _HELPER_COUNTER_RE.finditer(text):
+            literal["counter"].add(m.group(1))
     return literal, dynamic
 
 

@@ -24,6 +24,7 @@ def test_gpt2_forward_and_causality():
     assert not np.allclose(np.asarray(logits[0, 15]), np.asarray(l2[0, 15]))
 
 
+@pytest.mark.slow  # ~17s; tier-1 budget rebalance (PR 18) — forward/causality stays tier-1
 def test_gpt2_trains():
     cfg = gpt2.GPT2Config.tiny()
     params = gpt2.init_params(cfg, jax.random.key(0))
@@ -92,6 +93,7 @@ def test_t5_forward_shapes_and_decoder_causality():
     assert not np.allclose(np.asarray(logits[0]), np.asarray(l3[0]))
 
 
+@pytest.mark.slow  # ~14s; tier-1 budget rebalance (PR 18) — forward-shapes test stays tier-1
 def test_t5_trains():
     cfg = t5.T5Config.tiny()
     params = t5.init_params(cfg, jax.random.key(0))

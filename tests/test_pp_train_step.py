@@ -132,6 +132,7 @@ def test_fused_pp_bit_exact_vs_eager(accum, clip_norm):
         np.testing.assert_array_equal(a, b)
 
 
+@pytest.mark.slow  # ~30s; tier-1 budget rebalance (PR 18) — `make test` runs it
 def test_fused_pp_save_load_bit_exact_continuation(tmp_path):
     """save_state/load_state round-trips through the fused pp step: a
     restored run replays the remaining steps bit-exactly."""
