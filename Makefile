@@ -1,10 +1,10 @@
 # Test-suite splits mirroring the reference Makefile:25-77.
 
-.PHONY: test test-quick test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels bench telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke pp-smoke profile-smoke serving-smoke serving-trace-smoke elastic-smoke chaos-smoke serving-chaos-smoke fleet-chaos-smoke goodput-smoke memory-smoke perf-gate
+.PHONY: test test-quick test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels bench telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke pp-smoke profile-smoke serving-smoke spec-smoke serving-trace-smoke elastic-smoke chaos-smoke serving-chaos-smoke fleet-chaos-smoke goodput-smoke memory-smoke perf-gate
 
 PYTEST = python -m pytest -q
 
-test: test-quick telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke pp-smoke profile-smoke serving-smoke serving-trace-smoke elastic-smoke chaos-smoke serving-chaos-smoke fleet-chaos-smoke goodput-smoke memory-smoke perf-gate
+test: test-quick telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke pp-smoke profile-smoke serving-smoke spec-smoke serving-trace-smoke elastic-smoke chaos-smoke serving-chaos-smoke fleet-chaos-smoke goodput-smoke memory-smoke perf-gate
 	$(PYTEST) tests/
 
 # <5 min tier (VERDICT r5 item 6): oracles, state, sharding-spec/mesh,
@@ -114,6 +114,19 @@ profile-smoke:
 # telemetry report (docs/usage_guides/serving.md).
 serving-smoke:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.serving.smoke
+
+# Speculative-decode proof on an 8-device CPU mesh: pattern-heavy and random
+# prompts through a spec_tokens=3 engine (draft-then-verify inside the fused
+# decode dispatch) must stay token-identical to the offline generate_loop,
+# land acceptance_rate > 0 with > 1 token per slot-dispatch, keep every
+# decode tick on the ONE fixed k+1 window program per bucket (spec.rounds ==
+# decode dispatches), and leave the KV pool fully free after drain
+# (docs/usage_guides/serving.md, "Speculative decoding").  One loud bounded
+# retry via smoke_retry (subprocess XLA-CPU workload, same flake class as
+# resilience-smoke).
+spec-smoke:
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.resilience.smoke_retry \
+	  --label spec-smoke -- python -m accelerate_tpu.serving.spec_smoke
 
 # Per-request trace proof: a forced-slow request mix (injected queue delay +
 # injected preemption) must be blamed on the right phase by the trace

@@ -17,6 +17,7 @@ __all__ = [
     "generate_loop", "select_token", "make_kv_cache", "check_cache_room",
     "quantize_kv", "dequantize_kv", "pack_cache_for_scan",
     "unpack_cache_from_scan", "cache_write", "speculative_generate_loop",
+    "speculative_verify_greedy",
     "make_paged_pool", "gather_block_view", "extract_token_rows",
     "scatter_token_rows", "paged_cache_write", "pack_paged_pool_for_scan",
     "unpack_paged_rows_from_scan",
@@ -398,6 +399,44 @@ def generate_loop(
     return jnp.concatenate([input_ids, generated], axis=1)
 
 
+def speculative_verify_greedy(
+    t_logits: jax.Array,
+    drafts: jax.Array,
+    draft_len: Optional[jax.Array] = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Per-row greedy verify/accept for draft-then-verify decoding — the
+    accept kernel shared by the offline :func:`speculative_generate_loop`
+    and the serving engine's in-dispatch verify (``serving/engine.py``).
+
+    ``t_logits`` ``[B, γ+1, V]`` are the target's logits over the verify
+    window (row ``j`` is the distribution AFTER consuming window token
+    ``j``); ``drafts`` ``[B, γ]`` are the draft tokens fed at window
+    positions ``1..γ``.  Returns ``(t, m)``: ``t`` ``[B, γ+1]`` the target
+    argmax at every window position and ``m`` ``[B]`` the per-row accepted
+    count — draft ``j`` is accepted iff it equals the target argmax at
+    position ``j-1`` and every earlier draft was accepted.  The emitted
+    chunk for row ``b`` is exactly ``t[b, :m[b]+1]``: accepted drafts equal
+    the argmax rows they matched, and position ``m`` is the correction (on
+    mismatch) or bonus (on full accept) token — which is what makes
+    draft-then-verify token-identical to greedy decoding with the target
+    alone.
+
+    ``draft_len`` ``[B]`` (optional) masks per-row ragged proposals: draft
+    positions at or beyond ``draft_len[b]`` can never be accepted.  This is
+    the serving form — a static ``γ`` window carrying variable-length
+    n-gram proposals per slot, mixed acceptance across rows in one dispatch.
+    """
+    gamma = drafts.shape[1]
+    t = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [B, γ+1]
+    accept = t[:, :gamma] == drafts
+    if draft_len is not None:
+        accept = accept & (
+            jnp.arange(gamma, dtype=jnp.int32)[None, :] < draft_len[:, None]
+        )
+    m = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+    return t, m
+
+
 def speculative_generate_loop(
     apply_cached: Callable,
     init_cache: Callable,
@@ -449,10 +488,17 @@ def speculative_generate_loop(
     count is ≥ 1), and the families' position-based causal mask hides
     anything beyond ``index``.
 
-    Batch 1 only (speculative decoding is a latency optimization; rows with
-    different accept counts would need per-row cache indices).  ``top_k`` /
-    ``top_p`` are not supported here — filtering changes both distributions
-    and the residual algebra; use ``generate_loop`` for filtered sampling.
+    This *offline loop* is batch-1 only: the dense bundled cache carries a
+    single shared ``index``, so rows with different accept counts would
+    need per-row cache indices.  That is a limitation of this loop's cache
+    layout, **not** of speculative decoding — the serving engine runs the
+    per-slot form (``ServingConfig.spec_tokens``) where paged block tables
+    already carry per-slot lengths, so one fused dispatch verifies every
+    slot's window with per-slot variable acceptance (the accept kernel,
+    :func:`speculative_verify_greedy`, is shared with this loop).  ``top_k``
+    / ``top_p`` are not supported here — filtering changes both
+    distributions and the residual algebra; use ``generate_loop`` for
+    filtered sampling.
 
     ``return_stats=True`` additionally returns ``{"rounds", "proposed",
     "accepted"}`` (int32 scalars): ``accepted / proposed`` is the draft
@@ -575,9 +621,10 @@ def speculative_generate_loop(
         else:
             # Greedy acceptance: d_j must equal the target argmax; the fill
             # column is the target argmax itself (correction or bonus).
-            t = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [B, γ+1]
-            accept = (t[:, :gamma] == d).astype(jnp.int32)
-            m = jnp.sum(jnp.cumprod(accept, axis=1), axis=1)[0]  # scalar; b == 1
+            # Shared per-row kernel with the serving engine's in-dispatch
+            # verify — see speculative_verify_greedy.
+            t, m_rows = speculative_verify_greedy(t_logits, d)
+            m = m_rows[0]  # scalar; b == 1
             fill_col = t
 
         # The accepted chunk is [d_1..d_m, fill] — count = m+1, uniformly.

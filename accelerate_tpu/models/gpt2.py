@@ -364,9 +364,13 @@ def apply_paged(
     starts[b]+T-1``; attention consumes pool K/V through the block tables
     ``tables [B, M]`` (``paged_cache_write``) and the freshly written rows
     come back as ``{leaf: [B, L, T, ...]}`` for the caller to scatter into
-    the pool.  ``kernel=True`` routes single-token fp decode through the
-    Pallas paged-attention kernel (``ops/pallas_attention.py``); everything
-    else takes the always-correct XLA path."""
+    the pool.  ``kernel=True`` routes fp decode through the Pallas
+    paged-attention kernels (``ops/pallas_attention.py``): the single-token
+    kernel at ``T == 1`` and the multi-token window kernel at ``T > 1`` (the
+    speculative verify dispatch, where the T queries form a causal window at
+    the cache tail — exactly this function's position/mask contract);
+    int8 pools take the always-correct XLA path.  Prefill never passes
+    ``kernel=True``."""
     from .generation import (
         pack_paged_pool_for_scan,
         paged_cache_write,
@@ -387,7 +391,7 @@ def apply_paged(
     x = _embed_lookup(params["wte"], input_ids, c.dtype) + params["wpe"].astype(c.dtype)[positions]
     k_pos = jnp.arange(total, dtype=jnp.int32)
     mask = positions[:, :, None] >= k_pos[None, None, :]  # [B, T, M*bs]
-    use_kernel = kernel and not quant and t == 1
+    use_kernel = kernel and not quant
     if use_kernel:
         from ..ops.pallas_attention import pallas_available
 
@@ -403,13 +407,21 @@ def apply_paged(
         x = carry
         q, k, v = _qkv(x, lp, c)
         if use_kernel:
-            from ..ops.pallas_attention import pallas_paged_attention
+            from ..ops.pallas_attention import (
+                pallas_paged_attention,
+                pallas_paged_window_attention,
+            )
 
             k_store = k.astype(pk.dtype)
             v_store = v.astype(pv.dtype)
-            attn = pallas_paged_attention(
-                q[:, 0], k_store[:, 0], v_store[:, 0], pk, pv, tables, starts
-            )[:, None].reshape(b, t, c.hidden_size)
+            if t == 1:
+                attn = pallas_paged_attention(
+                    q[:, 0], k_store[:, 0], v_store[:, 0], pk, pv, tables, starts
+                )[:, None].reshape(b, t, c.hidden_size)
+            else:
+                attn = pallas_paged_window_attention(
+                    q, k_store, v_store, pk, pv, tables, starts
+                ).reshape(b, t, c.hidden_size)
         else:
             k_store, k_full = paged_cache_write(pk, k, tables, starts, c.dtype)
             v_store, v_full = paged_cache_write(pv, v, tables, starts, c.dtype)

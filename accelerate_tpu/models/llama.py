@@ -838,8 +838,10 @@ def apply_paged(
     starts[b]+T-1`` (RoPE is position-exact per slot); attention consumes
     pool K/V through the block tables via ``paged_cache_write`` and the
     written rows return as ``{leaf: [B, L, T, ...]}`` for the caller's
-    scatter.  ``kernel=True`` routes single-token fp decode through the
-    Pallas paged-attention kernel."""
+    scatter.  ``kernel=True`` routes fp decode through the Pallas
+    paged-attention kernels: single-token at ``T == 1``, the multi-token
+    window variant at ``T > 1`` (the speculative verify dispatch; GQA folds
+    into the kernel's grouped layout); int8 pools stay on the XLA path."""
     from .generation import (
         pack_paged_pool_for_scan,
         paged_cache_write,
@@ -856,7 +858,7 @@ def apply_paged(
     x = embed_tokens(params, input_ids, c)
     k_pos = jnp.arange(total, dtype=jnp.int32)
     mask = positions[:, :, None] >= k_pos[None, None, :]  # [B, T, M*bs]
-    use_kernel = kernel and not quant and t == 1
+    use_kernel = kernel and not quant
     if use_kernel:
         from ..ops.pallas_attention import pallas_available
 
@@ -874,13 +876,21 @@ def apply_paged(
         q, k, v = _qkv_proj(h, lp, c, b, t)
         q, k = _rope(q, k, positions, c.rope_theta, getattr(c, "rope_scaling", None))
         if use_kernel:
-            from ..ops.pallas_attention import pallas_paged_attention
+            from ..ops.pallas_attention import (
+                pallas_paged_attention,
+                pallas_paged_window_attention,
+            )
 
             k_store = k.astype(pk.dtype)
             v_store = v.astype(pv.dtype)
-            attn = pallas_paged_attention(
-                q[:, 0], k_store[:, 0], v_store[:, 0], pk, pv, tables, starts
-            )[:, None]
+            if t == 1:
+                attn = pallas_paged_attention(
+                    q[:, 0], k_store[:, 0], v_store[:, 0], pk, pv, tables, starts
+                )[:, None]
+            else:
+                attn = pallas_paged_window_attention(
+                    q, k_store, v_store, pk, pv, tables, starts
+                )
         else:
             k_store, k_full = paged_cache_write(pk, k, tables, starts, c.dtype)
             v_store, v_full = paged_cache_write(pv, v, tables, starts, c.dtype)

@@ -77,6 +77,12 @@ under a ``perf_gate.bloat`` owner — the knob that proves the **memory row**
 arithmetic, not allocator stats, so CI load cannot flake it) actually judges
 the footprint.  A change that silently doubles optimizer state or fattens
 the KV pool fails in tier-1, not on the next real-model TPU run.
+``=no-spec`` runs the **spec row**'s speculative arm with ``spec_tokens=0``
+— plain greedy masquerading as the speculative config.  The
+``serving_spec_active`` tripwire must catch it: the measured ITL ratio stays
+near 1.0 (often ABOVE the 0.9 floor, since greedy-vs-greedy is noise), which
+is exactly why the integer tripwires, not the ratio floor, carry exactness
+(PR 19: the floor only guards a pathological verify-window slowdown).
 """
 
 from __future__ import annotations
@@ -91,6 +97,7 @@ from typing import Optional
 
 __all__ = [
     "load_baseline", "run_probe", "run_pp_probe", "run_serving_probe",
+    "run_spec_probe",
     "evaluate", "run_gate", "main",
 ]
 
@@ -311,6 +318,103 @@ def run_serving_probe(decode_ticks: int = 25, degrade: Optional[str] = None) -> 
     }
 
 
+def run_spec_probe(degrade: Optional[str] = None, max_new: int = 60) -> dict:
+    """The serving-spec row's measurement: speculative draft-then-verify vs
+    plain greedy decode inter-token latency on a bounded CPU engine pair at
+    IDENTICAL geometry (gpt2-tiny, same prompts, same budgets, paged path
+    both sides — only ``spec_tokens`` differs).
+
+    The prompts carry a repeated pattern so the default n-gram drafter
+    actually hits (the workload speculative serving targets: templated /
+    repetitive traffic), and random tiny-model greedy decode promptly falls
+    into repetition loops of its own — everything is deterministic per seed,
+    so the measured acceptance rate is CI-stable.  Each arm first runs a
+    warm-up request end to end (same geometry) so every bucket's program is
+    jit-cached before the timed batch; mean inter-token latency then comes
+    from the completed requests' own SLO samples.  Judged invariants:
+    ``serving_spec_active`` (acceptance > 0 AND tokens/dispatch > 1 — the
+    silent-fallback tripwire), per-request token identity vs the greedy
+    arm, and the spec-vs-greedy ITL ratio over the committed floor.
+    ``degrade="no-spec"`` builds the spec arm with ``spec_tokens=0`` — the
+    self-test that this row actually judges speculative decode."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import gpt2
+    from ..serving import ServingConfig, ServingEngine
+
+    if degrade is None:
+        degrade = os.environ.get(ENV_DEGRADE, "").strip().lower() or None
+    cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32)
+    params = gpt2.init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(13)
+    pattern = [int(t) for t in rng.integers(0, cfg.vocab_size, size=8)]
+    # Pure pattern repeats at staggered phases: the trailing n-gram recurs
+    # from the very first decode tick, so the drafter contributes over the
+    # whole run rather than only after the model falls into its own loop.
+    prompts = [pattern * 2 + pattern[:j] for j in (0, 2, 4, 6)]
+    max_new = int(max_new)  # 60 for the gated row; self-tests run shorter
+
+    def arm(spec_tokens):
+        eng = ServingEngine(
+            gpt2.apply_cached, gpt2.init_cache, params, cfg,
+            serving=ServingConfig(
+                block_size=8, num_blocks=80, max_slots=4, prefill_chunk=8,
+                max_blocks_per_seq=16, prefix_cache=False,
+                spec_tokens=spec_tokens,
+            ),
+        )
+        # Warm every bucket's program (prefill, decode, verify) outside the
+        # timed window — same prompt shape as the timed batch.
+        eng.submit(list(prompts[0]), max_new)
+        eng.run()
+        rids = [eng.submit(list(p), max_new) for p in prompts]
+        t0 = time.perf_counter()
+        outs = eng.run()
+        wall = time.perf_counter() - t0
+        itl = [
+            ms
+            for r in eng.pop_finished()
+            if r.id in set(rids)
+            for ms in r.inter_token_ms
+        ]
+        stats = eng.stats()
+        itl_sorted = sorted(itl)
+        return {
+            "outputs": [outs[r] for r in rids],
+            "itl_ms": sum(itl) / max(len(itl), 1),
+            "itl_p95_ms": (
+                itl_sorted[min(int(len(itl_sorted) * 0.95), len(itl_sorted) - 1)]
+                if itl_sorted else 0.0
+            ),
+            "wall_s": wall,
+            "spec": stats["spec"],
+        }
+
+    arm(0)  # discarded: process-level warm-up (first arm pays one-time
+    # costs no per-engine warm request covers; measured ~1.4x ITL skew
+    # between two IDENTICAL greedy arms without this)
+    greedy = arm(0)
+    spec = arm(0 if degrade == "no-spec" else 3)
+    acceptance = spec["spec"]["acceptance_rate"]
+    tokens_per_dispatch = spec["spec"]["tokens_per_dispatch"]
+    return {
+        "serving_greedy_itl_ms": round(greedy["itl_ms"], 3),
+        "serving_spec_itl_ms": round(spec["itl_ms"], 3),
+        "serving_greedy_itl_p95_ms": round(greedy["itl_p95_ms"], 3),
+        "serving_spec_itl_p95_ms": round(spec["itl_p95_ms"], 3),
+        "serving_spec_vs_greedy_itl_ratio": round(
+            greedy["itl_ms"] / max(spec["itl_ms"], 1e-9), 3
+        ),
+        "serving_spec_acceptance_rate": acceptance,
+        "serving_spec_tokens_per_dispatch": tokens_per_dispatch,
+        "serving_spec_active": bool(acceptance > 0 and tokens_per_dispatch > 1),
+        "serving_spec_token_identical": spec["outputs"] == greedy["outputs"],
+    }
+
+
 def run_probe(
     accum: int = 2,
     steps: int = 10,
@@ -524,6 +628,9 @@ def run_probe(
         serving_row = None
         if serving:
             serving_row = run_serving_probe(degrade=degrade)
+            # spec row: speculative vs greedy decode on the same engine
+            # geometry (one more paired probe; rides the serving flag).
+            serving_row.update(run_spec_probe(degrade=degrade))
 
         # goodput row: one fused epoch (compiles warmed OUTSIDE the window)
         # through the wall-clock attribution ledger — the productive fraction
@@ -859,6 +966,35 @@ def evaluate(measurements: dict, baseline: dict) -> list:
                 f"{measurements['serving_paged_vs_dense_ratio']:.3f} < baseline min "
                 f"{min_serving_ratio} — the serving decode fast path lost its "
                 "win over the dense gather-view program"
+            )
+    # spec row: judged only when the arm ran.  A speculative config that
+    # silently decodes greedily (drafter never fires, verify program lost),
+    # an accept/rewind bug that diverges from greedy, or a verify dispatch
+    # slower per token than the single-token program it replaces are exactly
+    # the regressions this row exists to catch.
+    if "serving_spec_vs_greedy_itl_ratio" in measurements:
+        if baseline.get("require_spec_active"):
+            if not measurements.get("serving_spec_active"):
+                failures.append(
+                    "serving_spec_active is False — speculative decode "
+                    "silently fell back to plain greedy (no drafts accepted "
+                    "or no multi-token dispatches landed)"
+                )
+            if measurements.get("serving_spec_token_identical") is False:
+                failures.append(
+                    "speculative serving outputs diverged from the greedy "
+                    "arm — the per-slot accept/rewind contract is broken"
+                )
+        min_spec_ratio = baseline.get("min_spec_vs_greedy_itl_ratio")
+        if (
+            min_spec_ratio is not None
+            and measurements["serving_spec_vs_greedy_itl_ratio"] < min_spec_ratio
+        ):
+            failures.append(
+                f"spec-vs-greedy inter-token latency ratio "
+                f"{measurements['serving_spec_vs_greedy_itl_ratio']:.3f} < baseline "
+                f"min {min_spec_ratio} — draft-then-verify stopped beating "
+                "one-token-per-dispatch greedy decode"
             )
     return failures
 

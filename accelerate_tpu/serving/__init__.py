@@ -30,6 +30,7 @@ in ``docs/package_reference/serving_tracing.md``.
 """
 
 from .blocks import BlockAllocator, BlockOutOfMemory, PagedKVCache, PrefixCache
+from .drafter import DraftModelDrafter, NgramDrafter
 from .engine import (
     AdmissionRejected,
     CompletedRequest,
@@ -54,7 +55,9 @@ __all__ = [
     "PagedKVCache",
     "PrefixCache",
     "CompletedRequest",
+    "DraftModelDrafter",
     "JournalError",
+    "NgramDrafter",
     "Request",
     "RequestState",
     "RequestTrace",

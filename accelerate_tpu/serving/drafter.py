@@ -1,0 +1,149 @@
+"""Draft proposers for speculative serving decode (``ServingConfig.spec_tokens``).
+
+The engine's draft-then-verify tick needs up to ``k`` candidate next tokens
+per slot BEFORE the fused verify dispatch (see ``serving/engine.py``).
+Correctness never depends on the drafts: the target model verifies every
+window position in-dispatch and greedy acceptance keeps outputs
+token-identical to greedy decoding with the target alone.  Draft quality
+only moves the *acceptance rate*, i.e. how many tokens each dispatch lands.
+
+Two built-ins behind one duck-typed interface —
+``propose(feed: Sequence[int], k: int) -> list[int]`` returns up to ``k``
+candidate continuations of ``feed`` (prompt + everything emitted so far),
+possibly fewer, possibly empty (empty ⇒ the slot contributes no drafts and
+the tick degrades gracefully toward plain greedy):
+
+- :class:`NgramDrafter` (the default) — prompt-lookup / n-gram drafting:
+  match the feed's trailing n-gram against its own earlier occurrences and
+  propose the continuation that followed last time.  Pure host-side list
+  scanning — no second model to shard, no extra device dispatch — and it
+  targets exactly the workloads speculative serving is for (templated,
+  retrieval-augmented, and code traffic re-emits its own substrings; so do
+  the repetition loops greedy decoding itself falls into).
+- :class:`DraftModelDrafter` — a small draft model proposes greedily via
+  bucketed full forwards (jit-cached per power-of-two bucket).  The
+  draft-model option behind the same interface; a production deployment
+  would route the draft model through its own cached engine, but the
+  interface — and everything downstream of it — is identical.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["NgramDrafter", "DraftModelDrafter"]
+
+
+class NgramDrafter:
+    """Prompt-lookup drafts: propose the continuation that followed the most
+    recent earlier occurrence of the feed's trailing n-gram.
+
+    Tries match lengths ``max_ngram`` down to ``min_ngram`` (longer matches
+    first — higher precision), scanning for the *latest* earlier occurrence
+    (recency beats distance for repetitive decode loops).  Among occurrences
+    of the same n-gram, the latest one whose continuation is a full ``k``
+    tokens wins over a later-but-truncated one: in a short repetition loop
+    the most recent match sits at the very end of the feed where the
+    continuation runs off the list after one token, while a match one period
+    earlier yields the same continuation at full length.  The proposed
+    continuation may run past the historical match back into the suffix
+    region; that is fine — it is still the verbatim historical continuation.
+    """
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1):
+        if min_ngram < 1:
+            raise ValueError(f"min_ngram must be >= 1, got {min_ngram}")
+        if max_ngram < min_ngram:
+            raise ValueError(
+                f"max_ngram ({max_ngram}) must be >= min_ngram ({min_ngram})"
+            )
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+
+    def propose(self, feed: Sequence[int], k: int) -> List[int]:
+        toks = list(feed)
+        n_feed = len(toks)
+        if k <= 0 or n_feed < self.min_ngram + 1:
+            return []
+        for n in range(min(self.max_ngram, n_feed - 1), self.min_ngram - 1, -1):
+            suffix = toks[-n:]
+            best: List[int] = []
+            # Latest occurrence whose match ends strictly before the end of
+            # the feed, so at least one continuation token exists.  Keep
+            # scanning earlier occurrences until one yields a full-length
+            # continuation (the latest match truncates at the feed end when
+            # the loop period is short).
+            for i in range(n_feed - n - 1, -1, -1):
+                if toks[i : i + n] == suffix:
+                    cont = toks[i + n : i + n + k]
+                    if len(cont) >= k:
+                        return [int(t) for t in cont]
+                    if len(cont) > len(best):
+                        best = [int(t) for t in cont]
+            if best:
+                return best
+        return []
+
+
+class DraftModelDrafter:
+    """Greedy proposals from a small draft model's full forward.
+
+    ``apply`` is a model-family forward ``apply(params, ids, config,
+    attention_mask=...) -> logits [B, S, V]`` (``gpt2.apply`` /
+    ``llama.apply``).  Feeds are right-padded to power-of-two buckets so the
+    jitted forward compiles once per bucket, with the padding masked out of
+    the keys; the next token is the argmax at the last real position.
+    """
+
+    def __init__(self, apply, params, config, max_len: Optional[int] = None):
+        self._apply = apply
+        self.params = params
+        self.config = config
+        self._max_len = int(max_len) if max_len else getattr(config, "max_seq_len", None)
+        self._jitted: Dict[int, object] = {}
+
+    @staticmethod
+    def _bucket(n: int) -> int:
+        b = 1
+        while b < n:
+            b *= 2
+        return b
+
+    def _fn(self, bucket: int):
+        fn = self._jitted.get(bucket)
+        if fn is None:
+            apply, config = self._apply, self.config
+
+            def fwd(params, ids, n_real):
+                mask = (jnp.arange(ids.shape[1]) < n_real)[None]
+                logits = apply(params, ids, config, attention_mask=mask)
+                row = jax.lax.dynamic_index_in_dim(
+                    logits[0], n_real - 1, axis=0, keepdims=False
+                )
+                return jnp.argmax(row, axis=-1).astype(jnp.int32)
+
+            fn = jax.jit(fwd)
+            self._jitted[bucket] = fn
+        return fn
+
+    def propose(self, feed: Sequence[int], k: int) -> List[int]:
+        toks = [int(t) for t in feed]
+        out: List[int] = []
+        for _ in range(max(int(k), 0)):
+            n = len(toks)
+            if self._max_len is not None and n >= self._max_len:
+                break
+            bucket = self._bucket(n)
+            if self._max_len is not None:
+                bucket = min(bucket, self._max_len)
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :n] = toks
+            nxt = int(self._fn(bucket)(self.params, ids, jnp.int32(n)))
+            out.append(nxt)
+            toks.append(nxt)
+        return out

@@ -184,6 +184,20 @@ class ServingConfig:
       hash (copy-on-write tail, refcounted blocks, LRU reclaim).  Host-side
       policy only — the compiled programs are identical either way.
 
+    Speculative decode knobs (draft-then-verify; token-identical to greedy
+    by the accept rule — see ``models/generation.py
+    speculative_verify_greedy``):
+
+    - ``spec_tokens``: the draft window ``k``.  0 (default) disables; at
+      ``k > 0`` each decode tick asks the drafter for up to ``k`` candidate
+      tokens per slot and the target verifies all slots' ``k+1``-token
+      windows in ONE fused dispatch, emitting 1..k+1 tokens per slot per
+      tick.  Block budgeting grows by the worst-case ``k``-row overshoot
+      (``Scheduler.max_rows``).
+    - ``spec_ngram_max`` / ``spec_ngram_min``: n-gram match lengths for the
+      default prompt-lookup drafter (``serving/drafter.py NgramDrafter``);
+      ignored when a custom ``drafter=`` is passed to the engine.
+
     Tracing knobs (``serving/tracing.py`` — host-side interval bookkeeping,
     no effect on the compiled programs):
 
@@ -206,6 +220,9 @@ class ServingConfig:
     decode_path: str = "paged"
     paged_kernel: bool = False
     prefix_cache: bool = True
+    spec_tokens: int = 0
+    spec_ngram_max: int = 3
+    spec_ngram_min: int = 1
     trace: Optional[bool] = None
     trace_dir: Optional[str] = None
 
@@ -265,6 +282,7 @@ class ServingEngine:
         params,
         config,
         serving: Optional[ServingConfig] = None,
+        drafter=None,
     ):
         self.serving = serving or ServingConfig()
         sc = self.serving
@@ -272,9 +290,12 @@ class ServingEngine:
             raise ValueError(f"prefill_chunk must be >= 1, got {sc.prefill_chunk}")
         if sc.resolved_max_blocks() < 1:
             raise ValueError("max_blocks_per_seq must be >= 1")
+        if sc.spec_tokens < 0:
+            raise ValueError(f"spec_tokens must be >= 0, got {sc.spec_tokens}")
         self._apply_cached = apply_cached
         self._config = config
         self.params = params
+        self.spec_tokens = int(sc.spec_tokens)
         self.cache = PagedKVCache(init_cache, config, sc.num_blocks, sc.block_size)
         self.sched = Scheduler(
             self.cache.allocator,
@@ -282,6 +303,7 @@ class ServingEngine:
             block_size=sc.block_size,
             max_blocks_per_seq=sc.resolved_max_blocks(),
             prefill_chunk=sc.prefill_chunk,
+            spec_overshoot=self.spec_tokens,
         )
         max_len = sc.resolved_max_blocks() * sc.block_size
         model_max = getattr(config, "max_seq_len", None)
@@ -298,6 +320,11 @@ class ServingEngine:
         self.requeue_journal: Optional[List[dict]] = None
         self.ticks = 0
         self.decode_dispatches = 0
+        self.decode_emitted_tokens = 0
+        self.decode_slot_ticks = 0
+        self.spec_rounds = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
         self.prefill_dispatches = 0
         self.shed_count = 0
         self.deadline_expired_count = 0
@@ -348,7 +375,9 @@ class ServingEngine:
         # Per-width jit-cache bookkeeping for bucket-compile attribution:
         # a width this engine has not dispatched yet means the next dispatch
         # pays a trace+compile in the request's latency path.
-        self._seen_widths: Dict[str, set] = {"decode": set(), "prefill": set()}
+        self._seen_widths: Dict[str, set] = {
+            "decode": set(), "decode_spec": set(), "prefill": set(),
+        }
         # Live /debug endpoints: the metrics HTTP server asks registered
         # engines for request/block snapshots (weakly — a collected engine
         # just drops off the page).
@@ -398,6 +427,25 @@ class ServingEngine:
         else:
             self._decode_fn = jax.jit(self._build_decode(), donate_argnums=(1,))
             self._prefill_fn = jax.jit(self._build_prefill(), donate_argnums=(1,))
+        # Speculative draft-then-verify: one more jitted program (the W-token
+        # verify), plus a host-side drafter.  A tick with live drafts runs
+        # the verify program INSTEAD of the single-token one — still exactly
+        # one fused decode dispatch per tick.
+        self._drafter = None
+        self._decode_spec_fn = None
+        if self.spec_tokens > 0:
+            if drafter is None:
+                from .drafter import NgramDrafter
+
+                drafter = NgramDrafter(
+                    max_ngram=sc.spec_ngram_max, min_ngram=sc.spec_ngram_min
+                )
+            self._drafter = drafter
+            builder = (
+                self._build_decode_spec_paged
+                if self.decode_path == "paged" else self._build_decode_spec
+            )
+            self._decode_spec_fn = jax.jit(builder(), donate_argnums=(1,))
         # Pre-create the robustness + fast-path counters so the Prometheus
         # endpoint exposes them at 0 from the first scrape — a dashboard can
         # alert on rate() without waiting for the first incident (or the
@@ -409,8 +457,12 @@ class ServingEngine:
                 "serving.quarantined", "serving.journal_recoveries",
                 "serving.prefix_hits", "serving.prefix_blocks_reused",
                 "serving.prefix_cow_copies", "serving.decode_gather_bytes",
+                "serving.spec.proposed", "serving.spec.accepted",
+                "serving.spec.rounds",
             ):
                 tel.registry.counter(name)
+            tel.registry.gauge("serving.spec.acceptance_rate").set(0.0)
+            tel.registry.gauge("serving.tokens_per_dispatch").set(0.0)
 
     # -- compiled programs ---------------------------------------------------
 
@@ -461,6 +513,66 @@ class ServingEngine:
             return next_tok, ok, new_pool
 
         return prefill
+
+    def _build_decode_spec_paged(self):
+        """The speculative verify dispatch, paged flavor: every slot's
+        ``[last, d_1..d_k]`` window goes through ``apply_paged`` as a
+        ``[S, k+1]`` query block (causally masked against the paged K/V plus
+        the in-window prefix), the shared greedy accept kernel scores all
+        rows at once, and all ``k+1`` freshly written K/V rows scatter into
+        the donated pool.  Rows past a slot's accepted length are stale by
+        construction — the next dispatch at the rewound length re-writes
+        them before its masks ever admit those positions (the offline
+        loop's rewind argument, per-slot)."""
+        apply_paged, config = self._paged_apply, self._config
+        kernel = self.serving.paged_kernel
+        from ..models.generation import speculative_verify_greedy
+
+        def decode(params, pool, tables, lengths, tokens, draft_len, *poison):
+            window = tokens.shape[1]
+            logits, rows = apply_paged(
+                params, tokens, config, pool, tables, lengths, kernel=kernel,
+            )  # [S, W, V]
+            if poison:  # trace-time gate: unarmed programs carry no plumbing
+                logits = logits * poison[0][:, None, None]
+            t, m = speculative_verify_greedy(logits, tokens[:, 1:], draft_len)
+            ok = jnp.all(jnp.isfinite(logits), axis=(1, 2))
+            new_pool = dict(pool)
+            for n, r in rows.items():
+                new_pool[n] = scatter_token_rows(pool[n], r, tables, lengths, window)
+            return t, m, ok, new_pool
+
+        return decode
+
+    def _build_decode_spec(self):
+        """Speculative verify, dense flavor: per-slot gather views (the PR 9
+        reference path) with a W-token cached forward per lane under vmap —
+        the contrast arm proving accept/rewind correctness is independent of
+        the paged fast path."""
+        apply_cached, config, names = self._apply_cached, self._config, self._kv_names
+        from ..models.generation import speculative_verify_greedy
+
+        def decode(params, pool, tables, lengths, tokens, draft_len, *poison):
+            window = tokens.shape[1]
+            views = {n: gather_block_view(pool[n], tables) for n in names}
+            caches = dict(views, index=lengths)
+
+            def one(cache, toks):
+                logits, new_cache = apply_cached(params, toks[None, :], config, cache)
+                return logits[0], new_cache
+
+            logits, new_caches = jax.vmap(one)(caches, tokens)  # [S, W, V]
+            if poison:  # trace-time gate: unarmed programs carry no plumbing
+                logits = logits * poison[0][:, None, None]
+            t, m = speculative_verify_greedy(logits, tokens[:, 1:], draft_len)
+            ok = jnp.all(jnp.isfinite(logits), axis=(1, 2))
+            new_pool = {}
+            for n in names:
+                rows = extract_token_rows(new_caches[n], lengths, window)
+                new_pool[n] = scatter_token_rows(pool[n], rows, tables, lengths, window)
+            return t, m, ok, new_pool
+
+        return decode
 
     def _build_decode(self):
         apply_cached, config, names = self._apply_cached, self._config, self._kv_names
@@ -1092,11 +1204,37 @@ class ServingEngine:
              if slot.request.state == RequestState.DECODING),
             key=lambda i: sched.slots[i].admit_seq,
         )
+        # Speculative drafts come BEFORE block growth: a spec engine's every
+        # decode tick is a k+1-window verify dispatch whose write extent is
+        # the full window for EVERY live slot (the program scatters all
+        # rows), so growth must budget window rows whether or not a given
+        # slot has drafts of its own.  Draft-less slots (and draft-less
+        # ticks) ride the same program with ``draft_len = 0`` — the window
+        # is FIXED at k+1 whenever speculation is on, so each bucket has
+        # exactly one decode program shape and a rare draft-less tick can
+        # never trigger a fresh single-token compile mid-serve.  A draft
+        # never exceeds remaining-1 — the window position after the last
+        # accepted draft must still be emittable.
+        k = self.spec_tokens
+        drafts: Dict[int, List[int]] = {}
+        if k > 0:
+            for idx in decoding:
+                slot = sched.slots.get(idx)
+                if slot is None or slot.request.state != RequestState.DECODING:
+                    continue
+                req = slot.request
+                want = min(k, req.remaining - 1)
+                if want <= 0:
+                    continue
+                d = self._drafter.propose(req.to_feed, want)
+                if d:
+                    drafts[idx] = [int(t) for t in d[:want]]
+        window = k + 1 if k > 0 else 1
         # Grow oldest-first so older requests steal blocks from younger ones
         # (matching the LIFO victim policy), then re-collect the survivors.
         for idx in decoding:
             if idx in sched.slots and sched.slots[idx].request.state == RequestState.DECODING:
-                sched.grow_to(idx, sched.slots[idx].cache_len + 1)
+                sched.grow_to(idx, sched.slots[idx].cache_len + window)
         live = [
             idx for idx in decoding
             if idx in sched.slots and sched.slots[idx].request.state == RequestState.DECODING
@@ -1116,16 +1254,24 @@ class ServingEngine:
             gathered = s * m
         tables = np.zeros((s, m), np.int32)
         lengths = np.zeros((s,), np.int32)
-        tokens = np.zeros((s,), np.int32)
+        tokens = np.zeros((s, window), np.int32)
+        draft_len = np.zeros((s,), np.int32)
         for idx in live:
             slot = sched.slots[idx]
             tables[idx] = self._table_row(slot.blocks, m)
             lengths[idx] = slot.cache_len
-            tokens[idx] = slot.request.emitted[-1]
+            tokens[idx, 0] = slot.request.emitted[-1]
+            d = drafts.get(idx)
+            if d:
+                tokens[idx, 1 : 1 + len(d)] = d
+                draft_len[idx] = len(d)
         self.decode_gather_bytes += gathered * self._block_bytes
-        fresh = self._note_bucket("decode", m)
+        fresh = self._note_bucket("decode_spec" if window > 1 else "decode", m)
         dispatch_t0 = time.monotonic()
-        args = [self.params, self.cache.pool, tables, lengths, tokens]
+        if window > 1:
+            args = [self.params, self.cache.pool, tables, lengths, tokens, draft_len]
+        else:
+            args = [self.params, self.cache.pool, tables, lengths, tokens[:, 0]]
         if self._poison_ordinal is not None:
             # Armed: the program was traced with the poison lane.  NaN rides
             # into exactly one slot's logits on that request's first decode
@@ -1138,7 +1284,16 @@ class ServingEngine:
                     poison[idx] = np.nan
                     req._poison_pending = False  # fires once
             args.append(poison)
-        next_tokens, ok_flags, self.cache.pool = self._decode_fn(*args)
+        if window > 1:
+            # The verify program REPLACES the single-token one this tick —
+            # still exactly one fused decode dispatch per bucket.
+            t_rows, m_counts, ok_flags, self.cache.pool = self._decode_spec_fn(*args)
+            out = np.asarray(t_rows)
+            accepts = np.asarray(m_counts)
+        else:
+            next_tokens, ok_flags, self.cache.pool = self._decode_fn(*args)
+            out = np.asarray(next_tokens)[:, None]
+            accepts = np.zeros((s,), np.int32)
         self.decode_dispatches += 1
         tel = get_telemetry()
         if tel.enabled:
@@ -1147,7 +1302,6 @@ class ServingEngine:
                 gathered * self._block_bytes
             )
             tel.registry.gauge("serving.decode_bucket_width").set(m)
-        out = np.asarray(next_tokens)
         oks = np.asarray(ok_flags)
         emit_t = time.monotonic()
         if self.tracer is not None:
@@ -1157,15 +1311,47 @@ class ServingEngine:
                 [(sched.slots[idx].request, idx) for idx in live],
                 emit_t, co_batch=len(live), width=m, fresh=fresh,
                 dispatch_ms=(emit_t - dispatch_t0) * 1e3,
+                phase="verify" if window > 1 else "decode",
             )
+        # rounds counts verify DISPATCHES (with >= 1 healthy lane);
+        # proposed/accepted are per-slot sums over the healthy lanes.
+        spec_rounds = spec_proposed = spec_accepted = 0
         for idx in live:
-            sched.slots[idx].cache_len += 1
+            slot = sched.slots[idx]
+            req = slot.request
+            if window > 1:
+                # Accept bookkeeping: the emitted chunk is t[:count] where
+                # count = accepted drafts + the correction/bonus row, capped
+                # at remaining (count == remaining finishes the request on
+                # its exact last token).  cache_len advances by count — the
+                # rewind; rows past it are stale and re-written before read.
+                count = min(int(accepts[idx]) + 1, req.remaining)
+            else:
+                count = 1
+            slot.cache_len += count
             if not bool(oks[idx]):
                 # Quarantine instead of emitting the garbage argmax; the
                 # other slots' emissions proceed untouched.
                 self._quarantine(idx, emit_t)
                 continue
-            self._emit(idx, int(out[idx]), emit_t)
+            if window > 1:
+                spec_rounds = 1
+                spec_proposed += int(draft_len[idx])
+                spec_accepted += int(accepts[idx])
+            self.decode_emitted_tokens += count
+            self.decode_slot_ticks += 1
+            for j in range(count):
+                self._emit(idx, int(out[idx, j]), emit_t)
+        if spec_rounds:
+            self.spec_rounds += spec_rounds
+            self.spec_proposed += spec_proposed
+            self.spec_accepted += spec_accepted
+            if tel.enabled:
+                tel.registry.counter("serving.spec.rounds").inc(spec_rounds)
+                if spec_proposed:
+                    tel.registry.counter("serving.spec.proposed").inc(spec_proposed)
+                if spec_accepted:
+                    tel.registry.counter("serving.spec.accepted").inc(spec_accepted)
 
     # -- completion / metrics ------------------------------------------------
 
@@ -1261,6 +1447,15 @@ class ServingEngine:
         reg.gauge("serving.block_occupancy").set(round(alloc.occupancy, 4))
         reg.gauge("serving.prefix_cache_blocks").set(
             len(self._prefix) if self._prefix is not None else 0
+        )
+        reg.gauge("serving.spec.acceptance_rate").set(
+            round(self.spec_accepted / max(self.spec_proposed, 1), 4)
+        )
+        # Per slot-lane, not per fused dispatch: continuous batching already
+        # lands co_batch tokens per dispatch; this gauge isolates the
+        # SPECULATIVE gain (1.0 == plain greedy, >1 == accepted drafts).
+        reg.gauge("serving.tokens_per_dispatch").set(
+            round(self.decode_emitted_tokens / max(self.decode_slot_ticks, 1), 4)
         )
         # HBM ledger + headroom: refresh the prefix-cache resident bytes
         # (a subset of the pool reservation) and publish the serving
@@ -1411,6 +1606,21 @@ class ServingEngine:
             "prefix_cow_copies": self.cow_copies,
             "prefix_cached_blocks": len(self._prefix) if self._prefix else 0,
             "decode_bucket_widths": sorted(self._seen_widths["decode"]),
+            "spec": {
+                "window": self.spec_tokens,
+                "rounds": self.spec_rounds,
+                "proposed": self.spec_proposed,
+                "accepted": self.spec_accepted,
+                "acceptance_rate": round(
+                    self.spec_accepted / max(self.spec_proposed, 1), 4
+                ),
+                # Per slot-lane: mean tokens a slot advances per fused decode
+                # dispatch it rode (1.0 == plain greedy; the speculative gain
+                # net of batch width).
+                "tokens_per_dispatch": round(
+                    self.decode_emitted_tokens / max(self.decode_slot_ticks, 1), 4
+                ),
+            },
             "trace_blame": (
                 dict(self.tracer.blame_counts) if self.tracer is not None else None
             ),

@@ -164,6 +164,7 @@ class Scheduler:
         block_size: int,
         max_blocks_per_seq: int,
         prefill_chunk: int,
+        spec_overshoot: int = 0,
     ):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
@@ -172,6 +173,7 @@ class Scheduler:
         self.block_size = block_size
         self.max_blocks_per_seq = max_blocks_per_seq
         self.prefill_chunk = prefill_chunk
+        self.spec_overshoot = max(int(spec_overshoot), 0)
         self.queue: Deque[Request] = deque()
         self.slots: Dict[int, _Slot] = {}  # slot index -> lane
         self._admit_seq = itertools.count()
@@ -186,9 +188,17 @@ class Scheduler:
     def max_rows(self, request: Request) -> int:
         """Worst-case cache rows the request ever needs: the prompt plus every
         generated token except the last (which is emitted but never fed),
-        rounded up to the prefill-chunk boundary a re-admission after maximal
-        preemption would pad to."""
-        rows = len(request.prompt) + max(request.max_new_tokens - 1, 0)
+        plus the speculative verify window's overshoot (``spec_overshoot`` is
+        the engine's draft window ``k`` — a verify dispatch writes ``k+1``
+        rows starting at the last fed position, so the final dispatch can
+        write ``k`` rows past the plain-greedy extent), rounded up to the
+        prefill-chunk boundary a re-admission after maximal preemption would
+        pad to."""
+        rows = (
+            len(request.prompt)
+            + max(request.max_new_tokens - 1, 0)
+            + self.spec_overshoot
+        )
         chunks = blocks_for_tokens(rows, self.prefill_chunk)
         return chunks * self.prefill_chunk
 

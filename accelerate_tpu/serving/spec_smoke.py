@@ -1,0 +1,146 @@
+"""Speculative-serving smoke: draft-then-verify proof on an 8-device CPU mesh.
+
+Run via ``make spec-smoke`` (or ``python -m accelerate_tpu.serving.spec_smoke``).
+A mix of pattern-heavy prompts (the n-gram drafter's best case) and random
+prompts (mostly-rejected drafts) flows through a speculative engine
+(``ServingConfig.spec_tokens > 0``) on a forced 8-device CPU mesh.  Asserts:
+
+- **speculation is live** — ``serving.spec.acceptance_rate`` ends above zero
+  and more than one token lands per slot-dispatch on the pattern traffic;
+- **one decode program per tick per bucket** — the decode-dispatch counter
+  delta equals the engine's dispatch count, never exceeds ticks, and every
+  decode dispatch is a verify dispatch (``spec.rounds`` == dispatches: the
+  fixed ``k+1`` window means a draft-less tick reuses the SAME program
+  instead of compiling a fresh single-token one);
+- **token identity** — every request's output is token-identical to the
+  offline greedy ``generate_loop`` for that prompt alone, including the
+  requests whose drafts were mostly rejected;
+- **zero block leaks** — the KV pool is fully free after the last
+  completion (accept/rewind never strands a block).
+
+Exit code 0 only when every assertion holds.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    os.environ.setdefault("ACCELERATE_TPU_COMPILE_CACHE", "")
+    os.environ.setdefault("ACCELERATE_TPU_SENTINEL_PROFILE", "0")
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu import telemetry
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.models import gpt2
+    from accelerate_tpu.utils.dataclasses import ParallelismConfig
+
+    tel = telemetry.enable(dir=tempfile.mkdtemp(prefix="atpu_spec_smoke_"))
+    assert jax.device_count() == 8, f"expected 8 CPU devices, got {jax.device_count()}"
+    acc = Accelerator(parallelism_config=ParallelismConfig(dp=8))
+
+    cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32)
+    params = gpt2.init_params(cfg, jax.random.key(0))
+
+    rng = np.random.default_rng(7)
+    pattern = [int(t) for t in rng.integers(0, cfg.vocab_size, size=4)]
+    # Pattern prompts feed the prompt-lookup drafter from the first tick;
+    # the random prompts ride in the same co-batch with near-zero acceptance
+    # so variable per-slot accept/rewind is exercised inside one dispatch.
+    prompts = [
+        pattern * 3,
+        pattern * 2 + pattern[:2],
+        list(rng.integers(0, cfg.vocab_size, size=9)),
+        pattern * 2 + pattern[:3],
+        list(rng.integers(0, cfg.vocab_size, size=6)),
+    ]
+    budgets = [10, 8, 6, 9, 7]
+
+    print("# spec smoke: offline oracle (generate_loop, greedy)")
+    want = {}
+    for i, (p, m) in enumerate(zip(prompts, budgets)):
+        out = gpt2.generate(params, jnp.asarray([p], jnp.int32), cfg, max_new_tokens=m)
+        want[i] = [int(t) for t in np.asarray(out[0])]
+
+    engine = acc.prepare_serving(
+        gpt2.apply_cached, gpt2.init_cache, params, cfg,
+        block_size=4, num_blocks=24, max_slots=4, prefill_chunk=8,
+        max_blocks_per_seq=8, spec_tokens=3, prefix_cache=False,
+    )
+
+    dispatch_counter = tel.registry.counter("serving.decode_dispatches")
+    rounds_counter = tel.registry.counter("serving.spec.rounds")
+    d0, r0 = dispatch_counter.value, rounds_counter.value
+
+    ids = {}
+    for k, i in enumerate(rng.permutation(len(prompts))):
+        ids[engine.submit(prompts[i], budgets[i])] = int(i)
+        if k % 2 == 1:
+            engine.step()
+    outputs = engine.run(max_ticks=2000)
+    stats = engine.stats()
+    print(f"# spec smoke: stats {stats}")
+
+    for rid, out in outputs.items():
+        assert out == want[ids[rid]], (
+            f"request {rid} (prompt #{ids[rid]}) diverged from generate_loop:\n"
+            f"  got  {out}\n  want {want[ids[rid]]}"
+        )
+    print(f"# spec smoke: {len(outputs)} requests token-identical to generate_loop")
+
+    spec = stats["spec"]
+    assert spec["acceptance_rate"] > 0, "drafter never landed a token"
+    assert spec["tokens_per_dispatch"] > 1.0, (
+        f"tokens/slot-dispatch {spec['tokens_per_dispatch']:.3f} <= 1 — "
+        "speculation emitted no more than plain greedy would"
+    )
+    snap_rate = tel.registry.gauge("serving.spec.acceptance_rate").value
+    assert snap_rate > 0, "serving.spec.acceptance_rate gauge never moved"
+    print(
+        f"# spec smoke: acceptance {spec['acceptance_rate']:.3f} "
+        f"({spec['accepted']}/{spec['proposed']} drafts), "
+        f"{spec['tokens_per_dispatch']:.3f} tokens per slot-dispatch"
+    )
+
+    delta = dispatch_counter.value - d0
+    assert delta == engine.decode_dispatches, (
+        f"telemetry counted {delta} decode dispatches, engine ran "
+        f"{engine.decode_dispatches}"
+    )
+    assert delta <= engine.ticks, f"{delta} decode dispatches > {engine.ticks} ticks"
+    rounds = rounds_counter.value - r0
+    assert rounds == delta, (
+        f"{rounds} verify rounds != {delta} decode dispatches — a tick fell "
+        "out of the fixed k+1 window program (fresh single-token compile)"
+    )
+    print(
+        f"# spec smoke: {delta} fused verify dispatches over {engine.ticks} "
+        "ticks (<= 1/step, every dispatch a k+1 window)"
+    )
+
+    assert engine.cache.allocator.used_blocks == 0, (
+        f"{engine.cache.allocator.used_blocks} blocks still allocated after "
+        "the last completion — accept/rewind leaked pool blocks"
+    )
+    print("# spec smoke: KV pool fully free after drain (zero block leaks)")
+
+    telemetry.disable()
+    print("spec smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

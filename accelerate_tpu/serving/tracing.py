@@ -93,6 +93,7 @@ PHASES = (
     "queue_wait",
     "prefill",
     "decode",
+    "verify",  # speculative draft-then-verify dispatch (productive, like decode)
     "preempted",
     "requeued_wait",
     "compile_in_path",
@@ -380,7 +381,14 @@ class ServingTracer:
     def on_decode(
         self, reqs_slots, end: float,
         co_batch: int, width: Optional[int], fresh: bool, dispatch_ms: float,
+        phase: str = "decode",
     ) -> None:
+        """One fused decode/verify dispatch.  ``phase`` is ``"decode"`` for
+        the single-token program and ``"verify"`` for a speculative
+        draft-then-verify dispatch — both productive (never blamed); the
+        phase key keeps greedy and speculative runs from coalescing into one
+        interval, so a trace shows exactly where the engine ran verify
+        windows."""
         for req, slot in reqs_slots:
             t = self.live.get(req.id)
             if t is None:
@@ -389,7 +397,7 @@ class ServingTracer:
             if (
                 not fresh
                 and last is not None
-                and last.phase == "decode"
+                and last.phase == phase
                 and last.meta.get("co_batch") == co_batch
                 and last.meta.get("width") == width
                 and t.cursor == last.end
@@ -403,14 +411,13 @@ class ServingTracer:
                 last.meta["dispatch_ms"] = round(last.meta["dispatch_ms"] + dispatch_ms, 3)
                 t.cursor = end
             else:
-                phase = "compile_in_path" if fresh else "decode"
                 # Cursor start (see on_prefill): in-slot residency across a
                 # shape change or host gap stays attributed to the request.
                 t.add(
-                    phase, end,
+                    "compile_in_path" if fresh else phase, end,
                     co_batch=co_batch, width=width, slot=slot,
                     ticks=1, dispatch_ms=round(dispatch_ms, 3),
-                    **({"kind": "decode"} if fresh else {}),
+                    **({"kind": phase} if fresh else {}),
                 )
             self._ticked.add(req.id)
         self._note_event()

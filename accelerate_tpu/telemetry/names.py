@@ -66,6 +66,9 @@ COUNTERS = frozenset({
     "serving.quarantined",
     "serving.requests",
     "serving.shed",
+    "serving.spec.accepted",
+    "serving.spec.proposed",
+    "serving.spec.rounds",
     "serving.tokens",
     "stall.count",
     "step.count",
@@ -111,6 +114,8 @@ GAUGES = frozenset({
     "serving.slo.ttft_burn_rate",
     "serving.slo.inter_token_target_ms",
     "serving.slo.inter_token_burn_rate",
+    "serving.spec.acceptance_rate",
+    "serving.tokens_per_dispatch",
     "step.mfu",
     "step.tokens_per_sec",
 })

@@ -503,6 +503,15 @@ def format_serving_block(snapshot) -> list:
         f"  dispatches: {g('serving.decode_dispatches', 0)} decode "
         f"(fused, 1/step), {g('serving.prefill_dispatches', 0)} prefill chunks"
     )
+    spec_rounds = g("serving.spec.rounds", 0)
+    if spec_rounds:
+        lines.append(
+            f"  speculative: {g('serving.spec.accepted', 0)}/"
+            f"{g('serving.spec.proposed', 0)} drafts accepted "
+            f"(rate {g('serving.spec.acceptance_rate', 0.0):.1%}) over "
+            f"{spec_rounds} verify rounds; "
+            f"{g('serving.tokens_per_dispatch', 0.0):.2f} tokens/dispatch"
+        )
 
     def hist(stem, label, unit="ms"):
         if g(f"{stem}.count"):

@@ -1259,9 +1259,15 @@ def _serving_probe() -> dict:
 
     # Paged-vs-dense decode throughput: the perf-gate serving row's probe,
     # journaled here so the bench trajectory records the fast-path win too.
-    from accelerate_tpu.pipeline.perf_gate import run_serving_probe
+    from accelerate_tpu.pipeline.perf_gate import run_serving_probe, run_spec_probe
 
     paged_row = run_serving_probe(decode_ticks=20)
+
+    # Speculative draft-then-verify vs plain greedy at identical geometry
+    # (repeated-pattern prompts the n-gram drafter targets): acceptance,
+    # tokens landed per slot-dispatch, and the p95 inter-token tail both
+    # arms — journaled so the bench trajectory records the spec win too.
+    spec_row = run_spec_probe()
 
     # Per-request trace accounting over the staggered-mix window: blame
     # tally plus the conservation residual the tracer could not attribute
@@ -1319,6 +1325,14 @@ def _serving_probe() -> dict:
                 "gather_bytes_per_tick": round(
                     cached_eng.decode_gather_bytes / max(cached_eng.decode_dispatches, 1)
                 ),
+            },
+            "speculative": {
+                "acceptance_rate": spec_row["serving_spec_acceptance_rate"],
+                "tokens_per_dispatch": spec_row["serving_spec_tokens_per_dispatch"],
+                "spec_p95_inter_token_ms": spec_row["serving_spec_itl_p95_ms"],
+                "greedy_p95_inter_token_ms": spec_row["serving_greedy_itl_p95_ms"],
+                "spec_vs_greedy_itl_ratio": spec_row["serving_spec_vs_greedy_itl_ratio"],
+                "token_identical": spec_row["serving_spec_token_identical"],
             },
         }
     }

@@ -17,6 +17,7 @@ from accelerate_tpu.pipeline.perf_gate import (
     run_pp_probe,
     run_probe,
     run_serving_probe,
+    run_spec_probe,
 )
 
 
@@ -293,6 +294,75 @@ def test_serving_row_fails_when_dense_decode_degraded(monkeypatch):
     assert row["serving_paged_active"] is False
     failures = evaluate(dict(_passing_measurements(), **row), load_baseline())
     assert any("fell back to the dense" in f for f in failures)
+
+
+# ---------------------------------------------------------------------------
+# spec row (PR 19): speculative draft-then-verify vs plain greedy decode
+# ---------------------------------------------------------------------------
+
+
+def _passing_spec_measurements():
+    return dict(
+        _passing_serving_measurements(),
+        serving_spec_vs_greedy_itl_ratio=1.1,
+        serving_spec_acceptance_rate=0.9,
+        serving_spec_tokens_per_dispatch=3.0,
+        serving_spec_active=True,
+        serving_spec_token_identical=True,
+    )
+
+
+def test_evaluate_spec_row_thresholds():
+    """The spec row cuts three ways: the active tripwire (silent fallback to
+    greedy), token identity (accept/rewind contract), and the ITL ratio floor
+    (verify window slower per token than the single-token program).  The
+    integer tripwires carry exactness — the CPU ratio floor sits below the
+    noise band on purpose (see the baseline's _comment)."""
+    baseline = load_baseline()
+    assert baseline["require_spec_active"] is True
+    assert 0 < baseline["min_spec_vs_greedy_itl_ratio"] < 1.0
+    assert evaluate(_passing_spec_measurements(), baseline) == []
+    m = dict(_passing_spec_measurements(), serving_spec_active=False)
+    assert any("serving_spec_active is False" in f for f in evaluate(m, baseline))
+    m = dict(_passing_spec_measurements(), serving_spec_token_identical=False)
+    assert any("accept/rewind contract" in f for f in evaluate(m, baseline))
+    m = dict(_passing_spec_measurements(), serving_spec_vs_greedy_itl_ratio=0.5)
+    assert any("stopped beating" in f for f in evaluate(m, baseline))
+    # spec arm never ran: no spec judgments at all
+    assert evaluate(_passing_serving_measurements(), baseline) == []
+
+
+@pytest.mark.slow
+def test_spec_row_fails_when_no_spec_degraded(monkeypatch):
+    """ACCELERATE_TPU_PERF_GATE_DEGRADE=no-spec runs the spec arm with
+    spec_tokens=0 — plain greedy masquerading as the speculative config.
+    The serving_spec_active tripwire must fail the row; note the measured
+    ratio typically stays ABOVE the floor here (greedy vs greedy ~1.0+,
+    and the floor is 0.9), which is exactly why the tripwire exists: the
+    ratio floor alone can never catch a silent fallback.  Probe-level
+    self-test; the cheap evaluate()-row tests run in tier-1."""
+    monkeypatch.setenv("ACCELERATE_TPU_PERF_GATE_DEGRADE", "no-spec")
+    row = run_spec_probe(max_new=16)
+    assert row["serving_spec_active"] is False
+    assert row["serving_spec_tokens_per_dispatch"] <= 1.0
+    failures = evaluate(dict(_passing_measurements(), **row), load_baseline())
+    assert any("serving_spec_active is False" in f for f in failures)
+
+
+@pytest.mark.slow
+def test_spec_probe_wins_and_matches_greedy():
+    """The real spec probe on CPU: drafts are accepted (the n-gram drafter
+    engages on the pure-pattern prompts from the first tick), more than one
+    token lands per slot-dispatch, outputs are token-identical to the greedy
+    arm, and the full row passes the committed gate."""
+    row = run_spec_probe(max_new=24)
+    assert row["serving_spec_active"] is True
+    assert row["serving_spec_acceptance_rate"] > 0.5
+    assert row["serving_spec_tokens_per_dispatch"] > 1.5
+    assert row["serving_spec_token_identical"] is True
+    failures = evaluate(dict(_passing_measurements(), **row), load_baseline())
+    spec_failures = [f for f in failures if "spec" in f]
+    assert spec_failures == []
 
 
 # ---------------------------------------------------------------------------
