@@ -1,10 +1,10 @@
 # Test-suite splits mirroring the reference Makefile:25-77.
 
-.PHONY: test test-quick test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels bench telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke pp-smoke profile-smoke serving-smoke spec-smoke serving-trace-smoke elastic-smoke chaos-smoke serving-chaos-smoke fleet-chaos-smoke goodput-smoke memory-smoke perf-gate
+.PHONY: test test-quick test_core test_big_modeling test_cli test_fsdp test_tp test_examples test_kernels bench telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke pp-smoke profile-smoke serving-smoke spec-smoke serving-trace-smoke elastic-smoke chaos-smoke serving-chaos-smoke tiering-chaos-smoke fleet-chaos-smoke goodput-smoke memory-smoke perf-gate
 
 PYTEST = python -m pytest -q
 
-test: test-quick telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke pp-smoke profile-smoke serving-smoke spec-smoke serving-trace-smoke elastic-smoke chaos-smoke serving-chaos-smoke fleet-chaos-smoke goodput-smoke memory-smoke perf-gate
+test: test-quick telemetry-smoke introspect-smoke resilience-smoke pipeline-smoke health-smoke flightrec-smoke zero-smoke pp-smoke profile-smoke serving-smoke spec-smoke serving-trace-smoke elastic-smoke chaos-smoke serving-chaos-smoke tiering-chaos-smoke fleet-chaos-smoke goodput-smoke memory-smoke perf-gate
 	$(PYTEST) tests/
 
 # <5 min tier (VERDICT r5 item 6): oracles, state, sharding-spec/mesh,
@@ -153,6 +153,22 @@ serving-trace-smoke:
 serving-chaos-smoke:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.resilience.smoke_retry \
 	  --label serving-chaos-smoke -- python -m accelerate_tpu.serving.chaos
+
+# KV-tiering-under-fire proof: a pool tight enough that every life preempts,
+# with the host-DRAM tier on.  Arms: a memory-pressure life (preemption
+# demotes KV blocks to host, re-admission promotes them back — real
+# migrations, ZERO re-prefill dispatches on migrated resumes), a host-full
+# life (SERVING_HOST_FULL fault forces the fallback re-prefill path), a
+# SIGKILL landed at the instant a request's blocks sit in host DRAM (the
+# journal must record "host" residency), and a journal recovery that
+# finishes everything.  Every output token-identical to generate_loop, zero
+# block leaks in either tier (docs/usage_guides/serving.md, "KV tiering &
+# memory pressure").  Quarantined with one loud bounded retry (subprocess
+# XLA-CPU workload, same flake class as resilience-smoke).
+tiering-chaos-smoke:
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.resilience.smoke_retry \
+	  --label tiering-chaos-smoke -- \
+	  python -m accelerate_tpu.serving.chaos --campaign tiering
 
 # Multi-process fleet campaign: a REAL 4-process localhost jax.distributed
 # cluster (gloo CPU collectives, hybrid dcn_dp mesh) launched and babysat by

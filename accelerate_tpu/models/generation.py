@@ -20,7 +20,7 @@ __all__ = [
     "speculative_verify_greedy",
     "make_paged_pool", "gather_block_view", "extract_token_rows",
     "scatter_token_rows", "paged_cache_write", "pack_paged_pool_for_scan",
-    "unpack_paged_rows_from_scan",
+    "unpack_paged_rows_from_scan", "demote_pool_blocks", "promote_pool_blocks",
 ]
 
 
@@ -186,6 +186,34 @@ def scatter_token_rows(
     blk = jnp.where(blk_idx < m, blk, 0)
     off = pos % bs
     return pool_leaf.at[:, blk, off].set(jnp.moveaxis(rows, 0, 1))
+
+
+def demote_pool_blocks(pool: dict, blocks) -> dict:
+    """Gather whole blocks out of every pool leaf and land them in host
+    memory: ``{name: [L, n, bs, *r] numpy}`` for ``n = len(blocks)``.  One
+    device gather + one D2H transfer per leaf — the KV-tiering demotion
+    primitive (serving/blocks.py), batched per call and never part of the
+    fused decode dispatch.  On TPU the destination is the pinned-host
+    mirror pool; ``device_get`` rather than a cross-memory-kind
+    ``device_put`` keeps the copy a real transfer on CPU backends too,
+    where host is already the default memory kind."""
+    import numpy as np
+
+    idx = jnp.asarray(blocks, jnp.int32)
+    gathered = {name: jnp.take(leaf, idx, axis=1) for name, leaf in pool.items()}
+    return {name: np.asarray(jax.device_get(g)) for name, g in gathered.items()}
+
+
+def promote_pool_blocks(pool: dict, host_rows: dict, dst_blocks) -> dict:
+    """Scatter host-resident block rows ``{name: [L, n, bs, *r]}`` back into
+    the pool at block ids ``dst_blocks``; returns the updated pool.  One H2D
+    transfer + one scatter per leaf — the promotion primitive paired with
+    :func:`demote_pool_blocks`."""
+    dst = jnp.asarray(dst_blocks, jnp.int32)
+    return {
+        name: leaf.at[:, dst].set(jnp.asarray(host_rows[name], leaf.dtype))
+        for name, leaf in pool.items()
+    }
 
 
 def _insert_rows(ctx: jax.Array, new_rows: jax.Array, starts: jax.Array) -> jax.Array:

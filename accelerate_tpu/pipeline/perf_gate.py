@@ -415,6 +415,132 @@ def run_spec_probe(degrade: Optional[str] = None, max_new: int = 60) -> dict:
     }
 
 
+def run_tiering_probe(cycles: int = 4, degrade: Optional[str] = None) -> dict:
+    """The serving-tiering row's measurement: preempt-resume latency with
+    the host-DRAM KV tier (demote the victim's blocks on preemption, promote
+    on re-admission, zero re-prefill dispatches) vs the re-prefill fallback
+    it replaces, at IDENTICAL geometry (gpt2-tiny, same prompt, same preempt
+    cadence — only ``host_blocks`` differs).
+
+    Each arm runs one warm request end to end, then repeatedly preempts the
+    probe request mid-decode via ``preempt_slot`` and times preemption ->
+    next emitted token; one discarded cycle per arm lands the migration /
+    re-prefill programs' compiles outside the timed window.  Judged
+    invariants: ``serving_tiering_active`` (promotions landed, zero fallback
+    re-prefills, and the completed request's prefill dispatches stayed at
+    the no-preemption count — the silent-re-prefill tripwire), token
+    identity vs the untiered arm, and the migrated-vs-re-prefill resume
+    ratio over the committed floor.  ``degrade="no-tiering"`` builds the
+    tiered arm with ``host_blocks=0`` — the self-test that this row
+    actually judges the migration path."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import gpt2
+    from ..serving import ServingConfig, ServingEngine
+    from ..serving.scheduler import RequestState
+
+    if degrade is None:
+        degrade = os.environ.get(ENV_DEGRADE, "").strip().lower() or None
+    cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32)
+    params = gpt2.init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(7)
+    # A long prompt makes the structural gap measurable on CPU: a migrated
+    # resume is one promote + one decode tick regardless of prompt length,
+    # while the re-prefill fallback pays ceil(rows/chunk) = 13 dispatches.
+    # 97 rows keeps the request at EXACTLY 13 blocks through every timed
+    # cycle (rows 98..102 as tokens land) — a block-boundary crossing
+    # recompiles the demote/promote copies mid-window and poisons the mean.
+    prompt = [int(t) for t in rng.integers(0, cfg.vocab_size, size=97)]
+    max_new = 12
+
+    def arm(host_blocks):
+        eng = ServingEngine(
+            gpt2.apply_cached, gpt2.init_cache, params, cfg,
+            serving=ServingConfig(
+                block_size=8, num_blocks=80, max_slots=4, prefill_chunk=8,
+                max_blocks_per_seq=16, prefix_cache=False,
+                host_blocks=host_blocks,
+            ),
+        )
+        # Warm every bucket's program end to end outside the timed cycles.
+        eng.submit(list(prompt), max_new)
+        eng.run()
+        eng.pop_finished()
+        rid = eng.submit(list(prompt), max_new)
+        req = next(r for r in eng.sched.queue if r.id == rid)
+        resumes = []
+        for cycle in range(cycles + 1):  # cycle 0 discarded: warms the
+            # demote/promote (or re-prefill-resume) programs themselves.
+            while req.state != RequestState.DECODING or len(req.emitted) <= cycle:
+                eng.step()
+            idx = next(i for i, s in eng.sched.slots.items() if s.request.id == rid)
+            n0 = len(req.emitted)
+            t0 = time.perf_counter()
+            eng.sched.preempt_slot(idx)
+            while len(req.emitted) == n0:
+                eng.step()
+            if cycle:
+                resumes.append((time.perf_counter() - t0) * 1e3)
+        outs = eng.run()
+        done = next(r for r in eng.pop_finished() if r.id == rid)
+        # Raw migration bandwidth: one timed 8-block round trip through the
+        # drained cache (second pass — the first warms the per-shape copies).
+        demote_ms = promote_ms = None
+        if eng.cache.host is not None and eng.cache.host.free_blocks >= 8:
+            blocks = eng.sched.allocator.alloc(8)
+            for _ in range(2):
+                t0 = time.perf_counter()
+                host_ids = eng.cache.demote(blocks)
+                demote_ms = (time.perf_counter() - t0) * 1e3
+                t0 = time.perf_counter()
+                eng.cache.promote(host_ids, blocks)
+                jax.block_until_ready(list(eng.cache.pool.values()))
+                promote_ms = (time.perf_counter() - t0) * 1e3
+            eng.sched.allocator.free(blocks)
+        return {
+            "resume_ms": sum(resumes) / max(len(resumes), 1),
+            "outputs": outs[rid],
+            "tiering": eng.stats()["tiering"],
+            "prefill_dispatches": done.prefill_dispatches,
+            "migrations": done.migrations,
+            "block_bytes": eng.cache.block_bytes(),
+            "demote_ms": demote_ms,
+            "promote_ms": promote_ms,
+        }
+
+    base = arm(0)  # the re-prefill resume path the tier replaces
+    tier = arm(0 if degrade == "no-tiering" else 16)
+    tiering = tier["tiering"]
+    active = bool(
+        tiering is not None
+        and tiering["promotions"] >= 1
+        and tiering["fallback_reprefills"] == 0
+        # Zero re-prefill: the completed request's prefill dispatches must
+        # equal the single-admission chunk count despite every preemption.
+        and tier["prefill_dispatches"] == -(-len(prompt) // 8)
+    )
+    def bw(ms):
+        return round(8 * tier["block_bytes"] / (ms / 1e3) / 1e6, 1) if ms else None
+    return {
+        "serving_reprefill_resume_ms": round(base["resume_ms"], 3),
+        "serving_migrated_resume_ms": round(tier["resume_ms"], 3),
+        "serving_migrated_vs_reprefill_ratio": round(
+            base["resume_ms"] / max(tier["resume_ms"], 1e-9), 3
+        ),
+        "serving_tiering_active": active,
+        "serving_tiering_token_identical": tier["outputs"] == base["outputs"],
+        "serving_tier_migrations": tier["migrations"],
+        "serving_tier_fallback_reprefills": (
+            tiering["fallback_reprefills"] if tiering is not None else None
+        ),
+        "serving_tier_demote_mb_per_s": bw(tier["demote_ms"]),
+        "serving_tier_promote_mb_per_s": bw(tier["promote_ms"]),
+    }
+
+
 def run_probe(
     accum: int = 2,
     steps: int = 10,
@@ -631,6 +757,9 @@ def run_probe(
             # spec row: speculative vs greedy decode on the same engine
             # geometry (one more paired probe; rides the serving flag).
             serving_row.update(run_spec_probe(degrade=degrade))
+            # tiering row: migrated preempt-resume vs re-prefill on the same
+            # engine geometry (the host-DRAM KV tier's paired probe).
+            serving_row.update(run_tiering_probe(degrade=degrade))
 
         # goodput row: one fused epoch (compiles warmed OUTSIDE the window)
         # through the wall-clock attribution ledger — the productive fraction
@@ -996,6 +1125,37 @@ def evaluate(measurements: dict, baseline: dict) -> list:
                 f"min {min_spec_ratio} — draft-then-verify stopped beating "
                 "one-token-per-dispatch greedy decode"
             )
+    # tiering row: judged only when the arm ran.  A preempted request that
+    # silently re-prefills instead of resuming from its host-demoted blocks,
+    # a migration round trip that corrupts the KV (token divergence), or a
+    # migrated resume slower than the re-prefill it replaces are exactly the
+    # regressions this row exists to catch.
+    if "serving_migrated_vs_reprefill_ratio" in measurements:
+        if baseline.get("require_tiering_active"):
+            if not measurements.get("serving_tiering_active"):
+                failures.append(
+                    "serving_tiering_active is False — preempted requests are "
+                    "not resuming from host-demoted KV blocks (no promotions "
+                    "landed, a fallback re-prefill fired, or prefill "
+                    "dispatches grew past the single-admission count)"
+                )
+            if measurements.get("serving_tiering_token_identical") is False:
+                failures.append(
+                    "tiered serving outputs diverged from the untiered arm — "
+                    "the HBM->host->HBM round trip corrupted KV state"
+                )
+        min_tier_ratio = baseline.get("min_migrated_resume_vs_reprefill_ratio")
+        if (
+            min_tier_ratio is not None
+            and measurements["serving_migrated_vs_reprefill_ratio"] < min_tier_ratio
+        ):
+            failures.append(
+                f"migrated-vs-re-prefill resume ratio "
+                f"{measurements['serving_migrated_vs_reprefill_ratio']:.3f} < "
+                f"baseline min {min_tier_ratio} — resuming a preempted request "
+                "from the host tier stopped beating re-prefilling it from "
+                "scratch"
+            )
     return failures
 
 
@@ -1040,6 +1200,11 @@ def run_gate(baseline_path: Optional[str] = None, probe_kwargs: Optional[dict] =
             f", serving paged/dense {measurements['serving_paged_vs_dense_ratio']}x "
             f"at {measurements['serving_decode_dispatches_per_tick']:.0f} "
             "dispatch/tick"
+        )
+    if measurements.get("serving_migrated_vs_reprefill_ratio") is not None:
+        zero_note += (
+            f", tiering migrated/re-prefill resume "
+            f"{measurements['serving_migrated_vs_reprefill_ratio']}x"
         )
     if measurements.get("train_state_bytes_per_chip") is not None:
         zero_note += (

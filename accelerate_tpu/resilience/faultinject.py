@@ -38,6 +38,11 @@ under test can be broken without code changes (``make resilience-smoke`` and
   The engine's in-program non-finite detection must quarantine exactly that
   request while every other slot keeps decoding bit-identically
   (``make serving-chaos-smoke`` proves this).  Fires once.
+- ``ACCELERATE_TPU_FAULT_SERVING_HOST_FULL=1`` — the serving KV host tier
+  reports itself full on every demotion attempt, so preemption falls back to
+  the free-and-re-prefill path and prefix-cache eviction drops instead of
+  demoting (the ``make tiering-chaos-smoke`` host-exhaustion life proves the
+  fallback stays token-identical).
 
 Zero overhead when unarmed: the env is read once, and every hook is a single
 ``if`` on a cached None.
@@ -67,6 +72,7 @@ __all__ = [
     "bad_batch_index",
     "maybe_poison_batch",
     "serving_nan_ordinal",
+    "serving_host_full",
 ]
 
 ENV_WRITE_N = "ACCELERATE_TPU_FAULT_WRITE_N"
@@ -77,6 +83,7 @@ ENV_NAN_STEP = "ACCELERATE_TPU_FAULT_NAN_STEP"
 ENV_NAN_COUNT = "ACCELERATE_TPU_FAULT_NAN_COUNT"
 ENV_BAD_BATCH = "ACCELERATE_TPU_FAULT_BAD_BATCH"
 ENV_SERVING_NAN = "ACCELERATE_TPU_FAULT_SERVING_NAN_REQUEST"
+ENV_SERVING_HOST_FULL = "ACCELERATE_TPU_FAULT_SERVING_HOST_FULL"
 
 
 class InjectedWriteError(OSError):
@@ -87,6 +94,7 @@ class _Config:
     __slots__ = (
         "write_n", "write_sticky", "sigterm_step", "oom_once",
         "nan_step", "nan_count", "bad_batch", "serving_nan",
+        "serving_host_full",
     )
 
     def __init__(self):
@@ -106,6 +114,9 @@ class _Config:
         self.nan_count = _int(ENV_NAN_COUNT) or 1
         self.bad_batch = _int(ENV_BAD_BATCH)
         self.serving_nan = _int(ENV_SERVING_NAN)
+        self.serving_host_full = os.environ.get(
+            ENV_SERVING_HOST_FULL, ""
+        ).strip().lower() in ("1", "true", "yes", "on")
 
     @property
     def any_armed(self) -> bool:
@@ -116,6 +127,7 @@ class _Config:
             or self.nan_step is not None
             or self.bad_batch is not None
             or self.serving_nan is not None
+            or self.serving_host_full
         )
 
 
@@ -137,7 +149,8 @@ def _config() -> _Config:
                 f"write_n={_cfg.write_n} sticky={_cfg.write_sticky} "
                 f"sigterm_step={_cfg.sigterm_step} oom_once={_cfg.oom_once} "
                 f"nan_step={_cfg.nan_step} nan_count={_cfg.nan_count} "
-                f"bad_batch={_cfg.bad_batch} serving_nan={_cfg.serving_nan}"
+                f"bad_batch={_cfg.bad_batch} serving_nan={_cfg.serving_nan} "
+                f"serving_host_full={_cfg.serving_host_full}"
             )
     return _cfg
 
@@ -263,6 +276,14 @@ def serving_nan_ordinal() -> Optional[int]:
     unarmed fused decode program carries no poison plumbing at all (the
     ``nan_armed`` trace-time gating trick)."""
     return _config().serving_nan
+
+
+def serving_host_full() -> bool:
+    """True when the serving KV host tier is forced to report itself full:
+    every demotion attempt fails, exercising the free-and-re-prefill
+    fallback and the eviction drop path.  Checked per demotion attempt (a
+    host-path branch between dispatches), not folded into any program."""
+    return _config().serving_host_full
 
 
 def bad_batch_index() -> Optional[int]:

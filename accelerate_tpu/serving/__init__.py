@@ -22,6 +22,15 @@ quarantine, crash-recovery journal): ``engine.py`` + ``journal.py``, proven
 under fire by the seeded serving chaos campaign (``serving/chaos.py``,
 ``make serving-chaos-smoke``).
 
+KV survivability layer (``host_blocks > 0``): a host-DRAM second tier for
+the paged pool (``blocks.HostBlockPool``) — preemption demotes the
+victim's blocks and re-admission promotes them back (zero re-prefill
+dispatches), cold prefix chains spill on LRU eviction, and admission
+demotes proactively under the memory-headroom watermark; proven by the
+tiered chaos campaign (``make tiering-chaos-smoke``) and the perf-gate
+tiering row. See ``docs/usage_guides/serving.md`` ("KV tiering & memory
+pressure").
+
 Observability layer (per-request phase traces, tail-latency blame
 decomposition, Chrome-trace export, live ``/debug`` endpoints):
 ``tracing.py`` + the metrics HTTP server, walked through in
@@ -29,7 +38,13 @@ decomposition, Chrome-trace export, live ``/debug`` endpoints):
 in ``docs/package_reference/serving_tracing.md``.
 """
 
-from .blocks import BlockAllocator, BlockOutOfMemory, PagedKVCache, PrefixCache
+from .blocks import (
+    BlockAllocator,
+    BlockOutOfMemory,
+    HostBlockPool,
+    PagedKVCache,
+    PrefixCache,
+)
 from .drafter import DraftModelDrafter, NgramDrafter
 from .engine import (
     AdmissionRejected,
@@ -52,6 +67,7 @@ __all__ = [
     "AdmissionRejected",
     "BlockAllocator",
     "BlockOutOfMemory",
+    "HostBlockPool",
     "PagedKVCache",
     "PrefixCache",
     "CompletedRequest",

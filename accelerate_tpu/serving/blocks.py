@@ -50,6 +50,20 @@ request may reuse wholesale (it must keep >= 1 token to feed), the next
 chain block is copied into a private block and writing continues there —
 shared blocks are never written after registration (writes always move
 forward from ``cache_len``; every shared block ends before it).
+
+**Host tier.**  :class:`PagedKVCache` can carry a second, host-DRAM block
+pool (:class:`HostBlockPool`) mirroring the device pool's leaf layout, with
+explicit :meth:`PagedKVCache.demote` / :meth:`PagedKVCache.promote` block
+migrations (batched device_get / device-scatter per call — never inside the
+fused decode dispatch).  The host tier has no refcounts: every host block has
+exactly one owner (a preempted request's demoted KV, or a cold prefix-cache
+chain entry), and the scrub contract carries over — a host block marked dirty
+is zeroed synchronously on free, so quarantined content can never leak into a
+later resident.  On real accelerators the host leaves live in pinned host
+memory (``memory_kind="pinned_host"``); here they are numpy arrays so the
+D2H/H2D copies are real transfers on every backend, including the CPU one
+where host *is* the default memory kind and a same-kind ``device_put`` would
+silently commit the leaf instead of moving it.
 """
 
 from __future__ import annotations
@@ -63,6 +77,7 @@ import numpy as np
 __all__ = [
     "BlockAllocator",
     "BlockOutOfMemory",
+    "HostBlockPool",
     "PagedKVCache",
     "PrefixCache",
     "blocks_for_tokens",
@@ -192,6 +207,12 @@ class BlockAllocator:
             if b in self._ref:
                 self._dirty.add(b)
 
+    def is_dirty(self, block: int) -> bool:
+        """Whether a block is quarantine-poisoned (pending its scrub).  The
+        tiering paths refuse to demote dirty blocks — copying possibly
+        poisoned KV into the host tier would outlive the device scrub."""
+        return block in self._dirty
+
     def pop_pending_scrub(self) -> List[int]:
         """Dirty blocks whose last reference released since the previous
         drain.  The caller (the engine) zeroes them on device and hands them
@@ -206,6 +227,101 @@ class BlockAllocator:
         self._free.extend(blocks)
 
 
+class HostBlockPool:
+    """Host-DRAM mirror of the device block pool: one numpy leaf per pool
+    leaf with the same ``[L, num_blocks, block_size, *rest]`` layout (fp and
+    int8 codes+scale alike), plus a LIFO free-list allocator over ids
+    ``0..num_blocks-1`` (no null block — host blocks are never gathered
+    through a block table, only copied wholesale).
+
+    There are no refcounts: a host block has exactly one owner at a time —
+    either a preempted request's demoted KV or a cold prefix-cache chain
+    entry — so ownership transfers are plain id hand-offs.  The scrub
+    contract from the device tier carries over in synchronous form: a block
+    marked dirty (:meth:`mark_dirty`) is zeroed at :meth:`free` time, before
+    it can ever be re-allocated, because host writes are cheap and need no
+    deferred drain stage."""
+
+    def __init__(self, pool: dict, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError(f"host tier needs >= 1 block, got {num_blocks}")
+        self.num_blocks = num_blocks
+        self.leaves: Dict[str, np.ndarray] = {
+            name: np.zeros(
+                (leaf.shape[0], num_blocks) + tuple(leaf.shape[2:]),
+                dtype=np.dtype(leaf.dtype),
+            )
+            for name, leaf in pool.items()
+        }
+        self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+        self._used: set = set()
+        self._dirty: set = set()
+
+    @property
+    def capacity(self) -> int:
+        return self.num_blocks
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return len(self._used)
+
+    @property
+    def occupancy(self) -> float:
+        return len(self._used) / self.num_blocks
+
+    def block_bytes(self) -> int:
+        """Bytes behind ONE host block across every leaf and layer (equal to
+        the device pool's per-block footprint by construction)."""
+        return sum(
+            (leaf.size // self.num_blocks) * leaf.dtype.itemsize
+            for leaf in self.leaves.values()
+        )
+
+    def pool_bytes(self) -> int:
+        return sum(leaf.size * leaf.dtype.itemsize for leaf in self.leaves.values())
+
+    def used_bytes(self) -> int:
+        return len(self._used) * self.block_bytes()
+
+    def alloc(self, n: int = 1) -> List[int]:
+        """Pop ``n`` free host blocks; all-or-nothing like the device
+        allocator so a failed demotion never strands a partial grant."""
+        if n < 0:
+            raise ValueError(f"alloc count must be >= 0, got {n}")
+        if n > len(self._free):
+            raise BlockOutOfMemory(
+                f"host tier needs {n} blocks, {len(self._free)} free of {self.num_blocks}"
+            )
+        out = [self._free.pop() for _ in range(n)]
+        self._used.update(out)
+        return out
+
+    def mark_dirty(self, ids: List[int]) -> None:
+        """Mark host blocks as quarantine-poisoned: they are zeroed at free
+        time, before any reuse (the host half of the two-tier scrub)."""
+        for i in ids:
+            if i in self._used:
+                self._dirty.add(i)
+
+    def free(self, ids: List[int]) -> None:
+        """Return host blocks to the free list, zero-scrubbing dirty ones
+        synchronously.  Freeing an unallocated id is a hard error (tier
+        bookkeeping corruption)."""
+        for i in ids:
+            if i not in self._used:
+                raise ValueError(f"host double free / foreign block: {i}")
+            self._used.discard(i)
+            if i in self._dirty:
+                self._dirty.discard(i)
+                for leaf in self.leaves.values():
+                    leaf[:, i] = 0
+            self._free.append(i)
+
+
 class PrefixCache:
     """Content-addressed cache of full prompt blocks for cross-request
     sharing (see the module docstring for the chain-hash identity and the
@@ -216,6 +332,14 @@ class PrefixCache:
     blocks LRU-first when the allocator needs room.  Evicting a middle chain
     block strands the later entries of that chain (a lookup stops at the
     first miss); they age out of the same LRU order.
+
+    With a host tier attached (:meth:`attach_tier`), eviction pressure
+    **demotes** cold cache-only chains to host DRAM instead of dropping them
+    — the chain key moves to a host-side LRU map, the device block is freed,
+    and a later lookup that walks onto the demoted key **promotes** it back
+    (one device block allocation + wholesale H2D copy) and keeps sharing.
+    The chain-hash identity and the device-side refcounts are untouched; the
+    effective prefix cache simply grows past HBM by the host pool's size.
     """
 
     def __init__(self, allocator: BlockAllocator, block_size: int):
@@ -228,7 +352,23 @@ class PrefixCache:
         # tick, so an O(cached-blocks) refcount scan here would put an O(N)
         # walk on the per-tick host path the allocator promises is O(1).
         self._reclaimable = 0
+        # Host tier: chain key -> host block id, LRU oldest first.  Entries
+        # live in exactly one of _entries / _host_entries at a time.
+        self._host_entries: "OrderedDict[bytes, int]" = OrderedDict()
+        self._kv: Optional["PagedKVCache"] = None
+        # Monotonic tiering counters; the engine publishes per-tick deltas.
+        self.host_demotions = 0
+        self.host_promotions = 0
+        self.host_drops = 0  # evictions that fell through to a plain drop
         allocator.attach_cache(self)
+
+    def attach_tier(self, kv: "PagedKVCache") -> None:
+        """Enable host-tier spillover through ``kv`` (which must have its
+        host tier enabled): eviction demotes instead of dropping, and lookups
+        promote demoted chain entries back on a hit."""
+        if kv.host is None:
+            raise ValueError("attach_tier requires an enabled host tier")
+        self._kv = kv
 
     @staticmethod
     def chain_keys(tokens: List[int], block_size: int, limit: Optional[int] = None) -> List[bytes]:
@@ -257,6 +397,11 @@ class PrefixCache:
         transitions of cached blocks and this cache's own entry churn."""
         return self._reclaimable
 
+    @property
+    def host_count(self) -> int:
+        """Chain entries currently demoted to the host tier."""
+        return len(self._host_entries)
+
     def _note_first_reader(self, block: int) -> None:
         """Allocator hook: a block at refcount 1 gained a reader — if that
         lone reference was ours, the block just stopped being reclaimable."""
@@ -282,22 +427,56 @@ class PrefixCache:
         for key in self.chain_keys(tokens, bs, limit=blocks_for_tokens(max_rows, bs)):
             block = self._entries.get(key)
             if block is None:
+                block = self._promote_entry(key)
+            if block is None:
                 break
+            # Retain NOW, not in a second pass: promoting the NEXT key
+            # allocates a device block, and that allocation may evict
+            # cache-only blocks — an unretained earlier match could be freed
+            # out from under this walk.
+            self.allocator.retain(block)
+            self._entries.move_to_end(key)
             matched.append((key, block))
         if not matched:
             return [], 0, None
         full_usable = min(len(matched), max_rows // bs)
-        blocks = []
-        for key, block in matched[:full_usable]:
-            self.allocator.retain(block)
-            self._entries.move_to_end(key)
-            blocks.append(block)
+        blocks = [block for _, block in matched[:full_usable]]
+        extra = matched[full_usable:]
         cow_src = None
-        if len(matched) > full_usable and max_rows % bs:
-            key, cow_src = matched[full_usable]
-            self.allocator.retain(cow_src)
-            self._entries.move_to_end(key)
+        if extra and max_rows % bs:
+            cow_src = extra[0][1]
+            extra = extra[1:]
+        for _, block in extra:  # matched past the reusable window: release
+            self.allocator.free([block])
         return blocks, full_usable * bs, cow_src
+
+    def _promote_entry(self, key: bytes) -> Optional[int]:
+        """Promote a host-demoted chain entry back to the device tier on a
+        lookup hit: allocate one device block (may itself evict LRU cache
+        blocks; a device OOM degrades to a miss), copy the host block's rows
+        back, and re-enter the device LRU.  Returns the device block, or
+        ``None`` when the key is not host-resident or no device block is
+        reachable."""
+        if self._kv is None:
+            return None
+        host_id = self._host_entries.get(key)
+        if host_id is None:
+            return None
+        try:
+            block = self.allocator.alloc(1)[0]
+        except BlockOutOfMemory:
+            return None
+        self._kv.promote([host_id], [block])
+        del self._host_entries[key]
+        # Same ordering invariant as register(): the alloc granted refcount
+        # 1 and that lone reference is now the cache's, so the block is
+        # reclaimable until the caller retains it (the 1->2 hook then
+        # decrements — net zero).
+        self._entries[key] = block
+        self._by_block[block] = key
+        self._reclaimable += 1
+        self.host_promotions += 1
+        return block
 
     def register(self, chain_key: bytes, block: int) -> bool:
         """Publish a fully-written prompt block under its chain key; returns
@@ -315,19 +494,52 @@ class PrefixCache:
     def evict(self, n: int) -> int:
         """Release up to ``n`` cache-only blocks, least recently used first;
         returns how many were released.  Blocks with live readers are never
-        touched."""
+        touched.  With a host tier attached, a clean victim's content is
+        demoted to host DRAM first (the chain key moves to the host LRU map)
+        so the eviction costs a D2H copy instead of the cached prefix —
+        only when the host tier is also full (or the block is quarantine
+        dirty) does the entry drop outright."""
         released = 0
         for key in list(self._entries):
             if released >= n:
                 break
             block = self._entries[key]
-            if self.allocator.refcount(block) == 1:
-                del self._entries[key]
-                del self._by_block[block]
-                self._reclaimable -= 1
-                self.allocator.free([block])
-                released += 1
+            if self.allocator.refcount(block) != 1:
+                continue
+            if self._kv is not None:
+                host_ids = (
+                    self._kv.try_demote([block])
+                    if not self.allocator.is_dirty(block)
+                    else None  # never spill quarantine-dirty rows to host
+                )
+                if host_ids is not None:
+                    self._host_entries[key] = host_ids[0]
+                    self._host_entries.move_to_end(key)
+                    self.host_demotions += 1
+                else:
+                    self.host_drops += 1
+            del self._entries[key]
+            del self._by_block[block]
+            self._reclaimable -= 1
+            self.allocator.free([block])
+            released += 1
         return released
+
+    def drop_host_entries(self, n: Optional[int] = None) -> int:
+        """Free up to ``n`` host-demoted chain entries (all of them when
+        ``n`` is None), least recently used first; returns how many were
+        dropped.  The engine uses this to reclaim host room for request
+        migrations (a live request outranks a cold cached prefix) and to
+        leave the host tier empty at drain."""
+        dropped = 0
+        for key in list(self._host_entries):
+            if n is not None and dropped >= n:
+                break
+            host_id = self._host_entries.pop(key)
+            if self._kv is not None and self._kv.host is not None:
+                self._kv.host.free([host_id])
+            dropped += 1
+        return dropped
 
     def invalidate_blocks(self, blocks: List[int]) -> None:
         """Drop cached entries for ``blocks`` (quarantine: no new sharers may
@@ -349,6 +561,11 @@ class PagedKVCache:
     the pool leaves are derived from its batch-1 template, so the fp and
     int8-quantized layouts both page without special cases
     (:func:`accelerate_tpu.models.generation.make_paged_pool`).
+
+    With ``num_host_blocks > 0`` (or a later :meth:`enable_host_tier`) the
+    cache carries a second, host-DRAM tier mirroring the pool's leaf layout;
+    :meth:`demote` and :meth:`promote` move whole blocks between the tiers
+    as batched copies on the host path between dispatches.
     """
 
     def __init__(
@@ -357,6 +574,7 @@ class PagedKVCache:
         config,
         num_blocks: int,
         block_size: int,
+        num_host_blocks: int = 0,
     ):
         from ..models.generation import make_paged_pool
 
@@ -365,6 +583,78 @@ class PagedKVCache:
         self.block_size = block_size
         self.allocator = BlockAllocator(num_blocks)
         self.pool = make_paged_pool(init_cache, config, num_blocks, block_size)
+        self.host: Optional[HostBlockPool] = None
+        if num_host_blocks:
+            self.enable_host_tier(num_host_blocks)
+
+    def enable_host_tier(self, num_host_blocks: int) -> HostBlockPool:
+        """Attach a host-DRAM block pool of ``num_host_blocks`` blocks with
+        the same leaf layout as the device pool."""
+        if self.host is not None:
+            raise ValueError("host tier already enabled")
+        self.host = HostBlockPool(self.pool, num_host_blocks)
+        return self.host
+
+    def host_can_fit(self, n: int) -> bool:
+        """Whether a demotion of ``n`` blocks can be granted right now.
+        False when no host tier is attached, when the tier lacks room, or
+        when the ``SERVING_HOST_FULL`` fault arm forces the host-exhausted
+        fallback paths for testing."""
+        if self.host is None or self.host.free_blocks < n:
+            return False
+        from ..resilience import faultinject
+
+        if faultinject.serving_host_full():
+            return False
+        return True
+
+    def demote(self, blocks: List[int]) -> List[int]:
+        """Copy device ``blocks`` into freshly-allocated host blocks (one
+        batched D2H gather per leaf) and return the host ids, in order.  The
+        caller keeps its device references and decides when to release them
+        — demotion is a copy, not a move, so refcounted sharing survives.
+        Raises :class:`BlockOutOfMemory` when the host tier cannot fit."""
+        from ..models.generation import demote_pool_blocks
+
+        if not blocks:
+            return []
+        if not self.host_can_fit(len(blocks)):
+            free = self.host.free_blocks if self.host is not None else 0
+            cap = self.host.capacity if self.host is not None else 0
+            raise BlockOutOfMemory(
+                f"host tier cannot fit {len(blocks)} blocks ({free} free of {cap})"
+            )
+        host_ids = self.host.alloc(len(blocks))
+        rows = demote_pool_blocks(self.pool, blocks)
+        for name, leaf in self.host.leaves.items():
+            leaf[:, host_ids] = rows[name]
+        return host_ids
+
+    def try_demote(self, blocks: List[int]) -> Optional[List[int]]:
+        """:meth:`demote`, returning ``None`` instead of raising when the
+        host tier cannot fit (the waterfall callers fall through to the
+        free/drop path)."""
+        if not self.host_can_fit(len(blocks)):
+            return None
+        return self.demote(blocks)
+
+    def promote(self, host_ids: List[int], dst_blocks: List[int]) -> None:
+        """Copy host blocks back into already-allocated device blocks
+        ``dst_blocks`` (one batched H2D scatter per leaf) and free the host
+        ids.  The caller owns ``dst_blocks``' references."""
+        from ..models.generation import promote_pool_blocks
+
+        if len(host_ids) != len(dst_blocks):
+            raise ValueError(
+                f"promote id mismatch: {len(host_ids)} host vs {len(dst_blocks)} device"
+            )
+        if not host_ids:
+            return
+        if self.host is None:
+            raise ValueError("promote without a host tier")
+        rows = {name: leaf[:, host_ids] for name, leaf in self.host.leaves.items()}
+        self.pool = promote_pool_blocks(self.pool, rows, dst_blocks)
+        self.host.free(host_ids)
 
     @property
     def leaf_names(self) -> list:

@@ -32,6 +32,28 @@ The parent asserts, across the whole campaign:
   counts match the plan, and the SIGKILLed life really died by signal 9.
 
 Fully deterministic for a given ``--seed`` (:func:`plan_serving_campaign`).
+
+``--campaign tiering`` (``make tiering-chaos-smoke``) runs the **tiered**
+campaign instead: the same lineage discipline pointed at the host-DRAM KV
+tier.  A pool tight enough that every life preempts drives four fronts:
+
+1. **memory-pressure life** — preemptions migrate KV blocks to host DRAM
+   and re-admissions promote them back; the parent asserts real migrations
+   happened, every output is token-identical to the offline oracle, and a
+   migrated request that never fell back paid ZERO extra prefill
+   dispatches on resume (the zero-re-prefill contract);
+2. **host-full life** — ``ACCELERATE_TPU_FAULT_SERVING_HOST_FULL`` forces
+   the host-exhausted path: every preemption falls back to PR 9 re-prefill
+   (fallbacks > 0, promotions == 0) and stays token-identical;
+3. **SIGKILL while demoted** — a victim life dies by signal 9 at the exact
+   moment a request's blocks sit in host DRAM; the parent then reads the
+   journal and asserts the ``tier`` record shows ``"host"`` residency;
+4. **recovery** — a finisher life recovers the journal (host DRAM died with
+   the victim, so it re-prefills) and finishes everything token-identically.
+
+Both campaigns run with the host tier enabled; the classic campaign's
+loose pool keeps its exact-shed accounting while exercising construction,
+drain, and recovery with tiering on.
 """
 
 from __future__ import annotations
@@ -98,6 +120,25 @@ def plan_serving_campaign(seed: int) -> dict:
     }
 
 
+def plan_tiering_campaign(seed: int) -> dict:
+    """Deterministic request mix for the tiered campaign: enough concurrent
+    prompts that the 8-usable-block pool must preempt, every request sized
+    to need several blocks (so a migration moves real KV state)."""
+    import random
+
+    rnd = random.Random(seed)
+
+    def prompt(n):
+        return [rnd.randrange(0, 64) for _ in range(n)]
+
+    requests = [
+        {"tag": f"t{i}", "prompt": prompt(rnd.randint(5, 12)),
+         "max_new": rnd.randint(5, 8), "chunk": 4}
+        for i in range(4)
+    ]
+    return {"seed": seed, "requests": requests}
+
+
 # ---------------------------------------------------------------------------
 # Lives (child-process roles)
 # ---------------------------------------------------------------------------
@@ -118,9 +159,36 @@ def _build_engine(journal_path: str, queue_depth: Optional[int] = None):
             block_size=4, num_blocks=40, max_slots=2, prefill_chunk=8,
             max_blocks_per_seq=8, max_queue_depth=queue_depth,
             journal_path=journal_path,
+            # Tiering on even in the classic campaign: the loose pool rarely
+            # preempts (the exact-shed oracles stay untouched — shed is
+            # queue-depth-only), but construction, drain, and recovery all
+            # run with the host tier attached.
+            host_blocks=16,
         ),
     )
     return engine
+
+
+def _build_tiered_engine(journal_path: Optional[str] = None):
+    """The tiering campaign's engine: a pool tight enough (8 usable blocks
+    vs 3 slots) that preemption — and therefore migration — is guaranteed,
+    with host room for every victim."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import gpt2
+    from . import ServingConfig, ServingEngine
+
+    cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32)
+    params = gpt2.init_params(cfg, jax.random.key(0))
+    return ServingEngine(
+        gpt2.apply_cached, gpt2.init_cache, params, cfg,
+        serving=ServingConfig(
+            block_size=4, num_blocks=9, max_slots=3, prefill_chunk=4,
+            max_blocks_per_seq=6, journal_path=journal_path,
+            host_blocks=16,
+        ),
+    )
 
 
 def _emit(out, record: dict) -> None:
@@ -251,6 +319,79 @@ def run_finisher_life(journal_path: str) -> int:
     return 0
 
 
+def _emit_done_tiered(out, c) -> None:
+    _emit(out, {
+        "kind": "done", "tag": c.tag, "status": c.status, "tokens": c.tokens,
+        "migrations": c.migrations, "fallback_reprefills": c.fallback_reprefills,
+        "prefill_dispatches": c.prefill_dispatches, "prompt_len": c.prompt_len,
+    })
+
+
+def _emit_tier_exit(out, engine) -> None:
+    st = engine.stats()["tiering"]
+    prefix_host = engine._prefix.host_count if engine._prefix is not None else 0
+    _emit(out, {
+        "kind": "exit",
+        "tiering": st,
+        "preempted": engine.sched.preempted_count,
+        "free_blocks": engine.cache.allocator.free_blocks,
+        "capacity": engine.cache.allocator.capacity,
+        "host_used": engine.cache.host.used_blocks,
+        "prefix_host_entries": prefix_host,
+    })
+
+
+def run_tier_pressure_life(plan: dict) -> int:
+    """Memory-pressure life: the tight pool preempts, preemption migrates,
+    re-admission promotes.  Also serves as the host-full life when the
+    parent arms ``SERVING_HOST_FULL`` in this child's environment (same
+    code path; the fault flips every migration into a fallback)."""
+    engine = _build_tiered_engine()
+    out = sys.stdout
+    for rec in plan["requests"]:
+        engine.submit(rec["prompt"], rec["max_new"], tag=rec["tag"])
+    engine.run(max_ticks=MAX_TICKS)
+    assert engine.sched.preempted_count > 0, (
+        "tiering life never preempted — the pool is not tight enough"
+    )
+    for c in engine.pop_finished():
+        _emit_done_tiered(out, c)
+    _emit_tier_exit(out, engine)
+    return 0
+
+
+def run_tier_victim_life(plan: dict, journal_path: str) -> int:
+    """SIGKILL-while-demoted: run until some request's KV blocks sit in host
+    DRAM, then die by signal 9 on the spot — the journal's tier record must
+    carry what the host tier cannot (host DRAM dies with this process)."""
+    engine = _build_tiered_engine(journal_path)
+    out = sys.stdout
+    for rec in plan["requests"]:
+        engine.submit(rec["prompt"], rec["max_new"], tag=rec["tag"])
+    for _ in range(MAX_TICKS):
+        engine.step()
+        for c in engine.pop_finished():
+            _emit_done_tiered(out, c)
+        if any(req.demoted_blocks for req in engine.sched.queue):
+            os.kill(os.getpid(), signal.SIGKILL)
+    raise AssertionError(
+        "victim life finished without ever holding a request in the host tier"
+    )
+
+
+def run_tier_finisher_life(journal_path: str) -> int:
+    """Recover the SIGKILLed victim's journal (all host-resident state is
+    gone; re-prefill from the journaled progress) and finish everything."""
+    engine = _build_tiered_engine(journal_path)
+    mapping = engine.recover_from_journal()
+    _emit(sys.stdout, {"kind": "recovered", "count": len(mapping)})
+    engine.run(max_ticks=MAX_TICKS)
+    for c in engine.pop_finished():
+        _emit_done_tiered(sys.stdout, c)
+    _emit_tier_exit(sys.stdout, engine)
+    return 0
+
+
 # ---------------------------------------------------------------------------
 # Orchestration (parent)
 # ---------------------------------------------------------------------------
@@ -260,6 +401,7 @@ def _child_env(extra: Optional[dict] = None) -> dict:
     env = dict(os.environ)
     for key in (
         "ACCELERATE_TPU_FAULT_SERVING_NAN_REQUEST",
+        "ACCELERATE_TPU_FAULT_SERVING_HOST_FULL",
         "ACCELERATE_TPU_TELEMETRY",
         "ACCELERATE_TPU_TELEMETRY_DIR",
         "XLA_FLAGS",  # token identity across lives needs ONE device layout
@@ -416,12 +558,142 @@ def run_serving_campaign(seed: int, workdir: Optional[str] = None) -> dict:
     }
 
 
+def run_tiering_campaign(seed: int, workdir: Optional[str] = None) -> dict:
+    """The tiered chaos campaign; asserts every oracle, returns a summary."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import gpt2
+    from .journal import ServingJournal
+
+    work = workdir or tempfile.mkdtemp(prefix="atpu_tiering_chaos_")
+    os.makedirs(work, exist_ok=True)
+    plan = plan_tiering_campaign(seed)
+    plan_path = os.path.join(work, "plan.json")
+    with open(plan_path, "w") as f:
+        json.dump(plan, f)
+    journal_path = os.path.join(work, "journal.json")
+
+    cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32)
+    params = gpt2.init_params(cfg, jax.random.key(0))
+    oracle = {}
+    for rec in plan["requests"]:
+        out = gpt2.generate(
+            params, jnp.asarray([rec["prompt"]], jnp.int32), cfg,
+            max_new_tokens=rec["max_new"],
+        )
+        oracle[rec["tag"]] = [int(t) for t in np.asarray(out[0])]
+    all_tags = {r["tag"] for r in plan["requests"]}
+    by_kind = lambda recs, kind: [r for r in recs if r["kind"] == kind]
+
+    def check_identity(done_recs):
+        for r in done_recs:
+            assert r["status"] == "ok", f"request {r['tag']} ended {r['status']}"
+            assert r["tokens"] == oracle[r["tag"]], (
+                f"request {r['tag']} diverged from generate_loop:\n"
+                f"  got  {r['tokens']}\n  want {oracle[r['tag']]}"
+            )
+
+    # -- life 0: memory pressure (preempt -> demote -> promote -> resume) ----
+    print(f"# tiering-chaos: life 0 (memory pressure: preemption as migration), "
+          f"seed {seed}", file=sys.stderr)
+    recs0 = _spawn("tier-pressure", plan_path, journal_path)
+    done0 = by_kind(recs0, "done")
+    assert {r["tag"] for r in done0} == all_tags, "life 0 starved a request"
+    check_identity(done0)
+    exit0 = by_kind(recs0, "exit")[0]
+    st0 = exit0["tiering"]
+    assert st0["demotions"] > 0 and st0["promotions"] > 0, (
+        f"pressure life never migrated: {st0}"
+    )
+    migrated0 = [r for r in done0 if r["migrations"] > 0]
+    assert migrated0, "no request round-tripped through the host tier"
+    for r in migrated0:
+        if r["fallback_reprefills"] == 0:
+            base = -(-r["prompt_len"] // 4)  # ceil(prompt / prefill_chunk)
+            assert r["prefill_dispatches"] == base, (
+                f"{r['tag']} re-prefilled on the migrated resume path: "
+                f"{r['prefill_dispatches']} dispatches vs {base}"
+            )
+    assert exit0["host_used"] == exit0["prefix_host_entries"], (
+        f"life 0 leaked host blocks: {exit0}"
+    )
+    assert exit0["free_blocks"] == exit0["capacity"], f"life 0 leaked: {exit0}"
+
+    # -- life 1: host tier full (fault-forced fallback re-prefill) -----------
+    print("# tiering-chaos: life 1 (SERVING_HOST_FULL: forced fallback re-prefill)",
+          file=sys.stderr)
+    recs1 = _spawn(
+        "tier-pressure", plan_path, journal_path,
+        extra_env={"ACCELERATE_TPU_FAULT_SERVING_HOST_FULL": "1"},
+    )
+    done1 = by_kind(recs1, "done")
+    assert {r["tag"] for r in done1} == all_tags, "host-full life starved a request"
+    check_identity(done1)
+    st1 = by_kind(recs1, "exit")[0]["tiering"]
+    assert st1["fallback_reprefills"] > 0, (
+        f"host-full fault never forced a fallback: {st1}"
+    )
+    assert st1["promotions"] == 0, f"a promotion happened with the host full: {st1}"
+
+    # -- lives 2+3: SIGKILL while demoted, then journal recovery -------------
+    print("# tiering-chaos: life 2 (SIGKILL at the instant a request is "
+          "host-resident)", file=sys.stderr)
+    recs2 = _spawn(
+        "tier-victim", plan_path, journal_path, expect_rc=-signal.SIGKILL,
+    )
+    # The victim died with blocks in host DRAM: its journal must say so.
+    state = ServingJournal.load(journal_path)
+    host_resident = [
+        rid for rid, rec in state["requests"].items()
+        if rec.get("tier", {}).get("residency") == "host"
+        and rid not in state["done"]
+    ]
+    assert host_resident, (
+        "victim's journal carries no host-resident tier record at the kill"
+    )
+
+    print("# tiering-chaos: life 3 (journal recovery: host state is gone, "
+          "re-prefill finishes everything)", file=sys.stderr)
+    recs3 = _spawn("tier-finisher", plan_path, journal_path)
+    done: dict[str, dict] = {}
+    for r in by_kind(recs2, "done") + by_kind(recs3, "done"):
+        assert r["tag"] not in done, f"request {r['tag']} completed twice"
+        done[r["tag"]] = r
+    assert set(done) == all_tags, (
+        f"starvation across the kill: {all_tags - set(done)}"
+    )
+    check_identity(done.values())
+    exit3 = by_kind(recs3, "exit")[0]
+    assert exit3["free_blocks"] == exit3["capacity"], f"life 3 leaked: {exit3}"
+    assert exit3["host_used"] == exit3["prefix_host_entries"], (
+        f"life 3 leaked host blocks: {exit3}"
+    )
+
+    return {
+        "seed": seed,
+        "requests": len(all_tags),
+        "migrations": st0["demotions"],
+        "promotions": st0["promotions"],
+        "fallbacks_forced": st1["fallback_reprefills"],
+        "host_resident_at_kill": len(host_resident),
+        "workdir": work,
+    }
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m accelerate_tpu.serving.chaos",
     )
-    parser.add_argument("--role", choices=("first", "victim", "finisher"),
+    parser.add_argument("--role",
+                        choices=("first", "victim", "finisher",
+                                 "tier-pressure", "tier-victim",
+                                 "tier-finisher"),
                         default=None)
+    parser.add_argument("--campaign", choices=("serving", "tiering"),
+                        default="serving")
     parser.add_argument("--plan", default=None)
     parser.add_argument("--journal", default=None)
     parser.add_argument("--kill-after", type=int, default=1)
@@ -436,7 +708,28 @@ def main(argv=None) -> int:
             return run_first_life(plan, args.journal)
         if args.role == "victim":
             return run_victim_life(args.journal, args.kill_after)
-        return run_finisher_life(args.journal)
+        if args.role == "finisher":
+            return run_finisher_life(args.journal)
+        if args.role == "tier-pressure":
+            return run_tier_pressure_life(plan)
+        if args.role == "tier-victim":
+            return run_tier_victim_life(plan, args.journal)
+        return run_tier_finisher_life(args.journal)
+
+    if args.campaign == "tiering":
+        summary = run_tiering_campaign(args.seed)
+        print(
+            f"tiering-chaos-smoke OK — seed {summary['seed']}: "
+            f"{summary['requests']} requests under memory pressure "
+            f"({summary['migrations']} demotions / {summary['promotions']} "
+            f"promotions through the host tier, zero re-prefill on migrated "
+            f"resumes), a host-full life ({summary['fallbacks_forced']} forced "
+            f"fallback re-prefills), and a SIGKILL landed while "
+            f"{summary['host_resident_at_kill']} request(s) sat host-resident "
+            "+ journal recovery; every output token-identical to "
+            "generate_loop, zero block leaks in either tier"
+        )
+        return 0
 
     summary = run_serving_campaign(args.seed)
     print(

@@ -167,6 +167,18 @@ class ServingConfig:
       applied to requests that do not pass their own (None = no deadline).
     - ``journal_path``: arm the crash-recovery write-ahead journal at this
       path (see ``serving/journal.py``).
+    - ``host_blocks``: size of the host-DRAM KV tier (0 = disabled, the
+      pre-tiering behavior).  With a tier, preemption **demotes** the
+      victim's blocks to host memory instead of freeing them (re-admission
+      promotes and resumes with zero re-prefill dispatches), cold
+      prefix-cache chains demote on eviction pressure instead of dropping,
+      and the free-and-re-prefill path survives only as the fallback when
+      the host tier is full.  Host-side policy plus batched D2H/H2D copies
+      between dispatches — the compiled programs are identical either way.
+    - ``tier_demote_batch``: max cold prefix chains proactively demoted per
+      tick when the allocator's raw free list falls under the headroom
+      watermark (demote-before-shed; 0 disables the proactive sweep —
+      on-demand demotion inside eviction still applies).
 
     Decode fast-path knobs:
 
@@ -217,6 +229,8 @@ class ServingConfig:
     default_ttft_deadline_ms: Optional[float] = None
     default_deadline_ms: Optional[float] = None
     journal_path: Optional[str] = None
+    host_blocks: int = 0
+    tier_demote_batch: int = 8
     decode_path: str = "paged"
     paged_kernel: bool = False
     prefix_cache: bool = True
@@ -254,6 +268,13 @@ class CompletedRequest:
     inter_token_ms: List[float] = field(default_factory=list)
     status: str = "ok"
     tag: Optional[str] = None
+    # KV-tiering accounting: host-tier round-trips this request survived,
+    # times the host tier was full so a preemption fell back to the plain
+    # re-prefill, and prefill dispatches it consumed in total (the
+    # zero-re-prefill oracle: a migrated resume adds none).
+    migrations: int = 0
+    fallback_reprefills: int = 0
+    prefill_dispatches: int = 0
 
 
 class ServingEngine:
@@ -292,11 +313,16 @@ class ServingEngine:
             raise ValueError("max_blocks_per_seq must be >= 1")
         if sc.spec_tokens < 0:
             raise ValueError(f"spec_tokens must be >= 0, got {sc.spec_tokens}")
+        if sc.host_blocks < 0:
+            raise ValueError(f"host_blocks must be >= 0, got {sc.host_blocks}")
         self._apply_cached = apply_cached
         self._config = config
         self.params = params
         self.spec_tokens = int(sc.spec_tokens)
-        self.cache = PagedKVCache(init_cache, config, sc.num_blocks, sc.block_size)
+        self.cache = PagedKVCache(
+            init_cache, config, sc.num_blocks, sc.block_size,
+            num_host_blocks=sc.host_blocks,
+        )
         self.sched = Scheduler(
             self.cache.allocator,
             num_slots=sc.max_slots,
@@ -333,6 +359,15 @@ class ServingEngine:
         self.prefix_blocks_reused = 0
         self.cow_copies = 0
         self.decode_gather_bytes = 0
+        # KV-tiering accounting (engine-side migrations; the prefix cache's
+        # own demote/promote churn is folded in at publish time).
+        self.tier_demotions = 0
+        self.tier_promotions = 0
+        self.tier_demoted_blocks = 0
+        self.tier_fallback_reprefills = 0
+        self._prefix_demotions_published = 0
+        self._prefix_promotions_published = 0
+        self._draining = False
         self._submissions = 0
         self._recovering = False
         # NaN poison injection is gated at TRACE time (the train-step trick):
@@ -362,6 +397,14 @@ class ServingEngine:
             PrefixCache(self.cache.allocator, sc.block_size)
             if sc.prefix_cache else None
         )
+        if self.cache.host is not None:
+            # Wire the tiering policies in: eviction pressure demotes cold
+            # prefix chains instead of dropping them, and preemption demotes
+            # the victim's KV instead of freeing it (the scheduler falls
+            # back to the plain free-and-re-prefill when the hook declines).
+            if self._prefix is not None:
+                self._prefix.attach_tier(self.cache)
+            self.sched.on_migrate_out = self._migrate_out
         # Per-request phase tracing (host-side interval bookkeeping only).
         # The scheduler's preemption callback is the one eviction site every
         # preemption flavor funnels through (drain, block pressure, LIFO
@@ -411,6 +454,23 @@ class ServingEngine:
         weakref.finalize(self, ledger.unregister, "serving.kv_pool", pool_token)
         weakref.finalize(self, ledger.unregister, "serving.prefix_cache", prefix_token)
         self._memledger_tokens = (pool_token, prefix_token)
+        if self.cache.host is not None:
+            # The host tier's backing arrays live for the engine's life, so
+            # the reservation is static — and it charges host DRAM, not HBM
+            # (per_device stays empty; the conservation residual must not
+            # absorb bytes that never touched a device).
+            host_token = ledger.register(
+                "serving.kv_host",
+                per_device={},
+                host_bytes=self.cache.host.pool_bytes(),
+                detail={
+                    "host_blocks": sc.host_blocks,
+                    "block_size": sc.block_size,
+                    "block_bytes": self._block_bytes,
+                },
+            )
+            weakref.finalize(self, ledger.unregister, "serving.kv_host", host_token)
+            self._memledger_tokens = (pool_token, prefix_token, host_token)
         self._low_headroom = False
         try:
             self._headroom_watermark_frac = float(
@@ -418,6 +478,11 @@ class ServingEngine:
             )
         except ValueError:
             self._headroom_watermark_frac = 0.1
+        # Hysteresis band for re-arming the low-headroom event: re-arm only
+        # after the pool recovers ABOVE 1.5x the watermark, so a pool
+        # oscillating right at the line emits one event per genuine pressure
+        # episode instead of one per tick-scale wobble.
+        self._headroom_rearm_frac = min(self._headroom_watermark_frac * 1.5, 1.0)
         if self.decode_path == "paged":
             # One jitted wrapper each; bucketed table widths retrace under it
             # (jit caches per shape), so a tick is still exactly one decode
@@ -459,10 +524,14 @@ class ServingEngine:
                 "serving.prefix_cow_copies", "serving.decode_gather_bytes",
                 "serving.spec.proposed", "serving.spec.accepted",
                 "serving.spec.rounds",
+                "serving.tier.demotions", "serving.tier.promotions",
+                "serving.tier.demoted_blocks", "serving.tier.fallback_reprefills",
             ):
                 tel.registry.counter(name)
             tel.registry.gauge("serving.spec.acceptance_rate").set(0.0)
             tel.registry.gauge("serving.tokens_per_dispatch").set(0.0)
+            tel.registry.gauge("serving.tier.host_bytes").set(0)
+            tel.registry.gauge("serving.tier.host_occupancy").set(0.0)
 
     # -- compiled programs ---------------------------------------------------
 
@@ -739,11 +808,22 @@ class ServingEngine:
         # Deadline expiry FIRST: an expired queued request is shed before a
         # slot, a prefill chunk, or any blocks are spent on it.
         self._expire_deadlines(now)
+        # Demote-before-shed: with the raw free list under the watermark,
+        # batch-demote cold prefix chains to host DRAM BEFORE admission, so
+        # the allocations this tick makes hit the free list instead of
+        # dropping cached content on demand.
+        self._pressure_relief()
         admitted = self.sched.admit(now)
         if self.tracer is not None:
             admit_t = time.monotonic()
             for idx in admitted:
                 self.tracer.on_admit(self.sched.slots[idx].request, admit_t, idx)
+        for idx in admitted:
+            # Host-tier round-trip first: a re-admitted migration victim
+            # promotes its demoted KV back and resumes exactly where it
+            # stopped (zero re-prefill dispatches); _attach_prefix then
+            # skips it (its cache_len is already set).
+            self._promote_admitted(idx)
         for idx in admitted:
             self._attach_prefix(idx)
         self._observe_requeue_waits(admitted)
@@ -798,8 +878,17 @@ class ServingEngine:
         ``serving.drained`` event.  Idempotent; returns the journal."""
         if self._drained:
             return self.requeue_journal or []
+        # Migration is pointless past this line: host DRAM dies with the
+        # process, so demoting a drained slot would spend a D2H copy on
+        # bytes no successor can read — and leak the host blocks at exit.
+        # The flag makes _migrate_out decline; every slot takes the classic
+        # free-and-requeue path, and already-demoted queued victims release
+        # their host blocks below (the journal recorded their progress).
+        self._draining = True
         while self.sched.slots:
             self.sched.preempt_one()
+        for req in self.sched.queue:
+            self._release_demoted(req)
         journal = [
             {
                 "id": req.id,
@@ -912,6 +1001,141 @@ class ServingEngine:
             )
         return mapping
 
+    # -- KV tiering (host-DRAM second tier) ----------------------------------
+
+    def _migrate_out(self, slot) -> bool:
+        """Preemption-as-migration (the scheduler's ``on_migrate_out`` hook):
+        copy the victim slot's blocks to the host tier, release the device
+        references, and stash the host ids + resume state on the request —
+        re-admission then promotes and resumes with zero re-prefill
+        dispatches.  Declines (→ plain free-and-re-prefill) during a drain
+        (host DRAM dies with the process; demoting would waste a copy and
+        leak at exit), when any block is quarantine-dirty (a possibly
+        poisoned block must be rebuilt clean, never tiered), or when the
+        host tier cannot fit even after dropping cold cached prefixes (a
+        live request outranks a cold chain)."""
+        req = slot.request
+        blocks = slot.blocks
+        if self._draining or not blocks:
+            return False
+        alloc = self.cache.allocator
+        tel = get_telemetry()
+        if any(alloc.is_dirty(b) for b in blocks):
+            req.fallback_reprefills += 1
+            self.tier_fallback_reprefills += 1
+            if tel.enabled:
+                tel.registry.counter("serving.tier.fallback_reprefills").inc()
+            return False
+        n = len(blocks)
+        if not self.cache.host_can_fit(n) and self._prefix is not None and self.cache.host is not None:
+            need = n - self.cache.host.free_blocks
+            if 0 < need <= self._prefix.host_count:
+                self._prefix.drop_host_entries(need)
+        if not self.cache.host_can_fit(n):
+            req.fallback_reprefills += 1
+            self.tier_fallback_reprefills += 1
+            if tel.enabled:
+                tel.registry.counter("serving.tier.fallback_reprefills").inc()
+            return False
+        host_ids = self.cache.demote(blocks)
+        req.demoted_blocks = host_ids
+        req.demoted_rows = slot.cache_len
+        req.demoted_registered = slot.registered_blocks
+        req.migrations += 1
+        alloc.free(blocks)  # demotion copied; release the slot's device refs
+        self.tier_demotions += 1
+        self.tier_demoted_blocks += n
+        if tel.enabled:
+            tel.registry.counter("serving.tier.demotions").inc()
+            tel.registry.counter("serving.tier.demoted_blocks").inc(n)
+        if self.journal is not None:
+            self.journal.record_tier(req, "host")
+        return True
+
+    def _promote_admitted(self, idx: int) -> None:
+        """Re-admission half of preemption-as-migration: allocate device
+        blocks for a demoted request, copy its KV back from the host tier,
+        and restore the slot exactly as preemption found it — cache_len,
+        registration cursor, and DECODING state when the cache already
+        covers every fed token but the last emitted one (the decode
+        invariant), so no prefill dispatch is ever spent on the resume.
+        When the device pool cannot grant the blocks, the request falls
+        back to the PR 9 re-prefill (host blocks released, counted)."""
+        slot = self.sched.slots.get(idx)
+        if slot is None:
+            return
+        req = slot.request
+        host_ids = req.demoted_blocks
+        if not host_ids:
+            return
+        tel = get_telemetry()
+        try:
+            dst = self.cache.allocator.alloc(len(host_ids))
+        except BlockOutOfMemory:
+            self._release_demoted(req)
+            req.fallback_reprefills += 1
+            self.tier_fallback_reprefills += 1
+            if tel.enabled:
+                tel.registry.counter("serving.tier.fallback_reprefills").inc()
+            if self.journal is not None:
+                self.journal.record_tier(req, "device")
+            return
+        self.cache.promote(host_ids, dst)
+        slot.blocks = dst
+        slot.cache_len = req.demoted_rows
+        slot.registered_blocks = req.demoted_registered
+        req.demoted_blocks = None
+        req.demoted_rows = 0
+        req.demoted_registered = 0
+        if req.emitted and slot.cache_len == len(req.to_feed) - 1:
+            # Mid-decode victim: the only unwritten row is the last emitted
+            # token's (the next decode dispatch writes it) — resume DECODING
+            # with zero re-prefill dispatches.
+            req.state = RequestState.DECODING
+        # else: mid-prefill victim — admit() already set PREFILLING; the
+        # next chunk continues from cache_len, no rows recomputed.
+        self.tier_promotions += 1
+        if tel.enabled:
+            tel.registry.counter("serving.tier.promotions").inc()
+        if self.journal is not None:
+            self.journal.record_tier(req, "device")
+
+    def _release_demoted(self, req: Request, dirty: bool = False) -> None:
+        """Free a request's demoted host blocks (deadline expiry of a queued
+        victim, promotion fallback, drain, or defensively at quarantine).
+        ``dirty=True`` routes them through the host tier's synchronous
+        zero-scrub — the host half of the two-tier scrub contract."""
+        if req.demoted_blocks:
+            if dirty:
+                self.cache.host.mark_dirty(req.demoted_blocks)
+            self.cache.host.free(req.demoted_blocks)
+        req.demoted_blocks = None
+        req.demoted_rows = 0
+        req.demoted_registered = 0
+
+    def _pressure_relief(self) -> None:
+        """Proactive demote-before-shed: when the allocator's RAW free list
+        (free_blocks minus reclaimable cache blocks) falls under the
+        headroom watermark, demote up to ``tier_demote_batch`` cold cache
+        chains to host DRAM in one batch — the D2H copies happen here, off
+        the allocation path, so this tick's grants pop the free list instead
+        of dropping cached prefixes on demand.  The admission waterfall is
+        demote → evict-drop (host full) → preempt-migrate → preempt-free
+        (fallback) → terminal OOM."""
+        if (
+            self._prefix is None
+            or self.cache.host is None
+            or self.serving.tier_demote_batch <= 0
+        ):
+            return
+        alloc = self.cache.allocator
+        raw_free = alloc.free_blocks - self._prefix.reclaimable_count
+        if raw_free / max(alloc.capacity, 1) >= self._headroom_watermark_frac:
+            return
+        reclaim = min(self.serving.tier_demote_batch, self._prefix.reclaimable_count)
+        if reclaim > 0:
+            self._prefix.evict(reclaim)
+
     # -- deadline / quarantine enforcement -----------------------------------
 
     def _observe_requeue_waits(self, admitted: List[int]) -> None:
@@ -944,6 +1168,9 @@ class ServingEngine:
                 self._finish_expired(req, now)
 
     def _finish_expired(self, req: Request, now: float) -> None:
+        # A queued migration victim dies with KV still in the host tier —
+        # release it or the tier leaks a dead request's blocks forever.
+        self._release_demoted(req)
         req.state = RequestState.DONE
         req.finish_t = now
         self.deadline_expired_count += 1
@@ -980,6 +1207,10 @@ class ServingEngine:
             self._prefix.invalidate_blocks(slot.blocks)
         self.cache.allocator.mark_dirty(slot.blocks)
         req = self.sched.finish(idx, now)
+        # Defensive: a slotted request holds no demoted blocks by invariant
+        # (promotion clears them at admission), but if any exist they route
+        # through the host tier's dirty scrub — the two-tier contract.
+        self._release_demoted(req, dirty=True)
         # Unshared blocks just hit refcount 0 and are scrubbed right here;
         # the null block is always included (a poisoned request's padded
         # prefill rows scatter past its table into block 0).
@@ -1032,6 +1263,10 @@ class ServingEngine:
             return
         slot = self.sched.slots.get(idx)
         if slot is None:
+            return
+        if slot.blocks:
+            # A promoted migration victim already owns its table and
+            # cache_len — the cached-prefix attach is for EMPTY slots only.
             return
         feed = slot.request.to_feed
         max_rows = len(feed) - 1
@@ -1175,6 +1410,7 @@ class ServingEngine:
             np.int32(n_real),
         )
         self.prefill_dispatches += 1
+        req.prefill_dispatches += 1  # per-request: the zero-re-prefill oracle
         tel = get_telemetry()
         if tel.enabled:
             tel.registry.counter("serving.prefill_dispatches").inc()
@@ -1410,6 +1646,9 @@ class ServingEngine:
             inter_token_ms=list(req.inter_token_ms),
             status=status,
             tag=req.tag,
+            migrations=req.migrations,
+            fallback_reprefills=req.fallback_reprefills,
+            prefill_dispatches=req.prefill_dispatches,
         )
         self._finished.append(rec)
         if self.journal is not None:
@@ -1476,9 +1715,13 @@ class ServingEngine:
         if hbm_free is not None:
             headroom = min(headroom, hbm_free)
         reg.gauge("serving.headroom_bytes").set(headroom)
-        # Low-headroom watermark (item 3's future tiering control signal):
-        # one event per crossing, re-armed only after occupancy recovers —
-        # a pool hovering at the line must not spam the ring.
+        # Low-headroom watermark (the tiering control signal): one event per
+        # pressure EPISODE, with hysteresis — the event re-arms only after
+        # free capacity recovers above the re-arm line (1.5x the watermark,
+        # capped at 1.0), so a pool oscillating right at the watermark
+        # cannot spam the ring, while each genuine dip-recover-dip cycle
+        # under tiering emits its own event instead of being silently
+        # swallowed after the first.
         free_frac = alloc.free_blocks / max(alloc.capacity, 1)
         if free_frac < self._headroom_watermark_frac:
             if not self._low_headroom:
@@ -1491,8 +1734,25 @@ class ServingEngine:
                     capacity=alloc.capacity,
                     watermark_frac=self._headroom_watermark_frac,
                 )
-        elif self._low_headroom:
+        elif self._low_headroom and free_frac >= self._headroom_rearm_frac:
             self._low_headroom = False
+        # KV host tier: occupancy gauges plus the prefix cache's own
+        # demote/promote churn (which happens inside allocator eviction,
+        # out of counter reach) folded into the tier counters as deltas.
+        host = self.cache.host
+        if host is not None:
+            reg.gauge("serving.tier.host_bytes").set(host.used_bytes())
+            reg.gauge("serving.tier.host_occupancy").set(round(host.occupancy, 4))
+            if self._prefix is not None:
+                d = self._prefix.host_demotions - self._prefix_demotions_published
+                if d > 0:
+                    reg.counter("serving.tier.demotions").inc(d)
+                    reg.counter("serving.tier.demoted_blocks").inc(d)
+                self._prefix_demotions_published = self._prefix.host_demotions
+                p = self._prefix.host_promotions - self._prefix_promotions_published
+                if p > 0:
+                    reg.counter("serving.tier.promotions").inc(p)
+                self._prefix_promotions_published = self._prefix.host_promotions
         # Publish only preemptions since the last publish: a registry.reset()
         # (e.g. scoping a measurement window) must not be re-inflated with
         # engine-lifetime history.
@@ -1571,6 +1831,23 @@ class ServingEngine:
                     for b in self._prefix._entries.values()
                 ],
             }
+            if self.cache.host is not None:
+                out["prefix_cache"]["host_entries"] = self._prefix.host_count
+        if self.cache.host is not None:
+            host = self.cache.host
+            out["host_tier"] = {
+                "capacity": host.capacity,
+                "free": host.free_blocks,
+                "used": host.used_blocks,
+                "occupancy": round(host.occupancy, 4),
+                # Which live requests currently own host-resident blocks
+                # (demoted mid-flight, awaiting re-admission).
+                "demoted_requests": {
+                    str(req.id): len(req.demoted_blocks or ())
+                    for req in self.sched.queue
+                    if req.demoted_blocks
+                },
+            }
         return out
 
     def export_chrome_trace(self, path: str) -> str:
@@ -1621,6 +1898,30 @@ class ServingEngine:
                     self.decode_emitted_tokens / max(self.decode_slot_ticks, 1), 4
                 ),
             },
+            "tiering": (
+                {
+                    "host_blocks": self.cache.host.capacity,
+                    "host_used": self.cache.host.used_blocks,
+                    "host_free": self.cache.host.free_blocks,
+                    "host_occupancy": round(self.cache.host.occupancy, 4),
+                    "host_bytes": self.cache.host.used_bytes(),
+                    "demotions": self.tier_demotions
+                    + (self._prefix.host_demotions if self._prefix else 0),
+                    "promotions": self.tier_promotions
+                    + (self._prefix.host_promotions if self._prefix else 0),
+                    "demoted_blocks": self.tier_demoted_blocks
+                    + (self._prefix.host_demotions if self._prefix else 0),
+                    "fallback_reprefills": self.tier_fallback_reprefills,
+                    "prefix_host_entries": (
+                        self._prefix.host_count if self._prefix else 0
+                    ),
+                    "prefix_host_drops": (
+                        self._prefix.host_drops if self._prefix else 0
+                    ),
+                }
+                if self.cache.host is not None
+                else None
+            ),
             "trace_blame": (
                 dict(self.tracer.blame_counts) if self.tracer is not None else None
             ),

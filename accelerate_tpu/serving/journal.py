@@ -34,6 +34,16 @@ What is journaled when:
 Emitted tokens are deliberately NOT journaled per decode tick: that would
 put a disk write on the hot path, and recovery does not need it for
 token-identity — only for avoiding recompute, which the drain path covers.
+
+**Tier residency** (``record_tier``): when the KV host tier migrates a
+preempted request's blocks to host DRAM (and again when they promote back),
+the request's entry gains a ``tier`` record — residency (``"host"`` /
+``"device"``), demoted row count, and emitted-token progress at migration
+time.  Host DRAM dies with the process, so a successor can never reload the
+demoted bytes; the record exists so recovery can rebuild *either way* (the
+emitted progress rides along exactly like a drain's ``record_progress``) and
+so post-mortem forensics can see which requests were host-resident at the
+kill.  Same schema version — readers ignore keys they do not use.
 """
 
 from __future__ import annotations
@@ -115,6 +125,24 @@ class ServingJournal:
 
     def record_done(self, rid: int, status: str) -> None:
         self._done[str(rid)] = status
+        self._flush()
+
+    def record_tier(self, req, residency: str) -> None:
+        """Persist a request's KV tier residency transition (``"host"`` on
+        demotion, ``"device"`` on promotion or fallback re-prefill), plus its
+        emitted progress at that moment — so a successor resumes a killed
+        host-resident request from its last migration point instead of the
+        bare prompt, exactly as if a drain had recorded progress."""
+        entry = self._requests.get(str(req.id))
+        if entry is None:
+            return
+        entry["tier"] = {
+            "residency": residency,
+            "demoted_rows": int(req.demoted_rows),
+            "demoted_blocks": len(req.demoted_blocks or ()),
+            "migrations": int(req.migrations),
+        }
+        entry["emitted"] = list(req.emitted)
         self._flush()
 
     def record_progress(self, reqs) -> None:

@@ -17,6 +17,13 @@ bit-identical cache (K/V rows depend only on the prefix), so preemption never
 changes a request's output — the equivalence oracle in
 ``tests/test_serving.py`` covers exactly this path.
 
+With the engine's KV host tier enabled, preemption first offers the victim to
+the ``on_migrate_out`` hook: the engine demotes the victim's blocks to host
+DRAM (stashing the host ids on the request) before the device references are
+released, and re-admission promotes them back and resumes decode with zero
+re-prefill dispatches.  The free-and-re-prefill path above survives as the
+fallback whenever the host tier cannot take the blocks.
+
 The scheduler is pure host-side bookkeeping: admission/preemption decisions
 happen between dispatches and the jitted decode step never sees them (slots
 simply flip their active mask)."""
@@ -94,6 +101,23 @@ class Request:
         # ``serving.requeue_wait_ms`` histogram (one sample per re-admission).
         self.requeued_t: Optional[float] = None
         self.requeue_waits_ms: List[float] = []
+        # KV host-tier residency (engine/blocks.py tiering): while the
+        # request sits re-queued after a preemption-as-migration, its cache
+        # lives in host DRAM as ``demoted_blocks`` (host block ids, table
+        # order) covering ``demoted_rows`` cache rows with the prefix-cache
+        # registration cursor parked at ``demoted_registered``.  Re-admission
+        # promotes the blocks back and restores the slot exactly; the fields
+        # clear on promotion (or on the host-full re-prefill fallback).
+        self.demoted_blocks: Optional[List[int]] = None
+        self.demoted_rows = 0
+        self.demoted_registered = 0
+        # Robustness accounting: prefill dispatches this request consumed
+        # (the zero-re-prefill oracle for migrated resumes), migrations it
+        # survived, and times the host tier was full so it fell back to a
+        # plain re-prefill.
+        self.prefill_dispatches = 0
+        self.migrations = 0
+        self.fallback_reprefills = 0
 
     def pop_requeue_waits(self) -> List[float]:
         out, self.requeue_waits_ms = self.requeue_waits_ms, []
@@ -182,6 +206,12 @@ class Scheduler:
         # (the engine wires its tracer here — one site sees the LIFO victim,
         # the self-preemption, and the drain flavors alike).
         self.on_preempt: Optional[Callable[[Request], None]] = None
+        # Migration hook: offered the victim's slot BEFORE its blocks are
+        # freed.  Returning True means the hook demoted the KV to the host
+        # tier and released the device references itself (the request now
+        # carries ``demoted_blocks``); False falls through to the plain
+        # free-and-re-prefill preemption.
+        self.on_migrate_out: Optional[Callable[[_Slot], bool]] = None
 
     # -- capacity validation -------------------------------------------------
 
@@ -270,10 +300,14 @@ class Scheduler:
     def preempt_slot(self, idx: int) -> int:
         """Evict slot ``idx`` specifically (the LIFO victim policy lives in
         :meth:`preempt_one`; the engine's graceful drain evicts EVERY slot):
-        free its blocks and requeue the request at the FRONT, emitted tokens
-        carried."""
+        demote its blocks to the host tier when the ``on_migrate_out`` hook
+        accepts the victim, else free them; either way the request re-enters
+        the queue FRONT, emitted tokens carried."""
         slot = self.slots.pop(idx)
-        if slot.blocks:
+        migrated = False
+        if slot.blocks and self.on_migrate_out is not None:
+            migrated = self.on_migrate_out(slot)
+        if slot.blocks and not migrated:
             self.allocator.free(slot.blocks)
         req = slot.request
         req.state = RequestState.QUEUED

@@ -69,6 +69,10 @@ COUNTERS = frozenset({
     "serving.spec.accepted",
     "serving.spec.proposed",
     "serving.spec.rounds",
+    "serving.tier.demoted_blocks",
+    "serving.tier.demotions",
+    "serving.tier.fallback_reprefills",
+    "serving.tier.promotions",
     "serving.tokens",
     "stall.count",
     "step.count",
@@ -115,6 +119,8 @@ GAUGES = frozenset({
     "serving.slo.inter_token_target_ms",
     "serving.slo.inter_token_burn_rate",
     "serving.spec.acceptance_rate",
+    "serving.tier.host_bytes",
+    "serving.tier.host_occupancy",
     "serving.tokens_per_dispatch",
     "step.mfu",
     "step.tokens_per_sec",

@@ -542,6 +542,22 @@ def format_serving_block(snapshot) -> list:
             f"(occupancy {occ:.1%}), queue depth {g('serving.queue_depth', 0)}, "
             f"active slots {g('serving.active_slots', 0)}"
         )
+    demotions = g("serving.tier.demotions", 0)
+    promotions = g("serving.tier.promotions", 0)
+    fallbacks = g("serving.tier.fallback_reprefills", 0)
+    if demotions or promotions or fallbacks:
+        line = (
+            f"  kv tiering: {demotions} demotions / {promotions} promotions "
+            f"({g('serving.tier.demoted_blocks', 0)} blocks to host, "
+            f"{fallbacks} fallback re-prefills)"
+        )
+        host_bytes = g("serving.tier.host_bytes")
+        if host_bytes is not None:
+            line += (
+                f"; host tier {_human(host_bytes)}B resident "
+                f"({g('serving.tier.host_occupancy', 0.0):.1%} occupancy)"
+            )
+        lines.append(line)
     return lines
 
 

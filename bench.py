@@ -1269,6 +1269,13 @@ def _serving_probe() -> dict:
     # arms — journaled so the bench trajectory records the spec win too.
     spec_row = run_spec_probe()
 
+    # KV tiering: migrated preempt-resume (host-DRAM tier) vs the re-prefill
+    # fallback at identical geometry, plus raw demote/promote bandwidth —
+    # journaled so the bench trajectory records the survivability win too.
+    from accelerate_tpu.pipeline.perf_gate import run_tiering_probe
+
+    tier_row = run_tiering_probe()
+
     # Per-request trace accounting over the staggered-mix window: blame
     # tally plus the conservation residual the tracer could not attribute
     # (serving/tracing.py) — a rising residual means the phase taxonomy is
@@ -1333,6 +1340,18 @@ def _serving_probe() -> dict:
                 "greedy_p95_inter_token_ms": spec_row["serving_greedy_itl_p95_ms"],
                 "spec_vs_greedy_itl_ratio": spec_row["serving_spec_vs_greedy_itl_ratio"],
                 "token_identical": spec_row["serving_spec_token_identical"],
+            },
+            "tiering": {
+                "migrated_resume_ms": tier_row["serving_migrated_resume_ms"],
+                "reprefill_resume_ms": tier_row["serving_reprefill_resume_ms"],
+                "migrated_vs_reprefill_ratio": tier_row[
+                    "serving_migrated_vs_reprefill_ratio"
+                ],
+                "migrations": tier_row["serving_tier_migrations"],
+                "fallback_reprefills": tier_row["serving_tier_fallback_reprefills"],
+                "demote_mb_per_s": tier_row["serving_tier_demote_mb_per_s"],
+                "promote_mb_per_s": tier_row["serving_tier_promote_mb_per_s"],
+                "token_identical": tier_row["serving_tiering_token_identical"],
             },
         }
     }

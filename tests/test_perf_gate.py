@@ -18,6 +18,7 @@ from accelerate_tpu.pipeline.perf_gate import (
     run_probe,
     run_serving_probe,
     run_spec_probe,
+    run_tiering_probe,
 )
 
 
@@ -363,6 +364,78 @@ def test_spec_probe_wins_and_matches_greedy():
     failures = evaluate(dict(_passing_measurements(), **row), load_baseline())
     spec_failures = [f for f in failures if "spec" in f]
     assert spec_failures == []
+
+
+# ---------------------------------------------------------------------------
+# tiering row (PR 20): migrated preempt-resume vs the re-prefill fallback
+# ---------------------------------------------------------------------------
+
+
+def _passing_tiering_measurements():
+    return dict(
+        _passing_spec_measurements(),
+        serving_migrated_vs_reprefill_ratio=1.4,
+        serving_tiering_active=True,
+        serving_tiering_token_identical=True,
+        serving_tier_migrations=4,
+        serving_tier_fallback_reprefills=0,
+    )
+
+
+def test_evaluate_tiering_row_thresholds():
+    """The tiering row cuts three ways: the active tripwire (a preempted
+    request silently re-prefilling instead of promoting its host-demoted
+    blocks), token identity across the HBM->host->HBM round trip, and the
+    migrated-vs-re-prefill resume ratio floor.  The tripwires carry the
+    exactness — the CPU ratio floor sits below the noise band on purpose
+    (see the baseline's _comment)."""
+    baseline = load_baseline()
+    assert baseline["require_tiering_active"] is True
+    assert 0 < baseline["min_migrated_resume_vs_reprefill_ratio"] < 1.0
+    assert evaluate(_passing_tiering_measurements(), baseline) == []
+    m = dict(_passing_tiering_measurements(), serving_tiering_active=False)
+    assert any(
+        "serving_tiering_active is False" in f for f in evaluate(m, baseline)
+    )
+    m = dict(_passing_tiering_measurements(), serving_tiering_token_identical=False)
+    assert any(
+        "round trip corrupted KV state" in f for f in evaluate(m, baseline)
+    )
+    m = dict(_passing_tiering_measurements(), serving_migrated_vs_reprefill_ratio=0.5)
+    assert any("stopped beating re-prefilling" in f for f in evaluate(m, baseline))
+    # tiering arm never ran: no tiering judgments at all
+    assert evaluate(_passing_spec_measurements(), baseline) == []
+
+
+@pytest.mark.slow
+def test_tiering_row_fails_when_no_tiering_degraded(monkeypatch):
+    """ACCELERATE_TPU_PERF_GATE_DEGRADE=no-tiering builds the tiered arm
+    with host_blocks=0 — re-prefill resume masquerading as the tiered
+    config.  The serving_tiering_active tripwire must fail the row; the
+    measured ratio typically stays NEAR 1.0 here (re-prefill vs re-prefill)
+    while the floor is 0.9, which is exactly why the tripwire exists: the
+    ratio floor alone can never catch a silent fallback.  Probe-level
+    self-test; the cheap evaluate()-row tests run in tier-1."""
+    monkeypatch.setenv("ACCELERATE_TPU_PERF_GATE_DEGRADE", "no-tiering")
+    row = run_tiering_probe(cycles=2)
+    assert row["serving_tiering_active"] is False
+    failures = evaluate(dict(_passing_measurements(), **row), load_baseline())
+    assert any("serving_tiering_active is False" in f for f in failures)
+
+
+@pytest.mark.slow
+def test_tiering_probe_wins_and_stays_token_identical():
+    """The real tiering probe on CPU: promotions land with zero fallback
+    re-prefills, outputs survive the HBM->host->HBM round trip
+    token-identically, and the full row passes the committed gate."""
+    row = run_tiering_probe(cycles=2)
+    assert row["serving_tiering_active"] is True
+    assert row["serving_tiering_token_identical"] is True
+    assert row["serving_tier_migrations"] >= 2
+    assert row["serving_tier_fallback_reprefills"] == 0
+    failures = evaluate(dict(_passing_measurements(), **row), load_baseline())
+    tier_failures = [f for f in failures if "tier" in f or "migrated" in f]
+    assert tier_failures == []
 
 
 # ---------------------------------------------------------------------------

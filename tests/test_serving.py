@@ -346,6 +346,7 @@ def test_preemption_keeps_outputs_token_identical(gpt2_setup):
         assert out == want[ids[rid]]
 
 
+@pytest.mark.slow
 def test_int8_kv_cache_pages_and_stays_token_identical():
     cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32, kv_cache_quant=True)
     params = gpt2.init_params(cfg, jax.random.key(0))
@@ -419,7 +420,10 @@ def test_chunked_prefill_interleaves_with_decode(gpt2_setup):
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("decode_path", ["paged", "dense"])
+@pytest.mark.parametrize(
+    "decode_path",
+    ["paged", pytest.param("dense", marks=pytest.mark.slow)],
+)
 @pytest.mark.parametrize("quant", [False, True])
 def test_decode_path_matrix_token_identical(decode_path, quant):
     """The acceptance matrix: paged decode x int8 KV x forced preemption x

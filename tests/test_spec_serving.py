@@ -304,7 +304,10 @@ def test_window_kernel_single_row_degenerates_to_decode_shape():
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("decode_path", ["paged", "dense"])
+@pytest.mark.parametrize(
+    "decode_path",
+    ["paged", pytest.param("dense", marks=pytest.mark.slow)],
+)
 @pytest.mark.parametrize("quant", [False, True])
 def test_spec_matrix_token_identical(decode_path, quant):
     """spec x {paged, dense} x {fp, int8} under a randomized mix with a pool
@@ -389,6 +392,7 @@ def test_llama_gqa_spec_window_kernel_token_identical():
     assert eng.stats()["spec"]["rounds"] > 0
 
 
+@pytest.mark.slow
 def test_spec_journal_recovery_token_identical(gpt2_setup, tmp_path):
     """An abandoned speculative engine's journal rebuilds in a SPECULATIVE
     successor and finishes token-identically — greedy acceptance makes the
@@ -426,6 +430,7 @@ def test_spec_journal_recovery_token_identical(gpt2_setup, tmp_path):
         assert done[f"t{i}"] == want[i], f"recovered request {i} diverged"
 
 
+@pytest.mark.slow
 def test_spec_forced_preemption_mid_chunk_token_identical(gpt2_setup):
     """Preempting a slot whose emitted tokens landed in multi-token chunks:
     the re-prefill feeds prompt+emitted and the request still finishes
